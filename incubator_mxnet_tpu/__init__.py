@@ -1,0 +1,68 @@
+"""incubator_mxnet_tpu — a TPU-native deep-learning framework with the
+capabilities of Apache MXNet 1.x (the reference: sudhirshahu51/incubator-mxnet).
+
+Not a port: the reference's layered C++ core (dependency engine, NNVM graph
+IR, mshadow, KVStore/ps-lite — SURVEY.md §1) is re-designed around JAX/XLA:
+
+  - the async dependency engine  → XLA async dispatch (SURVEY.md §7.3)
+  - NNVM + CachedOp              → trace-to-XLA compilation (``hybridize()``)
+  - mshadow/cuDNN kernels        → jnp/lax + Pallas TPU kernels
+  - KVStore/ps-lite/NCCL         → jax.sharding + ICI/DCN collectives
+
+Usage mirrors the reference::
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, autograd, gluon
+
+    x = nd.ones((2, 3), ctx=mx.tpu(0))
+    with autograd.record():
+        y = (x * 2).sum()
+    y.backward()
+"""
+
+__version__ = "0.1.0"
+
+import os as _os
+
+import jax as _jax
+
+# Reference parity: float32 ops compute in true float32 (the reference's
+# cuBLAS/oneDNN fp32 paths). The TPU perf path uses bfloat16 *dtypes* (AMP),
+# which this default does not affect. Override via MXTPU_MATMUL_PRECISION.
+_jax.config.update(
+    "jax_default_matmul_precision",
+    _os.environ.get("MXTPU_MATMUL_PRECISION", "float32"))
+
+from . import base
+from .base import MXNetError
+from . import context
+from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, \
+    num_gpus, num_tpus, num_devices
+from . import random
+from . import autograd
+from . import ops
+from . import ndarray
+from . import ndarray as nd
+from .ndarray.ndarray import NDArray
+
+_LAZY_SUBMODULES = (
+    "gluon", "symbol", "sym", "optimizer", "kvstore", "metric", "io", "image",
+    "initializer", "lr_scheduler", "profiler", "amp", "parallel", "models",
+    "runtime", "test_utils", "callback", "util", "engine", "recordio",
+    "numpy", "npx",
+)
+
+
+def __getattr__(name):
+    """Lazy submodule loading (keeps import light and cycle-free)."""
+    if name in _LAZY_SUBMODULES:
+        import importlib
+
+        alias = {"sym": ".symbol", "npx": ".numpy_extension",
+                 "numpy": ".numpy_shim", "recordio": ".io.recordio",
+                 "lr_scheduler": ".optimizer.lr_scheduler"}
+        modpath = alias.get(name, "." + name)
+        mod = importlib.import_module(modpath, __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,358 @@
+"""Imperative autograd: tape recording + backward over ``jax.vjp``.
+
+TPU-native re-design of the reference's imperative autograd
+(`src/imperative/imperative.cc` ``Imperative::RecordOp/Backward``, AGInfo
+nodes attached to NDArrays; Python surface `python/mxnet/autograd.py` —
+file-level citations, see SURVEY.md provenance caveat).
+
+Design (SURVEY.md §7.1 stage 2):
+  - While ``record()`` is active, every imperative op appends an ``_AGNode``
+    holding its *pure* function and input arrays — the tape is a DAG of pure
+    closures, not a mutated graph IR.
+  - ``backward()`` topo-sorts the reachable tape and runs ``jax.vjp`` per
+    node, accumulating cotangents. This trades one extra forward execution
+    per node for zero tape-recording overhead on the hot path — the fast
+    path for training is ``HybridBlock.hybridize()``, where the whole step
+    becomes ONE ``jax.vjp`` of a jitted function (CachedOp analogue).
+  - ``grad_req`` semantics ('write'/'add'/'null') follow the reference's
+    kWriteTo/kAddTo contract (SURVEY.md §7.2).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "mark_variables", "backward", "grad", "Function",
+           "set_recording", "set_training"]
+
+_STATE = threading.local()
+
+
+def _st():
+    if not hasattr(_STATE, "recording"):
+        _STATE.recording = False
+        _STATE.training = False
+    return _STATE
+
+
+def is_recording() -> bool:
+    return _st().recording
+
+
+def is_training() -> bool:
+    return _st().training
+
+
+def set_recording(is_record: bool) -> bool:
+    st = _st()
+    prev, st.recording = st.recording, is_record
+    return prev
+
+
+def set_training(train: bool) -> bool:
+    st = _st()
+    prev, st.training = st.training, train
+    return prev
+
+
+class _ModeScope:
+    def __init__(self, recording: Optional[bool], training: Optional[bool]):
+        self._rec = recording
+        self._train = training
+
+    def __enter__(self):
+        st = _st()
+        self._prev = (st.recording, st.training)
+        if self._rec is not None:
+            st.recording = self._rec
+        if self._train is not None:
+            st.training = self._train
+        return self
+
+    def __exit__(self, *exc):
+        st = _st()
+        st.recording, st.training = self._prev
+
+
+def record(train_mode: bool = True) -> _ModeScope:
+    """Scope in which executed ops are recorded for differentiation
+    (parity: ``mx.autograd.record``)."""
+    return _ModeScope(recording=True, training=train_mode)
+
+
+def pause(train_mode: bool = False) -> _ModeScope:
+    """Scope in which ops are NOT recorded (parity: ``mx.autograd.pause``)."""
+    return _ModeScope(recording=False, training=train_mode)
+
+
+def train_mode() -> _ModeScope:
+    return _ModeScope(recording=None, training=True)
+
+
+def predict_mode() -> _ModeScope:
+    return _ModeScope(recording=None, training=False)
+
+
+class _AGNode:
+    """One recorded op: a pure fn + its primal inputs + output arrays.
+
+    The analogue of the reference's ``AGInfo``/``nnvm::Node`` pair; the
+    "graph" is the web of nodes reachable through ``NDArray._ag_node``.
+    """
+
+    __slots__ = ("pure_fn", "primals", "owners", "outputs", "custom_vjp",
+                 "name", "tuple_out")
+
+    def __init__(self, pure_fn, primals, owners, outputs, custom_vjp=None,
+                 name="", tuple_out=False):
+        self.pure_fn = pure_fn      # fn(*primals) -> array | tuple(arrays)
+        self.primals = primals      # list[jax.Array]
+        self.owners = owners        # list[NDArray | None], aligned w/ primals
+        self.outputs = outputs      # list[NDArray]
+        self.custom_vjp = custom_vjp  # optional fn(out_cots) -> in_cots
+        self.name = name
+        self.tuple_out = tuple_out  # pure_fn returns a tuple (even if len 1)
+
+
+def _record_node(pure_fn, primals, owners, outputs, custom_vjp=None, name="",
+                 tuple_out=False):
+    node = _AGNode(pure_fn, list(primals), list(owners), list(outputs),
+                   custom_vjp, name, tuple_out)
+    for idx, o in enumerate(node.outputs):
+        o._ag_node = node
+        o._ag_idx = idx
+    return node
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers to variables (parity:
+    ``mx.autograd.mark_variables``)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, g, req in zip(variables, gradients, grad_reqs):
+        var._ag_node = None
+        var._ag_grad = g
+        var._ag_grad_req = req
+
+
+def _topo(heads) -> List[_AGNode]:
+    """Topological order of tape nodes reachable from head arrays."""
+    roots = [h._ag_node for h in heads if getattr(h, "_ag_node", None) is not None]
+    order: List[_AGNode] = []
+    seen: Dict[int, int] = {}  # id(node) -> 0 visiting, 1 done
+    stack = [(n, False) for n in roots]
+    while stack:
+        node, processed = stack.pop()
+        nid = id(node)
+        if processed:
+            seen[nid] = 1
+            order.append(node)
+            continue
+        if nid in seen:
+            continue
+        seen[nid] = 0
+        stack.append((node, True))
+        for owner in node.owners:
+            child = getattr(owner, "_ag_node", None) if owner is not None else None
+            if child is not None and id(child) not in seen:
+                stack.append((child, False))
+    return order  # already child-before-parent; reverse for backward
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Run backward from ``heads``, accumulating into attached ``.grad``
+    buffers (parity: ``MXAutogradBackwardEx``)."""
+    from .ndarray.ndarray import NDArray  # local: avoid import cycle
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+    if len(heads) != len(head_grads):
+        raise MXNetError("heads and head_grads length mismatch")
+
+    # cotangent store: id(NDArray) -> jax.Array
+    cots: Dict[int, jax.Array] = {}
+    keep: Dict[int, object] = {}  # keep NDArrays alive while we hold their ids
+    # leaf accumulation: id(NDArray) -> jax.Array
+    leaf_acc: Dict[int, jax.Array] = {}
+    leaves: Dict[int, object] = {}
+
+    def _add(store, arr, val):
+        key = id(arr)
+        if key in store:
+            store[key] = store[key] + val
+        else:
+            store[key] = val
+
+    for h, hg in zip(heads, head_grads):
+        g = hg._data if hasattr(hg, "_data") else hg
+        if g is None:
+            g = jnp.ones(h.shape, h.dtype)
+        recorded = getattr(h, "_ag_node", None) is not None
+        marked = getattr(h, "_ag_grad", None) is not None
+        if recorded:
+            _add(cots, h, g)
+            keep[id(h)] = h
+        if marked:
+            _add(leaf_acc, h, g)
+            leaves[id(h)] = h
+        if not recorded and not marked:
+            raise MXNetError(
+                "head array is neither recorded nor a marked variable; "
+                "did you forget autograd.record() or attach_grad()?")
+
+    order = _topo(heads)
+    with _ModeScope(recording=False, training=train_mode):
+        for node in reversed(order):
+            out_cots = []
+            any_cot = False
+            for o in node.outputs:
+                c = cots.get(id(o))
+                if c is None:
+                    c = jnp.zeros(o.shape, o.dtype)
+                else:
+                    any_cot = True
+                out_cots.append(c)
+            if not any_cot:
+                continue
+            if node.custom_vjp is not None:
+                in_cots = node.custom_vjp(out_cots)
+            else:
+                _, vjp_fn = jax.vjp(node.pure_fn, *node.primals)
+                seed = tuple(out_cots) if node.tuple_out or len(out_cots) > 1 \
+                    else out_cots[0]
+                in_cots = vjp_fn(seed)
+            for owner, ic in zip(node.owners, in_cots):
+                if owner is None or ic is None:
+                    continue
+                if ic.dtype == jax.dtypes.float0:
+                    continue  # non-differentiable input (e.g. PRNG key)
+                # an array can be BOTH an intermediate (has a tape node to
+                # propagate through) and a marked variable (grad() /
+                # attach_grad on a non-leaf): feed both paths
+                child = getattr(owner, "_ag_node", None)
+                if child is not None:
+                    _add(cots, owner, ic)
+                    keep[id(owner)] = owner
+                if getattr(owner, "_ag_grad", None) is not None:
+                    _add(leaf_acc, owner, ic)
+                    leaves[id(owner)] = owner
+
+    # flush leaf accumulators honoring grad_req
+    for key, total in leaf_acc.items():
+        leaf = leaves[key]
+        req = getattr(leaf, "_ag_grad_req", "write")
+        if req == "null":
+            continue
+        gbuf = leaf._ag_grad
+        if req == "add":
+            gbuf._data = gbuf._data + total.astype(gbuf.dtype)
+        else:  # write
+            gbuf._data = total.astype(gbuf.dtype)
+
+    if not retain_graph:
+        for node in order:
+            for o in node.outputs:
+                o._ag_node = None
+            node.outputs = []
+            node.owners = []
+            node.primals = []
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Return gradients of heads w.r.t. variables without touching their
+    ``.grad`` buffers (parity: ``mx.autograd.grad``)."""
+    from .ndarray.ndarray import NDArray
+
+    if create_graph:
+        raise MXNetError(
+            "create_graph=True (higher-order imperative grad) is not "
+            "supported; hybridize the block and use jax.grad composition "
+            "for higher-order derivatives.")
+    if isinstance(variables, NDArray):
+        variables = [variables]
+    if retain_graph is None:
+        retain_graph = create_graph
+    # temporarily mark variables with fresh buffers
+    saved = [(getattr(v, "_ag_grad", None), getattr(v, "_ag_grad_req", "write"))
+             for v in variables]
+    zeros = []
+    for v in variables:
+        z = v.__class__(jnp.zeros(v.shape, v.dtype))
+        zeros.append(z)
+        v._ag_grad = z
+        v._ag_grad_req = "write"
+    try:
+        backward(heads, head_grads, retain_graph=retain_graph,
+                 train_mode=train_mode)
+        return [v._ag_grad for v in variables]
+    finally:
+        for v, (g, req) in zip(variables, saved):
+            v._ag_grad = g
+            v._ag_grad_req = req
+
+
+class Function:
+    """User-defined differentiable function (parity:
+    ``mx.autograd.Function``, `python/mxnet/autograd.py`).
+
+    Subclass and implement ``forward(self, *inputs)`` and
+    ``backward(self, *output_grads)`` over NDArrays. Inside both, autograd
+    recording is paused.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *arrays):
+        self._saved = arrays
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray, _wrap
+
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if single else list(outputs)
+        if is_recording():
+            def custom_vjp(out_cots, _self=self, _n_in=len(inputs)):
+                with pause():
+                    gs = _self.backward(*[_wrap(c) for c in out_cots])
+                if not isinstance(gs, (list, tuple)):
+                    gs = [gs]
+                if len(gs) != _n_in:
+                    raise MXNetError(
+                        f"Function.backward returned {len(gs)} grads for "
+                        f"{_n_in} inputs")
+                return [g._data if g is not None else None for g in gs]
+
+            _record_node(
+                pure_fn=None,
+                primals=[x._data for x in inputs],
+                owners=list(inputs),
+                outputs=outs,
+                custom_vjp=custom_vjp,
+                name=type(self).__name__,
+            )
+        return outs[0] if single else outs

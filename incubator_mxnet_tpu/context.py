@@ -1,0 +1,169 @@
+"""Device context.
+
+Re-design of the reference's ``Context`` (`python/mxnet/context.py`,
+`include/mxnet/base.h` ``Context`` struct; file-level citation — see
+SURVEY.md provenance caveat) for TPU:
+
+  - ``mx.tpu(i)`` is the first-class accelerator context (the north-star
+    requirement: "Add TPU as a first-class MXNet context").
+  - ``mx.gpu(i)`` is kept as a compatibility alias that resolves to the
+    accelerator backend so reference training scripts run unmodified.
+  - ``mx.cpu()`` maps to the JAX CPU backend.
+
+A Context resolves lazily to a concrete ``jax.Device``; when tests force
+``JAX_PLATFORMS=cpu`` with a virtual 8-device host platform, ``tpu(i)``
+degrades to host device ``i`` so multi-device code paths stay testable
+(SURVEY.md §4 idiom 4).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import jax
+
+from .base import MXNetError
+
+__all__ = ["Context", "cpu", "gpu", "tpu", "cpu_pinned", "current_context",
+           "num_gpus", "num_tpus", "num_devices"]
+
+
+def _accelerator_devices() -> List["jax.Device"]:
+    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    if devs:
+        return devs
+    # CPU-only process (tests / dry-runs): every host device doubles as a
+    # virtual accelerator so tpu(i) keeps working.
+    return list(jax.devices())
+
+
+def _cpu_devices() -> List["jax.Device"]:
+    try:
+        return list(jax.devices("cpu"))
+    except RuntimeError:
+        return list(jax.devices())
+
+
+class Context:
+    """Device context holding a device type and id.
+
+    Parameters
+    ----------
+    device_type : {'cpu', 'gpu', 'tpu', 'cpu_pinned', 'cpu_shared'}
+    device_id : int
+    """
+
+    # numeric codes mirror the reference's DeviceType enum
+    # (include/mxnet/base.h); 6 is our TPU extension.
+    devtype2str = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 5: "cpu_shared", 6: "tpu"}
+    devstr2type = {v: k for k, v in devtype2str.items()}
+
+    _default = threading.local()
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        if isinstance(device_type, Context):
+            device_id = device_type.device_id
+            device_type = device_type.device_typestr
+        if device_type not in Context.devstr2type:
+            raise MXNetError(f"unknown device type {device_type!r}")
+        self.device_typeid = Context.devstr2type[device_type]
+        self.device_id = device_id
+
+    @property
+    def device_type(self) -> str:
+        return Context.devtype2str[self.device_typeid]
+
+    # the reference exposes .device_type as a string property; keep both names
+    device_typestr = device_type
+
+    @property
+    def jax_device(self) -> "jax.Device":
+        """Resolve to a concrete jax.Device."""
+        if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
+            devs = _cpu_devices()
+        else:  # 'gpu' is an alias for the accelerator backend on this stack
+            devs = _accelerator_devices()
+        if not devs:
+            raise MXNetError(f"no devices for context {self}")
+        return devs[self.device_id % len(devs)]
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, Context)
+            and self.device_typeid == other.device_typeid
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_typeid, self.device_id))
+
+    def __repr__(self):
+        return f"{self.device_type}({self.device_id})"
+
+    __str__ = __repr__
+
+    def __enter__(self):
+        if not hasattr(Context._default, "stack"):
+            Context._default.stack = []
+        Context._default.stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        Context._default.stack.pop()
+
+    def empty_cache(self):
+        """Parity shim for the reference's pooled GPU allocator cache release
+        (`src/storage/pooled_storage_manager.h`). XLA owns device memory; we
+        just trigger a host GC + live-buffer sweep."""
+        import gc
+
+        gc.collect()
+
+
+def cpu(device_id: int = 0) -> Context:
+    """Return a CPU context."""
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id: int = 0) -> Context:
+    """Parity alias; XLA manages pinned staging buffers internally."""
+    return Context("cpu_pinned", device_id)
+
+
+def tpu(device_id: int = 0) -> Context:
+    """Return a TPU context — the first-class accelerator device."""
+    return Context("tpu", device_id)
+
+
+def gpu(device_id: int = 0) -> Context:
+    """Compatibility alias: reference scripts using ``mx.gpu()`` get the
+    accelerator (TPU) backend."""
+    return Context("gpu", device_id)
+
+
+def num_tpus() -> int:
+    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    if devs:
+        return len(devs)
+    return len(jax.devices())
+
+
+def num_gpus() -> int:
+    """Reference-parity name (`mx.context.num_gpus`)."""
+    return num_tpus()
+
+
+def num_devices() -> int:
+    return len(jax.devices())
+
+
+def current_context() -> Context:
+    """The context from the innermost ``with ctx:`` block, else the default
+    (accelerator if present, else cpu)."""
+    stack = getattr(Context._default, "stack", None)
+    if stack:
+        return stack[-1]
+    if any(d.platform != "cpu" for d in jax.devices()):
+        return tpu(0)
+    return cpu(0)

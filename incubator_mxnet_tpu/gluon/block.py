@@ -1,0 +1,547 @@
+"""Gluon Block / HybridBlock / CachedOp.
+
+Re-design of `python/mxnet/gluon/block.py` + `src/imperative/cached_op.cc`
+(file-level citations — SURVEY.md caveat).
+
+The reference's ``hybridize()`` captures a HybridBlock's op sequence into an
+NNVM graph on first call and replays it with a static memory plan
+(SURVEY.md §2.1 CachedOp). The TPU-native CachedOp instead traces the
+block's forward ONCE per input signature into a single jitted XLA program:
+
+  - shape/dtype signature  → jit cache key ("per-shape recompile" contract,
+    SURVEY.md §7.2);
+  - dropout keys are threaded as traced inputs (random.key_provider), so
+    replays draw fresh masks;
+  - BatchNorm running-stat updates are captured as extra outputs ("aux
+    updates") and written back after each call — the functional analogue of
+    the reference's in-place aux-state mutation;
+  - under ``autograd.record()``, the whole cached op is ONE tape node whose
+    backward is the XLA-compiled transpose (``jax.vjp`` of the jitted
+    program) — fwd+bwd each run once, fully fused, which is how the
+    reference's "hybridize for speed" contract maps to XLA.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.tree_util as jtu
+
+from .. import autograd, random as _random
+from ..base import DeferredInitializationError, MXNetError
+from ..context import Context
+from ..ndarray import NDArray
+from ..ndarray import ndarray as _ndmod
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "CachedOp", "nd"]
+
+# the functional namespace handed to hybrid_forward as `F`
+from .. import ndarray as nd  # noqa: E402
+
+_naming = threading.local()
+
+
+class _BlockScope:
+    """Name manager (parity: block.py _BlockScope): auto prefixes
+    ``dense0_``, ``conv1_`` … per class within the enclosing scope."""
+
+    def __init__(self, block):
+        self._block = block
+        self._counter: Dict[str, int] = {}
+
+    @staticmethod
+    def create(prefix, params, hint) -> Tuple[str, ParameterDict]:
+        current = getattr(_naming, "current", None)
+        if current is None:
+            if prefix is None:
+                counter = getattr(_naming, "counter", {})
+                count = counter.get(hint, 0)
+                counter[hint] = count + 1
+                _naming.counter = counter
+                prefix = f"{hint}{count}_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, shared=params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            current._counter[hint] = count + 1
+            prefix = f"{hint}{count}_"
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix)
+        else:
+            params = ParameterDict(params.prefix, shared=params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        self._old = getattr(_naming, "current", None)
+        _naming.current = self
+        return self
+
+    def __exit__(self, *exc):
+        _naming.current = self._old
+
+
+class Block:
+    """Base class for all layers/models (parity: gluon.Block)."""
+
+    def __init__(self, prefix=None, params=None):
+        hint = self._alias()
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(prefix, params, hint)
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children: Dict[str, "Block"] = {}
+        self._reg_params: Dict[str, Parameter] = {}
+        self._forward_hooks: List = []
+        self._forward_pre_hooks: List = []
+
+    def _alias(self) -> str:
+        return type(self).__name__.lower()
+
+    # -- attribute magic: auto-register children & params -------------- #
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = self.__dict__.get("_children")
+            if existing is not None:
+                existing[name] = value
+        elif isinstance(value, Parameter):
+            reg = self.__dict__.get("_reg_params")
+            if reg is not None:
+                reg[name] = value
+        super().__setattr__(name, value)
+
+    @property
+    def prefix(self) -> str:
+        return self._prefix
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self) -> ParameterDict:
+        return self._params
+
+    def collect_params(self, select: Optional[str] = None) -> ParameterDict:
+        """All parameters of self + descendants, optionally regex-filtered
+        (parity: Block.collect_params)."""
+        ret = ParameterDict(self._params.prefix)
+        if select is None:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            for name, p in self.params.items():
+                if pattern.match(name):
+                    ret._params[name] = p
+        for child in self._children.values():
+            sub = child.collect_params(select)
+            for name, p in sub.items():
+                if name not in ret._params:
+                    ret._params[name] = p
+        # params registered directly on this block (they live in self._params
+        # already via ParameterDict.get; _reg_params may add externally
+        # created ones)
+        for name, p in self._reg_params.items():
+            if p.name not in ret._params and (
+                    select is None or re.compile(select).match(p.name)):
+                ret._params[p.name] = p
+        return ret
+
+    def _collect_params_with_prefix(self, prefix="") -> Dict[str, Parameter]:
+        """Structural names for save/load (parity: gluon structured naming:
+        attribute paths like '0.weight')."""
+        if prefix:
+            prefix += "."
+        ret = {prefix + name: p for name, p in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    # -- lifecycle ------------------------------------------------------ #
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        self.collect_params().initialize(init=init, ctx=ctx, verbose=verbose,
+                                         force_reinit=force_reinit)
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for _, p in self.params.items():
+            p.cast(dtype)
+        for p in self._reg_params.values():
+            p.cast(dtype)
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+
+    def register_child(self, block, name=None):
+        self._children[name or str(len(self._children))] = block
+
+    # -- save/load ------------------------------------------------------ #
+    def save_parameters(self, filename, deduplicate=False):
+        """Structural-name save (parity: Block.save_parameters)."""
+        from ..ndarray import save as nd_save
+        params = self._collect_params_with_prefix()
+        nd_save(filename, {k: p.data() for k, p in params.items()})
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False):
+        from ..ndarray import load as nd_load
+        loaded = nd_load(filename)
+        params = self._collect_params_with_prefix()
+        for name, p in params.items():
+            if name in loaded:
+                p.set_data(loaded[name])
+                if ctx is not None:
+                    p.reset_ctx(ctx)
+            elif not allow_missing:
+                raise MXNetError(f"parameter {name} missing in {filename}")
+        if not ignore_extra:
+            extra = set(loaded) - set(params)
+            if extra:
+                raise MXNetError(
+                    f"extra parameters in {filename}: {sorted(extra)}")
+
+    # -- call ----------------------------------------------------------- #
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        """Print a per-layer summary (parity: Block.summary)."""
+        lines = [f"{'Layer':<40}{'Output':<20}"]
+        hooks = []
+
+        def add_hook(block):
+            def hook(blk, ins, out):
+                shape = out.shape if hasattr(out, "shape") else "?"
+                lines.append(f"{blk.name:<40}{str(shape):<20}")
+            block._forward_hooks.append(hook)
+            hooks.append((block, hook))
+
+        self.apply(add_hook)
+        try:
+            self(*inputs)
+        finally:
+            for blk, hook in hooks:
+                blk._forward_hooks.remove(hook)
+        print("\n".join(lines))
+
+    def __repr__(self):
+        lines = [type(self).__name__ + "("]
+        for name, child in self._children.items():
+            child_repr = repr(child).replace("\n", "\n  ")
+            lines.append(f"  ({name}): {child_repr}")
+        lines.append(")")
+        return "\n".join(lines)
+
+
+def _flatten_args(args):
+    """Flatten nested (lists of) NDArrays, keeping non-arrays static."""
+    flat, treedef = jtu.tree_flatten(
+        args, is_leaf=lambda x: isinstance(x, NDArray))
+    arr_pos = [i for i, x in enumerate(flat) if isinstance(x, NDArray)]
+    return flat, treedef, arr_pos
+
+
+class CachedOp:
+    """Trace-to-XLA executor for a HybridBlock (reference:
+    src/imperative/cached_op.cc — re-designed, see module docstring)."""
+
+    def __init__(self, block: "HybridBlock"):
+        self.block = block
+        self._cache: Dict = {}
+
+    def _params(self) -> List[Parameter]:
+        return list(self.block.collect_params().values())
+
+    def __call__(self, *args):
+        params = self._params()
+        param_nds = [p.data() for p in params]
+        flat, treedef, arr_pos = _flatten_args(args)
+        input_nds = [flat[i] for i in arr_pos]
+        training = autograd.is_training()
+
+        sig = (
+            tuple((a.shape, str(a.dtype)) for a in input_nds),
+            tuple((p.shape, str(p.dtype)) for p in param_nds),
+            tuple(i for i, x in enumerate(flat) if not isinstance(x, NDArray)),
+            tuple(repr(x) for x in flat if not isinstance(x, NDArray)),
+            training,
+        )
+        entry = self._cache.get(sig)
+        if entry is None:
+            entry = self._build(params, flat, treedef, arr_pos, training)
+            self._cache[sig] = entry
+
+        rng = _random.new_key()
+        primals = ([p._data for p in param_nds]
+                   + [a._data for a in input_nds] + [rng])
+        if autograd.is_recording():
+            # vjp through the jitted program: forward runs once compiled,
+            # backward replays the compiled transpose (no double forward)
+            out_vals, vjp_fn = jax.vjp(entry["jit"], *primals)
+            outs = [NDArray(v) for v in out_vals]
+            owners = list(param_nds) + list(input_nds) + [None]
+
+            def custom_vjp(out_cots, _vjp=vjp_fn):
+                return _vjp(tuple(out_cots))
+
+            autograd._record_node(entry["jit"], primals, owners, outs,
+                                  custom_vjp=custom_vjp,
+                                  name=f"CachedOp({self.block.name})")
+        else:
+            out_vals = entry["jit"](*primals)
+            outs = [NDArray(v) for v in out_vals]
+
+        n_out = entry["n_out"]
+        # write back aux updates (running stats), detached
+        for (pi, _), val in zip(entry["aux_slots"], outs[n_out:]):
+            params[pi]._data._data = val._data
+        real = outs[:n_out]
+        return jtu.tree_unflatten(entry["out_treedef"],
+                                  [r for r in real])
+
+    def _build(self, params, flat, treedef, arr_pos, training):
+        """Trace the block once to discover output & aux structure, then
+        return the pure function + its jit."""
+        n_params = len(params)
+        n_inputs = len(arr_pos)
+        cell = {}  # filled during first trace
+
+        block = self.block
+
+        def pure(*primals):
+            param_vals = primals[:n_params]
+            input_vals = primals[n_params:n_params + n_inputs]
+            rng = primals[-1]
+            # bind tracer values into Parameters
+            saved = [p._data for p in params]
+            aux_before = list(saved)
+            for p, v in zip(params, param_vals):
+                p._data = NDArray(v)
+            flat2 = list(flat)
+            for pos, v in zip(arr_pos, input_vals):
+                flat2[pos] = NDArray(v)
+            call_args = jtu.tree_unflatten(treedef, flat2)
+            try:
+                with _hybrid_trace_scope(), _random.key_provider(rng), \
+                        autograd._ModeScope(recording=False, training=training):
+                    out = block.hybrid_call(*call_args)
+                out_flat, out_treedef = jtu.tree_flatten(
+                    out, is_leaf=lambda x: isinstance(x, NDArray))
+                out_vals = [o._data if isinstance(o, NDArray) else o
+                            for o in out_flat]
+                # aux updates: params whose ._data was replaced during trace
+                aux_slots = []
+                aux_vals = []
+                for i, p in enumerate(params):
+                    if p._data is not None and \
+                            p._data._data is not param_vals[i]:
+                        aux_slots.append((i, p.name))
+                        aux_vals.append(p._data._data)
+                cell["n_out"] = len(out_vals)
+                cell["out_treedef"] = out_treedef
+                cell["aux_slots"] = aux_slots
+            finally:
+                for p, s in zip(params, saved):
+                    p._data = s
+            return tuple(out_vals) + tuple(aux_vals)
+
+        jitted = jax.jit(pure)
+        return _CacheEntry(pure, jitted, cell)
+
+
+class _CacheEntry(dict):
+    """Entry whose structure fields resolve after the first trace."""
+
+    def __init__(self, fn, jitted, cell):
+        super().__init__(fn=fn, jit=jitted)
+        self._cell = cell
+
+    def __getitem__(self, key):
+        if key in ("n_out", "out_treedef", "aux_slots"):
+            if key not in self._cell:
+                # force a trace via eval_shape? structure is filled on first
+                # real execution instead — callers always execute first.
+                raise MXNetError("CachedOp structure accessed before trace")
+            return self._cell[key]
+        return super().__getitem__(key)
+
+
+_trace_state = threading.local()
+
+
+class _hybrid_trace_scope:
+    """Marks 'we are inside a CachedOp trace' so nested hybridized blocks
+    inline into the parent graph instead of nesting jits (the reference
+    builds one NNVM graph for the whole hybridized subtree)."""
+
+    def __enter__(self):
+        self._prev = getattr(_trace_state, "active", False)
+        _trace_state.active = True
+        return self
+
+    def __exit__(self, *exc):
+        _trace_state.active = self._prev
+
+
+def in_hybrid_trace() -> bool:
+    return getattr(_trace_state, "active", False)
+
+
+class HybridBlock(Block):
+    """A Block that can be compiled to one XLA program
+    (parity: gluon.HybridBlock; CachedOp contract — see module docstring).
+
+    Subclasses implement ``hybrid_forward(F, x, *args, **params)`` where
+    ``F`` is the ``nd`` namespace and params arrive as keyword NDArrays.
+    """
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_op: Optional[CachedOp] = None
+        self._flags = {}
+
+    def hybridize(self, active=True, static_alloc=False, static_shape=False,
+                  **kwargs):
+        """Enable compiled execution. static_alloc/static_shape accepted for
+        source parity; XLA always plans memory statically per signature."""
+        self._active = active
+        self._flags = dict(static_alloc=static_alloc,
+                           static_shape=static_shape, **kwargs)
+        if not active:
+            self._cached_op = None
+        super().hybridize(active, **kwargs)
+
+    def infer_shape(self, *args):
+        """Complete deferred param shapes from input shapes. Built-in layers
+        override; custom blocks with deferred params that cannot infer get a
+        clear error (the reference runs symbolic shape inference here)."""
+        raise MXNetError(
+            f"{type(self).__name__}: cannot infer parameter shapes; "
+            f"provide explicit shapes (in_units/in_channels) or override "
+            f"infer_shape()")
+
+    def _ensure_params_ready(self, *args):
+        for p in self._reg_params.values():
+            if p._deferred_init is not None:
+                self.infer_shape(*args)
+                break
+        for p in self._reg_params.values():
+            if p._deferred_init is not None:
+                p._finish_deferred_init()
+
+    def hybrid_call(self, *args):
+        """The un-cached forward: deferred-init then hybrid_forward with
+        params bound. Used both eagerly and under the CachedOp trace."""
+        self._ensure_params_ready(*args)
+        try:
+            kwargs = {name: p.data() for name, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self._ensure_params_ready(*args)
+            kwargs = {name: p.data() for name, p in self._reg_params.items()}
+        return self.hybrid_forward(nd, *args, **kwargs)
+
+    def forward(self, *args):
+        if self._active and not in_hybrid_trace():
+            # deferred params must be materialized before tracing; do the
+            # shape-inference dance eagerly first
+            for p in self.collect_params().values():
+                if p._deferred_init is not None:
+                    return self.hybrid_call(*args)
+            if self._cached_op is None:
+                self._cached_op = CachedOp(self)
+            return self._cached_op(*args)
+        return self.hybrid_call(*args)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    def export(self, path, epoch=0):
+        """Export compiled graph + params for deployment
+        (parity: HybridBlock.export → <path>-symbol.json + <path>-NNNN.params)."""
+        from ..symbol import save_block_symbol
+        save_block_symbol(self, path, epoch)
+
+    def optimize_for(self, x, backend=None, **kwargs):
+        """Parity shim for the subgraph-backend API (reference:
+        SubgraphProperty — SURVEY.md §2.1). XLA is the only backend; this
+        just hybridizes and warms the cache."""
+        self.hybridize()
+        self(x)
+
+
+class SymbolBlock(HybridBlock):
+    """Construct a block from a saved symbolic graph
+    (parity: gluon.SymbolBlock; see symbol/)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="", params=None)
+        self._sym_outputs = outputs
+        self._sym_inputs = inputs if isinstance(inputs, (list, tuple)) \
+            else [inputs]
+        if params is not None:
+            for name, p in (params.items() if hasattr(params, "items")
+                            else params._params.items()):
+                param = Parameter(name, shape=p.shape, dtype=str(p.dtype))
+                param.set_data(p if isinstance(p, NDArray) else p.data())
+                self._reg_params[name] = param
+                self._params._params[name] = param
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        from ..symbol import load as sym_load
+        from ..ndarray import load as nd_load
+        sym = sym_load(symbol_file)
+        params = nd_load(param_file) if param_file else {}
+        block = SymbolBlock(sym, [sym.__class__.var(n) if isinstance(n, str)
+                                  else n for n in input_names])
+        for name, data in params.items():
+            clean = name.split(":", 1)[-1]
+            p = Parameter(clean, shape=data.shape, dtype=str(data.dtype))
+            p.set_data(data)
+            block._reg_params[clean] = p
+            block._params._params[clean] = p
+        return block
+
+    def hybrid_call(self, *args):
+        from ..symbol import executor as sym_exec
+        bindings = {}
+        for var, val in zip(self._sym_inputs, args):
+            bindings[var.name] = val
+        for name, p in self._reg_params.items():
+            bindings[name] = p.data()
+        return sym_exec.evaluate(self._sym_outputs, bindings)

@@ -1,0 +1,335 @@
+"""Gluon Parameter / ParameterDict.
+
+Re-design of `python/mxnet/gluon/parameter.py` (file-level citation —
+SURVEY.md caveat) with the same deferred-shape-inference contract: a
+Parameter may be created with unknown dims (0), initialization is recorded
+and finished on the first forward once shapes are inferred.
+
+Single-copy semantics: the reference replicates parameters across a ctx
+list; here SPMD replication/sharding is owned by jax.sharding (parallel/),
+so a Parameter holds ONE logical array. The list-based API (``list_data``,
+``list_ctx``…) is kept for source compatibility and returns singletons.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+
+from .. import autograd, initializer as _initializer
+from ..base import DeferredInitializationError, MXNetError
+from ..context import Context, current_context
+from ..ndarray import NDArray
+from ..ndarray.ndarray import _to_jnp_dtype
+
+__all__ = ["Parameter", "Constant", "ParameterDict"]
+
+
+def _norm_shape(shape):
+    if shape is None:
+        return None
+    if isinstance(shape, int):
+        shape = (shape,)
+    return tuple(0 if s in (None, 0) else int(s) for s in shape)
+
+
+class Parameter:
+    """A trainable (or auxiliary) array with deferred initialization."""
+
+    def __init__(self, name, grad_req="write", shape=None, dtype="float32",
+                 lr_mult=1.0, wd_mult=1.0, init=None,
+                 allow_deferred_init=False, differentiable=True,
+                 stype="default", grad_stype="default"):
+        self.name = name
+        self._grad_req = grad_req if differentiable else "null"
+        self._shape = _norm_shape(shape)
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._data: Optional[NDArray] = None
+        self._grad: Optional[NDArray] = None
+        self._deferred_init = None  # (initializer, ctx)
+        self._sharding = None  # optional PartitionSpec hint (parallel/)
+
+    # ------------------------------------------------------------------ #
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        new_shape = _norm_shape(new_shape)
+        if self._shape is None:
+            self._shape = new_shape
+            return
+        if len(self._shape) != len(new_shape) or any(
+                s not in (0, n) for s, n in zip(self._shape, new_shape)):
+            raise MXNetError(
+                f"parameter {self.name}: inferred shape {new_shape} "
+                f"incompatible with declared {self._shape}")
+        self._shape = new_shape
+
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        if req not in ("write", "add", "null"):
+            raise MXNetError(f"invalid grad_req {req!r}")
+        self._grad_req = req
+        if self._data is not None:
+            if req == "null":
+                self._grad = None
+                self._data._ag_grad = None
+            else:
+                self._init_grad()
+
+    def _shape_known(self) -> bool:
+        return self._shape is not None and all(s > 0 for s in self._shape)
+
+    # ------------------------------------------------------------------ #
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        """Allocate & initialize; defer if shape not fully known."""
+        if self._data is not None and not force_reinit:
+            return
+        if isinstance(ctx, (list, tuple)):
+            ctx = ctx[0] if ctx else None  # single-copy semantics
+        eff_init = init or self.init or default_init or _initializer.Uniform()
+        if not self._shape_known():
+            if self.allow_deferred_init:
+                self._deferred_init = (eff_init, ctx)
+                return
+            raise MXNetError(
+                f"cannot initialize parameter {self.name}: shape "
+                f"{self._shape} unknown; set allow_deferred_init=True or "
+                f"provide a full shape")
+        self._finish_init(eff_init, ctx)
+
+    def _finish_init(self, init, ctx):
+        arr = NDArray(jnp.zeros(self._shape, _to_jnp_dtype(self.dtype)))
+        _initializer.create(init)(self.name, arr)
+        if ctx is not None:
+            arr = arr.as_in_context(ctx)
+        self._data = arr
+        self._deferred_init = None
+        if self._grad_req != "null":
+            self._init_grad()
+
+    def _init_grad(self):
+        self._grad = NDArray(jnp.zeros(self._data.shape, self._data.dtype))
+        autograd.mark_variables([self._data], [self._grad], self._grad_req)
+
+    def _finish_deferred_init(self):
+        if self._deferred_init is None:
+            return
+        if not self._shape_known():
+            raise DeferredInitializationError(
+                f"parameter {self.name}: shape still unknown")
+        init, ctx = self._deferred_init
+        self._finish_init(init, ctx)
+
+    # ------------------------------------------------------------------ #
+    def data(self, ctx=None) -> NDArray:
+        if self._data is None:
+            if self._deferred_init is not None:
+                raise DeferredInitializationError(
+                    f"parameter {self.name} pending deferred init; run a "
+                    f"forward pass with real inputs first")
+            raise MXNetError(
+                f"parameter {self.name} not initialized; call .initialize()")
+        return self._data
+
+    def list_data(self) -> List[NDArray]:
+        return [self.data()]
+
+    def grad(self, ctx=None) -> NDArray:
+        if self._grad is None:
+            raise MXNetError(
+                f"parameter {self.name} has no gradient buffer "
+                f"(grad_req={self._grad_req!r})")
+        return self._grad
+
+    def list_grad(self) -> List[NDArray]:
+        return [self.grad()]
+
+    def list_ctx(self) -> List[Context]:
+        return [self.data().context]
+
+    def zero_grad(self):
+        if self._grad is not None:
+            self._grad._data = jnp.zeros_like(self._grad._data)
+
+    def set_data(self, data):
+        if not isinstance(data, NDArray):
+            from ..ndarray import array as nd_array
+            data = nd_array(data)
+        if self._data is None:
+            self.shape = data.shape
+            self._data = data.astype(self.dtype)
+            self._deferred_init = None
+            if self._grad_req != "null":
+                self._init_grad()
+        else:
+            self._data._data = data._data.astype(self._data.dtype)
+
+    def reset_ctx(self, ctx):
+        if self._data is not None:
+            self._data = self._data.as_in_context(ctx)
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is not None:
+            self._data = self._data.astype(dtype)
+            if self._grad is not None:
+                self._grad = self._grad.astype(dtype)
+                autograd.mark_variables([self._data], [self._grad],
+                                        self._grad_req)
+
+    def var(self):
+        from ..symbol import Variable
+        return Variable(self.name)
+
+    def shard(self, partition_spec):
+        """TPU extension: attach a ``PartitionSpec`` hint consumed by the
+        parallel trainer (SURVEY.md §2.3 — model/tensor parallelism)."""
+        self._sharding = partition_spec
+        return self
+
+    def __repr__(self):
+        return f"Parameter {self.name} (shape={self._shape}, dtype={self.dtype})"
+
+
+class Constant(Parameter):
+    """Non-differentiable constant parameter (parity: gluon.Constant)."""
+
+    def __init__(self, name, value):
+        import numpy as np
+        if not isinstance(value, np.ndarray):
+            value = np.asarray(value, dtype=np.float32)
+        self._value = value
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=str(value.dtype),
+                         init=_initializer.Constant(0.0))
+
+    def _finish_init(self, init, ctx):
+        arr = NDArray(jnp.asarray(self._value))
+        if ctx is not None:
+            arr = arr.as_in_context(ctx)
+        self._data = arr
+        self._deferred_init = None
+
+
+class ParameterDict:
+    """Ordered name→Parameter mapping with prefix (parity: ParameterDict)."""
+
+    def __init__(self, prefix="", shared: Optional["ParameterDict"] = None):
+        self._prefix = prefix
+        self._params: Dict[str, Parameter] = {}
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def __contains__(self, name):
+        return name in self._params
+
+    def __getitem__(self, name) -> Parameter:
+        return self._params[name]
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def get(self, name, **kwargs) -> Parameter:
+        """Get or create (parity: ParameterDict.get). Name is prefixed."""
+        full = self._prefix + name
+        if self._shared is not None and full in self._shared:
+            param = self._shared[full]
+        elif full in self._params:
+            param = self._params[full]
+        else:
+            param = Parameter(full, **kwargs)
+            self._params[full] = param
+            return param
+        # merge newly-supplied attrs into existing param
+        if "shape" in kwargs and kwargs["shape"] is not None:
+            param.shape = _norm_shape(kwargs["shape"])
+        self._params.setdefault(full, param)
+        return param
+
+    def get_constant(self, name, value=None) -> Constant:
+        full = self._prefix + name
+        if full not in self._params:
+            self._params[full] = Constant(full, value)
+        return self._params[full]
+
+    def update(self, other: "ParameterDict"):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise MXNetError(f"duplicate parameter name {k}")
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        for param in self._params.values():
+            param.initialize(init=None, ctx=ctx, default_init=init,
+                             force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self._params.values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for p in self._params.values():
+            p.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for p in self._params.values():
+            setattr(p, name, value)
+
+    def save(self, fname, strip_prefix=""):
+        from ..ndarray import save as nd_save
+        out = {}
+        for name, p in self._params.items():
+            if strip_prefix and name.startswith(strip_prefix):
+                name = name[len(strip_prefix):]
+            out[name] = p.data()
+        nd_save(fname, out)
+
+    def load(self, fname, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        from ..ndarray import load as nd_load
+        loaded = nd_load(fname)
+        if restore_prefix:
+            loaded = {restore_prefix + k: v for k, v in loaded.items()}
+        for name, p in self._params.items():
+            if name in loaded:
+                p.set_data(loaded[name])
+            elif not allow_missing:
+                raise MXNetError(f"parameter {name} missing in {fname}")
+        if not ignore_extra:
+            extra = set(loaded) - set(self._params)
+            if extra:
+                raise MXNetError(f"extra parameters in {fname}: {sorted(extra)}")
+
+    def __repr__(self):
+        lines = [f"ParameterDict (prefix={self._prefix!r})"]
+        lines += [f"  {p!r}" for p in self._params.values()]
+        return "\n".join(lines)

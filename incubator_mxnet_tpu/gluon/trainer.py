@@ -1,0 +1,138 @@
+"""Gluon Trainer.
+
+Re-design of `python/mxnet/gluon/trainer.py` (file-level citation —
+SURVEY.md caveat). Orchestrates grad reduction (KVStore facade) + optimizer
+updates over a Block's parameters; the reference's update_on_kvstore logic
+(server-side optimizer) collapses into post-reduction local updates, which
+is mathematically identical for sync training (SURVEY.md §3.2).
+
+The eager ``step()`` here is the correctness path; for TPU throughput use
+``parallel.SPMDTrainer`` which fuses fwd+bwd+psum+update into one jitted
+program (SURVEY.md §3.2: "the whole step becomes ONE jitted SPMD function").
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Union
+
+from .. import optimizer as opt_mod
+from ..base import MXNetError
+from ..kvstore import create as kv_create
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            param_list = [params[k] for k in sorted(params.keys())] \
+                if isinstance(params, dict) else list(params.values())
+        elif isinstance(params, (list, tuple)):
+            param_list = list(params)
+        else:
+            raise MXNetError("params must be a (Parameter)Dict or list")
+        self._params: List[Parameter] = []
+        self._param2idx: Dict[str, int] = {}
+        for i, p in enumerate(param_list):
+            if not isinstance(p, Parameter):
+                raise MXNetError(f"expected Parameter, got {type(p)}")
+            self._param2idx[p.name] = i
+            self._params.append(p)
+
+        optimizer_params = optimizer_params or {}
+        param_dict = {p.name: p for p in self._params}
+        self._optimizer = opt_mod.create(
+            optimizer, param_dict=param_dict,
+            param_idx2name={i: p.name for i, p in enumerate(self._params)},
+            **optimizer_params)
+        self._updaters = [opt_mod.get_updater(self._optimizer)]
+        self._scale = self._optimizer.rescale_grad
+
+        self._compression_params = compression_params
+        self._kvstore = None
+        self._kv_initialized = False
+        self._update_on_kvstore = update_on_kvstore
+        self._kvstore_type = kvstore
+        self._distributed = isinstance(kvstore, str) and \
+            kvstore.startswith("dist")
+
+    # -- kvstore bootstrap ---------------------------------------------- #
+    def _init_kvstore(self):
+        if self._kv_initialized:
+            return
+        if self._kvstore_type is None:
+            self._kvstore = None
+        else:
+            kv = self._kvstore_type if not isinstance(self._kvstore_type, str) \
+                else kv_create(self._kvstore_type)
+            if self._compression_params:
+                kv.set_gradient_compression(self._compression_params)
+            self._kvstore = kv
+            for i, p in enumerate(self._params):
+                if p.grad_req != "null":
+                    kv.init(i, p.data())
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self) -> float:
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr: float):
+        self._optimizer.set_learning_rate(lr)
+
+    # -- the step -------------------------------------------------------- #
+    def step(self, batch_size, ignore_stale_grad=False):
+        """allreduce grads then update (parity: Trainer.step)."""
+        self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            grads = p.list_grad()
+            if self._kvstore.num_workers > 1 or len(grads) > 1:
+                self._kvstore.pushpull(i, grads, out=grads)
+
+    def allreduce_grads(self):
+        self._init_kvstore()
+        self._allreduce_grads()
+
+    def _update(self, ignore_stale_grad=False):
+        updater = self._updaters[0]
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            updater(i, p.grad(), p.data())
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def zero_grad(self):
+        for p in self._params:
+            p.zero_grad()
+
+    # -- checkpoint ------------------------------------------------------ #
+    def save_states(self, fname):
+        """(parity: Trainer.save_states — optimizer state incl. momentum
+        buffers; SURVEY.md §5.4)."""
+        with open(fname, "wb") as f:
+            f.write(self._updaters[0].get_states(dump_optimizer=False))
+
+    def load_states(self, fname):
+        with open(fname, "rb") as f:
+            self._updaters[0].set_states(f.read())
+        self._optimizer = self._updaters[0].optimizer

@@ -1,0 +1,68 @@
+"""Gluon utilities (re-design of `python/mxnet/gluon/utils.py` —
+file-level citation, SURVEY.md caveat)."""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from ..base import MXNetError
+from ..context import Context
+from ..ndarray import NDArray
+from ..ndarray import ndarray as _ndmod
+
+
+def split_data(data: NDArray, num_slice: int, batch_axis: int = 0,
+               even_split: bool = True) -> List[NDArray]:
+    """Split ``data`` into ``num_slice`` slices along ``batch_axis``
+    (parity: gluon.utils.split_data)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            f"data with shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data._op("slice_axis", axis=batch_axis,
+                               begin=begin, end=end))
+    return slices
+
+
+def split_and_load(data, ctx_list: Sequence[Context], batch_axis: int = 0,
+                   even_split: bool = True) -> List[NDArray]:
+    """Split a batch across contexts (parity: gluon.utils.split_and_load;
+    the reference's per-GPU scatter — SURVEY.md §2.3 data-parallel row).
+
+    On TPU the idiomatic fast path shards one global array over the mesh
+    (parallel/), but the per-context list API is kept for source parity.
+    """
+    if not isinstance(data, NDArray):
+        data = _ndmod.NDArray(_ndmod._as_jax(data))
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays: Sequence[NDArray], max_norm: float,
+                     check_isfinite: bool = True):
+    """Rescale arrays so their joint L2 norm is at most ``max_norm``
+    (parity: gluon.utils.clip_global_norm)."""
+    import jax.numpy as jnp
+
+    total = None
+    for a in arrays:
+        sq = jnp.sum(a._data.astype(jnp.float32) ** 2)
+        total = sq if total is None else total + sq
+    norm = jnp.sqrt(total)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+    for a in arrays:
+        a._data = (a._data.astype(jnp.float32) * scale).astype(a.dtype)
+    norm_val = float(norm)
+    if check_isfinite and not (norm_val == norm_val and abs(norm_val) != float("inf")):
+        import warnings
+        warnings.warn(f"nan or inf is detected. Clipping results will be "
+                      f"undefined: norm={norm_val}")
+    return norm_val

@@ -1,0 +1,215 @@
+"""Weight initializers.
+
+Re-design of `python/mxnet/initializer.py` (file-level citation — SURVEY.md
+caveat). Initializers are registered by alias so string specs like
+``init='xavier'`` work, and draw from the global counter-based RNG stream
+(SURVEY.md §7.2 RNG parity).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from . import random as _random
+from .base import MXNetError, Registry
+from .ndarray.ndarray import NDArray, _to_jnp_dtype
+
+__all__ = ["Initializer", "Uniform", "Normal", "Zero", "One", "Constant",
+           "Xavier", "MSRAPrelu", "Orthogonal", "Bilinear", "LSTMBias",
+           "register", "create"]
+
+_REGISTRY = Registry("initializer")
+register = _REGISTRY.register
+
+
+class Initializer:
+    """Base initializer: call pattern ``init(name, arr)`` mirrors the
+    reference (name-based dispatch for bias/gamma/beta conventions)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, name, arr: NDArray):
+        if not isinstance(name, str):
+            name, arr = arr, name  # tolerate swapped order
+        self.init_weight(name, arr)
+
+    def init_weight(self, name: str, arr: NDArray):
+        name = name.lower()
+        if name.endswith("bias") or name.endswith("beta") or "moving_mean" in name \
+                or "running_mean" in name:
+            arr._data = jnp.zeros(arr.shape, arr.dtype)
+        elif name.endswith("gamma") or "moving_var" in name or "running_var" in name:
+            arr._data = jnp.ones(arr.shape, arr.dtype)
+        else:
+            self._init_weight(name, arr)
+
+    def _init_weight(self, name: str, arr: NDArray):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+
+@register("uniform")
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        arr._data = jax.random.uniform(_random.new_key(), arr.shape,
+                                       minval=-self.scale, maxval=self.scale,
+                                       dtype=jnp.float32).astype(arr.dtype)
+
+
+@register("normal")
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        arr._data = (self.sigma * jax.random.normal(
+            _random.new_key(), arr.shape, dtype=jnp.float32)).astype(arr.dtype)
+
+
+@register("zeros", aliases=("zero",))
+class Zero(Initializer):
+    def _init_weight(self, name, arr):
+        arr._data = jnp.zeros(arr.shape, arr.dtype)
+
+
+@register("ones", aliases=("one",))
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        arr._data = jnp.ones(arr.shape, arr.dtype)
+
+
+@register("constant")
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        arr._data = jnp.full(arr.shape, self.value, arr.dtype)
+
+
+def _fan(shape, factor_type):
+    hw = 1
+    for d in shape[2:]:
+        hw *= d
+    fan_in = (shape[1] if len(shape) > 1 else shape[0]) * hw
+    fan_out = shape[0] * hw
+    if factor_type == "avg":
+        return (fan_in + fan_out) / 2.0
+    if factor_type == "in":
+        return float(fan_in)
+    if factor_type == "out":
+        return float(fan_out)
+    raise MXNetError(f"unknown factor_type {factor_type}")
+
+
+@register("xavier")
+class Xavier(Initializer):
+    """Glorot initialization (reference: initializer.py Xavier)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        factor = _fan(arr.shape, self.factor_type)
+        scale = math.sqrt(self.magnitude / max(factor, 1.0))
+        if self.rnd_type == "uniform":
+            arr._data = jax.random.uniform(
+                _random.new_key(), arr.shape, minval=-scale, maxval=scale,
+                dtype=jnp.float32).astype(arr.dtype)
+        elif self.rnd_type == "gaussian":
+            arr._data = (scale * jax.random.normal(
+                _random.new_key(), arr.shape, dtype=jnp.float32)).astype(arr.dtype)
+        else:
+            raise MXNetError(f"unknown rnd_type {self.rnd_type}")
+
+
+@register("msraprelu")
+class MSRAPrelu(Xavier):
+    """He initialization (reference: initializer.py MSRAPrelu)."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register("orthogonal")
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, arr):
+        nout = arr.shape[0]
+        nin = 1
+        for d in arr.shape[1:]:
+            nin *= d
+        if self.rand_type == "uniform":
+            tmp = jax.random.uniform(_random.new_key(), (nout, nin),
+                                     minval=-1.0, maxval=1.0)
+        else:
+            tmp = jax.random.normal(_random.new_key(), (nout, nin))
+        u, _, v = jnp.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        arr._data = (self.scale * q.reshape(arr.shape)).astype(arr.dtype)
+
+
+@register("bilinear")
+class Bilinear(Initializer):
+    """Bilinear upsampling kernels for deconvolution
+    (reference: initializer.py Bilinear)."""
+
+    def _init_weight(self, name, arr):
+        import numpy as np
+        weight = np.zeros(arr.shape, dtype=np.float32)
+        f = math.ceil(arr.shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        flat = weight.reshape(-1)
+        for i in range(flat.size):
+            x = i % arr.shape[3]
+            y = (i // arr.shape[3]) % arr.shape[2]
+            flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr._data = jnp.asarray(weight.reshape(arr.shape)).astype(arr.dtype)
+
+
+@register("lstmbias")
+class LSTMBias(Initializer):
+    """Forget-gate bias init (reference: initializer.py LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        b = jnp.zeros(arr.shape, arr.dtype)
+        n = arr.shape[0] // 4
+        b = b.at[n:2 * n].set(self.forget_bias)
+        arr._data = b
+
+
+def create(init) -> Initializer:
+    if isinstance(init, Initializer):
+        return init
+    if init is None:
+        return Uniform()
+    if isinstance(init, str):
+        cls = _REGISTRY.get(init)
+        return cls()
+    raise MXNetError(f"cannot create initializer from {init!r}")

@@ -1,0 +1,5 @@
+"""KVStore: parameter synchronization facade (re-design of
+`src/kvstore/` + `python/mxnet/kvstore/` — SURVEY.md §2.1/§5.8)."""
+
+from .base import KVStoreBase, register
+from .kvstore import KVStore, create

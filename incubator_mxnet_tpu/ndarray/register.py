@@ -1,0 +1,113 @@
+"""Imperative op dispatch: registry function → eager NDArray call.
+
+The analogue of the reference's generated-op + invoke path
+(`python/mxnet/ndarray/register.py` → `MXImperativeInvokeEx` →
+`Imperative::Invoke`, SURVEY.md §3.1; file-level citations, SURVEY caveat).
+
+The entire call stack of the reference's hot path (Python → C ABI → engine
+queue → worker thread → kernel launch) collapses to: unwrap ``jax.Array``s,
+call the op's pure function (XLA dispatches asynchronously), wrap outputs,
+and — when autograd is recording — append one tape node.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax
+import jax.tree_util as jtu
+
+from .. import autograd, random as _random
+from ..base import MXNetError
+from ..ops import registry as _reg
+from .ndarray import NDArray, _as_jax
+
+__all__ = ["imperative_invoke", "invoke_by_name", "make_op_function"]
+
+
+def _is_leaf(x):
+    return isinstance(x, NDArray)
+
+
+def imperative_invoke(spec: _reg.OpSpec, *args, out=None, ctx=None, **kwargs):
+    """Execute a registered op eagerly on NDArray inputs."""
+    # resolve mode-dependent statics at call time (dropout/batchnorm)
+    if spec.training_aware and kwargs.get("training") is None:
+        kwargs["training"] = autograd.is_training()
+    # stochastic ops: thread a fresh key from the global stream as an input
+    if spec.needs_key and kwargs.get("key") is None:
+        kwargs["key"] = _random.new_key()
+    key_arr = kwargs.pop("key", None)
+
+    # flatten args AND kwargs together so NDArrays passed by keyword
+    # (e.g. ``sequence_length=``) are unwrapped and autograd-visible too
+    flat, treedef = jtu.tree_flatten((args, kwargs), is_leaf=_is_leaf)
+    arr_pos: List[int] = []
+    primals: List[Any] = []
+    owners: List[Any] = []
+    for i, leaf in enumerate(flat):
+        if isinstance(leaf, NDArray):
+            arr_pos.append(i)
+            primals.append(leaf._data)
+            owners.append(leaf)
+        elif isinstance(leaf, jax.Array):
+            arr_pos.append(i)
+            primals.append(leaf)
+            owners.append(None)
+    if key_arr is not None:
+        if isinstance(key_arr, NDArray):
+            key_arr = key_arr._data
+        primals.append(key_arr)
+        owners.append(None)
+
+    n_args = len(primals) - (1 if key_arr is not None else 0)
+
+    def pure_fn(*arrs):
+        flat2 = list(flat)
+        for pos, a in zip(arr_pos, arrs[:n_args]):
+            flat2[pos] = a
+        call_args, call_kwargs = jtu.tree_unflatten(treedef, flat2)
+        if key_arr is not None:
+            res = spec.fn(*call_args, key=arrs[-1], **call_kwargs)
+        else:
+            res = spec.fn(*call_args, **call_kwargs)
+        # normalize variadic outputs to a tuple so vjp seeding is uniform
+        return tuple(res) if isinstance(res, list) else res
+
+    try:
+        result = pure_fn(*primals)
+    except (TypeError, ValueError) as e:
+        raise MXNetError(f"operator {spec.name} failed: {e}") from e
+
+    multi = isinstance(result, (tuple, list))
+    if ctx is not None:
+        dev = ctx.jax_device
+        result = jax.device_put(result, dev)
+    outs = [NDArray(r) for r in (result if multi else (result,))]
+
+    if autograd.is_recording():
+        autograd._record_node(pure_fn, primals, owners, outs, name=spec.name,
+                              tuple_out=multi)
+
+    if out is not None:
+        targets = out if isinstance(out, (tuple, list)) else (out,)
+        for t, o in zip(targets, outs):
+            t._data = o._data.astype(t.dtype)
+        return out
+    return outs if multi else outs[0]
+
+
+def invoke_by_name(name: str, *args, **kwargs):
+    return imperative_invoke(_reg.get(name), *args, **kwargs)
+
+
+def make_op_function(spec: _reg.OpSpec, public_name: str):
+    """Build the module-level function surfaced as ``mx.nd.<name>``."""
+
+    def op_function(*args, **kwargs):
+        return imperative_invoke(spec, *args, **kwargs)
+
+    op_function.__name__ = public_name
+    op_function.__qualname__ = public_name
+    op_function.__doc__ = _reg.describe_op(spec.name)
+    return op_function

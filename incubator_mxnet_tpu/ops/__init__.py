@@ -1,0 +1,13 @@
+"""Operator library (TPU-native re-design of `src/operator/**` — SURVEY.md §2.1).
+
+Importing this package registers all operators into the registry; both the
+``mx.nd`` and ``mx.sym`` front ends are generated from it (one registration
+serving both front ends, mirroring the reference's single NNVM registry).
+"""
+
+from . import registry
+from . import tensor  # noqa: F401  (registers ops)
+from . import nn  # noqa: F401
+from . import random_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from .registry import get, list_all_ops, describe_op, register

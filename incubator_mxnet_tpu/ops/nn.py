@@ -1,0 +1,522 @@
+"""Neural-network operators: conv, pooling, dense, norm, softmax, dropout.
+
+TPU-native re-design of the reference's `src/operator/nn/` family
+(`convolution.cc`, `fully_connected.cc`, `batch_norm.cc`, `layer_norm.cc`,
+`pooling.cc`, `activation.cc`, `softmax.cc`, `dropout.cc`, `indexing_op.cc`
+Embedding — file-level citations, SURVEY.md caveat).
+
+Design notes (TPU-first):
+  - Convolutions lower to ONE ``lax.conv_general_dilated`` in NCHW/OIHW —
+    XLA tiles it onto the MXU; there is no algorithm-selection layer (the
+    reference's cuDNN autotune, `nn/cudnn/`) because XLA owns that choice.
+  - BatchNorm returns ``(out, batch_mean, batch_var)``; running-stat update
+    is the caller's (Gluon layer's) responsibility — functional style keeps
+    the op pure so it composes with jit/vjp/vmap.
+  - Dropout takes an explicit PRNG ``key`` argument (counter-based RNG —
+    SURVEY.md §7.2 RNG parity); the imperative front end threads the global
+    stream automatically.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import register
+
+# --------------------------------------------------------------------- #
+# dense / linear
+# --------------------------------------------------------------------- #
+
+
+@register("FullyConnected", aliases=("fully_connected",))
+def fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False,
+                    flatten=True):
+    """y = x W^T + b (reference: src/operator/nn/fully_connected.cc).
+    Weight layout (num_hidden, in_units) matches the reference."""
+    if flatten and data.ndim > 2:
+        data = data.reshape(data.shape[0], -1)
+    out = jnp.matmul(data, weight.T)
+    if not no_bias and bias is not None:
+        out = out + bias
+    return out
+
+
+# --------------------------------------------------------------------- #
+# convolution
+# --------------------------------------------------------------------- #
+def _tup(v, n):
+    if v is None:
+        return (0,) * n if n else ()
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+@register("Convolution", aliases=("convolution",))
+def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
+                pad=None, num_filter=None, num_group=1, no_bias=False,
+                layout=None):
+    """N-d convolution, NC(D)HW layout, OIHW kernel
+    (reference: src/operator/nn/convolution.cc). Lowers to one
+    ``lax.conv_general_dilated`` so XLA maps it onto the MXU."""
+    nsp = len(kernel)  # spatial dims
+    stride = _tup(stride, nsp) or (1,) * nsp
+    stride = tuple(s or 1 for s in stride)
+    dilate = tuple(d or 1 for d in (_tup(dilate, nsp) or (1,) * nsp))
+    pad = _tup(pad, nsp)
+    spatial = "DHW"[-nsp:] if nsp <= 3 else None
+    if spatial is None:
+        raise MXNetError("convolution supports 1-3 spatial dims")
+    lhs_spec = "NC" + spatial
+    rhs_spec = "OI" + spatial
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                    (lhs_spec, rhs_spec, lhs_spec))
+    out = lax.conv_general_dilated(
+        data, weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+    )
+    if not no_bias and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nsp)
+    return out
+
+
+@register("Deconvolution", aliases=("deconvolution",))
+def deconvolution(data, weight, bias=None, kernel=None, stride=None,
+                  dilate=None, pad=None, adj=None, num_filter=None,
+                  num_group=1, no_bias=True, target_shape=None, layout=None):
+    """Transposed convolution (reference: src/operator/nn/deconvolution.cc).
+    Weight layout (in_channels, out_channels/groups, kh, kw) as in the
+    reference."""
+    nsp = len(kernel)
+    stride = tuple(s or 1 for s in (_tup(stride, nsp) or (1,) * nsp))
+    dilate = tuple(d or 1 for d in (_tup(dilate, nsp) or (1,) * nsp))
+    pad = _tup(pad, nsp)
+    adj = _tup(adj, nsp)
+    spatial = "DHW"[-nsp:]
+    lhs_spec = "NC" + spatial
+    # gradient-of-conv implementation: lhs-dilate the input
+    rhs_spec = "IO" + spatial
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                    (lhs_spec, rhs_spec, lhs_spec))
+    k_eff = [(kernel[i] - 1) * dilate[i] + 1 for i in range(nsp)]
+    padding = [(k_eff[i] - 1 - pad[i], k_eff[i] - 1 - pad[i] + adj[i])
+               for i in range(nsp)]
+    out = lax.conv_general_dilated(
+        data, jnp.flip(weight, axis=tuple(range(2, 2 + nsp))),
+        window_strides=(1,) * nsp,
+        padding=padding,
+        lhs_dilation=stride,
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+    )
+    if not no_bias and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nsp)
+    return out
+
+
+# --------------------------------------------------------------------- #
+# pooling
+# --------------------------------------------------------------------- #
+@register("Pooling", aliases=("pooling",))
+def pooling(data, kernel=None, pool_type="max", global_pool=False,
+            stride=None, pad=None, pooling_convention="valid",
+            count_include_pad=True, layout=None):
+    """Max/avg/sum/lp pooling over NC(D)HW (reference: src/operator/nn/pooling.cc)."""
+    nsp = data.ndim - 2
+    if global_pool:
+        axes = tuple(range(2, data.ndim))
+        if pool_type == "max":
+            out = jnp.max(data, axis=axes, keepdims=True)
+        elif pool_type in ("avg", "sum"):
+            out = (jnp.mean if pool_type == "avg" else jnp.sum)(
+                data, axis=axes, keepdims=True)
+        else:
+            raise MXNetError(f"pool_type {pool_type}")
+        return out
+    kernel = _tup(kernel, nsp)
+    stride = tuple(s or 1 for s in (_tup(stride, nsp) or (1,) * nsp))
+    pad = _tup(pad, nsp)
+
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    if pooling_convention == "full":
+        # ceil-mode: pad on the high side enough to cover the last window
+        hi_pad = []
+        for i in range(nsp):
+            in_sz = data.shape[2 + i]
+            out_sz = -(-(in_sz + 2 * pad[i] - kernel[i]) // stride[i]) + 1
+            need = (out_sz - 1) * stride[i] + kernel[i] - in_sz - pad[i]
+            hi_pad.append(max(need, pad[i]))
+        pads = ((0, 0), (0, 0)) + tuple((pad[i], hi_pad[i]) for i in range(nsp))
+    else:
+        pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, init, lax.max, window, strides, pads)
+    if pool_type in ("avg", "sum"):
+        summed = lax.reduce_window(data, 0.0, lax.add, window, strides, pads)
+        if pool_type == "sum":
+            return summed
+        if count_include_pad:
+            denom = 1.0
+            for k in kernel:
+                denom *= k
+            return summed / denom
+        ones = jnp.ones_like(data)
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
+        return summed / counts
+    raise MXNetError(f"pool_type {pool_type}")
+
+
+@register("AdaptiveAvgPooling2D", aliases=("contrib_AdaptiveAvgPooling2D",))
+def adaptive_avg_pooling2d(data, output_size=None):
+    """(reference: src/operator/contrib/adaptive_avg_pooling.cc)"""
+    if output_size is None:
+        output_size = (1, 1)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    n, c, h, w = data.shape
+    oh, ow = output_size
+    if h % oh == 0 and w % ow == 0:
+        x = data.reshape(n, c, oh, h // oh, ow, w // ow)
+        return x.mean(axis=(3, 5))
+    # general case: interpolate-style average via per-output-bin windows
+    out = jax.image.resize(data, (n, c, oh, ow), method="linear")
+    return out
+
+
+# --------------------------------------------------------------------- #
+# normalization
+# --------------------------------------------------------------------- #
+@register("BatchNorm", aliases=("batch_norm",), num_outputs=3,
+          training_aware=True)
+def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
+               momentum=0.9, fix_gamma=True, use_global_stats=False,
+               axis=1, training=None):
+    """Batch normalization (reference: src/operator/nn/batch_norm.cc).
+
+    Returns ``(out, batch_mean, batch_var)``; running stats are updated by
+    the Gluon layer (functional purity — see module docstring).
+    """
+    axes = tuple(i for i in range(data.ndim) if i != axis % data.ndim)
+    bshape = tuple(data.shape[i] if i == axis % data.ndim else 1
+                   for i in range(data.ndim))
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    if training and not use_global_stats:
+        mean = jnp.mean(data, axis=axes)
+        var = jnp.var(data, axis=axes)
+    else:
+        mean, var = moving_mean, moving_var
+    inv = lax.rsqrt(var + eps)
+    out = (data - mean.reshape(bshape)) * (inv * g).reshape(bshape) \
+        + beta.reshape(bshape)
+    if training and not use_global_stats:
+        return out, mean, var
+    return out, moving_mean, moving_var
+
+
+@register("LayerNorm", aliases=("layer_norm",))
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5):
+    """(reference: src/operator/nn/layer_norm.cc)"""
+    mean = jnp.mean(data, axis=axis, keepdims=True)
+    var = jnp.var(data, axis=axis, keepdims=True)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    bshape = tuple(data.shape[a] if a == axis % data.ndim else 1
+                   for a in range(data.ndim))
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("InstanceNorm", aliases=("instance_norm",))
+def instance_norm(data, gamma, beta, eps=1e-3):
+    """(reference: src/operator/instance_norm.cc); data NC+spatial."""
+    axes = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=axes, keepdims=True)
+    var = jnp.var(data, axis=axes, keepdims=True)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("GroupNorm", aliases=("group_norm",))
+def group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
+    """(reference: src/operator/nn/group_norm.cc); data NCHW."""
+    n, c = data.shape[:2]
+    spatial = data.shape[2:]
+    x = data.reshape((n, num_groups, c // num_groups) + spatial)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    x = (x - mean) * lax.rsqrt(var + eps)
+    x = x.reshape(data.shape)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    return x * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("L2Normalization")
+def l2_normalization(data, eps=1e-10, mode="instance"):
+    """(reference: src/operator/l2_normalization.cc)"""
+    if mode == "instance":
+        axes = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    elif mode == "spatial":
+        axes = tuple(range(2, data.ndim))
+    else:
+        raise MXNetError(f"mode {mode}")
+    norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=axes, keepdims=True) + eps)
+    return data / norm
+
+
+@register("LRN")
+def lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    """Local response norm across channels (reference: src/operator/nn/lrn.cc)."""
+    sq = jnp.square(data)
+    pad = nsize // 2
+    sq_pad = jnp.pad(sq, ((0, 0), (pad, pad)) + ((0, 0),) * (data.ndim - 2))
+    windows = sum(sq_pad[:, i:i + data.shape[1]] for i in range(nsize))
+    return data / jnp.power(knorm + alpha * windows / nsize, beta)
+
+
+# --------------------------------------------------------------------- #
+# activations
+# --------------------------------------------------------------------- #
+@register("Activation", aliases=("activation",))
+def activation(data, act_type="relu"):
+    """(reference: src/operator/nn/activation.cc)"""
+    fns = {
+        "relu": jax.nn.relu,
+        "sigmoid": jax.nn.sigmoid,
+        "tanh": jnp.tanh,
+        "softrelu": jax.nn.softplus,
+        "softsign": jax.nn.soft_sign,
+    }
+    if act_type not in fns:
+        raise MXNetError(f"unknown act_type {act_type!r}")
+    return fns[act_type](data)
+
+
+@register("LeakyReLU", needs_key=True, training_aware=True)
+def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
+               lower_bound=0.125, upper_bound=0.334, key=None, training=None):
+    """leaky / prelu / elu / selu / gelu / rrelu
+    (reference: src/operator/leaky_relu.cc)."""
+    if act_type == "leaky":
+        return jnp.where(data > 0, data, slope * data)
+    if act_type == "prelu":
+        g = gamma.reshape((1, -1) + (1,) * (data.ndim - 2)) \
+            if gamma.ndim == 1 and data.ndim > 2 else gamma
+        return jnp.where(data > 0, data, g * data)
+    if act_type == "elu":
+        return jnp.where(data > 0, data, slope * jnp.expm1(data))
+    if act_type == "selu":
+        return 1.0507009873554805 * jnp.where(
+            data > 0, data, 1.6732632423543772 * jnp.expm1(data))
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if act_type == "rrelu":
+        if training:
+            s = jax.random.uniform(key, data.shape, minval=lower_bound,
+                                   maxval=upper_bound, dtype=data.dtype)
+        else:
+            s = (lower_bound + upper_bound) / 2.0
+        return jnp.where(data > 0, data, s * data)
+    raise MXNetError(f"unknown act_type {act_type!r}")
+
+
+@register("softmax", aliases=("Softmax",))
+def softmax(data, axis=-1, temperature=None, length=None):
+    """(reference: src/operator/nn/softmax.cc); optional masking by length."""
+    if temperature is not None and temperature != 1.0:
+        data = data / temperature
+    if length is not None:
+        T = data.shape[axis]
+        pos = jnp.arange(T)
+        mask = pos[None, :] < length[:, None].astype(pos.dtype)
+        shape = [1] * data.ndim
+        shape[0] = data.shape[0]
+        shape[axis % data.ndim] = T
+        mask = mask.reshape(shape)
+        data = jnp.where(mask, data, -jnp.inf)
+        out = jax.nn.softmax(data, axis=axis)
+        return jnp.where(mask, out, 0.0)
+    return jax.nn.softmax(data, axis=axis)
+
+
+@register("log_softmax")
+def log_softmax(data, axis=-1, temperature=None):
+    if temperature is not None and temperature != 1.0:
+        data = data / temperature
+    return jax.nn.log_softmax(data, axis=axis)
+
+
+@register("softmin")
+def softmin(data, axis=-1):
+    return jax.nn.softmax(-data, axis=axis)
+
+
+@register("SoftmaxActivation")
+def softmax_activation(data, mode="instance"):
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+
+
+@register("SoftmaxOutput", aliases=("softmax_output",))
+def softmax_output(data, label, grad_scale=1.0, ignore_label=-1,
+                   use_ignore=False, multi_output=False, normalization="null",
+                   smooth_alpha=0.0, out_grad=False, preserve_shape=False):
+    """Softmax with cross-entropy gradient fused in backward
+    (reference: src/operator/softmax_output.cc). Forward returns softmax;
+    backward is (p - onehot(label)) * grad_scale via custom VJP."""
+    axis = 1 if multi_output else -1
+
+    @jax.custom_vjp
+    def _so(d, l):
+        return jax.nn.softmax(d, axis=axis)
+
+    def _fwd(d, l):
+        p = jax.nn.softmax(d, axis=axis)
+        return p, (p, l)
+
+    def _bwd(res, g):
+        p, l = res
+        depth = p.shape[axis]
+        lab = l.astype(jnp.int32)
+        oh = jax.nn.one_hot(lab, depth, dtype=p.dtype)
+        if multi_output:
+            oh = jnp.moveaxis(oh, -1, 1)
+        grad = p - oh
+        if smooth_alpha:
+            grad = grad + smooth_alpha * (oh - 1.0 / depth)
+        if use_ignore:
+            keep = (l != ignore_label).astype(p.dtype)
+            keep = jnp.expand_dims(keep, axis % p.ndim)
+            grad = grad * keep
+        scale = grad_scale
+        if normalization == "batch":
+            scale = scale / p.shape[0]
+        elif normalization == "valid" and use_ignore:
+            valid = jnp.maximum(jnp.sum(l != ignore_label), 1)
+            scale = scale / valid
+        return (grad * scale, jnp.zeros_like(l))
+
+    _so.defvjp(_fwd, _bwd)
+    return _so(data, label)
+
+
+@register("softmax_cross_entropy")
+def softmax_cross_entropy(data, label):
+    """(reference: src/operator/loss_binary_op.cc)"""
+    logp = jax.nn.log_softmax(data, axis=-1)
+    lab = label.astype(jnp.int32)
+    picked = jnp.take_along_axis(logp, lab[:, None], axis=-1)
+    return -jnp.sum(picked)
+
+
+# --------------------------------------------------------------------- #
+# dropout / embedding
+# --------------------------------------------------------------------- #
+@register("Dropout", aliases=("dropout",), needs_key=True, training_aware=True)
+def dropout_op(data, p=0.5, mode="training", axes=(), key=None, training=None):
+    """Inverted dropout with counter-based RNG
+    (reference: src/operator/nn/dropout.cc; RNG parity — SURVEY.md §7.2)."""
+    if (not training and mode != "always") or p == 0.0:
+        return data
+    shape = list(data.shape)
+    for a in axes:
+        shape[a] = 1  # broadcast dropout (reference `axes` param)
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, tuple(shape)).astype(data.dtype)
+    return data * mask / keep
+
+
+@register("Embedding", aliases=("embedding",))
+def embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
+              sparse_grad=False):
+    """Lookup table (reference: src/operator/tensor/indexing_op.cc Embedding).
+    Lowers to one gather; on a sharded mesh the table shards row-wise and the
+    gather rides XLA collectives (row_sparse_pull parity — SURVEY.md §2.3)."""
+    idx = data.astype(jnp.int32)
+    return jnp.take(weight, idx, axis=0, mode="clip")
+
+
+@register("CTCLoss", aliases=("ctc_loss",))
+def ctc_loss(data, label, data_lengths=None, label_lengths=None,
+             use_data_lengths=False, use_label_lengths=False,
+             blank_label="first"):
+    """Connectionist temporal classification loss
+    (reference: src/operator/nn/ctc_loss.cc). Layout: data (T, B, C) raw
+    activations (softmax applied internally, matching the reference);
+    label (B, L) padded with -1 (or 0 when blank is 'first' and labels are
+    1-indexed... we follow the reference: padding value 0 with blank='first'
+    means "shift labels by 1"; here padding is -1 unless label_lengths given).
+    """
+    T, B, C = data.shape
+    logp = jax.nn.log_softmax(data, axis=-1)  # (T,B,C)
+    blank = 0 if blank_label == "first" else C - 1
+    lab = label.astype(jnp.int32)
+    if blank_label == "first" and not use_label_lengths:
+        pass
+    L = lab.shape[1]
+    if use_label_lengths and label_lengths is not None:
+        lab_len = label_lengths.astype(jnp.int32)
+    else:
+        lab_len = jnp.sum((lab >= 0).astype(jnp.int32), axis=1)
+        lab = jnp.where(lab >= 0, lab, 0)
+    if use_data_lengths and data_lengths is not None:
+        in_len = data_lengths.astype(jnp.int32)
+    else:
+        in_len = jnp.full((B,), T, dtype=jnp.int32)
+
+    # extended label seq: blank, l1, blank, l2, ... blank  → length 2L+1
+    S = 2 * L + 1
+    ext = jnp.full((B, S), blank, dtype=jnp.int32)
+    ext = ext.at[:, 1::2].set(lab)
+    ext_valid = jnp.arange(S)[None, :] < (2 * lab_len + 1)[:, None]
+
+    neg_inf = jnp.asarray(-1e30, dtype=logp.dtype)
+
+    def emit(t_logp, s_idx):  # (B,C),(B,S)->(B,S)
+        return jnp.take_along_axis(t_logp, s_idx, axis=1)
+
+    # alpha recursion (forward algorithm) via lax.scan over time
+    same_as_prev2 = jnp.concatenate(
+        [jnp.zeros((B, 2), dtype=bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+    can_skip = jnp.logical_and(ext != blank, jnp.logical_not(same_as_prev2))
+
+    init = jnp.full((B, S), neg_inf)
+    init = init.at[:, 0].set(emit(logp[0], ext[:, :1])[:, 0])
+    first_lab = jnp.where(lab_len > 0, emit(logp[0], ext[:, 1:2])[:, 0], neg_inf)
+    init = init.at[:, 1].set(first_lab)
+
+    def step(alpha, t):
+        shift1 = jnp.concatenate([jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+        shift2 = jnp.concatenate([jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+        shift2 = jnp.where(can_skip, shift2, neg_inf)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, shift1), shift2)
+        new_alpha = merged + emit(logp[t], ext)
+        new_alpha = jnp.where(ext_valid, new_alpha, neg_inf)
+        # positions beyond in_len keep previous alpha (sequence ended)
+        active = (t < in_len)[:, None]
+        new_alpha = jnp.where(active, new_alpha, alpha)
+        return new_alpha, None
+
+    alpha, _ = lax.scan(step, init, jnp.arange(1, T))
+    # final: sum of alpha at S-1 and S-2 positions (per true label length)
+    sl = 2 * lab_len  # index of final blank
+    a_last = jnp.take_along_axis(alpha, sl[:, None], axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(alpha, jnp.maximum(sl - 1, 0)[:, None], axis=1)[:, 0]
+    a_prev = jnp.where(lab_len > 0, a_prev, neg_inf)
+    ll = jnp.logaddexp(a_last, a_prev)
+    return -ll

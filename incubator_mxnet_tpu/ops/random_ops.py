@@ -1,0 +1,115 @@
+"""Random sampling operators.
+
+TPU-native re-design of `src/operator/random/` (`sample_op.cc`,
+`multisample_op.cc`, `unique_sample_op.cc`; file-level citations — SURVEY.md
+caveat). Stateful per-device RNG resources (`src/resource.cc`) become
+explicit counter-based keys threaded by the dispatcher (SURVEY.md §7.2).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .registry import register
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+@register("random_uniform", aliases=("uniform", "_random_uniform"), needs_key=True)
+def random_uniform(low=0.0, high=1.0, shape=None, dtype="float32", key=None):
+    from ..ndarray.ndarray import _to_jnp_dtype
+    return jax.random.uniform(key, _shape(shape), dtype=_to_jnp_dtype(dtype),
+                              minval=low, maxval=high)
+
+
+@register("random_normal", aliases=("normal", "_random_normal"), needs_key=True)
+def random_normal(loc=0.0, scale=1.0, shape=None, dtype="float32", key=None):
+    from ..ndarray.ndarray import _to_jnp_dtype
+    return loc + scale * jax.random.normal(key, _shape(shape),
+                                           dtype=_to_jnp_dtype(dtype))
+
+
+@register("random_gamma", aliases=("_random_gamma",), needs_key=True)
+def random_gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", key=None):
+    from ..ndarray.ndarray import _to_jnp_dtype
+    return beta * jax.random.gamma(key, alpha, _shape(shape),
+                                   dtype=_to_jnp_dtype(dtype))
+
+
+@register("random_exponential", aliases=("_random_exponential",), needs_key=True)
+def random_exponential(lam=1.0, shape=None, dtype="float32", key=None):
+    from ..ndarray.ndarray import _to_jnp_dtype
+    return jax.random.exponential(key, _shape(shape),
+                                  dtype=_to_jnp_dtype(dtype)) / lam
+
+
+@register("random_poisson", aliases=("_random_poisson",), needs_key=True)
+def random_poisson(lam=1.0, shape=None, dtype="float32", key=None):
+    from ..ndarray.ndarray import _to_jnp_dtype
+    return jax.random.poisson(key, lam, _shape(shape)).astype(_to_jnp_dtype(dtype))
+
+
+@register("random_randint", aliases=("randint", "_random_randint"), needs_key=True)
+def random_randint(low=0, high=None, shape=None, dtype="int32", key=None):
+    from ..ndarray.ndarray import _to_jnp_dtype
+    return jax.random.randint(key, _shape(shape), low, high,
+                              dtype=_to_jnp_dtype(dtype))
+
+
+@register("random_bernoulli", aliases=("bernoulli",), needs_key=True)
+def random_bernoulli(p=0.5, shape=None, dtype="float32", key=None):
+    from ..ndarray.ndarray import _to_jnp_dtype
+    return jax.random.bernoulli(key, p, _shape(shape)).astype(_to_jnp_dtype(dtype))
+
+
+@register("sample_uniform", needs_key=True)
+def sample_uniform(low, high, shape=None, dtype=None, key=None):
+    """Per-distribution batched sampling (reference: multisample_op.cc):
+    low/high are arrays; one draw of `shape` per leading element."""
+    out_shape = tuple(low.shape) + _shape(shape)
+    u = jax.random.uniform(key, out_shape, dtype=low.dtype)
+    bshape = low.shape + (1,) * len(_shape(shape))
+    return low.reshape(bshape) + u * (high - low).reshape(bshape)
+
+
+@register("sample_normal", needs_key=True)
+def sample_normal(mu, sigma, shape=None, dtype=None, key=None):
+    out_shape = tuple(mu.shape) + _shape(shape)
+    z = jax.random.normal(key, out_shape, dtype=mu.dtype)
+    bshape = mu.shape + (1,) * len(_shape(shape))
+    return mu.reshape(bshape) + z * sigma.reshape(bshape)
+
+
+@register("sample_multinomial", aliases=("_sample_multinomial",), needs_key=True)
+def sample_multinomial(data, shape=None, get_prob=False, dtype="int32", key=None):
+    """Sample category ids from probability rows
+    (reference: src/operator/random/sample_multinomial_op.cc)."""
+    from ..ndarray.ndarray import _to_jnp_dtype
+    logits = jnp.log(jnp.maximum(data, 1e-38))
+    batch_shape = data.shape[:-1]
+    draw_shape = _shape(shape)
+    total = 1
+    for d in draw_shape:
+        total *= d
+    samples = jax.random.categorical(
+        key, logits[..., None, :].repeat(total, axis=-2) if total > 1 else logits,
+        axis=-1,
+    )
+    if total > 1:
+        samples = samples.reshape(batch_shape + draw_shape)
+    out = samples.astype(_to_jnp_dtype(dtype))
+    if get_prob:
+        logp = jnp.log(jnp.maximum(data, 1e-38))
+        picked = jnp.take_along_axis(
+            logp, samples.reshape(batch_shape + (-1,)).astype(jnp.int32), axis=-1
+        ).reshape(out.shape)
+        return out, picked
+    return out

@@ -1,0 +1,124 @@
+"""Operator registry.
+
+TPU-native analogue of the reference's NNVM op registry
+(`NNVM_REGISTER_OP` in `3rdparty/tvm/nnvm/include/nnvm/op.h`, MXNet-side
+registration in `src/operator/**`; file-level citations — SURVEY.md caveat).
+
+Key differences from the reference, by design:
+  - An op here is ONE pure, jit-traceable function over ``jax.Array``s. There
+    is no separate FCompute/FGradient pair: gradients come from ``jax.vjp``
+    of the same function, so every registered op is differentiable for free
+    (custom VJPs may still be attached via ``jax.custom_vjp`` inside the fn).
+  - Shape/type inference (`FInferShape`/`FInferType`) is XLA's abstract
+    evaluation — ``jax.eval_shape`` over the same function — instead of
+    per-op C++ inference functions.
+  - ``dmlc::Parameter`` typed attribute structs become keyword arguments with
+    defaults; ``describe_op`` regenerates registry-driven docs the way the
+    reference generates Python signatures from the C registry at import
+    (`python/mxnet/ndarray/register.py`).
+
+Ops registered here are surfaced on BOTH front ends (``mx.nd`` imperatively,
+``mx.sym`` symbolically), mirroring how a single NNVM registration served the
+reference's imperative and symbolic paths (SURVEY.md §1 pillar b).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..base import MXNetError
+
+__all__ = ["register", "get", "list_all_ops", "OpSpec", "describe_op"]
+
+_OP_REGISTRY: Dict[str, "OpSpec"] = {}
+
+
+class OpSpec:
+    """Metadata for a registered operator.
+
+    Attributes
+    ----------
+    name : canonical snake_case op name (reference op names kept verbatim,
+        e.g. ``broadcast_add``, ``FullyConnected`` is an alias).
+    fn : pure function ``fn(*arrays, **params) -> array | tuple``.
+    num_outputs : static output arity (None if variadic, e.g. ``split``).
+    needs_key : op consumes a PRNG key as its LAST array argument (stochastic
+        ops: dropout, samplers). The imperative front end feeds the global
+        stream; traced front ends must thread keys explicitly.
+    training_aware : fn takes a ``training`` kwarg resolved from autograd
+        mode at call time (dropout, batchnorm).
+    """
+
+    __slots__ = ("name", "fn", "aliases", "num_outputs", "needs_key",
+                 "training_aware", "wrap_list", "doc")
+
+    def __init__(self, name, fn, aliases=(), num_outputs=1, needs_key=False,
+                 training_aware=False, wrap_list=False):
+        self.name = name
+        self.fn = fn
+        self.aliases = tuple(aliases)
+        self.num_outputs = num_outputs
+        self.needs_key = needs_key
+        self.training_aware = training_aware
+        self.wrap_list = wrap_list
+        self.doc = fn.__doc__
+
+    def __repr__(self):
+        return f"OpSpec({self.name})"
+
+
+def register(name: str, aliases: Tuple[str, ...] = (), num_outputs: Optional[int] = 1,
+             needs_key: bool = False, training_aware: bool = False,
+             wrap_list: bool = False) -> Callable:
+    """Register a pure operator function under ``name`` (+ aliases)."""
+
+    def _deco(fn):
+        spec = OpSpec(name, fn, aliases, num_outputs, needs_key,
+                      training_aware, wrap_list)
+        if name in _OP_REGISTRY:
+            raise MXNetError(f"operator {name!r} registered twice")
+        _OP_REGISTRY[name] = spec
+        for a in aliases:
+            if a in _OP_REGISTRY:
+                raise MXNetError(f"operator alias {a!r} registered twice")
+            _OP_REGISTRY[a] = spec
+        return fn
+
+    return _deco
+
+
+def get(name: str) -> OpSpec:
+    try:
+        return _OP_REGISTRY[name]
+    except KeyError:
+        raise MXNetError(f"operator {name!r} not registered") from None
+
+
+def exists(name: str) -> bool:
+    return name in _OP_REGISTRY
+
+
+def list_all_ops() -> List[str]:
+    """Canonical names only (parity: ``MXListAllOpNames``)."""
+    return sorted({s.name for s in _OP_REGISTRY.values()})
+
+
+def list_all_names() -> List[str]:
+    """All registered names including aliases."""
+    return sorted(_OP_REGISTRY)
+
+
+def describe_op(name: str) -> str:
+    """Registry-driven documentation, the analogue of the reference's
+    ``MXSymbolGetAtomicSymbolInfo`` docstring generation."""
+    spec = get(name)
+    sig = inspect.signature(spec.fn)
+    lines = [f"Operator `{spec.name}`"]
+    if spec.aliases:
+        lines.append(f"aliases: {', '.join(spec.aliases)}")
+    lines.append(f"signature: {spec.name}{sig}")
+    if spec.doc:
+        lines.append("")
+        lines.append(inspect.cleandoc(spec.doc))
+    return "\n".join(lines)

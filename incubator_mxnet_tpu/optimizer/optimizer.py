@@ -1,0 +1,432 @@
+"""Optimizer zoo.
+
+Re-design of `python/mxnet/optimizer/optimizer.py` + the fused update
+kernels of `src/operator/optimizer_op.cc` (file-level citations — SURVEY.md
+caveat). Each ``update`` calls a registered fused-update op
+(ops/optimizer_ops.py) so XLA compiles one fused elementwise kernel per
+param — and when driven from a jitted SPMD train step, the whole optimizer
+collapses into that single program (the reference's server-side/updater
+split disappears — SURVEY.md §3.2 TPU translation).
+
+Supports per-param lr/wd multipliers, multi-precision (fp32 master weights
+for bf16/fp16 params, reference mp_* kernels), learning-rate schedulers,
+and serializable state for Trainer.save_states.
+"""
+
+from __future__ import annotations
+
+import math
+import pickle
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+
+from ..base import MXNetError, Registry
+from ..ndarray import NDArray, zeros as nd_zeros
+from ..ndarray.register import invoke_by_name
+
+__all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "RMSProp", "Ftrl",
+           "Signum", "LAMB", "AdaGrad", "AdaDelta", "Updater", "create",
+           "register", "get_updater"]
+
+_REGISTRY = Registry("optimizer")
+
+
+def register(name, aliases=()):
+    return _REGISTRY.register(name, aliases=aliases)
+
+
+def create(name, **kwargs) -> "Optimizer":
+    if isinstance(name, Optimizer):
+        return name
+    cls = _REGISTRY.get(name)
+    return cls(**kwargs)
+
+
+class Optimizer:
+    """Base optimizer (parity surface: rescale_grad, clip_gradient, lr/wd
+    multipliers, idx-keyed state, set_learning_rate)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 multi_precision=False, param_dict=None, **kwargs):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None and hasattr(lr_scheduler, "base_lr"):
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient if clip_gradient is not None else -1.0
+        self.multi_precision = multi_precision
+        self.num_update = 0
+        self._index_update_count: Dict[int, int] = {}
+        self.idx2name = param_idx2name or {}
+        self.param_dict = param_dict or {}
+        self.lr_mult: Dict[str, float] = {}
+        self.wd_mult: Dict[str, float] = {}
+
+    # -- learning rate ------------------------------------------------- #
+    @property
+    def learning_rate(self) -> float:
+        if self.lr_scheduler is not None:
+            return float(self.lr_scheduler(self.num_update))
+        return self.lr
+
+    def set_learning_rate(self, lr: float):
+        if self.lr_scheduler is not None:
+            raise MXNetError("cannot set lr directly when lr_scheduler is set")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult: Dict[str, float]):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult: Dict[str, float]):
+        self.wd_mult = dict(args_wd_mult)
+
+    def _update_count(self, index: int):
+        count = self._index_update_count.get(index, 0) + 1
+        self._index_update_count[index] = count
+        self.num_update = max(count, self.num_update)
+
+    def _get_lr(self, index) -> float:
+        lr = self.learning_rate
+        name = self.idx2name.get(index, index)
+        param = self.param_dict.get(name)
+        if param is not None and hasattr(param, "lr_mult"):
+            lr *= param.lr_mult
+        else:
+            lr *= self.lr_mult.get(name, 1.0)
+        return lr
+
+    def _get_wd(self, index) -> float:
+        wd = self.wd
+        name = self.idx2name.get(index, index)
+        param = self.param_dict.get(name)
+        if param is not None and hasattr(param, "wd_mult"):
+            wd *= param.wd_mult
+        else:
+            wd *= self.wd_mult.get(name, 1.0)
+        return wd
+
+    # -- state --------------------------------------------------------- #
+    def create_state(self, index, weight: NDArray):
+        return None
+
+    def create_state_multi_precision(self, index, weight: NDArray):
+        if self.multi_precision and weight.dtype != jnp.float32:
+            master = weight.astype("float32")
+            return (master, self.create_state(index, master))
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype != jnp.float32:
+            master, inner = state
+            self.update(index, master, grad.astype("float32"), inner)
+            weight._data = master._data.astype(weight.dtype)
+        else:
+            self.update(index, weight, grad, state)
+
+
+@register("sgd")
+class SGD(Optimizer):
+    """SGD w/ momentum (reference: optimizer.py SGD + sgd_mom_update)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd_zeros(weight.shape, dtype=str(weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if state is None:
+            new_w = invoke_by_name("sgd_update", weight, grad, lr=lr, wd=wd,
+                                   rescale_grad=self.rescale_grad,
+                                   clip_gradient=self.clip_gradient)
+            weight._data = new_w._data
+        else:
+            new_w, new_m = invoke_by_name(
+                "sgd_mom_update", weight, grad, state, lr=lr,
+                momentum=self.momentum, wd=wd, rescale_grad=self.rescale_grad,
+                clip_gradient=self.clip_gradient)
+            weight._data, state._data = new_w._data, new_m._data
+
+
+@register("nag")
+class NAG(Optimizer):
+    def __init__(self, momentum=0.9, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        return nd_zeros(weight.shape, dtype=str(weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        new_w, new_m = invoke_by_name(
+            "nag_mom_update", weight, grad, state, lr=self._get_lr(index),
+            momentum=self.momentum, wd=self._get_wd(index),
+            rescale_grad=self.rescale_grad, clip_gradient=self.clip_gradient)
+        weight._data, state._data = new_w._data, new_m._data
+
+
+@register("adam")
+class Adam(Optimizer):
+    """(reference: optimizer.py Adam + adam_update). Bias correction is
+    folded into lr, matching the reference."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        dt = str(weight.dtype)
+        return (nd_zeros(weight.shape, dtype=dt), nd_zeros(weight.shape, dtype=dt))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index)
+        lr *= math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        mean, var = state
+        new_w, new_mean, new_var = invoke_by_name(
+            "adam_update", weight, grad, mean, var, lr=lr, beta1=self.beta1,
+            beta2=self.beta2, epsilon=self.epsilon, wd=self._get_wd(index),
+            rescale_grad=self.rescale_grad, clip_gradient=self.clip_gradient)
+        weight._data = new_w._data
+        mean._data, var._data = new_mean._data, new_var._data
+
+
+@register("adamw")
+class AdamW(Adam):
+    """Decoupled weight decay (reference: contrib adamw.py)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index)
+        lr *= math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        mean, var = state
+        new_w, new_mean, new_var = invoke_by_name(
+            "adamw_update", weight, grad, mean, var, lr=lr, beta1=self.beta1,
+            beta2=self.beta2, epsilon=self.epsilon, wd=self._get_wd(index),
+            rescale_grad=self.rescale_grad, clip_gradient=self.clip_gradient)
+        weight._data = new_w._data
+        mean._data, var._data = new_mean._data, new_var._data
+
+
+@register("rmsprop")
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2 = gamma1, gamma2
+        self.epsilon = epsilon
+        self.centered = centered
+        self.clip_weights = clip_weights if clip_weights is not None else -1.0
+
+    def create_state(self, index, weight):
+        dt = str(weight.dtype)
+        if self.centered:
+            return (nd_zeros(weight.shape, dtype=dt),
+                    nd_zeros(weight.shape, dtype=dt),
+                    nd_zeros(weight.shape, dtype=dt))
+        return nd_zeros(weight.shape, dtype=dt)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if self.centered:
+            n, g_avg, delta = state
+            new_w, new_n, new_g, new_delta = invoke_by_name(
+                "rmspropalex_update", weight, grad, n, g_avg, delta, lr=lr,
+                gamma1=self.gamma1, gamma2=self.gamma2, epsilon=self.epsilon,
+                wd=wd, rescale_grad=self.rescale_grad,
+                clip_gradient=self.clip_gradient)
+            weight._data, n._data, g_avg._data, delta._data = \
+                new_w._data, new_n._data, new_g._data, new_delta._data
+        else:
+            new_w, new_n = invoke_by_name(
+                "rmsprop_update", weight, grad, state, lr=lr,
+                gamma1=self.gamma1, epsilon=self.epsilon, wd=wd,
+                rescale_grad=self.rescale_grad,
+                clip_gradient=self.clip_gradient,
+                clip_weights=self.clip_weights)
+            weight._data, state._data = new_w._data, new_n._data
+
+
+@register("ftrl")
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        dt = str(weight.dtype)
+        return (nd_zeros(weight.shape, dtype=dt), nd_zeros(weight.shape, dtype=dt))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        z, n = state
+        new_w, new_z, new_n = invoke_by_name(
+            "ftrl_update", weight, grad, z, n, lr=self._get_lr(index),
+            lamda1=self.lamda1, beta=self.beta, wd=self._get_wd(index),
+            rescale_grad=self.rescale_grad, clip_gradient=self.clip_gradient)
+        weight._data, z._data, n._data = new_w._data, new_z._data, new_n._data
+
+
+@register("signum")
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd_zeros(weight.shape, dtype=str(weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        if state is None:
+            new_w = invoke_by_name(
+                "signsgd_update", weight, grad, lr=self._get_lr(index),
+                wd=self._get_wd(index), rescale_grad=self.rescale_grad,
+                clip_gradient=self.clip_gradient)
+            weight._data = new_w._data
+        else:
+            new_w, new_m = invoke_by_name(
+                "signum_update", weight, grad, state, lr=self._get_lr(index),
+                momentum=self.momentum, wd=self._get_wd(index),
+                rescale_grad=self.rescale_grad,
+                clip_gradient=self.clip_gradient, wd_lh=self.wd_lh)
+            weight._data, state._data = new_w._data, new_m._data
+
+
+@register("lamb")
+class LAMB(Optimizer):
+    """Layer-wise adaptive moments for large-batch BERT pretraining
+    (reference: optimizer.py LAMB + lamb_update_phase1/2 kernels)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound = lower_bound if lower_bound is not None else -1.0
+        self.upper_bound = upper_bound if upper_bound is not None else -1.0
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        dt = str(weight.dtype)
+        return (nd_zeros(weight.shape, dtype=dt), nd_zeros(weight.shape, dtype=dt))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        g_upd, new_mean, new_var = invoke_by_name(
+            "lamb_update_phase1", weight, grad, mean, var, beta1=self.beta1,
+            beta2=self.beta2, epsilon=self.epsilon, t=t,
+            bias_correction=self.bias_correction, wd=self._get_wd(index),
+            rescale_grad=self.rescale_grad, clip_gradient=self.clip_gradient)
+        new_w = invoke_by_name(
+            "lamb_update_phase2", weight, g_upd, lr=self._get_lr(index),
+            lower_bound=self.lower_bound, upper_bound=self.upper_bound)
+        weight._data = new_w._data
+        mean._data, var._data = new_mean._data, new_var._data
+
+
+@register("adagrad")
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return nd_zeros(weight.shape, dtype=str(weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient > 0:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + wd * weight._data
+        state._data = state._data + jnp.square(g)
+        weight._data = weight._data - lr * g / jnp.sqrt(
+            state._data + self.float_stable_eps)
+
+
+@register("adadelta")
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.9, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        dt = str(weight.dtype)
+        return (nd_zeros(weight.shape, dtype=dt), nd_zeros(weight.shape, dtype=dt))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        acc_g, acc_delta = state
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient > 0:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + wd * weight._data
+        acc_g._data = self.rho * acc_g._data + (1 - self.rho) * jnp.square(g)
+        delta = jnp.sqrt(acc_delta._data + self.epsilon) / \
+            jnp.sqrt(acc_g._data + self.epsilon) * g
+        acc_delta._data = self.rho * acc_delta._data + (1 - self.rho) * jnp.square(delta)
+        weight._data = weight._data - delta
+
+
+class Updater:
+    """Serializable (index → state) updater, the unit the reference ships to
+    KVStore servers (`python/mxnet/optimizer/optimizer.py get_updater`;
+    here it backs Trainer.save_states)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states: Dict = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(
+                index, weight)
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def get_states(self, dump_optimizer=False) -> bytes:
+        import jax
+        host_states = jax.tree_util.tree_map(
+            lambda x: x.asnumpy() if isinstance(x, NDArray) else x, self.states,
+            is_leaf=lambda x: isinstance(x, NDArray))
+        payload = (host_states, self.optimizer) if dump_optimizer else host_states
+        return pickle.dumps(payload)
+
+    def set_states(self, states: bytes):
+        from ..ndarray import array as nd_array
+        import jax
+        data = pickle.loads(states)
+        if isinstance(data, tuple) and len(data) == 2 and \
+                isinstance(data[1], Optimizer):
+            data, self.optimizer = data
+        import numpy as np
+        self.states = jax.tree_util.tree_map(
+            lambda x: nd_array(x) if isinstance(x, np.ndarray) else x, data)
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)

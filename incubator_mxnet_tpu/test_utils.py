@@ -1,0 +1,196 @@
+"""Test utilities.
+
+Re-design of `python/mxnet/test_utils.py` (file-level citation — SURVEY.md
+caveat): ``assert_almost_equal`` with per-dtype tolerances,
+``check_numeric_gradient`` (finite differences vs autograd — SURVEY.md §4
+idiom 1), ``check_consistency`` (cross-backend equality — idiom 2),
+``default_context``, seeded reproducibility helpers (idiom 3).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import random as _pyrandom
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import autograd
+from . import context as _ctx
+from . import random as _random
+from .base import MXNetError
+from .ndarray import NDArray, array as nd_array
+from . import ndarray as nd
+
+__all__ = ["assert_almost_equal", "check_numeric_gradient", "check_consistency",
+           "default_context", "with_seed", "rand_ndarray", "same",
+           "almost_equal", "environment"]
+
+_DTYPE_RTOL = {np.dtype(np.float16): 1e-2, np.dtype(np.float32): 1e-4,
+               np.dtype(np.float64): 1e-6}
+_DTYPE_ATOL = {np.dtype(np.float16): 1e-2, np.dtype(np.float32): 1e-5,
+               np.dtype(np.float64): 1e-7}
+
+
+def default_context() -> _ctx.Context:
+    return _ctx.current_context()
+
+
+def _to_np(a):
+    if isinstance(a, NDArray):
+        return a.asnumpy()
+    return np.asarray(a)
+
+
+def same(a, b) -> bool:
+    return np.array_equal(_to_np(a), _to_np(b))
+
+
+def almost_equal(a, b, rtol=None, atol=None, equal_nan=False) -> bool:
+    a, b = _to_np(a), _to_np(b)
+    rtol = rtol or _DTYPE_RTOL.get(a.dtype, 1e-4)
+    atol = atol or _DTYPE_ATOL.get(a.dtype, 1e-5)
+    return np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b"),
+                        equal_nan=False):
+    a_np, b_np = _to_np(a), _to_np(b)
+    rtol = rtol if rtol is not None else _DTYPE_RTOL.get(np.dtype(a_np.dtype), 1e-4)
+    atol = atol if atol is not None else _DTYPE_ATOL.get(np.dtype(a_np.dtype), 1e-5)
+    if not np.allclose(a_np.astype(np.float64), b_np.astype(np.float64),
+                       rtol=rtol, atol=atol, equal_nan=equal_nan):
+        diff = np.abs(a_np.astype(np.float64) - b_np.astype(np.float64))
+        rel = diff / (np.abs(b_np.astype(np.float64)) + atol)
+        raise AssertionError(
+            f"{names[0]} and {names[1]} differ: max abs {diff.max():.3e}, "
+            f"max rel {rel.max():.3e} (rtol={rtol}, atol={atol})\n"
+            f"{names[0]}: {a_np.ravel()[:8]}...\n{names[1]}: {b_np.ravel()[:8]}...")
+
+
+def rand_ndarray(shape, dtype="float32", ctx=None, low=-1.0, high=1.0) -> NDArray:
+    data = np.random.uniform(low, high, size=shape).astype(dtype)
+    return nd_array(data, ctx=ctx)
+
+
+def check_numeric_gradient(fn: Callable, inputs: Sequence[NDArray],
+                           eps: float = 1e-3, rtol: float = 1e-2,
+                           atol: float = 1e-3,
+                           grad_nodes: Optional[Sequence[int]] = None):
+    """Validate autograd gradients of ``fn`` against central finite
+    differences (parity: ``check_numeric_gradient``; SURVEY.md §4 idiom 1).
+
+    ``fn(*inputs) -> NDArray`` must return a scalar-reducible output; we
+    reduce with ``sum()`` internally (matching the reference, which uses a
+    random projection head — sum is the deterministic variant).
+    """
+    inputs = [x if isinstance(x, NDArray) else nd_array(x) for x in inputs]
+    grad_nodes = list(range(len(inputs))) if grad_nodes is None else list(grad_nodes)
+
+    # analytic gradients (float32 path)
+    for i in grad_nodes:
+        inputs[i].attach_grad()
+    with autograd.record():
+        out = fn(*inputs)
+        head = out.sum() if out.shape != () else out
+    head.backward()
+    analytic = [inputs[i].grad.asnumpy().astype(np.float64) for i in grad_nodes]
+
+    # numeric gradients via central differences on float64 host copies
+    # (ascontiguousarray: device_get may hand back F-order arrays, and a
+    # reshape view would silently copy — perturbations must be in-place)
+    host = [np.ascontiguousarray(x.asnumpy(), dtype=np.float64) for x in inputs]
+
+    def eval_sum(arrs) -> float:
+        nds = [nd_array(a.astype(inputs[j].asnumpy().dtype))
+               for j, a in enumerate(arrs)]
+        with autograd.pause():
+            o = fn(*nds)
+        return float(o.sum().asscalar() if o.shape != () else o.asscalar())
+
+    for gi, i in enumerate(grad_nodes):
+        base = host[i]
+        num = np.zeros_like(base)
+        for idx in np.ndindex(*base.shape):
+            orig = base[idx]
+            base[idx] = orig + eps
+            f_plus = eval_sum(host)
+            base[idx] = orig - eps
+            f_minus = eval_sum(host)
+            base[idx] = orig
+            num[idx] = (f_plus - f_minus) / (2 * eps)
+        assert_almost_equal(analytic[gi], num, rtol=rtol, atol=atol,
+                            names=(f"analytic_grad[{i}]", f"numeric_grad[{i}]"))
+
+
+def check_consistency(fn: Callable, inputs_np: Sequence[np.ndarray],
+                      ctx_list: Optional[Sequence[_ctx.Context]] = None,
+                      rtol=1e-4, atol=1e-5):
+    """Run ``fn`` with the same inputs on several contexts and assert outputs
+    match (parity: ``check_consistency`` — SURVEY.md §4 idiom 2; here the
+    backends are host devices vs the TPU chip)."""
+    if ctx_list is None:
+        ctx_list = [_ctx.cpu(0), _ctx.tpu(0)]
+    results = []
+    for ctx in ctx_list:
+        ins = [nd_array(a, ctx=ctx) for a in inputs_np]
+        out = fn(*ins)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        results.append([o.asnumpy() for o in outs])
+    ref = results[0]
+    for ctx, res in zip(ctx_list[1:], results[1:]):
+        for r0, r1 in zip(ref, res):
+            assert_almost_equal(r0, r1, rtol=rtol, atol=atol,
+                                names=(f"{ctx_list[0]}", f"{ctx}"))
+
+
+def with_seed(seed: Optional[int] = None):
+    """Decorator: seed mx/np/python RNGs per test and log the seed on failure
+    (parity: tests/python/unittest/common.py @with_seed — SURVEY.md §4
+    idiom 3)."""
+
+    def decorator(test_fn):
+        @functools.wraps(test_fn)
+        def wrapper(*args, **kwargs):
+            actual = seed if seed is not None else np.random.randint(0, 2**31)
+            np.random.seed(actual)
+            _pyrandom.seed(actual)
+            _random.seed(actual)
+            try:
+                return test_fn(*args, **kwargs)
+            except Exception:
+                print(f"[with_seed] test failed with seed={actual}; "
+                      f"reproduce via @with_seed({actual})")
+                raise
+
+        return wrapper
+
+    return decorator
+
+
+class environment:
+    """Context manager to scope env vars (parity:
+    ``mx.util.environment`` / test_utils.environment)."""
+
+    def __init__(self, *args):
+        if len(args) == 2:
+            self._env = {args[0]: args[1]}
+        else:
+            self._env = dict(args[0])
+
+    def __enter__(self):
+        self._saved = {k: os.environ.get(k) for k in self._env}
+        for k, v in self._env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        return self
+
+    def __exit__(self, *exc):
+        for k, v in self._saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v

@@ -1,0 +1,3 @@
+"""Utility subsystems: serialization, docs, misc helpers."""
+
+from . import serialization  # noqa: F401

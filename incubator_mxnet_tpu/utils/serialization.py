@@ -1,0 +1,115 @@
+"""NDArray / parameter serialization.
+
+Re-design of the reference's ``.params`` format (`NDArray::Save/Load`,
+`src/ndarray/ndarray.cc`: magic header + name→array dict, device stripped —
+file-level citation, SURVEY.md caveat).
+
+Format (v1): little-endian
+    8 bytes  magic  b'MXTPU\\x00\\x01\\x00'
+    8 bytes  header length N (uint64)
+    N bytes  JSON header: {"names": [...], "arrays": [{dtype, shape}, ...]}
+    raw buffers, each 64-byte aligned, in header order (C-contiguous)
+
+Arrays are always materialized on host before save (the reference strips
+device too); load returns host arrays that callers place onto devices.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Dict, List, Union
+
+import jax
+import numpy as np
+
+from ..base import MXNetError
+
+MAGIC = b"MXTPU\x00\x01\x00"
+_ALIGN = 64
+
+
+def _tohost(arr) -> np.ndarray:
+    if hasattr(arr, "_data"):
+        arr = arr._data
+    out = np.asarray(jax.device_get(arr))
+    # bfloat16 has no numpy dtype string repr numpy understands natively in
+    # all versions; store via uint16 view with a marker.
+    return out
+
+
+def _dtype_str(a: np.ndarray) -> str:
+    return str(a.dtype)
+
+
+def save_ndarrays(fname: str, data) -> None:
+    if isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [_tohost(v) for v in data.values()]
+    elif isinstance(data, (list, tuple)):
+        names = [str(i) for i in range(len(data))]
+        arrays = [_tohost(v) for v in data]
+    else:
+        names = ["0"]
+        arrays = [_tohost(data)]
+
+    metas = []
+    bufs = []
+    for a in arrays:
+        if a.dtype.name == "bfloat16":
+            buf = a.view(np.uint16).tobytes(order="C")
+            metas.append({"dtype": "bfloat16", "shape": list(a.shape)})
+        else:
+            buf = np.ascontiguousarray(a).tobytes(order="C")
+            metas.append({"dtype": _dtype_str(a), "shape": list(a.shape)})
+        bufs.append(buf)
+
+    header = json.dumps({"names": names, "arrays": metas}).encode("utf-8")
+    with open(fname, "wb") as f:
+        f.write(MAGIC)
+        f.write(struct.pack("<Q", len(header)))
+        f.write(header)
+        pos = len(MAGIC) + 8 + len(header)
+        for buf in bufs:
+            padding = (-pos) % _ALIGN
+            f.write(b"\x00" * padding)
+            pos += padding
+            f.write(buf)
+            pos += len(buf)
+
+
+def load_ndarrays(fname: str):
+    """Returns dict name→NDArray (or list if names are all indices)."""
+    from ..ndarray import NDArray
+    import jax.numpy as jnp
+    import ml_dtypes
+
+    with open(fname, "rb") as f:
+        magic = f.read(len(MAGIC))
+        if magic != MAGIC:
+            raise MXNetError(f"{fname}: not a MXTPU params file")
+        (hlen,) = struct.unpack("<Q", f.read(8))
+        header = json.loads(f.read(hlen).decode("utf-8"))
+        pos = len(MAGIC) + 8 + hlen
+        out = {}
+        for name, meta in zip(header["names"], header["arrays"]):
+            padding = (-pos) % _ALIGN
+            f.read(padding)
+            pos += padding
+            shape = tuple(meta["shape"])
+            if meta["dtype"] == "bfloat16":
+                count = int(np.prod(shape)) if shape else 1
+                raw = f.read(count * 2)
+                pos += len(raw)
+                arr = np.frombuffer(raw, dtype=np.uint16).reshape(shape) \
+                    .view(ml_dtypes.bfloat16)
+            else:
+                dt = np.dtype(meta["dtype"])
+                count = int(np.prod(shape)) if shape else 1
+                raw = f.read(count * dt.itemsize)
+                pos += len(raw)
+                arr = np.frombuffer(raw, dtype=dt).reshape(shape)
+            out[name] = NDArray(jnp.asarray(arr))
+    if out and all(k.isdigit() for k in out):
+        return [out[str(i)] for i in range(len(out))]
+    return out

@@ -1,0 +1,20 @@
+"""Test bootstrap: force the CPU backend with a virtual 8-device host
+platform BEFORE jax is imported anywhere, so multi-device/sharding code
+paths run without TPU hardware (SURVEY.md §4 idiom 4; the driver separately
+dry-runs the multi-chip path via __graft_entry__.dryrun_multichip)."""
+
+import os
+
+# Hard override: the driver environment points JAX_PLATFORMS at a remote TPU
+# tunnel and a sitecustomize hook re-asserts it via jax.config, so both the
+# env var AND the config must be forced to cpu before any backend initializes.
+# Unit tests always run on the virtual 8-device CPU host platform.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

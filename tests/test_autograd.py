@@ -1,0 +1,264 @@
+"""Autograd semantics tests (behavioral port of the reference's
+tests/python/unittest/test_autograd.py — SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, nd
+from incubator_mxnet_tpu.test_utils import (assert_almost_equal,
+                                            check_numeric_gradient, with_seed)
+
+
+def test_basic_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_chain_rule():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(nd.log(x) * 2.0)  # = x^2
+        z = y.sum()
+    z.backward()
+    assert_almost_equal(x.grad.asnumpy(), 2 * x.asnumpy(), rtol=1e-3,
+                        atol=1e-3)
+
+
+def test_head_gradient():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3.0
+    y.backward(nd.array([10.0, 100.0]))
+    assert x.grad.asnumpy().tolist() == [30.0, 300.0]
+
+
+def test_grad_req_add():
+    x = nd.array([1.0, 1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = (x * 2.0).sum()
+        y.backward()
+    assert x.grad.asnumpy().tolist() == [6.0, 6.0]
+    x.attach_grad()  # re-attach resets to write
+    with autograd.record():
+        (x * 2.0).sum().backward()
+    assert x.grad.asnumpy().tolist() == [2.0, 2.0]
+
+
+def test_grad_req_null():
+    x = nd.array([1.0])
+    y = nd.array([2.0])
+    x.attach_grad()
+    y.attach_grad(grad_req="null")
+    with autograd.record():
+        z = x * y
+    z.backward()
+    assert x.grad.asnumpy().tolist() == [2.0]
+    assert y.grad.asnumpy().tolist() == [0.0]
+
+
+def test_multiple_uses():
+    # same variable used twice: gradients accumulate along both paths
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + x
+    y.backward()
+    assert x.grad.asnumpy().tolist() == [7.0]  # 2x + 1
+
+
+def test_detach_and_stop_gradient():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    assert x.grad.asnumpy().tolist() == [4.0]  # only d(z)/dx via second factor
+    with autograd.record():
+        w = nd.BlockGrad(x * x) * x
+    w.backward()
+    assert x.grad.asnumpy().tolist() == [4.0]
+
+
+def test_pause_and_modes():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+            y = x * 2.0
+        assert y._ag_node is None
+        with autograd.predict_mode():
+            assert autograd.is_recording()
+            assert not autograd.is_training()
+    assert not autograd.is_recording()
+
+
+def test_training_aware_dropout():
+    x = nd.ones((100, 100))
+    with autograd.record(train_mode=True):
+        y = nd.Dropout(x, p=0.5)
+    dropped = float((y.asnumpy() == 0).mean())
+    assert 0.3 < dropped < 0.7
+    with autograd.record(train_mode=False):
+        y2 = nd.Dropout(x, p=0.5)
+    assert (y2.asnumpy() == 1.0).all()
+    y3 = nd.Dropout(x, p=0.5)  # outside record: predict mode
+    assert (y3.asnumpy() == 1.0).all()
+
+
+def test_dropout_backward_consistency():
+    # the SAME mask must be used in forward and backward (key threading)
+    x = nd.ones((50, 50))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Dropout(x, p=0.5)
+        z = y.sum()
+    z.backward()
+    g = x.grad.asnumpy()
+    out = y.asnumpy()
+    assert np.array_equal(g != 0, out != 0)
+
+
+def test_autograd_grad_function():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    gs = autograd.grad([y], [x], retain_graph=False)
+    assert_almost_equal(gs[0].asnumpy(), 2 * x.asnumpy())
+    # .grad buffer untouched by autograd.grad
+    assert x.grad.asnumpy().tolist() == [0.0, 0.0]
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = nd.array([0.0, 1.0, -1.0])
+    x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    assert_almost_equal(x.grad.asnumpy(), s * (1 - s), rtol=1e-4)
+
+
+def test_mark_variables():
+    x = nd.array([2.0])
+    g = nd.zeros((1,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = x * 5.0
+    y.backward()
+    assert g.asnumpy().tolist() == [5.0]
+
+
+def test_multi_output_op_backward():
+    x = nd.array(np.arange(6).reshape(2, 3).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        a, b, c = nd.split(x, num_outputs=3, axis=1)
+        loss = (a * 1.0 + b * 2.0 + c * 3.0).sum()
+    loss.backward()
+    assert x.grad.asnumpy().tolist() == [[1.0, 2.0, 3.0]] * 2
+
+
+@with_seed(0)
+def test_numeric_gradient_elemwise():
+    x = nd.array(np.random.uniform(0.5, 1.5, (3, 4)).astype(np.float32))
+    check_numeric_gradient(lambda a: nd.exp(a), [x])
+    check_numeric_gradient(lambda a: nd.log(a), [x])
+    check_numeric_gradient(lambda a: nd.sqrt(a), [x])
+    check_numeric_gradient(lambda a: nd.sigmoid(a), [x])
+    check_numeric_gradient(lambda a: nd.tanh(a), [x])
+
+
+@with_seed(0)
+def test_numeric_gradient_matmul():
+    a = nd.array(np.random.uniform(-1, 1, (3, 4)).astype(np.float32))
+    b = nd.array(np.random.uniform(-1, 1, (4, 2)).astype(np.float32))
+    check_numeric_gradient(lambda x, y: nd.dot(x, y), [a, b])
+
+
+@with_seed(0)
+def test_numeric_gradient_softmax():
+    x = nd.array(np.random.uniform(-2, 2, (2, 5)).astype(np.float32))
+    check_numeric_gradient(lambda a: nd.softmax(a), [x], rtol=2e-2)
+    check_numeric_gradient(lambda a: nd.log_softmax(a), [x], rtol=2e-2)
+
+
+def test_retain_graph():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward(retain_graph=True)
+    assert x.grad.asnumpy().tolist() == [4.0]
+    y.backward()  # second backward works because graph retained
+    assert x.grad.asnumpy().tolist() == [4.0]
+
+
+def test_exception_without_record():
+    x = nd.array([1.0])
+    with pytest.raises(Exception):
+        x.backward()
+
+
+def test_inplace_ops_record_gradient():
+    """__iadd__/__imul__ on a recorded array must keep the tape wired to the
+    mutated array (regression: tape node pointed at the discarded temp)."""
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        y += 1
+        y *= 3          # y = (2x+1)*3, dy/dx = 6
+        loss = y.sum()
+    loss.backward()
+    assert np.allclose(x.grad.asnumpy(), [6.0, 6.0])
+
+
+def test_grad_wrt_intermediate():
+    """autograd.grad w.r.t. a non-leaf (recorded) array (regression:
+    returned zeros because the node path shadowed the marked-variable
+    path)."""
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = (y * 3).sum()
+    gy = autograd.grad(z, [y])[0]
+    assert np.allclose(gy.asnumpy(), [3.0, 3.0, 3.0])
+
+
+def test_single_output_variadic_backward():
+    """split with one section returns a 1-tuple; backward must seed the vjp
+    with a tuple (regression: ValueError tree-structure mismatch)."""
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        parts = nd.split_v2(x, 1, axis=0)
+        part = parts[0] if isinstance(parts, (list, tuple)) else parts
+        loss = (part * 2).sum()
+    loss.backward()
+    assert np.allclose(x.grad.asnumpy(), 2 * np.ones((2, 2)))

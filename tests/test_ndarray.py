@@ -1,0 +1,256 @@
+"""NDArray semantics tests (ports the *behavioral contract* of the
+reference's tests/python/unittest/test_ndarray.py — SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.test_utils import assert_almost_equal, with_seed
+
+
+def test_creation():
+    a = nd.zeros((2, 3))
+    assert a.shape == (2, 3)
+    assert str(a.dtype) == "float32"
+    b = nd.ones((4,), dtype="int32")
+    assert b.asnumpy().tolist() == [1, 1, 1, 1]
+    c = nd.full((2, 2), 7.0)
+    assert c.asnumpy().max() == 7.0
+    d = nd.array([[1, 2], [3, 4]])
+    assert str(d.dtype) == "float32"  # python lists default to float32
+    e = nd.array(np.int64(np.arange(4)).reshape(2, 2))
+    assert "int" in str(e.dtype)
+    f = nd.arange(0, 10, 2)
+    assert f.shape == (5,)
+    g = nd.eye(3)
+    assert g.asnumpy()[1, 1] == 1.0
+
+
+def test_arithmetic_broadcast():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([10.0, 20.0])
+    assert_almost_equal((a + b).asnumpy(), np.array([[11, 22], [13, 24]]))
+    assert_almost_equal((a * 2 + 1).asnumpy(), a.asnumpy() * 2 + 1)
+    assert_almost_equal((1 - a).asnumpy(), 1 - a.asnumpy())
+    assert_almost_equal((a / b).asnumpy(), a.asnumpy() / b.asnumpy())
+    assert_almost_equal((a ** 2).asnumpy(), a.asnumpy() ** 2)
+    assert_almost_equal((-a).asnumpy(), -a.asnumpy())
+    assert_almost_equal(abs(nd.array([-1.0, 2.0])).asnumpy(), [1.0, 2.0])
+
+
+def test_inplace_ops():
+    a = nd.ones((2, 2))
+    a += 1
+    assert a.asnumpy().min() == 2.0
+    a *= 3
+    assert a.asnumpy().max() == 6.0
+    a /= 2
+    assert a.asnumpy().max() == 3.0
+
+
+def test_comparisons():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([2.0, 2.0, 2.0])
+    assert (a > b).asnumpy().tolist() == [0.0, 0.0, 1.0]
+    assert (a == b).asnumpy().tolist() == [0.0, 1.0, 0.0]
+    assert (a <= b).asnumpy().tolist() == [1.0, 1.0, 0.0]
+
+
+def test_indexing():
+    a = nd.array(np.arange(24).reshape(2, 3, 4))
+    assert a[0].shape == (3, 4)
+    assert a[1, 2].shape == (4,)
+    assert a[0, 1, 2].asscalar() == 6
+    assert a[:, 1].shape == (2, 4)
+    assert a[0, :, 1:3].shape == (3, 2)
+    # setitem
+    b = nd.zeros((3, 3))
+    b[1] = 5.0
+    assert b.asnumpy()[1].tolist() == [5.0, 5.0, 5.0]
+    b[0, 2] = 1.0
+    assert b.asnumpy()[0, 2] == 1.0
+    b[:] = 9.0
+    assert b.asnumpy().min() == 9.0
+
+
+def test_reshape_magic_codes():
+    a = nd.zeros((2, 3, 4))
+    assert a.reshape((6, 4)).shape == (6, 4)
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((0, 0, 2, 2)).shape == (2, 3, 2, 2)
+    assert a.reshape((-2,)).shape == (2, 3, 4)
+    assert a.reshape((-3, 4)).shape == (6, 4)
+    assert a.reshape((2, -4, 3, 1, 4)).shape == (2, 3, 1, 4)
+    assert a.reshape((2, -4, -1, 3, 4)).shape == (2, 1, 3, 4)
+
+
+def test_reductions():
+    a_np = np.random.uniform(size=(2, 3, 4)).astype(np.float32)
+    a = nd.array(a_np)
+    assert_almost_equal(a.sum().asnumpy(), a_np.sum())
+    assert_almost_equal(a.sum(axis=1).asnumpy(), a_np.sum(axis=1))
+    assert_almost_equal(a.mean(axis=(0, 2)).asnumpy(), a_np.mean(axis=(0, 2)))
+    assert_almost_equal(a.max(axis=-1, keepdims=True).asnumpy(),
+                        a_np.max(axis=-1, keepdims=True))
+    assert_almost_equal(nd.sum(a, axis=1, exclude=True).asnumpy(),
+                        a_np.sum(axis=(0, 2)))
+    assert_almost_equal(a.norm().asnumpy(), np.sqrt((a_np ** 2).sum()),
+                        rtol=1e-4)
+    assert int(a.argmax().asscalar()) == int(a_np.argmax())
+
+
+def test_dot():
+    a_np = np.random.uniform(size=(3, 4)).astype(np.float32)
+    b_np = np.random.uniform(size=(4, 5)).astype(np.float32)
+    assert_almost_equal(nd.dot(nd.array(a_np), nd.array(b_np)).asnumpy(),
+                        a_np @ b_np, rtol=1e-4, atol=1e-4)
+    assert_almost_equal(
+        nd.dot(nd.array(a_np), nd.array(b_np.T), transpose_b=True).asnumpy(),
+        a_np @ b_np, rtol=1e-4, atol=1e-4)
+    # batch_dot
+    x = np.random.uniform(size=(2, 3, 4)).astype(np.float32)
+    y = np.random.uniform(size=(2, 4, 5)).astype(np.float32)
+    assert_almost_equal(nd.batch_dot(nd.array(x), nd.array(y)).asnumpy(),
+                        np.matmul(x, y), rtol=1e-4, atol=1e-4)
+
+
+def test_shape_ops():
+    a = nd.array(np.arange(24).reshape(2, 3, 4).astype(np.float32))
+    assert a.transpose().shape == (4, 3, 2)
+    assert a.transpose((1, 0, 2)).shape == (3, 2, 4)
+    assert a.T.shape == (4, 3, 2)
+    assert a.expand_dims(0).shape == (1, 2, 3, 4)
+    assert a.expand_dims(0).squeeze(0).shape == (2, 3, 4)
+    assert a.flatten().shape == (2, 12)
+    assert nd.concat(a, a, dim=1).shape == (2, 6, 4)
+    assert nd.stack(a, a, axis=0).shape == (2, 2, 3, 4)
+    parts = nd.split(a, num_outputs=3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 1, 4)
+    sq = nd.split(a, num_outputs=3, axis=1, squeeze_axis=True)
+    assert sq[0].shape == (2, 4)
+    assert a.swapaxes(0, 2).shape == (4, 3, 2)
+    assert nd.tile(nd.ones((2, 2)), (2, 3)).shape == (4, 6)
+    assert nd.repeat(nd.ones((2, 2)), 3, axis=0).shape == (6, 2)
+    assert a.slice_axis(axis=2, begin=1, end=3).shape == (2, 3, 2)
+    s = nd.slice(a, begin=(0, 1), end=(2, 3))
+    assert s.shape == (2, 2, 4)
+
+
+def test_take_pick_gather():
+    a = nd.array(np.arange(12).reshape(3, 4).astype(np.float32))
+    t = nd.take(a, nd.array([0, 2]), axis=0)
+    assert t.shape == (2, 4)
+    assert t.asnumpy()[1, 0] == 8.0
+    p = nd.pick(a, nd.array([1, 0, 3]), axis=1)
+    assert p.asnumpy().tolist() == [1.0, 4.0, 11.0]
+    g = nd.gather_nd(a, nd.array([[0, 2], [1, 3]]))
+    assert g.asnumpy().tolist() == [1.0, 11.0]
+    oh = nd.one_hot(nd.array([0, 2]), depth=3)
+    assert oh.asnumpy().tolist() == [[1, 0, 0], [0, 0, 1]]
+
+
+def test_ordering():
+    a = nd.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]])
+    v = nd.topk(a, k=2, ret_typ="value")
+    assert v.asnumpy().tolist() == [[3.0, 2.0], [5.0, 4.0]]
+    idx = nd.topk(a, k=1)
+    assert idx.asnumpy().reshape(-1).tolist() == [0.0, 1.0]
+    asc = nd.sort(a, is_ascend=True)
+    assert asc.asnumpy()[0].tolist() == [1.0, 2.0, 3.0]
+    desc = nd.argsort(a, is_ascend=False)
+    assert desc.asnumpy()[1].tolist() == [1.0, 2.0, 0.0]
+
+
+def test_sequence_ops():
+    # (T=3, B=2)
+    data = nd.array(np.arange(6).reshape(3, 2).astype(np.float32))
+    lens = nd.array([2.0, 3.0])
+    m = nd.SequenceMask(data, sequence_length=lens, use_sequence_length=True,
+                        value=-1.0)
+    out = m.asnumpy()
+    assert out[2, 0] == -1.0 and out[2, 1] == 5.0
+    last = nd.SequenceLast(data, sequence_length=lens, use_sequence_length=True)
+    assert last.asnumpy().tolist() == [2.0, 5.0]
+    rev = nd.SequenceReverse(data, sequence_length=lens, use_sequence_length=True)
+    assert rev.asnumpy()[0].tolist() == [2.0, 5.0]
+
+
+def test_dtype_cast_copy():
+    a = nd.ones((2, 2))
+    b = a.astype("int32")
+    assert "int32" in str(b.dtype)
+    c = a.copy()
+    c[:] = 5
+    assert a.asnumpy().max() == 1.0
+    d = nd.cast(a, dtype="float16")
+    assert "float16" in str(d.dtype)
+
+
+def test_context_placement():
+    a = nd.ones((2, 2), ctx=mx.tpu(0))
+    assert a.context.device_id == 0
+    b = a.as_in_context(mx.tpu(1))
+    assert b.context.device_id == 1
+    assert_almost_equal(a.asnumpy(), b.asnumpy())
+    assert mx.num_devices() >= 8  # virtual host platform in tests
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrays.params")
+    d = {"w": nd.array(np.random.uniform(size=(3, 3)).astype(np.float32)),
+         "b": nd.ones((7,), dtype="int32")}
+    nd.save(fname, d)
+    loaded = nd.load(fname)
+    assert set(loaded) == {"w", "b"}
+    assert_almost_equal(loaded["w"].asnumpy(), d["w"].asnumpy())
+    assert loaded["b"].asnumpy().tolist() == [1] * 7
+    lst = [nd.ones((2,)), nd.zeros((3,))]
+    nd.save(fname, lst)
+    back = nd.load(fname)
+    assert isinstance(back, list) and back[1].shape == (3,)
+
+
+def test_bfloat16_save_load(tmp_path):
+    fname = str(tmp_path / "bf16.params")
+    a = nd.ones((4, 4)).astype("bfloat16")
+    nd.save(fname, {"x": a})
+    out = nd.load(fname)["x"]
+    assert "bfloat16" in str(out.dtype)
+    assert out.astype("float32").asnumpy().max() == 1.0
+
+
+@with_seed(42)
+def test_random_reproducibility():
+    mx.random.seed(7)
+    a = nd.random.uniform(shape=(5,)).asnumpy()
+    mx.random.seed(7)
+    b = nd.random.uniform(shape=(5,)).asnumpy()
+    assert np.array_equal(a, b)
+    c = nd.random.uniform(shape=(5,)).asnumpy()
+    assert not np.array_equal(b, c)  # stream advances
+    n = nd.random.normal(0.0, 1.0, shape=(10000,))
+    assert abs(float(n.mean().asscalar())) < 0.05
+    r = nd.random.randint(0, 10, shape=(100,))
+    assert r.asnumpy().min() >= 0 and r.asnumpy().max() < 10
+
+
+def test_scalar_conversions():
+    a = nd.array([3.5])
+    assert float(a) == 3.5
+    assert a.asscalar() == 3.5
+    with pytest.raises(Exception):
+        nd.ones((2, 2)).asscalar()
+    assert len(nd.zeros((5, 2))) == 5
+    rows = list(nd.array([[1.0], [2.0]]))
+    assert rows[1].asscalar() == 2.0
+
+
+def test_where_clip_misc():
+    cond = nd.array([1.0, 0.0, 1.0])
+    x = nd.array([1.0, 2.0, 3.0])
+    y = nd.array([10.0, 20.0, 30.0])
+    assert nd.where(cond, x, y).asnumpy().tolist() == [1.0, 20.0, 3.0]
+    assert nd.clip(x, 1.5, 2.5).asnumpy().tolist() == [1.5, 2.0, 2.5]
+    assert nd.add_n(x, y, x).asnumpy().tolist() == [12.0, 24.0, 36.0]
