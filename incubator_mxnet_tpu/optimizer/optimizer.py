@@ -60,6 +60,10 @@ class Optimizer:
         self.multi_precision = multi_precision
         self.num_update = 0
         self._index_update_count: Dict[int, int] = {}
+        # trace overrides: a jitted SPMD step threads the step counter and
+        # scheduler lr as traced scalars so they are not frozen at trace time
+        self._traced_t = None
+        self._traced_lr = None
         self.idx2name = param_idx2name or {}
         self.param_dict = param_dict or {}
         self.lr_mult: Dict[str, float] = {}
@@ -88,8 +92,15 @@ class Optimizer:
         self._index_update_count[index] = count
         self.num_update = max(count, self.num_update)
 
+    def _step_t(self, index):
+        """Per-param update count; a traced scalar inside a jitted step."""
+        if self._traced_t is not None:
+            return self._traced_t
+        return self._index_update_count[index]
+
     def _get_lr(self, index) -> float:
-        lr = self.learning_rate
+        lr = self._traced_lr if self._traced_lr is not None \
+            else self.learning_rate
         name = self.idx2name.get(index, index)
         param = self.param_dict.get(name)
         if param is not None and hasattr(param, "lr_mult"):
@@ -193,9 +204,9 @@ class Adam(Optimizer):
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
-        t = self._index_update_count[index]
+        t = self._step_t(index)
         lr = self._get_lr(index)
-        lr *= math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        lr = lr * jnp.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
         mean, var = state
         new_w, new_mean, new_var = invoke_by_name(
             "adam_update", weight, grad, mean, var, lr=lr, beta1=self.beta1,
@@ -211,9 +222,9 @@ class AdamW(Adam):
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
-        t = self._index_update_count[index]
+        t = self._step_t(index)
         lr = self._get_lr(index)
-        lr *= math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        lr = lr * jnp.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
         mean, var = state
         new_w, new_mean, new_var = invoke_by_name(
             "adamw_update", weight, grad, mean, var, lr=lr, beta1=self.beta1,
@@ -332,7 +343,7 @@ class LAMB(Optimizer):
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
-        t = self._index_update_count[index]
+        t = self._step_t(index)
         mean, var = state
         g_upd, new_mean, new_var = invoke_by_name(
             "lamb_update_phase1", weight, grad, mean, var, beta1=self.beta1,

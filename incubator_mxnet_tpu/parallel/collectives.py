@@ -1,0 +1,90 @@
+"""Collective communication wrappers.
+
+The reference's comm layer is explicit code paths per transport: CPU reduce
+(`CommCPU`), GPU P2P/tree reduce (`CommDevice`/`CommDeviceTree`), NCCL
+(`kvstore_nccl.h`), ZMQ parameter server (ps-lite) — SURVEY.md §5.8. Here
+every collective is an XLA op on a mesh axis; the compiler schedules it on
+ICI within a slice and DCN across slices, and overlap with compute comes
+from XLA's latency-hiding scheduler (the reference's P3 priority scheduling
+has no manual analogue — SURVEY.md §2.3).
+
+Two API levels:
+  - in-step (traced) collectives for use inside `shard_map`-ped functions:
+    thin aliases of `jax.lax` collectives, kept here so model code imports
+    one namespace;
+  - host-level eager helpers (`host_allreduce`) used by the KVStore facade
+    for cross-process reduction outside a compiled step.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# ----------------------------------------------------------------------- #
+# traced collectives (inside shard_map / pmapped code)
+# ----------------------------------------------------------------------- #
+psum = lax.psum
+pmean = lax.pmean
+pmax = lax.pmax
+pmin = lax.pmin
+ppermute = lax.ppermute
+all_gather = lax.all_gather
+all_to_all = lax.all_to_all
+axis_index = lax.axis_index
+
+
+def reduce_scatter(x, axis_name: str, scatter_dimension: int = 0,
+                   tiled: bool = True):
+    """Sum across ``axis_name`` and scatter shards along
+    ``scatter_dimension`` (reference capability: the reduce half of a
+    ring allreduce; used for ZeRO-style grad sharding)."""
+    return lax.psum_scatter(x, axis_name,
+                            scatter_dimension=scatter_dimension, tiled=tiled)
+
+
+# ----------------------------------------------------------------------- #
+# host-level eager collectives (the KVStore facade's transport)
+# ----------------------------------------------------------------------- #
+def host_allreduce(x: jax.Array, op: str = "sum") -> jax.Array:
+    """Eager cross-process allreduce over DCN.
+
+    Replaces the reference's dist_sync push path (worker → ps-lite server
+    aggregate → pull, SURVEY.md §3.4): every process contributes its local
+    array; all processes get the elementwise reduction. Single-process is
+    the identity (the in-process multi-device reduction already happened in
+    the caller).
+    """
+    if jax.process_count() == 1:
+        return x
+    from jax.experimental import multihost_utils
+
+    if op != "sum":
+        raise ValueError(f"unsupported host_allreduce op {op!r}")
+    gathered = multihost_utils.process_allgather(x)  # (n_proc, ...)
+    return jnp.sum(gathered, axis=0)
+
+
+def host_broadcast(x: jax.Array, root: int = 0) -> jax.Array:
+    """Broadcast ``x`` from the root process to all processes (the
+    reference's init-time weight broadcast via kvstore init/pull)."""
+    if jax.process_count() == 1:
+        return x
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(
+        x, is_source=jax.process_index() == root)
+
+
+def host_barrier(tag: str = "barrier"):
+    """Cross-process barrier (reference: ps-lite ``Barrier``)."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(tag)

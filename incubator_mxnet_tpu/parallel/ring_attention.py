@@ -1,0 +1,133 @@
+"""Ring attention: exact attention over sequences sharded across devices.
+
+The reference has NO long-context parallelism (SURVEY.md §5.7 — BERT-era
+≤512 windows); this module is the TPU-native capability that subsumes it.
+Sequence length is sharded over the mesh ``sp`` axis; each device holds a
+Q/K/V block and K/V blocks rotate around the ring via ``lax.ppermute`` on
+ICI while a numerically-stable streaming softmax (the flash-attention
+recurrence) accumulates partial outputs. Compute on the current block
+overlaps with the transfer of the next (XLA schedules the ppermute
+asynchronously), so attention of length ``sp × T_blk`` runs with per-device
+memory of one block — the Ring Attention construction (see PAPERS.md).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..base import MXNetError
+
+__all__ = ["ring_self_attention", "ring_attention_block"]
+
+_NEG_INF = -1e30
+
+
+def _stream_block(q, k, v, acc, row_max, row_sum, mask):
+    """One flash-attention accumulation step.
+
+    q: (B, Tq, H, D); k/v: (B, Tk, H, D); acc: (B, Tq, H, D);
+    row_max/row_sum: (B, Tq, H); mask: (Tq, Tk) additive or None.
+    """
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+    if mask is not None:
+        scores = scores + mask[None, None, :, :]
+    blk_max = scores.max(axis=-1)                       # (B,H,Tq)
+    blk_max = jnp.moveaxis(blk_max, 1, -1)              # (B,Tq,H)
+    new_max = jnp.maximum(row_max, blk_max)
+    corr = jnp.exp(row_max - new_max)                   # (B,Tq,H)
+    p = jnp.exp(scores - jnp.moveaxis(new_max, -1, 1)[..., None])  # (B,H,Tq,Tk)
+    blk_out = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    blk_sum = jnp.moveaxis(p.sum(axis=-1), 1, -1)       # (B,Tq,H)
+    acc = acc * corr[..., None] + blk_out
+    row_sum = row_sum * corr + blk_sum
+    return acc, new_max, row_sum
+
+
+def ring_attention_block(q, k, v, axis_name: str = "sp",
+                         causal: bool = False, scale: Optional[float] = None):
+    """Per-shard ring attention body (call inside ``shard_map``).
+
+    q, k, v: local blocks (B, T_blk, H, D); the global sequence is the
+    concatenation over the ``axis_name`` mesh axis. Returns the local
+    output block (B, T_blk, H, D).
+    """
+    B, Tq, H, D = q.shape
+    n = lax.axis_index(axis_name)
+    size = lax.psum(1, axis_name)
+    if scale is None:
+        scale = D ** -0.5
+    q = q * scale
+
+    acc = jnp.zeros(q.shape, jnp.float32)
+    row_max = jnp.full((B, Tq, H), _NEG_INF, jnp.float32)
+    row_sum = jnp.zeros((B, Tq, H), jnp.float32)
+    # constants enter the loop unvarying over the mesh axis while the loop
+    # body produces device-varying values; align the carry's varying type
+    acc, row_max, row_sum = jax.tree_util.tree_map(
+        lambda x: lax.pcast(x, (axis_name,), to="varying"),
+        (acc, row_max, row_sum))
+    qf = q.astype(jnp.float32)
+
+    pos_q = n * Tq + jnp.arange(Tq)
+
+    def body(step, carry):
+        acc, row_max, row_sum, k_cur, v_cur = carry
+        # after `step` rotations device n holds the block of device n-step
+        src = (n - step) % size
+        if causal:
+            pos_k = src * Tq + jnp.arange(k_cur.shape[1])
+            mask = jnp.where(pos_k[None, :] <= pos_q[:, None], 0.0, _NEG_INF)
+        else:
+            mask = None
+        acc, row_max, row_sum = _stream_block(
+            qf, k_cur.astype(jnp.float32), v_cur.astype(jnp.float32),
+            acc, row_max, row_sum, mask)
+        # rotate k/v one hop around the ring (device i -> i+1)
+        perm = [(i, (i + 1) % size) for i in range(size)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return acc, row_max, row_sum, k_nxt, v_nxt
+
+    carry = (acc, row_max, row_sum, k, v)
+    carry = lax.fori_loop(0, size, body, carry)
+    acc, row_max, row_sum = carry[:3]
+    out = acc / jnp.maximum(row_sum[..., None], 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_self_attention(q, k, v, mesh: Optional[Mesh] = None,
+                        axis_name: str = "sp", causal: bool = False,
+                        scale: Optional[float] = None,
+                        batch_axis: Optional[str] = "dp"):
+    """Exact self-attention with the sequence sharded over ``axis_name``.
+
+    q, k, v: global (B, T, H, D) arrays; T must divide by the ``sp`` axis
+    size. Returns (B, T, H, D). Differentiable (jax traces through the
+    ppermute ring), jit-safe, and composable with data parallelism via
+    ``batch_axis``.
+    """
+    from . import mesh as _mesh_mod
+
+    if mesh is None:
+        mesh = _mesh_mod.default_mesh()
+    if axis_name not in mesh.shape:
+        raise MXNetError(f"mesh has no axis {axis_name!r}")
+    sp = mesh.shape[axis_name]
+    if q.shape[1] % sp != 0:
+        raise MXNetError(
+            f"sequence length {q.shape[1]} not divisible by {axis_name} "
+            f"axis size {sp}")
+    b_ax = batch_axis if batch_axis in mesh.shape else None
+    spec = PartitionSpec(b_ax, axis_name, None, None)
+
+    fn = partial(ring_attention_block, axis_name=axis_name, causal=causal,
+                 scale=scale)
+    mapped = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                           out_specs=spec)
+    return mapped(q, k, v)

@@ -1,0 +1,293 @@
+"""Fused SPMD training: forward + backward + gradient reduction + optimizer
+update as ONE jitted XLA program over a device mesh.
+
+This is the TPU-native replacement for the reference's entire hot training
+path (SURVEY.md §3.2): Gluon's eager fwd/bwd + `Trainer._allreduce_grads`
+(KVStore push/pull over NCCL/ps-lite) + per-param optimizer ops collapse
+into a single compiled step. Gradient reduction needs no explicit psum —
+parameters are replicated (or FSDP-sharded) and the batch is sharded over
+the ``dp`` axis, so XLA inserts the all-reduce/reduce-scatter on ICI/DCN
+itself and overlaps it with the backward pass (the reference's P3 priority
+propagation, compiler-scheduled — SURVEY.md §2.3).
+
+Sharding modes:
+  - ``replicated``: pure data parallelism (reference kvstore=`device`/`nccl`)
+  - ``fsdp``: parameters/optimizer state sharded over the ``fsdp`` axis
+    (ZeRO-style; beyond reference capability but idiomatic on TPU)
+  - per-Parameter ``PartitionSpec`` hints (``Parameter._sharding``) override
+    both — used by models/ for tensor parallelism.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import jax.tree_util as jtu
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .. import autograd, random as _random
+from ..base import MXNetError
+from ..ndarray import NDArray
+from ..optimizer import create as opt_create
+from . import mesh as _mesh
+
+__all__ = ["SPMDTrainer", "shard_params", "replicate"]
+
+
+def replicate(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def _fsdp_spec(shape, mesh: Mesh) -> PartitionSpec:
+    """Shard the largest divisible dim over the fsdp axis, else replicate."""
+    size = mesh.shape["fsdp"]
+    if size == 1:
+        return PartitionSpec()
+    dims = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for d in dims:
+        if shape[d] % size == 0 and shape[d] >= size:
+            spec = [None] * len(shape)
+            spec[d] = "fsdp"
+            return PartitionSpec(*spec)
+    return PartitionSpec()
+
+
+def _param_sharding(p, mesh: Mesh, mode: str) -> NamedSharding:
+    if getattr(p, "_sharding", None) is not None:
+        spec = p._sharding
+        if not isinstance(spec, PartitionSpec):
+            spec = PartitionSpec(*spec)
+        return NamedSharding(mesh, spec)
+    if mode == "fsdp":
+        return NamedSharding(mesh, _fsdp_spec(p.shape, mesh))
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def shard_params(block, mesh: Mesh, mode: str = "replicated"):
+    """Place every initialized Parameter of ``block`` onto ``mesh`` with its
+    resolved sharding (eager re-placement; the jitted step then runs with
+    arrays already resident)."""
+    for p in block.collect_params().values():
+        if p._data is None:
+            continue
+        sh = _param_sharding(p, mesh, mode)
+        p._data._data = jax.device_put(p._data._data, sh)
+
+
+class SPMDTrainer:
+    """One-fused-step trainer over a mesh (the Trainer fast path).
+
+    Parameters
+    ----------
+    block : HybridBlock — the model (must be initialized, shapes known).
+    loss : callable ``loss(out, *labels) -> NDArray`` (a gluon loss works).
+    optimizer : str | Optimizer, with ``optimizer_params`` as for Trainer.
+    mesh : jax mesh (default: all devices on ``dp``).
+    sharding : 'replicated' | 'fsdp'.
+    forward_loss : optional ``fn(block, *batch) -> scalar NDArray`` override
+        for models whose loss is not ``loss(block(x), y)`` (e.g. BERT MLM).
+    """
+
+    def __init__(self, block, loss=None, optimizer="sgd",
+                 optimizer_params=None, mesh: Optional[Mesh] = None,
+                 sharding: str = "replicated",
+                 forward_loss: Optional[Callable] = None,
+                 donate: bool = True):
+        if loss is None and forward_loss is None:
+            raise MXNetError("provide loss or forward_loss")
+        self.block = block
+        self.loss = loss
+        self.forward_loss = forward_loss
+        self.mesh = mesh if mesh is not None else _mesh.default_mesh()
+        self.sharding_mode = sharding
+        self.donate = donate
+
+        params = list(block.collect_params().values())
+        not_ready = [p.name for p in params
+                     if p._data is None and p._deferred_init is None]
+        if not_ready:
+            raise MXNetError(
+                f"uninitialized parameters: {not_ready}; call "
+                f"block.initialize() first")
+        self._params = params
+        self._train_idx = [i for i, p in enumerate(params)
+                           if p.grad_req != "null"]
+
+        if isinstance(optimizer, str):
+            pd = {p.name: p for p in params}
+            self._optimizer = opt_create(
+                optimizer, param_dict=pd,
+                param_idx2name={i: params[i].name
+                                for i in range(len(params))},
+                **(optimizer_params or {}))
+        else:
+            self._optimizer = optimizer
+
+        self._step_fn = None
+        self._opt_state = None  # list aligned with self._train_idx
+        self.step_count = 0
+
+    # ------------------------------------------------------------------ #
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    # ------------------------------------------------------------------ #
+    def _materialize(self, batch_nds):
+        """Finish deferred init with one eager forward, then place params
+        (and build optimizer state) with their mesh shardings."""
+        if any(p._deferred_init is not None for p in self._params):
+            with autograd.pause():
+                if self.forward_loss is not None:
+                    self.forward_loss(self.block, *batch_nds)
+                else:
+                    self.block(batch_nds[0])
+            self._params = list(self.block.collect_params().values())
+            self._train_idx = [i for i, p in enumerate(self._params)
+                               if p.grad_req != "null"]
+        shard_params(self.block, self.mesh, self.sharding_mode)
+        if self._opt_state is None:
+            self._opt_state = []
+            for i in self._train_idx:
+                p = self._params[i]
+                st = self._optimizer.create_state(i, p.data())
+                sh = _param_sharding(p, self.mesh, self.sharding_mode)
+                st = jtu.tree_map(
+                    lambda s: NDArray(jax.device_put(s._data, sh))
+                    if isinstance(s, NDArray) else s, st,
+                    is_leaf=lambda s: isinstance(s, NDArray))
+                self._opt_state.append(st)
+
+    def _build_step(self, n_batch):
+        params = self._params
+        train_idx = self._train_idx
+        train_set = set(train_idx)
+        optimizer = self._optimizer
+        block = self.block
+        loss = self.loss
+        forward_loss = self.forward_loss
+        from ..gluon.block import _hybrid_trace_scope
+
+        def pure_loss(train_vals, frozen_vals, key, *batch):
+            """loss + aux (mutated frozen params, e.g. BN running stats)."""
+            saved = [p._data for p in params]
+            it_t, it_f = iter(train_vals), iter(frozen_vals)
+            for i, p in enumerate(params):
+                p._data = NDArray(next(it_t) if i in train_set else next(it_f))
+            try:
+                with _hybrid_trace_scope(), _random.key_provider(key), \
+                        autograd._ModeScope(recording=False, training=True):
+                    batch_nd = [NDArray(b) for b in batch]
+                    if forward_loss is not None:
+                        L = forward_loss(block, *batch_nd)
+                    else:
+                        out = block(batch_nd[0])
+                        L = loss(out, *batch_nd[1:])
+                    if L.ndim > 0:
+                        L = L.mean()
+                    aux = []
+                    for i, p in enumerate(params):
+                        if i not in train_set:
+                            aux.append(p._data._data)
+            finally:
+                for p, s in zip(params, saved):
+                    p._data = s
+            return L._data, tuple(aux)
+
+        def step(train_vals, frozen_vals, opt_leaves, opt_tree, t, lr, key,
+                 *batch):
+            (loss_val, aux), grads = jax.value_and_grad(
+                pure_loss, argnums=0, has_aux=True)(
+                    train_vals, frozen_vals, key, *batch)
+            opt_state = jtu.tree_unflatten(opt_tree, opt_leaves)
+            new_train = []
+            new_states = []
+            # the step counter and lr arrive as traced scalars so schedules
+            # and Adam/LAMB bias correction advance without recompiling
+            optimizer._traced_t, optimizer._traced_lr = t, lr
+            try:
+                for slot, (pi, w, g) in enumerate(
+                        zip(train_idx, train_vals, grads)):
+                    w_nd = NDArray(w)
+                    g_nd = NDArray(g)
+                    st = jtu.tree_map(NDArray, opt_state[slot])
+                    optimizer.update(pi, w_nd, g_nd, st)
+                    new_train.append(w_nd._data)
+                    new_states.append(jtu.tree_map(
+                        lambda s: s._data if isinstance(s, NDArray) else s, st,
+                        is_leaf=lambda s: isinstance(s, NDArray)))
+            finally:
+                optimizer._traced_t = optimizer._traced_lr = None
+            return tuple(new_train), tuple(aux), \
+                tuple(jtu.tree_leaves(tuple(new_states))), loss_val
+
+        mesh = self.mesh
+        repl = NamedSharding(mesh, PartitionSpec())
+        batch_sh = NamedSharding(mesh, PartitionSpec(("dp", "fsdp")))
+        train_sh = tuple(
+            _param_sharding(params[i], mesh, self.sharding_mode)
+            for i in train_idx)
+        frozen_sh = tuple(
+            _param_sharding(params[i], mesh, self.sharding_mode)
+            for i in range(len(params)) if i not in train_set)
+        # optimizer-state leaves share their parameter's sharding
+        state_sh = []
+        for slot, i in enumerate(train_idx):
+            n_leaves = len(jtu.tree_leaves(
+                jtu.tree_map(lambda s: 0, self._opt_state[slot],
+                             is_leaf=lambda s: isinstance(s, NDArray))))
+            state_sh.extend(
+                [_param_sharding(params[i], mesh, self.sharding_mode)]
+                * n_leaves)
+
+        donate = (0, 2) if self.donate else ()
+        return jax.jit(
+            step,
+            static_argnums=(3,),
+            in_shardings=(train_sh, frozen_sh, tuple(state_sh), repl, repl,
+                          repl) + (batch_sh,) * n_batch,
+            donate_argnums=donate)
+
+    # ------------------------------------------------------------------ #
+    def step(self, *batch):
+        """Run one fused train step; returns the (device-resident) loss."""
+        batch_nds = [b if isinstance(b, NDArray) else NDArray(jnp.asarray(b))
+                     for b in batch]
+        if self._opt_state is None:
+            self._materialize(batch_nds)
+        if self._step_fn is None:
+            self._step_fn = self._build_step(len(batch_nds))
+
+        train_vals = tuple(self._params[i]._data._data
+                           for i in self._train_idx)
+        frozen_vals = tuple(p._data._data for i, p in enumerate(self._params)
+                            if i not in set(self._train_idx))
+        state_nd = tuple(self._opt_state)
+        opt_leaves, opt_tree = jtu.tree_flatten(
+            jtu.tree_map(lambda s: s._data if isinstance(s, NDArray) else s,
+                         state_nd,
+                         is_leaf=lambda s: isinstance(s, NDArray)))
+        key = _random.new_key()
+        self._optimizer.num_update = self.step_count  # drive lr schedules
+        t = jnp.asarray(self.step_count + 1, jnp.float32)
+        lr = jnp.asarray(float(self._optimizer.learning_rate), jnp.float32)
+
+        new_train, aux, new_state_leaves, loss_val = self._step_fn(
+            train_vals, frozen_vals, tuple(opt_leaves), opt_tree, t, lr, key,
+            *[b._data for b in batch_nds])
+
+        train_set = set(self._train_idx)
+        it_t = iter(new_train)
+        it_a = iter(aux)
+        for i, p in enumerate(self._params):
+            p._data._data = next(it_t) if i in train_set else next(it_a)
+        new_states = jtu.tree_unflatten(opt_tree, list(new_state_leaves))
+        self._opt_state = [
+            jtu.tree_map(NDArray, st) for st in new_states]
+        self.step_count += 1
+        return NDArray(loss_val)
