@@ -1,0 +1,95 @@
+"""Benchmark: BERT pretraining throughput on the available device.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The metric is tokens/sec/chip on a fused BERT pretraining step (BASELINE.md
+config #3); vs_baseline is achieved MFU divided by the 0.45 north-star MFU.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def _peak_flops_per_chip() -> float:
+    """bf16 peak FLOP/s for the local chip generation (used for MFU)."""
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
+    table = {
+        "v4": 275e12,
+        "v5e": 197e12,
+        "v5p": 459e12,
+        "v6e": 918e12,
+    }
+    for k, v in table.items():
+        if gen.startswith(k):
+            return v
+    return 197e12  # default: v5e
+
+
+def main():
+    import jax
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, parallel
+    from incubator_mxnet_tpu.models import bert as bert_mod
+
+    on_tpu = any(d.platform != "cpu" for d in jax.devices())
+    if on_tpu:
+        B, T, M = int(os.environ.get("MXTPU_BENCH_BATCH", "16")), 512, 76
+        dtype = "bfloat16"
+        steps, warmup = 20, 3
+    else:  # CPU smoke mode so the bench is runnable anywhere
+        B, T, M = 4, 128, 20
+        dtype = "float32"
+        steps, warmup = 3, 1
+
+    mx.random.seed(0)
+    model = bert_mod.bert_base(dtype=dtype, max_length=T)
+    model.initialize()
+    pre = bert_mod.BERTForPretraining(model)
+    pre.initialize()
+
+    rng = np.random.RandomState(0)
+    batch = (
+        nd.array(rng.randint(0, 30522, (B, T)), dtype="int32"),
+        nd.array(rng.randint(0, 2, (B, T)), dtype="int32"),
+        nd.array(np.full((B,), T), dtype="int32"),
+        nd.array(rng.randint(0, T, (B, M)), dtype="int32"),
+        nd.array(rng.randint(0, 30522, (B, M)), dtype="int32"),
+        nd.ones((B, M)),
+        nd.array(rng.randint(0, 2, (B,)), dtype="int32"),
+    )
+
+    trainer = parallel.SPMDTrainer(
+        pre, forward_loss=bert_mod.pretraining_loss, optimizer="lamb",
+        optimizer_params={"learning_rate": 1e-4}, sharding="replicated")
+
+    for _ in range(warmup):
+        loss = trainer.step(*batch)
+    loss.wait_to_read()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = trainer.step(*batch)
+    loss.wait_to_read()
+    dt = time.perf_counter() - t0
+
+    n_chips = len(jax.devices())
+    tokens_per_sec_chip = B * T * steps / dt / n_chips
+
+    # 6 * params * tokens for fwd+bwd (transformer rule of thumb)
+    n_params = sum(
+        int(np.prod(p.shape)) for p in pre.collect_params().values())
+    flops_per_step = 6.0 * n_params * B * T
+    mfu = (flops_per_step * steps / dt) / (_peak_flops_per_chip() * n_chips)
+
+    print(json.dumps({
+        "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec_chip, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.45, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -213,3 +213,21 @@ def create(init) -> Initializer:
         cls = _REGISTRY.get(init)
         return cls()
     raise MXNetError(f"cannot create initializer from {init!r}")
+
+
+@register("truncnorm")
+class TruncNorm(Initializer):
+    """Truncated normal within 2 stdev (reference: initializer.py used by
+    BERT; GluonNLP TruncNorm)."""
+
+    def __init__(self, mean=0.0, stdev=0.01, **kwargs):
+        super().__init__(**kwargs)
+        self.mean = mean
+        self.stdev = stdev
+
+    def _init_weight(self, name, arr):
+        import jax
+        from . import random as _random
+        key = _random.new_key()
+        arr._data = (self.mean + self.stdev * jax.random.truncated_normal(
+            key, -2.0, 2.0, arr.shape)).astype(arr.dtype)

@@ -1,0 +1,236 @@
+"""BERT (the flagship model; BASELINE.md config #3 — GluonNLP
+`scripts/bert`, model definition upstream `gluonnlp/model/bert.py`;
+file-level citation, SURVEY.md caveat).
+
+TPU-first design decisions:
+  - attention runs through the ``scaled_dot_product_attention`` registry op
+    (ops/attention.py): one fused XLA computation per layer instead of the
+    reference's interleaved_matmul kernel pair; ``flash=True`` selects the
+    blockwise kernel for long sequences;
+  - tensor-parallel sharding hints are attached to parameters
+    (PartitionSpec over the ``tp`` mesh axis: QKV/FFN-in column-sharded,
+    output projections row-sharded) so SPMDTrainer/pjit shard the model
+    with zero code changes — the idiomatic upgrade of the reference's
+    manual group2ctx model parallelism (SURVEY.md §2.3);
+  - compute dtype is a constructor knob (bf16 for the MFU target) while
+    parameters/layernorm stay fp32 (AMP contract, SURVEY.md §2.2 AMP row).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..base import MXNetError
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from .. import initializer as init
+
+__all__ = ["BERTModel", "BERTForPretraining", "bert_base", "bert_large",
+           "bert_tiny"]
+
+
+class BERTSelfAttention(HybridBlock):
+    """Multi-head self-attention with fused QKV projection."""
+
+    def __init__(self, units, num_heads, dropout=0.1, dtype="float32",
+                 flash=False, **kwargs):
+        super().__init__(**kwargs)
+        if units % num_heads:
+            raise MXNetError(f"units {units} not divisible by heads {num_heads}")
+        self._units = units
+        self._heads = num_heads
+        self._flash = flash
+        with self.name_scope():
+            self.qkv = nn.Dense(3 * units, in_units=units, flatten=False,
+                                dtype=dtype, weight_initializer=init.TruncNorm(stdev=0.02))
+            self.proj = nn.Dense(units, in_units=units, flatten=False,
+                                 dtype=dtype, weight_initializer=init.TruncNorm(stdev=0.02))
+            self.dropout = nn.Dropout(dropout)
+        # tp sharding: qkv column-parallel, out proj row-parallel
+        self.qkv.weight._sharding = P("tp", None)
+        self.qkv.bias._sharding = P("tp")
+        self.proj.weight._sharding = P(None, "tp")
+
+    def hybrid_forward(self, F, x, mask=None):
+        B, T = x.shape[0], x.shape[1]
+        H, D = self._heads, self._units // self._heads
+        qkv = self.qkv(x).reshape((B, T, 3, H, D))
+        q = qkv._op("slice_axis", axis=2, begin=0, end=1).reshape((B, T, H, D))
+        k = qkv._op("slice_axis", axis=2, begin=1, end=2).reshape((B, T, H, D))
+        v = qkv._op("slice_axis", axis=2, begin=2, end=3).reshape((B, T, H, D))
+        out = F.scaled_dot_product_attention(q, k, v, mask=mask,
+                                             flash=self._flash)
+        out = out.reshape((B, T, self._units))
+        return self.dropout(self.proj(out))
+
+
+class BERTEncoderLayer(HybridBlock):
+    def __init__(self, units, hidden_size, num_heads, dropout=0.1,
+                 layer_norm_eps=1e-12, dtype="float32", flash=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.attention = BERTSelfAttention(units, num_heads, dropout,
+                                               dtype=dtype, flash=flash)
+            self.ln1 = nn.LayerNorm(epsilon=layer_norm_eps, in_channels=units)
+            self.ffn_in = nn.Dense(hidden_size, in_units=units, flatten=False,
+                                   dtype=dtype,
+                                   weight_initializer=init.TruncNorm(stdev=0.02))
+            self.ffn_out = nn.Dense(units, in_units=hidden_size,
+                                    flatten=False, dtype=dtype,
+                                    weight_initializer=init.TruncNorm(stdev=0.02))
+            self.ln2 = nn.LayerNorm(epsilon=layer_norm_eps, in_channels=units)
+            self.dropout = nn.Dropout(dropout)
+        self.ffn_in.weight._sharding = P("tp", None)
+        self.ffn_in.bias._sharding = P("tp")
+        self.ffn_out.weight._sharding = P(None, "tp")
+
+    def hybrid_forward(self, F, x, mask=None):
+        x = self.ln1(x + self.attention(x, mask))
+        h = self.ffn_in(x)
+        h = F.gelu(h)
+        h = self.dropout(self.ffn_out(h))
+        return self.ln2(x + h)
+
+
+class BERTModel(HybridBlock):
+    """BERT encoder: embeddings + N transformer layers + pooler.
+
+    forward(input_ids, token_types, valid_length) ->
+        (sequence_output (B,T,units), pooled_output (B,units))
+    """
+
+    def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
+                 num_layers=12, num_heads=12, max_length=512,
+                 type_vocab_size=2, dropout=0.1, layer_norm_eps=1e-12,
+                 dtype="float32", flash=False, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._dtype = dtype
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.hidden_size = hidden_size
+        self.vocab_size = vocab_size
+        with self.name_scope():
+            self.word_embed = nn.Embedding(
+                vocab_size, units,
+                weight_initializer=init.TruncNorm(stdev=0.02))
+            self.token_type_embed = nn.Embedding(
+                type_vocab_size, units,
+                weight_initializer=init.TruncNorm(stdev=0.02))
+            self.position_embed = nn.Embedding(
+                max_length, units,
+                weight_initializer=init.TruncNorm(stdev=0.02))
+            self.embed_ln = nn.LayerNorm(epsilon=layer_norm_eps,
+                                         in_channels=units)
+            self.embed_dropout = nn.Dropout(dropout)
+            self.layers = []
+            for i in range(num_layers):
+                layer = BERTEncoderLayer(units, hidden_size, num_heads,
+                                         dropout, layer_norm_eps,
+                                         dtype=dtype, flash=flash)
+                self.register_child(layer, f"layer{i}")
+                setattr(self, f"layer{i}", layer)
+            self.pooler = nn.Dense(units, in_units=units, flatten=False,
+                                   activation="tanh",
+                                   weight_initializer=init.TruncNorm(stdev=0.02))
+        # embeddings shard over tp on the vocab/feature dim
+        self.word_embed.weight._sharding = P("tp", None)
+
+    def hybrid_forward(self, F, input_ids, token_types=None,
+                       valid_length=None):
+        B, T = input_ids.shape
+        pos = F.arange(0, T, dtype="int32").reshape((1, T)).broadcast_to((B, T))
+        emb = self.word_embed(input_ids) + self.position_embed(pos)
+        if token_types is not None:
+            emb = emb + self.token_type_embed(token_types)
+        x = self.embed_dropout(self.embed_ln(emb))
+        if self._dtype != "float32":
+            x = x.astype(self._dtype)
+        mask = None
+        if valid_length is not None:
+            ar = F.arange(0, T, dtype="float32").reshape((1, T))
+            mask = (ar < valid_length.astype("float32").reshape((-1, 1)))
+        for i in range(self.num_layers):
+            x = getattr(self, f"layer{i}")(x, mask)
+        x = x.astype("float32")
+        cls = x._op("slice_axis", axis=1, begin=0, end=1).reshape(
+            (B, self._units))
+        pooled = self.pooler(cls)
+        return x, pooled
+
+
+class BERTForPretraining(HybridBlock):
+    """MLM + NSP pretraining heads (GluonNLP BERTForPretrain parity).
+
+    forward(input_ids, token_types, valid_length, masked_positions) ->
+        (mlm_scores (B,M,vocab), nsp_scores (B,2))
+    """
+
+    def __init__(self, bert: BERTModel, layer_norm_eps=1e-12, **kwargs):
+        super().__init__(**kwargs)
+        units = bert._units
+        with self.name_scope():
+            self.bert = bert
+            self.mlm_transform = nn.Dense(
+                units, in_units=units, flatten=False,
+                weight_initializer=init.TruncNorm(stdev=0.02))
+            self.mlm_ln = nn.LayerNorm(epsilon=layer_norm_eps,
+                                       in_channels=units)
+            # decoder shares the word embedding matrix (tied weights)
+            from ..gluon.parameter import Parameter
+            self.mlm_bias = Parameter("mlm_bias", shape=(bert.vocab_size,),
+                                      init=init.Zero())
+            self.nsp = nn.Dense(2, in_units=units,
+                                weight_initializer=init.TruncNorm(stdev=0.02))
+
+    def hybrid_forward(self, F, input_ids, token_types, valid_length,
+                       masked_positions, mlm_bias=None):
+        seq, pooled = self.bert(input_ids, token_types, valid_length)
+        # gather masked positions: (B, M, units)
+        gathered = F.batch_take(seq, masked_positions)
+        h = self.mlm_transform(gathered)
+        h = F.gelu(h)
+        h = self.mlm_ln(h)
+        embed_w = self.bert.word_embed.weight.data()  # (vocab, units)
+        scores = F.dot(h, embed_w, transpose_b=True) + mlm_bias
+        return scores, self.nsp(pooled)
+
+
+def pretraining_loss(model: BERTForPretraining, input_ids, token_types,
+                     valid_length, masked_positions, masked_labels,
+                     masked_weights, nsp_labels):
+    """Scalar pretraining loss (MLM + NSP), shaped for SPMDTrainer's
+    ``forward_loss`` hook."""
+    from .. import ndarray as nd
+
+    mlm_scores, nsp_scores = model(input_ids, token_types, valid_length,
+                                   masked_positions)
+    logp = mlm_scores.log_softmax(axis=-1)
+    mlm_ll = logp.pick(masked_labels, axis=-1)            # (B, M)
+    denom = masked_weights.sum() + 1e-6
+    mlm_loss = -(mlm_ll * masked_weights).sum() / denom
+    nsp_logp = nsp_scores.log_softmax(axis=-1)
+    nsp_loss = -nsp_logp.pick(nsp_labels, axis=-1).mean()
+    return mlm_loss + nsp_loss
+
+
+def bert_tiny(vocab_size=1024, max_length=128, **kwargs) -> BERTModel:
+    """Small config for tests/dry-runs."""
+    return BERTModel(vocab_size=vocab_size, units=128, hidden_size=512,
+                     num_layers=2, num_heads=2, max_length=max_length,
+                     **kwargs)
+
+
+def bert_base(**kwargs) -> BERTModel:
+    return BERTModel(vocab_size=30522, units=768, hidden_size=3072,
+                     num_layers=12, num_heads=12, **kwargs)
+
+
+def bert_large(**kwargs) -> BERTModel:
+    return BERTModel(vocab_size=30522, units=1024, hidden_size=4096,
+                     num_layers=24, num_heads=16, **kwargs)

@@ -1,0 +1,120 @@
+"""Attention primitives.
+
+Parity target: the reference's fused BERT attention kernels
+(`src/operator/contrib/transformer.cc` — ``interleaved_matmul_selfatt_qk`` /
+``_valatt`` and the masked softmax they feed; file-level citation, SURVEY.md
+caveat §5.7). Those are hand-written CUDA GEMM+softmax fusions; here ONE
+pure function expresses the whole attention block and XLA fuses it onto the
+MXU. ``flash=True`` switches to a blockwise streaming-softmax evaluation
+(O(T·block) score memory) — the slot a Pallas kernel plugs into; the same
+recurrence is what ring attention (parallel/ring_attention.py) runs per
+sequence shard.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_NEG_INF = -1e30
+
+
+def _sdpa_dense(q, k, v, mask, scale):
+    """(B,T,H,D) attention, materializing the (B,H,Tq,Tk) score matrix."""
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if mask is not None:
+        scores = jnp.where(mask, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    probs = probs.astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _sdpa_blockwise(q, k, v, key_mask, causal, scale, block_k: int = 512):
+    """Streaming-softmax over key blocks (the flash-attention recurrence).
+
+    q: (B,Tq,H,D); k/v: (B,Tk,H,D); key_mask: (B,Tk) bool or None.
+    Never materializes more than (B,H,Tq,block_k) scores.
+    """
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    block_k = min(block_k, Tk)
+    pk = (-Tk) % block_k
+    if key_mask is None:
+        key_mask = jnp.ones((B, Tk), bool)
+    if pk:
+        k = jnp.pad(k, ((0, 0), (0, pk), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pk), (0, 0), (0, 0)))
+        key_mask = jnp.pad(key_mask, ((0, 0), (0, pk)))
+    nk = (Tk + pk) // block_k
+
+    qf = (q * scale).astype(jnp.float32)
+    k_blocks = jnp.moveaxis(k.reshape(B, nk, block_k, H, D), 1, 0)
+    v_blocks = jnp.moveaxis(v.reshape(B, nk, block_k, H, D), 1, 0)
+    m_blocks = jnp.moveaxis(key_mask.reshape(B, nk, block_k), 1, 0)
+
+    pos_q = jnp.arange(Tq)
+
+    acc0 = jnp.zeros((B, Tq, H, D), jnp.float32)
+    max0 = jnp.full((B, Tq, H), _NEG_INF, jnp.float32)
+    sum0 = jnp.zeros((B, Tq, H), jnp.float32)
+
+    def body(carry, inp):
+        acc, row_max, row_sum = carry
+        blk_idx, k_blk, v_blk, m_blk = inp
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32))
+        allow = m_blk[:, None, None, :]                       # (B,1,1,block)
+        if causal:
+            pos_k = blk_idx * block_k + jnp.arange(block_k)
+            allow = jnp.logical_and(
+                allow, (pos_k[None, :] <= pos_q[:, None])[None, None])
+        s = jnp.where(allow, s, _NEG_INF)
+        blk_max = jnp.moveaxis(s.max(axis=-1), 1, -1)         # (B,Tq,H)
+        new_max = jnp.maximum(row_max, blk_max)
+        corr = jnp.exp(row_max - new_max)
+        p = jnp.exp(s - jnp.moveaxis(new_max, -1, 1)[..., None])
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32))
+        row_sum = row_sum * corr + jnp.moveaxis(p.sum(-1), 1, -1)
+        return (acc, new_max, row_sum), None
+
+    (acc, _, row_sum), _ = lax.scan(
+        body, (acc0, max0, sum0),
+        (jnp.arange(nk), k_blocks, v_blocks, m_blocks))
+    out = acc / jnp.maximum(row_sum[..., None], 1e-30)
+    return out.astype(q.dtype)
+
+
+@register("scaled_dot_product_attention", aliases=("sdpa",))
+def scaled_dot_product_attention(q, k, v, mask=None, scale=None,
+                                 causal=False, flash=False):
+    """Multi-head attention core. q/k/v: (B, T, H, D). ``mask`` is either a
+    key-padding mask (B, Tk) or broadcastable to (B, H, Tq, Tk), True =
+    attend. Returns (B, Tq, H, D). ``flash=True`` uses the blockwise
+    streaming evaluation (key-padding/causal masks only)."""
+    D = q.shape[-1]
+    if scale is None:
+        scale = D ** -0.5
+    if flash and (mask is None or mask.ndim == 2):
+        return _sdpa_blockwise(q, k, v, mask, causal, scale)
+    Tq, Tk = q.shape[1], k.shape[1]
+    m = mask
+    if m is not None and m.ndim == 2:
+        m = m[:, None, None, :]                               # key padding
+    if causal:
+        cm = jnp.tril(jnp.ones((Tq, Tk), bool))[None, None]
+        m = cm if m is None else jnp.logical_and(m, cm)
+    return _sdpa_dense(q, k, v, m, scale)
+
+
+@register("masked_softmax")
+def masked_softmax(scores, mask=None, axis=-1):
+    """Softmax with optional boolean mask (True = keep). Parity surface for
+    the reference's masked softmax in the transformer contrib ops."""
+    if mask is not None:
+        scores = jnp.where(mask, scores, _NEG_INF)
+    return jax.nn.softmax(scores, axis=axis)

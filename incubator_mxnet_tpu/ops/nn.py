@@ -520,3 +520,10 @@ def ctc_loss(data, label, data_lengths=None, label_lengths=None,
     a_prev = jnp.where(lab_len > 0, a_prev, neg_inf)
     ll = jnp.logaddexp(a_last, a_prev)
     return -ll
+
+
+@register("gelu")
+def gelu(data, approximate=False):
+    """Gaussian error linear unit (reference: leaky_relu.cc act_type='gelu';
+    surfaced as a first-class op for transformer FFNs)."""
+    return jax.nn.gelu(data, approximate=approximate)

@@ -738,3 +738,17 @@ def smooth_l1(data, scalar=1.0):
     return jnp.where(jnp.abs(data) < 1.0 / s2,
                      0.5 * s2 * jnp.square(data),
                      jnp.abs(data) - 0.5 / s2)
+
+
+@register("batch_take")
+def batch_take(data, indices):
+    """Per-batch row gather: out[b, m] = data[b, indices[b, m]]
+    (reference capability: gather_nd over (batch, position) pairs, used by
+    the BERT MLM head to pull masked positions)."""
+    idx = indices.astype(jnp.int32)
+    if data.ndim == idx.ndim:
+        return jnp.take_along_axis(data, idx, axis=1)
+    extra = data.ndim - idx.ndim
+    idxe = idx.reshape(idx.shape + (1,) * extra)
+    idxe = jnp.broadcast_to(idxe, idx.shape + data.shape[idx.ndim:])
+    return jnp.take_along_axis(data, idxe, axis=1)

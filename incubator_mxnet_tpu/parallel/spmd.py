@@ -258,6 +258,12 @@ class SPMDTrainer:
         """Run one fused train step; returns the (device-resident) loss."""
         batch_nds = [b if isinstance(b, NDArray) else NDArray(jnp.asarray(b))
                      for b in batch]
+        dp = self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
+        for b in batch_nds:
+            if b.ndim and b.shape[0] % dp != 0:
+                raise MXNetError(
+                    f"batch dim {b.shape[0]} not divisible by the mesh's "
+                    f"dp×fsdp size {dp}; pad the batch or shrink the mesh")
         if self._opt_state is None:
             self._materialize(batch_nds)
         if self._step_fn is None:

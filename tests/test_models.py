@@ -1,0 +1,96 @@
+"""Model zoo tests (SURVEY.md §4: tiny fixtures, numpy oracles)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd, gluon, parallel
+from incubator_mxnet_tpu.models import LeNet, bert_tiny, BERTForPretraining
+from incubator_mxnet_tpu.models import bert as bert_mod
+
+
+def test_lenet_forward_and_train_step():
+    mx.random.seed(0)
+    net = LeNet()
+    net.initialize()
+    x = nd.array(np.random.RandomState(0).randn(4, 1, 28, 28),
+                 dtype="float32")
+    out = net(x)
+    assert out.shape == (4, 10)
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, kvstore=None)
+    y = nd.array([1, 2, 3, 4], dtype="int32")
+    with autograd.record():
+        L = loss_fn(net(x), y).mean()
+    L.backward()
+    tr.step(1)
+    assert np.isfinite(float(L.asnumpy()))
+
+
+def test_bert_shapes_and_masking():
+    mx.random.seed(0)
+    model = bert_tiny()
+    model.initialize()
+    rng = np.random.RandomState(0)
+    B, T = 2, 16
+    ids = nd.array(rng.randint(0, 1024, (B, T)), dtype="int32")
+    vl = nd.array([T, 5], dtype="int32")
+    seq, pooled = model(ids, None, vl)
+    assert seq.shape == (B, T, 128) and pooled.shape == (B, 128)
+
+    # padding tokens beyond valid_length must not affect earlier outputs
+    ids2_np = np.array(ids.asnumpy())
+    ids2_np[1, 5:] = 0  # change padding content
+    seq2, _ = model(nd.array(ids2_np, dtype="int32"), None, vl)
+    np.testing.assert_allclose(seq.asnumpy()[1, :5],
+                               seq2.asnumpy()[1, :5], rtol=1e-4, atol=1e-4)
+
+
+def test_bert_pretraining_loss_decreases():
+    mx.random.seed(1)
+    model = bert_tiny(vocab_size=256, max_length=32)
+    model.initialize()
+    pre = BERTForPretraining(model)
+    pre.initialize()
+    rng = np.random.RandomState(0)
+    B, T, M = 8, 16, 3
+    batch = (
+        nd.array(rng.randint(0, 256, (B, T)), dtype="int32"),
+        nd.array(rng.randint(0, 2, (B, T)), dtype="int32"),
+        nd.array(np.full((B,), T), dtype="int32"),
+        nd.array(rng.randint(0, T, (B, M)), dtype="int32"),
+        nd.array(rng.randint(0, 256, (B, M)), dtype="int32"),
+        nd.ones((B, M)),
+        nd.array(rng.randint(0, 2, (B,)), dtype="int32"),
+    )
+    tr = parallel.SPMDTrainer(
+        pre, forward_loss=bert_mod.pretraining_loss, optimizer="adam",
+        optimizer_params={"learning_rate": 1e-3})
+    l0 = float(tr.step(*batch).asnumpy())
+    for _ in range(12):
+        l_last = float(tr.step(*batch).asnumpy())
+    assert l_last < l0, (l0, l_last)
+
+
+def test_bert_flash_matches_dense():
+    """flash (blockwise) attention path must match the dense path."""
+    mx.random.seed(3)
+    dense_model = bert_tiny()
+    dense_model.initialize()
+    flash_model = bert_tiny(flash=True)
+    flash_model.initialize()
+    # copy params dense -> flash
+    src = dense_model._collect_params_with_prefix()
+    dst = flash_model._collect_params_with_prefix()
+    assert set(src) == set(dst)
+    for k, p in src.items():
+        dst[k].set_data(p.data())
+    rng = np.random.RandomState(0)
+    ids = nd.array(rng.randint(0, 1024, (2, 24)), dtype="int32")
+    vl = nd.array([24, 17], dtype="int32")
+    s1, p1 = dense_model(ids, None, vl)
+    s2, p2 = flash_model(ids, None, vl)
+    np.testing.assert_allclose(s1.asnumpy(), s2.asnumpy(),
+                               rtol=2e-4, atol=2e-4)
