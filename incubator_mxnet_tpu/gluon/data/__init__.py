@@ -1,0 +1,14 @@
+"""Datasets & DataLoader (re-design of `python/mxnet/gluon/data/` —
+SURVEY.md §2.2 Gluon row, §3.5 pipeline call stack)."""
+
+from . import dataset
+from .dataset import (Dataset, ArrayDataset, SimpleDataset, RecordFileDataset)
+from . import sampler
+from .sampler import (Sampler, SequentialSampler, RandomSampler, BatchSampler)
+from . import dataloader
+from .dataloader import DataLoader
+from . import vision
+
+__all__ = ["Dataset", "ArrayDataset", "SimpleDataset", "RecordFileDataset",
+           "Sampler", "SequentialSampler", "RandomSampler", "BatchSampler",
+           "DataLoader", "vision"]

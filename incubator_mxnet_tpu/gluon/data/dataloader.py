@@ -1,0 +1,174 @@
+"""DataLoader: parallel sample loading + async device transfer.
+
+Re-design of `python/mxnet/gluon/data/dataloader.py` (file-level citation —
+SURVEY.md caveat, pipeline stack §3.5). The reference forks worker
+processes that build batches in shared-memory NDArrays; here workers
+(processes or threads) produce host numpy batches and a prefetch thread
+overlaps ``jax.device_put`` with consumption — the double-buffering the
+reference got from PrefetcherIter. XLA's async dispatch hides the
+host→device copy behind the previous step's compute.
+"""
+
+from __future__ import annotations
+
+import multiprocessing
+import queue
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from ...base import MXNetError
+from ...ndarray import NDArray
+from ...ndarray.ndarray import _as_jax
+from .dataset import Dataset
+from .sampler import BatchSampler, RandomSampler, SequentialSampler, Sampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(samples):
+    """Stack samples into a batch (parity: gluon default_batchify_fn)."""
+    elem = samples[0]
+    if isinstance(elem, NDArray):
+        import jax.numpy as jnp
+        return NDArray(jnp.stack([s._data for s in samples]))
+    if isinstance(elem, (tuple, list)):
+        return tuple(default_batchify_fn(list(s)) for s in zip(*samples))
+    arr = np.asarray(samples)
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    return arr
+
+
+def _as_device_batch(batch, device=None):
+    """numpy → NDArray (device transfer point)."""
+    if isinstance(batch, (tuple, list)):
+        return tuple(_as_device_batch(b, device) for b in batch)
+    if isinstance(batch, NDArray):
+        return batch
+    return NDArray(_as_jax(batch))
+
+
+_worker_dataset = None
+
+
+def _worker_init(dataset):
+    global _worker_dataset
+    _worker_dataset = dataset
+
+
+def _worker_fn(indices, batchify_fn):
+    return batchify_fn([_worker_dataset[i] for i in indices])
+
+
+class DataLoader:
+    """Iterate a Dataset in batches.
+
+    Parameters mirror the reference: batch_size, shuffle, sampler,
+    last_batch, batch_sampler, batchify_fn, num_workers, prefetch.
+    """
+
+    def __init__(self, dataset: Dataset, batch_size=None, shuffle=False,
+                 sampler: Optional[Sampler] = None, last_batch=None,
+                 batch_sampler: Optional[BatchSampler] = None,
+                 batchify_fn: Optional[Callable] = None, num_workers=0,
+                 pin_memory=False, prefetch: Optional[int] = None,
+                 thread_pool: bool = False, timeout=120):
+        self._dataset = dataset
+        self._timeout = timeout
+        if batch_sampler is None:
+            if batch_size is None:
+                raise MXNetError(
+                    "batch_size is required when batch_sampler is not given")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle \
+                    else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise MXNetError("shuffle must be False with custom sampler")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif (batch_size is not None or shuffle or sampler is not None
+              or last_batch is not None):
+            raise MXNetError(
+                "batch_size/shuffle/sampler/last_batch incompatible with "
+                "batch_sampler")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers or 2)
+        self._thread_pool = thread_pool
+        self._pool = None
+        if self._num_workers > 0:
+            if thread_pool:
+                from multiprocessing.pool import ThreadPool
+                self._pool = ThreadPool(self._num_workers,
+                                        initializer=_worker_init,
+                                        initargs=(dataset,))
+            else:
+                ctx = multiprocessing.get_context("fork")
+                self._pool = ctx.Pool(self._num_workers,
+                                      initializer=_worker_init,
+                                      initargs=(dataset,))
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def __iter__(self):
+        if self._pool is not None:
+            yield from self._iter_workers()
+        else:
+            yield from self._iter_prefetch()
+
+    def _iter_prefetch(self):
+        """Single-process path with a device-transfer prefetch thread."""
+        q: "queue.Queue" = queue.Queue(maxsize=max(self._prefetch, 1))
+        stop = object()
+
+        def producer():
+            try:
+                for indices in self._batch_sampler:
+                    batch = self._batchify_fn(
+                        [self._dataset[i] for i in indices])
+                    q.put(_as_device_batch(batch))
+            except Exception as e:  # surface in consumer
+                q.put(e)
+            q.put(stop)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get(timeout=self._timeout)
+            if item is stop:
+                break
+            if isinstance(item, Exception):
+                raise item
+            yield item
+
+    def _iter_workers(self):
+        """Worker-pool path with a rolling async window (the reference's
+        prefetching worker pool)."""
+        results = []
+        it = iter(self._batch_sampler)
+
+        def submit():
+            try:
+                indices = next(it)
+            except StopIteration:
+                return False
+            results.append(self._pool.apply_async(
+                _worker_fn, (indices, self._batchify_fn)))
+            return True
+
+        for _ in range(self._prefetch):
+            if not submit():
+                break
+        while results:
+            batch = results.pop(0).get(self._timeout)
+            submit()
+            yield _as_device_batch(batch)
+
+    def __del__(self):
+        if self._pool is not None:
+            self._pool.terminate()

@@ -1,0 +1,9 @@
+"""Vision datasets & transforms (re-design of
+`python/mxnet/gluon/data/vision/` — SURVEY.md §2.2)."""
+
+from . import datasets
+from .datasets import MNIST, FashionMNIST, CIFAR10, ImageFolderDataset
+from . import transforms
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "ImageFolderDataset",
+           "transforms"]

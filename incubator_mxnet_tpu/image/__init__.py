@@ -1,0 +1,130 @@
+"""Image utilities (re-design of `python/mxnet/image/image.py`; file-level
+citation — SURVEY.md caveat). Decoding uses cv2/PIL when present; raw .npy
+is the hermetic fallback (zero-egress environments)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from ..ndarray.ndarray import _as_jax
+
+__all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
+           "center_crop", "random_crop", "color_normalize", "ImageIter"]
+
+
+def imdecode(buf: bytes, flag=1, to_rgb=True) -> NDArray:
+    """Decode an encoded image buffer (parity: mx.image.imdecode)."""
+    arr = None
+    if bytes(buf[:4]) == b"NPY0":
+        import io as _io
+        arr = np.load(_io.BytesIO(bytes(buf[4:])))
+    else:
+        try:
+            import cv2
+            raw = np.frombuffer(buf, np.uint8)
+            arr = cv2.imdecode(raw, flag)
+            if to_rgb and arr is not None and arr.ndim == 3:
+                arr = cv2.cvtColor(arr, cv2.COLOR_BGR2RGB)
+        except ImportError:
+            try:
+                from PIL import Image
+                import io as _io
+                arr = np.asarray(Image.open(_io.BytesIO(bytes(buf))))
+            except ImportError:
+                raise MXNetError("no image decoder available (cv2/PIL)")
+    if arr is None:
+        raise MXNetError("image decode failed")
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return NDArray(_as_jax(arr))
+
+
+def imread(filename: str, flag=1, to_rgb=True) -> NDArray:
+    if filename.endswith(".npy"):
+        arr = np.load(filename)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return NDArray(_as_jax(arr))
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag, to_rgb)
+
+
+def _np(img):
+    return img.asnumpy() if isinstance(img, NDArray) else np.asarray(img)
+
+
+def imresize(src, w, h, interp=1) -> NDArray:
+    x = _np(src)
+    rows = (np.arange(h) * x.shape[0] / h).astype(np.int32)
+    cols = (np.arange(w) * x.shape[1] / w).astype(np.int32)
+    return NDArray(_as_jax(x[rows][:, cols]))
+
+
+def resize_short(src, size, interp=1) -> NDArray:
+    x = _np(src)
+    H, W = x.shape[:2]
+    if H < W:
+        h, w = size, int(W * size / H)
+    else:
+        h, w = int(H * size / W), size
+    return imresize(x, w, h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=1) -> NDArray:
+    x = _np(src)[y0:y0 + h, x0:x0 + w]
+    if size is not None and (h, w) != tuple(size):
+        return imresize(x, size[0], size[1], interp)
+    return NDArray(_as_jax(x))
+
+
+def center_crop(src, size, interp=1):
+    x = _np(src)
+    H, W = x.shape[:2]
+    w, h = size
+    x0 = max((W - w) // 2, 0)
+    y0 = max((H - h) // 2, 0)
+    return fixed_crop(x, x0, y0, w, h), (x0, y0, w, h)
+
+
+def random_crop(src, size, interp=1):
+    from .. import random as _random
+    x = _np(src)
+    H, W = x.shape[:2]
+    w, h = size
+    rng = _random.np_rng()
+    x0 = rng.randint(0, max(W - w, 0) + 1)
+    y0 = rng.randint(0, max(H - h, 0) + 1)
+    return fixed_crop(x, x0, y0, w, h), (x0, y0, w, h)
+
+
+def color_normalize(src, mean, std=None) -> NDArray:
+    x = _np(src).astype(np.float32)
+    x = x - np.asarray(mean, np.float32)
+    if std is not None:
+        x = x / np.asarray(std, np.float32)
+    return NDArray(_as_jax(x))
+
+
+class ImageIter:
+    """Python image iterator over .lst/.rec sources (parity surface:
+    mx.image.ImageIter). Thin wrapper over io.ImageRecordIter for .rec."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 shuffle=False, **kwargs):
+        if path_imgrec is None:
+            raise MXNetError("ImageIter requires path_imgrec in this build")
+        from ..io import ImageRecordIter
+        self._inner = ImageRecordIter(
+            path_imgrec=path_imgrec, data_shape=data_shape,
+            batch_size=batch_size, shuffle=shuffle, **kwargs)
+
+    def __iter__(self):
+        return iter(self._inner)
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()

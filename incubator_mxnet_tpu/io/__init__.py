@@ -1,0 +1,414 @@
+"""Data iterators (re-design of `python/mxnet/io/io.py` + the native iters
+of `src/io/` — SURVEY.md §2.1 Data I/O row, §3.5 call stack)."""
+
+from __future__ import annotations
+
+import collections
+from typing import List, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from ..ndarray.ndarray import _as_jax
+from . import recordio
+from .recordio import MXRecordIO, IndexedRecordIO, pack, unpack, pack_img, \
+    unpack_img, IRHeader
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
+           "ImageRecordIter", "MNISTIter", "ResizeIter", "PrefetchingIter",
+           "recordio"]
+
+
+class DataDesc(collections.namedtuple("DataDesc", ["name", "shape", "dtype",
+                                                   "layout"])):
+    def __new__(cls, name, shape, dtype="float32", layout="NCHW"):
+        return super().__new__(cls, name, tuple(shape), dtype, layout)
+
+
+class DataBatch:
+    """(parity: mx.io.DataBatch)"""
+
+    def __init__(self, data, label=None, pad=0, index=None,
+                 provide_data=None, provide_label=None):
+        self.data = data if isinstance(data, (list, tuple)) else [data]
+        self.label = label if label is None or isinstance(label, (list, tuple)) \
+            else [label]
+        self.pad = pad
+        self.index = index
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """Epoch-based iterator (parity: mx.io.DataIter)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def __next__(self):
+        return self.next()
+
+    def next(self) -> DataBatch:
+        if self.iter_next():
+            return DataBatch(self.getdata(), self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        return 0
+
+
+def _to_nd_list(arrs) -> List[NDArray]:
+    if arrs is None:
+        return []
+    if isinstance(arrs, (np.ndarray, NDArray)):
+        arrs = [arrs]
+    if isinstance(arrs, dict):
+        arrs = list(arrs.values())
+    return [a if isinstance(a, NDArray) else NDArray(_as_jax(a))
+            for a in arrs]
+
+
+class NDArrayIter(DataIter):
+    """Batches over in-memory arrays (parity: mx.io.NDArrayIter), with
+    pad/discard/roll_over last-batch handling."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self._data = _to_nd_list(data)
+        self._label = _to_nd_list(label)
+        self._names = [data_name] if len(self._data) == 1 else \
+            [f"{data_name}{i}" for i in range(len(self._data))]
+        self._label_names = [label_name] if len(self._label) == 1 else \
+            [f"{label_name}{i}" for i in range(len(self._label))]
+        self._shuffle = shuffle
+        self._last = last_batch_handle
+        self.num_data = self._data[0].shape[0] if self._data else 0
+        self._order = np.arange(self.num_data)
+        self._cursor = -batch_size
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(n, (self.batch_size,) + d.shape[1:], str(d.dtype))
+                for n, d in zip(self._names, self._data)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(n, (self.batch_size,) + d.shape[1:], str(d.dtype))
+                for n, d in zip(self._label_names, self._label)]
+
+    def reset(self):
+        if self._shuffle:
+            from .. import random as _random
+            _random.np_rng().shuffle(self._order)
+        self._cursor = -self.batch_size
+
+    def iter_next(self):
+        self._cursor += self.batch_size
+        if self._last == "discard":
+            return self._cursor + self.batch_size <= self.num_data
+        return self._cursor < self.num_data
+
+    def _slice(self, arrs):
+        import jax.numpy as jnp
+        start = self._cursor
+        end = min(start + self.batch_size, self.num_data)
+        idx = self._order[start:end]
+        pad = self.batch_size - len(idx)
+        if pad and self._last == "pad":
+            idx = np.concatenate([idx, self._order[:pad]])
+        return [NDArray(jnp.take(a._data, jnp.asarray(idx), axis=0))
+                for a in arrs]
+
+    def getdata(self):
+        return self._slice(self._data)
+
+    def getlabel(self):
+        return self._slice(self._label)
+
+    def getpad(self):
+        end = self._cursor + self.batch_size
+        if self._last == "pad" and end > self.num_data:
+            return end - self.num_data
+        return 0
+
+
+class CSVIter(DataIter):
+    """CSV reader (parity: mx.io.CSVIter, reference src/io/iter_csv.cc)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32)
+            label = label.reshape((-1,) + tuple(label_shape))
+        else:
+            label = np.zeros((data.shape[0], 1), np.float32)
+        self._inner = NDArrayIter(
+            data, label, batch_size,
+            last_batch_handle="pad" if round_batch else "discard")
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    def iter_next(self):
+        return self._inner.iter_next()
+
+
+class MNISTIter(DataIter):
+    """(parity: mx.io.MNISTIter, reference src/io/iter_mnist.cc)"""
+
+    def __init__(self, image=None, label=None, batch_size=128, shuffle=True,
+                 flat=False, seed=0, silent=False, num_parts=1, part_index=0,
+                 **kwargs):
+        super().__init__(batch_size)
+        from ..gluon.data.vision.datasets import MNIST
+        import os
+        root = os.path.dirname(image) if image else "~/.mxnet/datasets/mnist"
+        train = image is None or "train" in os.path.basename(image)
+        try:
+            ds = MNIST(root=root, train=train)
+            imgs = ds._data
+            labels = ds._label
+        except MXNetError:
+            ds = MNIST(root=root, train=train, synthetic=True)
+            imgs = ds._data
+            labels = ds._label
+        imgs = imgs.astype(np.float32) / 255.0
+        if flat:
+            imgs = imgs.reshape(len(imgs), -1)
+        else:
+            imgs = imgs.transpose(0, 3, 1, 2)  # NCHW
+        if num_parts > 1:
+            imgs = imgs[part_index::num_parts]
+            labels = labels[part_index::num_parts]
+        self._inner = NDArrayIter(imgs, labels.astype(np.float32), batch_size,
+                                  shuffle=shuffle,
+                                  last_batch_handle="discard")
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    def iter_next(self):
+        return self._inner.iter_next()
+
+
+class ImageRecordIter(DataIter):
+    """RecordIO image iterator (parity: mx.io.ImageRecordIter, reference
+    `src/io/iter_image_recordio_2.cc`).
+
+    Uses the native reader (src/) when available for GIL-free batched file
+    IO; decode+augment run in Python. Supports shuffle, partitioning
+    (num_parts/part_index for multi-host), HWC→NCHW, mean/std, rand_crop
+    and rand_mirror augmentation.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
+                 shuffle=False, rand_crop=False, rand_mirror=False,
+                 mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
+                 std_b=1.0, num_parts=1, part_index=0, preprocess_threads=4,
+                 round_batch=True, seed=0, **kwargs):
+        super().__init__(batch_size)
+        self._path = path_imgrec
+        self._shape = tuple(data_shape)  # (C, H, W)
+        self._label_width = label_width
+        self._shuffle = shuffle
+        self._rand_crop = rand_crop
+        self._rand_mirror = rand_mirror
+        self._mean = np.array([mean_r, mean_g, mean_b], np.float32)
+        self._std = np.array([std_r, std_g, std_b], np.float32)
+        self._round = round_batch
+
+        self._native = None
+        try:
+            from ._native import NativeRecordReader
+            self._native = NativeRecordReader(path_imgrec,
+                                              n_threads=preprocess_threads)
+            n = len(self._native)
+        except Exception:
+            self._plain = MXRecordIO(path_imgrec, "r")
+            self._offsets = []
+            while True:
+                pos = self._plain.tell()
+                if self._plain.read() is None:
+                    break
+                self._offsets.append(pos)
+            n = len(self._offsets)
+        idx = np.arange(n)
+        if num_parts > 1:
+            idx = idx[part_index::num_parts]
+        self._indices = idx
+        self._order = np.array(idx)
+        self.reset()
+
+    def reset(self):
+        if self._shuffle:
+            from .. import random as _random
+            self._order = np.array(self._indices)
+            _random.np_rng().shuffle(self._order)
+        self._cursor = 0
+
+    def _read_records(self, ids):
+        if self._native is not None:
+            return self._native.read_batch(ids)
+        out = []
+        for i in ids:
+            self._plain.seek(self._offsets[i])
+            out.append(self._plain.read())
+        return out
+
+    def _decode(self, payload):
+        header, img = unpack_img(payload)
+        label = np.atleast_1d(np.asarray(header.label, np.float32))
+        C, H, W = self._shape
+        from .. import random as _random
+        if img.ndim == 2:
+            img = img[:, :, None]
+        if self._rand_crop and (img.shape[0] > H or img.shape[1] > W):
+            rng = _random.np_rng()
+            y0 = rng.randint(0, img.shape[0] - H + 1)
+            x0 = rng.randint(0, img.shape[1] - W + 1)
+            img = img[y0:y0 + H, x0:x0 + W]
+        elif img.shape[0] != H or img.shape[1] != W:
+            y0 = max((img.shape[0] - H) // 2, 0)
+            x0 = max((img.shape[1] - W) // 2, 0)
+            img = img[y0:y0 + H, x0:x0 + W]
+        if self._rand_mirror and _random.np_rng().rand() < 0.5:
+            img = img[:, ::-1]
+        img = img.astype(np.float32)
+        if img.shape[2] >= 3:
+            img = (img - self._mean) / self._std
+        return img.transpose(2, 0, 1), label[:self._label_width]
+
+    def iter_next(self):
+        return self._cursor < len(self._order)
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        end = self._cursor + self.batch_size
+        ids = self._order[self._cursor:end].tolist()
+        pad = 0
+        if len(ids) < self.batch_size:
+            if not self._round:
+                self._cursor = len(self._order)
+                if not ids:
+                    raise StopIteration
+            else:
+                pad = self.batch_size - len(ids)
+                ids = ids + self._order[:pad].tolist()
+        self._cursor = end
+        payloads = self._read_records(ids)
+        imgs, labels = zip(*(self._decode(p) for p in payloads))
+        import jax.numpy as jnp
+        data = NDArray(jnp.asarray(np.stack(imgs)))
+        label = NDArray(jnp.asarray(np.stack(labels).squeeze(-1)
+                                    if self._label_width == 1
+                                    else np.stack(labels)))
+        return DataBatch([data], [label], pad=pad)
+
+
+class ResizeIter(DataIter):
+    """Truncate/extend an iterator to a fixed number of batches
+    (parity: mx.io.ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self._iter = data_iter
+        self._size = size
+        self._reset_internal = reset_internal
+        self._cur = 0
+
+    def reset(self):
+        self._cur = 0
+        if self._reset_internal:
+            self._iter.reset()
+
+    def next(self):
+        if self._cur >= self._size:
+            raise StopIteration
+        self._cur += 1
+        try:
+            return self._iter.next()
+        except StopIteration:
+            self._iter.reset()
+            return self._iter.next()
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch wrapper (parity: mx.io.PrefetchingIter,
+    reference dmlc ThreadedIter double-buffering)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        import queue
+        import threading
+        it = iters[0] if isinstance(iters, (list, tuple)) else iters
+        super().__init__(it.batch_size)
+        self._iter = it
+        self._queue: "queue.Queue" = queue.Queue(maxsize=2)
+        self._stop = object()
+        self._thread = None
+        self._start()
+
+    def _start(self):
+        import threading
+
+        def run():
+            try:
+                for batch in self._iter:
+                    self._queue.put(batch)
+            except Exception as e:
+                self._queue.put(e)
+            self._queue.put(self._stop)
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        while self._thread.is_alive():
+            try:
+                self._queue.get_nowait()
+            except Exception:
+                break
+        self._thread.join(timeout=5)
+        self._iter.reset()
+        self._start()
+
+    def next(self):
+        item = self._queue.get()
+        if item is self._stop:
+            raise StopIteration
+        if isinstance(item, Exception):
+            raise item
+        return item

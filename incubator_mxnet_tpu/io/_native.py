@@ -1,0 +1,133 @@
+"""ctypes binding for the native IO engine (src/io/recordio_native.cc).
+
+Auto-builds the shared library on first use when a toolchain is present
+(the image bakes g++); every caller must handle ``lib() is None`` and fall
+back to the pure-Python path — native is an accelerator, not a dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_LOCK = threading.Lock()
+_LIB = None
+_TRIED = False
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "..", "src")
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "..", "lib",
+                         "libmxtpu_io.so")
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building it if needed; None if
+    unavailable."""
+    global _LIB, _TRIED
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        if not os.path.exists(_LIB_PATH):
+            if os.environ.get("MXTPU_NO_NATIVE"):
+                return None
+            try:
+                subprocess.run(["make", "-C", _SRC_DIR], check=True,
+                               capture_output=True, timeout=120)
+            except Exception:
+                return None
+        try:
+            l = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        l.mxtpu_rio_open.restype = ctypes.c_void_p
+        l.mxtpu_rio_open.argtypes = [ctypes.c_char_p]
+        l.mxtpu_rio_close.argtypes = [ctypes.c_void_p]
+        l.mxtpu_rio_scan.restype = ctypes.c_int64
+        l.mxtpu_rio_scan.argtypes = [ctypes.c_void_p]
+        l.mxtpu_rio_count.restype = ctypes.c_int64
+        l.mxtpu_rio_count.argtypes = [ctypes.c_void_p]
+        l.mxtpu_rio_index.restype = ctypes.c_int64
+        l.mxtpu_rio_index.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                      ctypes.c_void_p, ctypes.c_int64]
+        l.mxtpu_rio_read_at.restype = ctypes.c_int64
+        l.mxtpu_rio_read_at.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                        ctypes.c_void_p, ctypes.c_int64]
+        l.mxtpu_rio_read_batch.restype = ctypes.c_int64
+        l.mxtpu_rio_read_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_int64]
+        _LIB = l
+        return _LIB
+
+
+class NativeRecordReader:
+    """Random-access RecordIO reader backed by the native engine."""
+
+    def __init__(self, path: str, n_threads: int = 4):
+        l = lib()
+        if l is None:
+            raise RuntimeError("native IO library unavailable")
+        self._lib = l
+        self._path = path
+        self._n_threads = n_threads
+        self._h = l.mxtpu_rio_open(path.encode())
+        if not self._h:
+            raise OSError(f"cannot open {path}")
+        n = l.mxtpu_rio_scan(self._h)
+        if n < 0:
+            raise OSError(f"corrupt recordio file {path} (code {n})")
+        self.offsets = np.empty(n, np.int64)
+        self.lengths = np.empty(n, np.int64)
+        l.mxtpu_rio_index(self._h, self.offsets.ctypes.data,
+                          self.lengths.ctypes.data, n)
+
+    def __len__(self):
+        return len(self.offsets)
+
+    def read(self, i: int) -> bytes:
+        length = int(self.lengths[i])
+        buf = ctypes.create_string_buffer(length)
+        got = self._lib.mxtpu_rio_read_at(self._h, int(self.offsets[i]),
+                                          buf, length)
+        if got != length:
+            raise OSError(f"short read on record {i} (code {got})")
+        return buf.raw
+
+    def read_batch(self, indices) -> list:
+        idx = np.asarray(indices, np.int64)
+        offs = self.offsets[idx]
+        total = int(self.lengths[idx].sum())
+        out = ctypes.create_string_buffer(total)
+        lens = np.empty(len(idx), np.int64)
+        got = self._lib.mxtpu_rio_read_batch(
+            self._h, np.ascontiguousarray(offs).ctypes.data, len(idx),
+            out, total, lens.ctypes.data, self._n_threads)
+        if got < 0:
+            raise OSError(f"batch read failed (code {got})")
+        res = []
+        pos = 0
+        raw = out.raw
+        for n in lens:
+            res.append(raw[pos:pos + int(n)])
+            pos += int(n)
+        return res
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.mxtpu_rio_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        return {"path": self._path, "n_threads": self._n_threads}
+
+    def __setstate__(self, d):
+        self.__init__(d["path"], d["n_threads"])

@@ -1,0 +1,234 @@
+"""RecordIO: the reference's binary record format, byte-compatible.
+
+Re-design of `python/mxnet/recordio.py` over dmlc recordio
+(`3rdparty/dmlc-core/src/recordio.cc`; file-level citations — SURVEY.md
+caveat §3.5). Files written by the reference's ``im2rec`` load here and
+vice versa:
+
+    record  := magic(u32) | cflag_len(u32) | payload | pad to 4B
+    magic   =  0xced7230a
+    cflag   =  top 3 bits (0=whole, 1=first, 2=middle, 3=last chunk)
+    length  =  low 29 bits
+
+When the native reader (src/, libmxtpu_io.so via ctypes) is available it
+does chunked file IO + record splitting off the Python thread; this module
+is the always-available pure-Python path and the writer.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import struct
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["MXRecordIO", "IndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_MAGIC = 0xced7230a
+_LEN_MASK = (1 << 29) - 1
+
+
+class MXRecordIO:
+    """Sequential record reader/writer (parity: mx.recordio.MXRecordIO)."""
+
+    def __init__(self, uri: str, flag: str):
+        self.uri = uri
+        self.flag = flag
+        self._fp = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self._fp = open(self.uri, "wb")
+        elif self.flag == "r":
+            self._fp = open(self.uri, "rb")
+        else:
+            raise MXNetError(f"invalid flag {self.flag!r}")
+        self.writable = self.flag == "w"
+
+    def close(self):
+        if self._fp is not None:
+            self._fp.close()
+            self._fp = None
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        """Support pickling across DataLoader worker forks (the reference
+        re-opens the file in the child — fork-handler contract)."""
+        d = dict(self.__dict__)
+        d["_fp"] = None
+        d["_pos"] = self.tell() if not self.writable else 0
+        return d
+
+    def __setstate__(self, d):
+        pos = d.pop("_pos", 0)
+        self.__dict__.update(d)
+        self.open()
+        if not self.writable:
+            self._fp.seek(pos)
+
+    def write(self, buf: bytes):
+        if not self.writable:
+            raise MXNetError("not opened for writing")
+        header = struct.pack("<II", _MAGIC, len(buf) & _LEN_MASK)
+        self._fp.write(header)
+        self._fp.write(buf)
+        pad = (-len(buf)) % 4
+        if pad:
+            self._fp.write(b"\x00" * pad)
+
+    def read(self) -> Optional[bytes]:
+        if self.writable:
+            raise MXNetError("not opened for reading")
+        header = self._fp.read(8)
+        if len(header) < 8:
+            return None
+        magic, clen = struct.unpack("<II", header)
+        if magic != _MAGIC:
+            raise MXNetError(f"invalid record magic {magic:#x} in {self.uri}")
+        length = clen & _LEN_MASK
+        payload = self._fp.read(length)
+        pad = (-length) % 4
+        if pad:
+            self._fp.read(pad)
+        return payload
+
+    def tell(self) -> int:
+        return self._fp.tell()
+
+    def seek(self, pos: int):
+        if self.writable:
+            raise MXNetError("seek is read-mode only")
+        self._fp.seek(pos)
+
+
+class IndexedRecordIO(MXRecordIO):
+    """Random-access records through a ``.idx`` sidecar
+    (parity: mx.recordio.MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path: str, uri: str, flag: str,
+                 key_type=int):
+        self.idx_path = idx_path
+        self.idx: Dict = {}
+        self.keys: List = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+        if flag == "r" and os.path.exists(idx_path):
+            with open(idx_path) as f:
+                for line in f:
+                    key, pos = line.strip().split("\t")
+                    key = key_type(key)
+                    self.idx[key] = int(pos)
+                    self.keys.append(key)
+
+    def close(self):
+        if self.writable and self._fp is not None and self.idx:
+            with open(self.idx_path, "w") as f:
+                for key in self.keys:
+                    f.write(f"{key}\t{self.idx[key]}\n")
+        super().close()
+
+    def read_idx(self, idx) -> bytes:
+        self.seek(self.idx[idx])
+        return self.read()
+
+    def write_idx(self, idx, buf: bytes):
+        pos = self._fp.tell()
+        self.write(buf)
+        self.idx[idx] = pos
+        self.keys.append(idx)
+
+
+IRHeader = collections.namedtuple("IRHeader", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header: IRHeader, s: bytes) -> bytes:
+    """Pack a header + payload (parity: mx.recordio.pack). ``flag > 0``
+    means the label is a float array of that length prepended to payload."""
+    label = header.label
+    if isinstance(label, (list, tuple, np.ndarray)):
+        label_arr = np.asarray(label, np.float32)
+        header = header._replace(flag=label_arr.size, label=0.0)
+        s = label_arr.tobytes() + s
+    return struct.pack(_IR_FORMAT, header.flag, float(header.label),
+                       header.id, header.id2) + s
+
+
+def unpack(s: bytes):
+    """(header, payload) (parity: mx.recordio.unpack)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    payload = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(payload, np.float32, header.flag)
+        payload = payload[header.flag * 4:]
+        header = header._replace(label=label)
+    return header, payload
+
+
+def pack_img(header: IRHeader, img: np.ndarray, quality=95,
+             img_fmt=".jpg") -> bytes:
+    """Encode an image into a record (requires cv2 or PIL; raw .npy
+    fallback keeps the pipeline hermetic without them)."""
+    payload = _encode_img(img, quality, img_fmt)
+    return pack(header, payload)
+
+
+def unpack_img(s: bytes, iscolor=1):
+    header, payload = unpack(s)
+    return header, _decode_img(payload, iscolor)
+
+
+def _encode_img(img, quality, img_fmt):
+    try:
+        import cv2
+        ok, buf = cv2.imencode(img_fmt, img,
+                               [cv2.IMWRITE_JPEG_QUALITY, quality])
+        if not ok:
+            raise MXNetError("cv2.imencode failed")
+        return buf.tobytes()
+    except ImportError:
+        pass
+    import io as _io
+    try:
+        from PIL import Image
+        bio = _io.BytesIO()
+        Image.fromarray(img).save(bio, format="JPEG", quality=quality)
+        return bio.getvalue()
+    except ImportError:
+        bio = _io.BytesIO()
+        np.save(bio, np.asarray(img))
+        return b"NPY0" + bio.getvalue()
+
+
+def _decode_img(payload: bytes, iscolor):
+    if payload[:4] == b"NPY0":
+        import io as _io
+        return np.load(_io.BytesIO(payload[4:]))
+    try:
+        import cv2
+        arr = np.frombuffer(payload, np.uint8)
+        img = cv2.imdecode(arr, iscolor)
+        return cv2.cvtColor(img, cv2.COLOR_BGR2RGB) if iscolor else img
+    except ImportError:
+        pass
+    try:
+        from PIL import Image
+        import io as _io
+        return np.asarray(Image.open(_io.BytesIO(payload)))
+    except ImportError:
+        raise MXNetError(
+            "no image decoder available (cv2/PIL missing) and payload is "
+            "not raw NPY")

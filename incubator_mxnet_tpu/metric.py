@@ -1,0 +1,362 @@
+"""Evaluation metrics (re-design of `python/mxnet/metric.py`; file-level
+citation — SURVEY.md caveat §5.5).
+
+TPU-first detail: ``update`` accumulates ON DEVICE (small jnp reductions)
+and only ``get()`` syncs to host — the reference's per-batch ``asnumpy``
+sync disappears from the hot loop.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError, Registry
+from .ndarray import NDArray
+
+__all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "MCC", "MAE",
+           "MSE", "RMSE", "CrossEntropy", "Perplexity", "NegativeLogLikelihood",
+           "PearsonCorrelation", "Loss", "CompositeEvalMetric", "create"]
+
+_REGISTRY = Registry("metric")
+register = _REGISTRY.register
+
+
+def create(metric, *args, **kwargs) -> "EvalMetric":
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, (list, tuple)):
+        composite = CompositeEvalMetric()
+        for m in metric:
+            composite.add(create(m))
+        return composite
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
+    return _REGISTRY.get(str(metric).lower())(*args, **kwargs)
+
+
+def _as_jnp(x):
+    if isinstance(x, NDArray):
+        return x._data
+    return jnp.asarray(x)
+
+
+def _flat_pairs(labels, preds):
+    if isinstance(labels, (list, tuple)):
+        if not isinstance(preds, (list, tuple)) or len(labels) != len(preds):
+            raise MXNetError("labels and preds must pair up")
+        return list(zip(labels, preds))
+    return [(labels, preds)]
+
+
+class EvalMetric:
+    def __init__(self, name, output_names=None, label_names=None):
+        self.name = name
+        self.output_names = output_names
+        self.label_names = label_names
+        self.reset()
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = jnp.zeros(())
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, float(self.sum_metric) / self.num_inst
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name, value = [name], [value]
+        return list(zip(name, value))
+
+    def __str__(self):
+        return f"EvalMetric: {dict(self.get_name_value())}"
+
+
+@register("acc", aliases=("accuracy",))
+class Accuracy(EvalMetric):
+    def __init__(self, axis=-1, name="accuracy", **kwargs):
+        self.axis = axis
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        for label, pred in _flat_pairs(labels, preds):
+            label = _as_jnp(label)
+            pred = _as_jnp(pred)
+            if pred.ndim > label.ndim:
+                pred = jnp.argmax(pred, axis=self.axis)
+            correct = (pred.astype(jnp.int32) ==
+                       label.astype(jnp.int32)).sum()
+            self.sum_metric = self.sum_metric + correct
+            self.num_inst += int(np.prod(label.shape))
+
+
+@register("top_k_accuracy", aliases=("topk",))
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k=1, name="top_k_accuracy", **kwargs):
+        self.top_k = top_k
+        super().__init__(f"{name}_{top_k}", **kwargs)
+
+    def update(self, labels, preds):
+        for label, pred in _flat_pairs(labels, preds):
+            label = _as_jnp(label).astype(jnp.int32)
+            pred = _as_jnp(pred)
+            top = jnp.argsort(pred, axis=-1)[..., -self.top_k:]
+            hit = (top == label[..., None]).any(axis=-1).sum()
+            self.sum_metric = self.sum_metric + hit
+            self.num_inst += int(np.prod(label.shape))
+
+
+@register("f1")
+class F1(EvalMetric):
+    """Binary F1 (parity: metric.F1; average='macro' over resets)."""
+
+    def __init__(self, name="f1", average="macro", **kwargs):
+        self.average = average
+        super().__init__(name, **kwargs)
+
+    def reset(self):
+        self._tp = self._fp = self._fn = 0.0
+        self.num_inst = 0
+        self.sum_metric = jnp.zeros(())
+
+    def update(self, labels, preds):
+        for label, pred in _flat_pairs(labels, preds):
+            label = np.asarray(_as_jnp(label)).astype(np.int32)
+            pred = np.asarray(_as_jnp(pred))
+            if pred.ndim > label.ndim:
+                pred = pred.argmax(-1)
+            pred = pred.astype(np.int32)
+            self._tp += float(((pred == 1) & (label == 1)).sum())
+            self._fp += float(((pred == 1) & (label == 0)).sum())
+            self._fn += float(((pred == 0) & (label == 1)).sum())
+            self.num_inst += label.size
+
+    def get(self):
+        prec = self._tp / max(self._tp + self._fp, 1e-12)
+        rec = self._tp / max(self._tp + self._fn, 1e-12)
+        f1 = 2 * prec * rec / max(prec + rec, 1e-12)
+        return self.name, f1
+
+
+@register("mcc")
+class MCC(EvalMetric):
+    def __init__(self, name="mcc", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def reset(self):
+        self._tp = self._tn = self._fp = self._fn = 0.0
+        self.num_inst = 0
+        self.sum_metric = jnp.zeros(())
+
+    def update(self, labels, preds):
+        for label, pred in _flat_pairs(labels, preds):
+            label = np.asarray(_as_jnp(label)).astype(np.int32)
+            pred = np.asarray(_as_jnp(pred))
+            if pred.ndim > label.ndim:
+                pred = pred.argmax(-1)
+            pred = pred.astype(np.int32)
+            self._tp += float(((pred == 1) & (label == 1)).sum())
+            self._tn += float(((pred == 0) & (label == 0)).sum())
+            self._fp += float(((pred == 1) & (label == 0)).sum())
+            self._fn += float(((pred == 0) & (label == 1)).sum())
+            self.num_inst += label.size
+
+    def get(self):
+        num = self._tp * self._tn - self._fp * self._fn
+        den = np.sqrt((self._tp + self._fp) * (self._tp + self._fn) *
+                      (self._tn + self._fp) * (self._tn + self._fn))
+        return self.name, num / max(den, 1e-12)
+
+
+class _RegressionMetric(EvalMetric):
+    def update(self, labels, preds):
+        for label, pred in _flat_pairs(labels, preds):
+            label = _as_jnp(label).astype(jnp.float32)
+            pred = _as_jnp(pred).astype(jnp.float32)
+            label = label.reshape(pred.shape)
+            self.sum_metric = self.sum_metric + self._err(label, pred)
+            self.num_inst += label.shape[0] if label.ndim else 1
+
+
+@register("mae")
+class MAE(_RegressionMetric):
+    def __init__(self, name="mae", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def _err(self, label, pred):
+        return jnp.abs(label - pred).mean(
+            axis=tuple(range(1, label.ndim))).sum() if label.ndim > 1 \
+            else jnp.abs(label - pred).sum()
+
+
+@register("mse")
+class MSE(_RegressionMetric):
+    def __init__(self, name="mse", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def _err(self, label, pred):
+        return jnp.square(label - pred).mean(
+            axis=tuple(range(1, label.ndim))).sum() if label.ndim > 1 \
+            else jnp.square(label - pred).sum()
+
+
+@register("rmse")
+class RMSE(MSE):
+    def __init__(self, name="rmse", **kwargs):
+        super().__init__(name=name, **kwargs)
+
+    def get(self):
+        name, value = super().get()
+        return name, float(np.sqrt(value))
+
+
+@register("ce", aliases=("cross-entropy", "crossentropy"))
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps=1e-12, name="cross-entropy", **kwargs):
+        self.eps = eps
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        for label, pred in _flat_pairs(labels, preds):
+            label = _as_jnp(label).astype(jnp.int32).reshape(-1)
+            pred = _as_jnp(pred)
+            pred = pred.reshape(-1, pred.shape[-1])
+            p = jnp.take_along_axis(pred, label[:, None], axis=-1)[:, 0]
+            self.sum_metric = self.sum_metric + \
+                (-jnp.log(jnp.maximum(p, self.eps))).sum()
+            self.num_inst += int(label.shape[0])
+
+
+@register("nll_loss")
+class NegativeLogLikelihood(CrossEntropy):
+    def __init__(self, eps=1e-12, name="nll-loss", **kwargs):
+        super().__init__(eps=eps, name=name, **kwargs)
+
+
+@register("perplexity")
+class Perplexity(CrossEntropy):
+    def __init__(self, ignore_label=None, axis=-1, name="perplexity",
+                 **kwargs):
+        self.ignore_label = ignore_label
+        super().__init__(name=name, **kwargs)
+
+    def update(self, labels, preds):
+        for label, pred in _flat_pairs(labels, preds):
+            label = _as_jnp(label).astype(jnp.int32).reshape(-1)
+            pred = _as_jnp(pred).reshape(-1, _as_jnp(pred).shape[-1])
+            p = jnp.take_along_axis(pred, label[:, None], axis=-1)[:, 0]
+            logp = -jnp.log(jnp.maximum(p, self.eps))
+            if self.ignore_label is not None:
+                keep = (label != self.ignore_label)
+                logp = logp * keep
+                self.num_inst += int(keep.sum())
+            else:
+                self.num_inst += int(label.shape[0])
+            self.sum_metric = self.sum_metric + logp.sum()
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, float(np.exp(float(self.sum_metric) / self.num_inst))
+
+
+@register("pearsonr")
+class PearsonCorrelation(EvalMetric):
+    def __init__(self, name="pearsonr", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def reset(self):
+        self._x = []
+        self._y = []
+        self.num_inst = 0
+        self.sum_metric = jnp.zeros(())
+
+    def update(self, labels, preds):
+        for label, pred in _flat_pairs(labels, preds):
+            self._x.append(np.asarray(_as_jnp(label), np.float64).ravel())
+            self._y.append(np.asarray(_as_jnp(pred), np.float64).ravel())
+            self.num_inst += self._x[-1].size
+
+    def get(self):
+        if not self._x:
+            return self.name, float("nan")
+        x = np.concatenate(self._x)
+        y = np.concatenate(self._y)
+        return self.name, float(np.corrcoef(x, y)[0, 1])
+
+
+@register("loss")
+class Loss(EvalMetric):
+    """Running mean of raw loss values (parity: metric.Loss)."""
+
+    def __init__(self, name="loss", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, _, preds):
+        for pred in (preds if isinstance(preds, (list, tuple)) else [preds]):
+            p = _as_jnp(pred)
+            self.sum_metric = self.sum_metric + p.sum()
+            self.num_inst += int(np.prod(p.shape)) or 1
+
+
+class CustomMetric(EvalMetric):
+    def __init__(self, feval, name="custom", allow_extra_outputs=False,
+                 **kwargs):
+        self._feval = feval
+        super().__init__(f"custom({getattr(feval, '__name__', name)})",
+                         **kwargs)
+
+    def update(self, labels, preds):
+        for label, pred in _flat_pairs(labels, preds):
+            out = self._feval(np.asarray(_as_jnp(label)),
+                              np.asarray(_as_jnp(pred)))
+            if isinstance(out, tuple):
+                s, n = out
+                self.sum_metric = self.sum_metric + s
+                self.num_inst += n
+            else:
+                self.sum_metric = self.sum_metric + out
+                self.num_inst += 1
+
+
+def np_metric(name=None, allow_extra_outputs=False):
+    """Decorator building a CustomMetric from a numpy fn
+    (parity: mx.metric.np)."""
+
+    def deco(fn):
+        return CustomMetric(fn, name=name or fn.__name__)
+
+    return deco
+
+
+class CompositeEvalMetric(EvalMetric):
+    def __init__(self, metrics=None, name="composite", **kwargs):
+        super().__init__(name, **kwargs)
+        self.metrics = [create(m) for m in (metrics or [])]
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def reset(self):
+        for m in getattr(self, "metrics", []):
+            m.reset()
+
+    def update(self, labels, preds):
+        for m in self.metrics:
+            m.update(labels, preds)
+
+    def get(self):
+        names, values = [], []
+        for m in self.metrics:
+            n, v = m.get()
+            names.append(n)
+            values.append(v)
+        return names, values

@@ -1,0 +1,74 @@
+"""Metric zoo tests (SURVEY.md §2.2 metrics row; numpy oracles)."""
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import metric, nd
+
+
+def test_accuracy():
+    m = metric.create("acc")
+    pred = nd.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    label = nd.array([1, 0, 0])
+    m.update(label, pred)
+    name, value = m.get()
+    assert name == "accuracy"
+    np.testing.assert_allclose(value, 2 / 3)
+
+
+def test_topk():
+    m = metric.TopKAccuracy(top_k=2)
+    pred = nd.array([[0.5, 0.3, 0.2], [0.1, 0.2, 0.7]])
+    label = nd.array([1, 0])
+    m.update(label, pred)
+    _, value = m.get()
+    np.testing.assert_allclose(value, 0.5)
+
+
+def test_f1_and_mcc():
+    pred = nd.array([[0.2, 0.8], [0.9, 0.1], [0.3, 0.7], [0.6, 0.4]])
+    label = nd.array([1, 0, 0, 1])
+    f1 = metric.F1()
+    f1.update(label, pred)
+    # tp=1 fp=1 fn=1 -> precision=recall=0.5 -> f1=0.5
+    np.testing.assert_allclose(f1.get()[1], 0.5)
+    mcc = metric.MCC()
+    mcc.update(label, pred)
+    assert -1 <= mcc.get()[1] <= 1
+
+
+def test_regression_metrics():
+    label = nd.array([1.0, 2.0, 3.0])
+    pred = nd.array([1.5, 2.0, 2.0])
+    mae = metric.MAE()
+    mae.update(label, pred)
+    np.testing.assert_allclose(mae.get()[1], (0.5 + 0 + 1.0) / 3)
+    rmse = metric.RMSE()
+    rmse.update(label, pred)
+    np.testing.assert_allclose(rmse.get()[1],
+                               np.sqrt((0.25 + 0 + 1.0) / 3), rtol=1e-6)
+
+
+def test_perplexity_ignores_label():
+    probs = nd.array([[0.5, 0.5], [0.9, 0.1], [0.2, 0.8]])
+    label = nd.array([0, 0, 1])
+    p = metric.Perplexity(ignore_label=None)
+    p.update(label, probs)
+    expected = np.exp(-(np.log(0.5) + np.log(0.9) + np.log(0.8)) / 3)
+    np.testing.assert_allclose(p.get()[1], expected, rtol=1e-5)
+
+
+def test_composite_and_custom():
+    comp = metric.create(["acc", "ce"])
+    pred = nd.array([[0.1, 0.9], [0.8, 0.2]])
+    label = nd.array([1, 0])
+    comp.update(label, pred)
+    names, values = comp.get()
+    assert "accuracy" in names and len(values) == 2
+
+    @metric.np_metric()
+    def always_one(label, pred):
+        return 1.0
+
+    always_one.update(label, pred)
+    assert always_one.get()[1] == 1.0

@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""im2rec: build .rec/.idx RecordIO packs from an image folder or .lst file
+(parity: the reference's `tools/im2rec.py`; file-level citation — SURVEY.md
+caveat). Output is byte-compatible with the reference format, so existing
+.rec datasets work unchanged.
+
+Usage:
+    python tools/im2rec.py PREFIX ROOT [--list] [--recursive]
+    python tools/im2rec.py PREFIX ROOT            # pack using PREFIX.lst
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+
+def make_list(prefix, root, recursive=False, train_ratio=1.0, exts=None):
+    exts = exts or [".jpg", ".jpeg", ".png", ".bmp", ".npy"]
+    items = []
+    label_map = {}
+    if recursive:
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = label_map.setdefault(folder, len(label_map))
+            for fname in sorted(os.listdir(path)):
+                if os.path.splitext(fname)[1].lower() in exts:
+                    items.append((os.path.join(folder, fname), label))
+    else:
+        for fname in sorted(os.listdir(root)):
+            if os.path.splitext(fname)[1].lower() in exts:
+                items.append((fname, 0))
+    with open(prefix + ".lst", "w") as f:
+        for i, (rel, label) in enumerate(items):
+            f.write(f"{i}\t{label}\t{rel}\n")
+    return len(items)
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            yield int(parts[0]), float(parts[1]), parts[-1]
+
+
+def make_rec(prefix, root, quality=95):
+    from incubator_mxnet_tpu.io.recordio import (IndexedRecordIO, IRHeader,
+                                                 pack, pack_img)
+
+    rec = IndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    n = 0
+    for idx, label, rel in read_list(prefix + ".lst"):
+        path = os.path.join(root, rel)
+        header = IRHeader(0, label, idx, 0)
+        if path.endswith(".npy"):
+            img = np.load(path)
+            rec.write_idx(idx, pack_img(header, img, quality, ".jpg"))
+        else:
+            with open(path, "rb") as f:
+                rec.write_idx(idx, pack(header, f.read()))
+        n += 1
+    rec.close()
+    return n
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix")
+    ap.add_argument("root")
+    ap.add_argument("--list", action="store_true",
+                    help="generate PREFIX.lst instead of packing")
+    ap.add_argument("--recursive", action="store_true",
+                    help="class-per-subfolder labels")
+    ap.add_argument("--quality", type=int, default=95)
+    args = ap.parse_args()
+    if args.list:
+        n = make_list(args.prefix, args.root, args.recursive)
+        print(f"wrote {n} entries to {args.prefix}.lst")
+    else:
+        if not os.path.exists(args.prefix + ".lst"):
+            make_list(args.prefix, args.root, args.recursive)
+        n = make_rec(args.prefix, args.root, args.quality)
+        print(f"packed {n} records into {args.prefix}.rec")
+
+
+if __name__ == "__main__":
+    main()
