@@ -49,7 +49,7 @@ _LAZY_SUBMODULES = (
     "gluon", "symbol", "sym", "optimizer", "kvstore", "metric", "io", "image",
     "initializer", "lr_scheduler", "profiler", "amp", "parallel", "models",
     "runtime", "test_utils", "callback", "util", "engine", "recordio",
-    "numpy", "npx",
+    "numpy", "np", "npx",
 )
 
 
@@ -59,7 +59,8 @@ def __getattr__(name):
         import importlib
 
         alias = {"sym": ".symbol", "npx": ".numpy_extension",
-                 "numpy": ".numpy_shim", "recordio": ".io.recordio",
+                 "numpy": ".numpy_shim", "np": ".numpy_shim",
+                 "recordio": ".io.recordio",
                  "lr_scheduler": ".optimizer.lr_scheduler"}
         modpath = alias.get(name, "." + name)
         mod = importlib.import_module(modpath, __name__)

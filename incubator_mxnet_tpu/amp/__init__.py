@@ -1,0 +1,177 @@
+"""Automatic mixed precision.
+
+Re-design of `python/mxnet/amp/amp.py` (file-level citation — SURVEY.md
+caveat). The reference monkey-patches the generated op namespaces to insert
+fp16 casts around tensor-core ops and adds dynamic loss scaling
+(SURVEY.md §2.2 "AMP").
+
+TPU-native design: ``init()`` wraps the *op registry* (the single source
+both ``mx.nd`` and Gluon's ``F`` dispatch through) with an autocast shim —
+float inputs of MXU-bound ops (`lists.TARGET_DTYPE_OPS`) are cast to
+**bfloat16** for compute and results cast back to the widest input float
+dtype; `lists.FP32_OPS` are pinned to float32. XLA fuses the casts into the
+surrounding kernels, so under ``hybridize()`` this is exactly the
+"bf16 matmul, f32 accumulate/elementwise" pattern the MXU wants.
+
+Loss scaling (`amp.scale_loss` / `init_trainer`) follows the reference's
+dynamic-scale policy and matters for the optional float16 mode; bfloat16
+usually runs at scale 1.
+"""
+
+from __future__ import annotations
+
+import functools
+from contextlib import contextmanager
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ops import registry as _registry
+from . import lists
+from .loss_scaler import LossScaler
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale", "convert_model",
+           "convert_hybrid_block", "LossScaler"]
+
+_initialized = False
+_target_dtype: Optional[str] = None
+_orig_fns = {}
+
+
+def _is_float(x) -> bool:
+    return hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def _wrap_target(fn, target):
+    @functools.wraps(fn)
+    def autocast(*args, **kwargs):
+        widest = None
+        cast_args = []
+        for a in args:
+            if _is_float(a):
+                if widest is None or jnp.promote_types(a.dtype, widest) != widest:
+                    widest = a.dtype
+                cast_args.append(a.astype(target) if a.dtype != target else a)
+            else:
+                cast_args.append(a)
+        out = fn(*cast_args, **kwargs)
+        if widest is None or widest == target:
+            return out
+        if isinstance(out, (tuple, list)):
+            return type(out)(o.astype(widest) if _is_float(o) else o
+                             for o in out)
+        return out.astype(widest) if _is_float(out) else out
+
+    return autocast
+
+
+def _wrap_fp32(fn):
+    @functools.wraps(fn)
+    def force_fp32(*args, **kwargs):
+        low = (jnp.bfloat16, jnp.float16)
+        in_dtype = None
+        cast_args = []
+        for a in args:
+            if _is_float(a) and a.dtype in low:
+                in_dtype = a.dtype
+                cast_args.append(a.astype(jnp.float32))
+            else:
+                cast_args.append(a)
+        out = fn(*cast_args, **kwargs)
+        if in_dtype is None:
+            return out
+        if isinstance(out, (tuple, list)):
+            return type(out)(o.astype(in_dtype) if _is_float(o) else o
+                             for o in out)
+        return out.astype(in_dtype) if _is_float(out) else out
+
+    return force_fp32
+
+
+def init(target_dtype: str = "bfloat16", target_precision_ops=None,
+         fp32_ops=None, **_ignored) -> None:
+    """Enable AMP process-wide (parity: ``amp.init``). Idempotent."""
+    global _initialized, _target_dtype
+    if _initialized:
+        return
+    if target_dtype not in ("bfloat16", "float16"):
+        raise MXNetError("AMP target_dtype must be bfloat16 or float16 "
+                         f"(got {target_dtype!r})")
+    target = jnp.bfloat16 if target_dtype == "bfloat16" else jnp.float16
+    target_ops = list(target_precision_ops or lists.TARGET_DTYPE_OPS)
+    fp32 = list(fp32_ops or lists.FP32_OPS)
+
+    for name in target_ops + fp32:
+        try:
+            spec = _registry.get(name)
+        except (KeyError, MXNetError):
+            continue  # op list entry not present in this build
+        if spec.name in _orig_fns:
+            continue
+        _orig_fns[spec.name] = spec.fn
+        spec.fn = (_wrap_target(spec.fn, target) if name in target_ops
+                   else _wrap_fp32(spec.fn))
+    _initialized = True
+    _target_dtype = target_dtype
+
+
+def _deinit_for_tests() -> None:
+    """Restore original op fns (test helper; the reference has no un-init)."""
+    global _initialized, _target_dtype
+    for name, fn in _orig_fns.items():
+        _registry.get(name).fn = fn
+    _orig_fns.clear()
+    _initialized = False
+    _target_dtype = None
+
+
+def init_trainer(trainer) -> None:
+    """Attach a dynamic loss scaler to a Gluon Trainer (parity:
+    ``amp.init_trainer``)."""
+    if not _initialized:
+        raise MXNetError("call amp.init() before amp.init_trainer()")
+    trainer._amp_loss_scaler = LossScaler(
+        init_scale=2. ** 16 if _target_dtype == "float16" else 1.)
+    trainer._amp_original_scale = trainer._scale
+
+
+@contextmanager
+def scale_loss(loss, trainer):
+    """Scale the loss before ``backward()`` and mark the trainer to divide
+    gradients back (parity: ``amp.scale_loss``)::
+
+        with amp.scale_loss(loss, trainer) as scaled:
+            scaled.backward()
+        trainer.step(batch_size)
+    """
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        raise MXNetError("trainer not AMP-initialised; call amp.init_trainer")
+    trainer._scale = trainer._amp_original_scale / scaler.loss_scale
+    if isinstance(loss, (list, tuple)):
+        yield type(loss)(l * scaler.loss_scale for l in loss)
+    else:
+        yield loss * scaler.loss_scale
+
+
+def unscale(trainer) -> bool:
+    """Check grads for overflow and update the dynamic scale; returns True
+    when the step should be SKIPPED (overflow detected)."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        return False
+    overflow = scaler.has_overflow(trainer._params)
+    scaler.update_scale(overflow)
+    return overflow
+
+
+def convert_model(block, target_dtype: str = "bfloat16"):
+    """Cast a trained model's parameters for low-precision inference
+    (parity: ``amp.convert_model`` — the reference rewrites the symbol with
+    cast nodes; here XLA recompiles for the new dtypes automatically)."""
+    block.cast(target_dtype)
+    return block
+
+
+convert_hybrid_block = convert_model

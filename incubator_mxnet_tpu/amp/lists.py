@@ -1,0 +1,54 @@
+"""AMP op lists.
+
+Re-design of `python/mxnet/amp/lists/symbol_fp16.py` (file-level citation —
+SURVEY.md caveat): the reference classifies every operator into
+cast-to-fp16 (tensor-core compute), force-fp32 (numerically sensitive) and
+widest-type-propagate lists. The TPU lists target **bfloat16** (the MXU's
+native input dtype) and are keyed by registry op name/alias.
+"""
+
+# FLOP-dominated ops whose inputs are cast to the AMP dtype — these land on
+# the MXU (reference list: convolution/FC/RNN/interleaved_matmul_* kernels)
+TARGET_DTYPE_OPS = [
+    "FullyConnected",
+    "Convolution",
+    "Deconvolution",
+    "dot",
+    "batch_dot",
+    "interleaved_matmul_selfatt_qk",
+    "interleaved_matmul_selfatt_valatt",
+    "interleaved_matmul_encdec_qk",
+    "interleaved_matmul_encdec_valatt",
+]
+
+# numerically sensitive ops forced to run in float32 (reference FP32_FUNCS:
+# softmax/norm/exp/log/loss ops)
+FP32_OPS = [
+    "softmax",
+    "log_softmax",
+    "softmax_cross_entropy",
+    "SoftmaxOutput",
+    "BatchNorm",
+    "LayerNorm",
+    "InstanceNorm",
+    "GroupNorm",
+    "L2Normalization",
+    "norm",
+    "exp",
+    "log",
+    "log2",
+    "log10",
+    "log1p",
+    "expm1",
+    "rsqrt",
+    "erfinv",
+    "reciprocal",
+    "mean",
+    "sum",
+]
+
+# everything else propagates the widest input dtype (reference
+# WIDEST_TYPE_CASTS) — our registry ops already follow jnp promotion, so no
+# action is needed; the list exists for introspection parity.
+WIDEST_TYPE_CASTS = ["broadcast_add", "broadcast_mul", "elemwise_add",
+                     "concat", "where", "add_n"]

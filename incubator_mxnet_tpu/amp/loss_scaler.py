@@ -1,0 +1,52 @@
+"""Dynamic loss scaler (re-design of `python/mxnet/amp/loss_scaler.py`;
+file-level citation — SURVEY.md caveat).
+
+Used for float16 AMP; bfloat16 (the TPU default) has fp32's exponent range
+and normally runs with ``loss_scale=1`` — the scaler still functions so the
+fp16 contract is fully supported.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["LossScaler"]
+
+
+class LossScaler:
+    """Dynamic loss scaling: multiply the loss by ``loss_scale`` before
+    backward; after backward, check gradients for inf/nan — on overflow skip
+    the update and halve the scale, otherwise grow the scale 2× every
+    ``scale_window`` clean steps (the reference's exact policy)."""
+
+    def __init__(self, init_scale: float = 2. ** 16, scale_factor: float = 2.,
+                 scale_window: int = 2000, tolerance: float = 0.):
+        self.loss_scale = float(init_scale)
+        self._scale_factor = float(scale_factor)
+        self._scale_window = int(scale_window)
+        self._unskipped = 0
+
+    def has_overflow(self, params) -> bool:
+        """True if any parameter gradient contains inf/nan. Checked on-device
+        with one small fetch (reference: `multi_all_finite` op)."""
+        import jax.numpy as jnp
+
+        total = None
+        for p in params:
+            g = p.grad() if callable(getattr(p, "grad", None)) else p
+            data = getattr(g, "_data", g)
+            bad = jnp.logical_not(jnp.isfinite(data)).sum()
+            total = bad if total is None else total + bad
+        if total is None:
+            return False
+        return bool(np.asarray(total) > 0)
+
+    def update_scale(self, overflow: bool) -> None:
+        if overflow:
+            self.loss_scale = max(1., self.loss_scale / self._scale_factor)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0

@@ -161,7 +161,9 @@ class DataLoader:
                 _worker_fn, (indices, self._batchify_fn)))
             return True
 
-        for _ in range(self._prefetch):
+        # always keep at least one request in flight, else prefetch=0 would
+        # never enter the drain loop and the epoch would yield nothing
+        for _ in range(max(self._prefetch, 1)):
             if not submit():
                 break
         while results:

@@ -10,12 +10,18 @@ from ..base import MXNetError
 from ..ndarray import NDArray
 from ..ndarray.ndarray import _as_jax
 
-__all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
-           "center_crop", "random_crop", "color_normalize", "ImageIter"]
+__all__ = ["imread", "imdecode", "decode_to_numpy", "imresize",
+           "resize_short", "fixed_crop", "center_crop", "random_crop",
+           "color_normalize", "ImageIter"]
 
 
-def imdecode(buf: bytes, flag=1, to_rgb=True) -> NDArray:
-    """Decode an encoded image buffer (parity: mx.image.imdecode)."""
+def decode_to_numpy(buf: bytes, flag=1, to_rgb=True) -> np.ndarray:
+    """Decode an encoded image buffer to a HWC uint8 numpy array.
+
+    The single codec chain (cv2 → PIL → raw NPY0) shared by
+    ``mx.image.imdecode`` and the RecordIO data pipeline — host-side only,
+    no device transfer (the data pipeline stacks batches before
+    ``device_put``)."""
     arr = None
     if bytes(buf[:4]) == b"NPY0":
         import io as _io
@@ -38,7 +44,12 @@ def imdecode(buf: bytes, flag=1, to_rgb=True) -> NDArray:
         raise MXNetError("image decode failed")
     if arr.ndim == 2:
         arr = arr[:, :, None]
-    return NDArray(_as_jax(arr))
+    return arr
+
+
+def imdecode(buf: bytes, flag=1, to_rgb=True) -> NDArray:
+    """Decode an encoded image buffer (parity: mx.image.imdecode)."""
+    return NDArray(_as_jax(decode_to_numpy(buf, flag, to_rgb)))
 
 
 def imread(filename: str, flag=1, to_rgb=True) -> NDArray:

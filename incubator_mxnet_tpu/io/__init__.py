@@ -123,21 +123,30 @@ class NDArrayIter(DataIter):
         if self._shuffle:
             from .. import random as _random
             _random.np_rng().shuffle(self._order)
-        self._cursor = -self.batch_size
+        # roll_over: a short tail is not emitted at epoch end; its samples
+        # are prepended to the first batch of the next epoch (reference
+        # NDArrayIter contract)
+        leftover = self.num_data - self._cursor
+        if self._last == "roll_over" and 0 < leftover < self.batch_size:
+            self._cursor = -leftover - self.batch_size
+        else:
+            self._cursor = -self.batch_size
 
     def iter_next(self):
         self._cursor += self.batch_size
-        if self._last == "discard":
+        if self._last in ("discard", "roll_over"):
             return self._cursor + self.batch_size <= self.num_data
         return self._cursor < self.num_data
 
     def _slice(self, arrs):
         import jax.numpy as jnp
-        start = self._cursor
-        end = min(start + self.batch_size, self.num_data)
+        start = max(self._cursor, 0)
+        end = min(self._cursor + self.batch_size, self.num_data)
         idx = self._order[start:end]
+        if self._cursor < 0:  # roll_over head: prepend last epoch's tail
+            idx = np.concatenate([self._order[self._cursor:], idx])
         pad = self.batch_size - len(idx)
-        if pad and self._last == "pad":
+        if pad > 0 and self._last == "pad":
             idx = np.concatenate([idx, self._order[:pad]])
         return [NDArray(jnp.take(a._data, jnp.asarray(idx), axis=0))
                 for a in arrs]
@@ -304,6 +313,13 @@ class ImageRecordIter(DataIter):
             y0 = max((img.shape[0] - H) // 2, 0)
             x0 = max((img.shape[1] - W) // 2, 0)
             img = img[y0:y0 + H, x0:x0 + W]
+        if img.shape[0] < H or img.shape[1] < W:
+            # upsize smaller-than-target images by edge replication so every
+            # decoded sample stacks to exactly data_shape (the reference
+            # resizes via OpenCV; edge-pad is the hermetic equivalent)
+            img = np.pad(img, ((0, max(H - img.shape[0], 0)),
+                               (0, max(W - img.shape[1], 0)), (0, 0)),
+                         mode="edge")
         if self._rand_mirror and _random.np_rng().rand() < 0.5:
             img = img[:, ::-1]
         img = img.astype(np.float32)
@@ -372,42 +388,63 @@ class PrefetchingIter(DataIter):
 
     def __init__(self, iters, rename_data=None, rename_label=None):
         import queue
-        import threading
         it = iters[0] if isinstance(iters, (list, tuple)) else iters
         super().__init__(it.batch_size)
         self._iter = it
         self._queue: "queue.Queue" = queue.Queue(maxsize=2)
         self._stop = object()
         self._thread = None
+        self._cancel = None
+        self._exhausted = False
         self._start()
 
     def _start(self):
         import threading
 
+        cancel = threading.Event()
+
         def run():
             try:
                 for batch in self._iter:
-                    self._queue.put(batch)
+                    # bounded put that aborts promptly when reset() cancels
+                    while not cancel.is_set():
+                        try:
+                            self._queue.put(batch, timeout=0.1)
+                            break
+                        except Exception:
+                            continue
+                    if cancel.is_set():
+                        return
             except Exception as e:
                 self._queue.put(e)
             self._queue.put(self._stop)
 
+        self._cancel = cancel
+        self._exhausted = False
         self._thread = threading.Thread(target=run, daemon=True)
         self._thread.start()
 
     def reset(self):
+        # cancel the old producer FIRST, then drain so its pending put
+        # unblocks; only one thread ever touches self._iter at a time
+        self._cancel.set()
         while self._thread.is_alive():
             try:
-                self._queue.get_nowait()
+                self._queue.get(timeout=0.1)
             except Exception:
-                break
-        self._thread.join(timeout=5)
+                pass
+        self._thread.join()
+        while not self._queue.empty():
+            self._queue.get_nowait()
         self._iter.reset()
         self._start()
 
     def next(self):
+        if self._exhausted:
+            raise StopIteration
         item = self._queue.get()
         if item is self._stop:
+            self._exhausted = True
             raise StopIteration
         if isinstance(item, Exception):
             raise item

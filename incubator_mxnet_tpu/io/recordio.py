@@ -68,7 +68,8 @@ class MXRecordIO:
         re-opens the file in the child — fork-handler contract)."""
         d = dict(self.__dict__)
         d["_fp"] = None
-        d["_pos"] = self.tell() if not self.writable else 0
+        d["_pos"] = self.tell() if (not self.writable
+                                    and self._fp is not None) else 0
         return d
 
     def __setstate__(self, d):
@@ -214,21 +215,6 @@ def _encode_img(img, quality, img_fmt):
 
 
 def _decode_img(payload: bytes, iscolor):
-    if payload[:4] == b"NPY0":
-        import io as _io
-        return np.load(_io.BytesIO(payload[4:]))
-    try:
-        import cv2
-        arr = np.frombuffer(payload, np.uint8)
-        img = cv2.imdecode(arr, iscolor)
-        return cv2.cvtColor(img, cv2.COLOR_BGR2RGB) if iscolor else img
-    except ImportError:
-        pass
-    try:
-        from PIL import Image
-        import io as _io
-        return np.asarray(Image.open(_io.BytesIO(payload)))
-    except ImportError:
-        raise MXNetError(
-            "no image decoder available (cv2/PIL missing) and payload is "
-            "not raw NPY")
+    from ..image import decode_to_numpy
+
+    return decode_to_numpy(payload, flag=iscolor, to_rgb=bool(iscolor))

@@ -89,6 +89,12 @@ def imperative_invoke(spec: _reg.OpSpec, *args, out=None, ctx=None, **kwargs):
         autograd._record_node(pure_fn, primals, owners, outs, name=spec.name,
                               tuple_out=multi)
 
+    # NaiveEngine debug mode: surface async errors at the faulting op
+    # (parity: MXNET_ENGINE_TYPE=NaiveEngine — SURVEY.md §5.2)
+    from .. import engine as _engine
+    if _engine.is_sync():
+        _engine._maybe_sync(outs)
+
     if out is not None:
         targets = out if isinstance(out, (tuple, list)) else (out,)
         for t, o in zip(targets, outs):

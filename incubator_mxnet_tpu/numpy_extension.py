@@ -1,0 +1,41 @@
+"""``mx.npx`` — numpy-extension namespace (re-design of
+`python/mxnet/numpy_extension/`; file-level citation — SURVEY.md caveat).
+
+The reference's ``npx`` holds the neural-network ops that have no NumPy
+equivalent (relu, softmax, batch_norm, convolution, …) plus the
+``set_np``/``reset_np`` semantics switches. Here every registry op is
+already numpy-friendly, so ``npx`` forwards by name to the ``mx.nd``
+namespace and re-exports the semantics toggles from ``mx.util``.
+"""
+
+from __future__ import annotations
+
+from . import ndarray as _nd
+from .util import (is_np_array, is_np_shape, np_array, np_shape, reset_np,
+                   set_np, set_np_shape, use_np)
+
+__all__ = ["set_np", "reset_np", "set_np_shape", "is_np_array",
+           "is_np_shape", "use_np", "np_array", "np_shape", "waitall",
+           "cpu", "gpu", "tpu", "num_gpus", "current_context"]
+
+from .context import cpu, gpu, tpu, num_gpus, current_context  # noqa: E402
+
+
+def waitall():
+    """Parity: ``mx.npx.waitall``."""
+    from .engine import wait_all
+
+    wait_all()
+
+
+def __getattr__(name: str):
+    # registry-backed nn ops: npx.relu, npx.softmax, npx.batch_norm …
+    attr = getattr(_nd, name, None)
+    if attr is not None:
+        return attr
+    # snake_case → CamelCase registry aliases (npx.batch_norm → BatchNorm)
+    camel = "".join(p.capitalize() for p in name.split("_"))
+    attr = getattr(_nd, camel, None)
+    if attr is not None:
+        return attr
+    raise AttributeError(f"mx.npx has no attribute {name!r}")

@@ -1,0 +1,115 @@
+"""``mx.np`` — NumPy-compatible array API (re-design of
+`python/mxnet/numpy/` ≥1.6; file-level citation — SURVEY.md caveat).
+
+The reference re-implements the NumPy surface op-by-op on its own runtime.
+The TPU-native build sits on jnp, which *is* a NumPy-compatible tracer —
+so ``mx.np`` is a forwarding namespace: any ``numpy``-named function is
+resolved on ``jax.numpy``, executed through the imperative dispatcher (so
+``autograd.record()`` sees it as a tape node, exactly like a registry op),
+and returns :class:`~incubator_mxnet_tpu.ndarray.NDArray`.
+
+This gives the full jnp surface (hundreds of functions) with MXNet
+autograd/async semantics instead of a hand-ported subset.
+"""
+
+from __future__ import annotations
+
+import numpy as _onp
+
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray import NDArray
+from .ndarray.register import imperative_invoke
+from .ops.registry import OpSpec
+
+# numpy-API constants / dtypes re-exported verbatim
+pi = _onp.pi
+e = _onp.e
+inf = _onp.inf
+nan = _onp.nan
+newaxis = None
+float32 = "float32"
+float64 = "float64"
+float16 = "float16"
+bfloat16 = "bfloat16"
+int8 = "int8"
+int32 = "int32"
+int64 = "int64"
+uint8 = "uint8"
+bool_ = "bool"
+
+ndarray = NDArray  # parity: mx.np.ndarray is the array type
+
+_spec_cache = {}
+
+# jnp callables that are not array-valued ops (predicates/introspection):
+# call directly and return python/numpy values, no tape node
+_PASSTHROUGH = {"shape", "ndim", "size", "result_type", "promote_types",
+                "can_cast", "issubdtype", "isscalar", "iterable",
+                "broadcast_shapes"}
+
+
+def _unwrap(x):
+    if isinstance(x, NDArray):
+        return x._data
+    if isinstance(x, (list, tuple)):
+        return type(x)(_unwrap(v) for v in x)
+    return x
+
+
+def _make_spec(name: str, fn) -> OpSpec:
+    spec = _spec_cache.get(name)
+    if spec is None:
+        import jax
+
+        def op(*arrays, **params):
+            return fn(*arrays, **params)
+
+        op.__doc__ = fn.__doc__
+        spec = OpSpec("np." + name, op)
+        # variadic/multi-output jnp fns (split, meshgrid…) return sequences;
+        # detect at call time inside imperative_invoke via tuple normalize
+        spec.num_outputs = None
+        _spec_cache[name] = spec
+    return spec
+
+
+def array(obj, dtype=None, ctx=None):
+    """Parity: ``mx.np.array``."""
+    from .ndarray import array as _nd_array
+
+    return _nd_array(obj, dtype=dtype, ctx=ctx)
+
+
+def __getattr__(name: str):
+    fn = getattr(jnp, name, None)
+    if fn is None:
+        raise AttributeError(f"mx.np has no attribute {name!r} "
+                             "(not in jax.numpy)")
+    if not callable(fn):
+        return fn
+    if name in _PASSTHROUGH:
+        def passthrough(*args, **kwargs):
+            return fn(*_unwrap(args), **kwargs)
+
+        passthrough.__name__ = name
+        return passthrough
+
+    spec = _make_spec(name, fn)
+
+    def np_function(*args, **kwargs):
+        try:
+            return imperative_invoke(spec, *args, **kwargs)
+        except MXNetError:
+            # fns with non-array leading args (e.g. np.arange(5)) fail the
+            # array path; fall back to a direct call, still wrapping outputs
+            res = fn(*_unwrap(args), **{k: _unwrap(v)
+                                        for k, v in kwargs.items()})
+            if isinstance(res, (tuple, list)):
+                return type(res)(NDArray(r) for r in res)
+            return NDArray(res)
+
+    np_function.__name__ = name
+    np_function.__doc__ = fn.__doc__
+    return np_function

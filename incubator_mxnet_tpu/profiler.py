@@ -1,0 +1,254 @@
+"""Profiler.
+
+Re-design of `src/profiler/profiler.{h,cc}` + `python/mxnet/profiler.py`
+(file-level citations — SURVEY.md caveat). The reference instruments its
+dependency engine around every op dispatch and dumps Chrome trace-event
+JSON plus an aggregate stats table (SURVEY.md §5.1).
+
+TPU-native split of responsibilities:
+
+  - **device timeline** → ``jax.profiler`` (XLA's own tracing; TensorBoard/
+    perfetto output). ``set_config(profile_all=True)`` + ``start()/stop()``
+    drive it; ``mx.profiler.scope``/`named_scope` annotate regions so HLO
+    ops attribute to model layers.
+  - **host-side events** → recorded here (scoped ``ProfileEvent``s, counters)
+    and dumped as Chrome trace-event JSON via ``dump()`` — same format the
+    reference emits, loadable in chrome://tracing or perfetto.
+  - **aggregate table** → ``dumps()`` (parity: `MXAggregateProfileStatsPrint`
+    / ``profiler.dumps()``), per-name count/total/min/max/avg.
+  - ``mfu(...)`` — model-FLOPs-utilisation meter for the north-star metric
+    (SURVEY.md §6); no reference analogue, TPU-specific addition.
+
+Env autostart parity: ``MXTPU_PROFILER_AUTOSTART=1`` (reference:
+`MXNET_PROFILER_AUTOSTART`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+from .base import getenv_bool
+
+__all__ = ["set_config", "start", "stop", "dump", "dumps", "pause", "resume",
+           "scope", "ProfileEvent", "Counter", "Marker", "mfu",
+           "state_string"]
+
+_lock = threading.Lock()
+_config = {
+    "filename": "profile.json",
+    "profile_all": False,
+    "profile_symbolic": True,
+    "profile_imperative": True,
+    "profile_memory": False,
+    "profile_api": False,
+    "aggregate_stats": True,
+    "tensorboard_logdir": None,
+}
+_running = False
+_paused = False
+_device_trace_active = False
+_events: List[dict] = []
+_agg: Dict[str, List[float]] = defaultdict(list)
+_t0 = time.perf_counter()
+
+
+def set_config(**kwargs) -> None:
+    """Parity: ``mx.profiler.set_config`` (`MXSetProcessProfilerConfig`).
+    Unknown keys are accepted and ignored for drop-in compatibility."""
+    _config.update(kwargs)
+
+
+def _now_us() -> float:
+    return (time.perf_counter() - _t0) * 1e6
+
+
+def start() -> None:
+    """Begin profiling (parity: ``mx.profiler.set_state('run')``). Starts the
+    XLA device trace too when a tensorboard_logdir is configured."""
+    global _running, _device_trace_active
+    with _lock:
+        _running = True
+        logdir = _config.get("tensorboard_logdir")
+        if logdir and not _device_trace_active:
+            import jax
+
+            jax.profiler.start_trace(logdir)
+            _device_trace_active = True
+
+
+def stop() -> None:
+    """Parity: ``mx.profiler.set_state('stop')``."""
+    global _running, _device_trace_active
+    with _lock:
+        _running = False
+        if _device_trace_active:
+            import jax
+
+            jax.profiler.stop_trace()
+            _device_trace_active = False
+
+
+def pause() -> None:
+    global _paused
+    _paused = True
+
+
+def resume() -> None:
+    global _paused
+    _paused = False
+
+
+def state_string() -> str:
+    return "run" if _running else "stop"
+
+
+def is_running() -> bool:
+    return _running and not _paused
+
+
+def _record(name: str, cat: str, t_start_us: float, dur_us: float) -> None:
+    with _lock:
+        _events.append({"name": name, "cat": cat, "ph": "X",
+                        "ts": t_start_us, "dur": dur_us,
+                        "pid": os.getpid(),
+                        "tid": threading.get_ident() % 1_000_000})
+        if _config["aggregate_stats"]:
+            _agg[name].append(dur_us)
+
+
+@contextmanager
+def scope(name: str, cat: str = "operator"):
+    """Scoped profiling region. Host-side timing is recorded when the
+    profiler runs; the region is ALWAYS forwarded to ``jax.named_scope`` so
+    XLA device traces attribute HLO to it (SURVEY.md §5.1 TPU equivalent)."""
+    import jax
+
+    with jax.named_scope(name):
+        if not is_running():
+            yield
+            return
+        t = _now_us()
+        try:
+            yield
+        finally:
+            _record(name, cat, t, _now_us() - t)
+
+
+class ProfileEvent:
+    """Manually started/stopped event (parity: `profiler::ProfileEvent`)."""
+
+    def __init__(self, name: str, cat: str = "event"):
+        self.name = name
+        self.cat = cat
+        self._t = None
+
+    def start(self):
+        self._t = _now_us()
+
+    def stop(self):
+        if self._t is not None and is_running():
+            _record(self.name, self.cat, self._t, _now_us() - self._t)
+        self._t = None
+
+
+class Counter:
+    """Named monotonically-adjustable counter (parity: `ProfileCounter`)."""
+
+    def __init__(self, name: str, value: int = 0):
+        self.name = name
+        self.value = value
+        self._emit()
+
+    def _emit(self):
+        if is_running():
+            with _lock:
+                _events.append({"name": self.name, "ph": "C",
+                                "ts": _now_us(), "pid": os.getpid(),
+                                "args": {"value": self.value}})
+
+    def increment(self, delta: int = 1):
+        self.value += delta
+        self._emit()
+
+    def decrement(self, delta: int = 1):
+        self.value -= delta
+        self._emit()
+
+    def set_value(self, value: int):
+        self.value = value
+        self._emit()
+
+
+class Marker:
+    """Instant event (parity: `ProfileMarker` / instant markers)."""
+
+    def __init__(self, name: str, cat: str = "marker"):
+        self.name = name
+        self.cat = cat
+
+    def mark(self, scope_: str = "process"):
+        if is_running():
+            with _lock:
+                _events.append({"name": self.name, "cat": self.cat,
+                                "ph": "i", "ts": _now_us(),
+                                "s": {"process": "p", "thread": "t",
+                                      "global": "g"}.get(scope_, "p"),
+                                "pid": os.getpid()})
+
+
+def dump(finished: bool = True, filename: Optional[str] = None) -> str:
+    """Write Chrome trace-event JSON (parity: ``mx.profiler.dump`` →
+    `trace.json`). Returns the path written."""
+    path = filename or _config["filename"]
+    with _lock:
+        payload = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+        if finished:
+            _events.clear()
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    return path
+
+
+def dumps(reset: bool = False) -> str:
+    """Aggregate stats table (parity: ``mx.profiler.dumps`` /
+    `MXAggregateProfileStatsPrint`)."""
+    with _lock:
+        rows = []
+        for name, durs in sorted(_agg.items()):
+            n = len(durs)
+            tot = sum(durs)
+            rows.append((name, n, tot / 1e3, min(durs) / 1e3,
+                         max(durs) / 1e3, tot / n / 1e3))
+        if reset:
+            _agg.clear()
+    header = (f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}{'Min(ms)':>10}"
+              f"{'Max(ms)':>10}{'Avg(ms)':>10}")
+    lines = [header, "-" * len(header)]
+    for name, n, tot, mn, mx_, avg in rows:
+        lines.append(f"{name:<40}{n:>8}{tot:>12.3f}{mn:>10.3f}"
+                     f"{mx_:>10.3f}{avg:>10.3f}")
+    return "\n".join(lines)
+
+
+def mfu(model_flops_per_step: float, step_time_s: float,
+        n_chips: int = 1, peak_flops_per_chip: Optional[float] = None) -> float:
+    """Model-FLOPs-utilisation: achieved FLOP/s over peak (north-star metric,
+    SURVEY.md §6). ``peak_flops_per_chip`` defaults from the local TPU
+    generation (bf16 peak)."""
+    if peak_flops_per_chip is None:
+        gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
+        table = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
+        peak_flops_per_chip = next(
+            (v for k, v in table.items() if gen.startswith(k)), 197e12)
+    return model_flops_per_step / step_time_s / (n_chips * peak_flops_per_chip)
+
+
+if getenv_bool("MXTPU_PROFILER_AUTOSTART"):
+    set_config(profile_all=True)
+    start()

@@ -1,0 +1,148 @@
+"""Tests for the infra shims: runtime features, engine, util, profiler, AMP,
+mx.np / mx.npx (SURVEY.md §5.1/5.2/5.6 + §2.2 AMP/numpy rows)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd
+
+
+def test_runtime_features():
+    feats = mx.runtime.Features()
+    assert feats.is_enabled("XLA")
+    assert feats.is_enabled("CPU")
+    assert "DIST_KVSTORE" in feats
+    names = {f.name for f in mx.runtime.feature_list()}
+    assert {"TPU", "PALLAS", "PROFILER", "AMP"} <= names
+
+
+def test_engine_sync_mode_and_waitall():
+    prev = mx.engine.set_sync(True)
+    try:
+        x = nd.ones((4, 4))
+        y = (x * 2).sum()
+        assert float(y.asnumpy()) == 32.0
+    finally:
+        mx.engine.set_sync(prev)
+    mx.engine.wait_all()
+    with mx.engine.bulk(16):
+        assert nd.ones((2,)).shape == (2,)
+
+
+def test_util_environment():
+    assert os.environ.get("MXTPU_TEST_KNOB") is None
+    with mx.util.environment("MXTPU_TEST_KNOB", "7"):
+        assert os.environ["MXTPU_TEST_KNOB"] == "7"
+        with mx.util.environment({"MXTPU_TEST_KNOB": None}):
+            assert os.environ.get("MXTPU_TEST_KNOB") is None
+    assert os.environ.get("MXTPU_TEST_KNOB") is None
+
+
+def test_util_np_semantics():
+    assert not mx.util.is_np_array()
+    with mx.util.np_array(True):
+        assert mx.util.is_np_array()
+    mx.npx.set_np()
+    assert mx.util.is_np_array() and mx.util.is_np_shape()
+    mx.npx.reset_np()
+    assert not mx.util.is_np_array()
+
+
+def test_profiler_events_and_dump(tmp_path):
+    prof = mx.profiler
+    prof.set_config(filename=str(tmp_path / "trace.json"),
+                    aggregate_stats=True)
+    prof.start()
+    with prof.scope("fwd"):
+        nd.ones((8, 8)).sum().asnumpy()
+    ev = prof.ProfileEvent("manual")
+    ev.start()
+    ev.stop()
+    c = prof.Counter("batches")
+    c.increment(3)
+    prof.Marker("epoch_end").mark()
+    prof.stop()
+    path = prof.dump()
+    with open(path) as f:
+        trace = json.load(f)
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert {"fwd", "manual", "batches", "epoch_end"} <= names
+    table = prof.dumps(reset=True)
+    assert "fwd" in table and "Calls" in table
+
+
+def test_profiler_mfu():
+    val = mx.profiler.mfu(1e12, 1.0, n_chips=1, peak_flops_per_chip=2e12)
+    assert val == pytest.approx(0.5)
+
+
+def test_amp_autocast_and_loss_scaler():
+    from incubator_mxnet_tpu import amp
+
+    amp.init("bfloat16")
+    try:
+        a = nd.ones((4, 8))
+        b = nd.ones((8, 4))
+        out = nd.dot(a, b)
+        # autocast computes in bf16 but returns the widest input dtype
+        assert out.dtype == np.float32
+        assert np.allclose(out.asnumpy(), 8.0)
+        # fp32-pinned op keeps behaviour on low-precision input
+        sm = nd.softmax(nd.ones((2, 3), dtype="bfloat16"))
+        assert str(sm.dtype) == "bfloat16"
+
+        from incubator_mxnet_tpu import gluon
+        net = gluon.nn.Dense(4, in_units=8)
+        net.initialize()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1})
+        amp.init_trainer(trainer)
+        with autograd.record():
+            loss = net(nd.ones((2, 8))).sum()
+            with amp.scale_loss(loss, trainer) as scaled:
+                scaled.backward()
+        assert not amp.unscale(trainer)
+        trainer.step(2)
+    finally:
+        amp._deinit_for_tests()
+
+
+def test_loss_scaler_policy():
+    from incubator_mxnet_tpu.amp import LossScaler
+
+    s = LossScaler(init_scale=1024., scale_window=2)
+    s.update_scale(overflow=True)
+    assert s.loss_scale == 512.
+    s.update_scale(False)
+    s.update_scale(False)
+    assert s.loss_scale == 1024.
+
+
+def test_mx_np_forwarding_and_autograd():
+    x = mx.np.array([[1., 2.], [3., 4.]])
+    y = mx.np.exp(x)
+    assert isinstance(y, nd.NDArray)
+    assert np.allclose(y.asnumpy(), np.exp(x.asnumpy()))
+    # tape integration: grad of sum(x**2) = 2x
+    x.attach_grad()
+    with autograd.record():
+        z = mx.np.sum(mx.np.square(x))
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+    # non-array leading args fall back cleanly
+    r = mx.np.arange(5)
+    assert np.allclose(r.asnumpy(), np.arange(5))
+    assert mx.np.pi == np.pi
+
+
+def test_mx_npx_forwarding():
+    x = nd.array(np.array([[-1., 2.]]))
+    out = mx.npx.relu(x)
+    assert np.allclose(out.asnumpy(), [[0., 2.]])
+    sm = mx.npx.softmax(x)
+    assert sm.shape == (1, 2)
+    mx.npx.waitall()

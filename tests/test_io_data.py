@@ -163,3 +163,76 @@ def test_im2rec_tool(tmp_path):
                          batch_size=2)
     b = next(iter(it))
     assert b.data[0].shape == (2, 3, 6, 6)
+
+
+def test_ndarray_iter_roll_over():
+    # 10 samples, bs=4: epoch1 emits 2 full batches, 2 samples roll over;
+    # epoch2's first batch = 2 rolled + 2 new
+    data = np.arange(10, dtype=np.float32).reshape(10, 1)
+    it = mx.io.NDArrayIter(data, batch_size=4, last_batch_handle="roll_over")
+    e1 = [b.data[0].asnumpy() for b in it]
+    assert len(e1) == 2 and all(b.shape == (4, 1) for b in e1)
+    it.reset()
+    e2 = [b.data[0].asnumpy() for b in it]
+    assert len(e2) == 3 and all(b.shape == (4, 1) for b in e2)
+    assert set(e2[0].ravel()) == {8., 9., 0., 1.}
+
+
+def test_prefetching_iter_mid_epoch_reset_and_exhaustion():
+    from incubator_mxnet_tpu.io import PrefetchingIter
+
+    data = np.arange(32, dtype=np.float32).reshape(32, 1)
+    it = PrefetchingIter(mx.io.NDArrayIter(data, batch_size=4))
+    first = it.next()
+    assert first.data[0].shape == (4, 1)
+    it.reset()  # mid-epoch: must not deadlock or duplicate producers
+    batches = list(it)
+    assert len(batches) == 8
+    # exhausted iterator raises StopIteration repeatedly, never blocks
+    with pytest.raises(StopIteration):
+        it.next()
+    with pytest.raises(StopIteration):
+        it.next()
+    it.reset()
+    assert len(list(it)) == 8
+
+
+def test_dataloader_workers_prefetch_zero():
+    ds = ArrayDataset(np.arange(12, dtype=np.float32).reshape(12, 1))
+    loader = DataLoader(ds, batch_size=4, num_workers=2, prefetch=0)
+    assert len(list(loader)) == 3
+
+
+def test_image_record_iter_small_images(tmp_path):
+    # images smaller than data_shape must be upsized, not crash np.stack
+    from incubator_mxnet_tpu.io.recordio import IRHeader, IndexedRecordIO, \
+        pack_img
+
+    path = str(tmp_path / "small.rec")
+    idx = str(tmp_path / "small.idx")
+    w = IndexedRecordIO(idx, path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(4):
+        img = rng.randint(0, 255, (20, 20, 3), np.uint8)
+        w.write_idx(i, pack_img(IRHeader(0, float(i % 2), i, 0), img,
+                                quality=90))
+    w.close()
+    it = ImageRecordIter(path_imgrec=path, path_imgidx=idx,
+                         data_shape=(3, 28, 28), batch_size=2)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (2, 3, 28, 28)
+
+
+def test_recordio_pickle_closed_reader(tmp_path):
+    import pickle
+
+    from incubator_mxnet_tpu.io.recordio import MXRecordIO
+
+    path = str(tmp_path / "p.rec")
+    w = MXRecordIO(path, "w")
+    w.write(b"hello")
+    w.close()
+    r = MXRecordIO(path, "r")
+    r.close()
+    r2 = pickle.loads(pickle.dumps(r))
+    assert r2.read() == b"hello"
