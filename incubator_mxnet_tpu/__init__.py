@@ -49,7 +49,7 @@ _LAZY_SUBMODULES = (
     "gluon", "symbol", "sym", "optimizer", "kvstore", "metric", "io", "image",
     "initializer", "lr_scheduler", "profiler", "amp", "parallel", "models",
     "runtime", "test_utils", "callback", "util", "engine", "recordio",
-    "numpy", "np", "npx",
+    "numpy", "np", "npx", "module", "mod", "model", "executor",
 )
 
 
@@ -61,7 +61,8 @@ def __getattr__(name):
         alias = {"sym": ".symbol", "npx": ".numpy_extension",
                  "numpy": ".numpy_shim", "np": ".numpy_shim",
                  "recordio": ".io.recordio",
-                 "lr_scheduler": ".optimizer.lr_scheduler"}
+                 "lr_scheduler": ".optimizer.lr_scheduler",
+                 "mod": ".module", "executor": ".symbol.executor"}
         modpath = alias.get(name, "." + name)
         mod = importlib.import_module(modpath, __name__)
         globals()[name] = mod

@@ -476,6 +476,19 @@ class HybridBlock(Block):
         return self.hybrid_forward(nd, *args, **kwargs)
 
     def forward(self, *args):
+        # symbolic composition: Symbol inputs build a graph node instead of
+        # executing (the reference's dual NDArray/Symbol hybrid_forward
+        # dispatch in gluon/block.py)
+        from ..symbol.symbol import Symbol as _Sym
+        if any(isinstance(a, _Sym) for a in args):
+            if type(self).hybrid_forward is not HybridBlock.hybrid_forward:
+                kwargs = {name: p.var()
+                          for name, p in self._reg_params.items()}
+                from .. import symbol as _sym_mod
+                return self.hybrid_forward(_sym_mod, *args, **kwargs)
+            # container blocks (HybridSequential etc.) define hybrid_call
+            # only; their children dispatch symbolically in turn
+            return self.hybrid_call(*args)
         if self._active and not in_hybrid_trace():
             # deferred params must be materialized before tracing; do the
             # shape-inference dance eagerly first
@@ -516,22 +529,26 @@ class SymbolBlock(HybridBlock):
         if params is not None:
             for name, p in (params.items() if hasattr(params, "items")
                             else params._params.items()):
-                param = Parameter(name, shape=p.shape, dtype=str(p.dtype))
+                grad_req = getattr(p, "grad_req", "write")
+                param = Parameter(name, shape=p.shape, dtype=str(p.dtype),
+                                  grad_req=grad_req)
                 param.set_data(p if isinstance(p, NDArray) else p.data())
                 self._reg_params[name] = param
                 self._params._params[name] = param
 
     @staticmethod
     def imports(symbol_file, input_names, param_file=None, ctx=None):
-        from ..symbol import load as sym_load
+        from ..symbol import Variable as sym_var, load as sym_load
         from ..ndarray import load as nd_load
         sym = sym_load(symbol_file)
         params = nd_load(param_file) if param_file else {}
-        block = SymbolBlock(sym, [sym.__class__.var(n) if isinstance(n, str)
+        block = SymbolBlock(sym, [sym_var(n) if isinstance(n, str)
                                   else n for n in input_names])
         for name, data in params.items():
             clean = name.split(":", 1)[-1]
-            p = Parameter(clean, shape=data.shape, dtype=str(data.dtype))
+            grad_req = "null" if name.startswith("aux:") else "write"
+            p = Parameter(clean, shape=data.shape, dtype=str(data.dtype),
+                          grad_req=grad_req)
             p.set_data(data)
             block._reg_params[clean] = p
             block._params._params[clean] = p

@@ -192,7 +192,13 @@ class Parameter:
 
     def var(self):
         from ..symbol import Variable
-        return Variable(self.name)
+        attrs = {}
+        if self.grad_req == "null":
+            # non-differentiable state (running stats) → auxiliary variable
+            attrs["__aux__"] = 1
+        return Variable(self.name,
+                        shape=self._shape if self._shape_known() else None,
+                        dtype=str(self.dtype), **attrs)
 
     def shard(self, partition_spec):
         """TPU extension: attach a ``PartitionSpec`` hint consumed by the

@@ -1,0 +1,47 @@
+"""Checkpoint helpers (re-design of `python/mxnet/model.py`
+save_checkpoint/load_checkpoint; file-level citation — SURVEY.md caveat).
+
+Formats mirror the reference (SURVEY.md §5.4): ``<prefix>-symbol.json``
+(graph) + ``<prefix>-NNNN.params`` (name→NDArray dict with ``arg:``/
+``aux:`` key prefixes).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+from .ndarray import NDArray, load as nd_load, save as nd_save
+from .symbol.symbol import Symbol, load as sym_load
+
+__all__ = ["save_checkpoint", "load_checkpoint"]
+
+
+def save_checkpoint(prefix: str, epoch: int, symbol: Symbol,
+                    arg_params: Dict[str, NDArray],
+                    aux_params: Dict[str, NDArray]) -> None:
+    """Parity: ``mx.model.save_checkpoint`` / `callback.do_checkpoint`."""
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    payload = {}
+    payload.update({f"arg:{k}": v for k, v in (arg_params or {}).items()})
+    payload.update({f"aux:{k}": v for k, v in (aux_params or {}).items()})
+    nd_save(f"{prefix}-{epoch:04d}.params", payload)
+
+
+def load_checkpoint(prefix: str, epoch: int
+                    ) -> Tuple[Symbol, Dict[str, NDArray],
+                               Dict[str, NDArray]]:
+    """Parity: ``mx.model.load_checkpoint`` → (symbol, arg_params,
+    aux_params)."""
+    symbol = sym_load(f"{prefix}-symbol.json")
+    payload = nd_load(f"{prefix}-{epoch:04d}.params")
+    arg_params, aux_params = {}, {}
+    for key, val in payload.items():
+        kind, _, name = key.partition(":")
+        if kind == "arg":
+            arg_params[name] = val
+        elif kind == "aux":
+            aux_params[name] = val
+        else:
+            arg_params[key] = val
+    return symbol, arg_params, aux_params

@@ -1,0 +1,6 @@
+"""``mx.mod`` — legacy symbolic trainer API (SURVEY.md §2.2 "Module")."""
+
+from .module import BaseModule, Module
+from .bucketing_module import BucketingModule
+
+__all__ = ["BaseModule", "Module", "BucketingModule"]

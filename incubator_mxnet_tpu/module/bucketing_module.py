@@ -1,0 +1,109 @@
+"""BucketingModule — variable-length sequence training.
+
+Re-design of `python/mxnet/module/bucketing_module.py` (file-level citation
+— SURVEY.md caveat). The reference rebinds a per-bucket symbol with shared
+parameters (NMT buckets, SURVEY.md §5.7). TPU-native translation: each
+bucket is its own XLA compilation (jit cache per shape signature — the
+managed multi-shape cache of SURVEY.md §7.2); parameter arrays are shared
+across bucket executors by reference through ``shared_module``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from ..base import MXNetError
+from .module import BaseModule, Module
+
+__all__ = ["BucketingModule"]
+
+
+class BucketingModule(BaseModule):
+    def __init__(self, sym_gen: Callable, default_bucket_key=None,
+                 context=None, logger=None, **kwargs):
+        super().__init__(logger)
+        if default_bucket_key is None:
+            raise MXNetError("default_bucket_key is required")
+        self._sym_gen = sym_gen
+        self._default_key = default_bucket_key
+        self._context = context
+        self._kwargs = kwargs
+        self._buckets: Dict[object, Module] = {}
+        self._curr: Module = None
+        self._bind_args = None
+
+    def _make_module(self, key) -> Module:
+        sym, data_names, label_names = self._sym_gen(key)
+        return Module(sym, data_names=data_names, label_names=label_names,
+                      context=self._context, logger=self.logger,
+                      **self._kwargs)
+
+    @property
+    def symbol(self):
+        return self._curr.symbol if self._curr else None
+
+    # -- BaseModule interface ----------------------------------------- #
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, grad_req="write",
+             **_):
+        if self.binded and not force_rebind:
+            return
+        self._bind_args = dict(for_training=for_training,
+                               inputs_need_grad=inputs_need_grad,
+                               grad_req=grad_req)
+        master = self._make_module(self._default_key)
+        master.bind(data_shapes, label_shapes, **self._bind_args)
+        self._buckets[self._default_key] = master
+        self._curr = master
+        self.binded = True
+
+    def init_params(self, **kwargs):
+        self._buckets[self._default_key].init_params(**kwargs)
+        self.params_initialized = True
+
+    def init_optimizer(self, **kwargs):
+        self._buckets[self._default_key].init_optimizer(**kwargs)
+        self.optimizer_initialized = True
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        """Select (and lazily compile) the executor for ``bucket_key``."""
+        if bucket_key not in self._buckets:
+            mod = self._make_module(bucket_key)
+            mod.bind(data_shapes, label_shapes,
+                     shared_module=self._buckets[self._default_key],
+                     **self._bind_args)
+            self._buckets[bucket_key] = mod
+        self._curr = self._buckets[bucket_key]
+
+    def forward(self, data_batch, is_train=None):
+        key = getattr(data_batch, "bucket_key", self._default_key)
+        data_shapes = getattr(data_batch, "provide_data", None) or \
+            [(n, a.shape) for n, a in zip(
+                self._buckets[self._default_key]._data_names,
+                data_batch.data)]
+        label_shapes = getattr(data_batch, "provide_label", None)
+        if label_shapes is None and data_batch.label is not None:
+            label_shapes = [(n, a.shape) for n, a in zip(
+                self._buckets[self._default_key]._label_names,
+                data_batch.label)]
+        self.switch_bucket(key, data_shapes, label_shapes)
+        self._curr.forward(data_batch, is_train=is_train)
+
+    def backward(self, out_grads=None):
+        self._curr.backward(out_grads)
+
+    def update(self):
+        self._curr.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr.get_outputs(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels):
+        self._curr.update_metric(eval_metric, labels)
+
+    def get_params(self):
+        return self._buckets[self._default_key].get_params()
+
+    def set_params(self, *args, **kwargs):
+        self._buckets[self._default_key].set_params(*args, **kwargs)
+        self.params_initialized = True

@@ -1,0 +1,389 @@
+"""Module — the legacy symbolic trainer API.
+
+Re-design of `python/mxnet/module/base_module.py` + `module.py` +
+`executor_manager.py` (file-level citations — SURVEY.md caveat; call stack
+§3.3). The reference binds a Symbol per context into a
+`DataParallelExecutorGroup`; here one bound :class:`~..symbol.Executor`
+compiles the whole graph to XLA, and data parallelism is the SPMD mesh
+path (``parallel.SPMDTrainer``) rather than per-context executor groups.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import initializer as _init_mod
+from .. import metric as _metric_mod
+from .. import optimizer as _opt_mod
+from ..base import MXNetError
+from ..context import current_context
+from ..io import DataDesc
+from ..ndarray import NDArray, zeros as nd_zeros
+from ..ndarray.ndarray import _as_jax
+from ..symbol.symbol import Symbol
+
+__all__ = ["BaseModule", "Module"]
+
+
+def _norm_shapes(shapes) -> List[Tuple[str, tuple]]:
+    out = []
+    for s in shapes or []:
+        if isinstance(s, DataDesc):
+            out.append((s.name, tuple(s.shape)))
+        else:
+            name, shape = s[0], s[1]
+            out.append((name, tuple(shape)))
+    return out
+
+
+class BaseModule:
+    """Shared high-level train/eval loop (parity: `BaseModule`)."""
+
+    def __init__(self, logger=None):
+        self.logger = logger or logging.getLogger("incubator_mxnet_tpu")
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+
+    # subclass interface: bind, init_params, forward, backward, update,
+    # get_outputs, update_metric, get_params/set_params
+
+    def forward_backward(self, data_batch):
+        self.forward(data_batch, is_train=True)
+        self.backward()
+
+    def score(self, eval_data, eval_metric, num_batch=None, reset=True,
+              epoch=0, batch_end_callback=None):
+        if not isinstance(eval_metric, _metric_mod.EvalMetric):
+            eval_metric = _metric_mod.create(eval_metric)
+        if reset:
+            eval_data.reset()
+        eval_metric.reset()
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch >= num_batch:
+                break
+            self.forward(batch, is_train=False)
+            self.update_metric(eval_metric, batch.label)
+            if batch_end_callback is not None:
+                batch_end_callback(_BatchEndParam(epoch, nbatch, eval_metric))
+        return eval_metric.get_name_value()
+
+    def predict(self, eval_data, num_batch=None, reset=True):
+        """Concatenated outputs over the iterator (parity:
+        ``BaseModule.predict``)."""
+        import jax.numpy as jnp
+
+        if reset:
+            eval_data.reset()
+        chunks: List[List[NDArray]] = []
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch >= num_batch:
+                break
+            self.forward(batch, is_train=False)
+            outs = self.get_outputs()
+            pad = getattr(batch, "pad", 0) or 0
+            if pad:
+                outs = [NDArray(o._data[:o.shape[0] - pad]) for o in outs]
+            chunks.append(outs)
+        if not chunks:
+            return []
+        cat = [NDArray(jnp.concatenate([c[i]._data for c in chunks]))
+               for i in range(len(chunks[0]))]
+        return cat if len(cat) > 1 else cat[0]
+
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", optimizer="sgd",
+            optimizer_params=None, initializer=None,
+            arg_params=None, aux_params=None, allow_missing=False,
+            force_init=False, begin_epoch=0, num_epoch=None,
+            validation_metric=None):
+        """The canonical epoch loop (parity: ``Module.fit`` — SURVEY.md
+        §3.3)."""
+        if num_epoch is None:
+            raise MXNetError("fit: num_epoch is required")
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label, for_training=True)
+        self.init_params(initializer=initializer, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params)
+        if not isinstance(eval_metric, _metric_mod.EvalMetric):
+            eval_metric = _metric_mod.create(eval_metric)
+        validation_metric = validation_metric or eval_metric
+
+        for epoch in range(begin_epoch, num_epoch):
+            eval_metric.reset()
+            train_data.reset()
+            for nbatch, batch in enumerate(train_data):
+                self.forward_backward(batch)
+                self.update()
+                self.update_metric(eval_metric, batch.label)
+                if batch_end_callback is not None:
+                    for cb in _as_list(batch_end_callback):
+                        cb(_BatchEndParam(epoch, nbatch, eval_metric))
+            for name, val in eval_metric.get_name_value():
+                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            if epoch_end_callback is not None:
+                arg_p, aux_p = self.get_params()
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, self.symbol, arg_p, aux_p)
+            if eval_data is not None:
+                res = self.score(eval_data, validation_metric, epoch=epoch)
+                for name, val in res:
+                    self.logger.info("Epoch[%d] Validation-%s=%f",
+                                     epoch, name, val)
+
+
+class _BatchEndParam:
+    def __init__(self, epoch, nbatch, eval_metric):
+        self.epoch = epoch
+        self.nbatch = nbatch
+        self.eval_metric = eval_metric
+        self.locals = None
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+class Module(BaseModule):
+    """Single-symbol module (parity: ``mx.mod.Module``)."""
+
+    def __init__(self, symbol: Symbol, data_names=("data",),
+                 label_names=("softmax_label",), context=None, logger=None,
+                 **_ignored):
+        super().__init__(logger)
+        self._symbol = symbol
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._context = context or current_context()
+        arg_names = symbol.list_arguments()
+        self._param_names = [n for n in arg_names
+                             if n not in self._data_names
+                             and n not in self._label_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._exec = None
+        self._data_shapes = []
+        self._label_shapes = []
+        self._grad_req = "write"
+        self._inputs_need_grad = False
+        self._optimizer = None
+        self._opt_states: Dict[str, object] = {}
+
+    @property
+    def symbol(self) -> Symbol:
+        return self._symbol
+
+    @property
+    def data_names(self):
+        return list(self._data_names)
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        return [DataDesc(n, s) for n, s in self._data_shapes]
+
+    @property
+    def label_shapes(self):
+        return [DataDesc(n, s) for n, s in self._label_shapes]
+
+    @property
+    def output_shapes(self):
+        shapes = dict(self._data_shapes + self._label_shapes)
+        shapes.update({n: tuple(self._exec.arg_dict[n].shape)
+                       for n in self._param_names})
+        _, out_shapes, _ = self._symbol.infer_shape(**shapes)
+        return list(zip(self.output_names, out_shapes))
+
+    # -- bind --------------------------------------------------------- #
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, grad_req="write",
+             shared_module=None):
+        if self.binded and not force_rebind:
+            return
+        self._data_shapes = _norm_shapes(data_shapes)
+        self._label_shapes = _norm_shapes(label_shapes)
+        self._grad_req = grad_req if for_training else "null"
+        self._inputs_need_grad = inputs_need_grad
+        self._for_training = for_training
+
+        known = dict(self._data_shapes + self._label_shapes)
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**known)
+        if arg_shapes is None:
+            raise MXNetError(
+                "bind: cannot infer parameter shapes from data/label shapes;"
+                f" arguments: {self._symbol.list_arguments()}")
+        arg_names = self._symbol.list_arguments()
+        self._arg_shape = dict(zip(arg_names, arg_shapes))
+        self._aux_shape = dict(zip(self._aux_names, aux_shapes))
+
+        if shared_module is not None and shared_module._exec is not None:
+            # bucketing: share parameter arrays with the master module
+            args = {n: shared_module._exec.arg_dict[n]
+                    for n in self._param_names}
+            aux = dict(shared_module._exec.aux_dict)
+            self._opt_states = shared_module._opt_states
+            self._optimizer = shared_module._optimizer
+            self.params_initialized = shared_module.params_initialized
+            self.optimizer_initialized = shared_module.optimizer_initialized
+        else:
+            args = {n: nd_zeros(self._arg_shape[n])
+                    for n in self._param_names}
+            aux = {n: nd_zeros(self._aux_shape[n]) for n in self._aux_names}
+        for n, s in self._data_shapes + self._label_shapes:
+            args[n] = nd_zeros(s)
+
+        req = {}
+        for n in arg_names:
+            if n in self._param_names:
+                req[n] = self._grad_req
+            elif n in self._data_names and inputs_need_grad and for_training:
+                req[n] = "write"
+            else:
+                req[n] = "null"
+        self._exec = self._symbol.bind(self._context, args=args,
+                                       grad_req=req, aux_states=aux)
+        self.binded = True
+
+    # -- params ------------------------------------------------------- #
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False):
+        if self.params_initialized and not force_init:
+            return
+        if not self.binded:
+            raise MXNetError("init_params: call bind first")
+        initializer = initializer or _init_mod.Uniform(0.01)
+        for name in self._param_names:
+            if arg_params and name in arg_params:
+                self._exec.arg_dict[name] = arg_params[name]
+            else:
+                if arg_params is not None and not allow_missing and \
+                        arg_params != {}:
+                    pass
+                arr = nd_zeros(self._arg_shape[name])
+                initializer(name, arr)
+                self._exec.arg_dict[name] = arr
+        for name in self._aux_names:
+            if aux_params and name in aux_params:
+                self._exec.aux_dict[name] = aux_params[name]
+            else:
+                arr = nd_zeros(self._aux_shape[name])
+                if name.endswith(("moving_var", "running_var")):
+                    arr = arr + 1.0
+                self._exec.aux_dict[name] = arr
+        self.params_initialized = True
+
+    def get_params(self):
+        arg = {n: self._exec.arg_dict[n] for n in self._param_names}
+        aux = dict(self._exec.aux_dict)
+        return arg, aux
+
+    def set_params(self, arg_params, aux_params=None, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        if not self.binded:
+            raise MXNetError("set_params: call bind first")
+        self._exec.copy_params_from(arg_params or {}, aux_params or {},
+                                    allow_extra_params=allow_extra)
+        self.params_initialized = True
+
+    # -- optimizer ---------------------------------------------------- #
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=None, force_init=False):
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, _opt_mod.Optimizer):
+            self._optimizer = optimizer
+        else:
+            self._optimizer = _opt_mod.create(
+                optimizer, **(optimizer_params or {}))
+        self._optimizer.param_idx2name = {
+            i: n for i, n in enumerate(self._param_names)}
+        self._opt_states = {
+            n: self._optimizer.create_state(
+                i, self._exec.arg_dict[n])
+            for i, n in enumerate(self._param_names)}
+        self.optimizer_initialized = True
+
+    # -- execution ---------------------------------------------------- #
+    def forward(self, data_batch, is_train=None):
+        if not self.binded:
+            raise MXNetError("forward: call bind first")
+        if is_train is None:
+            is_train = getattr(self, "_for_training", True)
+        feeds = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feeds[name] = arr if isinstance(arr, NDArray) \
+                else NDArray(_as_jax(arr))
+        if data_batch.label is not None:
+            for name, arr in zip(self._label_names, data_batch.label):
+                feeds[name] = arr if isinstance(arr, NDArray) \
+                    else NDArray(_as_jax(arr))
+        self._exec.forward(is_train=is_train, **feeds)
+
+    def backward(self, out_grads=None):
+        if out_grads is None and len(self._exec.outputs) == 1:
+            import jax.numpy as jnp
+            out_grads = [NDArray(jnp.ones_like(self._exec.outputs[0]._data))]
+        self._exec.backward(out_grads)
+
+    def update(self):
+        if not self.optimizer_initialized:
+            raise MXNetError("update: call init_optimizer first")
+        for i, name in enumerate(self._param_names):
+            grad = self._exec.grad_dict.get(name)
+            if grad is None:
+                continue
+            weight = self._exec.arg_dict[name]
+            self._optimizer.update(i, weight, grad, self._opt_states[name])
+
+    def get_outputs(self, merge_multi_context=True):
+        return list(self._exec.outputs)
+
+    def get_input_grads(self, merge_multi_context=True):
+        if not self._inputs_need_grad:
+            raise MXNetError("bind with inputs_need_grad=True first")
+        return [self._exec.grad_dict[n] for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self.get_outputs())
+
+    # -- checkpointing (parity: python/mxnet/model.py helpers) --------- #
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        from ..model import save_checkpoint
+
+        arg, aux = self.get_params()
+        save_checkpoint(prefix, epoch, self._symbol, arg, aux)
+        if save_optimizer_states:
+            import pickle
+
+            with open(f"{prefix}-{epoch:04d}.states", "wb") as f:
+                pickle.dump({n: _state_np(s)
+                             for n, s in self._opt_states.items()}, f)
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        from ..model import load_checkpoint
+
+        sym, arg, aux = load_checkpoint(prefix, epoch)
+        mod = Module(sym, **kwargs)
+        mod._preloaded = (arg, aux)
+        # params applied at bind time via init_params(arg_params=...)
+        mod._loaded_args = arg
+        mod._loaded_aux = aux
+        return mod
+
+
+def _state_np(state):
+    import jax.tree_util as jtu
+
+    return jtu.tree_map(
+        lambda a: np.asarray(a._data if isinstance(a, NDArray) else a),
+        state)

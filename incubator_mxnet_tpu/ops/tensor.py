@@ -752,3 +752,35 @@ def batch_take(data, indices):
     idxe = idx.reshape(idx.shape + (1,) * extra)
     idxe = jnp.broadcast_to(idxe, idx.shape + data.shape[idx.ndim:])
     return jnp.take_along_axis(data, idxe, axis=1)
+
+
+# ---- scalar arithmetic ops (reference:
+# src/operator/tensor/elemwise_binary_scalar_op_basic.cc) — used by the
+# Symbol front end's operator sugar and surfaced as mx.nd._plus_scalar etc.
+def _scalar_op(name, fn):
+    def op(data, scalar=1.0):
+        return fn(data, scalar)
+
+    op.__doc__ = (f"Elementwise ``{name}`` with a python scalar (reference: "
+                  "elemwise_binary_scalar_op_basic.cc).")
+    register(name)(op)
+
+
+_scalar_op("_plus_scalar", lambda x, s: x + s)
+_scalar_op("_minus_scalar", lambda x, s: x - s)
+_scalar_op("_rminus_scalar", lambda x, s: s - x)
+_scalar_op("_mul_scalar", lambda x, s: x * s)
+_scalar_op("_div_scalar", lambda x, s: x / s)
+_scalar_op("_rdiv_scalar", lambda x, s: s / x)
+_scalar_op("_mod_scalar", lambda x, s: jnp.mod(x, s))
+_scalar_op("_rmod_scalar", lambda x, s: jnp.mod(s, x))
+_scalar_op("_power_scalar", lambda x, s: jnp.power(x, s))
+_scalar_op("_rpower_scalar", lambda x, s: jnp.power(s, x))
+_scalar_op("_maximum_scalar", lambda x, s: jnp.maximum(x, s))
+_scalar_op("_minimum_scalar", lambda x, s: jnp.minimum(x, s))
+_scalar_op("_equal_scalar", lambda x, s: (x == s).astype(x.dtype))
+_scalar_op("_not_equal_scalar", lambda x, s: (x != s).astype(x.dtype))
+_scalar_op("_greater_scalar", lambda x, s: (x > s).astype(x.dtype))
+_scalar_op("_greater_equal_scalar", lambda x, s: (x >= s).astype(x.dtype))
+_scalar_op("_lesser_scalar", lambda x, s: (x < s).astype(x.dtype))
+_scalar_op("_lesser_equal_scalar", lambda x, s: (x <= s).astype(x.dtype))

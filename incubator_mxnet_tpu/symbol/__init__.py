@@ -1,0 +1,163 @@
+"""``mx.sym`` — the symbolic front end.
+
+Op functions are generated from the SAME registry as ``mx.nd`` (one
+registration serves both front ends, the reference's NNVM contract —
+`python/mxnet/symbol/register.py`; file-level citation, SURVEY.md caveat),
+but build graph nodes instead of executing.
+"""
+
+from __future__ import annotations
+
+import inspect
+import sys as _sys
+from typing import Optional
+
+from ..base import MXNetError
+from ..ops import registry as _registry
+from .symbol import (Group, Symbol, Variable, _Node, _auto_name, fromjson,
+                     load, load_json, var)
+from . import executor
+from .executor import Executor
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
+           "fromjson", "Executor", "executor", "save_block_symbol",
+           "trace_block"]
+
+
+def _resolve_num_outputs(spec, attrs) -> int:
+    if spec.num_outputs:
+        return spec.num_outputs
+    # variadic-output ops (split/split_v2): arity from static attrs
+    if "num_outputs" in attrs:
+        return int(attrs["num_outputs"])
+    ios = attrs.get("indices_or_sections")
+    if ios is not None:
+        return len(ios) + 1 if isinstance(ios, (list, tuple)) else int(ios)
+    return 1
+
+
+def _invoke_symbol(op_name: str, *args, name: Optional[str] = None,
+                   **kwargs) -> Symbol:
+    """Compose a graph node (the symbolic twin of imperative_invoke)."""
+    spec = _registry.get(op_name)
+    if spec.wrap_list and len(args) == 1 and isinstance(args[0],
+                                                        (list, tuple)):
+        args = tuple(args[0])
+
+    params = list(inspect.signature(spec.fn).parameters.values())
+    has_varargs = any(p.kind is p.VAR_POSITIONAL for p in params)
+
+    inputs = []   # (node, out_idx) in positional order
+    attrs = {}
+
+    if has_varargs:
+        # concat/stack/add_n: every positional arg is a tensor input
+        for a in args:
+            if not isinstance(a, Symbol):
+                raise MXNetError(
+                    f"{op_name}: variadic inputs must all be Symbols")
+            inputs.append(a._heads[0])
+        attrs.update({k: v for k, v in kwargs.items()
+                      if not isinstance(v, Symbol)})
+    else:
+        # walk declared parameters in order: the LEADING run of
+        # Symbol-valued params are graph inputs (ops declare tensors
+        # first, the reference's convention); everything after the first
+        # gap/non-Symbol is a static attribute
+        values = {}
+        for i, a in enumerate(args):
+            if i >= len(params):
+                raise MXNetError(f"{op_name}: too many positional args")
+            values[params[i].name] = a
+        values.update(kwargs)
+        collecting = True
+        for p in params:
+            if p.kind is inspect.Parameter.VAR_KEYWORD:
+                continue
+            if p.name not in values:
+                collecting = False  # missing slot ends the tensor prefix
+                continue
+            v = values.pop(p.name)
+            if isinstance(v, Symbol):
+                if not collecting:
+                    raise MXNetError(
+                        f"{op_name}: tensor argument {p.name!r} follows a "
+                        "non-tensor gap — pass earlier tensor args too")
+                inputs.append(v._heads[0])
+            elif v is None and collecting:
+                # explicit None for an optional tensor slot (e.g. bias)
+                collecting = False
+            else:
+                attrs[p.name] = v
+                collecting = False
+        leftover_syms = [k for k, v in values.items()
+                         if isinstance(v, Symbol)]
+        if leftover_syms:
+            raise MXNetError(
+                f"{op_name}: unexpected Symbol kwargs {leftover_syms}")
+        attrs.update(values)
+
+    node = _Node(op_name, name or _auto_name(op_name), inputs, attrs)
+    n_out = _resolve_num_outputs(spec, attrs)
+    return Symbol([(node, i) for i in range(n_out)])
+
+
+def _make_symbol_function(op_name: str, public_name: str):
+    def sym_function(*args, **kwargs):
+        return _invoke_symbol(op_name, *args, **kwargs)
+
+    sym_function.__name__ = public_name
+    sym_function.__qualname__ = public_name
+    sym_function.__doc__ = _registry.describe_op(op_name)
+    return sym_function
+
+
+_THIS = _sys.modules[__name__]
+for _name in _registry.list_all_names():
+    if not hasattr(_THIS, _name):
+        _spec = _registry.get(_name)
+        setattr(_THIS, _name, _make_symbol_function(_spec.name, _name))
+
+
+# ------------------------------------------------------------------ #
+# Gluon bridge: HybridBlock → Symbol (the reference's hybridize/export
+# trace — `gluon/block.py` _build_cache + `HybridBlock.export`)
+# ------------------------------------------------------------------ #
+def trace_block(block, num_inputs: int = 1):
+    """Trace an initialized HybridBlock symbolically.
+
+    Returns (symbol, input_names). Parameters appear as variables named by
+    their full prefixed name; non-differentiable params (running stats)
+    are marked auxiliary.
+    """
+    from .. import autograd
+
+    input_names = ["data"] if num_inputs == 1 else \
+        [f"data{i}" for i in range(num_inputs)]
+    sym_inputs = [Variable(n) for n in input_names]
+    with autograd._ModeScope(recording=False, training=False):
+        out = block(*sym_inputs)
+    if isinstance(out, (list, tuple)):
+        out = Group(list(out))
+    return out, input_names
+
+
+def save_block_symbol(block, path: str, epoch: int = 0,
+                      num_inputs: int = 1) -> None:
+    """HybridBlock.export backend: write ``<path>-symbol.json`` +
+    ``<path>-NNNN.params`` with the reference's ``arg:``/``aux:`` key
+    prefixes (`src/ndarray/ndarray.cc` Save format, SURVEY.md §5.4)."""
+    from ..ndarray import save as nd_save
+
+    sym, _ = trace_block(block, num_inputs)
+    sym.save(f"{path}-symbol.json")
+    arg_names = set(sym.list_arguments())
+    aux_names = set(sym.list_auxiliary_states())
+    payload = {}
+    for name, p in block._collect_params_with_prefix().items():
+        full = p.name
+        if full in aux_names:
+            payload["aux:" + full] = p.data()
+        elif full in arg_names:
+            payload["arg:" + full] = p.data()
+    nd_save(f"{path}-{epoch:04d}.params", payload)

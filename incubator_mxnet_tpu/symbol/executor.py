@@ -1,0 +1,484 @@
+"""Graph execution for the Symbol front end.
+
+Re-design of the legacy symbolic executor
+(`src/executor/graph_executor.{h,cc}`, `attach_op_execs_pass.cc`,
+`src/c_api/c_api_executor.cc`; file-level citations — SURVEY.md caveat).
+
+The reference's `GraphExecutor::Bind` runs NNVM passes (InferShape →
+InferType → Gradient → PlanMemory) and pushes per-node closures to the
+dependency engine. Here:
+
+  - shape/type inference  → ``jax.eval_shape`` over the graph interpreter;
+  - Gradient pass         → ``jax.vjp`` of the whole interpreted program;
+  - PlanMemory + bulking  → XLA buffer assignment + fusion under ``jit``;
+  - topo dispatch         → one compiled XLA program per (shapes, is_train)
+    signature, the CachedOp contract applied to the symbolic path.
+
+``evaluate`` is the *imperative* interpreter: it walks the DAG through
+``imperative_invoke`` so autograd records tape nodes — this is what
+``SymbolBlock``/`sym.eval` use inside Gluon. ``Executor`` is the *compiled*
+path used by `Module`/`simple_bind`.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .. import random as _random
+from ..base import MXNetError
+from ..context import Context, current_context
+from ..ndarray import NDArray
+from ..ndarray.ndarray import _as_jax, _to_jnp_dtype
+from ..ndarray.register import imperative_invoke
+from ..ops import registry as _registry
+from .symbol import Symbol, _topo
+
+__all__ = ["evaluate", "Executor", "infer_shapes", "infer_types"]
+
+
+def _node_kwargs(node) -> dict:
+    return {k: v for k, v in node.attrs.items() if not k.startswith("__")}
+
+
+def evaluate(sym: Symbol, bindings: Dict[str, NDArray], training=None):
+    """Interpret the graph imperatively over NDArrays (tape-recording).
+
+    Multi-output symbols return a list; single output returns one NDArray.
+    """
+    nodes = _topo(sym._heads)
+    vals: Dict[int, tuple] = {}
+    for node in nodes:
+        if node.is_variable:
+            if node.name not in bindings:
+                raise MXNetError(
+                    f"symbol input {node.name!r} is not bound; provided: "
+                    f"{sorted(bindings)}")
+            v = bindings[node.name]
+            vals[id(node)] = (v if isinstance(v, NDArray) else NDArray(
+                _as_jax(v)),)
+        else:
+            spec = _registry.get(node.op)
+            ins = [vals[id(src)][idx] for src, idx in node.inputs]
+            kwargs = _node_kwargs(node)
+            if spec.training_aware and training is not None:
+                kwargs.setdefault("training", training)
+            out = imperative_invoke(spec, *ins, **kwargs)
+            vals[id(node)] = tuple(out) if isinstance(out, (list, tuple)) \
+                else (out,)
+    outs = [vals[id(n)][i] for n, i in sym._heads]
+    return outs if len(outs) > 1 else outs[0]
+
+
+def _interpret_pure(sym: Symbol, input_vals: Dict[str, jax.Array],
+                    training: bool, key: Optional[jax.Array]):
+    """Pure jnp interpreter (jit-traceable). Returns (head values,
+    {aux_name: updated value}) — aux updates implement the reference's
+    in-place running-stat mutation functionally (BatchNorm contract)."""
+    nodes = _topo(sym._heads)
+    vals: Dict[int, tuple] = {}
+    aux_updates: Dict[str, jax.Array] = {}
+    key_idx = 0
+    for node in nodes:
+        if node.is_variable:
+            vals[id(node)] = (input_vals[node.name],)
+            continue
+        spec = _registry.get(node.op)
+        ins = [vals[id(src)][idx] for src, idx in node.inputs]
+        kwargs = _node_kwargs(node)
+        if spec.training_aware:
+            kwargs.setdefault("training", training)
+        if spec.needs_key:
+            if key is None:
+                raise MXNetError(
+                    f"stochastic op {node.op} requires a key")
+            kwargs["key"] = jax.random.fold_in(key, key_idx)
+            key_idx += 1
+        out = spec.fn(*ins, **kwargs)
+        out = tuple(out) if isinstance(out, (list, tuple)) else (out,)
+        vals[id(node)] = out
+        # BatchNorm training: fold batch stats into the aux running stats
+        # (reference: aux-state mutation inside batch_norm.cc)
+        if node.op == "BatchNorm" and training:
+            mm_node, _ = node.inputs[3]
+            mv_node, _ = node.inputs[4]
+            momentum = float(node.attrs.get("momentum", 0.9))
+            if mm_node.is_variable and mm_node.attrs.get("__aux__"):
+                aux_updates[mm_node.name] = (
+                    momentum * vals[id(mm_node)][0]
+                    + (1 - momentum) * out[1])
+            if mv_node.is_variable and mv_node.attrs.get("__aux__"):
+                aux_updates[mv_node.name] = (
+                    momentum * vals[id(mv_node)][0]
+                    + (1 - momentum) * out[2])
+    heads = [vals[id(n)][i] for n, i in sym._heads]
+    return heads, aux_updates
+
+
+def _graph_needs_key(sym: Symbol) -> bool:
+    return any(not n.is_variable and _registry.get(n.op).needs_key
+               for n in _topo(sym._heads))
+
+
+def _placeholder(node, known: Dict[str, tuple], dtypes: Dict[str, str]):
+    shape = known.get(node.name, node.attrs.get("__shape__"))
+    if shape is None:
+        raise MXNetError(f"shape of input {node.name!r} unknown")
+    dtype = dtypes.get(node.name, node.attrs.get("__dtype__", "float32"))
+    return jax.ShapeDtypeStruct(tuple(shape), _to_jnp_dtype(dtype))
+
+
+def _prod(xs):
+    out = 1
+    for x in xs:
+        out *= x
+    return out
+
+
+# Parameter-shape rules for parametric ops: given the DATA input shape and
+# node attrs, return {input_position: shape} for the op's parameter slots.
+# This is the inverse-inference half of the reference's per-op FInferShape
+# functions (SURVEY.md §2.1 NNVM passes) — the forward half is XLA abstract
+# evaluation.
+def _rule_fc(din, attrs):
+    nh = int(attrs["num_hidden"])
+    flatten = attrs.get("flatten", True)
+    in_units = _prod(din[1:]) if flatten else din[-1]
+    return {1: (nh, in_units), 2: (nh,)}
+
+
+def _rule_conv(din, attrs):
+    kernel = tuple(attrs["kernel"])
+    nf = int(attrs["num_filter"])
+    ng = int(attrs.get("num_group", 1))
+    return {1: (nf, din[1] // ng) + kernel, 2: (nf,)}
+
+
+def _rule_deconv(din, attrs):
+    kernel = tuple(attrs["kernel"])
+    nf = int(attrs["num_filter"])
+    ng = int(attrs.get("num_group", 1))
+    return {1: (din[1], nf // ng) + kernel, 2: (nf,)}
+
+
+def _rule_bn(din, attrs):
+    ax = int(attrs.get("axis", 1)) % len(din)
+    c = (din[ax],)
+    return {1: c, 2: c, 3: c, 4: c}
+
+
+def _rule_ln(din, attrs):
+    ax = int(attrs.get("axis", -1)) % len(din)
+    c = (din[ax],)
+    return {1: c, 2: c}
+
+
+def _rule_embedding(din, attrs):
+    return {1: (int(attrs["input_dim"]), int(attrs["output_dim"]))}
+
+
+_PARAM_SHAPE_RULES = {
+    "FullyConnected": _rule_fc,
+    "Convolution": _rule_conv,
+    "Deconvolution": _rule_deconv,
+    "BatchNorm": _rule_bn,
+    "LayerNorm": _rule_ln,
+    "InstanceNorm": _rule_ln,
+    "Embedding": _rule_embedding,
+}
+
+
+def _node_eval_shape(node, in_structs):
+    spec = _registry.get(node.op)
+    kwargs = _node_kwargs(node)
+    if spec.training_aware:
+        kwargs["training"] = False
+
+    if spec.needs_key:
+        key_struct = jax.ShapeDtypeStruct((2,), jnp.uint32)
+
+        def f(key, *arrs):
+            return spec.fn(*arrs, key=key, **kwargs)
+
+        out = jax.eval_shape(f, key_struct, *in_structs)
+    else:
+        out = jax.eval_shape(lambda *arrs: spec.fn(*arrs, **kwargs),
+                             *in_structs)
+    return tuple(out) if isinstance(out, (tuple, list)) else (out,)
+
+
+def _propagate(sym: Symbol, known: Dict[str, tuple],
+               dtypes: Optional[Dict[str, str]] = None):
+    """Fixpoint partial shape/type propagation over the DAG (the reference's
+    NNVM `InferShape`/`InferType` passes). Returns
+    ({var_name: ShapeDtypeStruct}, [head structs]) or raises MXNetError
+    listing the under-determined variables."""
+    dtypes = dtypes or {}
+    nodes = _topo(sym._heads)
+    var_shape: Dict[str, tuple] = {k: tuple(v) for k, v in known.items()}
+    structs: Dict[tuple, jax.ShapeDtypeStruct] = {}
+
+    def var_struct(node):
+        s = var_shape.get(node.name, node.attrs.get("__shape__"))
+        if s is None:
+            return None
+        dt = dtypes.get(node.name, node.attrs.get("__dtype__", "float32"))
+        return jax.ShapeDtypeStruct(tuple(s), _to_jnp_dtype(dt))
+
+    changed = True
+    while changed:
+        changed = False
+        for node in nodes:
+            if (id(node), 0) in structs:
+                continue
+            if node.is_variable:
+                st = var_struct(node)
+                if st is not None:
+                    structs[(id(node), 0)] = st
+                    changed = True
+                continue
+            in_keys = [(id(src), i) for src, i in node.inputs]
+            if all(k in structs for k in in_keys):
+                outs = _node_eval_shape(node,
+                                        [structs[k] for k in in_keys])
+                for i, o in enumerate(outs):
+                    structs[(id(node), i)] = o
+                changed = True
+                continue
+            # inverse inference: fill unknown parameter variables from the
+            # (known) data input
+            rule = _PARAM_SHAPE_RULES.get(node.op)
+            if rule and node.inputs and \
+                    (id(node.inputs[0][0]), node.inputs[0][1]) in structs:
+                din = structs[(id(node.inputs[0][0]),
+                               node.inputs[0][1])].shape
+                for pos, shape in rule(din, node.attrs).items():
+                    if pos >= len(node.inputs):
+                        continue
+                    src, _ = node.inputs[pos]
+                    if src.is_variable and src.name not in var_shape \
+                            and not src.attrs.get("__shape__"):
+                        var_shape[src.name] = tuple(shape)
+                        changed = True
+
+    missing = [n.name for n in nodes
+               if n.is_variable and (id(n), 0) not in structs]
+    if missing:
+        raise MXNetError(f"shape inference under-determined for {missing}")
+    var_structs = {n.name: structs[(id(n), 0)]
+                   for n in nodes if n.is_variable}
+    head_structs = [structs[(id(n), i)] for n, i in sym._heads]
+    return var_structs, head_structs
+
+
+def infer_shapes(sym: Symbol, known: Dict[str, tuple],
+                 dtypes: Optional[Dict[str, str]] = None) -> dict:
+    """Partial-input shape inference (the reference's `InferShape` pass)."""
+    var_structs, heads = _propagate(sym, known, dtypes)
+    return {"args": {n: tuple(s.shape) for n, s in var_structs.items()},
+            "outs": [tuple(o.shape) for o in heads]}
+
+
+def infer_types(sym: Symbol, known: Dict[str, str]) -> dict:
+    var_nodes = [n for n in _topo(sym._heads) if n.is_variable]
+    shapes = {n.name: tuple(n.attrs.get("__shape__") or (1,))
+              for n in var_nodes}
+    var_structs, heads = _propagate(sym, shapes, dtypes=known)
+    return {"args": {n: str(s.dtype) for n, s in var_structs.items()},
+            "outs": [str(o.dtype) for o in heads]}
+
+
+def _as_req_map(grad_req, arg_names: Sequence[str]) -> Dict[str, str]:
+    if isinstance(grad_req, str):
+        return {n: grad_req for n in arg_names}
+    if isinstance(grad_req, (list, tuple)):
+        return dict(zip(arg_names, grad_req))
+    if isinstance(grad_req, dict):
+        return {n: grad_req.get(n, "null") for n in arg_names}
+    raise MXNetError(f"bad grad_req {grad_req!r}")
+
+
+class Executor:
+    """Bound symbolic program (parity: ``mx.executor.Executor``).
+
+    One jitted XLA program per (is_train) mode; recompiles transparently on
+    shape change (the CachedOp per-signature contract, SURVEY.md §7.2).
+    """
+
+    def __init__(self, symbol: Symbol, ctx=None, args=None, args_grad=None,
+                 grad_req="write", aux_states=None):
+        self._symbol = symbol
+        self._ctx = ctx or current_context()
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+
+        self.arg_dict: Dict[str, NDArray] = self._to_dict(
+            args, self._arg_names, "args")
+        self.aux_dict: Dict[str, NDArray] = self._to_dict(
+            aux_states, self._aux_names, "aux_states")
+        self._req = _as_req_map(grad_req, self._arg_names)
+        if args_grad is None:
+            args_grad = {n: NDArray(jnp.zeros_like(self.arg_dict[n]._data))
+                         for n in self._arg_names
+                         if self._req.get(n, "null") != "null"}
+        self.grad_dict: Dict[str, NDArray] = self._to_dict(
+            args_grad, [n for n in self._arg_names
+                        if self._req.get(n, "null") != "null"], "args_grad")
+
+        self.outputs: List[NDArray] = []
+        self._vjp = None
+        self._jit_cache: Dict[bool, any] = {}
+
+    @staticmethod
+    def _to_dict(vals, names, what) -> Dict[str, NDArray]:
+        if vals is None:
+            return {}
+        if isinstance(vals, dict):
+            return {k: v if isinstance(v, NDArray) else NDArray(_as_jax(v))
+                    for k, v in vals.items()}
+        if isinstance(vals, (list, tuple)):
+            if len(vals) != len(names):
+                raise MXNetError(
+                    f"{what}: expected {len(names)} entries ({names}), "
+                    f"got {len(vals)}")
+            return {n: v if isinstance(v, NDArray) else NDArray(_as_jax(v))
+                    for n, v in zip(names, vals)}
+        raise MXNetError(f"{what} must be list or dict")
+
+    @classmethod
+    def simple_bind(cls, symbol: Symbol, ctx=None, grad_req="write",
+                    **shapes):
+        """Allocate argument/gradient buffers from inferred shapes
+        (parity: ``sym.simple_bind``)."""
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**shapes)
+        if arg_shapes is None:
+            raise MXNetError(
+                "simple_bind: could not infer all shapes; provide shapes "
+                f"for {symbol.list_arguments()}")
+        args = [NDArray(jnp.zeros(s, jnp.float32)) for s in arg_shapes]
+        aux = [NDArray(jnp.zeros(s, jnp.float32)) for s in aux_shapes]
+        return cls(symbol, ctx, args, None, grad_req, aux)
+
+    # -- execution ---------------------------------------------------- #
+    def _compiled(self, is_train: bool):
+        if is_train not in self._jit_cache:
+            sym = self._symbol
+
+            def fn(arg_vals, aux_vals, key):
+                vals = dict(arg_vals)
+                vals.update(aux_vals)
+                heads, aux_up = _interpret_pure(
+                    sym, vals, training=is_train, key=key)
+                return tuple(heads), aux_up
+
+            self._jit_cache[is_train] = jax.jit(fn)
+        return self._jit_cache[is_train]
+
+    def forward(self, is_train: bool = False, **kwargs):
+        """Run the compiled program (parity: ``Executor.forward``). Under
+        ``is_train=True`` the vjp closure is stashed for ``backward``."""
+        for name, val in kwargs.items():
+            arr = val if isinstance(val, NDArray) else NDArray(_as_jax(val))
+            if name in self.arg_dict or name not in self.aux_dict:
+                self.arg_dict[name] = arr
+            else:
+                self.aux_dict[name] = arr
+        missing = [n for n in self._arg_names if n not in self.arg_dict]
+        if missing:
+            raise MXNetError(f"executor: unbound arguments {missing}")
+
+        arg_vals = {n: self.arg_dict[n]._data for n in self._arg_names}
+        aux_vals = {n: self.aux_dict[n]._data for n in self._aux_names}
+        key = _random.new_key() if _graph_needs_key(self._symbol) else None
+
+        if is_train:
+            diff_names = [n for n in self._arg_names
+                          if self._req.get(n, "null") != "null"]
+            const_vals = {n: arg_vals[n] for n in self._arg_names
+                          if n not in diff_names}
+            sym = self._symbol
+
+            def diff_fn(dvals):
+                vals = dict(const_vals)
+                vals.update(dvals)
+                vals.update(aux_vals)
+                heads, aux_up = _interpret_pure(sym, vals, training=True,
+                                                key=key)
+                return tuple(heads), aux_up
+
+            heads, vjp, aux_up = jax.vjp(
+                diff_fn, {n: arg_vals[n] for n in diff_names},
+                has_aux=True)
+            self._vjp = vjp
+        else:
+            heads, aux_up = self._compiled(False)(arg_vals, aux_vals, key)
+            self._vjp = None
+
+        for name, val in aux_up.items():
+            self.aux_dict[name] = NDArray(val)
+        self.outputs = [NDArray(h) for h in heads]
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        """Accumulate argument gradients per grad_req (parity:
+        ``Executor.backward``; `kAddTo` semantics under grad_req='add')."""
+        if self._vjp is None:
+            raise MXNetError("backward called before forward(is_train=True)")
+        if out_grads is None:
+            if len(self.outputs) != 1:
+                raise MXNetError("multi-output executor needs explicit "
+                                 "out_grads")
+            heads = (jnp.ones_like(self.outputs[0]._data),)
+        else:
+            if isinstance(out_grads, (NDArray, jax.Array)):
+                out_grads = [out_grads]
+            heads = tuple(g._data if isinstance(g, NDArray) else _as_jax(g)
+                          for g in out_grads)
+        grads = self._vjp(heads)[0]
+        for name, g in grads.items():
+            req = self._req.get(name, "null")
+            if req == "null":
+                continue
+            if req == "add" and name in self.grad_dict:
+                self.grad_dict[name] = NDArray(
+                    self.grad_dict[name]._data + g)
+            else:
+                self.grad_dict[name] = NDArray(g)
+        return self.grad_dict
+
+    # -- parity accessors --------------------------------------------- #
+    @property
+    def arg_arrays(self) -> List[NDArray]:
+        return [self.arg_dict[n] for n in self._arg_names]
+
+    @property
+    def grad_arrays(self) -> List[Optional[NDArray]]:
+        return [self.grad_dict.get(n) for n in self._arg_names]
+
+    @property
+    def aux_arrays(self) -> List[NDArray]:
+        return [self.aux_dict[n] for n in self._aux_names]
+
+    def copy_params_from(self, arg_params: Dict[str, NDArray],
+                         aux_params: Optional[Dict[str, NDArray]] = None,
+                         allow_extra_params: bool = False):
+        for name, val in arg_params.items():
+            if name in self._arg_names:
+                self.arg_dict[name] = val if isinstance(val, NDArray) \
+                    else NDArray(_as_jax(val))
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown argument {name!r}")
+        for name, val in (aux_params or {}).items():
+            if name in self._aux_names:
+                self.aux_dict[name] = val if isinstance(val, NDArray) \
+                    else NDArray(_as_jax(val))
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown aux state {name!r}")
+
+    def reshape(self, **shapes):
+        """Rebind with new shapes (parity: ``Executor.reshape``) — XLA
+        recompiles per signature, so only buffers need reallocating."""
+        return Executor.simple_bind(self._symbol, self._ctx,
+                                    grad_req=self._req, **shapes)

@@ -1,0 +1,372 @@
+"""Symbolic graph front end.
+
+Re-design of `python/mxnet/symbol/symbol.py` + the NNVM graph IR
+(`3rdparty/tvm/nnvm/include/nnvm/graph.h`, JSON pass
+`saveload_json.cc`; file-level citations — SURVEY.md caveat).
+
+The reference's Symbol is a handle to an NNVM node DAG shared with the C++
+executor. Here a Symbol is a lightweight Python DAG over the SAME op
+registry the imperative front end uses (SURVEY.md §1 pillar b: one
+registration serves both front ends); execution compiles the DAG into one
+jitted XLA program (`executor.py`) instead of walking an engine queue.
+
+Graph JSON keeps the NNVM shape (`nodes`/`arg_nodes`/`heads`) so saved
+models are inspectable with the same tooling conventions, but attribute
+values are stored as native JSON values rather than strings.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..base import MXNetError
+from ..ops import registry as _registry
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
+           "fromjson"]
+
+_counter_lock = threading.Lock()
+_name_counters: Dict[str, int] = {}
+
+
+def _auto_name(op: str) -> str:
+    with _counter_lock:
+        idx = _name_counters.get(op, 0)
+        _name_counters[op] = idx + 1
+    return f"{op.lower()}{idx}"
+
+
+class _Node:
+    """One graph node: a variable (``op='null'``) or an op application."""
+
+    __slots__ = ("op", "name", "inputs", "attrs")
+
+    def __init__(self, op: str, name: str,
+                 inputs: Sequence[Tuple["_Node", int]] = (),
+                 attrs: Optional[dict] = None):
+        self.op = op
+        self.name = name
+        self.inputs = list(inputs)
+        self.attrs = dict(attrs or {})
+
+    @property
+    def is_variable(self) -> bool:
+        return self.op == "null"
+
+    def num_outputs(self) -> int:
+        if self.is_variable:
+            return 1
+        spec = _registry.get(self.op)
+        if spec.num_outputs:
+            return spec.num_outputs
+        # variadic-output ops (split/split_v2): arity from static attrs
+        if "num_outputs" in self.attrs:
+            return int(self.attrs["num_outputs"])
+        ios = self.attrs.get("indices_or_sections")
+        if ios is not None:
+            return len(ios) + 1 if isinstance(ios, (list, tuple)) \
+                else int(ios)
+        return 1
+
+
+def _topo(heads: Sequence[Tuple[_Node, int]]) -> List[_Node]:
+    """Deterministic post-order topological sort of the DAG."""
+    seen: Dict[int, bool] = {}
+    order: List[_Node] = []
+
+    def visit(node: _Node):
+        if id(node) in seen:
+            return
+        seen[id(node)] = True
+        for src, _ in node.inputs:
+            visit(src)
+        order.append(node)
+
+    for node, _ in heads:
+        visit(node)
+    return order
+
+
+class Symbol:
+    """A symbolic multi-output expression (parity: ``mx.sym.Symbol``).
+
+    Internally: a list of ``(node, output_index)`` heads. A single-op
+    symbol has one head per op output; ``Group`` concatenates heads.
+    """
+
+    def __init__(self, heads: Sequence[Tuple[_Node, int]]):
+        self._heads: List[Tuple[_Node, int]] = list(heads)
+
+    # -- identity ---------------------------------------------------- #
+    @property
+    def name(self) -> str:
+        if len(self._heads) == 1:
+            return self._heads[0][0].name
+        return "grouped"
+
+    @property
+    def _node(self) -> _Node:
+        return self._heads[0][0]
+
+    def attr(self, key: str):
+        return self._node.attrs.get(key)
+
+    def list_attr(self) -> dict:
+        return dict(self._node.attrs)
+
+    def _set_attr(self, **kwargs):
+        self._node.attrs.update(kwargs)
+
+    # -- composition -------------------------------------------------- #
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            try:
+                index = names.index(index)
+            except ValueError:
+                raise MXNetError(f"no output named {index!r} in {names}")
+        outs = self._all_outputs()
+        return Symbol([outs[index]])
+
+    def _all_outputs(self) -> List[Tuple[_Node, int]]:
+        """Expand heads so each (node, idx) output appears individually."""
+        outs = []
+        for node, idx in self._heads:
+            outs.append((node, idx))
+        return outs
+
+    def __len__(self):
+        return len(self._heads)
+
+    def __iter__(self):
+        for i in range(len(self._heads)):
+            yield self[i]
+
+    def get_internals(self) -> "Symbol":
+        """Every intermediate output as a group (parity:
+        ``sym.get_internals()`` — used to truncate pretrained nets)."""
+        heads = []
+        for node in _topo(self._heads):
+            for k in range(node.num_outputs()):
+                heads.append((node, k))
+        return Symbol(heads)
+
+    def get_children(self) -> Optional["Symbol"]:
+        if self._node.is_variable:
+            return None
+        return Symbol(list(self._node.inputs))
+
+    # -- introspection ------------------------------------------------ #
+    def list_arguments(self) -> List[str]:
+        """Input variable names in topological order (aux excluded),
+        parity: ``sym.list_arguments()``."""
+        return [n.name for n in _topo(self._heads)
+                if n.is_variable and not n.attrs.get("__aux__")]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return [n.name for n in _topo(self._heads)
+                if n.is_variable and n.attrs.get("__aux__")]
+
+    def list_inputs(self) -> List[str]:
+        return [n.name for n in _topo(self._heads) if n.is_variable]
+
+    def list_outputs(self) -> List[str]:
+        names = []
+        for node, idx in self._heads:
+            if node.num_outputs() > 1:
+                names.append(f"{node.name}_output{idx}")
+            else:
+                names.append(f"{node.name}_output")
+        return names
+
+    @property
+    def num_outputs(self) -> int:
+        return len(self._heads)
+
+    # -- shape/type inference ----------------------------------------- #
+    def infer_shape(self, *args, **kwargs):
+        """Infer argument/output/aux shapes from partial inputs via XLA
+        abstract evaluation (parity: ``sym.infer_shape`` — reference runs
+        the NNVM `InferShape` pass)."""
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        known: Dict[str, tuple] = {}
+        if args:
+            for name, shape in zip(arg_names, args):
+                if shape is not None:
+                    known[name] = tuple(shape)
+        known.update({k: tuple(v) for k, v in kwargs.items()})
+        from . import executor as _exec
+
+        try:
+            shapes = _exec.infer_shapes(self, known)
+        except MXNetError:
+            # under-determined partial inference → (None, None, None),
+            # matching the reference's contract
+            return None, None, None
+        return ([shapes["args"][n] for n in arg_names],
+                shapes["outs"],
+                [shapes["args"][n] for n in aux_names])
+
+    def infer_shape_partial(self, *args, **kwargs):
+        try:
+            return self.infer_shape(*args, **kwargs)
+        except Exception:
+            return None, None, None
+
+    def infer_type(self, *args, **kwargs):
+        arg_names = self.list_arguments()
+        known = dict(zip(arg_names, args))
+        known.update(kwargs)
+        if any(n not in known for n in arg_names):
+            return None, None, None
+        from . import executor as _exec
+
+        dtypes = _exec.infer_types(
+            self, {k: v for k, v in known.items() if v is not None})
+        return ([dtypes["args"][n] for n in arg_names], dtypes["outs"],
+                [dtypes["args"][n] for n in self.list_auxiliary_states()])
+
+    # -- execution ---------------------------------------------------- #
+    def eval(self, ctx=None, **kwargs):
+        """Imperative evaluation with NDArray bindings (parity:
+        ``sym.eval``). Returns a list of NDArrays."""
+        from . import executor as _exec
+
+        out = _exec.evaluate(self, kwargs, training=False)
+        return out if isinstance(out, list) else [out]
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, **kwargs):
+        from .executor import Executor
+
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+
+    def simple_bind(self, ctx=None, grad_req="write", **shapes):
+        from .executor import Executor
+
+        return Executor.simple_bind(self, ctx, grad_req, **shapes)
+
+    # -- serialization ------------------------------------------------ #
+    def tojson(self) -> str:
+        nodes = _topo(self._heads)
+        node_id = {id(n): i for i, n in enumerate(nodes)}
+        out_nodes = []
+        for n in nodes:
+            out_nodes.append({
+                "op": n.op,
+                "name": n.name,
+                "attrs": n.attrs,
+                "inputs": [[node_id[id(src)], idx, 0]
+                           for src, idx in n.inputs],
+            })
+        payload = {
+            "nodes": out_nodes,
+            "arg_nodes": [i for i, n in enumerate(nodes) if n.is_variable],
+            "node_row_ptr": list(range(len(nodes) + 1)),
+            "heads": [[node_id[id(n)], idx, 0] for n, idx in self._heads],
+            "attrs": {"framework": "incubator_mxnet_tpu",
+                      "format_version": 1},
+        }
+        return json.dumps(payload, indent=2)
+
+    def save(self, fname: str):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- operator sugar ----------------------------------------------- #
+    _SCALAR_OPS = {
+        "broadcast_add": ("_plus_scalar", "_plus_scalar"),
+        "broadcast_sub": ("_minus_scalar", "_rminus_scalar"),
+        "broadcast_mul": ("_mul_scalar", "_mul_scalar"),
+        "broadcast_div": ("_div_scalar", "_rdiv_scalar"),
+        "broadcast_power": ("_power_scalar", "_rpower_scalar"),
+    }
+
+    def _binop(self, op_name, other, reverse=False):
+        from . import _invoke_symbol
+
+        if isinstance(other, Symbol):
+            a, b = (other, self) if reverse else (self, other)
+            return _invoke_symbol(op_name, a, b)
+        fwd, rev = self._SCALAR_OPS[op_name]
+        return _invoke_symbol(rev if reverse else fwd, self,
+                              scalar=float(other))
+
+    def __add__(self, o):
+        return self._binop("broadcast_add", o)
+
+    def __radd__(self, o):
+        return self._binop("broadcast_add", o)
+
+    def __sub__(self, o):
+        return self._binop("broadcast_sub", o)
+
+    def __rsub__(self, o):
+        return self._binop("broadcast_sub", o, reverse=True)
+
+    def __mul__(self, o):
+        return self._binop("broadcast_mul", o)
+
+    def __rmul__(self, o):
+        return self._binop("broadcast_mul", o)
+
+    def __truediv__(self, o):
+        return self._binop("broadcast_div", o)
+
+    def __rtruediv__(self, o):
+        return self._binop("broadcast_div", o, reverse=True)
+
+    def __pow__(self, o):
+        return self._binop("broadcast_power", o)
+
+    def __neg__(self):
+        return self * -1.0
+
+    def __repr__(self):
+        outs = ", ".join(self.list_outputs())
+        return f"<Symbol {self.name} [{outs}]>"
+
+
+def Variable(name: str, shape=None, dtype=None, init=None, **attrs) -> Symbol:
+    """Create an input placeholder (parity: ``mx.sym.Variable``)."""
+    node_attrs = dict(attrs)
+    if shape is not None:
+        node_attrs["__shape__"] = list(shape)
+    if dtype is not None:
+        node_attrs["__dtype__"] = str(dtype)
+    return Symbol([(_Node("null", name, (), node_attrs), 0)])
+
+
+var = Variable
+
+
+def Group(symbols: Sequence[Symbol]) -> Symbol:
+    """Concatenate symbols' outputs into one multi-output symbol
+    (parity: ``mx.sym.Group``)."""
+    heads: List[Tuple[_Node, int]] = []
+    for s in symbols:
+        heads.extend(s._heads)
+    return Symbol(heads)
+
+
+def fromjson(text: str) -> Symbol:
+    payload = json.loads(text)
+    nodes: List[_Node] = []
+    for spec in payload["nodes"]:
+        attrs = spec.get("attrs") or spec.get("param") or {}
+        inputs = [(nodes[i], idx) for i, idx, *_ in spec.get("inputs", [])]
+        nodes.append(_Node(spec["op"], spec["name"], inputs, attrs))
+    heads = [(nodes[i], idx) for i, idx, *_ in payload["heads"]]
+    return Symbol(heads)
+
+
+load_json = fromjson
+
+
+def load(fname: str) -> Symbol:
+    """Load a saved symbol JSON (parity: ``mx.sym.load``)."""
+    with open(fname) as f:
+        return fromjson(f.read())

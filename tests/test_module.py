@@ -1,0 +1,141 @@
+"""Module / BucketingModule tests (SURVEY.md §3.3 symbolic fit path;
+reference tests/python/unittest/test_module.py strategy)."""
+
+import logging
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+def _mlp_sym(num_hidden=16, num_classes=3):
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    h = mx.sym.FullyConnected(data, mx.sym.Variable("fc1_weight"),
+                              mx.sym.Variable("fc1_bias"),
+                              num_hidden=num_hidden, name="fc1")
+    h = mx.sym.relu(h)
+    o = mx.sym.FullyConnected(h, mx.sym.Variable("fc2_weight"),
+                              mx.sym.Variable("fc2_bias"),
+                              num_hidden=num_classes, name="fc2")
+    return mx.sym.SoftmaxOutput(o, label, normalization="batch",
+                                name="softmax")
+
+
+def _toy_iter(n=96, dim=8, classes=3, batch=16, seed=0, shuffle=True):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, dim).astype(np.float32)
+    W = rng.randn(dim, classes).astype(np.float32)
+    y = np.argmax(X @ W, axis=1).astype(np.float32)
+    return mx.io.NDArrayIter(X, y, batch_size=batch, shuffle=shuffle,
+                             label_name="softmax_label")
+
+
+def test_module_bind_init_forward():
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    it = _toy_iter()
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    batch = next(iter(it))
+    mod.forward(batch, is_train=False)
+    out = mod.get_outputs()[0]
+    assert out.shape == (16, 3)
+    probs = out.asnumpy()
+    assert np.allclose(probs.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_module_fit_converges():
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    it = _toy_iter()
+    mod.fit(it, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5}, num_epoch=8,
+            initializer=mx.initializer.Xavier())
+    score = mod.score(_toy_iter(), "acc")
+    assert dict(score)["accuracy"] > 0.8, score
+
+
+def test_module_predict_and_params_roundtrip(tmp_path):
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    it = _toy_iter()
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    preds = mod.predict(_toy_iter(shuffle=False))
+    assert preds.shape == (96, 3)
+
+    prefix = str(tmp_path / "mlp")
+    mod.save_checkpoint(prefix, 2)
+    sym, arg, aux = mx.model.load_checkpoint(prefix, 2)
+    assert "fc1_weight" in arg
+    mod2 = mx.mod.Module(sym, context=mx.cpu())
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod2.set_params(arg, aux)
+    preds2 = mod2.predict(_toy_iter(shuffle=False))
+    assert np.allclose(preds.asnumpy(), preds2.asnumpy(), atol=1e-5)
+
+
+def test_module_input_grads():
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    it = _toy_iter()
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             inputs_need_grad=True)
+    mod.init_params()
+    batch = next(iter(it))
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    (dgrad,) = mod.get_input_grads()
+    assert dgrad.shape == (16, 8)
+    assert float(np.abs(dgrad.asnumpy()).sum()) > 0
+
+
+def test_bucketing_module():
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        flat = mx.sym.reshape(data, shape=(-1, seq_len * 4))
+        o = mx.sym.FullyConnected(flat, mx.sym.Variable("out_weight"),
+                                  None, no_bias=True, num_hidden=2,
+                                  name="out")
+        # weight shape depends on bucket — realistic NMT models share only
+        # embedding/RNN params; here we share nothing but exercise the
+        # bucket-switch machinery with a bucket-invariant param
+        w = mx.sym.Variable("scale_weight")
+        o = mx.sym.broadcast_mul(o, w)
+        return mx.sym.SoftmaxOutput(o, label, name="softmax"), \
+            ("data",), ("softmax_label",)
+
+    # bucket-invariant symbol: use mean over seq axis so params share
+    def sym_gen_shared(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        pooled = mx.sym.mean(data, axis=1)
+        o = mx.sym.FullyConnected(pooled, mx.sym.Variable("out_weight"),
+                                  mx.sym.Variable("out_bias"),
+                                  num_hidden=2, name="out")
+        return mx.sym.SoftmaxOutput(o, label, name="softmax"), \
+            ("data",), ("softmax_label",)
+
+    bm = mx.mod.BucketingModule(sym_gen_shared, default_bucket_key=8,
+                                context=mx.cpu())
+    bm.bind(data_shapes=[("data", (4, 8, 4))],
+            label_shapes=[("softmax_label", (4,))])
+    bm.init_params()
+    bm.init_optimizer(optimizer="sgd",
+                      optimizer_params={"learning_rate": 0.1})
+
+    rng = np.random.RandomState(0)
+    for seq_len in (8, 5, 8, 3):
+        batch = mx.io.DataBatch(
+            data=[nd.array(rng.randn(4, seq_len, 4))],
+            label=[nd.array(rng.randint(0, 2, (4,)).astype(np.float32))])
+        batch.bucket_key = seq_len
+        batch.provide_data = [("data", (4, seq_len, 4))]
+        batch.provide_label = [("softmax_label", (4,))]
+        bm.forward(batch, is_train=True)
+        bm.backward()
+        bm.update()
+    assert set(bm._buckets) == {8, 5, 3}
+    # params are shared by reference across buckets
+    arg, _ = bm.get_params()
+    assert "out_weight" in arg

@@ -1,0 +1,211 @@
+"""Symbol front end + executor tests (SURVEY.md §2.2 "Symbol frontend",
+§3.3 symbolic bind path; reference tests/python/unittest/test_symbol.py
+strategy)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd
+from incubator_mxnet_tpu import gluon
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    w1 = mx.sym.Variable("w1")
+    b1 = mx.sym.Variable("b1")
+    w2 = mx.sym.Variable("w2")
+    h = mx.sym.FullyConnected(data, w1, b1, num_hidden=8, name="fc1")
+    h = mx.sym.relu(h, name="act1")
+    out = mx.sym.FullyConnected(h, w2, None, no_bias=True, num_hidden=3,
+                                name="fc2")
+    return out
+
+
+def test_compose_and_introspect():
+    out = _mlp()
+    assert out.list_arguments() == ["data", "w1", "b1", "w2"]
+    assert out.list_outputs() == ["fc2_output"]
+    assert out.name == "fc2"
+    internals = out.get_internals()
+    assert "act1_output" in internals.list_outputs()
+    fc1 = internals["act1_output"]
+    assert fc1.list_arguments() == ["data", "w1", "b1"]
+
+
+def test_infer_shape_and_type():
+    out = _mlp()
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(
+        data=(4, 16), w1=(8, 16), b1=(8,), w2=(3, 8))
+    assert out_shapes == [(4, 3)]
+    assert arg_shapes[0] == (4, 16)
+    assert aux_shapes == []
+    # partial inference: param shapes derive from data shape alone (the
+    # reference's InferShape pass contract)
+    arg_shapes2, out_shapes2, _ = out.infer_shape(data=(4, 16))
+    assert out_shapes2 == [(4, 3)]
+    assert arg_shapes2[arg_shapes2.index((8, 16))] == (8, 16)
+    # genuinely under-determined (free variable) → (None, None, None)
+    free = mx.sym.Variable("a") + mx.sym.Variable("b")
+    assert free.infer_shape(a=(2, 2)) == (None, None, None)
+
+
+def test_eval_matches_numpy():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = (a * 2.0 + b) / 4.0
+    av, bv = nd.ones((2, 2)), nd.full((2, 2), 6.0)
+    (res,) = c.eval(a=av, b=bv)
+    assert np.allclose(res.asnumpy(), 2.0)
+
+
+def test_json_roundtrip(tmp_path):
+    out = _mlp()
+    path = str(tmp_path / "mlp-symbol.json")
+    out.save(path)
+    loaded = mx.sym.load(path)
+    assert loaded.list_arguments() == out.list_arguments()
+    assert loaded.list_outputs() == out.list_outputs()
+    arg_shapes, out_shapes, _ = loaded.infer_shape(
+        data=(2, 16), w1=(8, 16), b1=(8,), w2=(3, 8))
+    assert out_shapes == [(2, 3)]
+    payload = json.loads(loaded.tojson())
+    assert {n["op"] for n in payload["nodes"]} == \
+        {"null", "FullyConnected", "relu"}
+
+
+def test_group_and_multi_output():
+    a = mx.sym.Variable("a")
+    s1 = mx.sym.relu(a, name="r")
+    s2 = mx.sym.exp(a, name="e")
+    g = mx.sym.Group([s1, s2])
+    assert g.list_outputs() == ["r_output", "e_output"]
+    outs = g.eval(a=nd.array([[-1.0, 1.0]]))
+    assert np.allclose(outs[0].asnumpy(), [[0.0, 1.0]])
+    assert np.allclose(outs[1].asnumpy(), np.exp([[-1.0, 1.0]]))
+    # split: variadic-output node
+    sp = mx.sym.split(mx.sym.Variable("x"), num_outputs=2, axis=1)
+    assert len(sp.list_outputs()) == 2
+    second = sp[1]
+    (v,) = second.eval(x=nd.array(np.arange(8.0).reshape(2, 4)))
+    assert v.shape == (2, 2)
+
+
+def test_executor_forward_backward():
+    out = _mlp()
+    rng = np.random.RandomState(0)
+    args = {"data": nd.array(rng.randn(4, 16)),
+            "w1": nd.array(rng.randn(8, 16) * 0.1),
+            "b1": nd.zeros((8,)),
+            "w2": nd.array(rng.randn(3, 8) * 0.1)}
+    exe = out.bind(args=args, grad_req="write")
+    (y,) = exe.forward(is_train=True)
+    assert y.shape == (4, 3)
+    exe.backward(nd.ones((4, 3)))
+    # compare against autograd on the same imperative composition
+    xs = {k: v.copy() for k, v in args.items()}
+    for v in xs.values():
+        v.attach_grad()
+    with autograd.record():
+        h = nd.relu(nd.FullyConnected(xs["data"], xs["w1"], xs["b1"],
+                                      num_hidden=8))
+        o = nd.FullyConnected(h, xs["w2"], None, no_bias=True, num_hidden=3)
+    o.backward(nd.ones((4, 3)))
+    for name in ("data", "w1", "b1", "w2"):
+        assert np.allclose(exe.grad_dict[name].asnumpy(),
+                           xs[name].grad.asnumpy(), atol=1e-5), name
+
+
+def test_executor_grad_add_and_null():
+    x = mx.sym.Variable("x")
+    y = mx.sym.sum(x * x)
+    exe = y.bind(args={"x": nd.array([1.0, 2.0])},
+                 grad_req={"x": "add"})
+    exe.forward(is_train=True)
+    exe.backward()
+    exe.forward(is_train=True)
+    exe.backward()
+    assert np.allclose(exe.grad_dict["x"].asnumpy(), [4.0, 8.0])
+    exe2 = y.bind(args={"x": nd.array([1.0, 2.0])}, grad_req="null")
+    exe2.forward(is_train=False)
+    assert exe2.grad_arrays == [None]
+
+
+def test_simple_bind_and_reshape():
+    out = _mlp()
+    exe = out.simple_bind(data=(4, 16), w1=(8, 16), b1=(8,), w2=(3, 8))
+    exe.forward(is_train=False)
+    assert exe.outputs[0].shape == (4, 3)
+    exe2 = exe.reshape(data=(6, 16), w1=(8, 16), b1=(8,), w2=(3, 8))
+    exe2.forward(is_train=False)
+    assert exe2.outputs[0].shape == (6, 3)
+
+
+def test_symbolic_batchnorm_aux_update():
+    data = mx.sym.Variable("data")
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4, in_units=3),
+            gluon.nn.BatchNorm(in_channels=4))
+    net.initialize()
+    sym_out = net(data)
+    aux = sym_out.list_auxiliary_states()
+    assert len(aux) == 2 and any("running_mean" in a for a in aux)
+    args = {n: p.data() for n, p in
+            ((p.name, p) for p in net.collect_params().values())
+            if n in sym_out.list_arguments()}
+    aux_states = {p.name: p.data() for p in net.collect_params().values()
+                  if p.name in aux}
+    args["data"] = nd.array(np.random.RandomState(0).randn(8, 3))
+    exe = sym_out.bind(args=args, aux_states=aux_states, grad_req="null")
+    before = {k: v.asnumpy().copy() for k, v in exe.aux_dict.items()}
+    exe.forward(is_train=True)
+    changed = any(not np.allclose(exe.aux_dict[k].asnumpy(), before[k])
+                  for k in before)
+    assert changed, "running stats should update under is_train=True"
+
+
+def test_gluon_export_symbolblock_import(tmp_path):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, activation="relu", in_units=5),
+            gluon.nn.Dense(3, in_units=8))
+    net.initialize()
+    x = nd.array(np.random.RandomState(1).randn(2, 5))
+    ref = net(x).asnumpy()
+
+    path = str(tmp_path / "mlp")
+    net.export(path, epoch=3)
+    assert os.path.exists(path + "-symbol.json")
+    assert os.path.exists(path + "-0003.params")
+
+    blk = gluon.SymbolBlock.imports(path + "-symbol.json", ["data"],
+                                    path + "-0003.params")
+    out = blk(x)
+    assert np.allclose(out.asnumpy(), ref, atol=1e-5)
+
+
+def test_symbolblock_autograd_through_graph(tmp_path):
+    net = gluon.nn.Dense(2, in_units=3)
+    net.initialize()
+    path = str(tmp_path / "d")
+    net.export(path)
+    blk = gluon.SymbolBlock.imports(path + "-symbol.json", ["data"],
+                                    path + "-0000.params")
+    x = nd.ones((1, 3))
+    with autograd.record():
+        y = blk(x).sum()
+    y.backward()
+    w = [p for p in blk.collect_params().values()
+         if p.name.endswith("weight")][0]
+    assert np.allclose(w.grad().asnumpy(), np.ones((2, 3)))
+
+
+def test_scalar_sugar_ops():
+    x = nd.array([1.0, 2.0])
+    assert np.allclose(nd._rdiv_scalar(x, scalar=4.0).asnumpy(), [4.0, 2.0])
+    s = mx.sym.Variable("s")
+    expr = 1.0 - s
+    (v,) = expr.eval(s=x)
+    assert np.allclose(v.asnumpy(), [0.0, -1.0])
