@@ -1,0 +1,11 @@
+"""Gluon recurrent layers & cells (parity: python/mxnet/gluon/rnn/)."""
+
+from .rnn_cell import (RecurrentCell, HybridRecurrentCell, RNNCell, LSTMCell,
+                       GRUCell, SequentialRNNCell, BidirectionalCell,
+                       ResidualCell, DropoutCell, ModifierCell)
+from .rnn_layer import RNN, LSTM, GRU
+
+__all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
+           "GRUCell", "SequentialRNNCell", "BidirectionalCell",
+           "ResidualCell", "DropoutCell", "ModifierCell", "RNN", "LSTM",
+           "GRU"]

@@ -32,8 +32,8 @@ class BucketingModule(BaseModule):
         self._curr: Module = None
         self._bind_args = None
 
-    def _make_module(self, key) -> Module:
-        sym, data_names, label_names = self._sym_gen(key)
+    def _make_module(self, key, gen=None) -> Module:
+        sym, data_names, label_names = gen or self._sym_gen(key)
         return Module(sym, data_names=data_names, label_names=label_names,
                       context=self._context, logger=self.logger,
                       **self._kwargs)
@@ -62,13 +62,22 @@ class BucketingModule(BaseModule):
         self.params_initialized = True
 
     def init_optimizer(self, **kwargs):
-        self._buckets[self._default_key].init_optimizer(**kwargs)
+        master = self._buckets[self._default_key]
+        master.init_optimizer(**kwargs)
+        # re-borrow into every already-compiled bucket (they captured the
+        # master's optimizer state at bind time, which may predate this)
+        for key, mod in self._buckets.items():
+            if mod is not master:
+                mod._optimizer = master._optimizer
+                mod._opt_states = master._opt_states
+                mod.optimizer_initialized = True
         self.optimizer_initialized = True
 
-    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None,
+                      gen=None):
         """Select (and lazily compile) the executor for ``bucket_key``."""
         if bucket_key not in self._buckets:
-            mod = self._make_module(bucket_key)
+            mod = self._make_module(bucket_key, gen=gen)
             mod.bind(data_shapes, label_shapes,
                      shared_module=self._buckets[self._default_key],
                      **self._bind_args)
@@ -77,16 +86,29 @@ class BucketingModule(BaseModule):
 
     def forward(self, data_batch, is_train=None):
         key = getattr(data_batch, "bucket_key", self._default_key)
-        data_shapes = getattr(data_batch, "provide_data", None) or \
-            [(n, a.shape) for n, a in zip(
-                self._buckets[self._default_key]._data_names,
-                data_batch.data)]
+        # derive input names from THIS bucket's symbol (sym_gen may emit
+        # bucket-specific data/label names), not the default bucket's;
+        # generate at most once per new bucket and hand the result through
+        gen = None
+        data_shapes = getattr(data_batch, "provide_data", None)
         label_shapes = getattr(data_batch, "provide_label", None)
-        if label_shapes is None and data_batch.label is not None:
-            label_shapes = [(n, a.shape) for n, a in zip(
-                self._buckets[self._default_key]._label_names,
-                data_batch.label)]
-        self.switch_bucket(key, data_shapes, label_shapes)
+        need_names = data_shapes is None or \
+            (label_shapes is None and data_batch.label is not None)
+        if need_names:
+            if key in self._buckets:
+                names_mod = self._buckets[key]
+                data_names = names_mod._data_names
+                label_names = names_mod._label_names
+            else:
+                gen = self._sym_gen(key)
+                _, data_names, label_names = gen
+            if data_shapes is None:
+                data_shapes = [(n, a.shape)
+                               for n, a in zip(data_names, data_batch.data)]
+            if label_shapes is None and data_batch.label is not None:
+                label_shapes = [(n, a.shape) for n, a in
+                                zip(label_names, data_batch.label)]
+        self.switch_bucket(key, data_shapes, label_shapes, gen=gen)
         self._curr.forward(data_batch, is_train=is_train)
 
     def backward(self, out_grads=None):

@@ -23,6 +23,7 @@ from ..context import current_context
 from ..io import DataDesc
 from ..ndarray import NDArray, zeros as nd_zeros
 from ..ndarray.ndarray import _as_jax
+from ..symbol.executor import Executor
 from ..symbol.symbol import Symbol
 
 __all__ = ["BaseModule", "Module"]
@@ -68,7 +69,8 @@ class BaseModule:
             self.forward(batch, is_train=False)
             self.update_metric(eval_metric, batch.label)
             if batch_end_callback is not None:
-                batch_end_callback(_BatchEndParam(epoch, nbatch, eval_metric))
+                for cb in _as_list(batch_end_callback):
+                    cb(_BatchEndParam(epoch, nbatch, eval_metric))
         return eval_metric.get_name_value()
 
     def predict(self, eval_data, num_batch=None, reset=True):
@@ -261,24 +263,39 @@ class Module(BaseModule):
         if not self.binded:
             raise MXNetError("init_params: call bind first")
         initializer = initializer or _init_mod.Uniform(0.01)
+        # Module.load stashes checkpoint params here; they are applied on
+        # the first init_params call (reference: load → fit(arg_params=...))
+        if arg_params is None:
+            arg_params = getattr(self, "_loaded_args", None)
+            self._loaded_args = None
+        if aux_params is None:
+            aux_params = getattr(self, "_loaded_aux", None)
+            self._loaded_aux = None
         for name in self._param_names:
+            dst = self._exec.arg_dict[name]
             if arg_params and name in arg_params:
-                self._exec.arg_dict[name] = arg_params[name]
+                Executor._set_in_place(dst, arg_params[name],
+                                       "parameter", name)
             else:
-                if arg_params is not None and not allow_missing and \
-                        arg_params != {}:
-                    pass
-                arr = nd_zeros(self._arg_shape[name])
-                initializer(name, arr)
-                self._exec.arg_dict[name] = arr
+                if arg_params and not allow_missing:
+                    raise MXNetError(
+                        f"init_params: parameter {name!r} missing from "
+                        f"arg_params and allow_missing=False")
+                initializer(name, dst)
         for name in self._aux_names:
+            dst = self._exec.aux_dict[name]
             if aux_params and name in aux_params:
-                self._exec.aux_dict[name] = aux_params[name]
+                Executor._set_in_place(dst, aux_params[name],
+                                       "aux state", name)
             else:
+                if aux_params and not allow_missing:
+                    raise MXNetError(
+                        f"init_params: aux state {name!r} missing from "
+                        f"aux_params and allow_missing=False")
                 arr = nd_zeros(self._aux_shape[name])
                 if name.endswith(("moving_var", "running_var")):
                     arr = arr + 1.0
-                self._exec.aux_dict[name] = arr
+                dst._data = arr._data
         self.params_initialized = True
 
     def get_params(self):
@@ -310,6 +327,20 @@ class Module(BaseModule):
             n: self._optimizer.create_state(
                 i, self._exec.arg_dict[n])
             for i, n in enumerate(self._param_names)}
+        preload = getattr(self, "_preload_opt_states", None)
+        if preload is not None:
+            import pickle
+
+            import jax.tree_util as jtu
+
+            with open(preload, "rb") as f:
+                saved = pickle.load(f)
+            for n, s in saved.items():
+                if n in self._opt_states:
+                    self._opt_states[n] = jtu.tree_map(
+                        lambda a: NDArray(_as_jax(a))
+                        if not isinstance(a, NDArray) else a, s)
+            self._preload_opt_states = None
         self.optimizer_initialized = True
 
     # -- execution ---------------------------------------------------- #
@@ -329,9 +360,7 @@ class Module(BaseModule):
         self._exec.forward(is_train=is_train, **feeds)
 
     def backward(self, out_grads=None):
-        if out_grads is None and len(self._exec.outputs) == 1:
-            import jax.numpy as jnp
-            out_grads = [NDArray(jnp.ones_like(self._exec.outputs[0]._data))]
+        # the executor seeds ones itself inside the fused fwd+bwd program
         self._exec.backward(out_grads)
 
     def update(self):
@@ -374,10 +403,11 @@ class Module(BaseModule):
 
         sym, arg, aux = load_checkpoint(prefix, epoch)
         mod = Module(sym, **kwargs)
-        mod._preloaded = (arg, aux)
-        # params applied at bind time via init_params(arg_params=...)
+        # applied by the first init_params() after bind (see init_params)
         mod._loaded_args = arg
         mod._loaded_aux = aux
+        if load_optimizer_states:
+            mod._preload_opt_states = f"{prefix}-{epoch:04d}.states"
         return mod
 
 

@@ -11,4 +11,5 @@ from . import nn  # noqa: F401
 from . import random_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import attention  # noqa: F401
+from . import rnn  # noqa: F401
 from .registry import get, list_all_ops, describe_op, register

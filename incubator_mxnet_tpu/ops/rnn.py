@@ -1,0 +1,193 @@
+"""Fused recurrent layers (RNN / LSTM / GRU).
+
+Parity target: the reference's fused ``RNN`` operator
+(`src/operator/rnn.cc`, cuDNN path `src/operator/rnn-inl.h` — file-level
+citations, SURVEY.md caveat §5.7). The reference packs all layer weights
+into ONE flat parameter vector (cuDNN canonical layout) and runs a fused
+multi-layer, optionally bidirectional recurrence; Gluon's ``rnn_layer.py``
+calls it with concatenated per-layer parameters.
+
+TPU-native design: the time loop is a ``lax.scan`` (compiler-friendly
+control flow — no Python loop under jit), the per-step cell math is two
+MXU matmuls batched over gates, and the layer/direction structure is a
+static Python loop (unrolled at trace time, so XLA sees a fixed DAG).
+Weight unpacking from the flat vector uses static offsets — free at
+runtime, it just aliases slices of one buffer.
+
+Flat parameter layout (documented contract, mirrors cuDNN canonical
+order the reference uses):
+  for layer in layers:            # all weights first …
+    for direction in directions:
+      W_i2h (G*H, in)  then  W_h2h (G*H, H)
+  for layer in layers:            # … then all biases
+    for direction in directions:
+      b_i2h (G*H,)  then  b_h2h (G*H,)
+
+Gate order: LSTM ``i, f, g, o``; GRU ``r, z, n`` (cuDNN convention).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_param_size(num_layers, input_size, state_size, mode,
+                   bidirectional=False, projection_size=None):
+    """Total length of the flat parameter vector (parity:
+    ``rnn_param_size`` in src/operator/rnn-inl.h)."""
+    gates = _GATES[mode]
+    dirs = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else state_size * dirs
+        size += dirs * gates * state_size * (in_sz + state_size + 2)
+    return size
+
+
+def _unpack(params, num_layers, input_size, state_size, mode, dirs):
+    """Static-offset views into the flat vector → per-(layer,dir) weights."""
+    gates = _GATES[mode]
+    H, G = state_size, gates
+    weights, biases = [], []
+    off = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else H * dirs
+        per_dir = []
+        for _ in range(dirs):
+            w_i2h = params[off:off + G * H * in_sz].reshape(G * H, in_sz)
+            off += G * H * in_sz
+            w_h2h = params[off:off + G * H * H].reshape(G * H, H)
+            off += G * H * H
+            per_dir.append((w_i2h, w_h2h))
+        weights.append(per_dir)
+    for layer in range(num_layers):
+        per_dir = []
+        for _ in range(dirs):
+            b_i2h = params[off:off + G * H]
+            off += G * H
+            b_h2h = params[off:off + G * H]
+            off += G * H
+            per_dir.append((b_i2h, b_h2h))
+        biases.append(per_dir)
+    return weights, biases
+
+
+def _cell_step(mode):
+    """Returns step(carry, gates_x) given precomputed x-projection.
+
+    carry: h (B,H) for rnn/gru, (h, c) for lstm. gates_x: (B, G*H) —
+    x @ W_i2h.T + b_i2h, hoisted out of the scan so the big input matmul
+    is ONE (T*B, in)×(in, G*H) MXU gemm instead of T small ones.
+    """
+    if mode in ("rnn_relu", "rnn_tanh"):
+        act = jax.nn.relu if mode == "rnn_relu" else jnp.tanh
+
+        def step(carry, gx, w_h2h, b_h2h):
+            h = carry
+            h2 = act(gx + h @ w_h2h.T + b_h2h)
+            return h2, h2
+        return step
+
+    if mode == "lstm":
+        def step(carry, gx, w_h2h, b_h2h):
+            h, c = carry
+            g = gx + h @ w_h2h.T + b_h2h
+            i, f, gg, o = jnp.split(g, 4, axis=-1)
+            c2 = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(gg)
+            h2 = jax.nn.sigmoid(o) * jnp.tanh(c2)
+            return (h2, c2), h2
+        return step
+
+    if mode == "gru":
+        def step(carry, gx, w_h2h, b_h2h):
+            h = carry
+            hh = h @ w_h2h.T + b_h2h
+            xr, xz, xn = jnp.split(gx, 3, axis=-1)
+            hr, hz, hn = jnp.split(hh, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            h2 = (1.0 - z) * n + z * h
+            return h2, h2
+        return step
+
+    raise ValueError(f"unknown RNN mode {mode!r}")
+
+
+def _scan_direction(x, h0, c0, w_i2h, w_h2h, b_i2h, b_h2h, mode, reverse):
+    """One direction of one layer. x: (T,B,in) → (T,B,H)."""
+    step = _cell_step(mode)
+    gx = x @ w_i2h.T + b_i2h  # (T,B,G*H): one big gemm, MXU-sized
+    carry = (h0, c0) if mode == "lstm" else h0
+
+    def body(carry, g):
+        return step(carry, g, w_h2h, b_h2h)
+
+    carry, ys = lax.scan(body, carry, gx, reverse=reverse)
+    if mode == "lstm":
+        hT, cT = carry
+    else:
+        hT, cT = carry, None
+    return ys, hT, cT
+
+
+@register("RNN", aliases=("rnn",), num_outputs=None, needs_key=True,
+          training_aware=True)
+def rnn(data, parameters, state, state_cell=None, *, state_size=None,
+        num_layers=1, mode="lstm", bidirectional=False, p=0.0,
+        state_outputs=False, key=None, training=None):
+    """Fused multi-layer recurrence (reference: the ``RNN`` op,
+    src/operator/rnn.cc). ``data`` is TNC ``(T, B, input)``;
+    ``parameters`` the flat vector (layout in module docstring);
+    ``state`` ``(L*dirs, B, H)``; ``state_cell`` same (LSTM only).
+
+    Returns ``output (T,B,dirs*H)`` or, with ``state_outputs=True``,
+    ``(output, state_n[, state_cell_n])``.
+
+    Inter-layer dropout ``p`` is applied to each layer's output except the
+    last (the reference/cuDNN contract), counter-RNG keyed.
+    """
+    if state_size is None or mode not in _GATES:
+        raise ValueError("RNN requires state_size and a valid mode")
+    T, B, input_size = data.shape
+    dirs = 2 if bidirectional else 1
+    H = state_size
+    weights, biases = _unpack(parameters, num_layers, input_size, H,
+                              mode, dirs)
+    h0 = state.reshape(num_layers, dirs, B, H)
+    c0 = state_cell.reshape(num_layers, dirs, B, H) if mode == "lstm" \
+        else None
+
+    x = data
+    hTs, cTs = [], []
+    for layer in range(num_layers):
+        outs = []
+        for d in range(dirs):
+            w_i2h, w_h2h = weights[layer][d]
+            b_i2h, b_h2h = biases[layer][d]
+            ys, hT, cT = _scan_direction(
+                x, h0[layer, d], c0[layer, d] if c0 is not None else None,
+                w_i2h, w_h2h, b_i2h, b_h2h, mode, reverse=(d == 1))
+            outs.append(ys)
+            hTs.append(hT)
+            if cT is not None:
+                cTs.append(cT)
+        x = outs[0] if dirs == 1 else jnp.concatenate(outs, axis=-1)
+        if p > 0.0 and training and layer < num_layers - 1 and key is not None:
+            key, sub = jax.random.split(key)
+            keep = jax.random.bernoulli(sub, 1.0 - p, x.shape)
+            x = jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+
+    if not state_outputs:
+        return x
+    state_n = jnp.stack(hTs).reshape(num_layers * dirs, B, H)
+    if mode == "lstm":
+        cell_n = jnp.stack(cTs).reshape(num_layers * dirs, B, H)
+        return x, state_n, cell_n
+    return x, state_n

@@ -24,18 +24,6 @@ __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
            "trace_block"]
 
 
-def _resolve_num_outputs(spec, attrs) -> int:
-    if spec.num_outputs:
-        return spec.num_outputs
-    # variadic-output ops (split/split_v2): arity from static attrs
-    if "num_outputs" in attrs:
-        return int(attrs["num_outputs"])
-    ios = attrs.get("indices_or_sections")
-    if ios is not None:
-        return len(ios) + 1 if isinstance(ios, (list, tuple)) else int(ios)
-    return 1
-
-
 def _invoke_symbol(op_name: str, *args, name: Optional[str] = None,
                    **kwargs) -> Symbol:
     """Compose a graph node (the symbolic twin of imperative_invoke)."""
@@ -98,8 +86,7 @@ def _invoke_symbol(op_name: str, *args, name: Optional[str] = None,
         attrs.update(values)
 
     node = _Node(op_name, name or _auto_name(op_name), inputs, attrs)
-    n_out = _resolve_num_outputs(spec, attrs)
-    return Symbol([(node, i) for i in range(n_out)])
+    return Symbol([(node, i) for i in range(node.num_outputs())])
 
 
 def _make_symbol_function(op_name: str, public_name: str):

@@ -22,7 +22,7 @@ path used by `Module`/`simple_bind`.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -120,14 +120,6 @@ def _interpret_pure(sym: Symbol, input_vals: Dict[str, jax.Array],
 def _graph_needs_key(sym: Symbol) -> bool:
     return any(not n.is_variable and _registry.get(n.op).needs_key
                for n in _topo(sym._heads))
-
-
-def _placeholder(node, known: Dict[str, tuple], dtypes: Dict[str, str]):
-    shape = known.get(node.name, node.attrs.get("__shape__"))
-    if shape is None:
-        raise MXNetError(f"shape of input {node.name!r} unknown")
-    dtype = dtypes.get(node.name, node.attrs.get("__dtype__", "float32"))
-    return jax.ShapeDtypeStruct(tuple(shape), _to_jnp_dtype(dtype))
 
 
 def _prod(xs):
@@ -300,6 +292,29 @@ def _as_req_map(grad_req, arg_names: Sequence[str]) -> Dict[str, str]:
     raise MXNetError(f"bad grad_req {grad_req!r}")
 
 
+class _LazyOutputs:
+    """Sequence proxy over ``Executor.outputs`` that defers the fwd-only
+    compilation until actually read (training steps that go straight to
+    ``backward`` never pay for it)."""
+
+    __slots__ = ("_exe",)
+
+    def __init__(self, exe):
+        self._exe = exe
+
+    def __iter__(self):
+        return iter(self._exe.outputs)
+
+    def __len__(self):
+        return len(self._exe.outputs)
+
+    def __getitem__(self, i):
+        return self._exe.outputs[i]
+
+    def __repr__(self):
+        return repr(self._exe.outputs)
+
+
 class Executor:
     """Bound symbolic program (parity: ``mx.executor.Executor``).
 
@@ -327,9 +342,9 @@ class Executor:
             args_grad, [n for n in self._arg_names
                         if self._req.get(n, "null") != "null"], "args_grad")
 
-        self.outputs: List[NDArray] = []
-        self._vjp = None
-        self._jit_cache: Dict[bool, any] = {}
+        self._outputs: List[NDArray] = []
+        self._pending = None
+        self._jit_cache: Dict[Any, Any] = {}
 
     @staticmethod
     def _to_dict(vals, names, what) -> Dict[str, NDArray]:
@@ -393,57 +408,96 @@ class Executor:
         aux_vals = {n: self.aux_dict[n]._data for n in self._aux_names}
         key = _random.new_key() if _graph_needs_key(self._symbol) else None
 
-        if is_train:
-            diff_names = [n for n in self._arg_names
-                          if self._req.get(n, "null") != "null"]
-            const_vals = {n: arg_vals[n] for n in self._arg_names
-                          if n not in diff_names}
+        diff_names = tuple(n for n in self._arg_names
+                           if self._req.get(n, "null") != "null")
+        if is_train and diff_names:
+            # lazy: the fused fwd+bwd XLA program runs at backward();
+            # reading .outputs (or aux stats) first forces the fwd-only
+            # program instead. Module.fit ignores the proxy and gets ONE
+            # fused fwd+bwd per step.
+            self._pending = (arg_vals, aux_vals, key, diff_names)
+            self._outputs = None
+            return _LazyOutputs(self)
+        heads, aux_up = self._compiled(is_train)(arg_vals, aux_vals, key)
+        self._pending = None
+        for name, val in aux_up.items():
+            self.aux_dict[name]._data = val
+        self._outputs = [NDArray(h) for h in heads]
+        return self._outputs
+
+    @property
+    def outputs(self) -> List[NDArray]:
+        """Forward outputs; under a pending training step this runs the
+        fwd-only compiled program (backward() recomputes fwd fused with
+        bwd — full rematerialization, the XLA-idiomatic trade)."""
+        if self._outputs is None and self._pending is not None:
+            arg_vals, aux_vals, key, _ = self._pending
+            heads, aux_up = self._compiled(True)(arg_vals, aux_vals, key)
+            for name, val in aux_up.items():
+                self.aux_dict[name]._data = val
+            self._outputs = [NDArray(h) for h in heads]
+        return self._outputs if self._outputs is not None else []
+
+    def _compiled_train(self, diff_names, seed_ones):
+        """One jitted program computing heads, aux updates AND argument
+        gradients (the reference's fwd+bwd GraphExecutor dispatch collapsed
+        into a single XLA compilation — SURVEY.md §3.3 TPU translation)."""
+        ck = ("train", diff_names, seed_ones)
+        if ck not in self._jit_cache:
             sym = self._symbol
 
-            def diff_fn(dvals):
-                vals = dict(const_vals)
-                vals.update(dvals)
-                vals.update(aux_vals)
-                heads, aux_up = _interpret_pure(sym, vals, training=True,
-                                                key=key)
-                return tuple(heads), aux_up
+            def fn(diff_vals, const_vals, aux_vals, key, cots):
+                def diff_fn(dv):
+                    vals = dict(const_vals)
+                    vals.update(dv)
+                    vals.update(aux_vals)
+                    heads, aux_up = _interpret_pure(sym, vals, training=True,
+                                                    key=key)
+                    return tuple(heads), aux_up
 
-            heads, vjp, aux_up = jax.vjp(
-                diff_fn, {n: arg_vals[n] for n in diff_names},
-                has_aux=True)
-            self._vjp = vjp
-        else:
-            heads, aux_up = self._compiled(False)(arg_vals, aux_vals, key)
-            self._vjp = None
+                heads, vjp, aux_up = jax.vjp(diff_fn, diff_vals,
+                                             has_aux=True)
+                seed = tuple(jnp.ones_like(h) for h in heads) \
+                    if seed_ones else cots
+                grads = vjp(seed)[0]
+                return heads, aux_up, grads
 
-        for name, val in aux_up.items():
-            self.aux_dict[name] = NDArray(val)
-        self.outputs = [NDArray(h) for h in heads]
-        return self.outputs
+            self._jit_cache[ck] = jax.jit(fn)
+        return self._jit_cache[ck]
 
     def backward(self, out_grads=None):
         """Accumulate argument gradients per grad_req (parity:
         ``Executor.backward``; `kAddTo` semantics under grad_req='add')."""
-        if self._vjp is None:
+        if self._pending is None:
             raise MXNetError("backward called before forward(is_train=True)")
-        if out_grads is None:
-            if len(self.outputs) != 1:
+        arg_vals, aux_vals, key, diff_names = self._pending
+        seed_ones = out_grads is None
+        if seed_ones:
+            if len(self._symbol.list_outputs()) != 1:
                 raise MXNetError("multi-output executor needs explicit "
                                  "out_grads")
-            heads = (jnp.ones_like(self.outputs[0]._data),)
+            cots = ()
         else:
             if isinstance(out_grads, (NDArray, jax.Array)):
                 out_grads = [out_grads]
-            heads = tuple(g._data if isinstance(g, NDArray) else _as_jax(g)
-                          for g in out_grads)
-        grads = self._vjp(heads)[0]
+            cots = tuple(g._data if isinstance(g, NDArray) else _as_jax(g)
+                         for g in out_grads)
+        diff_vals = {n: arg_vals[n] for n in diff_names}
+        const_vals = {n: v for n, v in arg_vals.items()
+                      if n not in diff_names}
+        fn = self._compiled_train(diff_names, seed_ones)
+        heads, aux_up, grads = fn(diff_vals, const_vals, aux_vals, key, cots)
+        for name, val in aux_up.items():
+            self.aux_dict[name]._data = val
+        self._outputs = [NDArray(h) for h in heads]
         for name, g in grads.items():
             req = self._req.get(name, "null")
             if req == "null":
                 continue
             if req == "add" and name in self.grad_dict:
-                self.grad_dict[name] = NDArray(
-                    self.grad_dict[name]._data + g)
+                self.grad_dict[name]._data = self.grad_dict[name]._data + g
+            elif name in self.grad_dict:
+                self.grad_dict[name]._data = g
             else:
                 self.grad_dict[name] = NDArray(g)
         return self.grad_dict
@@ -461,24 +515,57 @@ class Executor:
     def aux_arrays(self) -> List[NDArray]:
         return [self.aux_dict[n] for n in self._aux_names]
 
+    @staticmethod
+    def _set_in_place(dst: NDArray, val, what: str, name: str):
+        """Write into an existing buffer so by-reference sharing survives
+        (BucketingModule's shared executors capture these objects)."""
+        arr = val._data if isinstance(val, NDArray) else _as_jax(val)
+        if tuple(arr.shape) != tuple(dst.shape):
+            raise MXNetError(
+                f"{what} {name!r}: shape {tuple(arr.shape)} does not match "
+                f"bound shape {tuple(dst.shape)}")
+        dst._data = arr.astype(dst._data.dtype)
+
     def copy_params_from(self, arg_params: Dict[str, NDArray],
                          aux_params: Optional[Dict[str, NDArray]] = None,
                          allow_extra_params: bool = False):
         for name, val in arg_params.items():
             if name in self._arg_names:
-                self.arg_dict[name] = val if isinstance(val, NDArray) \
-                    else NDArray(_as_jax(val))
+                if name in self.arg_dict:
+                    self._set_in_place(self.arg_dict[name], val,
+                                       "argument", name)
+                else:
+                    self.arg_dict[name] = val if isinstance(val, NDArray) \
+                        else NDArray(_as_jax(val))
             elif not allow_extra_params:
                 raise MXNetError(f"unknown argument {name!r}")
         for name, val in (aux_params or {}).items():
             if name in self._aux_names:
-                self.aux_dict[name] = val if isinstance(val, NDArray) \
-                    else NDArray(_as_jax(val))
+                if name in self.aux_dict:
+                    self._set_in_place(self.aux_dict[name], val,
+                                       "aux state", name)
+                else:
+                    self.aux_dict[name] = val if isinstance(val, NDArray) \
+                        else NDArray(_as_jax(val))
             elif not allow_extra_params:
                 raise MXNetError(f"unknown aux state {name!r}")
 
-    def reshape(self, **shapes):
-        """Rebind with new shapes (parity: ``Executor.reshape``) — XLA
-        recompiles per signature, so only buffers need reallocating."""
-        return Executor.simple_bind(self._symbol, self._ctx,
-                                    grad_req=self._req, **shapes)
+    def reshape(self, partial_shaping=False, **shapes):
+        """Rebind with new shapes, SHARING parameter arrays with this
+        executor (parity: ``Executor.reshape`` shares contents — updates
+        through either executor stay visible to both)."""
+        new = Executor.simple_bind(self._symbol, self._ctx,
+                                   grad_req=self._req, **shapes)
+        for name, old in self.arg_dict.items():
+            if name in new.arg_dict and \
+                    tuple(new.arg_dict[name].shape) == tuple(old.shape):
+                new.arg_dict[name] = old
+            elif not partial_shaping and name not in shapes:
+                raise MXNetError(
+                    f"reshape: parameter {name!r} changed shape; pass "
+                    f"partial_shaping=True to allow re-initialization")
+        for name, old in self.aux_dict.items():
+            if name in new.aux_dict and \
+                    tuple(new.aux_dict[name].shape) == tuple(old.shape):
+                new.aux_dict[name] = old
+        return new

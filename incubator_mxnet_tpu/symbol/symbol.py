@@ -61,7 +61,12 @@ class _Node:
         spec = _registry.get(self.op)
         if spec.num_outputs:
             return spec.num_outputs
-        # variadic-output ops (split/split_v2): arity from static attrs
+        # variadic-output ops: arity from static attrs (single source of
+        # truth — symbol/__init__._invoke_symbol uses this method too)
+        if spec.name == "RNN":
+            if not self.attrs.get("state_outputs"):
+                return 1
+            return 3 if self.attrs.get("mode", "lstm") == "lstm" else 2
         if "num_outputs" in self.attrs:
             return int(self.attrs["num_outputs"])
         ios = self.attrs.get("indices_or_sections")

@@ -139,3 +139,85 @@ def test_bucketing_module():
     # params are shared by reference across buckets
     arg, _ = bm.get_params()
     assert "out_weight" in arg
+
+
+def test_module_load_restores_checkpoint(tmp_path):
+    """Regression: Module.load must actually apply checkpoint params."""
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    it = _toy_iter()
+    mod.fit(it, num_epoch=1, optimizer_params={"learning_rate": 0.1})
+    prefix = str(tmp_path / "ckpt")
+    mod.save_checkpoint(prefix, 1, save_optimizer_states=True)
+    arg0, _ = mod.get_params()
+
+    mod2 = mx.mod.Module.load(prefix, 1, load_optimizer_states=True)
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod2.init_params()
+    arg1, _ = mod2.get_params()
+    for name in arg0:
+        np.testing.assert_allclose(arg0[name].asnumpy(),
+                                   arg1[name].asnumpy(), rtol=1e-6)
+    mod2.init_optimizer()
+    assert mod2.optimizer_initialized
+
+
+def test_module_init_params_allow_missing_enforced():
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    it = _toy_iter()
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    partial = {"fc1_weight": nd.zeros((16, 8))}
+    with pytest.raises(mx.base.MXNetError):
+        mod.init_params(arg_params=partial, allow_missing=False)
+    mod.init_params(arg_params=partial, allow_missing=True)
+    assert mod.params_initialized
+
+
+def test_executor_reshape_preserves_params():
+    sym = _mlp_sym()
+    exe = sym.simple_bind(mx.cpu(), data=(16, 8), softmax_label=(16,))
+    exe.arg_dict["fc1_weight"]._data = exe.arg_dict["fc1_weight"]._data + 1.5
+    exe2 = exe.reshape(partial_shaping=True, data=(32, 8),
+                       softmax_label=(32,))
+    np.testing.assert_allclose(exe2.arg_dict["fc1_weight"].asnumpy(),
+                               exe.arg_dict["fc1_weight"].asnumpy())
+
+
+def test_bucketing_set_params_propagates_to_existing_buckets():
+    """Regression: set_params after a non-default bucket was compiled must
+    update that bucket too (by-reference parameter sharing)."""
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        w = mx.sym.Variable("fc_weight")
+        b = mx.sym.Variable("fc_bias")
+        o = mx.sym.FullyConnected(data, w, b, num_hidden=3, name="fc")
+        return mx.sym.SoftmaxOutput(o, label, name="softmax"), \
+            ["data"], ["softmax_label"]
+
+    bm = mx.mod.BucketingModule(sym_gen, default_bucket_key=8,
+                                context=mx.cpu())
+    bm.bind(data_shapes=[("data", (2, 4))],
+            label_shapes=[("softmax_label", (2,))])
+    bm.init_params()
+
+    class _Batch:
+        def __init__(self, key, n):
+            self.bucket_key = key
+            self.data = [nd.ones((n, 4))]
+            self.label = [nd.zeros((n,))]
+            self.provide_data = [("data", (n, 4))]
+            self.provide_label = [("softmax_label", (n,))]
+
+    bm.forward(_Batch(4, 4), is_train=False)  # compile bucket 4
+    out_before = bm.get_outputs()[0].asnumpy()
+
+    arg, aux = bm.get_params()
+    new_args = {n: nd.array(np.full(a.shape, 0.3, np.float32))
+                for n, a in arg.items()}
+    bm.set_params(new_args, aux)
+    bm.forward(_Batch(4, 4), is_train=False)
+    out_after = bm.get_outputs()[0].asnumpy()
+    assert not np.allclose(out_before, out_after)
+    # identical per-class weights -> uniform softmax
+    np.testing.assert_allclose(out_after, np.full_like(out_after, 1 / 3),
+                               atol=1e-5)

@@ -1,0 +1,222 @@
+"""RNN op + gluon.rnn layer/cell tests (mirrors reference
+tests/python/unittest/test_gluon_rnn.py strategy: numpy oracles, fused
+vs cell-unroll consistency, gradient flow)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.ops.rnn import rnn_param_size
+
+
+def _np_lstm_ref(x, h0, c0, wi, wh, bi, bh):
+    """Single-layer LSTM oracle in numpy, gate order i,f,g,o."""
+    T, B, _ = x.shape
+    H = h0.shape[-1]
+    h, c = h0.copy(), c0.copy()
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    outs = []
+    for t in range(T):
+        g = x[t] @ wi.T + bi + h @ wh.T + bh
+        i, f, gg, o = np.split(g, 4, axis=-1)
+        c = sig(f) * c + sig(i) * np.tanh(gg)
+        h = sig(o) * np.tanh(c)
+        outs.append(h)
+    return np.stack(outs), h, c
+
+
+def test_fused_lstm_matches_numpy():
+    rng = np.random.RandomState(0)
+    T, B, I, H = 5, 3, 4, 6
+    x = rng.randn(T, B, I).astype(np.float32)
+    wi = rng.randn(4 * H, I).astype(np.float32) * 0.1
+    wh = rng.randn(4 * H, H).astype(np.float32) * 0.1
+    bi = rng.randn(4 * H).astype(np.float32) * 0.1
+    bh = rng.randn(4 * H).astype(np.float32) * 0.1
+    params = np.concatenate([wi.ravel(), wh.ravel(), bi, bh])
+    assert params.size == rnn_param_size(1, I, H, "lstm")
+    h0 = np.zeros((1, B, H), np.float32)
+    c0 = np.zeros((1, B, H), np.float32)
+
+    out, hN, cN = nd.RNN(nd.array(x), nd.array(params), nd.array(h0),
+                         nd.array(c0), state_size=H, num_layers=1,
+                         mode="lstm", state_outputs=True)
+    ref_out, ref_h, ref_c = _np_lstm_ref(x, h0[0], c0[0], wi, wh, bi, bh)
+    np.testing.assert_allclose(out.asnumpy(), ref_out, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(hN.asnumpy()[0], ref_h, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(cN.asnumpy()[0], ref_c, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("mode,cls", [("lstm", gluon.rnn.LSTM),
+                                      ("gru", gluon.rnn.GRU),
+                                      ("rnn_tanh", gluon.rnn.RNN)])
+def test_layer_forward_shapes(mode, cls):
+    T, B, I, H, L = 4, 2, 5, 7, 2
+    layer = cls(H, num_layers=L, bidirectional=True)
+    layer.initialize()
+    x = nd.random.uniform(shape=(T, B, I))
+    out = layer(x)
+    assert out.shape == (T, B, 2 * H)
+    states = layer.begin_state(batch_size=B)
+    out, st = layer(x, states)
+    assert out.shape == (T, B, 2 * H)
+    assert st[0].shape == (L * 2, B, H)
+    if mode == "lstm":
+        assert len(st) == 2
+
+
+def test_layer_ntc_layout():
+    layer = gluon.rnn.GRU(6, layout="NTC")
+    layer.initialize()
+    x = nd.random.uniform(shape=(3, 5, 4))  # (B,T,C)
+    out = layer(x)
+    assert out.shape == (3, 5, 6)
+
+
+def test_lstm_layer_matches_cell_unroll():
+    """Fused scan path vs step-by-step LSTMCell unroll."""
+    T, B, I, H = 6, 2, 3, 4
+    layer = gluon.rnn.LSTM(H, input_size=I)
+    layer.initialize()
+    cell = gluon.rnn.LSTMCell(H, input_size=I)
+    cell.initialize()
+    # share weights: copy layer params into cell
+    lp = {"_".join(n.rsplit("_", 2)[-2:]): p
+          for n, p in layer.collect_params().items()}
+    cell.i2h_weight.set_data(lp["i2h_weight"].data())
+    cell.h2h_weight.set_data(lp["h2h_weight"].data())
+    cell.i2h_bias.set_data(lp["i2h_bias"].data())
+    cell.h2h_bias.set_data(lp["h2h_bias"].data())
+
+    x = nd.random.uniform(shape=(T, B, I))
+    fused = layer(x)
+    outs, _ = cell.unroll(T, x, layout="TNC", merge_outputs=True)
+    np.testing.assert_allclose(fused.asnumpy(), outs.asnumpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_rnn_gradient_flows():
+    layer = gluon.rnn.LSTM(4, num_layers=2, dropout=0.3)
+    layer.initialize()
+    x = nd.random.uniform(shape=(5, 2, 3))
+    with autograd.record():
+        out = layer(x)
+        loss = (out ** 2).sum()
+    loss.backward()
+    for _, p in layer.collect_params().items():
+        g = p.grad().asnumpy()
+        assert np.isfinite(g).all()
+        assert np.abs(g).sum() > 0
+
+
+def test_gru_cell_and_residual():
+    cell = gluon.rnn.ResidualCell(gluon.rnn.GRUCell(5, input_size=5))
+    cell.initialize()
+    x = nd.random.uniform(shape=(2, 5))
+    states = cell.begin_state(batch_size=2)
+    out, st = cell(x, states)
+    assert out.shape == (2, 5)
+
+
+def test_sequential_and_bidirectional_cells():
+    stack = gluon.rnn.SequentialRNNCell()
+    stack.add(gluon.rnn.LSTMCell(4, input_size=3))
+    stack.add(gluon.rnn.GRUCell(4, input_size=4))
+    stack.initialize()
+    x = nd.random.uniform(shape=(7, 2, 3))
+    outs, states = stack.unroll(7, x, layout="TNC", merge_outputs=True)
+    assert outs.shape == (7, 2, 4)
+    assert len(states) == 3  # lstm h,c + gru h
+
+    bi = gluon.rnn.BidirectionalCell(gluon.rnn.GRUCell(4, input_size=3),
+                                     gluon.rnn.GRUCell(4, input_size=3))
+    bi.initialize()
+    outs, _ = bi.unroll(7, x, layout="TNC", merge_outputs=True)
+    assert outs.shape == (7, 2, 8)
+
+
+def test_rnn_layer_hybridize():
+    layer = gluon.rnn.LSTM(4, num_layers=1)
+    layer.initialize()
+    x = nd.random.uniform(shape=(3, 2, 5))
+    eager = layer(x)
+    layer.hybridize()
+    compiled = layer(x)
+    np.testing.assert_allclose(eager.asnumpy(), compiled.asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_symbolic_rnn_state_outputs_arity():
+    """Regression: mx.sym.RNN with state_outputs must expose all heads."""
+    x = mx.sym.Variable("x")
+    p = mx.sym.Variable("p")
+    h = mx.sym.Variable("h")
+    c = mx.sym.Variable("c")
+    s = mx.sym.RNN(x, p, h, c, state_size=4, num_layers=1, mode="lstm",
+                   state_outputs=True)
+    assert len(s.list_outputs()) == 3
+    s2 = mx.sym.RNN(x, p, h, state_size=4, num_layers=1, mode="gru",
+                    state_outputs=True)
+    assert len(s2.list_outputs()) == 2
+    s3 = mx.sym.RNN(x, p, h, state_size=4, num_layers=1, mode="gru",
+                    state_outputs=False)
+    assert len(s3.list_outputs()) == 1
+
+
+def test_bidirectional_valid_length():
+    """Regression: backward direction must see valid frames, not padding."""
+    T, B, C, H = 5, 2, 3, 4
+    rng = np.random.RandomState(3)
+    x = rng.randn(T, B, C).astype(np.float32)
+    vl = np.array([5.0, 2.0], np.float32)
+
+    bi = gluon.rnn.BidirectionalCell(gluon.rnn.GRUCell(H, input_size=C),
+                                     gluon.rnn.GRUCell(H, input_size=C))
+    bi.initialize()
+    out, _ = bi.unroll(T, nd.array(x), layout="TNC", merge_outputs=True,
+                       valid_length=nd.array(vl))
+    out = out.asnumpy()
+    # sequence 1 has 2 valid steps: outputs at t>=2 masked to 0
+    assert np.allclose(out[2:, 1, :], 0.0)
+    # backward half of t=0 for seq 1 must be nonzero (computed from the 2
+    # valid frames) — the plain-reversal bug zeroed it
+    assert np.abs(out[0, 1, H:]).sum() > 0
+
+    # oracle: running the same cells on just the valid 2 frames must match
+    sub, _ = bi.unroll(2, nd.array(x[:2, 1:2]), layout="TNC",
+                       merge_outputs=True)
+    np.testing.assert_allclose(out[:2, 1, :], sub.asnumpy()[:, 0, :],
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_bucketing_init_optimizer_reaches_precompiled_buckets():
+    def sym_gen(key):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        o = mx.sym.FullyConnected(data, mx.sym.Variable("w"),
+                                  mx.sym.Variable("b"), num_hidden=3,
+                                  name="fc")
+        return mx.sym.SoftmaxOutput(o, label, name="softmax"), \
+            ["data"], ["softmax_label"]
+
+    bm = mx.mod.BucketingModule(sym_gen, default_bucket_key=8,
+                                context=mx.cpu())
+    bm.bind(data_shapes=[("data", (2, 4))],
+            label_shapes=[("softmax_label", (2,))])
+    bm.init_params()
+
+    class _Batch:
+        def __init__(self, key, n):
+            self.bucket_key = key
+            self.data = [nd.ones((n, 4))]
+            self.label = [nd.zeros((n,))]
+            self.provide_data = [("data", (n, 4))]
+            self.provide_label = [("softmax_label", (n,))]
+
+    bm.forward(_Batch(4, 4), is_train=True)   # compile bucket 4 pre-opt
+    bm.init_optimizer(optimizer="sgd",
+                      optimizer_params={"learning_rate": 0.5})
+    bm.forward(_Batch(4, 4), is_train=True)
+    bm.backward()
+    bm.update()  # regression: raised "call init_optimizer first"
