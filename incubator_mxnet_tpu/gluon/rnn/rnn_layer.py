@@ -53,7 +53,7 @@ class _RNNLayer(HybridBlock):
                     self._param_names.append(names)
 
     def infer_shape(self, x, *args):
-        in_sz = x.shape[2] if self._layout == "TNC" else x.shape[2]
+        in_sz = x.shape[2]  # channel axis is last in both TNC and NTC
         G, H = self._gates, self._hidden_size
         for idx, names in enumerate(self._param_names):
             layer = idx // self._dir

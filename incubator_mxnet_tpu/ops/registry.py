@@ -42,7 +42,8 @@ class OpSpec:
     name : canonical snake_case op name (reference op names kept verbatim,
         e.g. ``broadcast_add``, ``FullyConnected`` is an alias).
     fn : pure function ``fn(*arrays, **params) -> array | tuple``.
-    num_outputs : static output arity (None if variadic, e.g. ``split``).
+    num_outputs : static output arity (None if variadic, e.g. ``split``),
+        or a callable ``attrs -> int`` for attr-dependent arity (RNN).
     needs_key : op consumes a PRNG key as its LAST array argument (stochastic
         ops: dropout, samplers). The imperative front end feeds the global
         stream; traced front ends must thread keys explicitly.

@@ -41,6 +41,10 @@ def rnn_param_size(num_layers, input_size, state_size, mode,
                    bidirectional=False, projection_size=None):
     """Total length of the flat parameter vector (parity:
     ``rnn_param_size`` in src/operator/rnn-inl.h)."""
+    if projection_size is not None:
+        raise NotImplementedError(
+            "projected LSTM (LSTMP) is not supported; the flat layout here "
+            "has no projection weights")
     gates = _GATES[mode]
     dirs = 2 if bidirectional else 1
     size = 0
@@ -137,11 +141,17 @@ def _scan_direction(x, h0, c0, w_i2h, w_h2h, b_i2h, b_h2h, mode, reverse):
     return ys, hT, cT
 
 
-@register("RNN", aliases=("rnn",), num_outputs=None, needs_key=True,
-          training_aware=True)
+def _rnn_num_outputs(attrs):
+    if not attrs.get("state_outputs"):
+        return 1
+    return 3 if attrs.get("mode", "lstm") == "lstm" else 2
+
+
+@register("RNN", aliases=("rnn",), num_outputs=_rnn_num_outputs,
+          needs_key=True, training_aware=True)
 def rnn(data, parameters, state, state_cell=None, *, state_size=None,
         num_layers=1, mode="lstm", bidirectional=False, p=0.0,
-        state_outputs=False, key=None, training=None):
+        state_outputs=False, projection_size=None, key=None, training=None):
     """Fused multi-layer recurrence (reference: the ``RNN`` op,
     src/operator/rnn.cc). ``data`` is TNC ``(T, B, input)``;
     ``parameters`` the flat vector (layout in module docstring);
@@ -155,6 +165,8 @@ def rnn(data, parameters, state, state_cell=None, *, state_size=None,
     """
     if state_size is None or mode not in _GATES:
         raise ValueError("RNN requires state_size and a valid mode")
+    if projection_size is not None:
+        raise NotImplementedError("projected LSTM (LSTMP) is not supported")
     T, B, input_size = data.shape
     dirs = 2 if bidirectional else 1
     H = state_size

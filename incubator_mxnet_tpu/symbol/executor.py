@@ -404,6 +404,11 @@ class Executor:
         if missing:
             raise MXNetError(f"executor: unbound arguments {missing}")
 
+        if self._pending is not None and self._outputs is None:
+            # previous training step never consumed (no backward/outputs
+            # read): run it now so its aux (running-stat) updates land
+            _ = self.outputs
+
         arg_vals = {n: self.arg_dict[n]._data for n in self._arg_names}
         aux_vals = {n: self.aux_dict[n]._data for n in self._aux_names}
         key = _random.new_key() if _graph_needs_key(self._symbol) else None
@@ -487,6 +492,7 @@ class Executor:
                       if n not in diff_names}
         fn = self._compiled_train(diff_names, seed_ones)
         heads, aux_up, grads = fn(diff_vals, const_vals, aux_vals, key, cots)
+        self._pending = None  # consumed
         for name, val in aux_up.items():
             self.aux_dict[name]._data = val
         self._outputs = [NDArray(h) for h in heads]

@@ -59,14 +59,14 @@ class _Node:
         if self.is_variable:
             return 1
         spec = _registry.get(self.op)
+        if callable(spec.num_outputs):
+            # attr-dependent arity declared at registration (e.g. RNN's
+            # state_outputs) — arity stays next to the op definition
+            return spec.num_outputs(self.attrs)
         if spec.num_outputs:
             return spec.num_outputs
         # variadic-output ops: arity from static attrs (single source of
         # truth — symbol/__init__._invoke_symbol uses this method too)
-        if spec.name == "RNN":
-            if not self.attrs.get("state_outputs"):
-                return 1
-            return 3 if self.attrs.get("mode", "lstm") == "lstm" else 2
         if "num_outputs" in self.attrs:
             return int(self.attrs["num_outputs"])
         ios = self.attrs.get("indices_or_sections")

@@ -1,0 +1,92 @@
+"""Vision model zoo smoke tests (mirrors reference
+tests/python/unittest/test_gluon_model_zoo.py: construct + tiny forward).
+
+Full 224x224 forwards for every model would dominate CI time; each family
+is exercised once at full size and once per variant at construction level.
+"""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, nd
+from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+ALL_MODELS = sorted(vision._models)
+
+
+def test_get_model_unknown_raises():
+    with pytest.raises(mx.base.MXNetError):
+        vision.get_model("resnet9999_v9")
+
+
+def test_pretrained_gated():
+    with pytest.raises(mx.base.MXNetError):
+        vision.get_model("resnet18_v1", pretrained=True)
+
+
+@pytest.mark.parametrize("name", ALL_MODELS)
+def test_construct_all(name):
+    net = vision.get_model(name, classes=7)
+    assert net is not None
+
+
+@pytest.mark.parametrize("name,size", [
+    ("resnet18_v1", 32),
+    ("resnet50_v2", 32),
+    ("mobilenet0.25", 32),
+    ("mobilenetv2_0.25", 32),
+    ("squeezenet1.1", 64),
+])
+def test_forward_small(name, size):
+    net = vision.get_model(name, classes=5)
+    net.initialize()
+    x = nd.random.uniform(shape=(2, 3, size, size))
+    out = net(x)
+    assert out.shape == (2, 5)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def test_resnet18_hybridize_and_grad():
+    net = vision.get_model("resnet18_v1", classes=4)
+    net.initialize()
+    net.hybridize()
+    x = nd.random.uniform(shape=(2, 3, 32, 32))
+    from incubator_mxnet_tpu import autograd
+    with autograd.record():
+        out = net(x)
+        loss = (out ** 2).sum()
+    loss.backward()
+    grads = [p.grad() for _, p in net.collect_params().items()
+             if p.grad_req != "null"]
+    assert all(np.isfinite(g.asnumpy()).all() for g in grads)
+    total = sum(float(np.abs(g.asnumpy()).sum()) for g in grads)
+    assert total > 0
+
+
+def test_vgg11_forward_224():
+    net = vision.get_model("vgg11", classes=3)
+    net.initialize()
+    out = net(nd.random.uniform(shape=(1, 3, 224, 224)))
+    assert out.shape == (1, 3)
+
+
+def test_densenet121_forward_224():
+    net = vision.get_model("densenet121", classes=3)
+    net.initialize()
+    out = net(nd.random.uniform(shape=(1, 3, 224, 224)))
+    assert out.shape == (1, 3)
+
+
+def test_alexnet_forward_224():
+    net = vision.get_model("alexnet", classes=3)
+    net.initialize()
+    out = net(nd.random.uniform(shape=(1, 3, 224, 224)))
+    assert out.shape == (1, 3)
+
+
+def test_inception_forward_299():
+    net = vision.get_model("inceptionv3", classes=3)
+    net.initialize()
+    out = net(nd.random.uniform(shape=(1, 3, 299, 299)))
+    assert out.shape == (1, 3)
