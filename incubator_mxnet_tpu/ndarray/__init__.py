@@ -157,3 +157,7 @@ class _RandomNS:
 
 
 random = _RandomNS()
+
+
+# contrib namespace (parity: mx.nd.contrib)
+from . import contrib  # noqa: E402,F401

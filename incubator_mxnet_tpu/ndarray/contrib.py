@@ -1,0 +1,19 @@
+"""``mx.nd.contrib`` — contrib op namespace (parity:
+`python/mxnet/ndarray/contrib.py`: ops registered as ``_contrib_X`` are
+surfaced as ``nd.contrib.X``)."""
+
+from __future__ import annotations
+
+import sys as _sys
+
+from ..ops import registry as _registry
+from .register import make_op_function
+
+_THIS = _sys.modules[__name__]
+
+for _name in _registry.list_all_names():
+    if _name.startswith("_contrib_"):
+        _short = _name[len("_contrib_"):]
+        if not hasattr(_THIS, _short):
+            setattr(_THIS, _short, make_op_function(_registry.get(_name),
+                                                    _short))

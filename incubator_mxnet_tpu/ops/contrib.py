@@ -1,0 +1,466 @@
+"""Detection operators (SSD / Faster-RCNN family).
+
+Parity targets (file-level citations — SURVEY.md caveat):
+  - ``MultiBoxPrior/Target/Detection``: src/operator/contrib/multibox_*.cc
+  - ``box_nms`` / ``box_iou``: src/operator/contrib/bounding_box.cc
+  - ``ROIAlign``: src/operator/contrib/roi_align.cc
+  - ``ROIPooling``: src/operator/roi_pooling.cc
+  - ``Proposal``: src/operator/contrib/proposal.cc
+
+TPU-native design: every op here is FIXED-SHAPE under jit — suppression,
+matching and filtering are expressed as masks and ``lax`` loops instead of
+the reference's dynamic-length CUDA kernels, so XLA can compile one static
+program (scores set to -1 mark suppressed/invalid rows, the reference's own
+sentinel convention)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_VARIANCES = (0.1, 0.1, 0.2, 0.2)
+
+
+def _corner_to_center(boxes):
+    xmin, ymin, xmax, ymax = jnp.split(boxes, 4, axis=-1)
+    return jnp.concatenate([(xmin + xmax) / 2, (ymin + ymax) / 2,
+                            xmax - xmin, ymax - ymin], axis=-1)
+
+
+def _center_to_corner(boxes):
+    cx, cy, w, h = jnp.split(boxes, 4, axis=-1)
+    return jnp.concatenate([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                           axis=-1)
+
+
+def _pairwise_iou(lhs, rhs):
+    """IoU between (..., N, 4) and (..., M, 4) corner boxes → (..., N, M)."""
+    lt = jnp.maximum(lhs[..., :, None, :2], rhs[..., None, :, :2])
+    rb = jnp.minimum(lhs[..., :, None, 2:], rhs[..., None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_l = jnp.maximum(lhs[..., 2] - lhs[..., 0], 0.0) * \
+        jnp.maximum(lhs[..., 3] - lhs[..., 1], 0.0)
+    area_r = jnp.maximum(rhs[..., 2] - rhs[..., 0], 0.0) * \
+        jnp.maximum(rhs[..., 3] - rhs[..., 1], 0.0)
+    union = area_l[..., :, None] + area_r[..., None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register("box_iou", aliases=("_contrib_box_iou",))
+def box_iou(lhs, rhs, format="corner"):
+    """Pairwise IoU (reference: bounding_box.cc box_iou)."""
+    if format == "center":
+        lhs = _center_to_corner(lhs)
+        rhs = _center_to_corner(rhs)
+    return _pairwise_iou(lhs, rhs)
+
+
+@register("MultiBoxPrior", aliases=("multibox_prior",
+                                    "_contrib_MultiBoxPrior"))
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor generation (reference: multibox_prior.cc). ``data`` is the
+    (B, C, H, W) feature map; returns (1, H*W*(S+R-1), 4) corner anchors
+    in [0, 1] coordinates."""
+    sizes = tuple(sizes) if not isinstance(sizes, (int, float)) else (sizes,)
+    ratios = tuple(ratios) if not isinstance(ratios, (int, float)) \
+        else (ratios,)
+    H, W = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / H
+    step_x = steps[1] if steps[1] > 0 else 1.0 / W
+    cy = (jnp.arange(H, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(W, dtype=jnp.float32) + offsets[1]) * step_x
+    cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), axis=-1)  # (H,W,2)
+
+    # (size_i, ratio_0) for all i, then (size_0, ratio_j) for j >= 1
+    wh = [(s * (ratios[0] ** 0.5), s / (ratios[0] ** 0.5)) for s in sizes]
+    wh += [(sizes[0] * (r ** 0.5), sizes[0] / (r ** 0.5))
+           for r in ratios[1:]]
+    wh = jnp.asarray(wh, jnp.float32) / 2.0  # (A, 2) half (w, h)
+
+    cxs = cyx[..., 1][..., None]  # (H,W,1)
+    cys = cyx[..., 0][..., None]
+    anchors = jnp.stack([
+        cxs - wh[:, 0], cys - wh[:, 1], cxs + wh[:, 0], cys + wh[:, 1],
+    ], axis=-1)  # (H, W, A, 4)
+    anchors = anchors.reshape(1, -1, 4)
+    if clip:
+        anchors = jnp.clip(anchors, 0.0, 1.0)
+    return anchors
+
+
+def _nms_one(boxes, scores, ids, overlap_thresh, valid_thresh, topk,
+             force_suppress):
+    """Single-image greedy NMS; returns keep mask + score order."""
+    N = scores.shape[0]
+    order = jnp.argsort(-scores)
+    boxes_s = boxes[order]
+    scores_s = scores[order]
+    ids_s = ids[order]
+    valid = scores_s > valid_thresh
+    if topk > 0:
+        valid = valid & (jnp.arange(N) < topk)
+    iou = _pairwise_iou(boxes_s, boxes_s)
+    same_class = jnp.ones((N, N), bool) if force_suppress else \
+        (ids_s[:, None] == ids_s[None, :])
+    suppress_pair = (iou > overlap_thresh) & same_class
+
+    def body(i, keep):
+        # i suppresses later j only if i itself is kept and valid
+        cond = keep[i] & valid[i]
+        row = suppress_pair[i] & (jnp.arange(N) > i)
+        return jnp.where(cond, keep & ~row, keep)
+
+    keep = lax.fori_loop(0, N, body, jnp.ones((N,), bool))
+    return keep & valid, order
+
+
+@register("box_nms", aliases=("box_non_maximum_suppression",
+                              "_contrib_box_nms"))
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1,
+            force_suppress=False, in_format="corner",
+            out_format="corner", background_id=-1):
+    """Greedy non-maximum suppression (reference: bounding_box.cc).
+    data: (B, N, K) rows [.., score, .., x1, y1, x2, y2, ..]; suppressed
+    rows get score -1 (fixed shape out, the reference's convention)."""
+    squeeze = data.ndim == 2
+    if squeeze:
+        data = data[None]
+    B, N, K = data.shape
+    boxes = data[..., coord_start:coord_start + 4]
+    if in_format == "center":
+        boxes = _center_to_corner(boxes)
+    scores = data[..., score_index]
+    ids = data[..., id_index] if id_index >= 0 else \
+        jnp.zeros_like(scores)
+
+    if id_index >= 0 and background_id >= 0:
+        # background-class rows never survive NMS (reference contract)
+        scores = jnp.where(ids == background_id, -1.0, scores)
+
+    def per_image(b, s, i, row):
+        keep, order = _nms_one(b, s, i, overlap_thresh, valid_thresh,
+                               topk, force_suppress)
+        out = row[order]
+        if out_format != in_format:
+            bx = b[order] if out_format == "corner" else \
+                _corner_to_center(b[order])
+            out = out.at[..., coord_start:coord_start + 4].set(bx)
+        out = out.at[..., score_index].set(
+            jnp.where(keep, out[..., score_index], -1.0))
+        if id_index >= 0:
+            out = out.at[..., id_index].set(
+                jnp.where(keep, out[..., id_index], -1.0))
+        return out
+
+    out = jax.vmap(per_image)(boxes, scores, ids, data)
+    return out[0] if squeeze else out
+
+
+@register("MultiBoxTarget", aliases=("multibox_target",
+                                     "_contrib_MultiBoxTarget"),
+          num_outputs=3)
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=_VARIANCES):
+    """Anchor-to-ground-truth matching (reference: multibox_target.cc).
+
+    anchor: (1, N, 4) corner; label: (B, M, 5) [cls x1 y1 x2 y2] with
+    cls=-1 padding rows; cls_pred: (B, num_cls+1, N) (only used for hard
+    negative mining). Returns (box_target (B, N*4), box_mask (B, N*4),
+    cls_target (B, N))."""
+    anchors = anchor.reshape(-1, 4)
+    N = anchors.shape[0]
+    B, M, _ = label.shape
+    v = jnp.asarray(variances, jnp.float32)
+    a_center = _corner_to_center(anchors)
+
+    def per_image(lab, cp):
+        gt_valid = lab[:, 0] >= 0  # (M,)
+        gt_boxes = lab[:, 1:5]
+        iou = _pairwise_iou(anchors, gt_boxes)  # (N, M)
+        iou = jnp.where(gt_valid[None, :], iou, -1.0)
+
+        # stage 1: greedy bipartite — each gt grabs its best anchor
+        def bipartite(state, _):
+            matched, iou_w = state
+            flat = jnp.argmax(iou_w)
+            ai, gi = flat // M, flat % M
+            ok = iou_w[ai, gi] > 1e-12
+            matched = jnp.where(ok, matched.at[ai].set(gi), matched)
+            iou_w = jnp.where(ok, iou_w.at[ai, :].set(-1.0)
+                              .at[:, gi].set(-1.0), iou_w)
+            return (matched, iou_w), None
+
+        matched0 = jnp.full((N,), -1, jnp.int32)
+        (matched, _), _ = lax.scan(bipartite, (matched0, iou),
+                                   None, length=M)
+
+        # stage 2: anchors whose best IoU clears the threshold
+        best_gt = jnp.argmax(iou, axis=1).astype(jnp.int32)
+        best_iou = jnp.max(iou, axis=1)
+        thresh_match = (best_iou >= overlap_threshold) & (matched < 0)
+        matched = jnp.where(thresh_match, best_gt, matched)
+
+        is_pos = matched >= 0
+        gi = jnp.maximum(matched, 0)
+        g_center = _corner_to_center(gt_boxes[gi])
+        # encode offsets (the reference's variance-scaled parameterization)
+        tx = (g_center[:, 0] - a_center[:, 0]) / a_center[:, 2] / v[0]
+        ty = (g_center[:, 1] - a_center[:, 1]) / a_center[:, 3] / v[1]
+        tw = jnp.log(jnp.maximum(g_center[:, 2], 1e-12)
+                     / a_center[:, 2]) / v[2]
+        th = jnp.log(jnp.maximum(g_center[:, 3], 1e-12)
+                     / a_center[:, 3]) / v[3]
+        box_t = jnp.stack([tx, ty, tw, th], axis=-1) * is_pos[:, None]
+        box_m = jnp.broadcast_to(is_pos[:, None], (N, 4)).astype(jnp.float32)
+
+        cls_t = jnp.where(is_pos, lab[gi, 0] + 1.0, 0.0)
+        if negative_mining_ratio > 0:
+            # hard negative mining: among ELIGIBLE negatives (best IoU
+            # below negative_mining_thresh — near-matches are ignored,
+            # the reference contract), keep the ratio-capped hardest
+            # (lowest background confidence)
+            eligible = (~is_pos) & (best_iou < negative_mining_thresh)
+            bg_prob = jax.nn.softmax(cp, axis=0)[0]  # (N,)
+            num_pos = jnp.sum(is_pos)
+            max_neg = jnp.maximum(
+                (negative_mining_ratio * num_pos).astype(jnp.int32),
+                minimum_negative_samples)
+            neg_scores = jnp.where(eligible, bg_prob, jnp.inf)
+            rank = jnp.argsort(jnp.argsort(neg_scores))
+            keep_neg = eligible & (rank < max_neg)
+            cls_t = jnp.where(is_pos | keep_neg, cls_t, ignore_label)
+        return box_t.reshape(-1), box_m.reshape(-1), cls_t
+
+    return jax.vmap(per_image)(label, cls_pred)
+
+
+@register("MultiBoxDetection", aliases=("multibox_detection",
+                                        "_contrib_MultiBoxDetection"))
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True,
+                       threshold=0.01, background_id=0,
+                       nms_threshold=0.5, force_suppress=False,
+                       variances=_VARIANCES, nms_topk=-1):
+    """Decode + per-class NMS (reference: multibox_detection.cc).
+
+    cls_prob: (B, num_cls+1, N) softmax probs (class 0 background);
+    loc_pred: (B, N*4); anchor: (1, N, 4). Returns (B, N, 6) rows
+    [class_id, score, x1, y1, x2, y2], invalid rows class_id = -1."""
+    B = cls_prob.shape[0]
+    N = anchor.shape[1]
+    v = jnp.asarray(variances, jnp.float32)
+    a_center = _corner_to_center(anchor.reshape(-1, 4))
+
+    def per_image(cp, lp):
+        # decode
+        off = lp.reshape(N, 4)
+        cx = off[:, 0] * v[0] * a_center[:, 2] + a_center[:, 0]
+        cy = off[:, 1] * v[1] * a_center[:, 3] + a_center[:, 1]
+        w = jnp.exp(off[:, 2] * v[2]) * a_center[:, 2]
+        h = jnp.exp(off[:, 3] * v[3]) * a_center[:, 3]
+        boxes = _center_to_corner(jnp.stack([cx, cy, w, h], axis=-1))
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # argmax over ALL classes; background winning → invalid row.
+        # foreground ids renumber past the background row (bg=0 → id-1,
+        # the reference convention)
+        best_all = jnp.argmax(cp, axis=0)
+        fg = cp.at[background_id].set(-jnp.inf)
+        scores = jnp.max(fg, axis=0)
+        best_fg = jnp.argmax(fg, axis=0)
+        cls_id = jnp.where(best_fg > background_id, best_fg - 1,
+                           best_fg).astype(jnp.float32)
+        valid = (scores > threshold) & (best_all != background_id)
+        rows = jnp.concatenate([
+            jnp.where(valid, cls_id, -1.0)[:, None],
+            jnp.where(valid, scores, -1.0)[:, None], boxes], axis=-1)
+        out = box_nms(rows, overlap_thresh=nms_threshold, valid_thresh=0.0,
+                      topk=nms_topk, coord_start=2, score_index=1,
+                      id_index=0, force_suppress=force_suppress)
+        # box_nms marks suppressed via score/id -1; normalize class col
+        return out.at[:, 0].set(jnp.where(out[:, 1] > 0, out[:, 0], -1.0))
+
+    return jax.vmap(per_image)(cls_prob, loc_pred)
+
+
+def _bilinear(feat, y, x):
+    """feat: (C, H, W); y/x: scalar continuous coords → (C,) sample."""
+    H, W = feat.shape[1], feat.shape[2]
+    y = jnp.clip(y, 0.0, H - 1.0)
+    x = jnp.clip(x, 0.0, W - 1.0)
+    y0 = jnp.floor(y).astype(jnp.int32)
+    x0 = jnp.floor(x).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, H - 1)
+    x1 = jnp.minimum(x0 + 1, W - 1)
+    ly, lx = y - y0, x - x0
+    return (feat[:, y0, x0] * (1 - ly) * (1 - lx)
+            + feat[:, y0, x1] * (1 - ly) * lx
+            + feat[:, y1, x0] * ly * (1 - lx)
+            + feat[:, y1, x1] * ly * lx)
+
+
+@register("ROIAlign", aliases=("roi_align", "_contrib_ROIAlign"))
+def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+              sample_ratio=2, position_sensitive=False):
+    """RoIAlign (reference: roi_align.cc — bilinear-sampled average per
+    bin, no quantization). data: (B, C, H, W); rois: (R, 5)
+    [batch_idx, x1, y1, x2, y2] in image coords."""
+    if isinstance(pooled_size, int):
+        pooled_size = (pooled_size, pooled_size)
+    PH, PW = pooled_size
+    S = max(int(sample_ratio), 1)
+
+    def _ps_select(full):
+        """(C*PH*PW, PH, PW) → (C, PH, PW): bin (i, j) reads its own
+        channel group (R-FCN position-sensitive pooling)."""
+        C = full.shape[0] // (PH * PW)
+        grouped = full.reshape(C, PH * PW, PH, PW)
+        bin_idx = (jnp.arange(PH)[:, None] * PW
+                   + jnp.arange(PW)[None, :])  # (PH, PW)
+        idx = jnp.broadcast_to(bin_idx[None, None], (C, 1, PH, PW))
+        return jnp.take_along_axis(grouped, idx, axis=1)[:, 0]
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        feat = data[bidx]  # (C, H, W)
+        x1, y1, x2, y2 = roi[1] * spatial_scale, roi[2] * spatial_scale, \
+            roi[3] * spatial_scale, roi[4] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_h, bin_w = rh / PH, rw / PW
+        # S x S bilinear samples per bin, averaged
+        iy = jnp.arange(PH, dtype=jnp.float32)
+        ix = jnp.arange(PW, dtype=jnp.float32)
+        sy = (jnp.arange(S, dtype=jnp.float32) + 0.5) / S
+        sx = (jnp.arange(S, dtype=jnp.float32) + 0.5) / S
+        ys = y1 + (iy[:, None] + sy[None, :]) * bin_h  # (PH, S)
+        xs = x1 + (ix[:, None] + sx[None, :]) * bin_w  # (PW, S)
+        samp = jax.vmap(lambda yy: jax.vmap(
+            lambda xx: _bilinear(feat, yy, xx))(xs.reshape(-1)))(
+                ys.reshape(-1))  # (PH*S, PW*S, C)
+        samp = samp.reshape(PH, S, PW, S, -1)
+        out = jnp.mean(samp, axis=(1, 3)).transpose(2, 0, 1)  # (C,PH,PW)
+        if position_sensitive:
+            out = _ps_select(out)
+        return out
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("ROIPooling", aliases=("roi_pooling",))
+def roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
+    """RoI max pooling with the reference's quantized-bin semantics
+    (reference: roi_pooling.cc). TPU design: each rectangular bin's max is
+    two separable masked maxes (rows then cols) — exact integer-pixel
+    pooling with fully static shapes for XLA."""
+    if isinstance(pooled_size, int):
+        pooled_size = (pooled_size, pooled_size)
+    PH, PW = pooled_size
+    H, W = data.shape[2], data.shape[3]
+    neg = jnp.asarray(-jnp.inf, data.dtype)
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        feat = data[bidx]  # (C, H, W)
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        bin_h, bin_w = rh / PH, rw / PW
+        iy = jnp.arange(PH, dtype=jnp.float32)
+        ix = jnp.arange(PW, dtype=jnp.float32)
+        hs = jnp.floor(y1 + iy * bin_h)
+        he = jnp.maximum(jnp.ceil(y1 + (iy + 1) * bin_h), hs + 1)
+        ws = jnp.floor(x1 + ix * bin_w)
+        we = jnp.maximum(jnp.ceil(x1 + (ix + 1) * bin_w), ws + 1)
+        rows = jnp.arange(H, dtype=jnp.float32)
+        cols = jnp.arange(W, dtype=jnp.float32)
+        mask_y = (rows[None] >= hs[:, None]) & (rows[None] < he[:, None])
+        mask_x = (cols[None] >= ws[:, None]) & (cols[None] < we[:, None])
+        # separable rectangular max: over rows, then over cols
+        rowmax = jnp.max(jnp.where(mask_y[None, :, :, None],
+                                   feat[:, None, :, :], neg), axis=2)
+        out = jnp.max(jnp.where(mask_x[None, None, :, :],
+                                rowmax[:, :, None, :], neg), axis=3)
+        return jnp.where(jnp.isfinite(out), out, 0.0)  # empty bin -> 0
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("Proposal", aliases=("proposal", "_contrib_Proposal"))
+def proposal(cls_prob, bbox_pred, im_info, scales=(4, 8, 16, 32),
+             ratios=(0.5, 1.0, 2.0), rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             feature_stride=16):
+    """RPN proposal generation (reference: proposal.cc). cls_prob:
+    (B, 2*A, H, W); bbox_pred: (B, 4*A, H, W); im_info: (B, 3)
+    [height, width, scale]. Returns (B, post_top_n, 5)
+    [batch_idx, x1, y1, x2, y2] (fixed shape; invalid rows all-zero)."""
+    B, _, H, W = cls_prob.shape
+    A = len(scales) * len(ratios)
+
+    # base anchors centered on each stride cell (image coordinates)
+    base = []
+    cs = feature_stride / 2.0
+    for r in ratios:
+        for s in scales:
+            size = feature_stride * s
+            w_half = size * (1.0 / r) ** 0.5 / 2.0
+            h_half = size * (r ** 0.5) / 2.0
+            base.append([cs - w_half, cs - h_half, cs + w_half, cs + h_half])
+    base = jnp.asarray(base, jnp.float32)  # (A, 4)
+    shift_x = jnp.arange(W, dtype=jnp.float32) * feature_stride
+    shift_y = jnp.arange(H, dtype=jnp.float32) * feature_stride
+    sy, sx = jnp.meshgrid(shift_y, shift_x, indexing="ij")
+    shifts = jnp.stack([sx, sy, sx, sy], axis=-1).reshape(-1, 1, 4)
+    anchors = (base[None] + shifts).reshape(-1, 4)  # (H*W*A, 4)
+
+    def per_image(cp, bp, info):
+        scores = cp[A:].transpose(1, 2, 0).reshape(-1)  # fg scores
+        deltas = bp.transpose(1, 2, 0).reshape(-1, 4)
+        ac = _corner_to_center(anchors)
+        cx = deltas[:, 0] * ac[:, 2] + ac[:, 0]
+        cy = deltas[:, 1] * ac[:, 3] + ac[:, 1]
+        w = jnp.exp(jnp.clip(deltas[:, 2], -10, 10)) * ac[:, 2]
+        h = jnp.exp(jnp.clip(deltas[:, 3], -10, 10)) * ac[:, 3]
+        boxes = _center_to_corner(jnp.stack([cx, cy, w, h], -1))
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, info[1] - 1),
+            jnp.clip(boxes[:, 1], 0, info[0] - 1),
+            jnp.clip(boxes[:, 2], 0, info[1] - 1),
+            jnp.clip(boxes[:, 3], 0, info[0] - 1)], -1)
+        min_size = rpn_min_size * info[2]
+        ok = ((boxes[:, 2] - boxes[:, 0] + 1) >= min_size) & \
+             ((boxes[:, 3] - boxes[:, 1] + 1) >= min_size)
+        scores = jnp.where(ok, scores, -1.0)
+
+        k = min(rpn_pre_nms_top_n, scores.shape[0])
+        top_scores, idx = lax.top_k(scores, k)
+        top_boxes = boxes[idx]
+        keep, order = _nms_one(top_boxes, top_scores,
+                               jnp.zeros_like(top_scores), threshold,
+                               -1.0, -1, True)
+        kept_scores = jnp.where(keep, top_scores[order], -1.0)
+        kept_boxes = top_boxes[order]
+        k2 = min(rpn_post_nms_top_n, kept_scores.shape[0])
+        _, idx2 = lax.top_k(kept_scores, k2)
+        final = kept_boxes[idx2] * (kept_scores[idx2] > 0)[:, None]
+        pad = rpn_post_nms_top_n - k2
+        if pad > 0:
+            final = jnp.pad(final, ((0, pad), (0, 0)))
+        return final
+
+    out = jax.vmap(per_image)(cls_prob, bbox_pred, im_info)
+    bidx = jnp.broadcast_to(
+        jnp.arange(B, dtype=out.dtype)[:, None, None],
+        (B, out.shape[1], 1))
+    return jnp.concatenate([bidx, out], axis=-1)

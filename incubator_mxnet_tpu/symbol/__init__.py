@@ -148,3 +148,8 @@ def save_block_symbol(block, path: str, epoch: int = 0,
         elif full in arg_names:
             payload["arg:" + full] = p.data()
     nd_save(f"{path}-{epoch:04d}.params", payload)
+
+
+# contrib namespace (parity: mx.sym.contrib) — imported last so
+# _make_symbol_function exists
+from . import contrib  # noqa: E402,F401

@@ -1,0 +1,23 @@
+"""``mx.sym.contrib`` — contrib op namespace (symbolic twin of
+`python/mxnet/symbol/contrib.py`)."""
+
+from __future__ import annotations
+
+import sys as _sys
+
+from ..ops import registry as _registry
+
+_THIS = _sys.modules[__name__]
+
+
+def _make(op_name, public):
+    from . import _make_symbol_function
+    return _make_symbol_function(op_name, public)
+
+
+for _name in _registry.list_all_names():
+    if _name.startswith("_contrib_"):
+        _short = _name[len("_contrib_"):]
+        if not hasattr(_THIS, _short):
+            _spec = _registry.get(_name)
+            setattr(_THIS, _short, _make(_spec.name, _short))

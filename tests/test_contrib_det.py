@@ -1,0 +1,235 @@
+"""Detection op tests (reference strategy: numpy oracles —
+tests/python/unittest/test_contrib_operator.py)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+def _np_iou(a, b):
+    lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    aa = np.maximum(a[:, 2] - a[:, 0], 0) * np.maximum(a[:, 3] - a[:, 1], 0)
+    ab = np.maximum(b[:, 2] - b[:, 0], 0) * np.maximum(b[:, 3] - b[:, 1], 0)
+    union = aa[:, None] + ab[None, :] - inter
+    return np.where(union > 0, inter / union, 0)
+
+
+def test_box_iou_matches_numpy():
+    rng = np.random.RandomState(0)
+    a = np.sort(rng.rand(5, 2, 2), axis=-1).reshape(5, 4)[:, [0, 2, 1, 3]]
+    b = np.sort(rng.rand(7, 2, 2), axis=-1).reshape(7, 4)[:, [0, 2, 1, 3]]
+    got = nd.contrib.box_iou(nd.array(a), nd.array(b)).asnumpy()
+    np.testing.assert_allclose(got, _np_iou(a, b), rtol=1e-5, atol=1e-6)
+
+
+def test_multibox_prior_shapes_and_values():
+    feat = nd.zeros((1, 8, 4, 4))
+    anchors = nd.contrib.MultiBoxPrior(feat, sizes=(0.5, 0.25),
+                                       ratios=(1, 2), clip=True)
+    # S + R - 1 = 3 anchors per cell
+    assert anchors.shape == (1, 4 * 4 * 3, 4)
+    a = anchors.asnumpy()[0]
+    assert (a >= 0).all() and (a <= 1).all()
+    # first cell center is (0.125, 0.125); first anchor size 0.5 ratio 1
+    np.testing.assert_allclose(a[0], [0, 0, 0.375, 0.375], atol=1e-6)
+
+
+def test_box_nms_suppresses_overlaps():
+    rows = np.array([
+        # cls, score, x1, y1, x2, y2
+        [0, 0.9, 0.1, 0.1, 0.5, 0.5],
+        [0, 0.8, 0.12, 0.12, 0.52, 0.52],  # overlaps first -> suppressed
+        [0, 0.7, 0.6, 0.6, 0.9, 0.9],      # separate -> kept
+        [1, 0.6, 0.1, 0.1, 0.5, 0.5],      # other class -> kept
+    ], np.float32)[None]
+    out = nd.contrib.box_nms(nd.array(rows), overlap_thresh=0.5,
+                             coord_start=2, score_index=1,
+                             id_index=0).asnumpy()[0]
+    scores = out[:, 1]
+    kept = scores[scores > 0]
+    assert len(kept) == 3
+    assert 0.8 not in kept
+
+    # force_suppress ignores class ids
+    out2 = nd.contrib.box_nms(nd.array(rows), overlap_thresh=0.5,
+                              coord_start=2, score_index=1, id_index=0,
+                              force_suppress=True).asnumpy()[0]
+    assert (out2[:, 1] > 0).sum() == 2
+
+
+def test_multibox_target_basic():
+    anchors = np.array([[[0.1, 0.1, 0.4, 0.4],
+                         [0.6, 0.6, 0.9, 0.9],
+                         [0.0, 0.0, 0.05, 0.05]]], np.float32)
+    # one gt overlapping anchor 0 (class 2), padding row
+    label = np.array([[[2, 0.12, 0.12, 0.42, 0.42],
+                       [-1, 0, 0, 0, 0]]], np.float32)
+    cls_pred = np.zeros((1, 4, 3), np.float32)
+    bt, bm, ct = nd.contrib.MultiBoxTarget(nd.array(anchors),
+                                           nd.array(label),
+                                           nd.array(cls_pred))
+    ct = ct.asnumpy()[0]
+    bm = bm.asnumpy()[0].reshape(3, 4)
+    assert ct[0] == 3.0          # class 2 -> target 3 (background=0)
+    assert ct[1] == 0.0 and ct[2] == 0.0
+    assert bm[0].sum() == 4 and bm[1].sum() == 0
+    bt = bt.asnumpy()[0].reshape(3, 4)
+    assert np.abs(bt[0]).sum() > 0  # nonzero offsets for matched anchor
+
+
+def test_multibox_detection_decodes():
+    anchors = np.array([[[0.1, 0.1, 0.4, 0.4],
+                         [0.6, 0.6, 0.9, 0.9]]], np.float32)
+    # probs: anchor0 -> class1 confident; anchor1 -> background
+    cls_prob = np.array([[[0.1, 0.9],
+                          [0.8, 0.05],
+                          [0.1, 0.05]]], np.float32)
+    loc = np.zeros((1, 8), np.float32)
+    out = nd.contrib.MultiBoxDetection(nd.array(cls_prob), nd.array(loc),
+                                       nd.array(anchors)).asnumpy()[0]
+    valid = out[out[:, 0] >= 0]
+    assert len(valid) == 1
+    assert valid[0, 0] == 0.0          # class id 0 (= class index 1 - 1)
+    assert abs(valid[0, 1] - 0.8) < 1e-5
+    np.testing.assert_allclose(valid[0, 2:], [0.1, 0.1, 0.4, 0.4],
+                               atol=1e-5)
+
+
+def test_roi_align_uniform_feature():
+    # constant feature map -> every pooled value equals the constant
+    data = np.full((1, 3, 16, 16), 2.5, np.float32)
+    rois = np.array([[0, 2, 2, 10, 10]], np.float32)
+    out = nd.contrib.ROIAlign(nd.array(data), nd.array(rois),
+                              pooled_size=(4, 4),
+                              spatial_scale=1.0).asnumpy()
+    assert out.shape == (1, 3, 4, 4)
+    np.testing.assert_allclose(out, 2.5, atol=1e-5)
+
+
+def test_roi_align_gradient_center():
+    # linear ramp feature: pooled bin centers must interpolate the ramp
+    H = W = 8
+    ramp = np.arange(W, dtype=np.float32)[None, None, None, :]
+    data = np.broadcast_to(ramp, (1, 1, H, W)).copy()
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    out = nd.contrib.ROIAlign(nd.array(data), nd.array(rois),
+                              pooled_size=(7, 7), spatial_scale=1.0,
+                              sample_ratio=1).asnumpy()[0, 0]
+    # each column ~ constant, increasing left->right
+    assert (np.diff(out.mean(axis=0)) > 0).all()
+
+
+def test_roi_pooling_max_semantics():
+    data = np.zeros((1, 1, 8, 8), np.float32)
+    data[0, 0, 3, 3] = 5.0
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    out = nd.ROIPooling(nd.array(data), nd.array(rois),
+                        pooled_size=(2, 2), spatial_scale=1.0).asnumpy()
+    assert out.max() == pytest.approx(5.0, abs=1e-4)
+
+
+def test_proposal_shapes():
+    B, A, H, W = 1, 9, 4, 4
+    rng = np.random.RandomState(0)
+    cls = rng.rand(B, 2 * A, H, W).astype(np.float32)
+    bbox = (rng.randn(B, 4 * A, H, W) * 0.1).astype(np.float32)
+    info = np.array([[64, 64, 1.0]], np.float32)
+    out = nd.contrib.Proposal(nd.array(cls), nd.array(bbox), nd.array(info),
+                              scales=(8, 16, 32), ratios=(0.5, 1.0, 2.0),
+                              rpn_pre_nms_top_n=50,
+                              rpn_post_nms_top_n=10).asnumpy()
+    assert out.shape == (1, 10, 5)
+    boxes = out[0, :, 1:]
+    assert (boxes[:, 2] >= boxes[:, 0]).all()
+    assert (boxes >= 0).all() and (boxes[:, [0, 2]] <= 64).all()
+
+
+def test_box_nms_symbolic():
+    rows = mx.sym.Variable("rows")
+    s = mx.sym.contrib.box_nms(rows, overlap_thresh=0.5, coord_start=2,
+                               score_index=1, id_index=0)
+    exe = s.bind(args={"rows": nd.array(np.array([[
+        [0, 0.9, 0.1, 0.1, 0.5, 0.5],
+        [0, 0.8, 0.12, 0.12, 0.52, 0.52]]], np.float32))},
+        grad_req="null")
+    out = exe.forward(is_train=False)[0].asnumpy()
+    assert (out[0, :, 1] > 0).sum() == 1
+
+
+def test_ssd_end_to_end():
+    from incubator_mxnet_tpu.models.ssd import ssd_300
+    from incubator_mxnet_tpu import autograd, gluon
+
+    net = ssd_300(num_classes=3)
+    net.initialize()
+    x = nd.random.uniform(shape=(2, 3, 64, 64))
+    anchors, cls_preds, box_preds = net(x)
+    N = anchors.shape[1]
+    assert cls_preds.shape == (2, 4, N)
+    assert box_preds.shape == (2, N * 4)
+
+    labels = nd.array(np.array([
+        [[1, 0.1, 0.1, 0.4, 0.4], [-1, 0, 0, 0, 0]],
+        [[0, 0.5, 0.5, 0.9, 0.9], [2, 0.1, 0.6, 0.3, 0.9]]], np.float32))
+    bt, bm, ct = net.training_targets(anchors, cls_preds, labels)
+    assert ct.shape == (2, N) and bt.shape == (2, N * 4)
+
+    # one training step on the joint loss (ignore labels masked out)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    with autograd.record():
+        a, cp, bp = net(x)
+        btg, bmk, ctg = net.training_targets(a, cp, labels)
+        loss = net.loss(cp, bp, btg, bmk, ctg)
+    loss.backward()
+    tr.step(2)
+    assert (ctg.asnumpy() == -1).any()  # mining produced ignores
+
+    dets = net.detect(cls_preds, box_preds, anchors)
+    assert dets.shape == (2, N, 6)
+
+
+def test_multibox_target_symbolic_three_outputs():
+    a = mx.sym.Variable("a")
+    l = mx.sym.Variable("l")
+    p = mx.sym.Variable("p")
+    s = mx.sym.contrib.MultiBoxTarget(a, l, p)
+    assert len(s.list_outputs()) == 3
+
+
+def test_box_nms_out_format_and_background():
+    rows = np.array([[
+        [0, 0.9, 0.25, 0.25, 0.2, 0.2],   # center-format box, class 0
+        [1, 0.8, 0.75, 0.75, 0.2, 0.2],   # class 1
+    ]], np.float32)
+    out = nd.contrib.box_nms(nd.array(rows), in_format="center",
+                             out_format="corner", coord_start=2,
+                             score_index=1, id_index=0,
+                             background_id=0).asnumpy()[0]
+    kept = out[out[:, 1] > 0]
+    assert len(kept) == 1  # background class row dropped
+    np.testing.assert_allclose(kept[0, 2:], [0.65, 0.65, 0.85, 0.85],
+                               atol=1e-5)
+
+
+def test_ps_roi_align():
+    C, PH = 2, 2
+    data = np.zeros((1, C * PH * PH, 4, 4), np.float32)
+    # channel group k holds constant value k
+    for k in range(C * PH * PH):
+        data[0, k] = k
+    rois = np.array([[0, 0, 0, 3, 3]], np.float32)
+    out = nd.contrib.ROIAlign(nd.array(data), nd.array(rois),
+                              pooled_size=(PH, PH), spatial_scale=1.0,
+                              position_sensitive=True).asnumpy()
+    assert out.shape == (1, C, PH, PH)
+    # bin (i,j) of channel c must read group c*4 + i*2 + j
+    for c in range(C):
+        for i in range(PH):
+            for j in range(PH):
+                assert out[0, c, i, j] == pytest.approx(c * 4 + i * 2 + j)
