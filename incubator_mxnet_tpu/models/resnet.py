@@ -1,0 +1,17 @@
+"""ResNet entry point for the benchmark configs (BASELINE.md config #2).
+
+The canonical implementations live in the Gluon model zoo
+(gluon/model_zoo/vision/resnet.py, parity with
+`python/mxnet/gluon/model_zoo/vision/resnet.py`); this module re-exports
+them under ``mx.models.resnet`` for the driver/bench scripts."""
+
+from ..gluon.model_zoo.vision.resnet import (  # noqa: F401
+    BasicBlockV1, BasicBlockV2, BottleneckV1, BottleneckV2, ResNetV1,
+    ResNetV2, get_resnet, resnet18_v1, resnet34_v1, resnet50_v1,
+    resnet101_v1, resnet152_v1, resnet18_v2, resnet34_v2, resnet50_v2,
+    resnet101_v2, resnet152_v2)
+
+__all__ = ["ResNetV1", "ResNetV2", "get_resnet", "resnet18_v1",
+           "resnet34_v1", "resnet50_v1", "resnet101_v1", "resnet152_v1",
+           "resnet18_v2", "resnet34_v2", "resnet50_v2", "resnet101_v2",
+           "resnet152_v2"]

@@ -1,0 +1,331 @@
+"""Transformer for NMT (BASELINE.md config #4: WMT14 En-De, attention +
+beam search; reference: the fused attention ops in
+`src/operator/contrib/transformer.cc` and the GluonNLP transformer
+scripts the baselines cite — file-level citations, SURVEY.md caveat).
+
+TPU-native design:
+  - encoder/decoder layers are HybridBlocks over ONE fused
+    ``scaled_dot_product_attention`` op (ops/attention.py) — XLA fuses
+    the whole block onto the MXU; ``flash=True`` switches to the
+    blockwise streaming kernel slot for long sequences;
+  - beam search is a single ``lax.fori_loop`` program over a fixed
+    ``max_length`` — fixed shapes, no host round-trips per step, jitted
+    once per (batch, beam, length) signature.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from ..ndarray import NDArray
+
+__all__ = ["TransformerModel", "TransformerEncoder", "TransformerDecoder",
+           "transformer_base", "transformer_big", "beam_search_translate"]
+
+
+def _positional_encoding(max_len, units):
+    pos = jnp.arange(max_len, dtype=jnp.float32)[:, None]
+    dim = jnp.arange(0, units, 2, dtype=jnp.float32)[None, :]
+    angle = pos / jnp.power(10000.0, dim / units)
+    pe = jnp.zeros((max_len, units))
+    pe = pe.at[:, 0::2].set(jnp.sin(angle))
+    pe = pe.at[:, 1::2].set(jnp.cos(angle[:, : units // 2]))
+    return pe
+
+
+class MultiHeadAttention(HybridBlock):
+    """Projection + fused SDPA (+ cross-attention when kv differs)."""
+
+    def __init__(self, units, num_heads, dropout=0.1, causal=False,
+                 flash=False, **kwargs):
+        super().__init__(**kwargs)
+        if units % num_heads:
+            raise MXNetError(f"units {units} % heads {num_heads} != 0")
+        self._units = units
+        self._heads = num_heads
+        self._causal = causal
+        self._flash = flash
+        with self.name_scope():
+            self.q_proj = nn.Dense(units, in_units=units, flatten=False)
+            self.k_proj = nn.Dense(units, in_units=units, flatten=False)
+            self.v_proj = nn.Dense(units, in_units=units, flatten=False)
+            self.out_proj = nn.Dense(units, in_units=units, flatten=False)
+            self.dropout = nn.Dropout(dropout)
+
+    def hybrid_forward(self, F, query, kv=None, mask=None):
+        if kv is None:
+            kv = query
+        B, Tq = query.shape[0], query.shape[1]
+        Tk = kv.shape[1]
+        H, D = self._heads, self._units // self._heads
+        q = self.q_proj(query).reshape((B, Tq, H, D))
+        k = self.k_proj(kv).reshape((B, Tk, H, D))
+        v = self.v_proj(kv).reshape((B, Tk, H, D))
+        out = F.scaled_dot_product_attention(q, k, v, mask=mask,
+                                             causal=self._causal,
+                                             flash=self._flash)
+        return self.dropout(self.out_proj(out.reshape((B, Tq,
+                                                       self._units))))
+
+
+class _FFN(HybridBlock):
+    def __init__(self, units, hidden_size, dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.fc1 = nn.Dense(hidden_size, in_units=units, flatten=False,
+                                activation="relu")
+            self.fc2 = nn.Dense(units, in_units=hidden_size, flatten=False)
+            self.dropout = nn.Dropout(dropout)
+
+    def hybrid_forward(self, F, x):
+        return self.dropout(self.fc2(self.fc1(x)))
+
+
+class EncoderLayer(HybridBlock):
+    def __init__(self, units, hidden_size, num_heads, dropout=0.1,
+                 flash=False, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.attn = MultiHeadAttention(units, num_heads, dropout,
+                                           flash=flash)
+            self.ffn = _FFN(units, hidden_size, dropout)
+            self.ln1 = nn.LayerNorm(in_channels=units)
+            self.ln2 = nn.LayerNorm(in_channels=units)
+
+    def hybrid_forward(self, F, x, mask=None):
+        x = self.ln1(x + self.attn(x, None, mask))
+        return self.ln2(x + self.ffn(x))
+
+
+class DecoderLayer(HybridBlock):
+    def __init__(self, units, hidden_size, num_heads, dropout=0.1,
+                 flash=False, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.self_attn = MultiHeadAttention(units, num_heads, dropout,
+                                                causal=True, flash=flash)
+            self.cross_attn = MultiHeadAttention(units, num_heads, dropout)
+            self.ffn = _FFN(units, hidden_size, dropout)
+            self.ln1 = nn.LayerNorm(in_channels=units)
+            self.ln2 = nn.LayerNorm(in_channels=units)
+            self.ln3 = nn.LayerNorm(in_channels=units)
+
+    def hybrid_forward(self, F, x, memory, src_mask=None):
+        x = self.ln1(x + self.self_attn(x))
+        x = self.ln2(x + self.cross_attn(x, memory, src_mask))
+        return self.ln3(x + self.ffn(x))
+
+
+class TransformerEncoder(HybridBlock):
+    def __init__(self, vocab_size, units, hidden_size, num_heads,
+                 num_layers, max_length=512, dropout=0.1, flash=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._max_length = max_length
+        self._flash = flash
+        self._pe = _positional_encoding(max_length, units)  # built once
+        with self.name_scope():
+            self.embed = nn.Embedding(vocab_size, units)
+            self.dropout = nn.Dropout(dropout)
+            self.layers = nn.HybridSequential(prefix="layers_")
+            with self.layers.name_scope():
+                for _ in range(num_layers):
+                    self.layers.add(EncoderLayer(units, hidden_size,
+                                                 num_heads, dropout,
+                                                 flash=flash))
+
+    def hybrid_forward(self, F, src, src_mask=None):
+        T = src.shape[1]
+        if T > self._max_length:
+            raise MXNetError(
+                f"sequence length {T} exceeds max_length "
+                f"{self._max_length}")
+        x = self.embed(src) * math.sqrt(self._units)
+        x = self.dropout(x + NDArray(self._pe[:T]))
+        for layer in self.layers:
+            x = layer(x, src_mask)
+        return x
+
+
+class TransformerDecoder(HybridBlock):
+    def __init__(self, vocab_size, units, hidden_size, num_heads,
+                 num_layers, max_length=512, dropout=0.1, flash=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._max_length = max_length
+        self._flash = flash
+        self._pe = _positional_encoding(max_length, units)  # built once
+        with self.name_scope():
+            self.embed = nn.Embedding(vocab_size, units)
+            self.dropout = nn.Dropout(dropout)
+            self.layers = nn.HybridSequential(prefix="layers_")
+            with self.layers.name_scope():
+                for _ in range(num_layers):
+                    self.layers.add(DecoderLayer(units, hidden_size,
+                                                 num_heads, dropout,
+                                                 flash=flash))
+            self.proj = nn.Dense(vocab_size, in_units=units, flatten=False)
+
+    def hybrid_forward(self, F, tgt, memory, src_mask=None):
+        T = tgt.shape[1]
+        if T > self._max_length:
+            raise MXNetError(
+                f"sequence length {T} exceeds max_length "
+                f"{self._max_length}")
+        x = self.embed(tgt) * math.sqrt(self._units)
+        x = self.dropout(x + NDArray(self._pe[:T]))
+        for layer in self.layers:
+            x = layer(x, memory, src_mask)
+        return self.proj(x)
+
+
+class TransformerModel(HybridBlock):
+    """Encoder-decoder NMT transformer (Vaswani et al. 2017 layout).
+
+    ``forward(src, tgt)`` → logits (B, Tt, tgt_vocab). Source padding is
+    masked via ``src_valid_length``.
+    """
+
+    def __init__(self, src_vocab=36000, tgt_vocab=36000, units=512,
+                 hidden_size=2048, num_heads=8, num_layers=6,
+                 max_length=512, dropout=0.1, flash=False, **kwargs):
+        super().__init__(**kwargs)
+        self.units = units
+        self.tgt_vocab = tgt_vocab
+        with self.name_scope():
+            self.encoder = TransformerEncoder(src_vocab, units, hidden_size,
+                                              num_heads, num_layers,
+                                              max_length, dropout,
+                                              flash=flash)
+            self.decoder = TransformerDecoder(tgt_vocab, units, hidden_size,
+                                              num_heads, num_layers,
+                                              max_length, dropout,
+                                              flash=flash)
+
+    def _src_mask(self, F, src, src_valid_length):
+        if src_valid_length is None:
+            return None
+        T = src.shape[1]
+        pos = F.arange(0, T).reshape((1, T))
+        return F.broadcast_lesser(pos, src_valid_length.reshape((-1, 1)))
+
+    def hybrid_forward(self, F, src, tgt, src_valid_length=None):
+        mask = self._src_mask(F, src, src_valid_length)
+        memory = self.encoder(src, mask)
+        return self.decoder(tgt, memory, mask)
+
+    def encode(self, src, src_valid_length=None):
+        from .. import ndarray as nd
+        mask = self._src_mask(nd, src, src_valid_length)
+        return self.encoder(src, mask), mask
+
+
+def transformer_base(**kwargs):
+    """The WMT14 'base' config (512/2048/8 heads/6 layers)."""
+    return TransformerModel(units=512, hidden_size=2048, num_heads=8,
+                            num_layers=6, **kwargs)
+
+
+def transformer_big(**kwargs):
+    """The WMT14 'big' config (1024/4096/16 heads/6 layers)."""
+    return TransformerModel(units=1024, hidden_size=4096, num_heads=16,
+                            num_layers=6, **kwargs)
+
+
+# ------------------------------------------------------------------ #
+# Beam search (reference: GluonNLP BeamSearchTranslator semantics) —
+# one fixed-shape XLA program per signature.
+# ------------------------------------------------------------------ #
+def beam_search_translate(model: TransformerModel, src, beam_size=4,
+                          max_length=32, bos_id=1, eos_id=2, alpha=0.6,
+                          src_valid_length=None):
+    """Length-penalized beam search decode.
+
+    src: (B, Ts) int tokens. Returns (tokens (B, K, max_length), scores
+    (B, K)) sorted best-first; sequences end at ``eos_id``.
+
+    The whole search is one jitted ``fori_loop``: scores/tokens live on
+    device, finished beams are frozen by masking continuations, and the
+    length penalty ((5+len)/6)^alpha matches GNMT/GluonNLP.
+    """
+    from .. import ndarray as _nd
+
+    src = src._data if isinstance(src, NDArray) else jnp.asarray(src)
+    B, Ts = src.shape
+    K, V = beam_size, model.tgt_vocab
+    if max_length + 1 > model.decoder._max_length:
+        raise MXNetError(
+            f"beam search max_length {max_length} needs a decoder "
+            f"max_length of at least {max_length + 1} "
+            f"(model has {model.decoder._max_length})")
+
+    memory, mask = model.encode(
+        NDArray(src), None if src_valid_length is None
+        else src_valid_length)
+    memory = memory._data
+    mask_arr = None if mask is None else mask._data
+
+    # collect decoder params once; the decode step is a pure function of
+    # them (hybridize-style trace under the hood)
+    def decode_logits(tokens_flat):
+        """(B*K, Tmax) → (B*K, Tmax, V) logits (causal attention makes
+        positions past the current step inert — fixed shapes for the
+        fori_loop body, dynamic index picks the live position)."""
+        mem = jnp.repeat(memory, K, axis=0)
+        m = None if mask_arr is None else jnp.repeat(mask_arr, K, axis=0)
+        logits = model.decoder(NDArray(tokens_flat), NDArray(mem),
+                               None if m is None else NDArray(m))
+        return logits._data
+
+    tokens = jnp.full((B, K, max_length + 1), eos_id, jnp.int32)
+    tokens = tokens.at[:, :, 0].set(bos_id)
+    scores = jnp.tile(jnp.asarray([[0.0] + [-1e9] * (K - 1)]), (B, 1))
+    finished = jnp.zeros((B, K), bool)
+
+    neg_inf = -1e9
+
+    def step(t, state):
+        tokens, scores, finished = state
+        all_logits = decode_logits(tokens.reshape(B * K, -1))
+        logp = jax.nn.log_softmax(all_logits[:, t, :], axis=-1)
+        logp = logp.reshape(B, K, V)
+        # finished beams may only emit EOS at zero cost
+        eos_only = jnp.full((V,), neg_inf).at[eos_id].set(0.0)
+        logp = jnp.where(finished[:, :, None], eos_only[None, None], logp)
+        cand = scores[:, :, None] + logp                  # (B, K, V)
+        flat = cand.reshape(B, K * V)
+        top_scores, top_idx = lax.top_k(flat, K)
+        beam_idx = top_idx // V
+        tok_idx = top_idx % V
+        tokens = jnp.take_along_axis(
+            tokens, beam_idx[:, :, None], axis=1)
+        tokens = tokens.at[:, :, t + 1].set(tok_idx)
+        finished = jnp.take_along_axis(finished, beam_idx, axis=1) | \
+            (tok_idx == eos_id)
+        return tokens, top_scores, finished
+
+    def cond_body(t, state):
+        return step(t, state)
+
+    tokens, scores, finished = lax.fori_loop(
+        0, max_length, cond_body, (tokens, scores, finished))
+
+    # length penalty over the actual generated lengths
+    lengths = jnp.argmax(tokens[:, :, 1:] == eos_id, axis=-1) + 1
+    lengths = jnp.where(jnp.any(tokens[:, :, 1:] == eos_id, axis=-1),
+                        lengths, max_length)
+    lp = jnp.power((5.0 + lengths.astype(jnp.float32)) / 6.0, alpha)
+    final = scores / lp
+    order = jnp.argsort(-final, axis=1)
+    tokens = jnp.take_along_axis(tokens, order[:, :, None], axis=1)
+    final = jnp.take_along_axis(final, order, axis=1)
+    return _nd.NDArray(tokens[:, :, 1:]), _nd.NDArray(final)
