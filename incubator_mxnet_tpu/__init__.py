@@ -49,7 +49,7 @@ _LAZY_SUBMODULES = (
     "gluon", "symbol", "sym", "optimizer", "kvstore", "metric", "io", "image",
     "initializer", "lr_scheduler", "profiler", "amp", "parallel", "models",
     "runtime", "test_utils", "callback", "util", "engine", "recordio",
-    "numpy", "np", "npx", "module", "mod", "model", "executor",
+    "numpy", "np", "npx", "module", "mod", "model", "executor", "kv",
 )
 
 
@@ -58,7 +58,8 @@ def __getattr__(name):
     if name in _LAZY_SUBMODULES:
         import importlib
 
-        alias = {"sym": ".symbol", "npx": ".numpy_extension",
+        alias = {"sym": ".symbol", "kv": ".kvstore",
+                 "npx": ".numpy_extension",
                  "numpy": ".numpy_shim", "np": ".numpy_shim",
                  "recordio": ".io.recordio",
                  "lr_scheduler": ".optimizer.lr_scheduler",

@@ -53,6 +53,8 @@ class Parameter:
         self._grad: Optional[NDArray] = None
         self._deferred_init = None  # (initializer, ctx)
         self._sharding = None  # optional PartitionSpec hint (parallel/)
+        self._stype = stype
+        self._grad_stype = grad_stype  # 'row_sparse' → lazy optimizer rows
 
     # ------------------------------------------------------------------ #
     @property

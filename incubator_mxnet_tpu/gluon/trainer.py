@@ -114,7 +114,13 @@ class Trainer:
         for i, p in enumerate(self._params):
             if p.grad_req == "null":
                 continue
-            updater(i, p.grad(), p.data())
+            grad = p.grad()
+            if getattr(p, "_grad_stype", "default") == "row_sparse":
+                # sparse-embedding contract (SURVEY.md §2.3 last row):
+                # convert to active rows so the optimizer touches only them
+                from ..ndarray import sparse as _sparse
+                grad = _sparse.cast_storage(grad, "row_sparse")
+            updater(i, grad, p.data())
 
     def update(self, batch_size, ignore_stale_grad=False):
         self._init_kvstore()

@@ -161,14 +161,27 @@ class KVStore(_base.KVStoreBase):
             return self.pull(key, out=out, priority=priority)
         keys, outs = self._normalize(key, out)
         rids = row_ids if _is_list(row_ids) else [row_ids] * len(keys)
+        from ..ndarray.sparse import RowSparseNDArray
+        import numpy as _np
         for k, o, r in zip(keys, outs, rids):
             src = self._data[k]
-            idx = r._data.astype(jnp.int32)
+            # sorted + deduped (the RowSparseNDArray invariant)
+            idx = jnp.asarray(_np.unique(_np.asarray(r._data))
+                              .astype(_np.int32))
             gathered = jnp.take(src._data, idx, axis=0, mode="clip")
             targets = o if _is_list(o) else [o]
             for t in targets:
-                t._data = jnp.zeros_like(t._data).at[idx].set(
-                    gathered.astype(t.dtype))
+                if isinstance(t, RowSparseNDArray):
+                    # fill the sparse components in place: only the
+                    # requested rows travel (the reference's sparse-pull
+                    # bandwidth contract)
+                    t._sp_indices = idx.astype(jnp.int32)
+                    t._sp_values = gathered.astype(t.dtype)
+                    t._data = jnp.zeros(t.shape, t.dtype).at[idx].set(
+                        t._sp_values)
+                else:
+                    t._data = jnp.zeros_like(t._data).at[idx].set(
+                        gathered.astype(t.dtype))
 
     # -- optimizer ------------------------------------------------------- #
     def set_optimizer(self, optimizer):

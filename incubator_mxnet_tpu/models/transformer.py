@@ -313,11 +313,8 @@ def beam_search_translate(model: TransformerModel, src, beam_size=4,
             (tok_idx == eos_id)
         return tokens, top_scores, finished
 
-    def cond_body(t, state):
-        return step(t, state)
-
     tokens, scores, finished = lax.fori_loop(
-        0, max_length, cond_body, (tokens, scores, finished))
+        0, max_length, step, (tokens, scores, finished))
 
     # length penalty over the actual generated lengths
     lengths = jnp.argmax(tokens[:, :, 1:] == eos_id, axis=-1) + 1

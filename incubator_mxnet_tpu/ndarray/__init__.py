@@ -161,3 +161,7 @@ random = _RandomNS()
 
 # contrib namespace (parity: mx.nd.contrib)
 from . import contrib  # noqa: E402,F401
+
+# sparse storage types (parity: mx.nd.sparse)
+from . import sparse  # noqa: E402,F401
+from . import linalg  # noqa: E402,F401

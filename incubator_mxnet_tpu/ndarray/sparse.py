@@ -1,0 +1,266 @@
+"""Sparse NDArray storage types: ``row_sparse`` and ``csr``.
+
+Parity target: the reference's sparse storage (`include/mxnet/ndarray.h`
+storage types, `src/operator/tensor/cast_storage-inl.h`, sparse dot in
+`src/operator/tensor/dot-inl.h`, python surface
+`python/mxnet/ndarray/sparse.py` — file-level citations, SURVEY.md caveat).
+
+TPU-native design (SURVEY.md §7.2 "row_sparse"): XLA has no sparse
+tensors — the idiomatic TPU mapping is *dense gather/scatter over the
+active-row index set*. These classes therefore keep the reference's
+storage contract (indices/data components, ``stype``, ``retain``,
+``cast_storage``, sparse ``dot``) as the API, materialize a dense mirror
+for compute interop, and guarantee the part that matters for performance:
+**optimizer updates and KVStore pulls touch only the active rows**
+(optimizer.py lazy updates, kvstore.row_sparse_pull)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError
+from .ndarray import NDArray, _as_jax, _to_jnp_dtype
+
+__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+           "row_sparse_array", "csr_matrix", "cast_storage", "retain",
+           "zeros", "array", "dot"]
+
+
+class BaseSparseNDArray(NDArray):
+    """Common surface for sparse storage types."""
+
+    __slots__ = ()
+
+    @property
+    def stype(self):
+        raise NotImplementedError
+
+    def tostype(self, stype):
+        if stype == self.stype:
+            return self
+        if stype == "default":
+            return NDArray(self._data)
+        return cast_storage(NDArray(self._data), stype)
+
+    def __repr__(self):
+        return (f"\n<{type(self).__name__} {self.shape} "
+                f"nnz={self.nnz}>")
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Rows at ``indices`` hold ``data``; all other rows are zero
+    (parity: mx.nd.sparse.RowSparseNDArray)."""
+
+    __slots__ = ("_sp_indices", "_sp_values")
+
+    def __init__(self, data, indices, shape):
+        values = _as_jax(data)
+        idx = _as_jax(indices).astype(jnp.int32)
+        shape = tuple(shape)
+        if values.shape[0] != idx.shape[0]:
+            raise MXNetError(
+                f"row_sparse: {values.shape[0]} value rows vs "
+                f"{idx.shape[0]} indices")
+        if values.ndim != len(shape) or values.shape[1:] != shape[1:]:
+            raise MXNetError(
+                f"row_sparse: value row shape {values.shape[1:]} does not "
+                f"match array shape {shape}")
+        order = jnp.argsort(idx)
+        self._sp_indices = idx[order]
+        self._sp_values = values[order]
+        dense = jnp.zeros(shape, values.dtype).at[self._sp_indices].set(
+            self._sp_values)
+        super().__init__(dense)
+
+    @classmethod
+    def _from_sorted(cls, values, indices, shape, dense=None):
+        """Internal fast path: indices already sorted+unique; reuse an
+        existing dense mirror instead of re-scattering (hot path for
+        dense-grad → row_sparse conversion in Trainer)."""
+        obj = object.__new__(cls)
+        NDArray.__init__(obj, dense if dense is not None else
+                         jnp.zeros(tuple(shape), values.dtype)
+                         .at[indices].set(values))
+        obj._sp_indices = indices
+        obj._sp_values = values
+        return obj
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def indices(self) -> NDArray:
+        return NDArray(self._sp_indices)
+
+    @property
+    def data(self) -> NDArray:
+        return NDArray(self._sp_values)
+
+    @property
+    def nnz(self):
+        return int(self._sp_indices.shape[0])
+
+    def retain(self, indices):
+        return retain(self, indices)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix (parity: mx.nd.sparse.CSRNDArray)."""
+
+    __slots__ = ("_sp_data", "_sp_indices", "_sp_indptr")
+
+    def __init__(self, data, indices, indptr, shape):
+        vals = _as_jax(data)
+        idx = _as_jax(indices).astype(jnp.int32)
+        ptr = _as_jax(indptr).astype(jnp.int32)
+        shape = tuple(shape)
+        if len(shape) != 2:
+            raise MXNetError("csr arrays must be 2-D")
+        if ptr.shape[0] != shape[0] + 1:
+            raise MXNetError(
+                f"csr: indptr length {ptr.shape[0]} != rows+1 "
+                f"{shape[0] + 1}")
+        self._sp_data = vals
+        self._sp_indices = idx
+        self._sp_indptr = ptr
+        counts = _np.diff(_np.asarray(ptr))
+        rows = _np.repeat(_np.arange(shape[0]), counts)
+        dense = jnp.zeros(shape, vals.dtype).at[
+            jnp.asarray(rows), idx].add(vals)
+        super().__init__(dense)
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def data(self) -> NDArray:
+        return NDArray(self._sp_data)
+
+    @property
+    def indices(self) -> NDArray:
+        return NDArray(self._sp_indices)
+
+    @property
+    def indptr(self) -> NDArray:
+        return NDArray(self._sp_indptr)
+
+    @property
+    def nnz(self):
+        return int(self._sp_data.shape[0])
+
+
+# ------------------------------------------------------------------ #
+# factories (parity: mx.nd.sparse.*)
+# ------------------------------------------------------------------ #
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Create a RowSparseNDArray from (data, indices) or a dense source."""
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        if shape is None:
+            raise MXNetError("row_sparse_array((data, indices)) needs shape")
+        data = _as_jax(data, dtype=dtype or "float32")
+        return RowSparseNDArray(data, indices, shape)
+    dense = _as_jax(arg1, dtype=dtype)
+    return cast_storage(NDArray(dense), "row_sparse")
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Create a CSRNDArray from (data, indices, indptr) or dense/scipy."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        if shape is None:
+            raise MXNetError(
+                "csr_matrix((data, indices, indptr)) needs shape")
+        data = _as_jax(data, dtype=dtype or "float32")
+        return CSRNDArray(data, indices, indptr, shape)
+    dense = _as_jax(arg1, dtype=dtype)
+    return cast_storage(NDArray(dense), "csr")
+
+
+def zeros(stype, shape, ctx=None, dtype="float32"):
+    dt = _to_jnp_dtype(dtype)
+    if stype == "row_sparse":
+        return RowSparseNDArray(jnp.zeros((0,) + tuple(shape[1:]), dt),
+                                jnp.zeros((0,), jnp.int32), shape)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dt), jnp.zeros((0,), jnp.int32),
+                          jnp.zeros((shape[0] + 1,), jnp.int32), shape)
+    if stype == "default":
+        return NDArray(jnp.zeros(tuple(shape), dt))
+    raise MXNetError(f"unknown storage type {stype!r}")
+
+
+def array(source, ctx=None, dtype=None):
+    """Sparse-aware mx.nd.sparse.array: preserves the input's stype."""
+    if isinstance(source, BaseSparseNDArray):
+        return source
+    try:  # scipy sparse support (reference accepts scipy.sparse.csr)
+        import scipy.sparse as sps
+        if sps.issparse(source):
+            csr = source.tocsr()
+            return CSRNDArray(csr.data, csr.indices, csr.indptr, csr.shape)
+    except ImportError:
+        pass
+    return cast_storage(NDArray(_as_jax(source, dtype=dtype)), "csr")
+
+
+def cast_storage(arr, stype):
+    """Convert between storage types (reference: cast_storage op).
+    Note: finding the nonzero structure of a dense array is data-dependent
+    → this op synchronizes to host (eager-only, like the reference's)."""
+    if isinstance(arr, BaseSparseNDArray):
+        arr = NDArray(arr._data)
+    if stype == "default":
+        return NDArray(arr._data)
+    if stype == "row_sparse":
+        # device-side row mask; only the (rows,) bool vector crosses to
+        # host, and the existing dense array IS the mirror — no scatter
+        g = arr._data
+        mask = _np.asarray(jnp.any(g.reshape(g.shape[0], -1) != 0, axis=1))
+        rows = jnp.asarray(_np.nonzero(mask)[0].astype(_np.int32))
+        return RowSparseNDArray._from_sorted(g[rows], rows, g.shape,
+                                             dense=g)
+    dense = _np.asarray(arr._data)
+    if stype == "csr":
+        if dense.ndim != 2:
+            raise MXNetError("csr arrays must be 2-D")
+        rows, cols = _np.nonzero(dense)
+        data = dense[rows, cols]
+        indptr = _np.zeros(dense.shape[0] + 1, _np.int32)
+        _np.add.at(indptr, rows + 1, 1)
+        indptr = _np.cumsum(indptr)
+        return CSRNDArray(data, cols, indptr, dense.shape)
+    raise MXNetError(f"unknown storage type {stype!r}")
+
+
+def retain(rsp: RowSparseNDArray, indices):
+    """Keep only the requested rows (reference: _retain op; the KVStore
+    row_sparse_pull building block)."""
+    if not isinstance(rsp, RowSparseNDArray):
+        raise MXNetError("retain expects a RowSparseNDArray")
+    want = _as_jax(indices).astype(jnp.int32)
+    keep = jnp.isin(rsp._sp_indices, want)
+    kept_np = _np.asarray(keep)
+    idx = _np.asarray(rsp._sp_indices)[kept_np]
+    vals = _np.asarray(rsp._sp_values)[kept_np]
+    return RowSparseNDArray(vals, idx, rsp.shape)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot (reference: sparse dot kernels, dot-inl.h).
+
+    Supported: csr @ dense, csr.T @ dense, rsp @ dense, dense @ dense.
+    On TPU these lower to one dense MXU matmul over the materialized
+    mirror — the sparse win on TPU is storage/communication (row pulls),
+    not FLOPs, so this is the idiomatic lowering."""
+    a = lhs._data if isinstance(lhs, NDArray) else _as_jax(lhs)
+    b = rhs._data if isinstance(rhs, NDArray) else _as_jax(rhs)
+    if transpose_a:
+        a = a.T
+    if transpose_b:
+        b = b.T
+    return NDArray(a @ b)

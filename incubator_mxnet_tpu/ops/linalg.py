@@ -1,0 +1,162 @@
+"""Linear-algebra operators (reference: `src/operator/linalg/` +
+`src/operator/tensor/la_op.cc`, LAPACK/cuSOLVER-backed — file-level
+citations, SURVEY.md caveat).
+
+TPU-native: jnp.linalg / lax.linalg lowerings. Batched by construction
+(leading dims broadcast); triangular conventions follow the reference
+(lower=True default)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+import jax.scipy.linalg as jsl
+
+from .registry import register
+
+
+def _maybe_t(x, t):
+    return jnp.swapaxes(x, -1, -2) if t else x
+
+
+@register("linalg_gemm")
+def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
+                beta=1.0, axis=-2):
+    """C' = alpha * op(A) @ op(B) + beta * C (reference: linalg_gemm)."""
+    return alpha * (_maybe_t(A, transpose_a) @ _maybe_t(B, transpose_b)) \
+        + beta * C
+
+
+@register("linalg_gemm2")
+def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0,
+                 axis=-2):
+    """alpha * op(A) @ op(B) (reference: linalg_gemm2)."""
+    return alpha * (_maybe_t(A, transpose_a) @ _maybe_t(B, transpose_b))
+
+
+@register("linalg_potrf")
+def linalg_potrf(A):
+    """Cholesky factor L with A = L L^T (reference: linalg_potrf)."""
+    return jnp.linalg.cholesky(A)
+
+
+@register("linalg_potri")
+def linalg_potri(L):
+    """Inverse of A from its Cholesky factor: A^-1 = (L L^T)^-1
+    (reference: linalg_potri)."""
+    eye = jnp.broadcast_to(jnp.eye(L.shape[-1], dtype=L.dtype), L.shape)
+    Linv = jsl.solve_triangular(L, eye, lower=True)
+    return jnp.swapaxes(Linv, -1, -2) @ Linv
+
+
+@register("linalg_trsm")
+def linalg_trsm(A, B, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    """Solve op(A) X = alpha B (or X op(A) = alpha B) with triangular A
+    (reference: linalg_trsm)."""
+    if rightside:
+        # X op(A) = alpha B  ⇔  op(A)^T X^T = alpha B^T; op(A)^T is A
+        # with the opposite trans flag
+        sol = jsl.solve_triangular(A, jnp.swapaxes(B, -1, -2), lower=lower,
+                                   trans=0 if transpose else 1)
+        return alpha * jnp.swapaxes(sol, -1, -2)
+    return alpha * jsl.solve_triangular(A, B, lower=lower,
+                                        trans=1 if transpose else 0)
+
+
+@register("linalg_trmm")
+def linalg_trmm(A, B, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    """Triangular matrix multiply alpha op(A) B (reference: linalg_trmm).
+    A is read as triangular (other half ignored)."""
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    tri = _maybe_t(tri, transpose)
+    return alpha * (B @ tri if rightside else tri @ B)
+
+
+@register("linalg_syrk")
+def linalg_syrk(A, transpose=False, alpha=1.0):
+    """alpha * A A^T (or A^T A) (reference: linalg_syrk)."""
+    At = jnp.swapaxes(A, -1, -2)
+    return alpha * (At @ A if transpose else A @ At)
+
+
+@register("linalg_gelqf", num_outputs=2)
+def linalg_gelqf(A):
+    """LQ factorization A = L Q with Q orthonormal rows
+    (reference: linalg_gelqf)."""
+    Qt, Rt = jnp.linalg.qr(jnp.swapaxes(A, -1, -2), mode="reduced")
+    return jnp.swapaxes(Rt, -1, -2), jnp.swapaxes(Qt, -1, -2)
+
+
+@register("linalg_syevd", num_outputs=2)
+def linalg_syevd(A):
+    """Symmetric eigendecomposition: U (rows = eigenvectors), Lambda
+    (reference: linalg_syevd)."""
+    w, v = jnp.linalg.eigh(A)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+@register("linalg_sumlogdiag")
+def linalg_sumlogdiag(A):
+    """sum(log(diag(A))) per matrix (reference: linalg_sumlogdiag)."""
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("linalg_extractdiag")
+def linalg_extractdiag(A, offset=0):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("linalg_makediag")
+def linalg_makediag(a, offset=0):
+    n = a.shape[-1] + abs(offset)
+    out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+    idx = jnp.arange(a.shape[-1])
+    r = idx + max(-offset, 0)
+    c = idx + max(offset, 0)
+    return out.at[..., r, c].set(a)
+
+
+def _trian_indices(n, offset, lower):
+    """Triangle index sets, reference semantics: offset < 0 forces the
+    sub-diagonal (lower) triangle at diagonal ``offset``, offset > 0 the
+    super-diagonal (upper) triangle; offset == 0 follows ``lower``."""
+    if offset < 0 or (offset == 0 and lower):
+        return jnp.tril_indices(n, k=offset)
+    return jnp.triu_indices(n, k=offset)
+
+
+@register("linalg_extracttrian")
+def linalg_extracttrian(A, offset=0, lower=True):
+    """Pack a triangle into a vector (reference: linalg_extracttrian)."""
+    r, c = _trian_indices(A.shape[-1], offset, lower)
+    return A[..., r, c]
+
+
+@register("linalg_maketrian")
+def linalg_maketrian(a, offset=0, lower=True):
+    """Unpack extracttrian's vector back into an n x n matrix. With
+    diagonal ``offset``, L = m(m+1)/2 rows where m = n - |offset|."""
+    L = a.shape[-1]
+    m = int((-1 + (1 + 8 * L) ** 0.5) / 2)
+    n = m + abs(offset)
+    out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+    r, c = _trian_indices(n, offset, lower)
+    return out.at[..., r, c].set(a)
+
+
+@register("linalg_slogdet", num_outputs=2)
+def linalg_slogdet(A):
+    sign, ld = jnp.linalg.slogdet(A)
+    return sign, ld
+
+
+@register("linalg_det")
+def linalg_det(A):
+    return jnp.linalg.det(A)
+
+
+@register("linalg_inverse")
+def linalg_inverse(A):
+    return jnp.linalg.inv(A)

@@ -32,6 +32,22 @@ __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "RMSProp", "Ftrl",
 _REGISTRY = Registry("optimizer")
 
 
+def _rows_update(weight, grad, states, op_name, **op_kwargs):
+    """Lazy row_sparse update: run the registered fused update op on the
+    ACTIVE ROWS only, scatter results back (reference: the lazy_update
+    paths of sgd/adam — src/operator/optimizer_op.cc; SURVEY.md §7.2
+    row_sparse design). states: list of NDArray (momentum etc.)."""
+    idx = grad._sp_indices
+    w_rows = NDArray(weight._data[idx])
+    g_rows = NDArray(grad._sp_values)
+    s_rows = [NDArray(s._data[idx]) for s in states]
+    out = invoke_by_name(op_name, w_rows, g_rows, *s_rows, **op_kwargs)
+    outs = out if isinstance(out, (list, tuple)) else (out,)
+    weight._data = weight._data.at[idx].set(outs[0]._data)
+    for s, new in zip(states, outs[1:]):
+        s._data = s._data.at[idx].set(new._data)
+
+
 def register(name, aliases=()):
     return _REGISTRY.register(name, aliases=aliases)
 
@@ -148,6 +164,7 @@ class SGD(Optimizer):
     def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         if self.momentum == 0.0:
@@ -155,14 +172,27 @@ class SGD(Optimizer):
         return nd_zeros(weight.shape, dtype=str(weight.dtype))
 
     def update(self, index, weight, grad, state):
+        from ..ndarray.sparse import RowSparseNDArray
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
+        sparse = isinstance(grad, RowSparseNDArray) and self.lazy_update
         if state is None:
+            if sparse:
+                _rows_update(weight, grad, [], "sgd_update", lr=lr, wd=wd,
+                             rescale_grad=self.rescale_grad,
+                             clip_gradient=self.clip_gradient)
+                return
             new_w = invoke_by_name("sgd_update", weight, grad, lr=lr, wd=wd,
                                    rescale_grad=self.rescale_grad,
                                    clip_gradient=self.clip_gradient)
             weight._data = new_w._data
         else:
+            if sparse:
+                _rows_update(weight, grad, [state], "sgd_mom_update",
+                             lr=lr, momentum=self.momentum, wd=wd,
+                             rescale_grad=self.rescale_grad,
+                             clip_gradient=self.clip_gradient)
+                return
             new_w, new_m = invoke_by_name(
                 "sgd_mom_update", weight, grad, state, lr=lr,
                 momentum=self.momentum, wd=wd, rescale_grad=self.rescale_grad,
@@ -197,17 +227,26 @@ class Adam(Optimizer):
                  epsilon=1e-8, lazy_update=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         dt = str(weight.dtype)
         return (nd_zeros(weight.shape, dtype=dt), nd_zeros(weight.shape, dtype=dt))
 
     def update(self, index, weight, grad, state):
+        from ..ndarray.sparse import RowSparseNDArray
         self._update_count(index)
         t = self._step_t(index)
         lr = self._get_lr(index)
         lr = lr * jnp.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
         mean, var = state
+        if isinstance(grad, RowSparseNDArray) and self.lazy_update:
+            _rows_update(weight, grad, [mean, var], "adam_update", lr=lr,
+                         beta1=self.beta1, beta2=self.beta2,
+                         epsilon=self.epsilon, wd=self._get_wd(index),
+                         rescale_grad=self.rescale_grad,
+                         clip_gradient=self.clip_gradient)
+            return
         new_w, new_mean, new_var = invoke_by_name(
             "adam_update", weight, grad, mean, var, lr=lr, beta1=self.beta1,
             beta2=self.beta2, epsilon=self.epsilon, wd=self._get_wd(index),

@@ -1,0 +1,118 @@
+"""linalg op tests vs numpy/scipy oracles (reference strategy:
+tests/python/unittest/test_operator.py linalg section)."""
+
+import numpy as np
+
+from incubator_mxnet_tpu import nd
+
+
+def _rand_spd(n, rng):
+    a = rng.randn(n, n).astype(np.float32)
+    return a @ a.T + n * np.eye(n, dtype=np.float32)
+
+
+def test_gemm_and_gemm2():
+    rng = np.random.RandomState(0)
+    A = rng.randn(3, 4).astype(np.float32)
+    B = rng.randn(4, 5).astype(np.float32)
+    C = rng.randn(3, 5).astype(np.float32)
+    out = nd.linalg.gemm(nd.array(A), nd.array(B), nd.array(C),
+                         alpha=2.0, beta=0.5).asnumpy()
+    np.testing.assert_allclose(out, 2 * A @ B + 0.5 * C, rtol=1e-5)
+    out2 = nd.linalg.gemm2(nd.array(A), nd.array(B.T),
+                           transpose_b=True).asnumpy()
+    np.testing.assert_allclose(out2, A @ B, rtol=1e-5)
+
+
+def test_potrf_potri_roundtrip():
+    rng = np.random.RandomState(1)
+    A = _rand_spd(4, rng)
+    L = nd.linalg.potrf(nd.array(A))
+    np.testing.assert_allclose(L.asnumpy() @ L.asnumpy().T, A, rtol=1e-3,
+                               atol=1e-3)
+    Ainv = nd.linalg.potri(L).asnumpy()
+    np.testing.assert_allclose(Ainv @ A, np.eye(4), atol=1e-2)
+
+
+def test_trsm_all_modes():
+    rng = np.random.RandomState(2)
+    A = np.tril(rng.randn(3, 3).astype(np.float32)) + 3 * np.eye(
+        3, dtype=np.float32)
+    B = rng.randn(3, 2).astype(np.float32)
+    # left: A X = B
+    X = nd.linalg.trsm(nd.array(A), nd.array(B)).asnumpy()
+    np.testing.assert_allclose(A @ X, B, rtol=1e-4, atol=1e-4)
+    # left transposed: A^T X = B
+    X = nd.linalg.trsm(nd.array(A), nd.array(B), transpose=True).asnumpy()
+    np.testing.assert_allclose(A.T @ X, B, rtol=1e-4, atol=1e-4)
+    # right: X A = B
+    B2 = rng.randn(2, 3).astype(np.float32)
+    X = nd.linalg.trsm(nd.array(A), nd.array(B2), rightside=True).asnumpy()
+    np.testing.assert_allclose(X @ A, B2, rtol=1e-4, atol=1e-4)
+    # right transposed: X A^T = B
+    X = nd.linalg.trsm(nd.array(A), nd.array(B2), rightside=True,
+                       transpose=True).asnumpy()
+    np.testing.assert_allclose(X @ A.T, B2, rtol=1e-4, atol=1e-4)
+
+
+def test_trmm_syrk():
+    rng = np.random.RandomState(3)
+    A = rng.randn(3, 3).astype(np.float32)
+    B = rng.randn(3, 4).astype(np.float32)
+    out = nd.linalg.trmm(nd.array(A), nd.array(B)).asnumpy()
+    np.testing.assert_allclose(out, np.tril(A) @ B, rtol=1e-5)
+    s = nd.linalg.syrk(nd.array(B)).asnumpy()
+    np.testing.assert_allclose(s, B @ B.T, rtol=1e-5)
+
+
+def test_gelqf():
+    rng = np.random.RandomState(4)
+    A = rng.randn(3, 5).astype(np.float32)
+    L, Q = nd.linalg.gelqf(nd.array(A))
+    L, Q = L.asnumpy(), Q.asnumpy()
+    np.testing.assert_allclose(L @ Q, A, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(Q @ Q.T, np.eye(3), atol=1e-4)
+    assert np.allclose(np.triu(L, 1), 0, atol=1e-5)
+
+
+def test_syevd():
+    rng = np.random.RandomState(5)
+    A = _rand_spd(4, rng)
+    U, lam = nd.linalg.syevd(nd.array(A))
+    U, lam = U.asnumpy(), lam.asnumpy()
+    # rows of U are eigenvectors: A u_i = lam_i u_i
+    np.testing.assert_allclose(U @ A, np.diag(lam) @ U, rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_diag_trian_det():
+    rng = np.random.RandomState(6)
+    A = _rand_spd(3, rng)
+    d = nd.linalg.extractdiag(nd.array(A)).asnumpy()
+    np.testing.assert_allclose(d, np.diag(A), rtol=1e-6)
+    m = nd.linalg.makediag(nd.array(np.array([1., 2., 3.],
+                                             np.float32))).asnumpy()
+    np.testing.assert_allclose(m, np.diag([1., 2., 3.]), rtol=1e-6)
+    sld = nd.linalg.sumlogdiag(nd.array(A)).asnumpy()
+    np.testing.assert_allclose(sld, np.log(np.diag(A)).sum(), rtol=1e-5)
+    packed = nd.linalg.extracttrian(nd.array(A)).asnumpy()
+    back = nd.linalg.maketrian(nd.array(packed)).asnumpy()
+    np.testing.assert_allclose(back, np.tril(A), rtol=1e-6)
+    det = nd.linalg.det(nd.array(A)).asnumpy()
+    np.testing.assert_allclose(det, np.linalg.det(A), rtol=1e-3)
+    inv = nd.linalg.inverse(nd.array(A)).asnumpy()
+    np.testing.assert_allclose(inv @ A, np.eye(3), atol=1e-3)
+
+
+def test_trian_offsets():
+    A = np.array([[1., 2.], [3., 4.]], np.float32)
+    low = nd.linalg.extracttrian(nd.array(A), offset=-1).asnumpy()
+    np.testing.assert_array_equal(low, [3.0])
+    up = nd.linalg.extracttrian(nd.array(A), offset=1).asnumpy()
+    np.testing.assert_array_equal(up, [2.0])
+    back = nd.linalg.maketrian(nd.array(np.array([7.0], np.float32)),
+                               offset=1).asnumpy()
+    np.testing.assert_array_equal(back, [[0., 7.], [0., 0.]])
+    back2 = nd.linalg.maketrian(nd.array(np.array([7.0], np.float32)),
+                                offset=-1).asnumpy()
+    np.testing.assert_array_equal(back2, [[0., 0.], [7., 0.]])

@@ -1,0 +1,154 @@
+"""Sparse storage tests (reference strategy:
+tests/python/unittest/test_sparse_ndarray.py — numpy oracles, stype
+round-trips, sparse optimizer/kvstore flows)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.ndarray import sparse
+
+
+def test_row_sparse_roundtrip():
+    data = np.array([[1., 2.], [3., 4.]], np.float32)
+    rsp = sparse.row_sparse_array((data, [1, 3]), shape=(5, 2))
+    assert rsp.stype == "row_sparse"
+    assert rsp.nnz == 2
+    dense = rsp.asnumpy()
+    expect = np.zeros((5, 2), np.float32)
+    expect[1], expect[3] = data[0], data[1]
+    np.testing.assert_array_equal(dense, expect)
+    # dense -> rsp -> dense
+    back = nd.array(expect).tostype("row_sparse")
+    assert back.nnz == 2
+    np.testing.assert_array_equal(back.asnumpy(), expect)
+    np.testing.assert_array_equal(back.tostype("default").asnumpy(), expect)
+
+
+def test_csr_roundtrip():
+    dense = np.array([[0, 1, 0], [2, 0, 3], [0, 0, 0]], np.float32)
+    csr = nd.array(dense).tostype("csr")
+    assert csr.stype == "csr"
+    assert csr.nnz == 3
+    np.testing.assert_array_equal(csr.asnumpy(), dense)
+    np.testing.assert_array_equal(np.asarray(csr.indptr.asnumpy()),
+                                  [0, 1, 3, 3])
+    # explicit construction
+    c2 = sparse.csr_matrix((csr.data.asnumpy(), csr.indices.asnumpy(),
+                            csr.indptr.asnumpy()), shape=(3, 3))
+    np.testing.assert_array_equal(c2.asnumpy(), dense)
+
+
+def test_sparse_zeros_and_retain():
+    z = sparse.zeros("row_sparse", (4, 3))
+    assert z.nnz == 0 and z.asnumpy().sum() == 0
+    rsp = sparse.row_sparse_array(
+        (np.ones((3, 2), np.float32), [0, 2, 3]), shape=(5, 2))
+    kept = sparse.retain(rsp, [2, 3])
+    assert kept.nnz == 2
+    assert kept.asnumpy()[0].sum() == 0
+
+
+def test_sparse_dot():
+    rng = np.random.RandomState(0)
+    dense = (rng.rand(4, 6) > 0.5) * rng.randn(4, 6)
+    dense = dense.astype(np.float32)
+    csr = nd.array(dense).tostype("csr")
+    rhs = rng.randn(6, 3).astype(np.float32)
+    got = sparse.dot(csr, nd.array(rhs)).asnumpy()
+    np.testing.assert_allclose(got, dense @ rhs, rtol=1e-5, atol=1e-5)
+    gotT = sparse.dot(csr, nd.array(rng.randn(4, 2).astype(np.float32)),
+                      transpose_a=True)
+    assert gotT.shape == (6, 2)
+
+
+def test_sparse_array_scipy_like():
+    import scipy.sparse as sps
+    m = sps.random(5, 4, density=0.4, format="csr", dtype=np.float32,
+                   random_state=0)
+    arr = sparse.array(m)
+    np.testing.assert_allclose(arr.asnumpy(), m.toarray(), rtol=1e-6)
+
+
+def test_lazy_sgd_update_touches_only_active_rows():
+    w = nd.array(np.ones((6, 3), np.float32))
+    grad = sparse.row_sparse_array(
+        (np.full((2, 3), 0.5, np.float32), [1, 4]), shape=(6, 3))
+    opt = mx.optimizer.SGD(learning_rate=1.0, lazy_update=True)
+    state = opt.create_state(0, w)
+    opt.update(0, w, grad, state)
+    out = w.asnumpy()
+    np.testing.assert_allclose(out[1], 0.5)   # 1 - 1.0*0.5
+    np.testing.assert_allclose(out[4], 0.5)
+    np.testing.assert_allclose(out[0], 1.0)   # untouched rows
+    np.testing.assert_allclose(out[5], 1.0)
+
+
+def test_lazy_adam_matches_dense_on_active_rows():
+    rng = np.random.RandomState(1)
+    w0 = rng.randn(5, 2).astype(np.float32)
+    g_rows = rng.randn(2, 2).astype(np.float32)
+    g_dense = np.zeros((5, 2), np.float32)
+    g_dense[[0, 3]] = g_rows
+
+    w_sparse = nd.array(w0)
+    opt_s = mx.optimizer.Adam(learning_rate=0.1, lazy_update=True)
+    st_s = opt_s.create_state(0, w_sparse)
+    opt_s.update(0, w_sparse,
+                 sparse.row_sparse_array((g_rows, [0, 3]), shape=(5, 2)),
+                 st_s)
+
+    w_dense = nd.array(w0)
+    opt_d = mx.optimizer.Adam(learning_rate=0.1)
+    st_d = opt_d.create_state(0, w_dense)
+    opt_d.update(0, w_dense, nd.array(g_dense), st_d)
+
+    # active rows identical; inactive rows untouched in the sparse path
+    ws, wd = w_sparse.asnumpy(), w_dense.asnumpy()
+    np.testing.assert_allclose(ws[[0, 3]], wd[[0, 3]], rtol=1e-5)
+    np.testing.assert_allclose(ws[[1, 2, 4]], w0[[1, 2, 4]], rtol=1e-6)
+
+
+def test_kvstore_row_sparse_pull():
+    kv = mx.kv.create("local")
+    table = np.arange(20, dtype=np.float32).reshape(10, 2)
+    kv.init("emb", nd.array(table))
+    out = sparse.zeros("row_sparse", (10, 2))
+    kv.row_sparse_pull("emb", out=out, row_ids=nd.array([2.0, 7.0]))
+    assert out.nnz == 2
+    np.testing.assert_array_equal(out.data.asnumpy(), table[[2, 7]])
+    dense_out = nd.zeros((10, 2))
+    kv.row_sparse_pull("emb", out=dense_out, row_ids=nd.array([1.0]))
+    got = dense_out.asnumpy()
+    np.testing.assert_array_equal(got[1], table[1])
+    assert got[[0, 2]].sum() == 0
+
+
+def test_embedding_sparse_grad_training():
+    """gluon Embedding(sparse_grad=True): only looked-up rows change."""
+    emb = gluon.nn.Embedding(20, 4, sparse_grad=True)
+    emb.initialize()
+    w0 = emb.weight.data().asnumpy().copy()
+    tr = gluon.Trainer(emb.collect_params(), "sgd",
+                       {"learning_rate": 1.0})
+    idx = nd.array(np.array([3.0, 7.0, 3.0]))
+    with autograd.record():
+        out = emb(idx)
+        loss = (out ** 2).sum()
+    loss.backward()
+    tr.step(1)
+    w1 = emb.weight.data().asnumpy()
+    changed = np.abs(w1 - w0).sum(axis=1) > 1e-7
+    assert changed[3] and changed[7]
+    assert changed.sum() == 2  # every other row untouched
+
+
+def test_row_sparse_pull_dedups_and_sorts():
+    kv = mx.kv.create("local")
+    table = np.arange(12, dtype=np.float32).reshape(6, 2)
+    kv.init("t", nd.array(table))
+    out = sparse.zeros("row_sparse", (6, 2))
+    kv.row_sparse_pull("t", out=out, row_ids=nd.array([5.0, 2.0, 2.0]))
+    np.testing.assert_array_equal(out.indices.asnumpy(), [2, 5])
+    np.testing.assert_array_equal(out.data.asnumpy(), table[[2, 5]])
