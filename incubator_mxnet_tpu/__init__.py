@@ -50,6 +50,7 @@ _LAZY_SUBMODULES = (
     "initializer", "lr_scheduler", "profiler", "amp", "parallel", "models",
     "runtime", "test_utils", "callback", "util", "engine", "recordio",
     "numpy", "np", "npx", "module", "mod", "model", "executor", "kv",
+    "contrib", "operator", "rtc",
 )
 
 

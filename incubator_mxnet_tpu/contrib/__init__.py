@@ -1,0 +1,18 @@
+"""``mx.contrib`` (parity: python/mxnet/contrib/). Quantization is the
+main subsystem; ONNX import/export is gated (no onnx package in this
+build — SURVEY.md §7.3 documented substitutions)."""
+
+from . import quantization
+from .quantization import quantize_net
+
+__all__ = ["quantization", "quantize_net"]
+
+
+def __getattr__(name):
+    if name == "onnx":
+        from ..base import MXNetError
+        raise MXNetError(
+            "contrib.onnx is not available: the onnx package is not part "
+            "of this build. Use HybridBlock.export / SymbolBlock for "
+            "native serialization.")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

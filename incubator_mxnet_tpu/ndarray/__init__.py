@@ -165,3 +165,11 @@ from . import contrib  # noqa: E402,F401
 # sparse storage types (parity: mx.nd.sparse)
 from . import sparse  # noqa: E402,F401
 from . import linalg  # noqa: E402,F401
+
+
+def Custom(*inputs, op_type=None, **kwargs):
+    """User custom op (parity: mx.nd.Custom — see mx.operator)."""
+    from ..operator import custom as _custom
+    if op_type is None:
+        raise MXNetError("Custom requires op_type=")
+    return _custom(*inputs, op_type=op_type, **kwargs)

@@ -1,0 +1,161 @@
+"""Custom operators in Python (``mx.operator``).
+
+Parity target: `python/mxnet/operator.py` (CustomOp/CustomOpProp +
+``mx.nd.Custom``) backed by `src/operator/custom/custom.cc`, which runs
+user Python callbacks on a dedicated worker thread (file-level citations
+— SURVEY.md caveat).
+
+TPU-native design: the user's numpy forward/backward run on HOST via
+``jax.pure_callback`` wrapped in a ``jax.custom_vjp`` — so a Custom op is
+a first-class traced primitive: it composes with jit/vjp like any other
+op, while the callback boundary isolates the arbitrary Python from XLA.
+(The reference's dedicated-thread design solved GIL-vs-engine deadlocks;
+here the callback mechanism owns that problem.)"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered",
+           "custom"]
+
+_CUSTOM_REGISTRY: Dict[str, Type["CustomOpProp"]] = {}
+
+
+class CustomOp:
+    """User op: override ``forward``/``backward``; use ``assign`` to
+    honor the write/add/null request (parity: mx.operator.CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise MXNetError(
+            f"{type(self).__name__}.backward not implemented")
+
+    @staticmethod
+    def assign(dst, req, src):
+        if req == "null":
+            return
+        src = np.asarray(src, dtype=dst.dtype)
+        if req == "add":
+            dst += src
+        else:  # write / inplace
+            dst[...] = src
+
+
+class CustomOpProp:
+    """Shape/type inference + operator factory
+    (parity: mx.operator.CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self) -> List[str]:
+        return ["data"]
+
+    def list_outputs(self) -> List[str]:
+        return ["output"]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes) -> CustomOp:
+        raise NotImplementedError
+
+
+def register(reg_name: str):
+    """Class decorator registering a CustomOpProp under ``op_type``
+    (parity: mx.operator.register)."""
+
+    def _deco(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register expects a CustomOpProp subclass")
+        _CUSTOM_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return _deco
+
+
+def get_all_registered() -> List[str]:
+    return sorted(_CUSTOM_REGISTRY)
+
+
+def custom(*inputs, op_type: str, **kwargs):
+    """Invoke a registered custom op (parity: ``mx.nd.Custom``).
+
+    Differentiable: backward dispatches to the user's
+    ``CustomOp.backward`` through the same callback mechanism."""
+    if op_type not in _CUSTOM_REGISTRY:
+        raise MXNetError(
+            f"custom op {op_type!r} not registered; known: "
+            f"{get_all_registered()}")
+    prop = _CUSTOM_REGISTRY[op_type](**kwargs)
+    arrs = [x._data if isinstance(x, NDArray) else jnp.asarray(x)
+            for x in inputs]
+    in_shapes = [tuple(a.shape) for a in arrs]
+    in_types = [np.dtype(str(a.dtype)) for a in arrs]
+    shapes = prop.infer_shape(list(map(list, in_shapes)))
+    out_shapes = [tuple(s) for s in shapes[1]]
+    types = prop.infer_type(list(in_types))
+    out_types = list(types[1])
+    op = prop.create_operator(None, in_shapes, in_types)
+    n_in = len(arrs)
+
+    out_structs = tuple(jax.ShapeDtypeStruct(s, t)
+                        for s, t in zip(out_shapes, out_types))
+    in_structs = tuple(jax.ShapeDtypeStruct(s, t)
+                       for s, t in zip(in_shapes, in_types))
+
+    def _forward_np(*xs):
+        in_data = [np.asarray(x) for x in xs]
+        out_data = [np.zeros(s, t) for s, t in zip(out_shapes, out_types)]
+        op.forward(True, ["write"] * len(out_data), in_data, out_data, [])
+        return tuple(out_data)
+
+    def _backward_np(*xs):
+        in_data = [np.asarray(x) for x in xs[:n_in]]
+        cots = [np.asarray(x) for x in xs[n_in:]]
+        out_data = list(_forward_np(*in_data))
+        in_grad = [np.zeros(s, t) for s, t in zip(in_shapes, in_types)]
+        op.backward(["write"] * n_in, cots, in_data, out_data, in_grad, [])
+        return tuple(in_grad)
+
+    @jax.custom_vjp
+    def _call(*xs):
+        return jax.pure_callback(_forward_np, out_structs, *xs,
+                                 vmap_method="sequential")
+
+    def _fwd(*xs):
+        return _call(*xs), xs
+
+    def _bwd(res, cots):
+        grads = jax.pure_callback(_backward_np, in_structs, *res, *cots,
+                                  vmap_method="sequential")
+        return tuple(grads)
+
+    _call.defvjp(_fwd, _bwd)
+
+    from . import autograd
+
+    # run through the standard imperative path so autograd records it
+    outs_raw = _call(*arrs)
+    outs = [NDArray(o) for o in outs_raw]
+    if autograd.is_recording():
+        owners = [x if isinstance(x, NDArray) else None for x in inputs]
+        autograd._record_node(lambda *xs: _call(*xs), arrs, owners, outs,
+                              name=f"Custom[{op_type}]", tuple_out=True)
+    return outs if len(outs) > 1 else outs[0]

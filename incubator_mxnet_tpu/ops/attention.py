@@ -100,7 +100,11 @@ def scaled_dot_product_attention(q, k, v, mask=None, scale=None,
     if scale is None:
         scale = D ** -0.5
     if flash and (mask is None or mask.ndim == 2):
-        return _sdpa_blockwise(q, k, v, mask, causal, scale)
+        # Pallas kernel on TPU (length-style masks), blockwise jnp
+        # otherwise — same streaming-softmax math either way
+        from .pallas_attention import use_flash_attention
+        return use_flash_attention(q, k, v, key_mask=mask, causal=causal,
+                                   scale=scale)
     Tq, Tk = q.shape[1], k.shape[1]
     m = mask
     if m is not None and m.ndim == 2:

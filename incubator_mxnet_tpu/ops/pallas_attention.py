@@ -1,0 +1,208 @@
+"""Pallas TPU flash-attention kernel.
+
+The MXU-resident analogue of the reference's fused BERT attention CUDA
+kernels (`src/operator/contrib/transformer.cc`,
+``interleaved_matmul_selfatt_*`` — file-level citation, SURVEY.md caveat)
+and the performance backbone for the BERT MFU target (SURVEY.md §7.2).
+
+Design (per /opt/skills/guides/pallas_guide.md):
+  - grid (B, H, Tq/block_q): each program owns one q tile in VMEM;
+  - K/V live in VMEM per (batch, head) and are streamed in block_k
+    chunks by a ``fori_loop`` carrying the online-softmax state
+    (m, l, acc) — the flash recurrence, never materializing the
+    (Tq, Tk) score matrix in HBM;
+  - score blocks hit the MXU via ``jnp.dot(..., preferred_element_type=
+    float32)``; masks (key-padding + causal) are built from iota and
+    program ids, no mask tensor traffic;
+  - backward: ``jax.custom_vjp`` whose bwd re-runs the blockwise jnp
+    reference under ``jax.vjp`` — full rematerialization, the standard
+    flash-attention memory trade.
+
+Falls back transparently (use_flash_attention() returns the best
+available implementation) when Pallas/TPU is absent — e.g. the CPU test
+mesh — via ``interpret=True`` or the pure-jnp blockwise path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def _pallas_available():
+    try:
+        from jax.experimental import pallas  # noqa: F401
+        return True
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _flash_kernel(vl_ref, q_ref, k_ref, v_ref, o_ref, *, scale, causal,
+                  block_q, block_k, n_k_blocks):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * scale          # (bq, D)
+    bq, D = q.shape
+    vl = vl_ref[0, 0]                                    # valid key length
+    q_pos = qi * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        k_pos = j * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_pos < vl
+        if causal:
+            mask = mask & (k_pos <= q_pos)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[:, None] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, D), jnp.float32)
+    m, l, acc = lax.fori_loop(0, n_k_blocks, body, (m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-30)[:, None]
+    o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+def _pad_to(x, axis, multiple):
+    size = x.shape[axis]
+    pad = (-size) % multiple
+    if pad == 0:
+        return x, size
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths), size
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
+                                             "block_k", "interpret"))
+def _flash_forward(q, k, v, valid_len, causal=False, scale=None,
+                   block_q=128, block_k=128, interpret=False):
+    """q/k/v: (B, H, T, D). valid_len: (B,) int32 key lengths."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    scale = D ** -0.5 if scale is None else scale
+    block_q = min(block_q, max(Tq, 8))
+    block_k = min(block_k, max(Tk, 8))
+    q, _ = _pad_to(q, 2, block_q)
+    k, _ = _pad_to(k, 2, block_k)
+    v, _ = _pad_to(v, 2, block_k)
+    Tq_p, Tk_p = q.shape[2], k.shape[2]
+    n_k_blocks = Tk_p // block_k
+
+    # valid_len caps at real Tk so padded keys never attend
+    vl = jnp.minimum(valid_len.astype(jnp.int32), Tk).reshape(B, 1)
+
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, n_k_blocks=n_k_blocks)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, H, Tq_p // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda b, h, i: (b, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, Tk_p, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Tk_p, D), lambda b, h, i: (b, h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D),
+                               lambda b, h, i: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, Tq_p, D), q.dtype),
+        interpret=interpret,
+    )(vl, q, k, v)
+    return out[:, :, :Tq, :]
+
+
+def _reference_blockwise(q, k, v, valid_len, causal, scale):
+    """jnp online-softmax reference in (B,H,T,D) layout — the custom-vjp
+    backward recomputes through this (scan-structured, so autodiff keeps
+    memory at O(T * block))."""
+    from .attention import _sdpa_blockwise
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    key_mask = lax.broadcasted_iota(jnp.int32, (B, Tk), 1) < \
+        valid_len.astype(jnp.int32)[:, None]
+    sc = D ** -0.5 if scale is None else scale
+    # _sdpa_blockwise wants (B, T, H, D)
+    out = _sdpa_blockwise(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                          v.transpose(0, 2, 1, 3), key_mask, causal, sc)
+    return out.transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def flash_attention_bhtd(q, k, v, valid_len, causal=False, scale=None,
+                         interpret=False):
+    """Flash attention in (B, H, T, D) layout with a rematerializing
+    backward. Public entry: ops.attention uses this when Pallas is
+    available; ``interpret=True`` runs the same kernel on CPU."""
+    return _flash_forward(q, k, v, valid_len, causal=causal, scale=scale,
+                          interpret=interpret)
+
+
+def _fwd(q, k, v, valid_len, causal, scale, interpret):
+    out = _flash_forward(q, k, v, valid_len, causal=causal, scale=scale,
+                         interpret=interpret)
+    return out, (q, k, v, valid_len)
+
+
+def _bwd(causal, scale, interpret, res, g):
+    q, k, v, valid_len = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _reference_blockwise(q_, k_, v_, valid_len,
+                                                causal, scale), q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None
+
+
+flash_attention_bhtd.defvjp(_fwd, _bwd)
+
+
+def use_flash_attention(q, k, v, key_mask=None, causal=False, scale=None,
+                        valid_length=None):
+    """Dispatch helper for ops.attention: (B, T, H, D) in/out.
+
+    The Pallas kernel runs on TPU when the mask is expressible as
+    per-batch key LENGTHS (valid_length, or no mask at all) — the
+    contiguous-prefix form every bucketing/padding pipeline produces.
+    Arbitrary boolean masks fall back to the pure-jnp blockwise path
+    (same math, XLA-fused). Dispatch is static: no data-dependent
+    branching, safe under jit."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    on_tpu = any(d.platform == "tpu" for d in jax.devices()) \
+        and _pallas_available()
+    if valid_length is None and key_mask is None:
+        valid_length = jnp.full((B,), Tk, jnp.int32)
+    if not (on_tpu and valid_length is not None and D <= 256):
+        from .attention import _sdpa_blockwise
+        sc = D ** -0.5 if scale is None else scale
+        if key_mask is None and valid_length is not None:
+            key_mask = lax.broadcasted_iota(jnp.int32, (B, Tk), 1) < \
+                valid_length.astype(jnp.int32)[:, None]
+        return _sdpa_blockwise(q, k, v, key_mask, causal, sc)
+    out = flash_attention_bhtd(q.transpose(0, 2, 1, 3),
+                               k.transpose(0, 2, 1, 3),
+                               v.transpose(0, 2, 1, 3),
+                               valid_length, causal, scale)
+    return out.transpose(0, 2, 1, 3)

@@ -1,0 +1,115 @@
+"""Quantization operators (int8 PTQ).
+
+Parity target: `src/operator/quantization/{quantize_v2,dequantize,
+requantize,quantized_fully_connected,quantized_conv}.cc` (file-level
+citations — SURVEY.md caveat).
+
+TPU-native design: symmetric per-tensor int8; the quantized matmul runs
+``lax.dot_general`` on int8 operands with ``preferred_element_type=int32``
+— the MXU has a native int8 path, so this is the idiomatic analogue of
+the reference's cuDNN/oneDNN int8 kernels."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _symmetric_scale(min_r, max_r, bits=8):
+    amax = jnp.maximum(jnp.abs(min_r), jnp.abs(max_r))
+    qmax = float(2 ** (bits - 1) - 1)  # 127
+    return jnp.where(amax > 0, amax / qmax, 1.0)
+
+
+@register("quantize_v2", aliases=("_contrib_quantize_v2",), num_outputs=3)
+def quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                out_type="int8"):
+    """float → int8 with symmetric scaling (reference: quantize_v2.cc).
+    Returns (quantized, min_range, max_range). Without calib ranges the
+    data's own min/max are used (the reference's on-the-fly mode)."""
+    if min_calib_range is None:
+        min_r = jnp.min(data)
+        max_r = jnp.max(data)
+    else:
+        min_r = jnp.asarray(min_calib_range, jnp.float32)
+        max_r = jnp.asarray(max_calib_range, jnp.float32)
+    scale = _symmetric_scale(min_r, max_r)
+    q = jnp.clip(jnp.round(data / scale), -127, 127).astype(jnp.int8)
+    return q, min_r, max_r
+
+
+@register("dequantize", aliases=("_contrib_dequantize",))
+def dequantize(data, min_range, max_range, out_type="float32"):
+    """int8 → float (reference: dequantize.cc)."""
+    scale = _symmetric_scale(min_range, max_range)
+    return data.astype(jnp.float32) * scale
+
+
+@register("requantize", aliases=("_contrib_requantize",), num_outputs=3)
+def requantize(data, min_range, max_range, min_calib_range=None,
+               max_calib_range=None):
+    """int32 accumulator → int8 with a new scale (reference:
+    requantize.cc)."""
+    in_scale = _symmetric_scale(min_range, max_range, bits=32)
+    if min_calib_range is None:
+        real = data.astype(jnp.float32) * in_scale
+        min_out, max_out = jnp.min(real), jnp.max(real)
+    else:
+        min_out = jnp.asarray(min_calib_range, jnp.float32)
+        max_out = jnp.asarray(max_calib_range, jnp.float32)
+    out_scale = _symmetric_scale(min_out, max_out)
+    q = jnp.clip(jnp.round(data.astype(jnp.float32) * in_scale / out_scale),
+                 -127, 127).astype(jnp.int8)
+    return q, min_out, max_out
+
+
+@register("quantized_fully_connected",
+          aliases=("_contrib_quantized_fully_connected",), num_outputs=3)
+def quantized_fully_connected(data, weight, bias, min_data, max_data,
+                              min_weight, max_weight, min_bias=None,
+                              max_bias=None, num_hidden=None, no_bias=False):
+    """int8 x int8 → int32 matmul + float bias (reference:
+    quantized_fully_connected.cc). data (B, K) int8, weight (N, K) int8;
+    returns (float32 out, min_out, max_out) — the float output form the
+    reference uses after its dequantize fusion."""
+    s_d = _symmetric_scale(min_data, max_data)
+    s_w = _symmetric_scale(min_weight, max_weight)
+    acc = lax.dot_general(
+        data.astype(jnp.int8), weight.astype(jnp.int8),
+        (((data.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * (s_d * s_w)
+    if bias is not None and not no_bias:
+        out = out + bias
+    return out, jnp.min(out), jnp.max(out)
+
+
+@register("quantized_conv", aliases=("_contrib_quantized_conv",),
+          num_outputs=3)
+def quantized_conv(data, weight, bias, min_data, max_data, min_weight,
+                   max_weight, min_bias=None, max_bias=None, kernel=None,
+                   stride=(1, 1), pad=(0, 0), dilate=(1, 1), num_filter=None,
+                   num_group=1, no_bias=False, layout="NCHW"):
+    """int8 convolution with int32 accumulation (reference:
+    quantized_conv.cc). NCHW data, OIHW weight."""
+    s_d = _symmetric_scale(min_data, max_data)
+    s_w = _symmetric_scale(min_weight, max_weight)
+    if data.ndim != 4:
+        raise ValueError("quantized_conv supports 2-D (NCHW) data only")
+    ndim = 2
+    stride = (stride,) * ndim if isinstance(stride, int) else tuple(stride)
+    pad = (pad,) * ndim if isinstance(pad, int) else tuple(pad)
+    dilate = (dilate,) * ndim if isinstance(dilate, int) else tuple(dilate)
+    acc = lax.conv_general_dilated(
+        data.astype(jnp.int8), weight.astype(jnp.int8),
+        window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, feature_group_count=num_group,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * (s_d * s_w)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * ndim)
+    return out, jnp.min(out), jnp.max(out)

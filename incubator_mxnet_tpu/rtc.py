@@ -1,0 +1,26 @@
+"""``mx.rtc`` — runtime kernel compilation (gated).
+
+The reference's ``mx.rtc.CudaModule`` compiles CUDA C at runtime via NVRTC
+(`src/common/rtc.cc` — file-level citation, SURVEY.md caveat). On TPU the
+runtime-codegen capability is **Pallas**: write the kernel as a Python
+function and ``pallas_call`` compiles it for the MXU/VPU — see
+ops/pallas_attention.py for a worked example and
+/opt/skills/guides/pallas_guide.md. CUDA source strings are not
+translatable, so this module is an explicit gate, not a stub."""
+
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["CudaModule"]
+
+_MSG = ("mx.rtc.CudaModule compiles CUDA C, which has no TPU analogue. "
+        "Write the kernel as a Pallas function instead (jax.experimental."
+        "pallas; see incubator_mxnet_tpu/ops/pallas_attention.py for the "
+        "pattern) or as a registered op (incubator_mxnet_tpu.ops."
+        "registry.register) — both JIT-compile for the TPU at runtime.")
+
+
+class CudaModule:
+    def __init__(self, *args, **kwargs):
+        raise MXNetError(_MSG)

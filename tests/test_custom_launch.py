@@ -1,0 +1,81 @@
+"""CustomOp + launcher + rtc gate tests (reference:
+tests/python/unittest/test_operator.py custom-op section; dist launch CI
+idiom SURVEY.md §4.4)."""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, nd
+
+
+@mx.operator.register("scale2")
+class Scale2Prop(mx.operator.CustomOpProp):
+    def __init__(self, factor=2.0):
+        super().__init__(need_top_grad=True)
+        self.factor = float(factor)
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        factor = self.factor
+
+        class _Op(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                self.assign(out_data[0], req[0], in_data[0] * factor)
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                self.assign(in_grad[0], req[0], out_grad[0] * factor)
+
+        return _Op()
+
+
+def test_custom_op_forward_backward():
+    x = nd.array(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="scale2", factor=3.0)
+        loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy() * 3.0)
+    np.testing.assert_allclose(x.grad.asnumpy(), np.full((2, 2), 3.0))
+
+
+def test_custom_op_unknown_type():
+    with pytest.raises(mx.base.MXNetError):
+        nd.Custom(nd.ones((2,)), op_type="nope")
+
+
+def test_rtc_gated():
+    with pytest.raises(mx.base.MXNetError):
+        mx.rtc.CudaModule("__global__ void k() {}")
+
+
+def test_launch_local_two_workers(tmp_path):
+    """Multi-process launch on one box (SURVEY.md §4 idiom 4)."""
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, sys\n"
+        "rank = int(os.environ['JAX_PROCESS_ID'])\n"
+        "n = int(os.environ['JAX_NUM_PROCESSES'])\n"
+        "assert os.environ['DMLC_ROLE'] == 'worker'\n"
+        "assert 0 <= rank < n == 2\n"
+        f"open(r'{tmp_path}/ok' + str(rank), 'w').write('ok')\n")
+    r = subprocess.run(
+        [sys.executable, "tools/launch.py", "-n", "2", "--launcher",
+         "local", sys.executable, str(script)],
+        capture_output=True, text=True, timeout=120, cwd="/root/repo")
+    assert r.returncode == 0, r.stderr
+    assert (tmp_path / "ok0").exists() and (tmp_path / "ok1").exists()
+
+
+def test_launch_ssh_prints_commands():
+    r = subprocess.run(
+        [sys.executable, "tools/launch.py", "-n", "2", "--launcher", "ssh",
+         "python", "train.py"],
+        capture_output=True, text=True, timeout=60, cwd="/root/repo")
+    assert r.returncode == 0
+    assert r.stdout.count("ssh ") == 2
+    assert "JAX_PROCESS_ID=1" in r.stdout

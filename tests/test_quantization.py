@@ -1,0 +1,150 @@
+"""Int8 PTQ tests (reference strategy:
+tests/python/quantization/test_quantization.py — quantize/dequantize
+numerics, calibrated net accuracy preservation)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, nd
+from incubator_mxnet_tpu.contrib.quantization import (
+    calib_thresholds_entropy, quantize_net)
+
+
+def test_quantize_dequantize_roundtrip():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 8).astype(np.float32) * 3
+    q, mn, mxr = nd.quantize_v2(nd.array(x))
+    assert str(q.dtype) == "int8"
+    back = nd.dequantize(q, mn, mxr).asnumpy()
+    # max quantization error is scale/2 = amax/127/2
+    np.testing.assert_allclose(back, x, atol=float(np.abs(x).max()) / 127)
+
+
+def test_quantize_with_calib_range_clips():
+    x = nd.array(np.array([[-10.0, 0.5, 10.0]], np.float32))
+    q, _, _ = nd.quantize_v2(x, min_calib_range=-1.0, max_calib_range=1.0)
+    qn = q.asnumpy()
+    assert qn[0, 0] == -127 and qn[0, 2] == 127
+
+
+def test_quantized_fully_connected_matches_float():
+    rng = np.random.RandomState(1)
+    x = rng.randn(5, 16).astype(np.float32)
+    w = rng.randn(8, 16).astype(np.float32) * 0.2
+    b = rng.randn(8).astype(np.float32) * 0.1
+    xq, mn, mxr = nd.quantize_v2(nd.array(x))
+    amax_w = np.abs(w).max()
+    wq = nd.array(np.clip(np.round(w / (amax_w / 127)), -127,
+                          127).astype(np.int8))
+    out, _, _ = nd.quantized_fully_connected(
+        xq, wq, nd.array(b), mn, mxr, -float(amax_w), float(amax_w))
+    ref = x @ w.T + b
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=0.1, atol=0.1)
+
+
+def test_entropy_threshold_reasonable():
+    rng = np.random.RandomState(2)
+    # gaussian bulk with rare huge outlier: entropy threshold should be
+    # far below the outlier
+    a = np.abs(np.concatenate([rng.randn(100000), [50.0]]))
+    hist, edges = np.histogram(a, bins=2048, range=(0, 50.0))
+    t = calib_thresholds_entropy(hist, edges[1:])
+    assert t < 25.0
+
+
+@pytest.mark.parametrize("mode", ["naive", "entropy"])
+def test_quantize_net_mlp_accuracy(mode):
+    rng = np.random.RandomState(0)
+    X = rng.randn(256, 10).astype(np.float32)
+    W = rng.randn(10, 3).astype(np.float32)
+    y = np.argmax(X @ W, 1).astype(np.float32)
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu"), gluon.nn.Dense(3))
+    net.initialize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 1.0})
+    from incubator_mxnet_tpu import autograd
+    for _ in range(60):
+        with autograd.record():
+            l = loss_fn(net(nd.array(X)), nd.array(y))
+        l.backward()
+        tr.step(256)
+    float_acc = (np.argmax(net(nd.array(X)).asnumpy(), 1) == y).mean()
+
+    qnet = quantize_net(net, calib_data=[nd.array(X[i:i + 64])
+                                         for i in range(0, 256, 64)],
+                        calib_mode=mode)
+    q_out = qnet(nd.array(X)).asnumpy()
+    q_acc = (np.argmax(q_out, 1) == y).mean()
+    assert float_acc > 0.9
+    assert q_acc >= float_acc - 0.05, (float_acc, q_acc)
+
+
+def test_quantize_net_conv():
+    rng = np.random.RandomState(1)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, padding=1, activation="relu"),
+            gluon.nn.GlobalAvgPool2D(), gluon.nn.Dense(4))
+    net.initialize()
+    X = nd.array(rng.randn(2, 3, 8, 8).astype(np.float32))
+    ref = net(X).asnumpy()
+    qnet = quantize_net(net, calib_data=[X])
+    got = qnet(X).asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=0.25, atol=0.25)
+
+
+def test_quantize_net_errors():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4))
+    net.initialize()
+    with pytest.raises(mx.base.MXNetError):
+        quantize_net(net, calib_data=None)
+    with pytest.raises(mx.base.MXNetError):
+        quantize_net(net, calib_data=[nd.ones((1, 4))], calib_mode="bogus")
+    with pytest.raises(mx.base.MXNetError):
+        quantize_net(net, calib_data=[nd.ones((1, 4))],
+                     quantized_dtype="uint4")
+
+
+def test_quantize_net_hybridized():
+    """Regression: calibrating a hybridized net must not trace the hooks."""
+    rng = np.random.RandomState(3)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, activation="relu"), gluon.nn.Dense(3))
+    net.initialize()
+    net.hybridize()
+    X = nd.array(rng.randn(4, 6).astype(np.float32))
+    net(X)  # warm the cached op
+    ref = net(X).asnumpy()
+    qnet = quantize_net(net, calib_data=[X])
+    got = qnet(X).asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=0.3, atol=0.3)
+
+
+def test_entropy_range_growth():
+    """Regression: a later batch with larger range must widen the
+    histogram instead of being clipped into the first batch's range."""
+    from incubator_mxnet_tpu.contrib.quantization import _Collector
+
+    c = _Collector(mode="entropy", num_bins=256)
+    hook = c.hook("L")
+    hook(None, (nd.array(np.linspace(-1, 1, 1000,
+                                     dtype=np.float32)),), None)
+    hook(None, (nd.array(np.linspace(-10, 10, 100000,
+                                     dtype=np.float32)),), None)
+    t = c.threshold("L")
+    assert t > 2.0, t  # not capped at the first batch's max of 1.0
+
+
+def test_quantized_export_gated():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4))
+    net.initialize()
+    X = nd.ones((2, 6))
+    net(X)
+    qnet = quantize_net(net, calib_data=[X])
+    import incubator_mxnet_tpu as mx2
+    with pytest.raises(mx2.base.MXNetError):
+        qnet(mx2.sym.Variable("data"))
