@@ -50,7 +50,7 @@ _LAZY_SUBMODULES = (
     "initializer", "lr_scheduler", "profiler", "amp", "parallel", "models",
     "runtime", "test_utils", "callback", "util", "engine", "recordio",
     "numpy", "np", "npx", "module", "mod", "model", "executor", "kv",
-    "contrib", "operator", "rtc",
+    "contrib", "operator", "rtc", "monitor", "mon",
 )
 
 
@@ -59,7 +59,7 @@ def __getattr__(name):
     if name in _LAZY_SUBMODULES:
         import importlib
 
-        alias = {"sym": ".symbol", "kv": ".kvstore",
+        alias = {"sym": ".symbol", "kv": ".kvstore", "mon": ".monitor",
                  "npx": ".numpy_extension",
                  "numpy": ".numpy_shim", "np": ".numpy_shim",
                  "recordio": ".io.recordio",

@@ -1,0 +1,7 @@
+"""``gluon.contrib`` (parity: python/mxnet/gluon/contrib/)."""
+
+from . import nn
+from . import estimator
+from .estimator import Estimator
+
+__all__ = ["nn", "estimator", "Estimator"]

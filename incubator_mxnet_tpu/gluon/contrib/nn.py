@@ -1,0 +1,89 @@
+"""Contrib layers (re-design of
+`python/mxnet/gluon/contrib/nn/basic_layers.py` — file-level citation,
+SURVEY.md caveat)."""
+
+from __future__ import annotations
+
+from ...base import MXNetError
+from .. import nn
+from ..block import HybridBlock
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "PixelShuffle2D"]
+
+
+class HybridConcurrent(HybridBlock):
+    """Runs children on the same input, concatenates outputs on ``axis``
+    (parity: contrib.nn.HybridConcurrent)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._axis = axis
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+
+    def hybrid_call(self, x):
+        from ... import ndarray as nd
+        outs = [child(x) for child in self._children.values()]
+        return nd.concat(*outs, dim=self._axis)
+
+    def forward(self, x):
+        return self.hybrid_call(x)
+
+
+class Concurrent(HybridConcurrent):
+    """Eager twin (parity: contrib.nn.Concurrent)."""
+
+
+class Identity(HybridBlock):
+    """Passes input through unchanged (parity: contrib.nn.Identity —
+    useful as a no-op branch in Concurrent)."""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(HybridBlock):
+    """Embedding with row_sparse gradients (parity:
+    contrib.nn.SparseEmbedding). Sugar over
+    ``nn.Embedding(sparse_grad=True)`` — the optimizer's lazy path
+    touches only looked-up rows (optimizer.py _rows_update)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self._emb = nn.Embedding(input_dim, output_dim, dtype=dtype,
+                                     weight_initializer=weight_initializer,
+                                     sparse_grad=True, prefix="")
+        self.weight = self._emb.weight
+
+    def hybrid_call(self, x):
+        return self._emb(x)
+
+    def forward(self, x):
+        return self.hybrid_call(x)
+
+
+class PixelShuffle2D(HybridBlock):
+    """Rearranges (B, C*f1*f2, H, W) → (B, C, H*f1, W*f2) (parity:
+    contrib.nn.PixelShuffle2D; sub-pixel convolution upsampling)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        self._factors = (factor, factor) if isinstance(factor, int) \
+            else tuple(factor)
+
+    def hybrid_forward(self, F, x):
+        f1, f2 = self._factors
+        B, C, H, W = x.shape
+        if C % (f1 * f2):
+            raise MXNetError(
+                f"PixelShuffle2D: channels {C} not divisible by "
+                f"{f1}*{f2}")
+        c = C // (f1 * f2)
+        x = F.reshape(x, shape=(B, c, f1, f2, H, W))
+        x = F.transpose(x, axes=(0, 1, 4, 2, 5, 3))
+        return F.reshape(x, shape=(B, c, H * f1, W * f2))

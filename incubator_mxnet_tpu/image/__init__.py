@@ -139,3 +139,250 @@ class ImageIter:
 
     def next(self):
         return self._inner.next()
+
+
+# ------------------------------------------------------------------ #
+# Augmenter classes (parity: python/mxnet/image/image.py Augmenters +
+# CreateAugmenter; host-side numpy — the input pipeline stage, matching
+# the reference's CPU augmentation placement)
+# ------------------------------------------------------------------ #
+class Augmenter:
+    """Image augmenter base (parity: mx.image.Augmenter)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([type(self).__name__, self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=1):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=1):
+        super().__init__(size=size, interp=interp)
+        self.size = size  # (w, h)
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=1):
+        super().__init__(size=size, interp=interp)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.interp = interp
+
+    def __call__(self, src):
+        out, _ = random_crop(src, self.size, self.interp)
+        return out
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=1):
+        super().__init__(size=size, interp=interp)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.interp = interp
+
+    def __call__(self, src):
+        out, _ = center_crop(src, self.size, self.interp)
+        return out
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        from .. import random as _random
+        if _random.np_rng().rand() < self.p:
+            return NDArray(_as_jax(_np(src)[:, ::-1].copy()))
+        return src if isinstance(src, NDArray) else NDArray(_as_jax(src))
+
+
+class CastAug(Augmenter):
+    def __init__(self, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.dtype = dtype
+
+    def __call__(self, src):
+        return NDArray(_as_jax(_np(src).astype(self.dtype)))
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        from .. import random as _random
+        alpha = 1.0 + (_random.np_rng().rand() * 2 - 1) * self.brightness
+        return NDArray(_as_jax(_np(src).astype(np.float32) * alpha))
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = np.array([0.299, 0.587, 0.114], np.float32)
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        from .. import random as _random
+        x = _np(src).astype(np.float32)
+        alpha = 1.0 + (_random.np_rng().rand() * 2 - 1) * self.contrast
+        gray = (x * self._coef).sum(axis=-1, keepdims=True)
+        mean = gray.mean() * (1.0 - alpha)
+        return NDArray(_as_jax(x * alpha + mean))
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = np.array([0.299, 0.587, 0.114], np.float32)
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        from .. import random as _random
+        x = _np(src).astype(np.float32)
+        alpha = 1.0 + (_random.np_rng().rand() * 2 - 1) * self.saturation
+        gray = (x * self._coef).sum(axis=-1, keepdims=True)
+        return NDArray(_as_jax(x * alpha + gray * (1.0 - alpha)))
+
+
+class HueJitterAug(Augmenter):
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+
+    def __call__(self, src):
+        from .. import random as _random
+        x = _np(src).astype(np.float32)
+        alpha = (_random.np_rng().rand() * 2 - 1) * self.hue
+        # yiq rotation (the reference's tyiq approximation)
+        u, w = np.cos(alpha * np.pi), np.sin(alpha * np.pi)
+        t_yiq = np.array([[0.299, 0.587, 0.114],
+                          [0.596, -0.274, -0.321],
+                          [0.211, -0.523, 0.311]], np.float32)
+        t_rgb = np.array([[1.0, 0.956, 0.621],
+                          [1.0, -0.272, -0.647],
+                          [1.0, -1.107, 1.705]], np.float32)
+        rot = np.array([[1, 0, 0], [0, u, -w], [0, w, u]], np.float32)
+        m = t_rgb @ rot @ t_yiq
+        return NDArray(_as_jax(x @ m.T))
+
+
+class ColorJitterAug(Augmenter):
+    def __init__(self, brightness=0.0, contrast=0.0, saturation=0.0):
+        super().__init__(brightness=brightness, contrast=contrast,
+                         saturation=saturation)
+        self._augs = []
+        if brightness:
+            self._augs.append(BrightnessJitterAug(brightness))
+        if contrast:
+            self._augs.append(ContrastJitterAug(contrast))
+        if saturation:
+            self._augs.append(SaturationJitterAug(saturation))
+
+    def __call__(self, src):
+        from .. import random as _random
+        augs = list(self._augs)
+        _random.np_rng().shuffle(augs)
+        for a in augs:
+            src = a(src)
+        return src if isinstance(src, NDArray) else NDArray(_as_jax(src))
+
+
+class LightingAug(Augmenter):
+    """AlexNet-style PCA lighting noise."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, np.float32)
+        self.eigvec = np.asarray(eigvec, np.float32)
+
+    def __call__(self, src):
+        from .. import random as _random
+        alpha = _random.np_rng().normal(0, self.alphastd,
+                                        size=(3,)).astype(np.float32)
+        rgb = (self.eigvec * alpha * self.eigval).sum(axis=1)
+        return NDArray(_as_jax(_np(src).astype(np.float32) + rgb))
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean = mean
+        self.std = std
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class RandomGrayAug(Augmenter):
+    _coef = np.array([0.299, 0.587, 0.114], np.float32)
+
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        from .. import random as _random
+        x = _np(src).astype(np.float32)
+        if _random.np_rng().rand() < self.p:
+            x = np.repeat((x * self._coef).sum(-1, keepdims=True), 3, -1)
+        return NDArray(_as_jax(x))
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=1):
+    """Standard augmentation pipeline factory (parity:
+    mx.image.CreateAugmenter)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.814],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist

@@ -1,0 +1,102 @@
+"""gluon.contrib tests: estimator fit loop, contrib layers."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, nd
+from incubator_mxnet_tpu.gluon.contrib import nn as cnn
+from incubator_mxnet_tpu.gluon.contrib.estimator import (
+    CheckpointHandler, EarlyStoppingHandler, Estimator, LoggingHandler)
+
+
+def _toy():
+    rng = np.random.RandomState(0)
+    X = rng.randn(128, 8).astype(np.float32)
+    W = rng.randn(8, 3).astype(np.float32)
+    y = np.argmax(X @ W, 1).astype(np.float32)
+    return mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True,
+                             label_name="softmax_label"), X, y
+
+
+def test_estimator_fit_improves():
+    it, X, y = _toy()
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(3))
+    net.initialize()
+    logs = []
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    train_metrics="acc",
+                    trainer=gluon.Trainer(net.collect_params(), "adam",
+                                          {"learning_rate": 0.01}),
+                    logger=logs.append)
+    est.fit(it, epochs=5,
+            event_handlers=[LoggingHandler(log_interval=2)])
+    acc = (np.argmax(net(nd.array(X)).asnumpy(), 1) == y).mean()
+    assert acc > 0.8, acc
+    assert any("epoch 4 done" in s for s in logs)
+
+
+def test_estimator_checkpoint_and_early_stop(tmp_path):
+    it, X, y = _toy()
+    net = gluon.nn.Dense(3)
+    net.initialize()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    logger=lambda s: None)
+    est.fit(it, epochs=3,
+            event_handlers=[CheckpointHandler(str(tmp_path)),
+                            EarlyStoppingHandler(monitor="loss",
+                                                 patience=1)])
+    import os
+    saved = [f for f in os.listdir(tmp_path) if f.endswith(".params")]
+    assert saved
+
+
+def test_hybrid_concurrent_and_identity():
+    blk = cnn.HybridConcurrent(axis=-1)
+    blk.add(gluon.nn.Dense(4), cnn.Identity(), gluon.nn.Dense(2))
+    blk.initialize()
+    x = nd.random.uniform(shape=(3, 5))
+    out = blk(x)
+    assert out.shape == (3, 4 + 5 + 2)
+
+
+def test_sparse_embedding_contrib():
+    emb = cnn.SparseEmbedding(50, 8)
+    emb.initialize()
+    out = emb(nd.array(np.array([1.0, 3.0])))
+    assert out.shape == (2, 8)
+    assert emb.weight._grad_stype == "row_sparse"
+
+
+def test_pixel_shuffle():
+    x = nd.random.uniform(shape=(2, 12, 4, 4))
+    ps = cnn.PixelShuffle2D(2)
+    out = ps(x)
+    assert out.shape == (2, 3, 8, 8)
+    # value check against numpy reference
+    xn = x.asnumpy()
+    ref = xn.reshape(2, 3, 2, 2, 4, 4).transpose(0, 1, 4, 2, 5, 3) \
+        .reshape(2, 3, 8, 8)
+    np.testing.assert_allclose(out.asnumpy(), ref)
+
+
+def test_monitor_collects_stats():
+    from incubator_mxnet_tpu.monitor import Monitor
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4, activation="relu"), gluon.nn.Dense(2))
+    net.initialize()
+    mon = Monitor(interval=2).install(net)
+    seen = []
+    for step in range(4):
+        mon.tic()
+        net(nd.random.uniform(shape=(3, 5)))
+        seen.append(mon.toc())
+    assert len(seen[0]) > 0          # step 0 collected
+    assert seen[1] == []             # interval 2: step 1 skipped
+    assert len(seen[2]) > 0
+    name_set = {n for _, n, _ in seen[0]}
+    assert any("output" in n for n in name_set)
+    for _, _, stat in seen[0]:
+        assert np.isfinite(stat).all()

@@ -1,0 +1,56 @@
+"""Image augmenter tests (reference: tests/python/unittest/test_image.py
+strategy — deterministic seeded augmentation, shape/range checks)."""
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import image, nd
+
+
+def _img(h=32, w=48):
+    rng = np.random.RandomState(0)
+    return (rng.rand(h, w, 3) * 255).astype(np.float32)
+
+
+def test_create_augmenter_pipeline():
+    mx.random.seed(0)
+    augs = image.CreateAugmenter(data_shape=(3, 24, 24), rand_crop=True,
+                                 rand_mirror=True, brightness=0.2,
+                                 contrast=0.2, saturation=0.2, hue=0.1,
+                                 pca_noise=0.05, rand_gray=0.2,
+                                 mean=True, std=True)
+    x = _img()
+    for a in augs:
+        x = a(x)
+    out = x.asnumpy()
+    assert out.shape == (24, 24, 3)
+    assert np.isfinite(out).all()
+    # normalized: roughly zero-centered
+    assert abs(out.mean()) < 3.0
+
+
+def test_individual_augs_shapes():
+    x = _img()
+    assert image.CenterCropAug((16, 16))(x).shape == (16, 16, 3)
+    assert image.ForceResizeAug((20, 10))(x).shape == (10, 20, 3)
+    assert image.ResizeAug(16)(x).shape[0] == 16  # short side
+    g = image.RandomGrayAug(p=1.0)(x).asnumpy()
+    assert np.allclose(g[..., 0], g[..., 1])
+    f = image.HorizontalFlipAug(p=1.0)(x).asnumpy()
+    np.testing.assert_allclose(f, np.asarray(x)[:, ::-1])
+
+
+def test_hue_preserves_luma_roughly():
+    x = _img()
+    out = image.HueJitterAug(0.3)(x).asnumpy()
+    coef = np.array([0.299, 0.587, 0.114], np.float32)
+    np.testing.assert_allclose((out * coef).sum(-1), (np.asarray(x) *
+                                                      coef).sum(-1),
+                               rtol=0.15, atol=10.0)
+
+
+def test_augmenter_dumps():
+    a = image.BrightnessJitterAug(0.3)
+    import json
+    name, kw = json.loads(a.dumps())
+    assert name == "BrightnessJitterAug" and kw["brightness"] == 0.3
