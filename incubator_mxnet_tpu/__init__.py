@@ -47,7 +47,8 @@ from .ndarray.ndarray import NDArray
 
 _LAZY_SUBMODULES = (
     "gluon", "symbol", "sym", "optimizer", "kvstore", "metric", "io", "image",
-    "initializer", "lr_scheduler", "profiler", "amp", "parallel", "models",
+    "initializer", "init", "lr_scheduler", "profiler", "amp", "parallel",
+    "models",
     "runtime", "test_utils", "callback", "util", "engine", "recordio",
     "numpy", "np", "npx", "module", "mod", "model", "executor", "kv",
     "contrib", "operator", "rtc", "monitor", "mon",
@@ -60,6 +61,7 @@ def __getattr__(name):
         import importlib
 
         alias = {"sym": ".symbol", "kv": ".kvstore", "mon": ".monitor",
+                 "init": ".initializer",
                  "npx": ".numpy_extension",
                  "numpy": ".numpy_shim", "np": ".numpy_shim",
                  "recordio": ".io.recordio",
