@@ -63,8 +63,9 @@ def calib_thresholds_entropy(hist, bin_edges, num_quantized_bins=255):
             _np.maximum(p[mask], 1e-12) / _np.maximum(q[mask], 1e-12)))
             .sum())
         if kl < best_kl:
-            best_kl, best_t = kl, bin_edges[i - 1] if i <= num_bins \
-                else bin_edges[-1]
+            # threshold = UPPER edge of the last kept bin (bins [0, i) are
+            # kept, so edge index i — len(bin_edges) == num_bins + 1)
+            best_kl, best_t = kl, float(bin_edges[i])
     return float(best_t)
 
 

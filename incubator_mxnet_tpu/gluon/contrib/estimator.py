@@ -161,8 +161,10 @@ class Estimator:
         for m in metrics:
             m.reset()
         for batch in val_data:
-            data, label = batch.data[0], batch.label[0] \
-                if hasattr(batch, "data") else (batch[0], batch[1])
+            if hasattr(batch, "data"):
+                data, label = batch.data[0], batch.label[0]
+            else:
+                data, label = batch[0], batch[1]
             out = self.net(data)
             for m in metrics:
                 m.update([label], [out])

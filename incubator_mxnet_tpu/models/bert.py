@@ -56,16 +56,20 @@ class BERTSelfAttention(HybridBlock):
         self.proj.weight._sharding = P(None, "tp")
 
     def hybrid_forward(self, F, x, mask=None):
+        from ..parallel.spmd import constrain
         B, T = x.shape[0], x.shape[1]
         H, D = self._heads, self._units // self._heads
         qkv = self.qkv(x).reshape((B, T, 3, H, D))
+        qkv = constrain(qkv, ("dp", "fsdp"), None, None, "tp", None)
         q = qkv._op("slice_axis", axis=2, begin=0, end=1).reshape((B, T, H, D))
         k = qkv._op("slice_axis", axis=2, begin=1, end=2).reshape((B, T, H, D))
         v = qkv._op("slice_axis", axis=2, begin=2, end=3).reshape((B, T, H, D))
         out = F.scaled_dot_product_attention(q, k, v, mask=mask,
                                              flash=self._flash)
+        out = constrain(out, ("dp", "fsdp"), None, "tp", None)
         out = out.reshape((B, T, self._units))
-        return self.dropout(self.proj(out))
+        return constrain(self.dropout(self.proj(out)),
+                         ("dp", "fsdp"), None, None)
 
 
 class BERTEncoderLayer(HybridBlock):
@@ -90,11 +94,13 @@ class BERTEncoderLayer(HybridBlock):
         self.ffn_out.weight._sharding = P(None, "tp")
 
     def hybrid_forward(self, F, x, mask=None):
+        from ..parallel.spmd import constrain
         x = self.ln1(x + self.attention(x, mask))
-        h = self.ffn_in(x)
+        x = constrain(x, ("dp", "fsdp"), None, None)
+        h = constrain(self.ffn_in(x), ("dp", "fsdp"), None, "tp")
         h = F.gelu(h)
         h = self.dropout(self.ffn_out(h))
-        return self.ln2(x + h)
+        return constrain(self.ln2(x + h), ("dp", "fsdp"), None, None)
 
 
 class BERTModel(HybridBlock):
@@ -138,16 +144,22 @@ class BERTModel(HybridBlock):
             self.pooler = nn.Dense(units, in_units=units, flatten=False,
                                    activation="tanh",
                                    weight_initializer=init.TruncNorm(stdev=0.02))
-        # embeddings shard over tp on the vocab/feature dim
-        self.word_embed.weight._sharding = P("tp", None)
+        # embedding table shards over the VOCAB dim (tp×fsdp jointly): the
+        # TPU analogue of PS-sharded row_sparse embedding weights
+        # (SURVEY.md §2.3 last row). Keeping units replicated means the
+        # lookup output / backward scatter stay batch-sharded — no
+        # activation resharding against the encoder layout.
+        self.word_embed.weight._sharding = P(("tp", "fsdp"), None)
 
     def hybrid_forward(self, F, input_ids, token_types=None,
                        valid_length=None):
+        from ..parallel.spmd import constrain
         B, T = input_ids.shape
         pos = F.arange(0, T, dtype="int32").reshape((1, T)).broadcast_to((B, T))
         emb = self.word_embed(input_ids) + self.position_embed(pos)
         if token_types is not None:
             emb = emb + self.token_type_embed(token_types)
+        emb = constrain(emb, ("dp", "fsdp"), None, None)
         x = self.embed_dropout(self.embed_ln(emb))
         if self._dtype != "float32":
             x = x.astype(self._dtype)

@@ -239,15 +239,32 @@ def cast_storage(arr, stype):
 
 def retain(rsp: RowSparseNDArray, indices):
     """Keep only the requested rows (reference: _retain op; the KVStore
-    row_sparse_pull building block)."""
+    row_sparse_pull building block).
+
+    TPU design: fully device-side (sort + searchsorted + masked gather),
+    no host round-trip — this sits on the row_sparse_pull hot path.
+    ``indices`` is sorted device-side to keep the RowSparseNDArray
+    sorted-indices invariant. Documented divergence from the reference
+    ``_retain``: requested rows absent from ``rsp`` come back as explicit
+    zero rows (so ``nnz`` counts requested rows, not surviving rows) —
+    semantically identical as a sparse array, and shape-static for XLA."""
     if not isinstance(rsp, RowSparseNDArray):
         raise MXNetError("retain expects a RowSparseNDArray")
-    want = _as_jax(indices).astype(jnp.int32)
-    keep = jnp.isin(rsp._sp_indices, want)
-    kept_np = _np.asarray(keep)
-    idx = _np.asarray(rsp._sp_indices)[kept_np]
-    vals = _np.asarray(rsp._sp_values)[kept_np]
-    return RowSparseNDArray(vals, idx, rsp.shape)
+    want = jnp.sort(_as_jax(indices).astype(jnp.int32))
+    if rsp.nnz == 0 or want.shape[0] == 0:
+        row_shape = tuple(rsp.shape[1:])
+        return RowSparseNDArray(
+            jnp.zeros((int(want.shape[0]),) + row_shape,
+                      rsp._sp_values.dtype), want, rsp.shape)
+    src_idx = rsp._sp_indices.astype(jnp.int32)
+    order = jnp.argsort(src_idx)
+    sorted_idx = src_idx[order]
+    pos = jnp.clip(jnp.searchsorted(sorted_idx, want), 0,
+                   sorted_idx.shape[0] - 1)
+    hit = sorted_idx[pos] == want
+    vals = jnp.take(rsp._sp_values, jnp.take(order, pos), axis=0)
+    hitb = hit.reshape((-1,) + (1,) * (vals.ndim - 1))
+    return RowSparseNDArray(jnp.where(hitb, vals, 0), want, rsp.shape)
 
 
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):

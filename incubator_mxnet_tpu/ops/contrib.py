@@ -308,14 +308,22 @@ def _bilinear(feat, y, x):
 
 @register("ROIAlign", aliases=("roi_align", "_contrib_ROIAlign"))
 def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
-              sample_ratio=2, position_sensitive=False):
+              sample_ratio=2, position_sensitive=False, max_samples=8):
     """RoIAlign (reference: roi_align.cc — bilinear-sampled average per
     bin, no quantization). data: (B, C, H, W); rois: (R, 5)
-    [batch_idx, x1, y1, x2, y2] in image coords."""
+    [batch_idx, x1, y1, x2, y2] in image coords.
+
+    ``sample_ratio <= 0`` means ADAPTIVE (reference semantics:
+    ceil(bin_size) samples per bin, per ROI). TPU design: a static grid
+    with per-ROI validity weights — same math with static shapes for
+    XLA, except the adaptive count is capped at ``max_samples`` per bin
+    axis (the reference is uncapped; raise ``max_samples`` for parity on
+    very large ROIs at quadratic compute cost)."""
     if isinstance(pooled_size, int):
         pooled_size = (pooled_size, pooled_size)
     PH, PW = pooled_size
-    S = max(int(sample_ratio), 1)
+    adaptive = int(sample_ratio) <= 0
+    S = int(max_samples) if adaptive else max(int(sample_ratio), 1)
 
     def _ps_select(full):
         """(C*PH*PW, PH, PW) → (C, PH, PW): bin (i, j) reads its own
@@ -338,15 +346,25 @@ def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
         # S x S bilinear samples per bin, averaged
         iy = jnp.arange(PH, dtype=jnp.float32)
         ix = jnp.arange(PW, dtype=jnp.float32)
-        sy = (jnp.arange(S, dtype=jnp.float32) + 0.5) / S
-        sx = (jnp.arange(S, dtype=jnp.float32) + 0.5) / S
+        if adaptive:
+            s_h = jnp.clip(jnp.ceil(bin_h), 1.0, float(S))
+            s_w = jnp.clip(jnp.ceil(bin_w), 1.0, float(S))
+        else:
+            s_h = s_w = jnp.float32(S)
+        j = jnp.arange(S, dtype=jnp.float32)
+        sy = (j + 0.5) / s_h          # fractions; only j < s_h are valid
+        sx = (j + 0.5) / s_w
+        wy = (j < s_h).astype(jnp.float32)  # (S,)
+        wx = (j < s_w).astype(jnp.float32)
         ys = y1 + (iy[:, None] + sy[None, :]) * bin_h  # (PH, S)
         xs = x1 + (ix[:, None] + sx[None, :]) * bin_w  # (PW, S)
         samp = jax.vmap(lambda yy: jax.vmap(
             lambda xx: _bilinear(feat, yy, xx))(xs.reshape(-1)))(
                 ys.reshape(-1))  # (PH*S, PW*S, C)
         samp = samp.reshape(PH, S, PW, S, -1)
-        out = jnp.mean(samp, axis=(1, 3)).transpose(2, 0, 1)  # (C,PH,PW)
+        w = wy[None, :, None, None, None] * wx[None, None, None, :, None]
+        out = ((samp * w).sum(axis=(1, 3)) / (s_h * s_w)) \
+            .transpose(2, 0, 1)  # (C,PH,PW)
         if position_sensitive:
             out = _ps_select(out)
         return out

@@ -1,4 +1,4 @@
-"""Pallas TPU flash-attention kernel.
+"""Pallas TPU flash-attention kernels (forward AND backward).
 
 The MXU-resident analogue of the reference's fused BERT attention CUDA
 kernels (`src/operator/contrib/transformer.cc`,
@@ -6,17 +6,23 @@ kernels (`src/operator/contrib/transformer.cc`,
 and the performance backbone for the BERT MFU target (SURVEY.md §7.2).
 
 Design (per /opt/skills/guides/pallas_guide.md):
-  - grid (B, H, Tq/block_q): each program owns one q tile in VMEM;
-  - K/V live in VMEM per (batch, head) and are streamed in block_k
-    chunks by a ``fori_loop`` carrying the online-softmax state
-    (m, l, acc) — the flash recurrence, never materializing the
-    (Tq, Tk) score matrix in HBM;
+  - forward: grid (B, H, Tq/block_q); each program owns one q tile in
+    VMEM; K/V are streamed in block_k chunks by a ``fori_loop`` carrying
+    the online-softmax state (m, l, acc) — never materializing the
+    (Tq, Tk) score matrix in HBM. The per-row logsumexp is written as a
+    second output for the backward pass.
+  - backward: two Pallas kernels (the FlashAttention-2 recurrences).
+    dq: grid over q tiles, streaming K/V — p is rebuilt from q, k and the
+    saved logsumexp (no O(T^2) memory), ds = p*(dO·V^T − Δ), dq += ds·K.
+    dk/dv: grid over k tiles, streaming Q/dO — dv += p^T·dO,
+    dk += ds^T·q. Δ = rowsum(dO ⊙ O) is a cheap XLA-fused reduction
+    computed outside the kernels.
   - score blocks hit the MXU via ``jnp.dot(..., preferred_element_type=
     float32)``; masks (key-padding + causal) are built from iota and
-    program ids, no mask tensor traffic;
-  - backward: ``jax.custom_vjp`` whose bwd re-runs the blockwise jnp
-    reference under ``jax.vjp`` — full rematerialization, the standard
-    flash-attention memory trade.
+    program ids, no mask tensor traffic.
+  - padding contract: q/k/v/dO are zero-padded to block multiples;
+    padded-query contributions to dk/dv vanish because dO is zero there,
+    padded keys never attend because valid_len caps at the real Tk.
 
 Falls back transparently (use_flash_attention() returns the best
 available implementation) when Pallas/TPU is absent — e.g. the CPU test
@@ -42,8 +48,12 @@ def _pallas_available():
         return False
 
 
-def _flash_kernel(vl_ref, q_ref, k_ref, v_ref, o_ref, *, scale, causal,
-                  block_q, block_k, n_k_blocks):
+# --------------------------------------------------------------------- #
+# forward kernel
+# --------------------------------------------------------------------- #
+
+def _flash_kernel(vl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
+                  causal, block_q, block_k, n_k_blocks):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(2)
@@ -76,8 +86,9 @@ def _flash_kernel(vl_ref, q_ref, k_ref, v_ref, o_ref, *, scale, causal,
     l0 = jnp.zeros((block_q,), jnp.float32)
     acc0 = jnp.zeros((block_q, D), jnp.float32)
     m, l, acc = lax.fori_loop(0, n_k_blocks, body, (m0, l0, acc0))
-    out = acc / jnp.maximum(l, 1e-30)[:, None]
-    o_ref[0, 0] = out.astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0] = m + jnp.log(l_safe)
 
 
 def _pad_to(x, axis, multiple):
@@ -92,9 +103,9 @@ def _pad_to(x, axis, multiple):
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
                                              "block_k", "interpret"))
-def _flash_forward(q, k, v, valid_len, causal=False, scale=None,
+def _flash_fwd_lse(q, k, v, valid_len, causal=False, scale=None,
                    block_q=128, block_k=128, interpret=False):
-    """q/k/v: (B, H, T, D). valid_len: (B,) int32 key lengths."""
+    """q/k/v: (B, H, T, D). Returns (out, lse) with lse (B, H, Tq)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -116,7 +127,7 @@ def _flash_forward(q, k, v, valid_len, causal=False, scale=None,
         _flash_kernel, scale=scale, causal=causal, block_q=block_q,
         block_k=block_k, n_k_blocks=n_k_blocks)
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(B, H, Tq_p // block_q),
         in_specs=[
@@ -126,16 +137,190 @@ def _flash_forward(q, k, v, valid_len, causal=False, scale=None,
             pl.BlockSpec((1, 1, Tk_p, D), lambda b, h, i: (b, h, 0, 0)),
             pl.BlockSpec((1, 1, Tk_p, D), lambda b, h, i: (b, h, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i: (b, h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Tq_p, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Tq_p), jnp.float32),
+        ],
+        interpret=interpret,
+    )(vl, q, k, v)
+    return out[:, :, :Tq, :], lse[:, :, :Tq]
+
+
+def _flash_forward(q, k, v, valid_len, causal=False, scale=None,
+                   block_q=128, block_k=128, interpret=False):
+    """Forward-only entry (kept for tests / direct use)."""
+    return _flash_fwd_lse(q, k, v, valid_len, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k,
+                          interpret=interpret)[0]
+
+
+# --------------------------------------------------------------------- #
+# backward kernels (FlashAttention-2 recurrences)
+# --------------------------------------------------------------------- #
+
+def _flash_bwd_dq_kernel(vl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                         delta_ref, dq_ref, *, scale, causal, block_q,
+                         block_k, n_k_blocks):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)                   # (bq, D)
+    do = do_ref[0, 0].astype(jnp.float32)                 # (bq, D)
+    lse = lse_ref[0, 0].astype(jnp.float32)               # (bq,)
+    delta = delta_ref[0, 0].astype(jnp.float32)           # (bq,)
+    vl = vl_ref[0, 0]
+    bq, D = q.shape
+    q_pos = qi * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(j, dq):
+        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        k_pos = j * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_pos < vl
+        if causal:
+            mask = mask & (k_pos <= q_pos)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    dq = lax.fori_loop(0, n_k_blocks, body, jnp.zeros((bq, D), jnp.float32))
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(vl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                          delta_ref, dk_ref, dv_ref, *, scale, causal,
+                          block_q, block_k, n_q_blocks):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(2)
+    k = k_ref[0, 0].astype(jnp.float32)                   # (bk, D)
+    v = v_ref[0, 0].astype(jnp.float32)                   # (bk, D)
+    vl = vl_ref[0, 0]
+    bk, D = k.shape
+    k_pos = ki * block_k + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)].astype(jnp.float32)
+        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)] \
+            .astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        mask = k_pos < vl
+        if causal:
+            q_pos = i * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = mask & (k_pos <= q_pos)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)   # (bq, bk)
+        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk0 = jnp.zeros((bk, D), jnp.float32)
+    dv0 = jnp.zeros((bk, D), jnp.float32)
+    dk, dv = lax.fori_loop(0, n_q_blocks, body, (dk0, dv0))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
+                                             "block_k", "interpret"))
+def _flash_backward(q, k, v, valid_len, out, lse, g, causal=False,
+                    scale=None, block_q=128, block_k=128, interpret=False):
+    """Pallas backward: returns (dq, dk, dv). Shapes as forward."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    scale = D ** -0.5 if scale is None else scale
+    block_q = min(block_q, max(Tq, 8))
+    block_k = min(block_k, max(Tk, 8))
+
+    # Δ = rowsum(dO ⊙ O): cheap elementwise+reduce, XLA fuses it
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                              # (B, H, Tq)
+
+    qp, _ = _pad_to(q, 2, block_q)
+    dop, _ = _pad_to(g.astype(q.dtype), 2, block_q)
+    lsep, _ = _pad_to(lse, 2, block_q)
+    deltap, _ = _pad_to(delta, 2, block_q)
+    kp, _ = _pad_to(k, 2, block_k)
+    vp, _ = _pad_to(v, 2, block_k)
+    Tq_p, Tk_p = qp.shape[2], kp.shape[2]
+    n_q_blocks, n_k_blocks = Tq_p // block_q, Tk_p // block_k
+    vl = jnp.minimum(valid_len.astype(jnp.int32), Tk).reshape(B, 1)
+
+    dq_kernel = functools.partial(
+        _flash_bwd_dq_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, n_k_blocks=n_k_blocks)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(B, H, n_q_blocks),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda b, h, i: (b, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, Tk_p, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Tk_p, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i: (b, h, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i: (b, h, i)),
+        ],
         out_specs=pl.BlockSpec((1, 1, block_q, D),
                                lambda b, h, i: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B, H, Tq_p, D), q.dtype),
         interpret=interpret,
-    )(vl, q, k, v)
-    return out[:, :, :Tq, :]
+    )(vl, qp, kp, vp, dop, lsep, deltap)
 
+    dkv_kernel = functools.partial(
+        _flash_bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, n_q_blocks=n_q_blocks)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(B, H, n_k_blocks),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda b, h, j: (b, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, Tq_p, D), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, Tq_p, D), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Tq_p), lambda b, h, j: (b, h, 0)),
+            pl.BlockSpec((1, 1, Tq_p), lambda b, h, j: (b, h, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, j: (b, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Tk_p, D), k.dtype),
+            jax.ShapeDtypeStruct((B, H, Tk_p, D), v.dtype),
+        ],
+        interpret=interpret,
+    )(vl, qp, kp, vp, dop, lsep, deltap)
+
+    return dq[:, :, :Tq, :], dk[:, :, :Tk, :], dv[:, :, :Tk, :]
+
+
+# --------------------------------------------------------------------- #
+# custom-vjp entry
+# --------------------------------------------------------------------- #
 
 def _reference_blockwise(q, k, v, valid_len, causal, scale):
-    """jnp online-softmax reference in (B,H,T,D) layout — the custom-vjp
+    """jnp online-softmax reference in (B,H,T,D) layout — the fallback
     backward recomputes through this (scan-structured, so autodiff keeps
     memory at O(T * block))."""
     from .attention import _sdpa_blockwise
@@ -153,21 +338,26 @@ def _reference_blockwise(q, k, v, valid_len, causal, scale):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
 def flash_attention_bhtd(q, k, v, valid_len, causal=False, scale=None,
                          interpret=False):
-    """Flash attention in (B, H, T, D) layout with a rematerializing
-    backward. Public entry: ops.attention uses this when Pallas is
-    available; ``interpret=True`` runs the same kernel on CPU."""
+    """Flash attention in (B, H, T, D) layout with a Pallas backward.
+    Public entry: ops.attention uses this when Pallas is available;
+    ``interpret=True`` runs the same kernels on CPU."""
     return _flash_forward(q, k, v, valid_len, causal=causal, scale=scale,
                           interpret=interpret)
 
 
 def _fwd(q, k, v, valid_len, causal, scale, interpret):
-    out = _flash_forward(q, k, v, valid_len, causal=causal, scale=scale,
-                         interpret=interpret)
-    return out, (q, k, v, valid_len)
+    out, lse = _flash_fwd_lse(q, k, v, valid_len, causal=causal,
+                              scale=scale, interpret=interpret)
+    return out, (q, k, v, valid_len, out, lse)
 
 
 def _bwd(causal, scale, interpret, res, g):
-    q, k, v, valid_len = res
+    q, k, v, valid_len, out, lse = res
+    if _pallas_available():
+        dq, dk, dv = _flash_backward(q, k, v, valid_len, out, lse, g,
+                                     causal=causal, scale=scale,
+                                     interpret=interpret)
+        return dq, dk, dv, None
     _, vjp = jax.vjp(
         lambda q_, k_, v_: _reference_blockwise(q_, k_, v_, valid_len,
                                                 causal, scale), q, k, v)

@@ -15,7 +15,8 @@ from .mesh import (MeshConfig, build_mesh, current_mesh, default_mesh,
 from . import collectives
 from .collectives import host_allreduce
 from . import spmd
-from .spmd import SPMDTrainer, shard_params, replicate
+from .spmd import (SPMDTrainer, shard_params, replicate, constrain,
+                   activation_sharding_scope)
 from . import ring_attention
 from .ring_attention import ring_self_attention
 

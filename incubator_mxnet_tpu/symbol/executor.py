@@ -474,6 +474,11 @@ class Executor:
         """Accumulate argument gradients per grad_req (parity:
         ``Executor.backward``; `kAddTo` semantics under grad_req='add')."""
         if self._pending is None:
+            if not any(self._req.get(n, "null") != "null"
+                       for n in self._arg_names):
+                # nothing differentiable (all grad_req='null'): reference
+                # Executor.backward is a no-op here, not an error
+                return self.grad_dict
             raise MXNetError("backward called before forward(is_train=True)")
         arg_vals, aux_vals, key, diff_names = self._pending
         seed_ones = out_grads is None

@@ -1,0 +1,148 @@
+"""Regression tests for the round-1 advisor findings (VERDICT round 2,
+"What's weak" #4): each test pins the fixed behavior so it cannot
+regress silently."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, gluon
+from incubator_mxnet_tpu.gluon import nn
+
+
+# --------------------------------------------------------------------- #
+# 1. estimator.evaluate with plain (data, label) tuple batches
+#    (gluon/contrib/estimator.py ternary-precedence crash)
+# --------------------------------------------------------------------- #
+
+def test_estimator_evaluate_tuple_batches():
+    from incubator_mxnet_tpu.gluon.contrib.estimator import Estimator
+    from incubator_mxnet_tpu import metric as metric_mod
+
+    mx.random.seed(0)
+    net = nn.Dense(2, in_units=4)
+    net.initialize()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    train_metrics=metric_mod.Accuracy())
+    rng = np.random.RandomState(0)
+    batches = [(nd.array(rng.randn(3, 4).astype(np.float32)),
+                nd.array(rng.randint(0, 2, (3,))))
+               for _ in range(2)]
+    vals = est.evaluate(iter(batches))
+    assert vals and vals[0][0] == "accuracy"
+    assert 0.0 <= vals[0][1] <= 1.0
+
+
+# --------------------------------------------------------------------- #
+# 2. KL threshold uses the UPPER bin edge (contrib/quantization.py
+#    off-by-one)
+# --------------------------------------------------------------------- #
+
+def test_kl_threshold_upper_edge():
+    from incubator_mxnet_tpu.contrib.quantization import (
+        calib_thresholds_entropy)
+    num_bins = 1024
+    hist = np.concatenate([np.ones(255), np.zeros(num_bins - 255)])
+    bin_edges = np.linspace(0.0, float(num_bins), num_bins + 1)
+    t = calib_thresholds_entropy(hist, bin_edges, num_quantized_bins=255)
+    # all mass lives in bins [0, 255): the KL-optimal candidate keeps
+    # exactly those bins, and the threshold is their UPPER edge (255.0).
+    # The off-by-one bug returned bin_edges[254] = 254.0.
+    assert t == pytest.approx(255.0)
+
+
+# --------------------------------------------------------------------- #
+# 3. executor.backward after an all-null-grad forward is a no-op
+#    (symbol/executor.py raise)
+# --------------------------------------------------------------------- #
+
+def test_executor_backward_all_null_noop():
+    x = mx.sym.Variable("x")
+    y = x * 2.0
+    exe = y.bind(args={"x": nd.array([1.0, 2.0])}, grad_req="null")
+    exe.forward(is_train=True)
+    grads = exe.backward()  # must not raise
+    assert not grads or all(g is None for g in grads.values())
+
+
+# --------------------------------------------------------------------- #
+# 4. ROIAlign sample_ratio<=0 is adaptive (ceil(bin_size) samples/bin)
+# --------------------------------------------------------------------- #
+
+def _roi_align_np(feat, roi, PH, PW, scale, sample_ratio, s_cap=8):
+    """Naive numpy RoIAlign (reference roi_align.cc semantics) for one
+    image, one ROI. feat: (C, H, W); roi: [b, x1, y1, x2, y2]."""
+    C, H, W = feat.shape
+    x1, y1, x2, y2 = (roi[1] * scale, roi[2] * scale,
+                      roi[3] * scale, roi[4] * scale)
+    rw = max(x2 - x1, 1.0)
+    rh = max(y2 - y1, 1.0)
+    bin_h, bin_w = rh / PH, rw / PW
+    s_h = sample_ratio if sample_ratio > 0 else \
+        int(min(max(np.ceil(bin_h), 1), s_cap))
+    s_w = sample_ratio if sample_ratio > 0 else \
+        int(min(max(np.ceil(bin_w), 1), s_cap))
+
+    def bilin(c, y, x):
+        y = min(max(y, 0.0), H - 1.0)
+        x = min(max(x, 0.0), W - 1.0)
+        y0, x0 = int(np.floor(y)), int(np.floor(x))
+        y1_, x1_ = min(y0 + 1, H - 1), min(x0 + 1, W - 1)
+        ly, lx = y - y0, x - x0
+        return (feat[c, y0, x0] * (1 - ly) * (1 - lx)
+                + feat[c, y0, x1_] * (1 - ly) * lx
+                + feat[c, y1_, x0] * ly * (1 - lx)
+                + feat[c, y1_, x1_] * ly * lx)
+
+    out = np.zeros((C, PH, PW), np.float32)
+    for c in range(C):
+        for ph in range(PH):
+            for pw in range(PW):
+                acc = 0.0
+                for jy in range(s_h):
+                    for jx in range(s_w):
+                        yy = y1 + (ph + (jy + 0.5) / s_h) * bin_h
+                        xx = x1 + (pw + (jx + 0.5) / s_w) * bin_w
+                        acc += bilin(c, yy, xx)
+                out[c, ph, pw] = acc / (s_h * s_w)
+    return out
+
+
+def test_roi_align_adaptive_sampling():
+    rng = np.random.RandomState(0)
+    data = rng.randn(1, 2, 16, 16).astype(np.float32)
+    # a large ROI so ceil(bin_h) > 2 — discriminates adaptive from S=1/2
+    rois = np.array([[0, 1.0, 1.0, 13.0, 13.0]], np.float32)
+    got = nd.contrib.ROIAlign(nd.array(data), nd.array(rois),
+                              pooled_size=(2, 2), spatial_scale=1.0,
+                              sample_ratio=0).asnumpy()
+    want = _roi_align_np(data[0], rois[0], 2, 2, 1.0, 0)
+    np.testing.assert_allclose(got[0], want, rtol=1e-4, atol=1e-4)
+    # fixed sample_ratio still matches the naive reference too
+    got2 = nd.contrib.ROIAlign(nd.array(data), nd.array(rois),
+                               pooled_size=(2, 2), spatial_scale=1.0,
+                               sample_ratio=2).asnumpy()
+    want2 = _roi_align_np(data[0], rois[0], 2, 2, 1.0, 2)
+    np.testing.assert_allclose(got2[0], want2, rtol=1e-4, atol=1e-4)
+
+
+# --------------------------------------------------------------------- #
+# 5. sparse retain: device-native, absent rows come back zero
+# --------------------------------------------------------------------- #
+
+def test_retain_device_native_semantics():
+    from incubator_mxnet_tpu.ndarray import sparse
+    rsp = sparse.row_sparse_array(
+        (np.arange(6, dtype=np.float32).reshape(3, 2), [0, 2, 4]),
+        shape=(6, 2))
+    kept = sparse.retain(rsp, [1, 2, 4])
+    dense = kept.asnumpy()
+    assert dense.shape == (6, 2)
+    np.testing.assert_array_equal(dense[1], 0)          # absent row → zero
+    np.testing.assert_array_equal(dense[2], [2.0, 3.0])
+    np.testing.assert_array_equal(dense[4], [4.0, 5.0])
+    np.testing.assert_array_equal(dense[0], 0)          # not requested
+    # empty source
+    z = sparse.zeros("row_sparse", (4, 2))
+    kept0 = sparse.retain(z, [1, 3])
+    assert kept0.asnumpy().sum() == 0
