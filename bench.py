@@ -79,9 +79,11 @@ def _run_bert(on_tpu):
         dtype = "float32"
         steps, warmup = 3, 1
         flash = False
+    remat = os.environ.get("MXTPU_BENCH_REMAT", "0") == "1"
 
     mx.random.seed(0)
-    model = bert_mod.bert_base(dtype=dtype, max_length=T, flash=flash)
+    model = bert_mod.bert_base(dtype=dtype, max_length=T, flash=flash,
+                               remat=remat)
     model.initialize()
     pre = bert_mod.BERTForPretraining(model)
     pre.initialize()

@@ -1,18 +1,13 @@
 """``mx.contrib`` (parity: python/mxnet/contrib/). Quantization is the
-main subsystem; ONNX import/export is gated (no onnx package in this
-build — SURVEY.md §7.3 documented substitutions)."""
+main subsystem. ONNX import/export lives in ``contrib.onnx``: the graph
+translation layer is always available (and tested); actually reading or
+writing .onnx files additionally needs the ``onnx`` wheel and raises the
+documented gate otherwise (SURVEY.md §7.3)."""
 
 from . import quantization
+from . import onnx
+from . import text
 from .quantization import quantize_net
+from .svrg import SVRGModule
 
-__all__ = ["quantization", "quantize_net"]
-
-
-def __getattr__(name):
-    if name == "onnx":
-        from ..base import MXNetError
-        raise MXNetError(
-            "contrib.onnx is not available: the onnx package is not part "
-            "of this build. Use HybridBlock.export / SymbolBlock for "
-            "native serialization.")
-    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+__all__ = ["quantization", "quantize_net", "onnx", "text", "SVRGModule"]

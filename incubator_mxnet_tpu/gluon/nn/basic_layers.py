@@ -123,10 +123,20 @@ class Dropout(HybridBlock):
 
 
 class Embedding(HybridBlock):
-    """(parity: gluon.nn.Embedding; op: Embedding)."""
+    """(parity: gluon.nn.Embedding; op: Embedding).
+
+    ``sharded=True`` attaches a vocab-dim PartitionSpec hint
+    (``P(('tp','fsdp'), None)``) so SPMDTrainer/pjit splits the table's
+    ROWS across the mesh — the TPU-native analogue of the reference's
+    PS-sharded ``row_sparse`` embedding weights (SURVEY.md §2.3 last
+    row): each device stores a vocab shard, the gather and its backward
+    scatter become collective ops XLA schedules on ICI, and the lookup
+    output stays batch-sharded (keeping units replicated avoids
+    activation resharding against batch-sharded encoder layouts)."""
 
     def __init__(self, input_dim, output_dim, dtype="float32",
-                 weight_initializer=None, sparse_grad=False, **kwargs):
+                 weight_initializer=None, sparse_grad=False,
+                 sharded=False, **kwargs):
         super().__init__(**kwargs)
         self._input_dim = input_dim
         self._output_dim = output_dim
@@ -135,6 +145,9 @@ class Embedding(HybridBlock):
                 "weight", shape=(input_dim, output_dim), dtype=dtype,
                 init=weight_initializer,
                 grad_stype="row_sparse" if sparse_grad else "default")
+        if sharded:
+            from jax.sharding import PartitionSpec as _P
+            self.weight._sharding = _P(("tp", "fsdp"), None)
 
     def hybrid_forward(self, F, x, weight):
         return F.Embedding(x, weight, input_dim=self._input_dim,

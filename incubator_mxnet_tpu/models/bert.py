@@ -21,8 +21,12 @@ from __future__ import annotations
 import math
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
+
+from ..ndarray import NDArray
+from .. import random as _rand
 
 from ..base import MXNetError
 from ..gluon import nn
@@ -113,17 +117,18 @@ class BERTModel(HybridBlock):
     def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
                  num_layers=12, num_heads=12, max_length=512,
                  type_vocab_size=2, dropout=0.1, layer_norm_eps=1e-12,
-                 dtype="float32", flash=False, **kwargs):
+                 dtype="float32", flash=False, remat=False, **kwargs):
         super().__init__(**kwargs)
         self._units = units
         self._dtype = dtype
+        self._remat = remat
         self.num_layers = num_layers
         self.num_heads = num_heads
         self.hidden_size = hidden_size
         self.vocab_size = vocab_size
         with self.name_scope():
             self.word_embed = nn.Embedding(
-                vocab_size, units,
+                vocab_size, units, sharded=True,
                 weight_initializer=init.TruncNorm(stdev=0.02))
             self.token_type_embed = nn.Embedding(
                 type_vocab_size, units,
@@ -144,12 +149,9 @@ class BERTModel(HybridBlock):
             self.pooler = nn.Dense(units, in_units=units, flatten=False,
                                    activation="tanh",
                                    weight_initializer=init.TruncNorm(stdev=0.02))
-        # embedding table shards over the VOCAB dim (tp×fsdp jointly): the
+        # word_embed is vocab-sharded via Embedding(sharded=True) — the
         # TPU analogue of PS-sharded row_sparse embedding weights
-        # (SURVEY.md §2.3 last row). Keeping units replicated means the
-        # lookup output / backward scatter stay batch-sharded — no
-        # activation resharding against the encoder layout.
-        self.word_embed.weight._sharding = P(("tp", "fsdp"), None)
+        # (SURVEY.md §2.3 last row; see nn.Embedding docstring)
 
     def hybrid_forward(self, F, input_ids, token_types=None,
                        valid_length=None):
@@ -168,7 +170,28 @@ class BERTModel(HybridBlock):
             ar = F.arange(0, T, dtype="float32").reshape((1, T))
             mask = (ar < valid_length.astype("float32").reshape((-1, 1)))
         for i in range(self.num_layers):
-            x = getattr(self, f"layer{i}")(x, mask)
+            layer = getattr(self, f"layer{i}")
+            if self._remat:
+                # rematerialize each encoder layer in the backward pass
+                # (jax.checkpoint = the reference's mirroring/memonger
+                # memory plan, SURVEY.md §2.1 PlanMemory row): trades
+                # recompute FLOPs for activation HBM so bigger batches
+                # fit. Params enter via closure → saved, not recomputed.
+                # The layer's dropout keys are drawn OUTSIDE and passed as
+                # an explicit input: provider state mutated inside the
+                # checkpoint trace would leak inner tracers, and an input
+                # key replays identically in the remat pass.
+                base = _rand.new_key()
+
+                def _ckpt(xd, md, key, _l=layer):
+                    with _rand.key_provider(key):
+                        return _l(NDArray(xd),
+                                  None if md is None else NDArray(md))._data
+
+                x = NDArray(jax.checkpoint(_ckpt)(
+                    x._data, None if mask is None else mask._data, base))
+            else:
+                x = layer(x, mask)
         x = x.astype("float32")
         cls = x._op("slice_axis", axis=1, begin=0, end=1).reshape(
             (B, self._units))

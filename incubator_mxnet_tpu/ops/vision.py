@@ -1,0 +1,253 @@
+"""Spatial-transform / resampling / patch operators.
+
+Parity targets (file-level citations, SURVEY.md caveat — upstream paths):
+  - UpSampling           src/operator/nn/upsampling.cc
+  - BilinearSampler      src/operator/bilinear_sampler.cc
+  - GridGenerator        src/operator/grid_generator.cc
+  - SpatialTransformer   src/operator/spatial_transformer.cc
+  - im2col / col2im      src/operator/nn/im2col.h
+  - fft / ifft           src/operator/contrib/fft.cc (cuFFT there)
+
+TPU-first design: every op is ONE pure jnp/lax computation with static
+shapes — gathers with per-tap validity weights instead of the reference's
+hand-written CUDA samplers, ``lax.conv_general_dilated_patches`` for
+im2col (XLA lowers it onto the same window machinery as convolution),
+and ``col2im`` as the exact adjoint of ``im2col`` via ``jax.vjp`` (the
+reference maintains a separate handwritten scatter kernel; the adjoint
+identity is the whole spec). Gradients of every op come from jax.vjp of
+the same function (registry contract, ops/registry.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import register
+from .nn import _tup
+
+
+# --------------------------------------------------------------------- #
+# sampling helpers
+# --------------------------------------------------------------------- #
+
+def _bilinear_weights_1d(scale):
+    """The reference's bilinear deconvolution filter of size
+    2*scale - scale % 2 (upsampling.cc init)."""
+    k = 2 * scale - scale % 2
+    center = (2 * scale - 1 - scale % 2) / (2.0 * scale)
+    idx = jnp.arange(k, dtype=jnp.float32)
+    return 1.0 - jnp.abs(idx / scale - center)
+
+
+def _grid_sample_zero_pad(feat, ys, xs):
+    """Bilinear sample one image. feat: (C, H, W); ys/xs: (Ho, Wo) in
+    PIXEL coords. Out-of-boundary taps contribute zero (the reference
+    BilinearSampler contract)."""
+    C, H, W = feat.shape
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    ly = ys - y0
+    lx = xs - x0
+
+    def tap(yi, xi, w):
+        valid = (yi >= 0) & (yi <= H - 1) & (xi >= 0) & (xi <= W - 1)
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        vals = feat[:, yc, xc]                      # (C, Ho, Wo)
+        return vals * (w * valid)[None]
+
+    return (tap(y0, x0, (1 - ly) * (1 - lx))
+            + tap(y0, x0 + 1, (1 - ly) * lx)
+            + tap(y0 + 1, x0, ly * (1 - lx))
+            + tap(y0 + 1, x0 + 1, ly * lx))
+
+
+# --------------------------------------------------------------------- #
+# UpSampling
+# --------------------------------------------------------------------- #
+
+@register("UpSampling", aliases=("up_sampling",))
+def upsampling(*data, scale=2, sample_type="nearest", num_filter=0,
+               multi_input_mode="concat", num_args=None, workspace=None):
+    """Spatial upsampling by an integer ``scale``.
+
+    ``nearest``: pixel repetition (any number of inputs; all upsampled to
+    the FIRST input's scaled size, then channel-concatenated — the
+    reference's multi-input contract). ``bilinear``: the reference's
+    fixed bilinear deconvolution (kernel 2s - s%2, stride s, pad
+    ceil((s-1)/2)) applied per channel; a trailing weight argument, when
+    supplied (reference signature), is used as the deconvolution filter.
+    """
+    if not data:
+        raise MXNetError("UpSampling needs at least one input")
+    scale = int(scale)
+    if sample_type == "nearest":
+        target = (data[0].shape[2] * scale, data[0].shape[3] * scale)
+        outs = []
+        for x in data:
+            s_h = target[0] // x.shape[2]
+            s_w = target[1] // x.shape[3]
+            outs.append(jnp.repeat(jnp.repeat(x, s_h, axis=2), s_w, axis=3))
+        return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
+    if sample_type != "bilinear":
+        raise MXNetError(f"unknown sample_type {sample_type!r}")
+
+    x = data[0]
+    B, C, H, W = x.shape
+    k = 2 * scale - scale % 2
+    pad = -(-(scale - 1) // 2)  # ceil((scale-1)/2), the reference's pad
+    if len(data) > 1:
+        # reference weight layout (C, 1, k, k) → IOHW per-group (1, C, k, k)
+        weight = jnp.transpose(data[1], (1, 0, 2, 3))
+    else:
+        w1 = _bilinear_weights_1d(scale)
+        weight = jnp.broadcast_to((w1[:, None] * w1[None, :])[None, None],
+                                  (1, C, k, k)).astype(x.dtype)
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape,
+                                    ("NCHW", "IOHW", "NCHW"))
+    k_eff = k
+    padding = [(k_eff - 1 - pad, k_eff - 1 - pad)] * 2
+    out = lax.conv_general_dilated(
+        x, jnp.flip(weight, axis=(2, 3)),
+        window_strides=(1, 1),
+        padding=padding,
+        lhs_dilation=(scale, scale),
+        dimension_numbers=dn,
+        feature_group_count=C,
+    )
+    # reference output size is exactly scale * input
+    return out[:, :, :H * scale, :W * scale]
+
+
+# --------------------------------------------------------------------- #
+# BilinearSampler / GridGenerator / SpatialTransformer
+# --------------------------------------------------------------------- #
+
+@register("BilinearSampler", aliases=("bilinear_sampler",))
+def bilinear_sampler(data, grid, cudnn_off=None):
+    """Sample ``data`` at ``grid`` locations. data: (B, C, H, W); grid:
+    (B, 2, Ho, Wo), channel 0 = x, channel 1 = y, normalized to [-1, 1]
+    over the input extent. Out-of-range locations read zero."""
+    B, C, H, W = data.shape
+    xs = (grid[:, 0] + 1.0) * (W - 1) / 2.0          # (B, Ho, Wo)
+    ys = (grid[:, 1] + 1.0) * (H - 1) / 2.0
+    return jax.vmap(_grid_sample_zero_pad)(data, ys, xs)
+
+
+@register("GridGenerator", aliases=("grid_generator",))
+def grid_generator(data, transform_type="affine", target_shape=None):
+    """Generate a sampling grid for BilinearSampler.
+
+    ``affine``: data (B, 6) row-major 2x3 matrices over the normalized
+    target grid. ``warp``: data (B, 2, H, W) pixel-offset flow field.
+    Output (B, 2, Ho, Wo) normalized to [-1, 1]."""
+    if transform_type == "affine":
+        if target_shape is None:
+            raise MXNetError("affine GridGenerator needs target_shape")
+        Ho, Wo = int(target_shape[0]), int(target_shape[1])
+        theta = data.reshape(-1, 2, 3)
+        xt = jnp.linspace(-1.0, 1.0, Wo)
+        yt = jnp.linspace(-1.0, 1.0, Ho)
+        yy, xx = jnp.meshgrid(yt, xt, indexing="ij")   # (Ho, Wo)
+        base = jnp.stack([xx.ravel(), yy.ravel(),
+                          jnp.ones(Ho * Wo)])          # (3, Ho*Wo)
+        out = jnp.einsum("bij,jk->bik", theta, base.astype(data.dtype))
+        return out.reshape(-1, 2, Ho, Wo)
+    if transform_type == "warp":
+        B, two, H, W = data.shape
+        jj = jnp.arange(W, dtype=data.dtype)
+        ii = jnp.arange(H, dtype=data.dtype)
+        x = (data[:, 0] + jj[None, None, :]) * (2.0 / max(W - 1, 1)) - 1.0
+        y = (data[:, 1] + ii[None, :, None]) * (2.0 / max(H - 1, 1)) - 1.0
+        return jnp.stack([x, y], axis=1)
+    raise MXNetError(f"unknown transform_type {transform_type!r}")
+
+
+@register("SpatialTransformer", aliases=("spatial_transformer",))
+def spatial_transformer(data, loc, target_shape=None,
+                        transform_type="affine", sampler_type="bilinear",
+                        cudnn_off=None):
+    """Affine spatial transformer network head: GridGenerator(loc) then
+    BilinearSampler over ``data``."""
+    if transform_type != "affine" or sampler_type != "bilinear":
+        raise MXNetError("SpatialTransformer supports affine + bilinear")
+    if target_shape is None:
+        target_shape = data.shape[2:]
+    grid = grid_generator(loc, transform_type="affine",
+                          target_shape=target_shape)
+    return bilinear_sampler(data, grid)
+
+
+# --------------------------------------------------------------------- #
+# im2col / col2im
+# --------------------------------------------------------------------- #
+
+@register("im2col")
+def im2col(data, kernel=None, stride=None, dilate=None, pad=None):
+    """Sliding-window patch extraction. data: (B, C, H, W) → output
+    (B, C*kh*kw, oh*ow) (reference layout)."""
+    kernel = _tup(kernel, 2)
+    nsp = len(kernel)
+    stride = tuple(s or 1 for s in (_tup(stride, nsp) or (1,) * nsp))
+    dilate = tuple(d or 1 for d in (_tup(dilate, nsp) or (1,) * nsp))
+    pad = _tup(pad, nsp) or (0,) * nsp
+    patches = lax.conv_general_dilated_patches(
+        data, filter_shape=kernel, window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate)
+    B = patches.shape[0]
+    return patches.reshape(B, patches.shape[1], -1)
+
+
+@register("col2im")
+def col2im(data, output_size=None, kernel=None, stride=None, dilate=None,
+           pad=None):
+    """Adjoint of im2col: scatter-add patches back into the image.
+    data: (B, C*kh*kw, L) → (B, C, *output_size)."""
+    kernel = _tup(kernel, 2)
+    nsp = len(kernel)
+    if output_size is None:
+        raise MXNetError("col2im needs output_size")
+    hw = tuple(int(s) for s in _tup(output_size, nsp))
+    C = data.shape[1]
+    for k in kernel:
+        C //= k
+    img_shape = (data.shape[0], C) + hw
+
+    def fwd(img):
+        return im2col(img, kernel=kernel, stride=stride, dilate=dilate,
+                      pad=pad)
+
+    zeros = jnp.zeros(img_shape, data.dtype)
+    _, vjp = jax.vjp(fwd, zeros)
+    return vjp(data)[0]
+
+
+# --------------------------------------------------------------------- #
+# fft / ifft (contrib)
+# --------------------------------------------------------------------- #
+
+@register("fft", aliases=("_contrib_fft",))
+def fft(data, compute_size=128):
+    """FFT along the last axis (reference: contrib/fft.cc, cuFFT).
+    Real input (..., d) → interleaved real/imag output (..., 2d)."""
+    c = jnp.fft.fft(data.astype(jnp.float32), axis=-1)
+    out = jnp.stack([c.real, c.imag], axis=-1)
+    return out.reshape(data.shape[:-1] + (2 * data.shape[-1],)) \
+        .astype(data.dtype)
+
+
+@register("ifft", aliases=("_contrib_ifft",))
+def ifft(data, compute_size=128):
+    """Inverse FFT along the last axis. Interleaved input (..., 2d) →
+    real output (..., d). Reference contract: NO 1/d normalization —
+    ``ifft(fft(x)) == d * x`` (contrib/fft.cc)."""
+    d = data.shape[-1] // 2
+    inter = data.reshape(data.shape[:-1] + (d, 2)).astype(jnp.float32)
+    c = lax.complex(inter[..., 0], inter[..., 1])
+    out = jnp.fft.ifft(c, axis=-1).real * d
+    return out.astype(data.dtype)

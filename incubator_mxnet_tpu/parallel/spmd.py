@@ -140,11 +140,24 @@ def shard_params(block, mesh: Mesh, mode: str = "replicated"):
     """Place every initialized Parameter of ``block`` onto ``mesh`` with its
     resolved sharding (eager re-placement; the jitted step then runs with
     arrays already resident)."""
+    multiproc = jax.process_count() > 1
     for p in block.collect_params().values():
         if p._data is None:
             continue
         sh = _param_sharding(p, mesh, mode)
-        p._data._data = jax.device_put(p._data._data, sh)
+        arr = p._data._data
+        if multiproc and len(arr.devices()) == 1:
+            # promote a process-local array to the multi-host mesh: go
+            # through the host copy (identical on every process — SPMD
+            # programs compute the same init on each rank), the only
+            # legal source for a cross-process device_put
+            arr = _np_host(arr)
+        p._data._data = jax.device_put(arr, sh)
+
+
+def _np_host(arr):
+    import numpy as _np
+    return _np.asarray(arr)
 
 
 class SPMDTrainer:
@@ -221,19 +234,30 @@ class SPMDTrainer:
             self._params = list(self.block.collect_params().values())
             self._train_idx = [i for i, p in enumerate(self._params)
                                if p.grad_req != "null"]
-        shard_params(self.block, self.mesh, self.sharding_mode)
+        multiproc = jax.process_count() > 1
         if self._opt_state is None:
+            # create optimizer state BEFORE params go onto the global mesh:
+            # eager ops (e.g. the multi-precision f32 master cast) are not
+            # legal on non-fully-addressable multi-host arrays
             self._opt_state = []
             for i in self._train_idx:
                 p = self._params[i]
                 st = self._optimizer.create_state_multi_precision(
                     i, p.data())
                 sh = _param_sharding(p, self.mesh, self.sharding_mode)
+
+                def _place(s, sh=sh):
+                    if not isinstance(s, NDArray):
+                        return s
+                    arr = s._data
+                    if multiproc and len(arr.devices()) == 1:
+                        arr = _np_host(arr)
+                    return NDArray(jax.device_put(arr, sh))
+
                 st = jtu.tree_map(
-                    lambda s: NDArray(jax.device_put(s._data, sh))
-                    if isinstance(s, NDArray) else s, st,
-                    is_leaf=lambda s: isinstance(s, NDArray))
+                    _place, st, is_leaf=lambda s: isinstance(s, NDArray))
                 self._opt_state.append(st)
+        shard_params(self.block, self.mesh, self.sharding_mode)
 
     def _build_step(self, n_batch):
         params = self._params
@@ -356,14 +380,33 @@ class SPMDTrainer:
             jtu.tree_map(lambda s: s._data if isinstance(s, NDArray) else s,
                          state_nd,
                          is_leaf=lambda s: isinstance(s, NDArray)))
+        import numpy as _host_np
         key = _random.new_key()
         self._optimizer.num_update = self.step_count  # drive lr schedules
-        t = jnp.asarray(self.step_count + 1, jnp.float32)
-        lr = jnp.asarray(float(self._optimizer.learning_rate), jnp.float32)
+        t = _host_np.float32(self.step_count + 1)
+        lr = _host_np.float32(float(self._optimizer.learning_rate))
+        batch_vals = [b._data for b in batch_nds]
+        if jax.process_count() > 1:
+            # multi-host: every process holds the SAME full batch (SPMD
+            # input contract); build global dp-sharded arrays from the
+            # host copies — committed process-local device arrays cannot
+            # be resharded cross-process
+            key = _host_np.asarray(key)
+            batch_sh = NamedSharding(self.mesh,
+                                     PartitionSpec(("dp", "fsdp")))
+            def _globalize(b):
+                if len(b.devices()) > 1:
+                    return b
+                host = _host_np.asarray(b)
+                if host.ndim == 0:
+                    return host
+                return jax.make_array_from_callback(
+                    host.shape, batch_sh, lambda idx: host[idx])
+            batch_vals = [_globalize(b) for b in batch_vals]
 
         new_train, aux, new_state_leaves, loss_val = self._step_fn(
             train_vals, frozen_vals, tuple(opt_leaves), opt_tree, t, lr, key,
-            *[b._data for b in batch_nds])
+            *batch_vals)
 
         train_set = set(self._train_idx)
         it_t = iter(new_train)

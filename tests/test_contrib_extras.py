@@ -1,0 +1,108 @@
+"""contrib.svrg + contrib.text tests (VERDICT r2 missing #7; reference
+tests: tests/python/unittest/test_contrib_svrg_module.py and
+test_contrib_text.py strategies)."""
+
+import collections
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.contrib import SVRGModule
+from incubator_mxnet_tpu.contrib import text as ctext
+
+
+# --------------------------------------------------------------------- #
+# SVRG
+# --------------------------------------------------------------------- #
+
+def _lin_sym():
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    o = mx.sym.FullyConnected(data, mx.sym.Variable("w"),
+                              mx.sym.Variable("b"), num_hidden=3,
+                              name="fc")
+    return mx.sym.SoftmaxOutput(o, label, normalization="batch",
+                                name="softmax")
+
+
+def _iter(seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(64, 8).astype(np.float32)
+    W = rng.randn(8, 3).astype(np.float32)
+    y = np.argmax(X @ W, 1).astype(np.float32)
+    return mx.io.NDArrayIter(X, y, batch_size=16, shuffle=False,
+                             label_name="softmax_label")
+
+
+def test_svrg_module_fit_converges():
+    mod = SVRGModule(_lin_sym(), update_freq=2)
+    mod.fit(_iter(), optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5}, num_epoch=6,
+            initializer=mx.initializer.Xavier())
+    score = mod.score(_iter(), "acc")
+    assert dict(score)["accuracy"] > 0.8, score
+    assert mod._snapshot is not None and mod._mu is not None
+
+
+def test_svrg_variance_reduced_grad_is_exact_at_snapshot():
+    """Right after a snapshot (w == w~), the variance-reduced minibatch
+    gradient equals mu + (g_i - g_i) = the FULL gradient estimate mu for
+    the same batch distribution — concretely: g_vr == mu when the batch
+    gradient g_i equals the snapshot's batch gradient."""
+    mod = SVRGModule(_lin_sym(), update_freq=1)
+    it = _iter()
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=True)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.0})
+    mod.update_full_grads(it)
+    it.reset()
+    batch = next(iter(it))
+    mod.forward_backward(batch)
+    # w == w~ ⇒ g(w) - g(w~) cancels ⇒ executor grad must equal mu
+    for name, mu in mod._mu.items():
+        got = mod._exec.grad_dict[name].asnumpy()
+        np.testing.assert_allclose(got, mu.asnumpy(), rtol=1e-5,
+                                   atol=1e-6, err_msg=name)
+
+
+# --------------------------------------------------------------------- #
+# text
+# --------------------------------------------------------------------- #
+
+def test_count_tokens_and_vocabulary():
+    counter = ctext.count_tokens_from_str("a b b c c c\nd d d d")
+    assert counter == collections.Counter(
+        {"d": 4, "c": 3, "b": 2, "a": 1})
+    vocab = ctext.Vocabulary(counter, most_freq_count=3, min_freq=2,
+                             reserved_tokens=["<pad>"])
+    # 0=<unk>, 1=<pad>, then d, c, b capped at 3 most frequent
+    assert vocab.idx_to_token == ["<unk>", "<pad>", "d", "c", "b"]
+    assert vocab.to_indices(["d", "zzz", "b"]) == [2, 0, 4]
+    assert vocab.to_tokens([2, 3]) == ["d", "c"]
+    with pytest.raises(mx.MXNetError):
+        vocab.to_tokens(99)
+
+
+def test_custom_embedding_lookup_and_update():
+    emb = ctext.CustomEmbedding({"hot": [1.0, 0.0], "cold": [0.0, 1.0]})
+    v = emb.get_vecs_by_tokens(["hot", "cold", "missing"]).asnumpy()
+    np.testing.assert_allclose(v[0], [1, 0])
+    np.testing.assert_allclose(v[1], [0, 1])
+    np.testing.assert_allclose(v[2], [0, 0])        # unk → zeros
+    emb.update_token_vectors("hot", nd.array([[0.5, 0.5]]))
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hot").asnumpy(), [0.5, 0.5])
+
+
+def test_token_embedding_from_file(tmp_path):
+    p = tmp_path / "glove.txt"
+    p.write_text("the 0.1 0.2 0.3\nof 0.4 0.5 0.6\n")
+    emb = ctext.TokenEmbedding.from_file(str(p))
+    assert emb.vec_len == 3
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("of").asnumpy(), [0.4, 0.5, 0.6])
+    assert emb.idx_to_vec.shape == (3, 3)           # <unk> + 2 tokens

@@ -94,3 +94,36 @@ def test_bert_flash_matches_dense():
     s2, p2 = flash_model(ids, None, vl)
     np.testing.assert_allclose(s1.asnumpy(), s2.asnumpy(),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_bert_remat_matches_no_remat():
+    """jax.checkpoint on encoder layers must not change the training
+    trajectory (memory-only transform)."""
+    import numpy as np
+    from incubator_mxnet_tpu import nd, parallel
+    from incubator_mxnet_tpu.models import bert as bm
+
+    rng = np.random.RandomState(0)
+    B, T, M = 8, 16, 3
+    batch = (nd.array(rng.randint(0, 128, (B, T)), dtype="int32"),
+             nd.array(rng.randint(0, 2, (B, T)), dtype="int32"),
+             nd.array(np.full((B,), T), dtype="int32"),
+             nd.array(rng.randint(0, T, (B, M)), dtype="int32"),
+             nd.array(rng.randint(0, 128, (B, M)), dtype="int32"),
+             nd.ones((B, M)),
+             nd.array(rng.randint(0, 2, (B,)), dtype="int32"))
+    losses = {}
+    for remat in (False, True):
+        mx.random.seed(9)
+        model = bm.bert_tiny(vocab_size=128, max_length=T, remat=remat,
+                             dropout=0.0)
+        model.initialize()
+        pre = bm.BERTForPretraining(model)
+        pre.initialize()
+        tr = parallel.SPMDTrainer(
+            pre, forward_loss=bm.pretraining_loss, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1})
+        for _ in range(3):
+            L = tr.step(*batch)
+        losses[remat] = float(L.asnumpy())
+    assert abs(losses[True] - losses[False]) < 1e-5, losses

@@ -1,0 +1,38 @@
+"""Multi-host (2-process) execution test (VERDICT r2 next-round #6).
+
+Launches tests/dist_worker.py through tools/launch.py --launcher local —
+the TPU-native mirror of the reference's
+tests/nightly/dist_sync_kvstore.py CI idiom: prove the distributed
+kvstore and the fused SPMD step on one box with real separate processes
+(jax.distributed over a 2x4-virtual-device CPU mesh)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_two_process_dist_sync_and_spmd_step():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # the launcher must not inherit the single-process test mesh flags
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    for attempt in range(2):  # coordinator port/races under load: 1 retry
+        r = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
+             "-n", "2", "--launcher", "local", "--",
+             sys.executable, os.path.join(_REPO, "tests",
+                                          "dist_worker.py")],
+            capture_output=True, text=True, timeout=540, env=env,
+            cwd=_REPO)
+        if r.returncode == 0:
+            break
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    # both workers share the launcher's stdout pipe: concurrent writes can
+    # interleave on one line, so count occurrences, not lines
+    oks = r.stdout.count("DIST_WORKER_OK")
+    assert oks == 2, f"expected 2 worker OK markers, got: {r.stdout}"

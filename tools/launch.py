@@ -54,6 +54,8 @@ def _worker_env(rank, n, coord, extra=None):
         "DMLC_NUM_SERVER": "0",
         "DMLC_WORKER_ID": str(rank),
         "MXTPU_COORDINATOR": coord,
+        "MXTPU_NUM_PROCS": str(n),
+        "MXTPU_PROC_ID": str(rank),
     })
     if extra:
         env.update(extra)
@@ -90,6 +92,8 @@ def main(argv=None):
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
 
+    if args.command and args.command[0] == "--":
+        args.command = args.command[1:]
     if not args.command:
         ap.error("no command given")
     if args.num_servers:
