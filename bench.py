@@ -111,6 +111,15 @@ def _run_bert(on_tpu):
     # the axon tunnel backend (verified empirically), so the fetch IS the
     # synchronization point — the reference's asnumpy contract
 
+    trace_dir = os.environ.get("MXTPU_BENCH_TRACE")
+    if trace_dir:
+        # profiler evidence (BASELINE.md protocol): proves the Pallas
+        # kernel executes and shows comm/compute overlap in the step
+        import jax.profiler
+        with jax.profiler.trace(trace_dir):
+            loss = trainer.step(*batch)
+            float(loss.asnumpy())
+
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = trainer.step(*batch)

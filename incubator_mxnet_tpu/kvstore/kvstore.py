@@ -92,15 +92,26 @@ class KVStore(_base.KVStoreBase):
         (SURVEY.md §2.3 tree-reduce row)."""
         if not _is_list(vals):
             vals = [vals]
-        if self._compression_params is not None:
+        ctype = (self._compression_params or {}).get("type", "2bit")
+        if self._compression_params is not None and ctype == "2bit":
             vals = [self._compress_decompress(v) for v in vals]
+        elif self._compression_params is not None and ctype == "bf16":
+            # apply the bf16 rounding on every hop (numerics contract);
+            # the cross-process hop below additionally sends bf16 bytes
+            vals = [NDArray(v._data.astype(jnp.bfloat16)
+                            .astype(v._data.dtype)) for v in vals]
         dev = list(vals[0]._data.devices())[0]
         total = vals[0]._data
         for v in vals[1:]:
             total = total + jax.device_put(v._data, dev)
         if self._distributed:
             from ..parallel.collectives import host_allreduce
-            total = host_allreduce(total)
+            # type='bf16' compresses the CROSS-PROCESS hop with real
+            # wire savings (the TPU-idiomatic compressed collective);
+            # '2bit' keeps the reference's numerics emulation above
+            total = host_allreduce(
+                total,
+                compression="bf16" if ctype == "bf16" else None)
         return NDArray(total)
 
     def _compress_decompress(self, v: NDArray) -> NDArray:
@@ -201,7 +212,14 @@ class KVStore(_base.KVStoreBase):
             return k
 
     def set_gradient_compression(self, compression_params):
-        self._compression_params = dict(compression_params)
+        params = dict(compression_params)
+        ctype = params.get("type", "2bit")
+        if ctype not in ("2bit", "bf16"):
+            raise MXNetError(
+                f"unsupported gradient compression type {ctype!r}; "
+                f"supported: '2bit' (reference numerics emulation), "
+                f"'bf16' (compressed cross-process collective)")
+        self._compression_params = params
 
     # -- misc parity ----------------------------------------------------- #
     def barrier(self):

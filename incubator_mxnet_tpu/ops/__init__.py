@@ -14,6 +14,7 @@ from . import attention  # noqa: F401
 from . import rnn  # noqa: F401
 from . import contrib  # noqa: F401
 from . import vision  # noqa: F401
+from . import misc  # noqa: F401
 from . import linalg  # noqa: F401
 from . import quantization  # noqa: F401
 from .registry import get, list_all_ops, describe_op, register

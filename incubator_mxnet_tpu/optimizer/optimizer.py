@@ -463,19 +463,41 @@ class Updater:
         host_states = jax.tree_util.tree_map(
             lambda x: x.asnumpy() if isinstance(x, NDArray) else x, self.states,
             is_leaf=lambda x: isinstance(x, NDArray))
-        payload = (host_states, self.optimizer) if dump_optimizer else host_states
+        # update counters MUST travel with the state: Adam/LAMB bias
+        # correction and lr schedules depend on them — losing them on
+        # resume silently changes the trajectory
+        payload = {
+            "states": host_states,
+            "counters": {
+                "num_update": self.optimizer.num_update,
+                "index_update_count":
+                    dict(self.optimizer._index_update_count),
+            },
+        }
+        if dump_optimizer:
+            payload["optimizer"] = self.optimizer
         return pickle.dumps(payload)
 
     def set_states(self, states: bytes):
         from ..ndarray import array as nd_array
         import jax
-        data = pickle.loads(states)
-        if isinstance(data, tuple) and len(data) == 2 and \
-                isinstance(data[1], Optimizer):
-            data, self.optimizer = data
         import numpy as np
+        data = pickle.loads(states)
+        counters = None
+        if isinstance(data, dict) and "states" in data:
+            counters = data.get("counters")
+            if "optimizer" in data:
+                self.optimizer = data["optimizer"]
+            data = data["states"]
+        elif isinstance(data, tuple) and len(data) == 2 and \
+                isinstance(data[1], Optimizer):
+            data, self.optimizer = data      # legacy payload layout
         self.states = jax.tree_util.tree_map(
             lambda x: nd_array(x) if isinstance(x, np.ndarray) else x, data)
+        if counters is not None:
+            self.optimizer.num_update = counters["num_update"]
+            self.optimizer._index_update_count = dict(
+                counters["index_update_count"])
 
 
 def get_updater(optimizer: Optimizer) -> Updater:

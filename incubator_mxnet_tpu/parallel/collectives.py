@@ -51,7 +51,8 @@ def reduce_scatter(x, axis_name: str, scatter_dimension: int = 0,
 # ----------------------------------------------------------------------- #
 # host-level eager collectives (the KVStore facade's transport)
 # ----------------------------------------------------------------------- #
-def host_allreduce(x: jax.Array, op: str = "sum") -> jax.Array:
+def host_allreduce(x: jax.Array, op: str = "sum",
+                   compression: Optional[str] = None) -> jax.Array:
     """Eager cross-process allreduce over DCN.
 
     Replaces the reference's dist_sync push path (worker → ps-lite server
@@ -66,6 +67,13 @@ def host_allreduce(x: jax.Array, op: str = "sum") -> jax.Array:
 
     if op != "sum":
         raise ValueError(f"unsupported host_allreduce op {op!r}")
+    if compression == "bf16" and x.dtype == jnp.float32:
+        # REAL wire savings (unlike the reference's 2-bit emulation in
+        # kvstore): halve the bytes crossing DCN by gathering bf16,
+        # accumulate in f32 — the TPU-idiomatic compressed collective
+        gathered = multihost_utils.process_allgather(
+            x.astype(jnp.bfloat16))
+        return jnp.sum(gathered.astype(jnp.float32), axis=0)
     gathered = multihost_utils.process_allgather(x)  # (n_proc, ...)
     return jnp.sum(gathered, axis=0)
 

@@ -18,10 +18,13 @@ from .symbol import (Group, Symbol, Variable, _Node, _auto_name, fromjson,
                      load, load_json, var)
 from . import executor
 from .executor import Executor
+from . import passes
+from .passes import apply_pass, list_passes, register_pass, rewrite
 
 __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
            "fromjson", "Executor", "executor", "save_block_symbol",
-           "trace_block"]
+           "trace_block", "passes", "apply_pass", "list_passes",
+           "register_pass", "rewrite"]
 
 
 def _invoke_symbol(op_name: str, *args, name: Optional[str] = None,

@@ -43,6 +43,16 @@ def main():
     store.pull("w", out=out)
     np.testing.assert_allclose(out.asnumpy(), 3.0)  # 1 + 2
 
+    # bf16-compressed cross-process reduction: real wire savings, values
+    # exact here (small integers are bf16-representable)
+    store2 = kvs.create("dist_sync")
+    store2.set_gradient_compression({"type": "bf16"})
+    store2.init("g", nd.array(np.zeros(4, np.float32)))
+    store2.push("g", nd.array(np.full(4, float(rank + 1), np.float32)))
+    out2 = nd.zeros((4,))
+    store2.pull("g", out=out2)
+    np.testing.assert_allclose(out2.asnumpy(), 3.0)
+
     # ---- fused SPMD step over the global 8-device mesh --------------- #
     mx.random.seed(42)  # identical init on every rank (SPMD contract)
     net = gluon.nn.Sequential()

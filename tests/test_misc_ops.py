@@ -1,0 +1,148 @@
+"""Tests for the round-3 misc operator batch (numpy oracle +
+check_numeric_gradient idiom, reference test_operator.py strategy)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd
+from incubator_mxnet_tpu.test_utils import check_numeric_gradient
+
+
+def test_khatri_rao():
+    a = np.arange(6, dtype=np.float32).reshape(2, 3)
+    b = np.arange(9, dtype=np.float32).reshape(3, 3)
+    got = nd.khatri_rao(nd.array(a), nd.array(b)).asnumpy()
+    want = np.vstack([np.kron(a[:, j], b[:, j]) for j in range(3)]).T
+    np.testing.assert_allclose(got, want)
+
+
+def test_cumsum_cumprod_digamma():
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    np.testing.assert_allclose(nd.cumsum(nd.array(x), axis=1).asnumpy(),
+                               np.cumsum(x, 1))
+    np.testing.assert_allclose(nd.cumprod(nd.array(x), axis=0).asnumpy(),
+                               np.cumprod(x, 0))
+    # digamma vs known values: psi(1) = -euler_gamma, psi(2) = 1 - gamma
+    d = nd.digamma(nd.array([1.0, 2.0])).asnumpy()
+    np.testing.assert_allclose(d[0], -0.5772157, rtol=1e-4)
+    np.testing.assert_allclose(d[1], 1 - 0.5772157, rtol=1e-4)
+
+
+def test_ravel_unravel_roundtrip():
+    shape = (3, 4, 5)
+    flat = np.array([0, 17, 59, 23], np.int32)
+    coords = nd.unravel_index(nd.array(flat), shape=shape).asnumpy()
+    want = np.stack(np.unravel_index(flat, shape))
+    np.testing.assert_array_equal(coords, want)
+    back = nd.ravel_multi_index(nd.array(coords), shape=shape).asnumpy()
+    np.testing.assert_array_equal(back, flat)
+
+
+def test_choose_fill_element_0index():
+    lhs = np.arange(12, dtype=np.float32).reshape(3, 4)
+    rhs = np.array([1, 3, 0], np.float32)
+    got = nd.choose_element_0index(nd.array(lhs), nd.array(rhs)).asnumpy()
+    np.testing.assert_allclose(got, [1.0, 7.0, 8.0])
+    mhs = np.array([-1.0, -2.0, -3.0], np.float32)
+    filled = nd.fill_element_0index(nd.array(lhs), nd.array(mhs),
+                                    nd.array(rhs)).asnumpy()
+    assert filled[0, 1] == -1 and filled[1, 3] == -2 and filled[2, 0] == -3
+    assert filled[0, 0] == 0.0
+
+
+def test_moments():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 4).astype(np.float32)
+    mean, var = nd.moments(nd.array(x), axes=(0, 2))
+    np.testing.assert_allclose(mean.asnumpy(), x.mean(axis=(0, 2)),
+                               rtol=1e-5)
+    np.testing.assert_allclose(var.asnumpy(), x.var(axis=(0, 2)),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_correlation_matches_naive():
+    rng = np.random.RandomState(1)
+    B, C, H, W = 1, 2, 6, 6
+    d1 = rng.randn(B, C, H, W).astype(np.float32)
+    d2 = rng.randn(B, C, H, W).astype(np.float32)
+    got = nd.Correlation(nd.array(d1), nd.array(d2), kernel_size=1,
+                         max_displacement=1, stride1=1, stride2=1,
+                         pad_size=0).asnumpy()
+    disps = [-1, 0, 1]
+    centers = range(1, H - 1)
+    want = np.zeros((B, 9, H - 2, W - 2), np.float32)
+    for di, dy in enumerate(disps):
+        for dj, dx in enumerate(disps):
+            for yi, y in enumerate(centers):
+                for xi, x in enumerate(centers):
+                    want[:, di * 3 + dj, yi, xi] = (
+                        d1[:, :, y, x] * d2[:, :, y + dy, x + dx]
+                    ).mean(axis=1)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_crop():
+    x = np.arange(32, dtype=np.float32).reshape(1, 2, 4, 4)
+    ref = np.zeros((1, 2, 2, 2), np.float32)
+    out = nd.Crop(nd.array(x), nd.array(ref), center_crop=True).asnumpy()
+    np.testing.assert_allclose(out, x[:, :, 1:3, 1:3])
+    out2 = nd.Crop(nd.array(x), h_w=(2, 3), offset=(1, 0)).asnumpy()
+    np.testing.assert_allclose(out2, x[:, :, 1:3, 0:3])
+
+
+def test_output_heads_gradients():
+    rng = np.random.RandomState(2)
+    d = nd.array(rng.randn(4, 3).astype(np.float32))
+    lab = nd.array(np.array([0, 2, 1, 0], np.float32))
+    # logistic: forward sigmoid, grad (p - l)/B
+    x = nd.array(rng.randn(4, 1).astype(np.float32))
+    lab2 = nd.array((rng.rand(4, 1) > 0.5).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        out = nd.LogisticRegressionOutput(x, lab2)
+    out.backward()
+    p = 1 / (1 + np.exp(-x.asnumpy()))
+    # reference scaling: grad_scale / num_output (=1 here), NOT /batch
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               (p - lab2.asnumpy()), rtol=1e-5)
+    # SVM: no violation → zero grad
+    big = nd.array(np.array([[10.0, -10.0], [-10.0, 10.0]], np.float32))
+    labs = nd.array(np.array([0, 1], np.float32))
+    big.attach_grad()
+    with autograd.record():
+        o = nd.SVMOutput(big, labs, margin=1.0)
+    o.backward()
+    np.testing.assert_allclose(big.grad.asnumpy(), 0.0)
+    # MAE: sign gradient
+    m = nd.array(np.array([[2.0], [-3.0]], np.float32))
+    lm = nd.array(np.zeros((2, 1), np.float32))
+    m.attach_grad()
+    with autograd.record():
+        om = nd.MAERegressionOutput(m, lm)
+    om.backward()
+    np.testing.assert_allclose(m.grad.asnumpy(), [[1.0], [-1.0]])
+
+
+def test_amp_multicast_and_all_finite():
+    a = nd.array(np.ones((2, 2), np.float32)).astype("bfloat16")
+    b = nd.array(np.ones((2, 2), np.float32))
+    outs = nd.amp_multicast(a, b, num_outputs=2)
+    assert str(outs[0].dtype) == "float32" and str(outs[1].dtype) == \
+        "float32"
+    narrow = nd.amp_multicast(a, b, num_outputs=2, cast_narrow=True)
+    assert str(narrow[0].dtype) == "bfloat16"
+    ok = nd.all_finite(b).asnumpy()
+    assert ok == 1.0
+    bad = nd.array(np.array([np.inf, 1.0], np.float32))
+    assert nd.all_finite(bad).asnumpy() == 0.0
+    assert nd.multi_all_finite(b, bad, num_arrays=2).asnumpy() == 0.0
+
+
+def test_misc_gradients():
+    rng = np.random.RandomState(3)
+    x = rng.randn(3, 4).astype(np.float32)
+    check_numeric_gradient(lambda d: nd.cumsum(d, axis=1), [nd.array(x)])
+    check_numeric_gradient(
+        lambda d: nd.khatri_rao(d, nd.array(np.ones((2, 4), np.float32))),
+        [nd.array(x)])
