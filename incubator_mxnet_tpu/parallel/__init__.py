@@ -17,6 +17,8 @@ from .collectives import host_allreduce
 from . import spmd
 from .spmd import (SPMDTrainer, shard_params, replicate, constrain,
                    activation_sharding_scope)
+from . import pipeline
+from .pipeline import pipeline_apply, stack_stage_params
 from . import ring_attention
 from .ring_attention import ring_self_attention
 

@@ -1,0 +1,121 @@
+"""Pipeline parallelism over the ``pp`` mesh axis (GPipe schedule).
+
+The reference has no pipeline parallelism (SURVEY.md §2.3 marks the row
+optional); this module is a TPU-native capability that exceeds it, built
+the way the scaling-book prescribes: per-stage parameters are STACKED on
+a leading axis sharded over ``pp`` (one stage per device), and inside a
+``shard_map`` each device runs its stage while activations rotate to the
+next stage via ``lax.ppermute`` on ICI. A GPipe schedule with M
+microbatches fills/drains the pipe in M + S - 1 ticks; autodiff flows
+through the ppermutes, so ``jax.grad`` of a pipelined loss just works —
+no hand-written backward schedule (XLA reverses the permutes).
+
+Layout contract:
+  - ``stacked_params``: pytree whose leaves have leading dim S (=pp
+    size), sharded ``P("pp", ...)`` — stage i's slice lives on device i.
+  - ``x``: (M, B_micro, ...) microbatched input, replicated.
+  - ``stage_fn(params_slice, x_micro) -> y_micro`` — one stage's
+    computation; activations must keep one shape across stages (the
+    usual transformer-block contract).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import jax.tree_util as jtu
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from jax import shard_map
+
+from ..base import MXNetError
+
+__all__ = ["pipeline_apply", "stack_stage_params"]
+
+
+def stack_stage_params(param_trees):
+    """Stack S per-stage pytrees into one tree with leading stage dim
+    (shard it with ``P('pp', ...)`` on the mesh)."""
+    if not param_trees:
+        raise MXNetError("stack_stage_params needs at least one stage")
+    return jtu.tree_map(lambda *xs: jnp.stack(xs), *param_trees)
+
+
+def _pipeline_local(stage_fn, n_stages, n_micro, axis):
+    """Per-device GPipe body (runs inside shard_map)."""
+
+    def body(params, x):
+        # params: (1, ...) slice of the stacked tree → drop stage dim
+        params = jtu.tree_map(lambda p: p[0], params)
+        stage = lax.axis_index(axis)
+        M = n_micro
+        S = n_stages
+        B = x.shape[1]
+        feat = x.shape[2:]
+        # `+ 0*stage` brands the carries as pp-varying from tick 0 so the
+        # shard_map VMA checker accepts the scan (they genuinely become
+        # device-varying after the first ppermute)
+        vary0 = stage.astype(x.dtype) * 0
+        outs0 = jnp.zeros((M, B) + feat, x.dtype) + vary0
+        cur0 = jnp.zeros((B,) + feat, x.dtype) + vary0
+
+        zero_idx = (0,) * (1 + len(feat))
+
+        def tick(t, carry):
+            cur, outs = carry
+            # stage 0 injects microbatch t (while it exists);
+            # other stages consume what arrived from the previous stage
+            inject = jnp.where(t < M, t, M - 1)
+            x_t = lax.dynamic_slice(x, (inject,) + zero_idx,
+                                    (1,) + (B,) + feat)[0]
+            cur = jnp.where(stage == 0, x_t, cur)
+            y = stage_fn(params, cur)
+            # last stage emits microbatch t-(S-1) once the pipe is full
+            out_idx = jnp.clip(t - (S - 1), 0, M - 1)
+            emit = (stage == S - 1) & (t >= S - 1)
+            old = lax.dynamic_slice(outs, (out_idx,) + zero_idx,
+                                    (1,) + y.shape)[0]
+            outs = lax.dynamic_update_slice(
+                outs, jnp.where(emit, y, old)[None],
+                (out_idx,) + zero_idx)
+            # rotate activations one stage forward on the ring
+            perm = [(i, (i + 1) % S) for i in range(S)]
+            cur = lax.ppermute(y, axis, perm)
+            return cur, outs
+
+        _, outs = lax.fori_loop(0, M + S - 1, tick, (cur0, outs0))
+        # every device returns its outs buffer; only the last stage's is
+        # real — psum after masking broadcasts it everywhere (cheap: one
+        # buffer per device, and it keeps the output replicated like the
+        # input)
+        mine = jnp.where(stage == S - 1, 1.0, 0.0).astype(x.dtype)
+        return lax.psum(outs * mine, axis)
+
+    return body
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh,
+                   axis: str = "pp"):
+    """Run ``x`` (microbatched (M, B, ...)) through S pipeline stages.
+
+    Returns (M, B, ...) outputs, replicated over ``axis``. Differentiable
+    end-to-end; wrap in ``jax.jit``/``jax.grad`` freely."""
+    S = mesh.shape[axis]
+    M = x.shape[0]
+    if M < S:
+        raise MXNetError(
+            f"pipeline needs microbatches >= stages ({M} < {S}); more "
+            f"microbatches amortize the fill/drain bubble")
+    body = _pipeline_local(stage_fn, S, M, axis)
+
+    def spec_of(leaf):
+        return P(axis, *([None] * (leaf.ndim - 1)))
+
+    param_specs = jtu.tree_map(spec_of, stacked_params)
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(param_specs, P()), out_specs=P())
+    return fn(stacked_params, x)
