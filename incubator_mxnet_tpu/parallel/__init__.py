@@ -19,6 +19,8 @@ from .spmd import (SPMDTrainer, shard_params, replicate, constrain,
                    activation_sharding_scope)
 from . import pipeline
 from .pipeline import pipeline_apply, stack_stage_params
+from . import moe
+from .moe import switch_moe, stack_expert_params
 from . import ring_attention
 from .ring_attention import ring_self_attention
 

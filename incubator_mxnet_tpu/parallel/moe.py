@@ -1,0 +1,95 @@
+"""Mixture-of-Experts with expert parallelism over the ``ep`` mesh axis.
+
+The reference has no MoE (SURVEY.md §2.3 reserves the axis); this is a
+TPU-native capability beyond it, in the Mesh-TensorFlow/Switch
+formulation the scaling-book prescribes: routing is expressed as dense
+one-hot einsums (MXU-friendly, fully differentiable, static shapes) and
+the expert dimension is sharded over ``ep`` with
+``lax.with_sharding_constraint`` — XLA inserts the token all_to_all on
+ICI between the batch-sharded token layout and the expert-sharded
+expert layout. No per-token control flow anywhere.
+
+Top-1 (Switch) routing with capacity: tokens over an expert's capacity
+are DROPPED (output zero — the caller's residual connection carries
+them), the Switch-Transformer contract. The auxiliary load-balancing
+loss (E * Σ_e fraction_e * mean_prob_e) is returned for the caller to
+add to the objective.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import jax.tree_util as jtu
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+
+__all__ = ["switch_moe", "stack_expert_params"]
+
+
+def stack_expert_params(param_trees):
+    """Stack E per-expert pytrees on a leading expert dim (shard it
+    ``P('ep', ...)``)."""
+    if not param_trees:
+        raise MXNetError("stack_expert_params needs at least one expert")
+    return jtu.tree_map(lambda *xs: jnp.stack(xs), *param_trees)
+
+
+def _constrain(x, mesh, *spec):
+    from .spmd import constrain
+    return constrain(x, *spec, mesh=mesh)
+
+
+def switch_moe(x, gate_logits, expert_fn: Callable, expert_params,
+               capacity_factor: float = 1.25, mesh: Optional[Mesh] = None,
+               axis: str = "ep", token_axis: str = "dp"):
+    """Top-1 sparse MoE layer.
+
+    x: (N, D) tokens (flatten batch×seq first); gate_logits: (N, E);
+    expert_fn(params_slice, h (C, D)) -> (C, D) — one expert's FFN;
+    expert_params: pytree with leading expert dim E.
+
+    Returns (out (N, D), aux_loss scalar). Dropped tokens come back as
+    zeros — add the layer's residual around it."""
+    N, D = x.shape
+    E = gate_logits.shape[-1]
+    C = max(1, math.ceil(N / E * capacity_factor))
+
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)                    # (N,)
+    gate = jnp.take_along_axis(probs, expert_idx[:, None],
+                               axis=-1)[:, 0]                  # (N,)
+
+    onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # (N, E)
+    # position of each token in its expert's queue
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0            # (N, E)
+    keep = (pos < C) * onehot                                  # (N, E)
+    dispatch = keep[..., None] * jax.nn.one_hot(
+        jnp.clip(pos, 0, C - 1).astype(jnp.int32), C,
+        dtype=jnp.float32)                                     # (N, E, C)
+
+    # tokens (batch-sharded) → expert-major layout (ep-sharded): XLA
+    # lowers the layout change to an all_to_all on ICI
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch,
+                           x.astype(jnp.float32))              # (E, C, D)
+    expert_in = _constrain(expert_in, mesh, axis, None, None)
+    expert_params = jtu.tree_map(
+        lambda p: _constrain(p, mesh, axis,
+                             *([None] * (p.ndim - 1))), expert_params)
+    expert_out = jax.vmap(expert_fn)(expert_params,
+                                     expert_in.astype(x.dtype))
+    expert_out = _constrain(expert_out.astype(jnp.float32), mesh, axis,
+                            None, None)
+    out = jnp.einsum("nec,ecd->nd", dispatch, expert_out)      # (N, D)
+    out = _constrain(out, mesh, token_axis, None)
+    out = out * gate[:, None]
+
+    # Switch load-balancing auxiliary loss
+    frac_tokens = onehot.mean(axis=0)                          # (E,)
+    mean_prob = probs.mean(axis=0)                             # (E,)
+    aux = E * jnp.sum(frac_tokens * mean_prob)
+    return out.astype(x.dtype), aux

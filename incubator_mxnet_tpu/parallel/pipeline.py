@@ -21,16 +21,13 @@ Layout contract:
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable
 
 import jax
 import jax.numpy as jnp
 import jax.tree_util as jtu
-from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-from jax import shard_map
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..base import MXNetError
 
@@ -110,6 +107,12 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh,
         raise MXNetError(
             f"pipeline needs microbatches >= stages ({M} < {S}); more "
             f"microbatches amortize the fill/drain bubble")
+    for leaf in jtu.tree_leaves(stacked_params):
+        if leaf.shape[0] != S:
+            raise MXNetError(
+                f"stacked_params leading dim {leaf.shape[0]} != pp mesh "
+                f"size {S}: one stage per device (a multiple would be "
+                f"silently truncated by the per-device slice)")
     body = _pipeline_local(stage_fn, S, M, axis)
 
     def spec_of(leaf):

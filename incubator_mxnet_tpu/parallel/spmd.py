@@ -53,17 +53,19 @@ def activation_sharding_scope(mesh: Mesh):
         _ACTIVE_MESH.reset(tok)
 
 
-def constrain(x, *spec):
+def constrain(x, *spec, mesh: Optional[Mesh] = None):
     """Pin an activation's sharding inside the fused SPMD step
-    (``lax.with_sharding_constraint`` against the trainer's mesh).
+    (``lax.with_sharding_constraint`` against the trainer's mesh, or an
+    explicitly passed ``mesh``).
 
     Models sprinkle this on attention/FFN activations so the partitioner
     never falls back to replicate-then-repartition between fsdp-placed
     and tp-hinted params (VERDICT r2 weak #3). Each ``spec`` entry is an
     axis name, a tuple of axis names, or None; axes absent from the mesh
-    or of size 1 are dropped, and outside SPMDTrainer tracing the call
-    returns ``x`` unchanged — so model code is mesh-agnostic."""
-    mesh = _ACTIVE_MESH.get()
+    or of size 1 are dropped, and with no mesh (active or given) the
+    call returns ``x`` unchanged — so model code is mesh-agnostic."""
+    if mesh is None:
+        mesh = _ACTIVE_MESH.get()
     if mesh is None:
         return x
     entries = []
