@@ -1,0 +1,31 @@
+"""Shared per-layer rematerialization helper.
+
+``jax.checkpoint`` around one block call (the reference's
+mirroring/memonger memory plan, SURVEY.md §2.1 PlanMemory row). The
+block's dropout keys are drawn OUTSIDE the checkpoint and passed as an
+explicit input: provider state mutated inside the checkpoint trace would
+leak inner tracers, and an input key replays identically in the remat
+pass. Params enter via closure → saved as residuals, not recomputed."""
+
+from __future__ import annotations
+
+import jax
+
+from .. import random as _rand
+from ..ndarray import NDArray
+
+__all__ = ["remat_call"]
+
+
+def remat_call(block, *args):
+    """Apply ``block(*args)`` under jax.checkpoint. ``args`` are NDArrays
+    or None; returns an NDArray."""
+    base = _rand.new_key()
+    vals = [a._data if a is not None else None for a in args]
+
+    def _ckpt(key, *vs):
+        with _rand.key_provider(key):
+            nds = [NDArray(v) if v is not None else None for v in vs]
+            return block(*nds)._data
+
+    return NDArray(jax.checkpoint(_ckpt)(base, *vals))

@@ -172,24 +172,11 @@ class BERTModel(HybridBlock):
         for i in range(self.num_layers):
             layer = getattr(self, f"layer{i}")
             if self._remat:
-                # rematerialize each encoder layer in the backward pass
-                # (jax.checkpoint = the reference's mirroring/memonger
-                # memory plan, SURVEY.md §2.1 PlanMemory row): trades
-                # recompute FLOPs for activation HBM so bigger batches
-                # fit. Params enter via closure → saved, not recomputed.
-                # The layer's dropout keys are drawn OUTSIDE and passed as
-                # an explicit input: provider state mutated inside the
-                # checkpoint trace would leak inner tracers, and an input
-                # key replays identically in the remat pass.
-                base = _rand.new_key()
-
-                def _ckpt(xd, md, key, _l=layer):
-                    with _rand.key_provider(key):
-                        return _l(NDArray(xd),
-                                  None if md is None else NDArray(md))._data
-
-                x = NDArray(jax.checkpoint(_ckpt)(
-                    x._data, None if mask is None else mask._data, base))
+                # rematerialize each encoder layer in the backward pass:
+                # trades recompute FLOPs for activation HBM so bigger
+                # batches fit (see models/_remat.py for the key contract)
+                from ._remat import remat_call
+                x = remat_call(layer, x, mask)
             else:
                 x = layer(x, mask)
         x = x.astype("float32")
