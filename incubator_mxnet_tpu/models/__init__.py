@@ -13,7 +13,7 @@ __all__ = ["LeNet", "BERTModel", "BERTForPretraining", "bert_base",
 
 
 def __getattr__(name):
-    if name in ("resnet", "transformer", "ssd", "gpt"):
+    if name in ("resnet", "transformer", "ssd", "gpt", "faster_rcnn"):
         import importlib
         mod = importlib.import_module("." + name, __name__)
         globals()[name] = mod
