@@ -229,3 +229,118 @@ def mp_adam_update(weight, grad, mean, var, weight32, lr=0.001, beta1=0.9,
         beta2=beta2, epsilon=epsilon, wd=wd, rescale_grad=rescale_grad,
         clip_gradient=clip_gradient)
     return new_w32.astype(weight.dtype), new_mean, new_var, new_w32
+
+
+@register("multi_sgd_update", num_outputs=None, wrap_list=True)
+def multi_sgd_update(weights, grads, lrs=None, wds=None, rescale_grad=1.0,
+                     clip_gradient=-1.0):
+    outs = []
+    for i, (w, g) in enumerate(zip(weights, grads)):
+        outs.append(sgd_update(
+            w, g, lr=lrs[i] if lrs else 0.01, wd=wds[i] if wds else 0.0,
+            rescale_grad=rescale_grad, clip_gradient=clip_gradient))
+    return tuple(outs)
+
+
+@register("mp_sgd_update", num_outputs=2)
+def mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0):
+    new_w32 = sgd_update(weight32, grad.astype(jnp.float32), lr=lr, wd=wd,
+                         rescale_grad=rescale_grad,
+                         clip_gradient=clip_gradient)
+    return new_w32.astype(weight.dtype), new_w32
+
+
+@register("multi_mp_sgd_update", num_outputs=None, wrap_list=True)
+def multi_mp_sgd_update(weights, grads, weights32, lrs=None, wds=None,
+                        rescale_grad=1.0, clip_gradient=-1.0):
+    outs = []
+    for i, (w, g, w32) in enumerate(zip(weights, grads, weights32)):
+        outs.append(mp_sgd_update(
+            w, g, w32, lr=lrs[i] if lrs else 0.01,
+            wd=wds[i] if wds else 0.0, rescale_grad=rescale_grad,
+            clip_gradient=clip_gradient))
+    return tuple(x for pair in outs for x in pair)
+
+
+@register("multi_mp_sgd_mom_update", num_outputs=None, wrap_list=True)
+def multi_mp_sgd_mom_update(weights, grads, moms, weights32, lrs=None,
+                            wds=None, momentum=0.0, rescale_grad=1.0,
+                            clip_gradient=-1.0):
+    outs = []
+    for i, (w, g, m, w32) in enumerate(zip(weights, grads, moms,
+                                           weights32)):
+        outs.append(mp_sgd_mom_update(
+            w, g, m, w32, lr=lrs[i] if lrs else 0.01,
+            wd=wds[i] if wds else 0.0, momentum=momentum,
+            rescale_grad=rescale_grad, clip_gradient=clip_gradient))
+    return tuple(x for trio in outs for x in trio)
+
+
+@register("mp_nag_mom_update", num_outputs=3)
+def mp_nag_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    new_w32, new_mom = nag_mom_update(
+        weight32, grad.astype(jnp.float32), mom, lr=lr, momentum=momentum,
+        wd=wd, rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@register("mp_adamw_update", num_outputs=4)
+def mp_adamw_update(weight, grad, mean, var, weight32, lr=0.001, beta1=0.9,
+                    beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    new_w32, new_mean, new_var = adamw_update(
+        weight32, grad.astype(jnp.float32), mean, var, lr=lr, beta1=beta1,
+        beta2=beta2, epsilon=epsilon, wd=wd, eta=eta,
+        rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+    return new_w32.astype(weight.dtype), new_mean, new_var, new_w32
+
+
+@register("ftml_update", num_outputs=4)
+def ftml_update(weight, grad, d, v, z, lr=0.0025, beta1=0.6, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_grad=-1.0,
+                t=1):
+    """FTML (reference: optimizer_op.cc FTMLKernel). Returns
+    (new_weight, new_d, new_v, new_z). The reference clips the FULL
+    quantity rescale*grad + wd*weight, and the update preserves input
+    dtypes (low-precision storage stays low-precision)."""
+    g = grad * rescale_grad + wd * weight
+    if clip_grad is not None and clip_grad > 0:
+        g = jnp.clip(g, -clip_grad, clip_grad)
+    new_v = beta2 * v + (1 - beta2) * jnp.square(g)
+    bias2 = 1 - jnp.power(beta2, t)
+    d_t = (1 - jnp.power(beta1, t)) / lr * (
+        jnp.sqrt(new_v / bias2) + epsilon)
+    sigma = d_t - beta1 * d
+    new_z = beta1 * z + (1 - beta1) * g - sigma * weight
+    new_w = -new_z / d_t
+    return (new_w.astype(weight.dtype), d_t.astype(d.dtype), new_v,
+            new_z.astype(z.dtype))
+
+
+@register("adagrad_update", num_outputs=2)
+def adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    g = g + wd * weight
+    new_hist = history + jnp.square(g)
+    # epsilon INSIDE the sqrt (reference AdagradUpdate / the AdaGrad
+    # optimizer class — keep the two surfaces numerically identical)
+    return weight - lr * g / jnp.sqrt(new_hist + epsilon), new_hist
+
+
+@register("adadelta_update", num_outputs=3)
+def adadelta_update(weight, grad, acc_g, acc_delta, rho=0.9, epsilon=1e-5,
+                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    g = g + wd * weight
+    new_acc_g = rho * acc_g + (1 - rho) * jnp.square(g)
+    delta = jnp.sqrt(acc_delta + epsilon) / \
+        jnp.sqrt(new_acc_g + epsilon) * g
+    new_acc_delta = rho * acc_delta + (1 - rho) * jnp.square(delta)
+    return weight - delta, new_acc_g, new_acc_delta

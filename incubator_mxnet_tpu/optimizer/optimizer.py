@@ -26,8 +26,8 @@ from ..ndarray import NDArray, zeros as nd_zeros
 from ..ndarray.register import invoke_by_name
 
 __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "RMSProp", "Ftrl",
-           "Signum", "LAMB", "AdaGrad", "AdaDelta", "Updater", "create",
-           "register", "get_updater"]
+           "Signum", "LAMB", "FTML", "AdaGrad", "AdaDelta", "Updater",
+           "create", "register", "get_updater"]
 
 _REGISTRY = Registry("optimizer")
 
@@ -396,6 +396,34 @@ class LAMB(Optimizer):
         mean._data, var._data = new_mean._data, new_var._data
 
 
+@register("ftml")
+class FTML(Optimizer):
+    """FTML (reference: optimizer.py FTML + ftml_update op)."""
+
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        dt = str(weight.dtype)
+        return (nd_zeros(weight.shape, dtype=dt),   # d
+                nd_zeros(weight.shape, dtype=dt),   # v
+                nd_zeros(weight.shape, dtype=dt))   # z
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        d, v, z = state
+        t = self._step_t(index)
+        new_w, new_d, new_v, new_z = invoke_by_name(
+            "ftml_update", weight, grad, d, v, z, lr=lr, beta1=self.beta1,
+            beta2=self.beta2, epsilon=self.epsilon, wd=wd,
+            rescale_grad=self.rescale_grad,
+            clip_grad=self.clip_gradient, t=t)
+        weight._data = new_w._data
+        d._data, v._data, z._data = new_d._data, new_v._data, new_z._data
+
+
 @register("adagrad")
 class AdaGrad(Optimizer):
     def __init__(self, eps=1e-7, **kwargs):
@@ -408,13 +436,12 @@ class AdaGrad(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
-        g = grad._data * self.rescale_grad
-        if self.clip_gradient > 0:
-            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
-        g = g + wd * weight._data
-        state._data = state._data + jnp.square(g)
-        weight._data = weight._data - lr * g / jnp.sqrt(
-            state._data + self.float_stable_eps)
+        new_w, new_hist = invoke_by_name(
+            "adagrad_update", weight, grad, state, lr=lr,
+            epsilon=self.float_stable_eps, wd=wd,
+            rescale_grad=self.rescale_grad,
+            clip_gradient=self.clip_gradient)
+        weight._data, state._data = new_w._data, new_hist._data
 
 
 @register("adadelta")
@@ -431,15 +458,13 @@ class AdaDelta(Optimizer):
         self._update_count(index)
         wd = self._get_wd(index)
         acc_g, acc_delta = state
-        g = grad._data * self.rescale_grad
-        if self.clip_gradient > 0:
-            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
-        g = g + wd * weight._data
-        acc_g._data = self.rho * acc_g._data + (1 - self.rho) * jnp.square(g)
-        delta = jnp.sqrt(acc_delta._data + self.epsilon) / \
-            jnp.sqrt(acc_g._data + self.epsilon) * g
-        acc_delta._data = self.rho * acc_delta._data + (1 - self.rho) * jnp.square(delta)
-        weight._data = weight._data - delta
+        new_w, new_acc_g, new_acc_delta = invoke_by_name(
+            "adadelta_update", weight, grad, acc_g, acc_delta,
+            rho=self.rho, epsilon=self.epsilon, wd=wd,
+            rescale_grad=self.rescale_grad,
+            clip_gradient=self.clip_gradient)
+        weight._data = new_w._data
+        acc_g._data, acc_delta._data = new_acc_g._data, new_acc_delta._data
 
 
 class Updater:

@@ -146,3 +146,44 @@ def test_misc_gradients():
     check_numeric_gradient(
         lambda d: nd.khatri_rao(d, nd.array(np.ones((2, 4), np.float32))),
         [nd.array(x)])
+
+
+def test_new_optimizer_ops_and_ftml_class():
+    """Round-3 optimizer op batch: mp/multi variants + FTML end to end."""
+    from incubator_mxnet_tpu import autograd, gluon
+
+    w = nd.array(np.ones(4, np.float32))
+    g = nd.array(np.full(4, 0.5, np.float32))
+    w32 = nd.array(np.ones(4, np.float32))
+    out_b, out_32 = nd.mp_sgd_update(w.astype("bfloat16"),
+                                     g.astype("bfloat16"), w32, lr=0.1)
+    assert str(out_b.dtype) == "bfloat16"
+    np.testing.assert_allclose(out_32.asnumpy(), 0.95)
+    nw, nh = nd.adagrad_update(w, g, nd.zeros((4,)), lr=0.1)
+    np.testing.assert_allclose(nh.asnumpy(), 0.25)
+    ws = [nd.array(np.ones(3, np.float32)),
+          nd.array(np.ones(2, np.float32))]
+    gs = [nd.array(np.ones(3, np.float32)),
+          nd.array(np.ones(2, np.float32))]
+    outs = nd.multi_sgd_update(ws, gs, lrs=[0.1, 0.2], wds=[0.0, 0.0])
+    np.testing.assert_allclose(outs[0].asnumpy(), 0.9)
+    np.testing.assert_allclose(outs[1].asnumpy(), 0.8)
+
+    # FTML trains
+    mx.random.seed(0)
+    net = gluon.nn.Dense(3, in_units=4)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "ftml",
+                       {"learning_rate": 0.02}, kvstore=None)
+    rng = np.random.RandomState(0)
+    X = rng.randn(16, 4).astype(np.float32)
+    y = rng.randint(0, 3, (16,))
+    lf = gluon.loss.SoftmaxCrossEntropyLoss()
+    losses = []
+    for _ in range(15):
+        with autograd.record():
+            L = lf(net(nd.array(X)), nd.array(y)).mean()
+        L.backward()
+        tr.step(1)
+        losses.append(float(L.asnumpy()))
+    assert losses[-1] < losses[0]
