@@ -25,9 +25,11 @@ import subprocess
 import sys
 import time
 
-TPU_ATTEMPTS = 3
-TPU_TIMEOUT = 1800          # first compile through the tunnel can be slow
-CPU_TIMEOUT = 900
+TPU_ATTEMPTS = int(os.environ.get("MXTPU_BENCH_ATTEMPTS", "3"))
+# first compile through the tunnel can be slow; a DEAD tunnel hangs until
+# this timeout, so it bounds worst-case bench wall-clock (tunable)
+TPU_TIMEOUT = int(os.environ.get("MXTPU_BENCH_TPU_TIMEOUT", "1500"))
+CPU_TIMEOUT = int(os.environ.get("MXTPU_BENCH_CPU_TIMEOUT", "900"))
 BACKOFFS = (10, 30)
 
 
