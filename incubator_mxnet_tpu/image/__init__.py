@@ -386,3 +386,10 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     if mean is not None:
         auglist.append(ColorNormalizeAug(mean, std))
     return auglist
+
+
+# detection pipeline (parity: python/mxnet/image/detection.py)
+from . import detection  # noqa: E402,F401
+from .detection import (DetAugmenter, DetForceResizeAug,  # noqa: E402,F401
+                        DetHorizontalFlipAug, DetRandomCropAug,
+                        CreateDetAugmenter, ImageDetIter)

@@ -1,0 +1,289 @@
+"""Detection image pipeline (parity: python/mxnet/image/detection.py —
+``DetAugmenter``s, ``CreateDetAugmenter``, ``ImageDetIter``; file-level
+citation, SURVEY.md caveat).
+
+Labels ride with the images as (num_obj, 5) float arrays
+``[class_id, x1, y1, x2, y2]`` with coordinates NORMALIZED to [0, 1]
+(the reference's det-label convention). Augmenters transform image AND
+boxes together; the iterator pads every batch's object dim to a fixed
+``max_objects`` with -1 rows (the reference pads with the header's
+label_width) so batches are shape-static for jit consumers (SSD's
+MultiBoxTarget masks the -1 rows out)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from ..ndarray.ndarray import _as_jax
+from ..io import DataBatch, DataIter, DataDesc
+from . import Augmenter, imresize, resize_short
+
+
+def _np_img(src):
+    return np.asarray(src.asnumpy() if isinstance(src, NDArray) else src)
+
+
+class DetAugmenter(Augmenter):
+    """Base: __call__(img, label) -> (img, label)."""
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetForceResizeAug(DetAugmenter):
+    """Resize to exactly (w, h); normalized boxes are unchanged."""
+
+    def __init__(self, size, interp=1):
+        super().__init__(size=size, interp=interp)
+        self.size = tuple(size)
+        self.interp = interp
+
+    def __call__(self, src, label):
+        return imresize(src, self.size[0], self.size[1],
+                        self.interp), label
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Flip image and mirror box x-coordinates with probability p."""
+
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src, label):
+        from .. import random as _random
+        if _random.np_rng().rand() < self.p:
+            img = _np_img(src)[:, ::-1].copy()
+            lab = np.array(label, np.float32, copy=True)
+            valid = lab[:, 0] >= 0
+            x1 = lab[valid, 1].copy()
+            lab[valid, 1] = 1.0 - lab[valid, 3]
+            lab[valid, 3] = 1.0 - x1
+            return NDArray(_as_jax(img)), lab
+        return src, label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Random crop keeping enough object coverage (simplified reference
+    semantics: sample a sub-window, keep boxes whose center survives,
+    clip them to the window; retry up to max_attempts, else identity)."""
+
+    def __init__(self, min_object_covered=0.3, area_range=(0.5, 1.0),
+                 max_attempts=10):
+        super().__init__(min_object_covered=min_object_covered,
+                         area_range=area_range, max_attempts=max_attempts)
+        self.min_cov = float(min_object_covered)
+        self.area_range = tuple(area_range)
+        self.max_attempts = int(max_attempts)
+
+    def __call__(self, src, label):
+        from .. import random as _random
+        rng = _random.np_rng()
+        img = _np_img(src)
+        H, W = img.shape[:2]
+        lab = np.array(label, np.float32, copy=True)
+        valid = lab[:, 0] >= 0
+        for _ in range(self.max_attempts):
+            area = rng.uniform(*self.area_range)
+            side = np.sqrt(area)
+            cw, ch = max(int(W * side), 1), max(int(H * side), 1)
+            x0 = rng.randint(0, W - cw + 1)
+            y0 = rng.randint(0, H - ch + 1)
+            wx0, wy0 = x0 / W, y0 / H
+            wx1, wy1 = (x0 + cw) / W, (y0 + ch) / H
+            cx = (lab[:, 1] + lab[:, 3]) / 2
+            cy = (lab[:, 2] + lab[:, 4]) / 2
+            keep = valid & (cx >= wx0) & (cx <= wx1) & \
+                (cy >= wy0) & (cy <= wy1)
+            if valid.any() and keep.sum() < max(
+                    1, int(np.ceil(self.min_cov * valid.sum()))):
+                continue
+            out = np.full_like(lab, -1.0)
+            k = 0
+            sw, sh = wx1 - wx0, wy1 - wy0
+            for row in lab[keep]:
+                nx1 = (max(row[1], wx0) - wx0) / sw
+                ny1 = (max(row[2], wy0) - wy0) / sh
+                nx2 = (min(row[3], wx1) - wx0) / sw
+                ny2 = (min(row[4], wy1) - wy0) / sh
+                out[k] = [row[0], nx1, ny1, nx2, ny2]
+                k += 1
+            return NDArray(_as_jax(img[y0:y0 + ch,
+                                       x0:x0 + cw].copy())), out
+        return src, lab
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Apply the wrapped augmenter with probability p (parity:
+    DetRandomSelectAug's select-or-skip behavior)."""
+
+    def __init__(self, aug: DetAugmenter, p: float):
+        super().__init__(p=p)
+        self.aug, self.p = aug, float(p)
+
+    def __call__(self, src, label):
+        from .. import random as _random
+        if _random.np_rng().rand() < self.p:
+            return self.aug(src, label)
+        return src, label
+
+
+class DetResizeShortAug(DetAugmenter):
+    """Resize the shorter image side to ``size``; normalized boxes are
+    unchanged."""
+
+    def __init__(self, size, interp=1):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = int(size), interp
+
+    def __call__(self, src, label):
+        return resize_short(src, self.size, self.interp), label
+
+
+class DetNormalizeAug(DetAugmenter):
+    """Subtract mean / divide std on the image (HWC float)."""
+
+    def __init__(self, mean, std=None):
+        super().__init__()
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32) if std is not None else None
+
+    def __call__(self, src, label):
+        arr = _np_img(src).astype(np.float32) - self.mean
+        if self.std is not None:
+            arr = arr / self.std
+        return NDArray(_as_jax(arr)), label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0.0,
+                       rand_mirror=False, mean=None, std=None,
+                       min_object_covered=0.3,
+                       area_range=(0.5, 1.0)) -> List[DetAugmenter]:
+    """Build the standard detection augmenter list (parity:
+    mx.image.CreateDetAugmenter). ``rand_crop`` is the APPLICATION
+    PROBABILITY of the random crop (reference DetRandomSelectAug
+    semantics); ``mean``/``std`` append a normalization stage; color
+    jitter composes via the classifier augmenters on the image alone."""
+    augs: List[DetAugmenter] = []
+    if resize > 0:
+        augs.append(DetResizeShortAug(resize))
+    if rand_crop > 0:
+        crop = DetRandomCropAug(min_object_covered=min_object_covered,
+                                area_range=area_range)
+        augs.append(crop if rand_crop >= 1.0
+                    else DetRandomSelectAug(crop, rand_crop))
+    if rand_mirror:
+        augs.append(DetHorizontalFlipAug(0.5))
+    augs.append(DetForceResizeAug((data_shape[2], data_shape[1])))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53], np.float32)
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375], np.float32)
+    if mean is not None:
+        augs.append(DetNormalizeAug(mean, std))
+    return augs
+
+
+class ImageDetIter(DataIter):
+    """Detection data iterator (parity: mx.image.ImageDetIter).
+
+    Sources: ``path_imgrec`` (RecordIO written by tools/im2rec.py with
+    det labels in the header) or in-memory ``(imgs, labels)`` lists.
+    Emits DataBatch(data (B, C, H, W), label (B, max_objects, 5))."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 imgs: Optional[Sequence] = None,
+                 labels: Optional[Sequence] = None, shuffle=False,
+                 max_objects=None, mean=None, std=None,
+                 aug_list: Optional[List[DetAugmenter]] = None, **kwargs):
+        super().__init__(batch_size)
+        self._shape = tuple(data_shape)
+        if path_imgrec is not None:
+            from ..io import MXRecordIO
+            from ..io.recordio import unpack_img
+            rec = MXRecordIO(path_imgrec, "r")
+            imgs, labels = [], []
+            while True:
+                payload = rec.read()
+                if payload is None:
+                    break
+                header, img = unpack_img(payload)
+                flat = np.asarray(header.label, np.float32).ravel()
+                # reference det header: [header_width, obj_width, ...objs]
+                hw, ow = int(flat[0]), int(flat[1])
+                objs = flat[hw:].reshape(-1, ow)[:, :5]
+                imgs.append(img)
+                labels.append(objs)
+        if imgs is None or labels is None:
+            raise MXNetError("ImageDetIter needs path_imgrec or "
+                             "imgs+labels")
+        if len(imgs) != len(labels):
+            raise MXNetError("imgs and labels length mismatch")
+        self._imgs = list(imgs)
+        self._labels = [np.asarray(l, np.float32).reshape(-1, 5)
+                        for l in labels]
+        self._max_obj = max_objects or max(
+            (l.shape[0] for l in self._labels), default=1)
+        self._shuffle = shuffle
+        self._mean = np.asarray(mean, np.float32) if mean is not None \
+            else None
+        self._std = np.asarray(std, np.float32) if std is not None else None
+        self._augs = aug_list if aug_list is not None else \
+            CreateDetAugmenter(self._shape, **kwargs)
+        self._order = np.arange(len(self._imgs))
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self._shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("label",
+                         (self.batch_size, self._max_obj, 5))]
+
+    def reset(self):
+        if self._shuffle:
+            from .. import random as _random
+            _random.np_rng().shuffle(self._order)
+        self._cursor = 0
+
+    def iter_next(self):
+        return self._cursor < len(self._order)
+
+    def _prep(self, i):
+        img = self._imgs[i]
+        lab = np.array(self._labels[i], np.float32, copy=True)
+        pad = np.full((self._max_obj, 5), -1.0, np.float32)
+        pad[:min(len(lab), self._max_obj)] = lab[:self._max_obj]
+        lab = pad
+        for aug in self._augs:
+            img, lab = aug(img, lab)
+        arr = _np_img(img).astype(np.float32)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        if self._mean is not None:
+            arr = arr - self._mean
+        if self._std is not None:
+            arr = arr / self._std
+        return arr.transpose(2, 0, 1), lab
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        end = self._cursor + self.batch_size
+        ids = self._order[self._cursor:end].tolist()
+        pad = 0
+        if len(ids) < self.batch_size:
+            pad = self.batch_size - len(ids)
+            fill = np.resize(self._order, pad).tolist()  # wraps if tiny
+            ids = ids + fill
+        self._cursor = end
+        import jax.numpy as jnp
+        data, labs = zip(*(self._prep(i) for i in ids))
+        return DataBatch([NDArray(jnp.asarray(np.stack(data)))],
+                         [NDArray(jnp.asarray(np.stack(labs)))], pad=pad)

@@ -1,0 +1,86 @@
+"""Detection image pipeline tests (reference strategy:
+tests/python/unittest/test_image.py ImageDetIter cases)."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import image as img_mod
+from incubator_mxnet_tpu.image import (CreateDetAugmenter,
+                                       DetHorizontalFlipAug, ImageDetIter)
+
+
+def _toy(n=6, hw=(32, 40)):
+    rng = np.random.RandomState(0)
+    imgs = [rng.randint(0, 255, (hw[0], hw[1], 3)).astype(np.uint8)
+            for _ in range(n)]
+    labels = [np.array([[i % 3, 0.1, 0.2, 0.5, 0.6],
+                        [(i + 1) % 3, 0.4, 0.4, 0.9, 0.8]], np.float32)
+              for i in range(n)]
+    return imgs, labels
+
+
+def test_det_iter_shapes_and_padding():
+    imgs, labels = _toy()
+    it = ImageDetIter(batch_size=4, data_shape=(3, 24, 24), imgs=imgs,
+                      labels=labels, max_objects=4)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (4, 3, 24, 24)
+    assert batch.label[0].shape == (4, 4, 5)
+    lab = batch.label[0].asnumpy()
+    # two real objects, two -1 pad rows per sample
+    assert (lab[:, :2, 0] >= 0).all() and (lab[:, 2:, 0] == -1).all()
+    assert it.provide_data[0].shape == (4, 3, 24, 24)
+    assert it.provide_label[0].shape == (4, 4, 5)
+    # epoch covers all samples with round-batch padding
+    it.reset()
+    batches = list(it)
+    assert len(batches) == 2 and batches[-1].pad == 2
+
+
+def test_det_flip_mirrors_boxes():
+    mx.random.seed(0)  # np_rng determinism
+    img = np.zeros((10, 10, 3), np.uint8)
+    lab = np.array([[1, 0.1, 0.2, 0.4, 0.6]], np.float32)
+    aug = DetHorizontalFlipAug(p=1.0)
+    out_img, out_lab = aug(img, lab)
+    np.testing.assert_allclose(out_lab[0], [1, 0.6, 0.2, 0.9, 0.6],
+                               rtol=1e-6)
+    # pad rows (-1) stay untouched
+    lab2 = np.array([[-1, -1, -1, -1, -1]], np.float32)
+    _, out2 = aug(img, lab2)
+    np.testing.assert_allclose(out2, lab2)
+
+
+def test_det_random_crop_keeps_normalized_boxes():
+    mx.random.seed(1)
+    imgs, labels = _toy(n=1, hw=(64, 64))
+    augs = CreateDetAugmenter((3, 32, 32), rand_crop=1.0,
+                              rand_mirror=False)
+    it = ImageDetIter(batch_size=1, data_shape=(3, 32, 32), imgs=imgs,
+                      labels=labels, aug_list=augs, max_objects=2)
+    for batch in it:
+        lab = batch.label[0].asnumpy()[0]
+        real = lab[lab[:, 0] >= 0]
+        assert (real[:, 1:] >= -1e-6).all() and (real[:, 1:] <= 1 + 1e-6).all()
+        assert (real[:, 3] > real[:, 1]).all()
+        assert batch.data[0].shape == (1, 3, 32, 32)
+
+
+def test_det_iter_kwargs_and_tiny_dataset():
+    """kwargs reach CreateDetAugmenter; wrap-around fills batches larger
+    than the dataset; rand_crop acts as a probability."""
+    from incubator_mxnet_tpu.image.detection import (DetRandomSelectAug,
+                                                     DetNormalizeAug)
+    imgs, labels = _toy(n=3)
+    it = ImageDetIter(batch_size=8, data_shape=(3, 16, 16), imgs=imgs,
+                      labels=labels, rand_mirror=True, rand_crop=0.5,
+                      max_objects=2)
+    kinds = [type(a).__name__ for a in it._augs]
+    assert "DetRandomSelectAug" in kinds and \
+        "DetHorizontalFlipAug" in kinds
+    batch = next(iter(it))
+    assert batch.data[0].shape == (8, 3, 16, 16)
+    assert batch.pad == 5
+    augs = CreateDetAugmenter((3, 16, 16), mean=True, std=True)
+    assert type(augs[-1]).__name__ == "DetNormalizeAug"
