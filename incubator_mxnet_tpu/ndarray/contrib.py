@@ -17,3 +17,7 @@ for _name in _registry.list_all_names():
         if not hasattr(_THIS, _short):
             setattr(_THIS, _short, make_op_function(_registry.get(_name),
                                                     _short))
+
+
+# control-flow constructs (Python-callable, not registry ops)
+from ..ops.control_flow import foreach, while_loop, cond  # noqa: E402,F401

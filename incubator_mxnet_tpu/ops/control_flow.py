@@ -1,0 +1,205 @@
+"""Control-flow operators (parity: python/mxnet/ndarray/contrib.py
+``foreach`` / ``while_loop`` / ``cond`` backed by
+src/operator/control_flow.cc — file-level citations, SURVEY.md caveat).
+
+The reference builds explicit subgraphs and runs them through the
+executor; here each construct IS the corresponding XLA structured-
+control-flow primitive (``lax.scan`` / ``lax.while_loop`` /
+``lax.cond``), so the user-facing Python-callable API is identical but
+the loop compiles into one fused program — including under hybridize /
+SPMDTrainer tracing, where the body is traced exactly once.
+
+Contracts (matching the reference):
+  - ``foreach(body, data, init_states)``: body(data_slice, states) ->
+    (step_output, new_states); iterates over axis 0; returns
+    (stacked outputs, final states). data/states may be NDArrays or
+    lists of NDArrays.
+  - ``while_loop(cond, func, loop_vars, max_iterations)``:
+    cond(*loop_vars) -> boolean scalar; func(*loop_vars) ->
+    (step_output, new_loop_vars). Runs at most ``max_iterations``;
+    outputs are stacked into a fixed (max_iterations, ...) buffer
+    (rows beyond the actual trip count are zeros — the reference's
+    fixed-shape contract) and returned with the final loop_vars.
+  - ``cond(pred, then_func, else_func)``: funcs take no args (close
+    over NDArrays); both branches trace and must return matching
+    structures.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _unwrap(x):
+    if isinstance(x, (list, tuple)):
+        return [_unwrap(v) for v in x]
+    return x._data if isinstance(x, NDArray) else jnp.asarray(x)
+
+
+def _tree_unwrap(x):
+    """Unwrap NDArrays AND normalize tuples to lists so user-returned
+    structures always match the scan/while carry pytree (tuple vs list
+    is a structure mismatch to jax)."""
+    if isinstance(x, (list, tuple)):
+        return [_tree_unwrap(v) for v in x]
+    return x._data if isinstance(x, NDArray) else x
+
+
+def _discover_outputs(func, lv):
+    """Abstract-evaluate one func step (no compute, no tape) to learn
+    the step-output structure."""
+    lv_j = [_unwrap(v) for v in lv]
+
+    def probe(vals):
+        out, _ = func(*[NDArray(v) for v in vals])
+        outs = [out] if not isinstance(out, (list, tuple)) else list(out)
+        return [_tree_unwrap(o) for o in outs]
+
+    return jax.eval_shape(probe, lv_j)
+
+
+def _wrap(x):
+    if isinstance(x, (list, tuple)):
+        return [_wrap(v) for v in x]
+    return NDArray(x)
+
+
+def _recording() -> bool:
+    from .. import autograd
+    return autograd.is_recording()
+
+
+def foreach(body: Callable, data, init_states):
+    """Scan ``body`` over axis 0 of ``data`` (reference: contrib.foreach).
+
+    Under ``autograd.record()`` the loop runs eagerly per-iteration so
+    every op lands on the tape — gradients flow to loop-carried state
+    AND closure-captured parameters, exactly like the reference's
+    imperative foreach. Outside recording (inference, or inside a
+    hybridize/SPMDTrainer trace) it lowers to ONE ``lax.scan``."""
+    if _recording():
+        multi = isinstance(data, (list, tuple))
+        n = (data[0] if multi else data).shape[0]
+        states = init_states
+        outs = []
+        for i in range(n):
+            sl = [d[i] for d in data] if multi else data[i]
+            out, states = body(sl, states)
+            outs.append(out)
+        if isinstance(outs[0], (list, tuple)):
+            from ..ndarray import stack as nd_stack
+            stacked = [nd_stack(*[o[k] for o in outs], axis=0)
+                       for k in range(len(outs[0]))]
+        else:
+            from ..ndarray import stack as nd_stack
+            stacked = nd_stack(*outs, axis=0)
+        return stacked, states
+
+    xs = _unwrap(data)
+    init = _unwrap(init_states)
+
+    def scan_body(carry, x):
+        out, new_states = body(_wrap(x), _wrap(carry))
+        return _tree_unwrap(new_states), _tree_unwrap(out)
+
+    final, outs = lax.scan(scan_body, init, xs)
+    return _wrap(outs), _wrap(final)
+
+
+def while_loop(cond_fn: Callable, func: Callable, loop_vars,
+               max_iterations: int):
+    """Bounded while loop (reference: contrib.while_loop). Returns
+    (outputs (max_iterations, ...) zero-padded, final loop_vars)."""
+    if max_iterations is None or int(max_iterations) <= 0:
+        raise MXNetError("while_loop needs a positive max_iterations")
+    M = int(max_iterations)
+    single = not isinstance(loop_vars, (list, tuple))
+    lv = [loop_vars] if single else list(loop_vars)
+
+    if _recording():
+        # eager tape path (see foreach): host-evaluated condition,
+        # per-iteration ops recorded; outputs zero-padded to M rows
+        import numpy as _host_np
+        from ..ndarray import stack as nd_stack, zeros as nd_zeros
+        outs = []
+        vars_ = list(lv)
+        while len(outs) < M:
+            c = cond_fn(*vars_)
+            if not bool(_host_np.asarray(
+                    c.asnumpy() if isinstance(c, NDArray) else c).all()):
+                break
+            out, new_vars = func(*vars_)
+            vars_ = [new_vars] if not isinstance(new_vars, (list, tuple)) \
+                else list(new_vars)
+            outs.append([out] if not isinstance(out, (list, tuple))
+                        else list(out))
+        if not outs:
+            shapes = _discover_outputs(func, lv)  # abstract, no compute
+            bufs = [nd_zeros((M,) + tuple(s.shape)) for s in shapes]
+        else:
+            k = len(outs[0])
+            bufs = []
+            for j in range(k):
+                rows = [o[j] for o in outs]
+                pad = [nd_zeros(tuple(rows[0].shape))
+                       for _ in range(M - len(rows))]
+                bufs.append(nd_stack(*(rows + pad), axis=0))
+        out_single0 = len(bufs) == 1
+        return (bufs[0] if out_single0 else bufs), \
+            (vars_[0] if single else vars_)
+
+    lv_j = [_unwrap(v) for v in lv]
+
+    # abstract-evaluate one step to discover the output structure (the
+    # reference likewise traces func once; eval_shape runs NO compute,
+    # so a cond-guarded func is never executed on invalid inputs)
+    shapes = _discover_outputs(func, lv)
+    out_single = len(shapes) == 1
+    bufs0 = [jnp.zeros((M,) + tuple(s.shape), s.dtype) for s in shapes]
+
+    def _cond(carry):
+        i, vars_, bufs = carry
+        c = cond_fn(*[NDArray(v) for v in vars_])
+        c = c._data if isinstance(c, NDArray) else jnp.asarray(c)
+        return jnp.logical_and(i < M, c.reshape(()).astype(bool))
+
+    def _body(carry):
+        i, vars_, bufs = carry
+        out, new_vars = func(*[NDArray(v) for v in vars_])
+        outs = [out] if not isinstance(out, (list, tuple)) else list(out)
+        new_vars = [new_vars] if not isinstance(new_vars, (list, tuple)) \
+            else list(new_vars)
+        bufs = [lax.dynamic_update_slice(
+            b, _unwrap(o)[None].astype(b.dtype),
+            (i,) + (0,) * (b.ndim - 1)) for b, o in zip(bufs, outs)]
+        return i + 1, [_unwrap(v) for v in new_vars], bufs
+
+    n, final_vars, bufs = lax.while_loop(
+        _cond, _body, (jnp.asarray(0, jnp.int32), lv_j, bufs0))
+    outs = [NDArray(b) for b in bufs]
+    finals = [NDArray(v) for v in final_vars]
+    return (outs[0] if out_single else outs), \
+        (finals[0] if single else finals)
+
+
+def cond(pred, then_func: Callable, else_func: Callable):
+    """Conditional execution (reference: contrib.cond)."""
+    p = pred._data if isinstance(pred, NDArray) else jnp.asarray(pred)
+    p = p.reshape(()).astype(bool)
+    if _recording():
+        # eager tape path: pick the branch on the host so its ops record
+        import numpy as _host_np
+        return then_func() if bool(_host_np.asarray(p)) else else_func()
+
+    out = lax.cond(p, lambda _: _tree_unwrap(then_func()),
+                   lambda _: _tree_unwrap(else_func()), operand=None)
+    return _wrap(out)

@@ -281,3 +281,86 @@ def multi_all_finite(*data, num_arrays=None, init_output=True):
     for d in data:
         ok = jnp.logical_and(ok, jnp.isfinite(d).all())
     return ok.astype(jnp.float32)
+
+
+@register("BilinearResize2D", aliases=("_contrib_BilinearResize2D",))
+def bilinear_resize_2d(data, height=None, width=None, scale_height=None,
+                       scale_width=None, like=None, mode="size"):
+    """Bilinear resize, align_corners semantics (reference:
+    contrib/bilinear_resize.cc). data: (B, C, H, W). ``mode``:
+    'size' (explicit height+width), 'scale' (scale_height+scale_width,
+    auto-selected when scales are given), or 'like' (match ``like``'s
+    spatial dims)."""
+    B, C, H, W = data.shape
+    if mode == "like" or (like is not None and height is None
+                          and scale_height is None):
+        if like is None:
+            raise MXNetError("BilinearResize2D mode='like' needs `like`")
+        height, width = like.shape[2], like.shape[3]
+    elif scale_height is not None or scale_width is not None:
+        if scale_height is None or scale_width is None:
+            raise MXNetError(
+                "BilinearResize2D needs BOTH scale_height and scale_width")
+        height = int(H * scale_height)
+        width = int(W * scale_width)
+    if height is None or width is None:
+        raise MXNetError(
+            "BilinearResize2D needs height+width, both scales, or like=")
+    Ho, Wo = int(height), int(width)
+    # align_corners=True sampling grid (the reference's kernel)
+    ys = jnp.linspace(0.0, H - 1.0, Ho)
+    xs = jnp.linspace(0.0, W - 1.0, Wo)
+    y0 = jnp.floor(ys).astype(jnp.int32)
+    x0 = jnp.floor(xs).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, H - 1)
+    x1 = jnp.minimum(x0 + 1, W - 1)
+    ly = (ys - y0).astype(data.dtype)
+    lx = (xs - x0).astype(data.dtype)
+    top = data[:, :, y0][:, :, :, x0] * (1 - ly)[None, None, :, None] + \
+        data[:, :, y1][:, :, :, x0] * ly[None, None, :, None]
+    bot = data[:, :, y0][:, :, :, x1] * (1 - ly)[None, None, :, None] + \
+        data[:, :, y1][:, :, :, x1] * ly[None, None, :, None]
+    return top * (1 - lx)[None, None, None, :] + \
+        bot * lx[None, None, None, :]
+
+
+@register("index_array", aliases=("_contrib_index_array",))
+def index_array(data, axes=None):
+    """Coordinate tensor of ``data``'s indices (reference:
+    contrib/index_array.cc): output (..., len(axes) or ndim)."""
+    nd_ = data.ndim
+    axes = tuple(range(nd_)) if axes is None else tuple(axes)
+    comps = [lax.broadcasted_iota(jnp.int32, data.shape, a) for a in axes]
+    return jnp.stack(comps, axis=-1)
+
+
+@register("quadratic", aliases=("_contrib_quadratic",))
+def quadratic(data, a=0.0, b=0.0, c=0.0):
+    """a*x^2 + b*x + c (reference: contrib/quadratic_op.cc — the
+    custom-op tutorial operator)."""
+    return a * data * data + b * data + c
+
+
+@register("allclose", aliases=("_contrib_allclose",))
+def allclose_op(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    """Scalar 1.0/0.0 closeness test (reference: contrib/allclose_op.cc)."""
+    return jnp.allclose(a, b, rtol=rtol, atol=atol,
+                        equal_nan=equal_nan).astype(jnp.float32)
+
+
+@register("arange_like", aliases=("_contrib_arange_like",))
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    """arange shaped like ``data`` (reference: contrib op used by
+    position embeddings)."""
+    if axis is None:
+        n = 1
+        for s in data.shape:
+            n *= s
+        out = start + step * jnp.arange(n, dtype=jnp.float32)
+        out = jnp.repeat(out, repeat) if repeat != 1 else out
+        return out[:n].reshape(data.shape)
+    n = data.shape[axis]
+    idx = jnp.arange(n, dtype=jnp.float32)
+    if repeat != 1:
+        idx = jnp.floor(idx / repeat)  # each value repeats `repeat` times
+    return start + step * idx

@@ -578,7 +578,7 @@ def index_add(old, index, new):
     return old.at[index.astype(jnp.int32)].add(new)
 
 
-@register("boolean_mask")
+@register("boolean_mask", aliases=("_contrib_boolean_mask",))
 def boolean_mask(data, index, axis=0):
     """(reference: src/operator/contrib/boolean_mask.cc). NOTE: output shape
     is data-dependent; not jit-traceable — eager/debug use only."""
