@@ -86,9 +86,9 @@ def foreach(body: Callable, data, init_states):
     AND closure-captured parameters, exactly like the reference's
     imperative foreach. Outside recording (inference, or inside a
     hybridize/SPMDTrainer trace) it lowers to ONE ``lax.scan``."""
-    if _recording():
-        multi = isinstance(data, (list, tuple))
-        n = (data[0] if multi else data).shape[0]
+    multi = isinstance(data, (list, tuple))
+    n = (data[0] if multi else data).shape[0]
+    if _recording() and n > 0:  # n == 0: the scan path handles it
         states = init_states
         outs = []
         for i in range(n):
@@ -144,13 +144,15 @@ def while_loop(cond_fn: Callable, func: Callable, loop_vars,
                         else list(out))
         if not outs:
             shapes = _discover_outputs(func, lv)  # abstract, no compute
-            bufs = [nd_zeros((M,) + tuple(s.shape)) for s in shapes]
+            bufs = [nd_zeros((M,) + tuple(s.shape), dtype=str(s.dtype))
+                    for s in shapes]
         else:
             k = len(outs[0])
             bufs = []
             for j in range(k):
                 rows = [o[j] for o in outs]
-                pad = [nd_zeros(tuple(rows[0].shape))
+                pad = [nd_zeros(tuple(rows[0].shape),
+                                dtype=str(rows[0].dtype))
                        for _ in range(M - len(rows))]
                 bufs.append(nd_stack(*(rows + pad), axis=0))
         out_single0 = len(bufs) == 1

@@ -396,3 +396,102 @@ def use_flash_attention(q, k, v, key_mask=None, causal=False, scale=None,
                                v.transpose(0, 2, 1, 3),
                                valid_length, causal, scale)
     return out.transpose(0, 2, 1, 3)
+
+
+# --------------------------------------------------------------------- #
+# (out, lse) block primitive — the ring-attention building block
+# --------------------------------------------------------------------- #
+
+def _prefix_causal_mask(B, Tq, Tk, valid_len, causal):
+    """(B, 1, Tq, Tk) boolean mask: keys < valid_len, optionally causal.
+    SHARED by the dense forward and the residual-based dense backward so
+    the p = exp(s - LSE) identity holds bit-for-bit."""
+    k_pos = lax.broadcasted_iota(jnp.int32, (B, 1, 1, Tk), 3)
+    mask = k_pos < valid_len.astype(jnp.int32).reshape(B, 1, 1, 1)
+    if causal:
+        q_pos = lax.broadcasted_iota(jnp.int32, (Tq, Tk), 0)
+        kk = lax.broadcasted_iota(jnp.int32, (Tq, Tk), 1)
+        mask = jnp.logical_and(mask, (kk <= q_pos)[None, None])
+    return mask
+
+
+def _dense_attn_lse(q, k, v, valid_len, causal, scale):
+    """jnp fallback returning (out, lse). q/k/v: (B, H, T, D)."""
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    sc = D ** -0.5 if scale is None else scale
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sc
+    mask = _prefix_causal_mask(B, Tq, Tk, valid_len, causal)
+    s = jnp.where(mask, s, _NEG_INF)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p,
+                     v.astype(jnp.float32)) / \
+        jnp.maximum(l, 1e-30)[..., None]
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    return out.astype(q.dtype), lse
+
+
+def _pallas_runnable(interpret):
+    """Pallas kernels execute on TPU, or anywhere under interpret mode."""
+    if not _pallas_available():
+        return False
+    return interpret or any(d.platform == "tpu" for d in jax.devices())
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def block_attn_lse(q, k, v, valid_len, causal=False, scale=None,
+                   interpret=False):
+    """One attention block returning (out, lse) — Pallas forward AND
+    backward on TPU (or under interpret mode), jnp fallback otherwise.
+    The lse output is what makes partial results MERGEABLE across ring
+    steps (see parallel/ring_attention.py merge rule); it is
+    non-differentiable."""
+    if _pallas_runnable(interpret):
+        return _flash_fwd_lse(q, k, v, valid_len, causal=causal,
+                              scale=scale, interpret=interpret)
+    return _dense_attn_lse(q, k, v, valid_len, causal, scale)
+
+
+def _block_fwd(q, k, v, valid_len, causal, scale, interpret):
+    out, lse = block_attn_lse(q, k, v, valid_len, causal, scale,
+                              interpret)
+    return (out, lse), (q, k, v, valid_len, out, lse)
+
+
+def _dense_block_bwd(q, k, v, valid_len, out, lse, g, causal, scale):
+    """Residual-based dense backward: p = exp(s - LSE) rebuilt from the
+    saved logsumexp — no forward recompute. All (B, H, T, D)."""
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    sc = D ** -0.5 if scale is None else scale
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    gf = g.astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * sc
+    mask = _prefix_causal_mask(B, Tq, Tk, valid_len, causal)
+    p = jnp.where(mask, jnp.exp(s - lse[..., None]), 0.0)
+    delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vf)
+    ds = p * (dp - delta[..., None]) * sc
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _block_bwd(causal, scale, interpret, res, g):
+    q, k, v, valid_len, out, lse = res
+    g_out, _ = g                              # lse cotangent is dropped
+    if _pallas_runnable(interpret):
+        dq, dk, dv = _flash_backward(q, k, v, valid_len, out, lse, g_out,
+                                     causal=causal, scale=scale,
+                                     interpret=interpret)
+        return dq, dk, dv, None
+    dq, dk, dv = _dense_block_bwd(q, k, v, valid_len, out, lse, g_out,
+                                  causal, scale)
+    return dq, dk, dv, None
+
+
+block_attn_lse.defvjp(_block_fwd, _block_bwd)

@@ -22,10 +22,11 @@ from .pipeline import pipeline_apply, stack_stage_params
 from . import moe
 from .moe import switch_moe, stack_expert_params
 from . import ring_attention
-from .ring_attention import ring_self_attention
+from .ring_attention import ring_self_attention, ring_flash_attention
 
 __all__ = [
     "MeshConfig", "build_mesh", "current_mesh", "default_mesh",
     "set_default_mesh", "initialize", "collectives", "host_allreduce",
     "SPMDTrainer", "shard_params", "replicate", "ring_self_attention",
+    "ring_flash_attention",
 ]

@@ -23,7 +23,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..base import MXNetError
 
-__all__ = ["ring_self_attention", "ring_attention_block"]
+__all__ = ["ring_self_attention", "ring_attention_block",
+           "ring_flash_attention", "ring_flash_attention_block"]
 
 _NEG_INF = -1e30
 
@@ -101,17 +102,9 @@ def ring_attention_block(q, k, v, axis_name: str = "sp",
     return out.astype(q.dtype)
 
 
-def ring_self_attention(q, k, v, mesh: Optional[Mesh] = None,
-                        axis_name: str = "sp", causal: bool = False,
-                        scale: Optional[float] = None,
-                        batch_axis: Optional[str] = "dp"):
-    """Exact self-attention with the sequence sharded over ``axis_name``.
-
-    q, k, v: global (B, T, H, D) arrays; T must divide by the ``sp`` axis
-    size. Returns (B, T, H, D). Differentiable (jax traces through the
-    ppermute ring), jit-safe, and composable with data parallelism via
-    ``batch_axis``.
-    """
+def _ring_shard_map(block_fn, q, k, v, mesh, axis_name, batch_axis):
+    """Shared wrapper: validate the mesh/sequence contract and shard_map
+    the per-block ring function over (batch_axis, axis_name)."""
     from . import mesh as _mesh_mod
 
     if mesh is None:
@@ -125,9 +118,180 @@ def ring_self_attention(q, k, v, mesh: Optional[Mesh] = None,
             f"axis size {sp}")
     b_ax = batch_axis if batch_axis in mesh.shape else None
     spec = PartitionSpec(b_ax, axis_name, None, None)
+    mapped = jax.shard_map(block_fn, mesh=mesh,
+                           in_specs=(spec, spec, spec), out_specs=spec)
+    return mapped(q, k, v)
 
+
+def ring_self_attention(q, k, v, mesh: Optional[Mesh] = None,
+                        axis_name: str = "sp", causal: bool = False,
+                        scale: Optional[float] = None,
+                        batch_axis: Optional[str] = "dp"):
+    """Exact self-attention with the sequence sharded over ``axis_name``.
+
+    q, k, v: global (B, T, H, D) arrays; T must divide by the ``sp`` axis
+    size. Returns (B, T, H, D). Differentiable (jax traces through the
+    ppermute ring), jit-safe, and composable with data parallelism via
+    ``batch_axis``.
+    """
     fn = partial(ring_attention_block, axis_name=axis_name, causal=causal,
                  scale=scale)
-    mapped = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                           out_specs=spec)
-    return mapped(q, k, v)
+    return _ring_shard_map(fn, q, k, v, mesh, axis_name, batch_axis)
+
+
+# --------------------------------------------------------------------- #
+# ring attention with the Pallas flash kernel as the per-block engine
+# --------------------------------------------------------------------- #
+
+def _merge_partials(o1, lse1, o2, lse2):
+    """Associatively combine two attention partial results carrying
+    logsumexp (the flash merge rule): both (B,H,T,D)/(B,H,T)."""
+    m = jnp.maximum(lse1, lse2)
+    w1 = jnp.exp(lse1 - m)
+    w2 = jnp.exp(lse2 - m)
+    den = jnp.maximum(w1 + w2, 1e-38)
+    out = (o1.astype(jnp.float32) * w1[..., None]
+           + o2.astype(jnp.float32) * w2[..., None]) / den[..., None]
+    return out, m + jnp.log(den)
+
+
+def _block_vl(step, n, size, B, Tq, causal):
+    """Key-validity gating for ring step ``step``: the held block is
+    (n - step) mod size — under causality fully visible iff it precedes
+    ours, else fully masked (vl=0 ⇒ kernel masks everything ⇒ merge
+    weight ~0 in forward, zero gradient in backward)."""
+    if not causal:
+        return jnp.full((B,), Tq, jnp.int32)
+    allowed = (n - step) % size < n
+    return jnp.where(allowed, Tq, 0) * jnp.ones((B,), jnp.int32)
+
+
+def _block_bwd_any(q, k, v, vl, out, lse, g, causal, scale, interpret):
+    """Per-block backward against the GLOBAL logsumexp — the ring/flash
+    backward identity: p_ij = exp(s_ij - LSE_i) is exact for every block
+    once LSE is the full-row normalizer. Pallas kernels on TPU (or
+    interpret mode), the shared residual-based dense math otherwise."""
+    from ..ops.pallas_attention import (_dense_block_bwd, _flash_backward,
+                                        _pallas_runnable)
+
+    if _pallas_runnable(interpret):
+        return _flash_backward(q, k, v, vl, out, lse, g, causal=causal,
+                               scale=scale, interpret=interpret)
+    return _dense_block_bwd(q, k, v, vl, out, lse, g, causal, scale)
+
+
+def _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale, interpret):
+    from ..ops.pallas_attention import block_attn_lse
+
+    B, Tq, H, D = q.shape
+    n = lax.axis_index(axis_name)
+    size = lax.psum(1, axis_name)
+
+    qt = q.transpose(0, 2, 1, 3)                       # (B, H, T, D)
+    full_vl = jnp.full((B,), Tq, jnp.int32)
+
+    out0, lse0 = block_attn_lse(qt, k.transpose(0, 2, 1, 3),
+                                v.transpose(0, 2, 1, 3), full_vl,
+                                causal, scale, interpret)
+    out0 = out0.astype(jnp.float32)
+
+    def body(step, carry):
+        out, lse, k_cur, v_cur = carry
+        perm = [(i, (i + 1) % size) for i in range(size)]
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        vl = _block_vl(step, n, size, B, Tq, causal)
+        o_b, lse_b = block_attn_lse(qt, k_cur.transpose(0, 2, 1, 3),
+                                    v_cur.transpose(0, 2, 1, 3), vl,
+                                    False, scale, interpret)
+        out, lse = _merge_partials(out, lse, o_b.astype(jnp.float32),
+                                   lse_b)
+        return out, lse, k_cur, v_cur
+
+    out, lse, _, _ = lax.fori_loop(1, size, body, (out0, lse0, k, v))
+    return out.transpose(0, 2, 1, 3).astype(q.dtype), lse
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def ring_flash_attention_block(q, k, v, axis_name: str = "sp",
+                               causal: bool = False,
+                               scale: Optional[float] = None,
+                               interpret: bool = False):
+    """Ring attention with the Pallas flash kernel per block (call inside
+    shard_map; q/k/v local blocks (B, T_blk, H, D)).
+
+    Forward: each ring step computes its block's (out, logsumexp) with
+    ``block_attn_lse`` and merges partials with the flash merge rule.
+    Backward: a SECOND ring where each step runs the per-block flash
+    backward against the global logsumexp (the p = exp(s - LSE)
+    identity), accumulating dq locally while the dk/dv accumulators
+    ride the ring home with their blocks — the Ring Attention backward
+    schedule (PAPERS.md)."""
+    out, _ = _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale,
+                                  interpret)
+    return out
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, scale, interpret):
+    out, lse = _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale,
+                                    interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis_name, causal, scale, interpret, res, g):
+    q, k, v, out, lse = res
+    B, Tq, H, D = q.shape
+    n = lax.axis_index(axis_name)
+    size = lax.psum(1, axis_name)
+
+    qt = q.transpose(0, 2, 1, 3)
+    gt = g.transpose(0, 2, 1, 3).astype(jnp.float32)
+    ot = out.transpose(0, 2, 1, 3)
+    full_vl = jnp.full((B,), Tq, jnp.int32)
+
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    dq0, dk0, dv0 = _block_bwd_any(qt, kt, vt, full_vl, ot, lse, gt,
+                                   causal, scale, interpret)
+
+    def body(step, carry):
+        dq, dk_cur, dv_cur, k_cur, v_cur = carry
+        perm = [(i, (i + 1) % size) for i in range(size)]
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        dk_cur = lax.ppermute(dk_cur, axis_name, perm)
+        dv_cur = lax.ppermute(dv_cur, axis_name, perm)
+        vl = _block_vl(step, n, size, B, Tq, causal)
+        dq_b, dk_b, dv_b = _block_bwd_any(qt, k_cur, v_cur, vl, ot, lse,
+                                          gt, False, scale, interpret)
+        return (dq + dq_b.astype(jnp.float32),
+                dk_cur + dk_b.astype(jnp.float32),
+                dv_cur + dv_b.astype(jnp.float32), k_cur, v_cur)
+
+    dq, dk_cur, dv_cur, _, _ = lax.fori_loop(
+        1, size, body, (dq0.astype(jnp.float32), dk0.astype(jnp.float32),
+                        dv0.astype(jnp.float32), kt, vt))
+    # one final hop brings each block's accumulated dk/dv home
+    perm = [(i, (i + 1) % size) for i in range(size)]
+    dk_home = lax.ppermute(dk_cur, axis_name, perm)
+    dv_home = lax.ppermute(dv_cur, axis_name, perm)
+    return (dq.transpose(0, 2, 1, 3).astype(q.dtype),
+            dk_home.transpose(0, 2, 1, 3).astype(k.dtype),
+            dv_home.transpose(0, 2, 1, 3).astype(v.dtype))
+
+
+ring_flash_attention_block.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def ring_flash_attention(q, k, v, mesh: Optional[Mesh] = None,
+                         axis_name: str = "sp", causal: bool = False,
+                         scale: Optional[float] = None,
+                         batch_axis: Optional[str] = "dp",
+                         interpret: bool = False):
+    """ring_self_attention with the Pallas flash kernel as the per-block
+    engine (TPU hot path; ``interpret=True`` runs the same kernels on
+    CPU). Same contract: global (B, T, H, D), T divisible by the sp
+    size, differentiable end to end."""
+    fn = partial(ring_flash_attention_block, axis_name=axis_name,
+                 causal=causal, scale=scale, interpret=interpret)
+    return _ring_shard_map(fn, q, k, v, mesh, axis_name, batch_axis)

@@ -186,3 +186,26 @@ def test_review_regressions():
     # arange_like repeat semantics on an axis
     al = nd.contrib.arange_like(nd.zeros((2, 4)), repeat=2, axis=1)
     np.testing.assert_allclose(al.asnumpy(), [0, 0, 1, 1])
+
+
+def test_eager_paths_match_traced_edge_cases():
+    """Zero-length foreach and int dtype while_loop behave identically
+    under autograd.record() and on the traced path (review pins)."""
+    # zero-length data under recording
+    with autograd.record():
+        outs, fin = nd.contrib.foreach(
+            lambda x, s: (x + s, s), nd.zeros((0, 3)), nd.ones((3,)))
+    assert outs.shape == (0, 3)
+    np.testing.assert_allclose(fin.asnumpy(), 1.0)
+
+    # int32 loop vars keep their dtype in both modes
+    def run():
+        return nd.contrib.while_loop(
+            lambda i: i.sum() < 3, lambda i: (i, [i + 1]),
+            [nd.array(np.zeros(1, np.int32), dtype="int32")],
+            max_iterations=5)
+
+    outs_t, _ = run()
+    with autograd.record():
+        outs_e, _ = run()
+    assert str(outs_t.dtype) == str(outs_e.dtype) == "int32"
