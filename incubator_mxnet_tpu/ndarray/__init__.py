@@ -164,6 +164,7 @@ from . import contrib  # noqa: E402,F401
 
 # sparse storage types (parity: mx.nd.sparse)
 from . import sparse  # noqa: E402,F401
+from .sparse import cast_storage  # noqa: E402,F401  (top-level parity)
 from . import linalg  # noqa: E402,F401
 
 

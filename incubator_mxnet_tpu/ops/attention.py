@@ -122,3 +122,80 @@ def masked_softmax(scores, mask=None, axis=-1):
     if mask is not None:
         scores = jnp.where(mask, scores, _NEG_INF)
     return jax.nn.softmax(scores, axis=axis)
+
+
+# --------------------------------------------------------------------- #
+# interleaved-projection matmul surface (reference:
+# src/operator/contrib/transformer.cc interleaved_matmul_selfatt_qk /
+# _valatt, interleaved_matmul_encdec_qk / _valatt, div_sqrt_dim —
+# file-level citations, SURVEY.md caveat). The reference hand-writes
+# strided-batched CUDA GEMMs over an interleaved (seq, batch,
+# heads*3*head_dim) QKV buffer; here each op is one reshape+einsum that
+# XLA lowers to a single MXU batch-matmul — same user contract, no
+# layout gymnastics needed on TPU.
+# --------------------------------------------------------------------- #
+
+@register("div_sqrt_dim", aliases=("_contrib_div_sqrt_dim",))
+def div_sqrt_dim(data):
+    """data / sqrt(last_dim) (reference transformer.cc DivSqrtDim)."""
+    return data * (data.shape[-1] ** -0.5)
+
+
+def _split_interleaved(qkv, heads, parts):
+    """(S, B, heads*parts*D) -> ``parts`` tensors of (B*heads, S, D)."""
+    S, B = qkv.shape[0], qkv.shape[1]
+    x = qkv.reshape(S, B, heads, parts, -1)
+    outs = []
+    for p in range(parts):
+        t = x[:, :, :, p, :]                     # (S, B, H, D)
+        t = t.transpose(1, 2, 0, 3).reshape(B * heads, S, -1)
+        outs.append(t)
+    return outs
+
+
+@register("interleaved_matmul_selfatt_qk",
+          aliases=("_contrib_interleaved_matmul_selfatt_qk",))
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads=1):
+    """Scaled Q·Kᵀ over an interleaved (S, B, H*3*D) self-attention
+    projection. Returns (B*H, S, S); queries pre-scaled by 1/sqrt(D)
+    exactly like the reference kernel."""
+    q, k, _ = _split_interleaved(queries_keys_values, heads, 3)
+    q = q * (q.shape[-1] ** -0.5)
+    return jnp.einsum("bqd,bkd->bqk", q, k)
+
+
+@register("interleaved_matmul_selfatt_valatt",
+          aliases=("_contrib_interleaved_matmul_selfatt_valatt",))
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention,
+                                      heads=1):
+    """attention @ V, restored to the (S, B, H*D) seq-major layout."""
+    S, B = queries_keys_values.shape[0], queries_keys_values.shape[1]
+    _, _, v = _split_interleaved(queries_keys_values, heads, 3)
+    out = jnp.einsum("bqk,bkd->bqd", attention, v)     # (B*H, S, D)
+    out = out.reshape(B, heads, S, -1).transpose(2, 0, 1, 3)
+    return out.reshape(S, B, -1)
+
+
+@register("interleaved_matmul_encdec_qk",
+          aliases=("_contrib_interleaved_matmul_encdec_qk",))
+def interleaved_matmul_encdec_qk(queries, keys_values, heads=1):
+    """Scaled Q·Kᵀ for cross-attention: queries (Sq, B, H*D), interleaved
+    keys/values (Sk, B, H*2*D). Returns (B*H, Sq, Sk)."""
+    Sq, B = queries.shape[0], queries.shape[1]
+    q = queries.reshape(Sq, B, heads, -1).transpose(1, 2, 0, 3)
+    q = q.reshape(B * heads, Sq, -1)
+    q = q * (q.shape[-1] ** -0.5)
+    k, _ = _split_interleaved(keys_values, heads, 2)
+    return jnp.einsum("bqd,bkd->bqk", q, k)
+
+
+@register("interleaved_matmul_encdec_valatt",
+          aliases=("_contrib_interleaved_matmul_encdec_valatt",))
+def interleaved_matmul_encdec_valatt(keys_values, attention, heads=1):
+    """attention @ V for cross-attention; output (Sq, B, H*D)."""
+    B = keys_values.shape[1]
+    _, v = _split_interleaved(keys_values, heads, 2)
+    out = jnp.einsum("bqk,bkd->bqd", attention, v)     # (B*H, Sq, D)
+    Sq = out.shape[1]
+    out = out.reshape(B, heads, Sq, -1).transpose(2, 0, 1, 3)
+    return out.reshape(Sq, B, -1)

@@ -15,6 +15,8 @@ sentinel convention)."""
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -482,3 +484,155 @@ def proposal(cls_prob, bbox_pred, im_info, scales=(4, 8, 16, 32),
         jnp.arange(B, dtype=out.dtype)[:, None, None],
         (B, out.shape[1], 1))
     return jnp.concatenate([bidx, out], axis=-1)
+
+
+# --------------------------------------------------------------------- #
+# round-3 contrib batch: box codecs, matching, adaptive pooling, misc
+# (reference: src/operator/contrib/{bounding_box.cc,adaptive_avg_pooling.cc,
+# index_copy.cc,gradient_multiplier_op.cc,optimizer_op.cc} — file-level
+# citations, SURVEY.md caveat)
+# --------------------------------------------------------------------- #
+
+@register("box_encode", aliases=("_contrib_box_encode",),
+          num_outputs=2)
+def box_encode(samples, matches, anchors, refs, means=(0., 0., 0., 0.),
+               stds=(0.1, 0.1, 0.2, 0.2)):
+    """SSD-style corner-box regression-target encoding.
+
+    samples (B,N) in {+1,0,-1}; matches (B,N) ref indices; anchors (B,N,4)
+    and refs (B,M,4) corner boxes. Returns (targets (B,N,4), masks (B,N,4)).
+    """
+    matched = jnp.take_along_axis(
+        refs, matches[..., None].astype(jnp.int32), axis=1)  # (B,N,4)
+
+    def _cxywh(b):
+        w = b[..., 2] - b[..., 0]
+        h = b[..., 3] - b[..., 1]
+        return b[..., 0] + 0.5 * w, b[..., 1] + 0.5 * h, w, h
+
+    ax, ay, aw, ah = _cxywh(anchors)
+    gx, gy, gw, gh = _cxywh(matched)
+    means = jnp.asarray(means, anchors.dtype)
+    stds = jnp.asarray(stds, anchors.dtype)
+    t = jnp.stack([
+        ((gx - ax) / jnp.maximum(aw, 1e-12) - means[0]) / stds[0],
+        ((gy - ay) / jnp.maximum(ah, 1e-12) - means[1]) / stds[1],
+        (jnp.log(jnp.maximum(gw / jnp.maximum(aw, 1e-12), 1e-12))
+         - means[2]) / stds[2],
+        (jnp.log(jnp.maximum(gh / jnp.maximum(ah, 1e-12), 1e-12))
+         - means[3]) / stds[3]], axis=-1)
+    mask = (samples > 0.5)[..., None]
+    return jnp.where(mask, t, 0.0), mask.astype(anchors.dtype) * \
+        jnp.ones_like(t)
+
+
+@register("box_decode", aliases=("_contrib_box_decode",))
+def box_decode(data, anchors, std0=0.1, std1=0.1, std2=0.2, std3=0.2,
+               clip=-1.0, format="corner"):
+    """Invert box_encode: deltas (B,N,4) + anchors (1|B,N,4) → corner
+    boxes (B,N,4)."""
+    a = anchors
+    if format == "corner":
+        aw = a[..., 2] - a[..., 0]
+        ah = a[..., 3] - a[..., 1]
+        ax = a[..., 0] + 0.5 * aw
+        ay = a[..., 1] + 0.5 * ah
+    else:
+        ax, ay, aw, ah = (a[..., 0], a[..., 1], a[..., 2], a[..., 3])
+    ox = data[..., 0] * std0 * aw + ax
+    oy = data[..., 1] * std1 * ah + ay
+    dw, dh = data[..., 2] * std2, data[..., 3] * std3
+    if clip > 0:
+        dw = jnp.minimum(dw, clip)
+        dh = jnp.minimum(dh, clip)
+    ow = jnp.exp(dw) * aw * 0.5
+    oh = jnp.exp(dh) * ah * 0.5
+    return jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], axis=-1)
+
+
+@register("bipartite_matching", aliases=("_contrib_bipartite_matching",),
+          num_outputs=2)
+def bipartite_matching(data, is_ascend=False, threshold=0.5, topk=-1):
+    """Greedy bipartite matching over a (..., N, M) score matrix
+    (reference bipartite_matching). Returns (row→col match or -1, col→row
+    anchor index). Implemented as a lax.scan over min(N,M) greedy picks —
+    fixed trip count, jit-friendly."""
+    scores = data
+    N, M = scores.shape[-2], scores.shape[-1]
+    lead = scores.shape[:-2]
+    flat = scores.reshape((-1, N, M))
+    big = jnp.asarray(1e30, flat.dtype)
+    sgn = 1.0 if not is_ascend else -1.0
+    K = min(N, M) if topk < 0 else min(topk, min(N, M))
+
+    def per(mat):
+        def body(carry, _):
+            m, row_used, col_used = carry
+            eff = jnp.where(row_used[:, None] | col_used[None, :],
+                            -big, sgn * m)
+            idx = jnp.argmax(eff)
+            r, c = idx // M, idx % M
+            # accept: score >= thresh (descending) / score <= thresh
+            # (ascending) — both are `eff >= sgn*thresh` on the sign-
+            # flipped matrix (reference bipartite_matching contract)
+            ok = eff.reshape(-1)[idx] >= sgn * threshold
+            m_match = jnp.where(ok, c, -1)
+            row_used = row_used.at[r].set(row_used[r] | ok)
+            col_used = col_used.at[c].set(col_used[c] | ok)
+            return (m, row_used, col_used), (r, m_match, c)
+
+        (_, _, _), (rows, rmatch, cols) = lax.scan(
+            body, (mat, jnp.zeros(N, bool), jnp.zeros(M, bool)),
+            None, length=K)
+        row_out = jnp.full((N,), -1, jnp.int32)
+        row_out = row_out.at[rows].set(
+            jnp.where(rmatch >= 0, rmatch, row_out[rows]).astype(jnp.int32))
+        col_out = jnp.full((M,), -1, jnp.int32)
+        col_out = col_out.at[cols].set(
+            jnp.where(rmatch >= 0, rows, col_out[cols]).astype(jnp.int32))
+        return row_out, col_out
+
+    row, col = jax.vmap(per)(flat)
+    return (row.reshape(lead + (N,)).astype(data.dtype),
+            col.reshape(lead + (M,)).astype(data.dtype))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _grad_mult(x, scalar):
+    return x
+
+
+def _grad_mult_fwd(x, scalar):
+    return x, None
+
+
+def _grad_mult_bwd(scalar, _, g):
+    return (g * scalar,)
+
+
+_grad_mult.defvjp(_grad_mult_fwd, _grad_mult_bwd)
+
+
+@register("gradientmultiplier", aliases=("_contrib_gradientmultiplier",))
+def gradientmultiplier(data, scalar=1.0):
+    """Identity forward, gradient scaled by ``scalar`` (reference
+    gradient_multiplier_op.cc — the GAN/DANN gradient-reversal trick)."""
+    return _grad_mult(data, float(scalar))
+
+
+@register("group_adagrad_update", aliases=("_contrib_group_adagrad_update",),
+          num_outputs=2)
+def group_adagrad_update(weight, grad, history, lr=0.01, rescale_grad=1.0,
+                         clip_gradient=-1.0, epsilon=1e-5):
+    """Row-wise AdaGrad (reference optimizer_op.cc GroupAdagrad — the
+    embedding-friendly variant: one accumulator per row)."""
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    red_axes = tuple(range(1, g.ndim))
+    mean_sq = jnp.mean(jnp.square(g), axis=red_axes) if red_axes else \
+        jnp.square(g)
+    new_hist = history + mean_sq
+    denom = jnp.sqrt(new_hist) + epsilon
+    shape = (-1,) + (1,) * (g.ndim - 1)
+    return weight - lr * g / denom.reshape(shape), new_hist

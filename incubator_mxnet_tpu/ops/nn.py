@@ -179,7 +179,8 @@ def pooling(data, kernel=None, pool_type="max", global_pool=False,
     raise MXNetError(f"pool_type {pool_type}")
 
 
-@register("AdaptiveAvgPooling2D", aliases=("contrib_AdaptiveAvgPooling2D",))
+@register("AdaptiveAvgPooling2D", aliases=("contrib_AdaptiveAvgPooling2D",
+                                           "_contrib_AdaptiveAvgPooling2D"))
 def adaptive_avg_pooling2d(data, output_size=None):
     """(reference: src/operator/contrib/adaptive_avg_pooling.cc)"""
     if output_size is None:
@@ -191,9 +192,22 @@ def adaptive_avg_pooling2d(data, output_size=None):
     if h % oh == 0 and w % ow == 0:
         x = data.reshape(n, c, oh, h // oh, ow, w // ow)
         return x.mean(axis=(3, 5))
-    # general case: interpolate-style average via per-output-bin windows
-    out = jax.image.resize(data, (n, c, oh, ow), method="linear")
-    return out
+    # general case: exact per-bin averages via the integral-image trick —
+    # one cumsum + four gathers, static shapes for XLA. The cumsum runs in
+    # f32: its magnitude reaches H*W, far past bf16's 8-bit mantissa, and
+    # the a-b-c+d window difference would cancel catastrophically
+    ii = jnp.cumsum(jnp.cumsum(data.astype(jnp.float32), axis=2), axis=3)
+    ii = jnp.pad(ii, ((0, 0), (0, 0), (1, 0), (1, 0)))
+    hs = (jnp.arange(oh) * h) // oh
+    he = ((jnp.arange(oh) + 1) * h + oh - 1) // oh
+    ws = (jnp.arange(ow) * w) // ow
+    we = ((jnp.arange(ow) + 1) * w + ow - 1) // ow
+    a = ii[:, :, he[:, None], we[None, :]]
+    b = ii[:, :, hs[:, None], we[None, :]]
+    c_ = ii[:, :, he[:, None], ws[None, :]]
+    d = ii[:, :, hs[:, None], ws[None, :]]
+    area = (he - hs)[:, None] * (we - ws)[None, :]
+    return ((a - b - c_ + d) / area).astype(data.dtype)
 
 
 # --------------------------------------------------------------------- #
@@ -209,18 +223,23 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
     Returns ``(out, batch_mean, batch_var)``; running stats are updated by
     the Gluon layer (functional purity — see module docstring).
     """
+    dt = data.dtype
+    x = data.astype(jnp.float32)
     axes = tuple(i for i in range(data.ndim) if i != axis % data.ndim)
     bshape = tuple(data.shape[i] if i == axis % data.ndim else 1
                    for i in range(data.ndim))
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     if training and not use_global_stats:
-        mean = jnp.mean(data, axis=axes)
-        var = jnp.var(data, axis=axes)
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
     else:
         mean, var = moving_mean, moving_var
     inv = lax.rsqrt(var + eps)
-    out = (data - mean.reshape(bshape)) * (inv * g).reshape(bshape) \
+    # f32 stats/affine, output cast back to the input dtype (keep a bf16
+    # conv stream bf16 — see layer_norm below for why this matters)
+    out = (x - mean.reshape(bshape)) * (inv * g).reshape(bshape) \
         + beta.reshape(bshape)
+    out = out.astype(dt)
     if training and not use_global_stats:
         return out, mean, var
     return out, moving_mean, moving_var
@@ -228,24 +247,37 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
 
 @register("LayerNorm", aliases=("layer_norm",))
 def layer_norm(data, gamma, beta, axis=-1, eps=1e-5):
-    """(reference: src/operator/nn/layer_norm.cc)"""
-    mean = jnp.mean(data, axis=axis, keepdims=True)
-    var = jnp.var(data, axis=axis, keepdims=True)
-    out = (data - mean) * lax.rsqrt(var + eps)
+    """(reference: src/operator/nn/layer_norm.cc)
+
+    Statistics and the affine transform run in f32, but the OUTPUT is cast
+    back to the input dtype: fp32 gamma/beta must not promote a bf16
+    activation stream to f32, or every downstream matmul silently runs at
+    the MXU's f32 rate (~4x slower on v5e) — the mixed-precision contract
+    of the reference's LayerNorm-with-AMP path."""
+    dt = data.dtype
+    x = data.astype(jnp.float32)
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.var(x, axis=axis, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + eps)
     bshape = tuple(data.shape[a] if a == axis % data.ndim else 1
                    for a in range(data.ndim))
-    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+    out = out * gamma.reshape(bshape).astype(jnp.float32) + \
+        beta.reshape(bshape).astype(jnp.float32)
+    return out.astype(dt)
 
 
 @register("InstanceNorm", aliases=("instance_norm",))
 def instance_norm(data, gamma, beta, eps=1e-3):
-    """(reference: src/operator/instance_norm.cc); data NC+spatial."""
-    axes = tuple(range(2, data.ndim))
-    mean = jnp.mean(data, axis=axes, keepdims=True)
-    var = jnp.var(data, axis=axes, keepdims=True)
-    out = (data - mean) * lax.rsqrt(var + eps)
+    """(reference: src/operator/instance_norm.cc); data NC+spatial.
+    f32 stats, output in input dtype (see layer_norm)."""
+    dt = data.dtype
+    x = data.astype(jnp.float32)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + eps)
     bshape = (1, -1) + (1,) * (data.ndim - 2)
-    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+    return (out * gamma.reshape(bshape) + beta.reshape(bshape)).astype(dt)
 
 
 @register("GroupNorm", aliases=("group_norm",))
@@ -253,14 +285,16 @@ def group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
     """(reference: src/operator/nn/group_norm.cc); data NCHW."""
     n, c = data.shape[:2]
     spatial = data.shape[2:]
-    x = data.reshape((n, num_groups, c // num_groups) + spatial)
+    dt = data.dtype
+    x = data.astype(jnp.float32).reshape(
+        (n, num_groups, c // num_groups) + spatial)
     axes = tuple(range(2, x.ndim))
     mean = jnp.mean(x, axis=axes, keepdims=True)
     var = jnp.var(x, axis=axes, keepdims=True)
     x = (x - mean) * lax.rsqrt(var + eps)
     x = x.reshape(data.shape)
     bshape = (1, -1) + (1,) * (data.ndim - 2)
-    return x * gamma.reshape(bshape) + beta.reshape(bshape)
+    return (x * gamma.reshape(bshape) + beta.reshape(bshape)).astype(dt)
 
 
 @register("L2Normalization")

@@ -332,6 +332,31 @@ def adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7, wd=0.0,
     return weight - lr * g / jnp.sqrt(new_hist + epsilon), new_hist
 
 
+@register("multi_sum_sq", num_outputs=1, wrap_list=True)
+def multi_sum_sq(*arrays, num_arrays=None):
+    """Per-array sum of squares, returned as one (N,) vector (reference:
+    src/operator/contrib/multi_sum_sq.cc). Feeds multi_lars / global-norm
+    gradient clipping; one fused reduction launch per call under jit."""
+    if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+        arrays = tuple(arrays[0])
+    return jnp.stack([jnp.sum(jnp.square(a.astype(jnp.float32)))
+                      for a in arrays])
+
+
+@register("multi_lars", num_outputs=1)
+def multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, eta=0.001,
+               eps=1e-8, rescale_grad=1.0):
+    """LARS layer-wise lr scaling over stacked per-layer norms (reference:
+    src/operator/contrib/multi_lars.cc). All inputs are (N,) vectors."""
+    w_norm = jnp.sqrt(weights_sum_sq)
+    g_norm = jnp.sqrt(grads_sum_sq) * rescale_grad
+    trust = jnp.where(
+        (w_norm > 0) & (g_norm > 0),
+        eta * w_norm / (g_norm + wds * w_norm + eps),
+        jnp.ones_like(w_norm))
+    return lrs * trust
+
+
 @register("adadelta_update", num_outputs=3)
 def adadelta_update(weight, grad, acc_g, acc_delta, rho=0.9, epsilon=1e-5,
                     wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):

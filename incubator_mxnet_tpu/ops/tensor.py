@@ -531,6 +531,19 @@ def pick(data, index, axis=-1, keepdims=False, mode="clip"):
     return picked if keepdims else jnp.squeeze(picked, axis=axis % data.ndim)
 
 
+@register("logsumexp")
+def logsumexp(data, axis=-1, keepdims=False):
+    """Numerically-stable log-sum-exp with f32 accumulation. The bf16→f32
+    convert fuses into the reduction (no f32 materialization of ``data``)
+    — the building block for vocab-sized cross-entropy that never writes
+    the (..., vocab) log-prob tensor (reference: softmax CE fusions)."""
+    m = jax.lax.stop_gradient(jnp.max(data, axis=axis, keepdims=True))
+    s = jnp.sum(jnp.exp((data - m).astype(jnp.float32)), axis=axis,
+                keepdims=keepdims)
+    mm = m if keepdims else jnp.squeeze(m, axis=axis)
+    return jnp.log(s) + mm.astype(jnp.float32)
+
+
 @register("gather_nd")
 def gather_nd(data, indices):
     """(reference: indexing_op.cc GatherNDForward). indices shape
@@ -567,7 +580,7 @@ def clip(data, a_min=None, a_max=None):
     return jnp.clip(data, a_min, a_max)
 
 
-@register("index_copy")
+@register("index_copy", aliases=("_contrib_index_copy",))
 def index_copy(old, index, new):
     """(reference: src/operator/contrib/index_copy.cc)."""
     return old.at[index.astype(jnp.int32)].set(new)

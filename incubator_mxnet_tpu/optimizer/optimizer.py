@@ -22,12 +22,12 @@ from typing import Dict, Optional
 import jax.numpy as jnp
 
 from ..base import MXNetError, Registry
-from ..ndarray import NDArray, zeros as nd_zeros
+from ..ndarray import NDArray, array as nd_array, zeros as nd_zeros
 from ..ndarray.register import invoke_by_name
 
 __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "RMSProp", "Ftrl",
-           "Signum", "LAMB", "FTML", "AdaGrad", "AdaDelta", "Updater",
-           "create", "register", "get_updater"]
+           "Signum", "LAMB", "LARS", "FTML", "AdaGrad", "AdaDelta",
+           "Updater", "create", "register", "get_updater"]
 
 _REGISTRY = Registry("optimizer")
 
@@ -394,6 +394,52 @@ class LAMB(Optimizer):
             lower_bound=self.lower_bound, upper_bound=self.upper_bound)
         weight._data = new_w._data
         mean._data, var._data = new_mean._data, new_var._data
+
+
+@register("lars")
+class LARS(Optimizer):
+    """Layer-wise adaptive rate scaling for large-batch SGD (reference:
+    optimizer.py LARS built on contrib multi_sum_sq/multi_lars kernels).
+
+    Trust ratio eta*||w|| / (||g|| + wd*||w|| + eps) rescales each layer's
+    lr, then a standard momentum-SGD step applies. The norm pair is one
+    fused multi_sum_sq reduction, matching the reference's fused-kernel
+    design."""
+
+    def __init__(self, learning_rate=0.1, momentum=0.9, eta=0.001,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.eta = eta
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd_zeros(weight.shape, dtype=str(weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        sums = invoke_by_name("multi_sum_sq", [weight, grad])
+        scaled = invoke_by_name(
+            "multi_lars", nd_array([lr]), sums[0:1], sums[1:2],
+            nd_array([wd]), eta=self.eta, eps=self.epsilon,
+            rescale_grad=self.rescale_grad)
+        lr_eff = scaled._data[0]  # jnp scalar: trace-safe under jit
+        if state is None:
+            new_w = invoke_by_name(
+                "sgd_update", weight, grad, lr=lr_eff, wd=wd,
+                rescale_grad=self.rescale_grad,
+                clip_gradient=self.clip_gradient)
+            weight._data = new_w._data
+        else:
+            new_w, new_m = invoke_by_name(
+                "sgd_mom_update", weight, grad, state, lr=lr_eff,
+                momentum=self.momentum, wd=wd,
+                rescale_grad=self.rescale_grad,
+                clip_gradient=self.clip_gradient)
+            weight._data, state._data = new_w._data, new_m._data
 
 
 @register("ftml")

@@ -187,3 +187,186 @@ def test_new_optimizer_ops_and_ftml_class():
         tr.step(1)
         losses.append(float(L.asnumpy()))
     assert losses[-1] < losses[0]
+
+
+def test_multi_sum_sq_multi_lars_and_lars_optimizer():
+    from incubator_mxnet_tpu import gluon
+    rng = np.random.RandomState(1)
+    w = rng.randn(4, 5).astype(np.float32)
+    g = rng.randn(4, 5).astype(np.float32)
+    sums = nd.multi_sum_sq([nd.array(w), nd.array(g)]).asnumpy()
+    np.testing.assert_allclose(sums, [np.sum(w * w), np.sum(g * g)],
+                               rtol=1e-5)
+
+    # multi_lars trust-ratio oracle
+    lrs = np.array([0.1], np.float32)
+    wds = np.array([1e-4], np.float32)
+    out = nd.multi_lars(nd.array(lrs), nd.array(sums[0:1]),
+                        nd.array(sums[1:2]), nd.array(wds),
+                        eta=0.001, eps=1e-8).asnumpy()
+    wn, gn = np.sqrt(sums[0]), np.sqrt(sums[1])
+    want = lrs * (0.001 * wn / (gn + wds * wn + 1e-8))
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+    # top-level cast_storage parity alias
+    rs = nd.cast_storage(nd.array(np.eye(3, dtype=np.float32)),
+                         "row_sparse")
+    assert rs.stype == "row_sparse"
+
+    # LARS optimizer trains a small net
+    mx.random.seed(0)
+    net = gluon.nn.Dense(3, in_units=4)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "lars",
+                       {"learning_rate": 0.05, "momentum": 0.9},
+                       kvstore=None)
+    X = rng.randn(16, 4).astype(np.float32)
+    y = rng.randint(0, 3, (16,))
+    lf = gluon.loss.SoftmaxCrossEntropyLoss()
+    losses = []
+    for _ in range(15):
+        with autograd.record():
+            L = lf(net(nd.array(X)), nd.array(y)).mean()
+        L.backward()
+        tr.step(1)
+        losses.append(float(L.asnumpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_interleaved_matmul_attention_parity():
+    """interleaved_matmul_* vs an explicit-einsum numpy oracle."""
+    rng = np.random.RandomState(2)
+    S, B, H, D = 6, 2, 2, 4
+    qkv = rng.randn(S, B, H * 3 * D).astype(np.float32)
+    att_qk = nd.contrib.interleaved_matmul_selfatt_qk(
+        nd.array(qkv), heads=H).asnumpy()
+    x = qkv.reshape(S, B, H, 3, D)
+    q = x[..., 0, :].transpose(1, 2, 0, 3).reshape(B * H, S, D)
+    k = x[..., 1, :].transpose(1, 2, 0, 3).reshape(B * H, S, D)
+    v = x[..., 2, :].transpose(1, 2, 0, 3).reshape(B * H, S, D)
+    want = np.einsum("bqd,bkd->bqk", q / np.sqrt(D), k)
+    np.testing.assert_allclose(att_qk, want, rtol=1e-5, atol=1e-5)
+
+    att = np.exp(att_qk) / np.exp(att_qk).sum(-1, keepdims=True)
+    out = nd.contrib.interleaved_matmul_selfatt_valatt(
+        nd.array(qkv), nd.array(att), heads=H).asnumpy()
+    want_o = np.einsum("bqk,bkd->bqd", att, v)
+    want_o = want_o.reshape(B, H, S, D).transpose(2, 0, 1, 3).reshape(
+        S, B, H * D)
+    np.testing.assert_allclose(out, want_o, rtol=1e-5, atol=1e-5)
+
+    # encdec pair
+    Sq, Sk = 5, 7
+    qs = rng.randn(Sq, B, H * D).astype(np.float32)
+    kv = rng.randn(Sk, B, H * 2 * D).astype(np.float32)
+    qk = nd.contrib.interleaved_matmul_encdec_qk(
+        nd.array(qs), nd.array(kv), heads=H).asnumpy()
+    qm = qs.reshape(Sq, B, H, D).transpose(1, 2, 0, 3).reshape(B * H, Sq, D)
+    kvx = kv.reshape(Sk, B, H, 2, D)
+    km = kvx[..., 0, :].transpose(1, 2, 0, 3).reshape(B * H, Sk, D)
+    vm = kvx[..., 1, :].transpose(1, 2, 0, 3).reshape(B * H, Sk, D)
+    np.testing.assert_allclose(
+        qk, np.einsum("bqd,bkd->bqk", qm / np.sqrt(D), km),
+        rtol=1e-5, atol=1e-5)
+    att2 = np.exp(qk) / np.exp(qk).sum(-1, keepdims=True)
+    out2 = nd.contrib.interleaved_matmul_encdec_valatt(
+        nd.array(kv), nd.array(att2), heads=H).asnumpy()
+    want2 = np.einsum("bqk,bkd->bqd", att2, vm).reshape(
+        B, H, Sq, D).transpose(2, 0, 1, 3).reshape(Sq, B, H * D)
+    np.testing.assert_allclose(out2, want2, rtol=1e-5, atol=1e-5)
+
+    # div_sqrt_dim
+    np.testing.assert_allclose(
+        nd.contrib.div_sqrt_dim(nd.array(qs)).asnumpy(), qs / np.sqrt(D * H),
+        rtol=1e-6)
+
+
+def test_box_encode_decode_roundtrip():
+    rng = np.random.RandomState(3)
+    B, N = 2, 5
+    anchors = np.sort(rng.rand(B, N, 4).astype(np.float32), axis=-1)
+    deltas = (rng.randn(B, N, 4) * 0.1).astype(np.float32)
+    dec = nd.contrib.box_decode(nd.array(deltas), nd.array(anchors)).asnumpy()
+    # encode the decoded boxes back against the same anchors: identity
+    samples = np.ones((B, N), np.float32)
+    matches = np.tile(np.arange(N), (B, 1)).astype(np.float32)
+    enc, mask = nd.contrib.box_encode(
+        nd.array(samples), nd.array(matches), nd.array(anchors),
+        nd.array(dec))
+    np.testing.assert_allclose(enc.asnumpy(), deltas, rtol=1e-3, atol=1e-4)
+    assert mask.asnumpy().min() == 1.0
+
+
+def test_bipartite_matching():
+    score = np.array([[[0.9, 0.1], [0.8, 0.7], [0.2, 0.6]]], np.float32)
+    row, col = nd.contrib.bipartite_matching(nd.array(score), threshold=0.0)
+    # greedy: (0,0)=0.9 first, then (1,1)=0.7; row2 unmatched
+    np.testing.assert_array_equal(row.asnumpy()[0], [0, 1, -1])
+    np.testing.assert_array_equal(col.asnumpy()[0], [0, 1])
+
+
+def test_gradientmultiplier_and_group_adagrad():
+    x = nd.array(np.array([1.0, 2.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = (nd.contrib.gradientmultiplier(x, scalar=-0.5) * 3.0).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [-1.5, -1.5])
+
+    w = np.ones((3, 4), np.float32)
+    g = np.full((3, 4), 2.0, np.float32)
+    h = np.zeros((3,), np.float32)
+    nw, nh = nd.contrib.group_adagrad_update(
+        nd.array(w), nd.array(g), nd.array(h), lr=0.1)
+    np.testing.assert_allclose(nh.asnumpy(), np.full(3, 4.0))
+    np.testing.assert_allclose(
+        nw.asnumpy(), w - 0.1 * g / (np.sqrt(4.0) + 1e-5), rtol=1e-6)
+
+
+def test_adaptive_avg_pooling_general_size():
+    rng = np.random.RandomState(4)
+    x = rng.rand(1, 2, 5, 7).astype(np.float32)
+    out = nd.contrib.AdaptiveAvgPooling2D(nd.array(x),
+                                          output_size=(2, 3)).asnumpy()
+    # exact per-bin oracle
+    want = np.zeros((1, 2, 2, 3), np.float32)
+    for i in range(2):
+        for j in range(3):
+            hs, he = (i * 5) // 2, ((i + 1) * 5 + 1) // 2
+            ws, we = (j * 7) // 3, ((j + 1) * 7 + 2) // 3
+            want[:, :, i, j] = x[:, :, hs:he, ws:we].mean(axis=(2, 3))
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_bipartite_matching_ascend_threshold():
+    # ascending (distance) mode: matches with score > threshold rejected
+    score = np.array([[[0.9]]], np.float32)
+    row, _ = nd.contrib.bipartite_matching(nd.array(score), is_ascend=True,
+                                           threshold=0.5)
+    np.testing.assert_array_equal(row.asnumpy()[0], [-1])
+    row2, _ = nd.contrib.bipartite_matching(nd.array(score), is_ascend=True,
+                                            threshold=0.95)
+    np.testing.assert_array_equal(row2.asnumpy()[0], [0])
+
+
+def test_logsumexp_value_and_gradient():
+    rng = np.random.RandomState(5)
+    x = (rng.randn(4, 7) * 5).astype(np.float32)
+    lse = nd.logsumexp(nd.array(x), axis=-1).asnumpy()
+    m = x.max(-1, keepdims=True)
+    want = np.log(np.exp(x - m).sum(-1)) + m[:, 0]
+    np.testing.assert_allclose(lse, want, rtol=1e-5)
+
+    # d lse / d x = softmax(x)
+    xn = nd.array(x)
+    xn.attach_grad()
+    with autograd.record():
+        out = nd.logsumexp(xn, axis=-1).sum()
+    out.backward()
+    sm = np.exp(x - m) / np.exp(x - m).sum(-1, keepdims=True)
+    np.testing.assert_allclose(xn.grad.asnumpy(), sm, rtol=1e-4, atol=1e-5)
+
+    # bf16 input: f32 accumulation keeps the value accurate
+    xb = nd.array(x).astype("bfloat16")
+    lse_b = nd.logsumexp(xb, axis=-1).asnumpy()
+    np.testing.assert_allclose(lse_b, want, rtol=2e-2)
