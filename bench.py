@@ -71,7 +71,7 @@ def _run_bert(on_tpu):
     from incubator_mxnet_tpu.models import bert as bert_mod
 
     if on_tpu:
-        B = int(os.environ.get("MXTPU_BENCH_BATCH", "32"))
+        B = int(os.environ.get("MXTPU_BENCH_BATCH", "48"))
         T, M = 512, 76
         dtype = "bfloat16"
         steps, warmup = 10, 3
@@ -168,6 +168,13 @@ def _run_resnet(on_tpu):
     mx.random.seed(0)
     net = resnet50_v1()
     net.initialize()
+    if dtype != "float32":
+        # cast params too (the reference's net.cast('float16') recipe) —
+        # a bf16 input against f32 weights silently promotes every conv
+        # back to f32; multi_precision SGD keeps f32 master weights
+        rng0 = np.random.RandomState(0)
+        net(nd.array(rng0.rand(1, 3, side, side).astype("float32")))
+        net.cast(dtype)
 
     rng = np.random.RandomState(0)
     x = nd.array(rng.rand(B, 3, side, side).astype("float32"))

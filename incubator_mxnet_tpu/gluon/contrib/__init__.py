@@ -1,7 +1,8 @@
 """``gluon.contrib`` (parity: python/mxnet/gluon/contrib/)."""
 
 from . import nn
+from . import rnn
 from . import estimator
 from .estimator import Estimator
 
-__all__ = ["nn", "estimator", "Estimator"]
+__all__ = ["nn", "rnn", "estimator", "Estimator"]

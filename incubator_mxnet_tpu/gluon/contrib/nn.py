@@ -9,7 +9,8 @@ from .. import nn
 from ..block import HybridBlock
 
 __all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
-           "PixelShuffle2D"]
+           "PixelShuffle1D", "PixelShuffle2D", "PixelShuffle3D",
+           "SyncBatchNorm"]
 
 
 class HybridConcurrent(HybridBlock):
@@ -87,3 +88,76 @@ class PixelShuffle2D(HybridBlock):
         x = F.reshape(x, shape=(B, c, f1, f2, H, W))
         x = F.transpose(x, axes=(0, 1, 4, 2, 5, 3))
         return F.reshape(x, shape=(B, c, H * f1, W * f2))
+
+
+class PixelShuffle1D(HybridBlock):
+    """(B, C*f, W) → (B, C, W*f) (parity: contrib.nn.PixelShuffle1D)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        self._factor = int(factor)
+
+    def hybrid_forward(self, F, x):
+        f = self._factor
+        B, C, W = x.shape
+        if C % f:
+            raise MXNetError(f"PixelShuffle1D: channels {C} % {f} != 0")
+        c = C // f
+        x = F.reshape(x, shape=(B, c, f, W))
+        x = F.transpose(x, axes=(0, 1, 3, 2))
+        return F.reshape(x, shape=(B, c, W * f))
+
+
+class PixelShuffle3D(HybridBlock):
+    """(B, C*f1*f2*f3, D, H, W) → (B, C, D*f1, H*f2, W*f3)
+    (parity: contrib.nn.PixelShuffle3D)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        self._factors = (factor,) * 3 if isinstance(factor, int) \
+            else tuple(factor)
+
+    def hybrid_forward(self, F, x):
+        f1, f2, f3 = self._factors
+        B, C, D, H, W = x.shape
+        if C % (f1 * f2 * f3):
+            raise MXNetError(
+                f"PixelShuffle3D: channels {C} not divisible by "
+                f"{f1}*{f2}*{f3}")
+        c = C // (f1 * f2 * f3)
+        x = F.reshape(x, shape=(B, c, f1, f2, f3, D, H, W))
+        x = F.transpose(x, axes=(0, 1, 5, 2, 6, 3, 7, 4))
+        return F.reshape(x, shape=(B, c, D * f1, H * f2, W * f3))
+
+
+class SyncBatchNorm(nn.BatchNorm):
+    """Cross-device synchronized BatchNorm (parity:
+    contrib.nn.SyncBatchNorm — reference src/operator/contrib/
+    sync_batch_norm.cc, which all-reduces batch statistics over workers).
+
+    TPU-native design: inside an SPMD train step the batch axis is sharded
+    over the mesh's (dp, fsdp) axes, and XLA's partitioner already computes
+    GLOBAL batch statistics for a full-axis reduction — `jnp.mean` over a
+    sharded batch IS the reference's cross-worker all-reduce, riding ICI.
+    The layer therefore reuses the plain BatchNorm op; ``num_devices`` is
+    accepted for API parity and ignored (the mesh defines the sync group).
+    Outside an SPMD step (single device) it degrades to ordinary BN,
+    matching the reference's single-worker behavior.
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True,
+                 use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", **kwargs):
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         center=center, scale=scale,
+                         use_global_stats=use_global_stats,
+                         beta_initializer=beta_initializer,
+                         gamma_initializer=gamma_initializer,
+                         running_mean_initializer=running_mean_initializer,
+                         running_variance_initializer=(
+                             running_variance_initializer),
+                         in_channels=in_channels, **kwargs)
+        self._num_devices = num_devices

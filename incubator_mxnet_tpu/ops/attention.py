@@ -199,3 +199,81 @@ def interleaved_matmul_encdec_valatt(keys_values, attention, heads=1):
     Sq = out.shape[1]
     out = out.reshape(B, heads, Sq, -1).transpose(2, 0, 1, 3)
     return out.reshape(Sq, B, -1)
+
+
+# --------------------------------------------------------------------- #
+# sliding-window (banded) attention surface (reference:
+# src/operator/contrib/sldwin_atten*.cc — masked-window self-attention
+# for Longformer-style long-context models; file-level citations,
+# SURVEY.md caveat). The reference stores scores in a compact
+# (B, L, H, W_len) band; on TPU a banded gather breaks MXU tiling, so the
+# idiomatic mapping keeps the dense (B*H, L, L) score layout masked to
+# the band — XLA fuses the mask into the matmul epilogue, and the flash /
+# ring-attention path (ops/pallas_attention.py, parallel/ring_attention)
+# is the scalable long-context engine. The op CONTRACT (shapes in/out,
+# symmetric + dilation semantics) matches the reference.
+# --------------------------------------------------------------------- #
+
+def _sldwin_band_mask(L, w, symmetric, dilation, dtype):
+    """(L, L) band mask. ``dilation`` may be a Python int OR a traced
+    scalar (the reference passes it as a tensor input) — all arithmetic
+    is jnp elementwise, so tracing never needs a concrete value."""
+    i = lax.broadcasted_iota(jnp.int32, (L, L), 0)
+    j = lax.broadcasted_iota(jnp.int32, (L, L), 1)
+    d = j - i
+    dil = jnp.asarray(dilation, jnp.int32).reshape(-1)[0]
+    lo = -w * dil
+    hi = w * dil if symmetric else 0
+    band = (d >= lo) & (d <= hi) & (d % jnp.maximum(dil, 1) == 0)
+    return band.astype(dtype)
+
+
+@register("sldwin_atten_mask_like",
+          aliases=("_contrib_sldwin_atten_mask_like",))
+def sldwin_atten_mask_like(score, dilation, valid_length, num_heads=1,
+                           w=1, symmetric=True):
+    """Mask with ones where the banded score is valid (reference
+    sldwin_atten_mask_like). score: (B*H, L, L) dense-band layout."""
+    L = score.shape[-1]
+    band = _sldwin_band_mask(L, int(w), bool(symmetric), dilation,
+                             score.dtype)
+    BH = score.shape[0]
+    B = BH // num_heads
+    vl = valid_length.astype(jnp.int32).reshape(B, 1)
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    keyok = (pos < vl).astype(score.dtype)          # (B, L)
+    keyok = jnp.repeat(keyok, num_heads, axis=0)    # (B*H, L)
+    return band[None] * keyok[:, None, :] * keyok[:, :, None]
+
+
+@register("sldwin_atten_score", aliases=("_contrib_sldwin_atten_score",))
+def sldwin_atten_score(query, key, dilation, num_heads=1, w=1,
+                       symmetric=True):
+    """Banded Q·Kᵀ. query/key: (B, L, H*D) → (B*H, L, L) scores with
+    out-of-band entries zeroed (reference sldwin_atten_score)."""
+    B, L, HD = query.shape
+    D = HD // num_heads
+    q = query.reshape(B, L, num_heads, D).transpose(0, 2, 1, 3)
+    k = key.reshape(B, L, num_heads, D).transpose(0, 2, 1, 3)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).reshape(
+        B * num_heads, L, L)
+    band = _sldwin_band_mask(L, int(w), bool(symmetric), dilation,
+                             scores.dtype)
+    return scores * band[None]
+
+
+@register("sldwin_atten_context",
+          aliases=("_contrib_sldwin_atten_context",))
+def sldwin_atten_context(score, value, dilation, num_heads=1, w=1,
+                         symmetric=True):
+    """attention @ V over the band. score: (B*H, L, L); value:
+    (B, L, H*D) → (B, L, H*D) (reference sldwin_atten_context)."""
+    BH, L, _ = score.shape
+    B = BH // num_heads
+    D = value.shape[-1] // num_heads
+    band = _sldwin_band_mask(L, int(w), bool(symmetric), dilation,
+                             score.dtype)
+    s = (score * band[None]).reshape(B, num_heads, L, L)
+    v = value.reshape(B, L, num_heads, D).transpose(0, 2, 1, 3)
+    out = jnp.einsum("bhqk,bhkd->bhqd", s, v)
+    return out.transpose(0, 2, 1, 3).reshape(B, L, num_heads * D)

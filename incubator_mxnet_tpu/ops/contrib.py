@@ -636,3 +636,118 @@ def group_adagrad_update(weight, grad, history, lr=0.01, rescale_grad=1.0,
     denom = jnp.sqrt(new_hist) + epsilon
     shape = (-1,) + (1,) * (g.ndim - 1)
     return weight - lr * g / denom.reshape(shape), new_hist
+
+
+# --------------------------------------------------------------------- #
+# deformable convolution v1/v2 (reference:
+# src/operator/contrib/deformable_convolution.cc and
+# modulated_deformable_convolution.cc — file-level citations, SURVEY.md
+# caveat). TPU-native design: the deformed sampling grid is materialized
+# as (K2, Ho, Wo) pixel coordinates, bilinear taps become four clipped
+# gathers with validity weights (static shapes, no scatter), and the
+# final contraction over (C_in/group, K2) is ONE einsum that XLA maps
+# onto the MXU — replacing the reference's im2col+GEMM CUDA pipeline.
+# --------------------------------------------------------------------- #
+
+def _deform_conv_core(data, offset, weight, bias, kernel, stride, dilate,
+                      pad, num_filter, num_group, num_deformable_group,
+                      mask=None):
+    from .vision import _grid_sample_zero_pad
+    B, C, H, W = data.shape
+    kh, kw = kernel
+    sh, sw = stride
+    dh, dw = dilate
+    ph, pw = pad
+    Ho = (H + 2 * ph - ((kh - 1) * dh + 1)) // sh + 1
+    Wo = (W + 2 * pw - ((kw - 1) * dw + 1)) // sw + 1
+    K2 = kh * kw
+    G = num_deformable_group
+
+    # base sampling grid: (K2, Ho, Wo) pixel coords
+    oy = jnp.arange(Ho) * sh - ph
+    ox = jnp.arange(Wo) * sw - pw
+    ky = jnp.arange(kh) * dh
+    kx = jnp.arange(kw) * dw
+    base_y = (ky[:, None, None, None] +
+              jnp.zeros((kw,))[None, :, None, None] +
+              oy[None, None, :, None] +
+              jnp.zeros((Wo,))[None, None, None, :])
+    base_x = (jnp.zeros((kh,))[:, None, None, None] +
+              kx[None, :, None, None] +
+              jnp.zeros((Ho,))[None, None, :, None] +
+              ox[None, None, None, :])
+    base_y = base_y.reshape(K2, Ho, Wo)
+    base_x = base_x.reshape(K2, Ho, Wo)
+
+    off = offset.reshape(B, G, K2, 2, Ho, Wo).astype(jnp.float32)
+    dy, dx = off[:, :, :, 0], off[:, :, :, 1]          # (B, G, K2, Ho, Wo)
+    if mask is not None:
+        mk = mask.reshape(B, G, K2, Ho, Wo).astype(jnp.float32)
+
+    Cg = C // G
+
+    def per_image(feat, dyi, dxi, mki):
+        # feat (C,H,W); dyi/dxi (G,K2,Ho,Wo)
+        groups = []
+        for g in range(G):
+            ys = base_y + dyi[g]
+            xs = base_x + dxi[g]
+            s = _grid_sample_zero_pad(feat[g * Cg:(g + 1) * Cg], ys, xs)
+            if mki is not None:
+                s = s * mki[g][None]
+            groups.append(s)                            # (Cg, K2, Ho, Wo)
+        return jnp.concatenate(groups, axis=0)          # (C, K2, Ho, Wo)
+
+    if mask is not None:
+        sampled = jax.vmap(per_image)(data.astype(jnp.float32), dy, dx, mk)
+    else:
+        sampled = jax.vmap(lambda f, a, b: per_image(f, a, b, None))(
+            data.astype(jnp.float32), dy, dx)
+
+    # grouped contraction: weight (O, C/num_group, kh, kw)
+    Og = num_filter // num_group
+    Cng = C // num_group
+    w = weight.reshape(num_group, Og, Cng, K2).astype(jnp.float32)
+    x = sampled.reshape(B, num_group, Cng, K2, Ho, Wo)
+    out = jnp.einsum("bgckhw,gock->bgohw", x, w)
+    out = out.reshape(B, num_filter, Ho, Wo)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out.astype(data.dtype)
+
+
+@register("DeformableConvolution",
+          aliases=("_contrib_DeformableConvolution",))
+def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                           stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                           num_filter=0, num_group=1,
+                           num_deformable_group=1, no_bias=False):
+    """Deformable convolution v1 (Dai et al. 2017). ``offset``
+    (B, 2*K2*deform_groups, Ho, Wo) carries per-tap (dy, dx)."""
+    kernel = (kernel, kernel) if isinstance(kernel, int) else tuple(kernel)
+    stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    dilate = (dilate, dilate) if isinstance(dilate, int) else tuple(dilate)
+    pad = (pad, pad) if isinstance(pad, int) else tuple(pad)
+    return _deform_conv_core(data, offset, weight,
+                             None if no_bias else bias, kernel, stride,
+                             dilate, pad, num_filter, num_group,
+                             num_deformable_group)
+
+
+@register("ModulatedDeformableConvolution",
+          aliases=("_contrib_ModulatedDeformableConvolution",))
+def modulated_deformable_convolution(data, offset, mask, weight, bias=None,
+                                     kernel=(3, 3), stride=(1, 1),
+                                     dilate=(1, 1), pad=(0, 0),
+                                     num_filter=0, num_group=1,
+                                     num_deformable_group=1, no_bias=False):
+    """Deformable convolution v2 (Zhu et al. 2019): adds a sigmoid-gated
+    per-tap modulation ``mask`` (B, K2*deform_groups, Ho, Wo)."""
+    kernel = (kernel, kernel) if isinstance(kernel, int) else tuple(kernel)
+    stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    dilate = (dilate, dilate) if isinstance(dilate, int) else tuple(dilate)
+    pad = (pad, pad) if isinstance(pad, int) else tuple(pad)
+    return _deform_conv_core(data, offset, weight,
+                             None if no_bias else bias, kernel, stride,
+                             dilate, pad, num_filter, num_group,
+                             num_deformable_group, mask=mask)

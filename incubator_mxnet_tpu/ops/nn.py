@@ -241,7 +241,11 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
         + beta.reshape(bshape)
     out = out.astype(dt)
     if training and not use_global_stats:
-        return out, mean, var
+        # batch stats go back in the RUNNING-stat dtype: a bf16-cast net
+        # must not have its aux params drift to f32 after one step (that
+        # would force a recompile and break checkpoint dtype round-trips)
+        return (out, mean.astype(moving_mean.dtype),
+                var.astype(moving_var.dtype))
     return out, moving_mean, moving_var
 
 

@@ -101,3 +101,78 @@ def test_monitor_collects_stats():
     assert any("output" in n for n in name_set)
     for _, _, stat in seen[0]:
         assert np.isfinite(stat).all()
+
+
+def test_pixelshuffle_1d_3d():
+    import numpy as np
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.gluon.contrib import nn as gcn
+    x = np.arange(2 * 6 * 4, dtype=np.float32).reshape(2, 6, 4)
+    out = gcn.PixelShuffle1D(3)(nd.array(x)).asnumpy()
+    assert out.shape == (2, 2, 12)
+    # oracle: reshape/transpose
+    want = x.reshape(2, 2, 3, 4).transpose(0, 1, 3, 2).reshape(2, 2, 12)
+    np.testing.assert_array_equal(out, want)
+
+    x3 = np.random.RandomState(0).rand(1, 8, 2, 3, 4).astype(np.float32)
+    out3 = gcn.PixelShuffle3D(2)(nd.array(x3)).asnumpy()
+    assert out3.shape == (1, 1, 4, 6, 8)
+
+
+def test_sync_batch_norm_trains():
+    import numpy as np
+    from incubator_mxnet_tpu import nd, autograd
+    from incubator_mxnet_tpu.gluon.contrib import nn as gcn
+    bn = gcn.SyncBatchNorm(in_channels=3, num_devices=8)
+    bn.initialize()
+    x = nd.array(np.random.RandomState(0).rand(4, 3, 5, 5).astype("float32"))
+    with autograd.record():
+        y = bn(x)
+    y.backward()
+    # normalized output: near-zero mean per channel
+    m = y.asnumpy().mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(m, np.zeros(3), atol=1e-5)
+    # running stats updated
+    assert not np.allclose(bn.running_mean.data().asnumpy(), 0)
+
+
+def test_contrib_rnn_cells():
+    import numpy as np
+    from incubator_mxnet_tpu import nd, autograd
+    from incubator_mxnet_tpu.gluon.contrib import rnn as crnn
+
+    B, C, H, W = 2, 3, 5, 5
+    x = nd.array(np.random.RandomState(0).rand(B, C, H, W)
+                 .astype("float32"))
+    cell = crnn.Conv2DLSTMCell((C, H, W), 4, i2h_kernel=3, h2h_kernel=3)
+    cell.initialize()
+    states = cell.begin_state(batch_size=B)
+    out, st = cell(x, states)
+    assert out.shape == (B, 4, H, W)
+    assert st[0].shape == (B, 4, H, W) and st[1].shape == (B, 4, H, W)
+
+    gcell = crnn.Conv2DGRUCell((C, H, W), 4)
+    gcell.initialize()
+    gout, gst = gcell(x, gcell.begin_state(batch_size=B))
+    assert gout.shape == (B, 4, H, W) and len(gst) == 1
+
+    # LSTMP: projected recurrent state
+    xf = nd.array(np.random.RandomState(1).rand(B, 10).astype("float32"))
+    pcell = crnn.LSTMPCell(8, 4, input_size=10)
+    pcell.initialize()
+    pout, pst = pcell(xf, pcell.begin_state(batch_size=B))
+    assert pout.shape == (B, 4)
+    assert pst[0].shape == (B, 4) and pst[1].shape == (B, 8)
+
+    # VariationalDropout: same mask across steps while training
+    vcell = crnn.VariationalDropoutCell(
+        crnn.LSTMPCell(8, 4, input_size=10), drop_inputs=0.5)
+    vcell.base_cell.initialize()
+    with autograd.record():
+        o1, s1 = vcell(xf, vcell.begin_state(batch_size=B))
+        m1 = vcell._input_mask.asnumpy()
+        o2, s2 = vcell(xf, s1)
+        m2 = vcell._input_mask.asnumpy()
+    np.testing.assert_array_equal(m1, m2)
+    vcell.reset()
+    assert vcell._input_mask is None

@@ -370,3 +370,64 @@ def test_logsumexp_value_and_gradient():
     xb = nd.array(x).astype("bfloat16")
     lse_b = nd.logsumexp(xb, axis=-1).asnumpy()
     np.testing.assert_allclose(lse_b, want, rtol=2e-2)
+
+
+def test_sldwin_attention_ops():
+    rng = np.random.RandomState(0)
+    B, L, H, D, w = 2, 8, 2, 4, 2
+    q = rng.randn(B, L, H * D).astype(np.float32)
+    k = rng.randn(B, L, H * D).astype(np.float32)
+    v = rng.randn(B, L, H * D).astype(np.float32)
+    s = nd.contrib.sldwin_atten_score(nd.array(q), nd.array(k), 1,
+                                      num_heads=H, w=w, symmetric=True)
+    qh = q.reshape(B, L, H, D).transpose(0, 2, 1, 3)
+    kh = k.reshape(B, L, H, D).transpose(0, 2, 1, 3)
+    full = np.einsum("bhqd,bhkd->bhqk", qh, kh).reshape(B * H, L, L)
+    band = np.abs(np.arange(L)[:, None] - np.arange(L)[None, :]) <= w
+    np.testing.assert_allclose(s.asnumpy(), full * band, rtol=1e-5)
+
+    # asymmetric (causal-window) band keeps only j <= i
+    s_asym = nd.contrib.sldwin_atten_score(nd.array(q), nd.array(k), 1,
+                                           num_heads=H, w=w,
+                                           symmetric=False).asnumpy()
+    band_a = ((np.arange(L)[None, :] - np.arange(L)[:, None]) <= 0) & \
+        ((np.arange(L)[None, :] - np.arange(L)[:, None]) >= -w)
+    np.testing.assert_allclose(s_asym, full * band_a, rtol=1e-5)
+
+    m = nd.contrib.sldwin_atten_mask_like(
+        s, 1, nd.array([L, 5]), num_heads=H, w=w, symmetric=True).asnumpy()
+    assert m[2][:, 5:].sum() == 0 and m[0].sum() == band.sum()
+
+    ctx = nd.contrib.sldwin_atten_context(s, nd.array(v), 1, num_heads=H,
+                                          w=w, symmetric=True)
+    vh = v.reshape(B, L, H, D).transpose(0, 2, 1, 3)
+    want = np.einsum("bhqk,bhkd->bhqd",
+                     (full * band).reshape(B, H, L, L), vh)
+    want = want.transpose(0, 2, 1, 3).reshape(B, L, H * D)
+    np.testing.assert_allclose(ctx.asnumpy(), want, rtol=1e-4, atol=1e-5)
+
+    # dilation=2: only even offsets within the window survive
+    s_d = nd.contrib.sldwin_atten_score(nd.array(q), nd.array(k), 2,
+                                        num_heads=H, w=1,
+                                        symmetric=True).asnumpy()
+    dmat = np.arange(L)[None, :] - np.arange(L)[:, None]
+    band_d = (np.abs(dmat) <= 2) & (dmat % 2 == 0)
+    np.testing.assert_allclose(s_d, full * band_d, rtol=1e-5)
+
+
+def test_sldwin_backward_with_tensor_dilation():
+    """dilation as an NDArray (the reference contract) must survive the
+    autograd re-trace — regression for the int(tracer) crash."""
+    rng = np.random.RandomState(1)
+    B, L, H, D, w = 1, 6, 1, 3, 1
+    q = nd.array(rng.randn(B, L, H * D).astype(np.float32))
+    k = nd.array(rng.randn(B, L, H * D).astype(np.float32))
+    v = nd.array(rng.randn(B, L, H * D).astype(np.float32))
+    dil = nd.array(np.array([1], np.int32))
+    q.attach_grad()
+    with autograd.record():
+        s = nd.contrib.sldwin_atten_score(q, k, dil, num_heads=H, w=w)
+        ctx = nd.contrib.sldwin_atten_context(s, v, dil, num_heads=H, w=w)
+        out = ctx.sum()
+    out.backward()
+    assert float(np.abs(q.grad.asnumpy()).sum()) > 0

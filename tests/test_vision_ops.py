@@ -168,3 +168,39 @@ def test_fft_gradient():
     rng = np.random.RandomState(10)
     x = rng.randn(2, 4).astype(np.float32)
     check_numeric_gradient(lambda d: nd.contrib.fft(d), [nd.array(x)])
+
+
+def test_deformable_convolution_v1_v2():
+    """Zero-offset == plain conv; all-ones mask v2 == v1; gradients flow."""
+    import numpy as np
+    from incubator_mxnet_tpu import nd, autograd
+    rng = np.random.RandomState(0)
+    B, C, H, W, O, k = 2, 4, 7, 7, 6, 3
+    x = rng.randn(B, C, H, W).astype(np.float32)
+    w = rng.randn(O, C, k, k).astype(np.float32)
+    b = rng.randn(O).astype(np.float32)
+    off = np.zeros((B, 2 * k * k, 5, 5), np.float32)
+    out = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w), nd.array(b),
+        kernel=(3, 3), num_filter=O).asnumpy()
+    ref = nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                         kernel=(3, 3), num_filter=O).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+    mask = np.ones((B, k * k, 5, 5), np.float32)
+    out2 = nd.contrib.ModulatedDeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(mask), nd.array(w),
+        nd.array(b), kernel=(3, 3), num_filter=O).asnumpy()
+    np.testing.assert_allclose(out2, ref, rtol=1e-4, atol=1e-4)
+
+    # gradient flows to data, offset and weight
+    xn, on, wn = nd.array(x), nd.array(off + 0.3), nd.array(w)
+    for t in (xn, on, wn):
+        t.attach_grad()
+    with autograd.record():
+        y = nd.contrib.DeformableConvolution(
+            xn, on, wn, nd.array(b), kernel=(3, 3), num_filter=O).sum()
+    y.backward()
+    assert float(np.abs(xn.grad.asnumpy()).sum()) > 0
+    assert float(np.abs(on.grad.asnumpy()).sum()) > 0
+    assert float(np.abs(wn.grad.asnumpy()).sum()) > 0
