@@ -52,11 +52,16 @@ _LAZY_SUBMODULES = (
     "runtime", "test_utils", "callback", "util", "engine", "recordio",
     "numpy", "np", "npx", "module", "mod", "model", "executor", "kv",
     "contrib", "operator", "rtc", "monitor", "mon",
+    "name", "attribute", "viz", "visualization",
 )
 
 
 def __getattr__(name):
     """Lazy submodule loading (keeps import light and cycle-free)."""
+    if name == "AttrScope":
+        from .attribute import AttrScope
+        globals()["AttrScope"] = AttrScope
+        return AttrScope
     if name in _LAZY_SUBMODULES:
         import importlib
 
@@ -66,7 +71,8 @@ def __getattr__(name):
                  "numpy": ".numpy_shim", "np": ".numpy_shim",
                  "recordio": ".io.recordio",
                  "lr_scheduler": ".optimizer.lr_scheduler",
-                 "mod": ".module", "executor": ".symbol.executor"}
+                 "mod": ".module", "executor": ".symbol.executor",
+                 "viz": ".visualization"}
         modpath = alias.get(name, "." + name)
         mod = importlib.import_module(modpath, __name__)
         globals()[name] = mod

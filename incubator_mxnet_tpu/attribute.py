@@ -1,0 +1,53 @@
+"""Symbol attribute scoping (parity: `python/mxnet/attribute.py` —
+AttrScope; file-level citation, SURVEY.md caveat).
+
+``with mx.AttrScope(ctx_group="stage1"):`` attaches the given attributes
+to every symbol created inside the scope — the reference's mechanism for
+`group2ctx` model-parallel placement hints among other graph annotations.
+Scopes nest; inner values win on key conflicts."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+__all__ = ["AttrScope", "current_attrs"]
+
+
+class AttrScope:
+    _current: threading.local = threading.local()
+
+    def __init__(self, **attrs: str):
+        for k, v in attrs.items():
+            if not isinstance(v, str):
+                attrs[k] = str(v)
+        self._attrs = attrs
+        self._old: Optional[Dict[str, str]] = None
+
+    def get(self, attrs: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+        """Merge scope attrs under explicitly-passed ``attrs``."""
+        merged = dict(self._attrs)
+        if attrs:
+            merged.update(attrs)
+        return merged
+
+    def __enter__(self) -> "AttrScope":
+        prev = getattr(AttrScope._current, "value", None)
+        self._old = prev
+        merged = dict(prev._attrs) if isinstance(prev, AttrScope) else \
+            (dict(prev) if prev else {})
+        merged.update(self._attrs)
+        self._attrs = merged
+        AttrScope._current.value = self
+        return self
+
+    def __exit__(self, *exc):
+        AttrScope._current.value = self._old
+        self._old = None
+        return False
+
+
+def current_attrs() -> Dict[str, str]:
+    """Attributes of the innermost active AttrScope ({} outside any)."""
+    scope = getattr(AttrScope._current, "value", None)
+    return dict(scope._attrs) if isinstance(scope, AttrScope) else {}

@@ -262,7 +262,9 @@ class VariationalDropoutCell(ModifierCell):
                     self._input_mask = self._mask(inputs, self._drop_inputs)
                 inputs = inputs * self._input_mask
             if self._drop_states > 0:
-                if self._state_masks is None:
+                if self._state_masks is None or any(
+                        m.shape != s.shape
+                        for m, s in zip(self._state_masks, states)):
                     self._state_masks = [self._mask(s, self._drop_states)
                                          for s in states]
                 states = [s * m for s, m in zip(states, self._state_masks)]

@@ -1,0 +1,58 @@
+"""Automatic symbol naming (parity: `python/mxnet/name.py` — NameManager
+and Prefix; file-level citation, SURVEY.md caveat).
+
+``with mx.name.Prefix("stage1_"):`` prefixes every auto-generated symbol
+name created in the scope; a custom NameManager subclass can implement any
+naming policy. The active manager is consulted by the symbolic front end
+(symbol/__init__.py `_auto_name`)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+__all__ = ["NameManager", "Prefix", "current"]
+
+
+class NameManager:
+    """Scope-based name generator. ``get(name, hint)`` returns ``name`` if
+    given, else ``hint`` + a per-hint counter."""
+
+    _current: threading.local = threading.local()
+
+    def __init__(self):
+        self._counter: Dict[str, int] = {}
+        self._old_manager: Optional["NameManager"] = None
+
+    def get(self, name: Optional[str], hint: str) -> str:
+        if name:
+            return name
+        idx = self._counter.get(hint, 0)
+        self._counter[hint] = idx + 1
+        return f"{hint}{idx}"
+
+    def __enter__(self) -> "NameManager":
+        self._old_manager = getattr(NameManager._current, "value", None)
+        NameManager._current.value = self
+        return self
+
+    def __exit__(self, *exc):
+        NameManager._current.value = self._old_manager
+        self._old_manager = None
+        return False
+
+
+class Prefix(NameManager):
+    """NameManager that prepends ``prefix`` to every auto name."""
+
+    def __init__(self, prefix: str):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name: Optional[str], hint: str) -> str:
+        return self._prefix + super().get(name, hint)
+
+
+def current() -> Optional[NameManager]:
+    """The innermost active NameManager (None outside any scope)."""
+    return getattr(NameManager._current, "value", None)

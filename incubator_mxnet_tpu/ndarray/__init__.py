@@ -147,6 +147,10 @@ class _RandomNS:
             ("poisson", "random_poisson"), ("randint", "random_randint"),
             ("bernoulli", "random_bernoulli"), ("shuffle", "shuffle"),
             ("multinomial", "sample_multinomial"),
+            ("laplace", "random_laplace"), ("randn", "random_randn"),
+            ("negative_binomial", "random_negative_binomial"),
+            ("generalized_negative_binomial",
+             "random_generalized_negative_binomial"),
         ]:
             setattr(self, nm, make_op_function(_registry.get(target), nm))
 

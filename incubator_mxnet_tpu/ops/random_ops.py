@@ -113,3 +113,54 @@ def sample_multinomial(data, shape=None, get_prob=False, dtype="int32", key=None
         ).reshape(out.shape)
         return out, picked
     return out
+
+
+@register("random_laplace", aliases=("laplace", "_random_laplace"),
+          needs_key=True)
+def random_laplace(loc=0.0, scale=1.0, shape=None, dtype="float32",
+                   key=None):
+    """(reference: sample_op.cc LaplaceSample)."""
+    from ..ndarray.ndarray import _to_jnp_dtype
+    return loc + scale * jax.random.laplace(
+        key, _shape(shape), dtype=_to_jnp_dtype(dtype))
+
+
+@register("random_randn", aliases=("randn",), needs_key=True)
+def random_randn(*shape, loc=0.0, scale=1.0, dtype="float32", key=None):
+    """mx.nd.random.randn(*shape) sugar (reference: random.py randn)."""
+    from ..ndarray.ndarray import _to_jnp_dtype
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    return loc + scale * jax.random.normal(
+        key, tuple(int(s) for s in shape), dtype=_to_jnp_dtype(dtype))
+
+
+@register("random_negative_binomial",
+          aliases=("negative_binomial", "_random_negative_binomial"),
+          needs_key=True)
+def random_negative_binomial(k=1, p=1.0, shape=None, dtype="float32",
+                             key=None):
+    """NB(k, p) sampled as Poisson(Gamma(k, (1-p)/p)) — the reference's
+    own compound construction (sample_op.cc NegativeBinomialSample)."""
+    from ..ndarray.ndarray import _to_jnp_dtype
+    kg, kp = jax.random.split(key)
+    rate = jax.random.gamma(kg, k, _shape(shape)) * (1.0 - p) / p
+    return jax.random.poisson(kp, rate, _shape(shape)).astype(
+        _to_jnp_dtype(dtype))
+
+
+@register("random_generalized_negative_binomial",
+          aliases=("generalized_negative_binomial",
+                   "_random_generalized_negative_binomial"), needs_key=True)
+def random_generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None,
+                                         dtype="float32", key=None):
+    """GNB(mu, alpha): Poisson with Gamma(1/alpha, mu*alpha)-mixed rate
+    (reference sample_op.cc GeneralizedNegativeBinomialSample)."""
+    from ..ndarray.ndarray import _to_jnp_dtype
+    kg, kp = jax.random.split(key)
+    if alpha == 0:
+        lam = jnp.full(_shape(shape), mu, jnp.float32)
+    else:
+        lam = jax.random.gamma(kg, 1.0 / alpha, _shape(shape)) * mu * alpha
+    return jax.random.poisson(kp, lam, _shape(shape)).astype(
+        _to_jnp_dtype(dtype))

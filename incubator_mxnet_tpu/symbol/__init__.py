@@ -88,7 +88,17 @@ def _invoke_symbol(op_name: str, *args, name: Optional[str] = None,
                 f"{op_name}: unexpected Symbol kwargs {leftover_syms}")
         attrs.update(values)
 
-    node = _Node(op_name, name or _auto_name(op_name), inputs, attrs)
+    from ..name import current as _current_name_mgr
+    from ..attribute import current_attrs as _scope_attrs
+    mgr = _current_name_mgr()
+    if mgr is not None:
+        final_name = mgr.get(name, op_name.lower())
+    else:
+        final_name = name or _auto_name(op_name)
+    # scope attrs are ANNOTATIONS (placement hints etc.), kept apart
+    # from op kwargs so execution never sees them
+    node = _Node(op_name, final_name, inputs, attrs,
+                 annotations=_scope_attrs() or None)
     return Symbol([(node, i) for i in range(node.num_outputs())])
 
 

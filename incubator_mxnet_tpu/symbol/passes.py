@@ -97,7 +97,7 @@ def rewrite(sym: Symbol, fn: Callable[["_Node", List[Tuple[_Node, int]]],
         out = fn(node, new_inputs)
         if out is None:
             mapping[id(node)] = _Node(node.op, node.name, new_inputs,
-                                      node.attrs)
+                                      node.attrs, node.annotations)
         elif isinstance(out, tuple) and len(out) == 2 \
                 and isinstance(out[0], _Node):
             redirect[id(node)] = out

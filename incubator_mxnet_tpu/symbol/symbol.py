@@ -39,17 +39,24 @@ def _auto_name(op: str) -> str:
 
 
 class _Node:
-    """One graph node: a variable (``op='null'``) or an op application."""
+    """One graph node: a variable (``op='null'``) or an op application.
 
-    __slots__ = ("op", "name", "inputs", "attrs")
+    ``attrs`` are op parameters (forwarded as kwargs at execution);
+    ``annotations`` are graph metadata (AttrScope / _set_attr — e.g.
+    ``ctx_group`` placement hints) that execution never sees. The split
+    mirrors the reference's param-vs-attr distinction in nnvm nodes."""
+
+    __slots__ = ("op", "name", "inputs", "attrs", "annotations")
 
     def __init__(self, op: str, name: str,
                  inputs: Sequence[Tuple["_Node", int]] = (),
-                 attrs: Optional[dict] = None):
+                 attrs: Optional[dict] = None,
+                 annotations: Optional[dict] = None):
         self.op = op
         self.name = name
         self.inputs = list(inputs)
         self.attrs = dict(attrs or {})
+        self.annotations = dict(annotations or {})
 
     @property
     def is_variable(self) -> bool:
@@ -116,13 +123,30 @@ class Symbol:
         return self._heads[0][0]
 
     def attr(self, key: str):
-        return self._node.attrs.get(key)
+        node = self._node
+        if key in node.annotations:
+            return node.annotations[key]
+        return node.attrs.get(key)
 
     def list_attr(self) -> dict:
-        return dict(self._node.attrs)
+        node = self._node
+        merged = dict(node.attrs)
+        merged.update(node.annotations)
+        return merged
+
+    def attr_dict(self) -> dict:
+        """name -> merged attrs for every node (parity: attr_dict)."""
+        out = {}
+        for node in _topo(self._heads):
+            merged = dict(node.attrs)
+            merged.update(node.annotations)
+            if merged:
+                out[node.name] = {k: str(v) for k, v in merged.items()}
+        return out
 
     def _set_attr(self, **kwargs):
-        self._node.attrs.update(kwargs)
+        self._node.annotations.update(
+            {k: str(v) for k, v in kwargs.items()})
 
     # -- composition -------------------------------------------------- #
     def __getitem__(self, index):
@@ -260,13 +284,16 @@ class Symbol:
         node_id = {id(n): i for i, n in enumerate(nodes)}
         out_nodes = []
         for n in nodes:
-            out_nodes.append({
+            spec = {
                 "op": n.op,
                 "name": n.name,
                 "attrs": n.attrs,
                 "inputs": [[node_id[id(src)], idx, 0]
                            for src, idx in n.inputs],
-            })
+            }
+            if n.annotations:
+                spec["annotations"] = n.annotations
+            out_nodes.append(spec)
         payload = {
             "nodes": out_nodes,
             "arg_nodes": [i for i, n in enumerate(nodes) if n.is_variable],
@@ -363,7 +390,8 @@ def fromjson(text: str) -> Symbol:
     for spec in payload["nodes"]:
         attrs = spec.get("attrs") or spec.get("param") or {}
         inputs = [(nodes[i], idx) for i, idx, *_ in spec.get("inputs", [])]
-        nodes.append(_Node(spec["op"], spec["name"], inputs, attrs))
+        nodes.append(_Node(spec["op"], spec["name"], inputs, attrs,
+                           spec.get("annotations")))
     heads = [(nodes[i], idx) for i, idx, *_ in payload["heads"]]
     return Symbol(heads)
 

@@ -431,3 +431,15 @@ def test_sldwin_backward_with_tensor_dilation():
         out = ctx.sum()
     out.backward()
     assert float(np.abs(q.grad.asnumpy()).sum()) > 0
+
+
+def test_random_distribution_additions():
+    mx.random.seed(0)
+    a = nd.random.laplace(0.0, 2.0, shape=(20000,)).asnumpy()
+    assert abs(np.median(a)) < 0.1 and 2.5 < a.std() < 3.2  # std=sqrt(2)*b
+    assert nd.random.randn(3, 4).shape == (3, 4)
+    nb = nd.random.negative_binomial(k=5, p=0.5, shape=(20000,)).asnumpy()
+    assert 4.6 < nb.mean() < 5.4          # mean = k(1-p)/p
+    g = nd.random.generalized_negative_binomial(
+        mu=3.0, alpha=0.2, shape=(20000,)).asnumpy()
+    assert 2.7 < g.mean() < 3.3 and 4.0 < g.var() < 5.8  # var=mu+a*mu^2

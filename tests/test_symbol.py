@@ -209,3 +209,51 @@ def test_scalar_sugar_ops():
     expr = 1.0 - s
     (v,) = expr.eval(s=x)
     assert np.allclose(v.asnumpy(), [0.0, -1.0])
+
+
+def test_name_manager_attr_scope_and_viz():
+    import incubator_mxnet_tpu as mx
+
+    with mx.name.Prefix("stage1_"):
+        a = mx.sym.Variable("data")
+        b = mx.sym.relu(a)
+    assert b.name.startswith("stage1_")
+
+    with mx.AttrScope(ctx_group="dev1"):
+        c = mx.sym.relu(a)
+    assert c.attr("ctx_group") == "dev1"
+    # annotations are metadata, NOT op kwargs: the graph still executes
+    import numpy as np
+    from incubator_mxnet_tpu import nd
+    out_val = c.eval(data=nd.array(np.array([-1.0, 2.0], np.float32)))
+    if isinstance(out_val, (list, tuple)):
+        out_val = out_val[0]
+    np.testing.assert_allclose(out_val.asnumpy(), [0.0, 2.0])
+    with mx.AttrScope(g="1"):
+        with mx.AttrScope(g="2"):
+            d = mx.sym.relu(a)
+    assert d.attr("g") == "2"
+    # annotations round-trip through tojson/fromjson
+    d2 = mx.sym.fromjson(d.tojson())
+    assert d2.attr("g") == "2"
+    # _set_attr updates annotations; attr_dict merges them
+    d2._set_attr(stage="3")
+    assert d2.attr("stage") == "3"
+    assert d2.attr_dict()[d2.name]["g"] == "2"
+
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, mx.sym.Variable("w1"),
+                                mx.sym.Variable("b1"), num_hidden=16,
+                                name="fc1")
+    act = mx.sym.relu(fc1, name="act1")
+    out = mx.sym.FullyConnected(act, mx.sym.Variable("w2"),
+                                mx.sym.Variable("b2"), num_hidden=3,
+                                name="fc2")
+    total = mx.viz.print_summary(out, shape={"data": (1, 8)})
+    assert total == (16 * 8 + 16) + (3 * 16 + 3)
+    # plot_network: graphviz digraph when available, gated error otherwise
+    try:
+        g = mx.viz.plot_network(out)
+        assert hasattr(g, "source")
+    except mx.MXNetError as err:
+        assert "graphviz" in str(err)
