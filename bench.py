@@ -82,10 +82,11 @@ def _run_bert(on_tpu):
         steps, warmup = 3, 1
         flash = False
     remat = os.environ.get("MXTPU_BENCH_REMAT", "0") == "1"
+    dropout = float(os.environ.get("MXTPU_BENCH_DROPOUT", "0.1"))
 
     mx.random.seed(0)
     model = bert_mod.bert_base(dtype=dtype, max_length=T, flash=flash,
-                               remat=remat)
+                               remat=remat, dropout=dropout)
     model.initialize()
     pre = bert_mod.BERTForPretraining(model)
     pre.initialize()

@@ -16,20 +16,20 @@ __all__ = ["NameManager", "Prefix", "current"]
 
 class NameManager:
     """Scope-based name generator. ``get(name, hint)`` returns ``name`` if
-    given, else ``hint`` + a per-hint counter."""
+    given, else ``hint`` + a counter. The counter table is SHARED with the
+    symbolic front end's auto-namer, so names minted inside and outside a
+    manager scope never collide within one process/graph."""
 
     _current: threading.local = threading.local()
 
     def __init__(self):
-        self._counter: Dict[str, int] = {}
         self._old_manager: Optional["NameManager"] = None
 
     def get(self, name: Optional[str], hint: str) -> str:
         if name:
             return name
-        idx = self._counter.get(hint, 0)
-        self._counter[hint] = idx + 1
-        return f"{hint}{idx}"
+        from .symbol.symbol import _auto_name
+        return _auto_name(hint)
 
     def __enter__(self) -> "NameManager":
         self._old_manager = getattr(NameManager._current, "value", None)

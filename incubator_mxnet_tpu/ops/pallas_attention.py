@@ -40,6 +40,26 @@ from jax import lax
 _NEG_INF = -1e30
 
 
+def _env_block(name, default):
+    """Kernel tile-size knob (MXTPU_FLASH_BLOCK_Q / _K). Resolved in the
+    NON-jitted wrappers so the concrete value becomes part of the jit
+    cache key — changing the env between calls recompiles instead of
+    silently reusing the old tile size."""
+    import os
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _resolve_blocks(block_q, block_k):
+    if block_q is None:
+        block_q = _env_block("MXTPU_FLASH_BLOCK_Q", 128)
+    if block_k is None:
+        block_k = _env_block("MXTPU_FLASH_BLOCK_K", 128)
+    return block_q, block_k
+
+
 def _pallas_available():
     try:
         from jax.experimental import pallas  # noqa: F401
@@ -104,7 +124,7 @@ def _pad_to(x, axis, multiple):
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
                                              "block_k", "interpret"))
 def _flash_fwd_lse(q, k, v, valid_len, causal=False, scale=None,
-                   block_q=128, block_k=128, interpret=False):
+                   block_q=None, block_k=None, interpret=False):
     """q/k/v: (B, H, T, D). Returns (out, lse) with lse (B, H, Tq)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -112,8 +132,8 @@ def _flash_fwd_lse(q, k, v, valid_len, causal=False, scale=None,
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     scale = D ** -0.5 if scale is None else scale
-    block_q = min(block_q, max(Tq, 8))
-    block_k = min(block_k, max(Tk, 8))
+    block_q = min(block_q or 128, max(Tq, 8))
+    block_k = min(block_k or 128, max(Tk, 8))
     q, _ = _pad_to(q, 2, block_q)
     k, _ = _pad_to(k, 2, block_k)
     v, _ = _pad_to(v, 2, block_k)
@@ -151,8 +171,9 @@ def _flash_fwd_lse(q, k, v, valid_len, causal=False, scale=None,
 
 
 def _flash_forward(q, k, v, valid_len, causal=False, scale=None,
-                   block_q=128, block_k=128, interpret=False):
+                   block_q=None, block_k=None, interpret=False):
     """Forward-only entry (kept for tests / direct use)."""
+    block_q, block_k = _resolve_blocks(block_q, block_k)
     return _flash_fwd_lse(q, k, v, valid_len, causal=causal, scale=scale,
                           block_q=block_q, block_k=block_k,
                           interpret=interpret)[0]
@@ -238,7 +259,8 @@ def _flash_bwd_dkv_kernel(vl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
                                              "block_k", "interpret"))
 def _flash_backward(q, k, v, valid_len, out, lse, g, causal=False,
-                    scale=None, block_q=128, block_k=128, interpret=False):
+                    scale=None, block_q=None, block_k=None,
+                    interpret=False):
     """Pallas backward: returns (dq, dk, dv). Shapes as forward."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -246,8 +268,8 @@ def _flash_backward(q, k, v, valid_len, out, lse, g, causal=False,
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     scale = D ** -0.5 if scale is None else scale
-    block_q = min(block_q, max(Tq, 8))
-    block_k = min(block_k, max(Tk, 8))
+    block_q = min(block_q or 128, max(Tq, 8))
+    block_k = min(block_k or 128, max(Tk, 8))
 
     # Δ = rowsum(dO ⊙ O): cheap elementwise+reduce, XLA fuses it
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
@@ -346,16 +368,20 @@ def flash_attention_bhtd(q, k, v, valid_len, causal=False, scale=None,
 
 
 def _fwd(q, k, v, valid_len, causal, scale, interpret):
+    block_q, block_k = _resolve_blocks(None, None)
     out, lse = _flash_fwd_lse(q, k, v, valid_len, causal=causal,
-                              scale=scale, interpret=interpret)
+                              scale=scale, block_q=block_q,
+                              block_k=block_k, interpret=interpret)
     return out, (q, k, v, valid_len, out, lse)
 
 
 def _bwd(causal, scale, interpret, res, g):
     q, k, v, valid_len, out, lse = res
     if _pallas_available():
+        block_q, block_k = _resolve_blocks(None, None)
         dq, dk, dv = _flash_backward(q, k, v, valid_len, out, lse, g,
                                      causal=causal, scale=scale,
+                                     block_q=block_q, block_k=block_k,
                                      interpret=interpret)
         return dq, dk, dv, None
     _, vjp = jax.vjp(

@@ -104,7 +104,8 @@ def rewrite(sym: Symbol, fn: Callable[["_Node", List[Tuple[_Node, int]]],
             multi_out[id(node)] = node.num_outputs() > 1
         elif isinstance(out, tuple) and len(out) == 4:
             op, name, attrs, inputs = out
-            mapping[id(node)] = _Node(op, name, list(inputs), attrs)
+            mapping[id(node)] = _Node(op, name, list(inputs), attrs,
+                                      node.annotations)
         else:
             raise MXNetError(
                 "rewriter must return None, (node, idx), or "

@@ -369,7 +369,11 @@ def Variable(name: str, shape=None, dtype=None, init=None, **attrs) -> Symbol:
         node_attrs["__shape__"] = list(shape)
     if dtype is not None:
         node_attrs["__dtype__"] = str(dtype)
-    return Symbol([(_Node("null", name, (), node_attrs), 0)])
+    # scope attrs attach to variables too — the reference's primary
+    # AttrScope use (group2ctx placement of weights)
+    from ..attribute import current_attrs as _scope_attrs
+    return Symbol([(_Node("null", name, (), node_attrs,
+                          _scope_attrs() or None), 0)])
 
 
 var = Variable

@@ -56,6 +56,10 @@ def print_summary(symbol, shape: Optional[Dict[str, tuple]] = None,
     print_row(headers)
     print("=" * line_length)
 
+    # inputs = the user-provided shape keys (fall back to the "data"
+    # naming convention when no shapes are given); everything else that
+    # is a variable counts as a parameter
+    input_names = set(shape.keys()) if shape else {"data"}
     total_params = 0
     for node in _topo(symbol):
         if node.is_variable:
@@ -64,7 +68,7 @@ def print_summary(symbol, shape: Optional[Dict[str, tuple]] = None,
         n_params = 0
         prev = []
         for inp, _ in node.inputs:
-            if inp.is_variable and inp.name != "data":
+            if inp.is_variable and inp.name not in input_names:
                 sh = shapes_by_name.get(inp.name) or \
                     arg_shape_by_name.get(inp.name)
                 if sh:

@@ -1,0 +1,100 @@
+"""One-shot TPU perf sweep for the BERT flagship (run when the axon
+tunnel is up; each config is a fresh subprocess so a wedged compile can't
+sink the whole sweep).
+
+Writes one JSON line per config to ``--out`` (default
+/root/repo/perf_sweep.jsonl) and prints a ranked table at the end.
+
+Configs swept (beyond the bench default B=48):
+  - batch size ladder
+  - rbg PRNG (hardware RNG for the 37 dropout masks/step vs threefry)
+  - dropout off (isolates RNG + mask cost)
+  - flash block sizes via MXTPU_FLASH_BLOCK_Q/K
+  - remat on the larger batches (fit vs recompute trade)
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CONFIGS = [
+    {"name": "b48-base", "env": {"MXTPU_BENCH_BATCH": "48"}},
+    {"name": "b48-rbg", "env": {"MXTPU_BENCH_BATCH": "48",
+                                "JAX_DEFAULT_PRNG_IMPL": "rbg"}},
+    {"name": "b48-nodrop", "env": {"MXTPU_BENCH_BATCH": "48",
+                                   "MXTPU_BENCH_DROPOUT": "0"}},
+    {"name": "b48-bq256", "env": {"MXTPU_BENCH_BATCH": "48",
+                                  "MXTPU_FLASH_BLOCK_Q": "256"}},
+    {"name": "b48-bk256", "env": {"MXTPU_BENCH_BATCH": "48",
+                                  "MXTPU_FLASH_BLOCK_K": "256"}},
+    {"name": "b48-bq256-bk256", "env": {"MXTPU_BENCH_BATCH": "48",
+                                        "MXTPU_FLASH_BLOCK_Q": "256",
+                                        "MXTPU_FLASH_BLOCK_K": "256"}},
+    {"name": "b56", "env": {"MXTPU_BENCH_BATCH": "56"}},
+    {"name": "b64-remat", "env": {"MXTPU_BENCH_BATCH": "64",
+                                  "MXTPU_BENCH_REMAT": "1"}},
+    {"name": "b48-rbg-nodrop", "env": {"MXTPU_BENCH_BATCH": "48",
+                                       "JAX_DEFAULT_PRNG_IMPL": "rbg",
+                                       "MXTPU_BENCH_DROPOUT": "0"}},
+]
+
+
+def run_one(cfg, timeout):
+    env = dict(os.environ)
+    env.update(cfg["env"])
+    t0 = time.time()
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"), "--run",
+             "--workload", "bert"],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return {"name": cfg["name"], "error": f"timeout {timeout}s"}
+    for line in reversed(r.stdout.splitlines()):
+        if line.startswith("BENCH_RESULT "):
+            out = json.loads(line[len("BENCH_RESULT "):])
+            out["name"] = cfg["name"]
+            out["wall_s"] = round(time.time() - t0, 1)
+            return out
+    tail = (r.stderr or r.stdout or "").strip().splitlines()[-4:]
+    return {"name": cfg["name"], "error": " | ".join(tail)[:300]}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(REPO, "perf_sweep.jsonl"))
+    ap.add_argument("--timeout", type=int, default=900)
+    ap.add_argument("--only", default=None,
+                    help="comma-separated config names")
+    args = ap.parse_args()
+
+    picks = CONFIGS
+    if args.only:
+        names = set(args.only.split(","))
+        picks = [c for c in CONFIGS if c["name"] in names]
+
+    results = []
+    with open(args.out, "a") as f:
+        for cfg in picks:
+            res = run_one(cfg, args.timeout)
+            results.append(res)
+            f.write(json.dumps(res) + "\n")
+            f.flush()
+            print(json.dumps(res), flush=True)
+
+    ok = [r for r in results if "value" in r]
+    ok.sort(key=lambda r: -r["value"])
+    print("\n=== ranked ===")
+    for r in ok:
+        print(f"{r['name']:>18}: {r['value']:>10,.0f} tok/s/chip "
+              f"mfu={r.get('mfu', 0):.3f}")
+
+
+if __name__ == "__main__":
+    main()
