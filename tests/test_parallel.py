@@ -418,3 +418,56 @@ def test_ring_flash_attention_matches_dense():
     g_ref = jax.grad(lambda q: dense(q, k, v, True).sum())(q)
     np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
                                rtol=5e-4, atol=5e-5)
+
+
+def test_sync_batch_norm_global_stats_under_spmd():
+    """SyncBatchNorm's TPU contract: with the batch sharded over an 8-way
+    dp mesh, batch statistics must equal the FULL-batch oracle (the
+    reference's cross-worker all-reduce of stats), not per-shard stats -
+    i.e. the SPMD trajectory matches the single-device trajectory even
+    though each device only sees 1/8 of the batch."""
+    from incubator_mxnet_tpu.gluon.contrib import nn as gcn
+
+    def make_net():
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Conv2D(4, kernel_size=3, padding=1, in_channels=2),
+                gcn.SyncBatchNorm(in_channels=4, num_devices=8),
+                gluon.nn.Activation("relu"),
+                gluon.nn.GlobalAvgPool2D(),
+                gluon.nn.Dense(3, in_units=4))
+        net.initialize()
+        return net
+
+    rng = np.random.RandomState(0)
+    # per-sample values vary wildly so per-shard stats differ sharply
+    # from global stats - a per-shard BN would diverge immediately
+    X = (rng.randn(32, 2, 6, 6) * np.linspace(
+        0.1, 10, 32).reshape(32, 1, 1, 1)).astype("float32")
+    y = rng.randint(0, 3, size=(32,))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    mx.random.seed(5)
+    net_a = make_net()
+    tr_a = gluon.Trainer(net_a.collect_params(), "sgd",
+                         {"learning_rate": 0.05}, kvstore=None)
+    for _ in range(3):
+        with autograd.record():
+            L = loss_fn(net_a(nd.array(X)), nd.array(y)).mean()
+        L.backward()
+        tr_a.step(batch_size=1)
+
+    mx.random.seed(5)
+    net_b = make_net()
+    mesh = pmesh.build_mesh(axis_sizes={"dp": 8})
+    tr_b = parallel.SPMDTrainer(net_b, loss=loss_fn, optimizer="sgd",
+                                optimizer_params={"learning_rate": 0.05},
+                                mesh=mesh)
+    for _ in range(3):
+        tr_b.step(nd.array(X), nd.array(y))
+
+    for (na, pa), (nb, pb) in zip(
+            sorted(net_a.collect_params().items()),
+            sorted(net_b.collect_params().items())):
+        np.testing.assert_allclose(pa.data().asnumpy(), pb.data().asnumpy(),
+                                   rtol=5e-4, atol=5e-5,
+                                   err_msg=f"{na} vs {nb}")
