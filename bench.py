@@ -70,8 +70,12 @@ def _run_bert(on_tpu):
     from incubator_mxnet_tpu import nd, parallel
     from incubator_mxnet_tpu.models import bert as bert_mod
 
+    size = os.environ.get("MXTPU_BENCH_MODEL", "base")
+    if size not in ("base", "large"):
+        raise ValueError(f"MXTPU_BENCH_MODEL must be base|large, got {size!r}")
     if on_tpu:
-        B = int(os.environ.get("MXTPU_BENCH_BATCH", "48"))
+        default_b = "16" if size == "large" else "48"
+        B = int(os.environ.get("MXTPU_BENCH_BATCH", default_b))
         T, M = 512, 76
         dtype = "bfloat16"
         steps, warmup = 10, 3
@@ -85,8 +89,9 @@ def _run_bert(on_tpu):
     dropout = float(os.environ.get("MXTPU_BENCH_DROPOUT", "0.1"))
 
     mx.random.seed(0)
-    model = bert_mod.bert_base(dtype=dtype, max_length=T, flash=flash,
-                               remat=remat, dropout=dropout)
+    ctor = bert_mod.bert_large if size == "large" else bert_mod.bert_base
+    model = ctor(dtype=dtype, max_length=T, flash=flash,
+                 remat=remat, dropout=dropout)
     model.initialize()
     pre = bert_mod.BERTForPretraining(model)
     pre.initialize()
@@ -137,7 +142,7 @@ def _run_bert(on_tpu):
     mfu = (flops_per_step * steps / dt) / (_peak_flops_per_chip() * n_chips)
 
     return {
-        "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
+        "metric": f"bert_{size}_pretrain_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec_chip, 2),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.45, 4),
@@ -284,8 +289,9 @@ def main():
 
     result, errors = _measure("bert")
     if result is None:
+        size = os.environ.get("MXTPU_BENCH_MODEL", "base")
         result = {
-            "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
+            "metric": f"bert_{size}_pretrain_tokens_per_sec_per_chip",
             "value": 0.0,
             "unit": "tokens/s/chip",
             "vs_baseline": 0.0,

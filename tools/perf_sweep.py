@@ -41,6 +41,11 @@ CONFIGS = [
     {"name": "b48-rbg-nodrop", "env": {"MXTPU_BENCH_BATCH": "48",
                                        "JAX_DEFAULT_PRNG_IMPL": "rbg",
                                        "MXTPU_BENCH_DROPOUT": "0"}},
+    {"name": "large-b16", "env": {"MXTPU_BENCH_MODEL": "large",
+                                  "MXTPU_BENCH_BATCH": "16"}},
+    {"name": "large-b16-remat", "env": {"MXTPU_BENCH_MODEL": "large",
+                                        "MXTPU_BENCH_BATCH": "16",
+                                        "MXTPU_BENCH_REMAT": "1"}},
 ]
 
 
@@ -89,10 +94,12 @@ def main():
             print(json.dumps(res), flush=True)
 
     ok = [r for r in results if "value" in r]
-    ok.sort(key=lambda r: -r["value"])
-    print("\n=== ranked ===")
+    # rank by MFU within each metric group: raw tokens/s is apples-to-
+    # oranges across model sizes (bert-large does ~3x the FLOPs/token)
+    ok.sort(key=lambda r: (r.get("metric", ""), -r.get("mfu", 0)))
+    print("\n=== ranked (by MFU within each metric) ===")
     for r in ok:
-        print(f"{r['name']:>18}: {r['value']:>10,.0f} tok/s/chip "
+        print(f"{r['name']:>18}: {r['value']:>10,.0f} {r.get('unit', '')} "
               f"mfu={r.get('mfu', 0):.3f}")
 
 
