@@ -2,8 +2,10 @@
 `python/mxnet/gluon/data/vision/` — SURVEY.md §2.2)."""
 
 from . import datasets
-from .datasets import MNIST, FashionMNIST, CIFAR10, ImageFolderDataset
+from .datasets import (MNIST, FashionMNIST, CIFAR10, CIFAR100,
+                       ImageFolderDataset, ImageRecordDataset)
 from . import transforms
 
-__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "ImageFolderDataset",
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageFolderDataset", "ImageRecordDataset",
            "transforms"]

@@ -18,9 +18,11 @@ import struct
 import numpy as np
 
 from ....base import MXNetError
+from .. import dataset
 from ..dataset import Dataset
 
-__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "ImageFolderDataset"]
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageFolderDataset", "ImageRecordDataset"]
 
 
 def _synthetic_images(n, shape, classes, seed):
@@ -177,6 +179,56 @@ class ImageFolderDataset(Dataset):
         path, label = self.items[idx]
         from ....image import imread
         img = imread(path, self._flag)
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class CIFAR100(_DownloadedDataset):
+    """CIFAR-100 from the python pickle batches (parity:
+    gluon.data.vision.CIFAR100). ``fine_label`` selects the 100-way
+    fine labels (True, default) or the 20 coarse superclasses."""
+
+    def __init__(self, root="~/.mxnet/datasets/cifar100", train=True,
+                 transform=None, fine_label=True, synthetic=False,
+                 synthetic_size=6000):
+        self._fine = fine_label
+        super().__init__(root, train, transform, synthetic, synthetic_size,
+                         (32, 32, 3), 100 if fine_label else 20, seed=9)
+
+    def _files_exist(self):
+        base = os.path.join(self._root, "cifar-100-python")
+        fname = "train" if self._train else "test"
+        return os.path.exists(os.path.join(base, fname))
+
+    def _get_data(self):
+        base = os.path.join(self._root, "cifar-100-python")
+        fname = "train" if self._train else "test"
+        with open(os.path.join(base, fname), "rb") as f:
+            batch = pickle.load(f, encoding="latin1")
+        arr = np.asarray(batch["data"]).reshape(-1, 3, 32, 32)
+        self._data = arr.transpose(0, 2, 3, 1).astype(np.uint8)
+        key = "fine_labels" if self._fine else "coarse_labels"
+        self._label = np.asarray(batch[key], np.int32)
+
+
+class ImageRecordDataset(dataset.RecordFileDataset):
+    """Image + label dataset over an im2rec-packed RecordIO file
+    (parity: gluon.data.vision.ImageRecordDataset). Each record is an
+    IRHeader-packed (label, image-bytes) pair from tools/im2rec.py."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from ....io.recordio import unpack
+        from ....image import imdecode
+        record = super().__getitem__(idx)
+        header, img_bytes = unpack(record)
+        img = imdecode(img_bytes, flag=self._flag)
+        label = header.label
         if self._transform is not None:
             return self._transform(img, label)
         return img, label

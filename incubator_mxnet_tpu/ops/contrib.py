@@ -751,3 +751,123 @@ def modulated_deformable_convolution(data, offset, mask, weight, bias=None,
                              None if no_bias else bias, kernel, stride,
                              dilate, pad, num_filter, num_group,
                              num_deformable_group, mask=mask)
+
+
+# --------------------------------------------------------------------- #
+# round-3 contrib batch 2 (reference: src/operator/contrib/
+# {count_sketch.cc,hawkes_ll.cc,mrcnn_mask_target.cu} — file-level
+# citations, SURVEY.md caveat)
+# --------------------------------------------------------------------- #
+
+@register("count_sketch", aliases=("_contrib_count_sketch",))
+def count_sketch(data, h, s, out_dim=0, processing_batch_size=32):
+    """Count-sketch projection (compact bilinear pooling building block).
+
+    data (B, D) is scattered into (B, out_dim): out[b, h[d]] += s[d] *
+    data[b, d]. ``h``/``s`` are the (D,) bucket indices / ±1 signs. One
+    segment-sum scatter-add on TPU (no atomics, unlike the reference's
+    CUDA kernel)."""
+    out_dim = int(out_dim)
+    if out_dim <= 0:
+        from ..base import MXNetError
+        raise MXNetError("count_sketch requires out_dim > 0")
+    hh = h.reshape(-1).astype(jnp.int32)
+    ss = s.reshape(-1).astype(data.dtype)
+    signed = data * ss[None, :]
+    out = jnp.zeros(data.shape[:-1] + (out_dim,), data.dtype)
+    return out.at[..., hh].add(signed)
+
+
+@register("hawkes_ll", aliases=("_contrib_hawkes_ll",), num_outputs=2)
+def hawkes_ll(lda, alpha, beta, state, lags, marks, valid_length,
+              max_time):
+    """Log-likelihood of a marked multivariate Hawkes process with
+    exponential kernel (reference hawkes_ll.cc).
+
+    lda (K,)/alpha (K,)/beta (K,): per-mark background rate, excitation
+    and decay; state (B, K): kernel state at the interval start;
+    lags/marks (B, T): inter-arrival times and mark ids; valid_length
+    (B,): events per sequence; max_time: observation horizon. Returns
+    (loglik (B,), new_state (B, K)). A lax.scan over the T axis — the
+    recurrence is sequential by definition."""
+    B, T = lags.shape
+    K = lda.shape[0]
+    lda_ = lda.reshape(1, K)
+    alpha_ = alpha.reshape(1, K)
+    beta_ = beta.reshape(1, K)
+    marks_i = marks.astype(jnp.int32)
+    vl = valid_length.astype(jnp.int32)
+
+    def step(carry, t):
+        ll, st, elapsed = carry
+        lag_t = lags[:, t].reshape(B, 1)
+        mark_t = marks_i[:, t]
+        valid = (t < vl).reshape(B)
+        decay = jnp.exp(-beta_ * lag_t)
+        st_dec = st * decay
+        intensity = lda_ + st_dec                     # (B, K)
+        lam = jnp.take_along_axis(intensity, mark_t[:, None], axis=1)[:, 0]
+        # compensator increment over this interval, all marks
+        comp = jnp.sum(lda_ * lag_t + (st / beta_) * (1.0 - decay), axis=1)
+        contrib_ll = jnp.log(jnp.maximum(lam, 1e-30)) - comp
+        ll = ll + jnp.where(valid, contrib_ll, 0.0)
+        add = jnp.zeros((B, K), st.dtype).at[
+            jnp.arange(B), mark_t].set(alpha_[0, mark_t] * beta_[0, mark_t])
+        st = jnp.where(valid.reshape(B, 1), st_dec + add, st)
+        elapsed = elapsed + jnp.where(valid, lag_t[:, 0], 0.0)
+        return (ll, st, elapsed), None
+
+    init = (jnp.zeros((B,), jnp.float32),
+            state.astype(jnp.float32),
+            jnp.zeros((B,), jnp.float32))
+    (ll, st, elapsed), _ = lax.scan(step, init, jnp.arange(T))
+    # tail compensator from the last event to max_time
+    rem = jnp.maximum(max_time - elapsed, 0.0).reshape(B, 1)
+    decay = jnp.exp(-beta_ * rem)
+    tail = jnp.sum(lda_ * rem + (st / beta_) * (1.0 - decay), axis=1)
+    ll = ll - tail
+    st = st * decay
+    return ll, st
+
+
+@register("mrcnn_mask_target", aliases=("_contrib_mrcnn_mask_target",),
+          num_outputs=2)
+def mrcnn_mask_target(rois, gt_masks, matches, cls_targets,
+                      num_rois=None, num_classes=None, mask_size=(14, 14)):
+    """Mask-RCNN training targets (reference mrcnn_mask_target.cu):
+    crop each matched instance mask to its ROI and resize to
+    ``mask_size``; returns (mask_targets (B, N, C, H, W), mask_cls
+    (B, N, C, H, W) one-hot weights). rois (B, N, 4) corner; gt_masks
+    (B, M, IH, IW); matches (B, N); cls_targets (B, N)."""
+    from .vision import _grid_sample_zero_pad
+    B, N = matches.shape[:2]
+    M, IH, IW = gt_masks.shape[1:]
+    mh, mw = (mask_size, mask_size) if isinstance(mask_size, int) \
+        else tuple(mask_size)
+    if not num_classes:
+        from ..base import MXNetError
+        raise MXNetError("mrcnn_mask_target requires num_classes (the "
+                         "class count cannot be derived from a traced "
+                         "cls_targets array)")
+    C = int(num_classes)
+
+    def per_image(roi, gmask, match, cls_t):
+        picked = gmask[match.astype(jnp.int32)]          # (N, IH, IW)
+
+        def crop(m, box):
+            x1, y1, x2, y2 = box[0], box[1], box[2], box[3]
+            ys = y1 + (y2 - y1) * (jnp.arange(mh) + 0.5) / mh
+            xs = x1 + (x2 - x1) * (jnp.arange(mw) + 0.5) / mw
+            grid_y = jnp.broadcast_to(ys[:, None], (mh, mw))
+            grid_x = jnp.broadcast_to(xs[None, :], (mh, mw))
+            return _grid_sample_zero_pad(m[None], grid_y, grid_x)[0]
+
+        cropped = jax.vmap(crop)(picked, roi)            # (N, mh, mw)
+        onehot = jax.nn.one_hot(cls_t.astype(jnp.int32), C,
+                                dtype=cropped.dtype)     # (N, C)
+        targets = cropped[:, None] * onehot[..., None, None]
+        weights = jnp.broadcast_to(onehot[..., None, None],
+                                   (N, C, mh, mw))
+        return targets, weights
+
+    return jax.vmap(per_image)(rois, gt_masks, matches, cls_targets)

@@ -271,3 +271,47 @@ def test_faster_rcnn_forward_and_grad():
     tr.step(1)
     g = net.backbone.body[0].weight.grad()
     assert np.abs(g.asnumpy()).sum() > 0
+
+
+def test_count_sketch_hawkes_mrcnn_mask_target():
+    import numpy as np
+    from incubator_mxnet_tpu import nd
+
+    rng = np.random.RandomState(0)
+    D, O = 16, 8
+    x = rng.randn(2, D).astype(np.float32)
+    h = rng.randint(0, O, D).astype(np.float32)
+    s = rng.choice([-1.0, 1.0], D).astype(np.float32)
+    out = nd.contrib.count_sketch(nd.array(x), nd.array(h), nd.array(s),
+                                  out_dim=O).asnumpy()
+    want = np.zeros((2, O), np.float32)
+    for d in range(D):
+        want[:, int(h[d])] += s[d] * x[:, d]
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+    # Hawkes: empty sequence -> ll = -lda * T_horizon
+    ll, _ = nd.contrib.hawkes_ll(
+        nd.array([0.5]), nd.array([0.2]), nd.array([1.0]),
+        nd.zeros((1, 1)), nd.zeros((1, 3)), nd.zeros((1, 3)),
+        nd.array([0]), 4.0)
+    np.testing.assert_allclose(ll.asnumpy(), [-2.0], rtol=1e-5)
+    # one event at t=1 with exp-kernel tail compensator
+    ll1, _ = nd.contrib.hawkes_ll(
+        nd.array([0.5]), nd.array([0.2]), nd.array([1.0]),
+        nd.zeros((1, 1)), nd.array([[1.0]]), nd.array([[0.0]]),
+        nd.array([1]), 4.0)
+    want1 = np.log(0.5) - 0.5 - (1.5 + 0.2 * (1 - np.exp(-3.0)))
+    np.testing.assert_allclose(ll1.asnumpy(), [want1], rtol=1e-5)
+
+    B, N, M = 1, 2, 2
+    rois = np.array([[[0, 0, 7, 7], [2, 2, 6, 6]]], np.float32)
+    gmasks = np.zeros((B, M, 8, 8), np.float32)
+    gmasks[0, 0, :4] = 1.0
+    matches = np.array([[0, 1]], np.float32)
+    cls_t = np.array([[1, 2]], np.float32)
+    t, w = nd.contrib.mrcnn_mask_target(
+        nd.array(rois), nd.array(gmasks), nd.array(matches),
+        nd.array(cls_t), num_classes=3, mask_size=(4, 4))
+    assert t.shape == (1, 2, 3, 4, 4) and w.shape == (1, 2, 3, 4, 4)
+    wn = w.asnumpy()
+    assert wn[0, 0, 1].min() == 1.0 and wn[0, 0, 0].max() == 0.0

@@ -236,3 +236,36 @@ def test_recordio_pickle_closed_reader(tmp_path):
     r.close()
     r2 = pickle.loads(pickle.dumps(r))
     assert r2.read() == b"hello"
+
+
+def test_cifar100_and_image_record_dataset(tmp_path):
+    from incubator_mxnet_tpu.gluon.data import vision
+    from incubator_mxnet_tpu.io import recordio
+
+    d = vision.CIFAR100(synthetic=True, synthetic_size=64)
+    x, y = d[3]
+    assert x.shape == (32, 32, 3) and 0 <= int(y) < 100 and len(d) == 64
+
+    # build a tiny im2rec-style .rec/.idx with NPY0-raw images
+    rec = str(tmp_path / "toy.rec")
+    idx = str(tmp_path / "toy.idx")
+    w = recordio.IndexedRecordIO(idx, rec, "w")
+    rng = np.random.RandomState(0)
+    imgs = []
+    for i in range(5):
+        img = (rng.rand(8, 8, 3) * 255).astype(np.uint8)
+        imgs.append(img)
+        # NPY0 codec (image.decode_to_numpy): magic + np.save payload
+        import io as _io
+        bio = _io.BytesIO()
+        np.save(bio, img)
+        payload = b"NPY0" + bio.getvalue()
+        hdr = recordio.IRHeader(0, float(i), i, 0)
+        w.write_idx(i, recordio.pack(hdr, payload))
+    w.close()
+
+    ds = vision.ImageRecordDataset(rec)
+    assert len(ds) == 5
+    img, label = ds[2]
+    assert int(label) == 2
+    np.testing.assert_array_equal(np.asarray(img), imgs[2])
