@@ -18,8 +18,7 @@ import struct
 import numpy as np
 
 from ....base import MXNetError
-from .. import dataset
-from ..dataset import Dataset
+from ..dataset import Dataset, RecordFileDataset
 
 __all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
            "ImageFolderDataset", "ImageRecordDataset"]
@@ -212,7 +211,7 @@ class CIFAR100(_DownloadedDataset):
         self._label = np.asarray(batch[key], np.int32)
 
 
-class ImageRecordDataset(dataset.RecordFileDataset):
+class ImageRecordDataset(RecordFileDataset):
     """Image + label dataset over an im2rec-packed RecordIO file
     (parity: gluon.data.vision.ImageRecordDataset). Each record is an
     IRHeader-packed (label, image-bytes) pair from tools/im2rec.py."""

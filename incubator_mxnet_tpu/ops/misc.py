@@ -364,3 +364,41 @@ def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
     if repeat != 1:
         idx = jnp.floor(idx / repeat)  # each value repeats `repeat` times
     return start + step * idx
+
+
+@register("histogram", aliases=("_histogram",), num_outputs=2)
+def histogram(data, bins=10, range=None):
+    """(hist, bin_edges) over flattened data (reference:
+    src/operator/tensor/histogram.cc). ``bins`` int + optional range,
+    matching mx.nd.histogram's scalar form."""
+    lo, hi = (range if range is not None
+              else (jnp.min(data), jnp.max(data)))
+    # zero-width range expands by +/-0.5 (numpy / reference histogram.cc)
+    same = hi <= lo
+    lo = jnp.where(same, lo - 0.5, lo)
+    hi = jnp.where(same, hi + 0.5, hi)
+    edges = jnp.linspace(lo, hi, int(bins) + 1)
+    flat = data.reshape(-1)
+    # right-inclusive last bin, same as numpy/the reference
+    idx = jnp.clip(jnp.searchsorted(edges, flat, side="right") - 1,
+                   0, int(bins) - 1)
+    inside = (flat >= lo) & (flat <= hi)
+    hist = jnp.zeros((int(bins),), jnp.int32).at[idx].add(
+        inside.astype(jnp.int32))
+    return hist, edges
+
+
+@register("isnan", aliases=("_contrib_isnan",))
+def isnan_op(data):
+    """(reference: contrib isnan — elementwise NaN test)."""
+    return jnp.isnan(data)
+
+
+@register("isinf", aliases=("_contrib_isinf",))
+def isinf_op(data):
+    return jnp.isinf(data)
+
+
+@register("isfinite", aliases=("_contrib_isfinite",))
+def isfinite_op(data):
+    return jnp.isfinite(data)

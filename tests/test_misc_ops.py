@@ -443,3 +443,19 @@ def test_random_distribution_additions():
     g = nd.random.generalized_negative_binomial(
         mu=3.0, alpha=0.2, shape=(20000,)).asnumpy()
     assert 2.7 < g.mean() < 3.3 and 4.0 < g.var() < 5.8  # var=mu+a*mu^2
+
+
+def test_histogram_and_float_tests():
+    x = np.array([0.1, 0.4, 0.6, 0.9, 0.2], np.float32)
+    h, e = nd.histogram(nd.array(x), bins=2, range=(0.0, 1.0))
+    hn, en = np.histogram(x, bins=2, range=(0, 1))
+    np.testing.assert_array_equal(h.asnumpy(), hn)
+    np.testing.assert_allclose(e.asnumpy(), en)
+    # auto-range path
+    h2, _ = nd.histogram(nd.array(x), bins=4)
+    assert int(h2.asnumpy().sum()) == 5
+    y = nd.array(np.array([1.0, np.nan, np.inf], np.float32))
+    np.testing.assert_array_equal(nd.contrib.isnan(y).asnumpy(), [0, 1, 0])
+    np.testing.assert_array_equal(nd.contrib.isinf(y).asnumpy(), [0, 0, 1])
+    np.testing.assert_array_equal(nd.contrib.isfinite(y).asnumpy(),
+                                  [1, 0, 0])
