@@ -371,8 +371,14 @@ def histogram(data, bins=10, range=None):
     """(hist, bin_edges) over flattened data (reference:
     src/operator/tensor/histogram.cc). ``bins`` int + optional range,
     matching mx.nd.histogram's scalar form."""
-    lo, hi = (range if range is not None
-              else (jnp.min(data), jnp.max(data)))
+    if range is not None:
+        lo, hi = range
+        if hi < lo:
+            from ..base import MXNetError
+            raise MXNetError("histogram: max must be larger than min "
+                             f"(got range=({lo}, {hi}))")
+    else:
+        lo, hi = jnp.min(data), jnp.max(data)
     # zero-width range expands by +/-0.5 (numpy / reference histogram.cc)
     same = hi <= lo
     lo = jnp.where(same, lo - 0.5, lo)
@@ -390,15 +396,16 @@ def histogram(data, bins=10, range=None):
 
 @register("isnan", aliases=("_contrib_isnan",))
 def isnan_op(data):
-    """(reference: contrib isnan — elementwise NaN test)."""
-    return jnp.isnan(data)
+    """(reference: contrib isnan). 0/1 in the INPUT dtype (the
+    reference's convention; bool would break `1 - mask` arithmetic)."""
+    return jnp.isnan(data).astype(data.dtype)
 
 
 @register("isinf", aliases=("_contrib_isinf",))
 def isinf_op(data):
-    return jnp.isinf(data)
+    return jnp.isinf(data).astype(data.dtype)
 
 
 @register("isfinite", aliases=("_contrib_isfinite",))
 def isfinite_op(data):
-    return jnp.isfinite(data)
+    return jnp.isfinite(data).astype(data.dtype)

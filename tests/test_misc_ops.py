@@ -459,3 +459,13 @@ def test_histogram_and_float_tests():
     np.testing.assert_array_equal(nd.contrib.isinf(y).asnumpy(), [0, 0, 1])
     np.testing.assert_array_equal(nd.contrib.isfinite(y).asnumpy(),
                                   [1, 0, 0])
+
+
+def test_histogram_inverted_range_and_mask_dtype():
+    with pytest.raises(mx.MXNetError):
+        nd.histogram(nd.array(np.ones(3, np.float32)), bins=2,
+                     range=(2.0, 0.0))
+    y = nd.array(np.array([1.0, np.nan], np.float32))
+    m = nd.contrib.isnan(y)
+    assert str(m.dtype) in ("float32", "<dtype: 'float32'>"), m.dtype
+    np.testing.assert_allclose((1.0 - m).asnumpy(), [1.0, 0.0])
