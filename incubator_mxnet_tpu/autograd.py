@@ -269,21 +269,113 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             node.primals = []
 
 
+def _compose_pure(heads, variables):
+    """Replay the reachable tape into ONE pure function
+    variables -> heads (the reference's CreateGraph path builds the
+    backward as a symbolic graph; here the composite + ``jax.vjp`` is
+    that graph, and jax's vjp-of-vjp gives every higher order).
+
+    The replay is a SNAPSHOT: pure fns, primal values, and identity keys
+    are copied out of the tape, and the NDArray objects are pinned by
+    the closure — so a later ``backward(retain_graph=False)`` that
+    clears the shared tape nodes cannot corrupt this composite."""
+    order = _topo(heads)  # children before parents == forward order
+    for node in order:
+        if node.pure_fn is None:
+            raise MXNetError(
+                "create_graph=True is not supported through a custom "
+                "autograd.Function (its backward is opaque to replay)")
+
+    seeded = {id(v) for v in variables}
+    pins = list(variables) + list(heads)  # keep ids stable for closure
+    replay = []
+    for node in order:
+        pins.extend(node.outputs)
+        pins.extend(o for o in node.owners if o is not None)
+        replay.append((
+            node.pure_fn, list(node.primals),
+            [id(o) if o is not None else None for o in node.owners],
+            [id(o) for o in node.outputs]))
+    head_ids = [id(h) for h in heads]
+    head_vals = [h._data for h in heads]
+    seeded_order = [id(v) for v in variables]
+
+    def composite(*var_vals):
+        _pins = pins  # noqa: F841 — pin NDArray identities for env keys
+        env = dict(zip(seeded_order, var_vals))
+        for fn, primals, owner_ids, out_ids in replay:
+            prim = [env.get(oid, p) if oid is not None else p
+                    for oid, p in zip(owner_ids, primals)]
+            outs = fn(*prim)
+            outs_t = outs if isinstance(outs, (tuple, list)) else (outs,)
+            for oid, val in zip(out_ids, outs_t):
+                if oid not in seeded:
+                    # a seeded VARIABLE may itself be an intermediate
+                    # (grad of a non-leaf): its replayed producer must
+                    # not overwrite the vjp input, or the dependence is
+                    # severed and its gradient silently becomes zero
+                    env[oid] = val
+        return tuple(env.get(hid, hv)
+                     for hid, hv in zip(head_ids, head_vals))
+
+    return composite
+
+
+def _grad_create_graph(heads, variables, head_grads, train_mode):
+    """Higher-order path: grads come from ``jax.vjp`` of the replayed
+    composite, and the grad computation itself is RECORDED as a tape
+    node — so backward()/grad() on the result differentiates again."""
+    from .ndarray.ndarray import NDArray
+
+    if len(heads) != len(head_grads):
+        raise MXNetError("heads and head_grads length mismatch")
+    for h in heads:
+        if getattr(h, "_ag_node", None) is None and \
+                getattr(h, "_ag_grad", None) is None:
+            raise MXNetError(
+                "head array is neither recorded nor a marked variable; "
+                "did you forget autograd.record() or attach_grad()?")
+    seeds = []
+    for h, hg in zip(heads, head_grads):
+        if hg is None:
+            seeds.append(jnp.ones(h.shape, h.dtype))
+        else:
+            seeds.append(hg._data if hasattr(hg, "_data") else hg)
+    composite = _compose_pure(heads, variables)
+    seed_t = tuple(seeds)
+
+    def grad_fn(*var_vals):
+        _, vjp_fn = jax.vjp(composite, *var_vals)
+        return vjp_fn(seed_t)
+
+    var_vals = tuple(v._data for v in variables)
+    with _ModeScope(recording=False, training=train_mode):
+        grads = grad_fn(*var_vals)
+    outs = [NDArray(g) for g in grads]
+    _record_node(grad_fn, list(var_vals), list(variables), outs,
+                 name="grad", tuple_out=True)
+    return outs
+
+
 def grad(heads, variables, head_grads=None, retain_graph=None,
          create_graph=False, train_mode=True):
     """Return gradients of heads w.r.t. variables without touching their
-    ``.grad`` buffers (parity: ``mx.autograd.grad``)."""
+    ``.grad`` buffers (parity: ``mx.autograd.grad``; ``create_graph=True``
+    returns grads that are themselves differentiable)."""
     from .ndarray.ndarray import NDArray
 
-    if create_graph:
-        raise MXNetError(
-            "create_graph=True (higher-order imperative grad) is not "
-            "supported; hybridize the block and use jax.grad composition "
-            "for higher-order derivatives.")
     if isinstance(variables, NDArray):
         variables = [variables]
     if retain_graph is None:
         retain_graph = create_graph
+    if create_graph:
+        if isinstance(heads, NDArray):
+            heads = [heads]
+        if head_grads is None:
+            head_grads = [None] * len(heads)
+        elif isinstance(head_grads, NDArray):
+            head_grads = [head_grads]
+        return _grad_create_graph(heads, variables, head_grads, train_mode)
     # temporarily mark variables with fresh buffers
     saved = [(getattr(v, "_ag_grad", None), getattr(v, "_ag_grad_req", "write"))
              for v in variables]

@@ -262,3 +262,101 @@ def test_single_output_variadic_backward():
         loss = (part * 2).sum()
     loss.backward()
     assert np.allclose(x.grad.asnumpy(), 2 * np.ones((2, 2)))
+
+
+def test_higher_order_grad_create_graph():
+    """create_graph=True returns differentiable grads (reference:
+    autograd.grad CreateGraph path; upstream supported 2nd order for a
+    subset of ops — the tape-replay + vjp-of-vjp design gives any order)."""
+    # d2/dx2 x^3 = 6x
+    x = nd.array([2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x * x).sum()
+        g = autograd.grad(y, [x], create_graph=True)[0]
+        # first-order values available immediately
+        np.testing.assert_allclose(g.asnumpy(), [12.0, 27.0])
+        z = g.sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [12.0, 18.0])
+
+    # third order: d3/dx3 x^4 = 24x
+    x2 = nd.array([1.5])
+    x2.attach_grad()
+    with autograd.record():
+        y2 = (x2 * x2 * x2 * x2).sum()
+        g1 = autograd.grad(y2, [x2], create_graph=True)[0]
+        g2 = autograd.grad(g1.sum(), [x2], create_graph=True)[0]
+    g2.backward()
+    np.testing.assert_allclose(x2.grad.asnumpy(), [36.0])
+
+    # registry op (sigmoid): d2/dx2 sigma = s(1-s)(1-2s)
+    x3 = nd.array([0.3])
+    x3.attach_grad()
+    with autograd.record():
+        s = nd.sigmoid(x3).sum()
+        gs = autograd.grad(s, [x3], create_graph=True)[0]
+    gs.backward()
+    sv = 1 / (1 + np.exp(-0.3))
+    np.testing.assert_allclose(x3.grad.asnumpy(),
+                               [sv * (1 - sv) * (1 - 2 * sv)], rtol=1e-5)
+
+    # custom Function graphs are gated with a clear error
+    class MyF(autograd.Function):
+        def forward(self, a):
+            return a * 2
+        def backward(self, dy):
+            return dy * 2
+
+    xa = nd.array([1.0])
+    xa.attach_grad()
+    with autograd.record():
+        out = MyF()(xa).sum()
+        try:
+            autograd.grad(out, [xa], create_graph=True)
+            raised = False
+        except mx.MXNetError:
+            raised = True
+    assert raised
+
+
+def test_create_graph_nonleaf_and_robustness():
+    # grad w.r.t. a NON-LEAF intermediate: d/dy (y*y) = 2y with y = 2x
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = (y * y).sum()
+        gy = autograd.grad(z, [y], create_graph=True)[0]
+    np.testing.assert_allclose(gy.asnumpy(), [8.0])
+
+    # grad node survives a tape-clearing backward on the original head
+    x2 = nd.array([3.0])
+    x2.attach_grad()
+    with autograd.record():
+        y2 = (x2 * x2 * x2).sum()
+        g = autograd.grad(y2, [x2], create_graph=True)[0]
+        h = (g * g).sum()          # (3x^2)^2
+    y2b = None
+    h.backward()                   # d/dx 9x^4 = 36x^3
+    np.testing.assert_allclose(x2.grad.asnumpy(), [972.0])
+
+    # length-mismatch and unrecorded-head errors match first-order path
+    a = nd.array([1.0])
+    a.attach_grad()
+    with autograd.record():
+        out = (a * a).sum()
+        try:
+            autograd.grad([out], [a], head_grads=[None, None],
+                          create_graph=True)
+            raised = False
+        except mx.MXNetError:
+            raised = True
+        assert raised
+    b = nd.array([1.0]) * 2  # never recorded
+    try:
+        autograd.grad(b, [a], create_graph=True)
+        raised = False
+    except mx.MXNetError:
+        raised = True
+    assert raised
