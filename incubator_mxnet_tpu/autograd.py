@@ -286,12 +286,13 @@ def _compose_pure(heads, variables):
                 "create_graph=True is not supported through a custom "
                 "autograd.Function (its backward is opaque to replay)")
 
-    seeded = {id(v) for v in variables}
     pins = list(variables) + list(heads)  # keep ids stable for closure
     replay = []
+    produced = set()
     for node in order:
         pins.extend(node.outputs)
         pins.extend(o for o in node.owners if o is not None)
+        produced.update(id(o) for o in node.outputs)
         replay.append((
             node.pure_fn, list(node.primals),
             [id(o) if o is not None else None for o in node.owners],
@@ -302,19 +303,25 @@ def _compose_pure(heads, variables):
 
     def composite(*var_vals):
         _pins = pins  # noqa: F841 — pin NDArray identities for env keys
-        env = dict(zip(seeded_order, var_vals))
+        # leaf variables seed the env; variables that are themselves
+        # INTERMEDIATES (grad of a non-leaf) are instead INJECTED at
+        # their production site as `replayed + (v - stop_grad(v))`:
+        # value unchanged, d/dv is the identity (the ∂/∂v cotangent),
+        # and upstream paths THROUGH the variable stay connected — the
+        # same both-paths semantics as first-order backward()
+        env, inject = {}, {}
+        for vid, val in zip(seeded_order, var_vals):
+            (inject if vid in produced else env)[vid] = val
         for fn, primals, owner_ids, out_ids in replay:
             prim = [env.get(oid, p) if oid is not None else p
                     for oid, p in zip(owner_ids, primals)]
             outs = fn(*prim)
             outs_t = outs if isinstance(outs, (tuple, list)) else (outs,)
             for oid, val in zip(out_ids, outs_t):
-                if oid not in seeded:
-                    # a seeded VARIABLE may itself be an intermediate
-                    # (grad of a non-leaf): its replayed producer must
-                    # not overwrite the vjp input, or the dependence is
-                    # severed and its gradient silently becomes zero
-                    env[oid] = val
+                vv = inject.get(oid)
+                if vv is not None:
+                    val = val + (vv - jax.lax.stop_gradient(vv))
+                env[oid] = val
         return tuple(env.get(hid, hv)
                      for hid, hv in zip(head_ids, head_vals))
 
@@ -335,24 +342,38 @@ def _grad_create_graph(heads, variables, head_grads, train_mode):
             raise MXNetError(
                 "head array is neither recorded nor a marked variable; "
                 "did you forget autograd.record() or attach_grad()?")
-    seeds = []
-    for h, hg in zip(heads, head_grads):
+    # head_grads that are themselves recorded arrays become INPUTS of the
+    # recorded grad node (owners include them), so a later backward
+    # differentiates through the seed too instead of freezing it
+    const_seeds = {}
+    seed_inputs = []   # (position, NDArray)
+    for i, (h, hg) in enumerate(zip(heads, head_grads)):
         if hg is None:
-            seeds.append(jnp.ones(h.shape, h.dtype))
+            const_seeds[i] = jnp.ones(h.shape, h.dtype)
+        elif hasattr(hg, "_data"):
+            seed_inputs.append((i, hg))
         else:
-            seeds.append(hg._data if hasattr(hg, "_data") else hg)
+            const_seeds[i] = hg
     composite = _compose_pure(heads, variables)
-    seed_t = tuple(seeds)
+    n_vars = len(variables)
+    n_heads = len(heads)
 
-    def grad_fn(*var_vals):
+    def grad_fn(*vals):
+        var_vals, seed_vals = vals[:n_vars], vals[n_vars:]
+        seeds = list(range(n_heads))
+        it = iter(seed_vals)
+        for i in range(n_heads):
+            seeds[i] = const_seeds[i] if i in const_seeds else next(it)
         _, vjp_fn = jax.vjp(composite, *var_vals)
-        return vjp_fn(seed_t)
+        return vjp_fn(tuple(seeds))
 
-    var_vals = tuple(v._data for v in variables)
+    all_vals = tuple(v._data for v in variables) + \
+        tuple(hg._data for _, hg in seed_inputs)
+    all_owners = list(variables) + [hg for _, hg in seed_inputs]
     with _ModeScope(recording=False, training=train_mode):
-        grads = grad_fn(*var_vals)
+        grads = grad_fn(*all_vals)
     outs = [NDArray(g) for g in grads]
-    _record_node(grad_fn, list(var_vals), list(variables), outs,
+    _record_node(grad_fn, list(all_vals), all_owners, outs,
                  name="grad", tuple_out=True)
     return outs
 

@@ -51,7 +51,8 @@ def _stream_block(q, k, v, acc, row_max, row_sum, mask):
 
 
 def ring_attention_block(q, k, v, axis_name: str = "sp",
-                         causal: bool = False, scale: Optional[float] = None):
+                         causal: bool = False, scale: Optional[float] = None,
+                         *, vary_axes: tuple = ()):
     """Per-shard ring attention body (call inside ``shard_map``).
 
     q, k, v: local blocks (B, T_blk, H, D); the global sequence is the
@@ -68,10 +69,15 @@ def ring_attention_block(q, k, v, axis_name: str = "sp",
     acc = jnp.zeros(q.shape, jnp.float32)
     row_max = jnp.full((B, Tq, H), _NEG_INF, jnp.float32)
     row_sum = jnp.zeros((B, Tq, H), jnp.float32)
-    # constants enter the loop unvarying over the mesh axis while the loop
+    # constants enter the loop unvarying over the mesh axes while the loop
     # body produces device-varying values; align the carry's varying type
+    # over EVERY axis the shard_map shards q over (sp plus the batch axis
+    # when present — a dp x sp mesh otherwise trips the fori_loop carry
+    # type check)
+    cast_axes = (axis_name,) + tuple(a for a in vary_axes
+                                     if a and a != axis_name)
     acc, row_max, row_sum = jax.tree_util.tree_map(
-        lambda x: lax.pcast(x, (axis_name,), to="varying"),
+        lambda x: lax.pcast(x, cast_axes, to="varying"),
         (acc, row_max, row_sum))
     qf = q.astype(jnp.float32)
 
@@ -102,9 +108,13 @@ def ring_attention_block(q, k, v, axis_name: str = "sp",
     return out.astype(q.dtype)
 
 
-def _ring_shard_map(block_fn, q, k, v, mesh, axis_name, batch_axis):
+def _ring_shard_map(make_block_fn, q, k, v, mesh, axis_name, batch_axis):
     """Shared wrapper: validate the mesh/sequence contract and shard_map
-    the per-block ring function over (batch_axis, axis_name)."""
+    the per-block ring function over (batch_axis, axis_name).
+
+    ``make_block_fn(batch_axis_or_None) -> block_fn`` — a builder, so
+    every engine resolves the mesh's actual batch axis (the dense block
+    needs it for its fori_loop carry varying-type alignment)."""
     from . import mesh as _mesh_mod
 
     if mesh is None:
@@ -117,6 +127,9 @@ def _ring_shard_map(block_fn, q, k, v, mesh, axis_name, batch_axis):
             f"sequence length {q.shape[1]} not divisible by {axis_name} "
             f"axis size {sp}")
     b_ax = batch_axis if batch_axis in mesh.shape else None
+    if b_ax is not None and mesh.shape[b_ax] == 1:
+        b_ax = None
+    block_fn = make_block_fn(b_ax)  # resolve the per-mesh batch axis
     spec = PartitionSpec(b_ax, axis_name, None, None)
     mapped = jax.shard_map(block_fn, mesh=mesh,
                            in_specs=(spec, spec, spec), out_specs=spec)
@@ -134,9 +147,12 @@ def ring_self_attention(q, k, v, mesh: Optional[Mesh] = None,
     ppermute ring), jit-safe, and composable with data parallelism via
     ``batch_axis``.
     """
-    fn = partial(ring_attention_block, axis_name=axis_name, causal=causal,
-                 scale=scale)
-    return _ring_shard_map(fn, q, k, v, mesh, axis_name, batch_axis)
+    def fn_builder(b_ax):
+        return partial(ring_attention_block, axis_name=axis_name,
+                       causal=causal, scale=scale,
+                       vary_axes=(b_ax,) if b_ax else ())
+    return _ring_shard_map(fn_builder, q, k, v, mesh, axis_name,
+                           batch_axis)
 
 
 # --------------------------------------------------------------------- #
@@ -292,6 +308,8 @@ def ring_flash_attention(q, k, v, mesh: Optional[Mesh] = None,
     engine (TPU hot path; ``interpret=True`` runs the same kernels on
     CPU). Same contract: global (B, T, H, D), T divisible by the sp
     size, differentiable end to end."""
-    fn = partial(ring_flash_attention_block, axis_name=axis_name,
-                 causal=causal, scale=scale, interpret=interpret)
-    return _ring_shard_map(fn, q, k, v, mesh, axis_name, batch_axis)
+    return _ring_shard_map(
+        lambda b_ax: partial(ring_flash_attention_block,
+                             axis_name=axis_name, causal=causal,
+                             scale=scale, interpret=interpret),
+        q, k, v, mesh, axis_name, batch_axis)

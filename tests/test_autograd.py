@@ -360,3 +360,37 @@ def test_create_graph_nonleaf_and_robustness():
     except mx.MXNetError:
         raised = True
     assert raised
+
+
+def test_create_graph_matches_first_order_semantics():
+    # dz/dx must include the path THROUGH a co-requested intermediate y
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = (y * y).sum()
+        gx, gy = autograd.grad(z, [x, y], create_graph=True)
+    np.testing.assert_allclose(gy.asnumpy(), [8.0])    # dz/dy = 2y
+    np.testing.assert_allclose(gx.asnumpy(), [16.0])   # full chain
+    # and equals the first-order path
+    x2 = nd.array([2.0])
+    x2.attach_grad()
+    with autograd.record():
+        y2 = x2 * 2
+        z2 = (y2 * y2).sum()
+        g1 = autograd.grad(z2, [x2, y2])
+    np.testing.assert_allclose(g1[0].asnumpy(), gx.asnumpy())
+    np.testing.assert_allclose(g1[1].asnumpy(), gy.asnumpy())
+
+    # recorded head_grads participate in higher-order differentiation:
+    # g = hg * 2x with hg = 3x  ->  d/dx (sum g) = d/dx 6x^2 = 12x
+    x3 = nd.array([2.0])
+    x3.attach_grad()
+    with autograd.record():
+        y3 = (x3 * x3).sum()
+        hg = (x3 * 3.0).sum()
+        g3 = autograd.grad(y3, [x3], head_grads=hg,
+                           create_graph=True)[0]
+        s3 = g3.sum()
+    s3.backward()
+    np.testing.assert_allclose(x3.grad.asnumpy(), [24.0])  # 12 * 2
