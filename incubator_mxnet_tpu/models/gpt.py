@@ -33,12 +33,19 @@ __all__ = ["GPTModel", "gpt_mini", "gpt_small", "lm_loss",
 
 
 class CausalSelfAttention(HybridBlock):
+    """``seq_parallel=True`` routes attention through the sp-axis ring
+    (parallel/ring_attention.py) whenever the SPMD step's active mesh has
+    an ``sp`` axis of size > 1 — exact long-context attention with the
+    sequence sharded across chips; everywhere else it falls back to the
+    ordinary (flash-capable) kernel, so the flag is safe to leave on."""
+
     def __init__(self, units, num_heads, dropout=0.0, dtype="float32",
-                 flash=False, **kwargs):
+                 flash=False, seq_parallel=False, **kwargs):
         super().__init__(**kwargs)
         if units % num_heads:
             raise MXNetError(f"units {units} % heads {num_heads} != 0")
         self._units, self._heads, self._flash = units, num_heads, flash
+        self._seq_parallel = seq_parallel
         with self.name_scope():
             self.qkv = nn.Dense(3 * units, in_units=units, flatten=False,
                                 dtype=dtype,
@@ -56,16 +63,45 @@ class CausalSelfAttention(HybridBlock):
         B, T = x.shape[0], x.shape[1]
         H, D = self._heads, self._units // self._heads
         qkv = self.qkv(x).reshape((B, T, 3, H, D))
-        qkv = constrain(qkv, ("dp", "fsdp"), None, None, "tp", None)
+        seq_ax = "sp" if self._seq_parallel else None
+        qkv = constrain(qkv, ("dp", "fsdp"), seq_ax, None, "tp", None)
         q = qkv._op("slice_axis", axis=2, begin=0, end=1).reshape((B, T, H, D))
         k = qkv._op("slice_axis", axis=2, begin=1, end=2).reshape((B, T, H, D))
         v = qkv._op("slice_axis", axis=2, begin=2, end=3).reshape((B, T, H, D))
-        out = F.scaled_dot_product_attention(q, k, v, causal=True,
-                                             flash=self._flash)
-        out = constrain(out, ("dp", "fsdp"), None, "tp", None)
+        mesh = None
+        if self._seq_parallel:
+            from .. import autograd as _ag
+            from ..parallel.spmd import _ACTIVE_MESH
+            mesh = _ACTIVE_MESH.get()
+            if mesh is not None and (mesh.shape.get("sp", 1) <= 1
+                                     or T % mesh.shape["sp"]
+                                     or _ag.is_recording()):
+                # the ring call bypasses the eager tape — only take it
+                # inside a (non-recording) SPMD trace, never under
+                # autograd.record(), where it would silently detach
+                mesh = None
+        if mesh is not None:
+            from ..parallel.ring_attention import (ring_self_attention,
+                                                   ring_flash_attention)
+            from ..ops.pallas_attention import _pallas_available
+            b_ax = "dp" if mesh.shape.get("dp", 1) > 1 else (
+                "fsdp" if mesh.shape.get("fsdp", 1) > 1 else None)
+            on_tpu = any(d.platform == "tpu" for d in jax.devices())
+            if self._flash and on_tpu and _pallas_available():
+                out = NDArray(ring_flash_attention(
+                    q._data, k._data, v._data, mesh=mesh, causal=True,
+                    batch_axis=b_ax))
+            else:
+                out = NDArray(ring_self_attention(
+                    q._data, k._data, v._data, mesh=mesh, causal=True,
+                    batch_axis=b_ax))
+        else:
+            out = F.scaled_dot_product_attention(q, k, v, causal=True,
+                                                 flash=self._flash)
+        out = constrain(out, ("dp", "fsdp"), seq_ax, "tp", None)
         out = out.reshape((B, T, self._units))
         return constrain(self.dropout(self.proj(out)),
-                         ("dp", "fsdp"), None, None)
+                         ("dp", "fsdp"), seq_ax, None)
 
 
 class GPTBlock(HybridBlock):
@@ -74,13 +110,14 @@ class GPTBlock(HybridBlock):
 
     def __init__(self, units, hidden_size, num_heads, dropout=0.0,
                  layer_norm_eps=1e-5, dtype="float32", flash=False,
-                 **kwargs):
+                 seq_parallel=False, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
             self.ln1 = nn.LayerNorm(epsilon=layer_norm_eps,
                                     in_channels=units)
             self.attn = CausalSelfAttention(units, num_heads, dropout,
-                                            dtype=dtype, flash=flash)
+                                            dtype=dtype, flash=flash,
+                                            seq_parallel=seq_parallel)
             self.ln2 = nn.LayerNorm(epsilon=layer_norm_eps,
                                     in_channels=units)
             self.ffn_in = nn.Dense(hidden_size, in_units=units,
@@ -90,17 +127,20 @@ class GPTBlock(HybridBlock):
                                     flatten=False, dtype=dtype,
                                     weight_initializer=init.TruncNorm(stdev=0.02))
             self.dropout = nn.Dropout(dropout)
+        self._seq_parallel = seq_parallel
         self.ffn_in.weight._sharding = P("tp", None)
         self.ffn_in.bias._sharding = P("tp")
         self.ffn_out.weight._sharding = P(None, "tp")
 
     def hybrid_forward(self, F, x):
         from ..parallel.spmd import constrain
+        seq_ax = "sp" if self._seq_parallel else None
         x = x + self.attn(self.ln1(x))
-        x = constrain(x, ("dp", "fsdp"), None, None)
-        h = constrain(self.ffn_in(self.ln2(x)), ("dp", "fsdp"), None, "tp")
+        x = constrain(x, ("dp", "fsdp"), seq_ax, None)
+        h = constrain(self.ffn_in(self.ln2(x)),
+                      ("dp", "fsdp"), seq_ax, "tp")
         h = self.dropout(self.ffn_out(F.gelu(h)))
-        return constrain(x + h, ("dp", "fsdp"), None, None)
+        return constrain(x + h, ("dp", "fsdp"), seq_ax, None)
 
 
 class GPTModel(HybridBlock):
@@ -110,7 +150,7 @@ class GPTModel(HybridBlock):
     def __init__(self, vocab_size=50257, units=768, hidden_size=3072,
                  num_layers=12, num_heads=12, max_length=1024,
                  dropout=0.0, layer_norm_eps=1e-5, dtype="float32",
-                 flash=False, remat=False, **kwargs):
+                 flash=False, remat=False, seq_parallel=False, **kwargs):
         super().__init__(**kwargs)
         self.vocab_size = vocab_size
         self.num_layers = num_layers
@@ -129,7 +169,8 @@ class GPTModel(HybridBlock):
             self.embed_dropout = nn.Dropout(dropout)
             for i in range(num_layers):
                 blk = GPTBlock(units, hidden_size, num_heads, dropout,
-                               layer_norm_eps, dtype=dtype, flash=flash)
+                               layer_norm_eps, dtype=dtype, flash=flash,
+                               seq_parallel=seq_parallel)
                 self.register_child(blk, f"block{i}")
                 setattr(self, f"block{i}", blk)
             self.ln_f = nn.LayerNorm(epsilon=layer_norm_eps,
