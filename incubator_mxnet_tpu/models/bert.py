@@ -38,16 +38,24 @@ __all__ = ["BERTModel", "BERTForPretraining", "bert_base", "bert_large",
 
 
 class BERTSelfAttention(HybridBlock):
-    """Multi-head self-attention with fused QKV projection."""
+    """Multi-head self-attention with fused QKV projection.
+
+    ``seq_parallel=True``: inside a (non-recording) SPMD trace whose mesh
+    has an ``sp`` axis, attention rides the sequence-parallel ring
+    (parallel/ring_attention.py) with the key-padding mask converted to
+    global valid lengths — exact encoder long-context attention with the
+    sequence sharded across chips. Falls back to the standard kernel
+    everywhere else."""
 
     def __init__(self, units, num_heads, dropout=0.1, dtype="float32",
-                 flash=False, **kwargs):
+                 flash=False, seq_parallel=False, **kwargs):
         super().__init__(**kwargs)
         if units % num_heads:
             raise MXNetError(f"units {units} not divisible by heads {num_heads}")
         self._units = units
         self._heads = num_heads
         self._flash = flash
+        self._seq_parallel = seq_parallel
         with self.name_scope():
             self.qkv = nn.Dense(3 * units, in_units=units, flatten=False,
                                 dtype=dtype, weight_initializer=init.TruncNorm(stdev=0.02))
@@ -59,31 +67,49 @@ class BERTSelfAttention(HybridBlock):
         self.qkv.bias._sharding = P("tp")
         self.proj.weight._sharding = P(None, "tp")
 
-    def hybrid_forward(self, F, x, mask=None):
+    def hybrid_forward(self, F, x, mask=None, valid_length=None):
         from ..parallel.spmd import constrain
         B, T = x.shape[0], x.shape[1]
         H, D = self._heads, self._units // self._heads
+        seq_ax = "sp" if self._seq_parallel else None
         qkv = self.qkv(x).reshape((B, T, 3, H, D))
-        qkv = constrain(qkv, ("dp", "fsdp"), None, None, "tp", None)
+        qkv = constrain(qkv, ("dp", "fsdp"), seq_ax, None, "tp", None)
         q = qkv._op("slice_axis", axis=2, begin=0, end=1).reshape((B, T, H, D))
         k = qkv._op("slice_axis", axis=2, begin=1, end=2).reshape((B, T, H, D))
         v = qkv._op("slice_axis", axis=2, begin=2, end=3).reshape((B, T, H, D))
-        out = F.scaled_dot_product_attention(q, k, v, mask=mask,
-                                             flash=self._flash)
-        out = constrain(out, ("dp", "fsdp"), None, "tp", None)
+        mesh = None
+        # ring dispatch requires EXPLICIT valid lengths (or no mask):
+        # an arbitrary key mask is NOT converted — a non-prefix mask
+        # would silently mis-attend, so it always takes the dense path
+        if self._seq_parallel and (mask is None or valid_length is not None):
+            from ..parallel.ring_attention import active_ring_mesh
+            mesh = active_ring_mesh(T)
+        if mesh is not None:
+            from ..parallel.ring_attention import ring_self_attention
+            vl = valid_length.astype("int32")._data \
+                if valid_length is not None else None
+            out = NDArray(ring_self_attention(
+                q._data, k._data, v._data, mesh=mesh, causal=False,
+                batch_axis=("dp", "fsdp"), valid_length=vl))
+        else:
+            out = F.scaled_dot_product_attention(q, k, v, mask=mask,
+                                                 flash=self._flash)
+        out = constrain(out, ("dp", "fsdp"), seq_ax, "tp", None)
         out = out.reshape((B, T, self._units))
         return constrain(self.dropout(self.proj(out)),
-                         ("dp", "fsdp"), None, None)
+                         ("dp", "fsdp"), seq_ax, None)
 
 
 class BERTEncoderLayer(HybridBlock):
     def __init__(self, units, hidden_size, num_heads, dropout=0.1,
                  layer_norm_eps=1e-12, dtype="float32", flash=False,
-                 **kwargs):
+                 seq_parallel=False, **kwargs):
         super().__init__(**kwargs)
+        self._seq_parallel = seq_parallel
         with self.name_scope():
             self.attention = BERTSelfAttention(units, num_heads, dropout,
-                                               dtype=dtype, flash=flash)
+                                               dtype=dtype, flash=flash,
+                                               seq_parallel=seq_parallel)
             self.ln1 = nn.LayerNorm(epsilon=layer_norm_eps, in_channels=units)
             self.ffn_in = nn.Dense(hidden_size, in_units=units, flatten=False,
                                    dtype=dtype,
@@ -97,14 +123,15 @@ class BERTEncoderLayer(HybridBlock):
         self.ffn_in.bias._sharding = P("tp")
         self.ffn_out.weight._sharding = P(None, "tp")
 
-    def hybrid_forward(self, F, x, mask=None):
+    def hybrid_forward(self, F, x, mask=None, valid_length=None):
         from ..parallel.spmd import constrain
-        x = self.ln1(x + self.attention(x, mask))
-        x = constrain(x, ("dp", "fsdp"), None, None)
-        h = constrain(self.ffn_in(x), ("dp", "fsdp"), None, "tp")
+        seq_ax = "sp" if self._seq_parallel else None
+        x = self.ln1(x + self.attention(x, mask, valid_length))
+        x = constrain(x, ("dp", "fsdp"), seq_ax, None)
+        h = constrain(self.ffn_in(x), ("dp", "fsdp"), seq_ax, "tp")
         h = F.gelu(h)
         h = self.dropout(self.ffn_out(h))
-        return constrain(self.ln2(x + h), ("dp", "fsdp"), None, None)
+        return constrain(self.ln2(x + h), ("dp", "fsdp"), seq_ax, None)
 
 
 class BERTModel(HybridBlock):
@@ -117,7 +144,8 @@ class BERTModel(HybridBlock):
     def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
                  num_layers=12, num_heads=12, max_length=512,
                  type_vocab_size=2, dropout=0.1, layer_norm_eps=1e-12,
-                 dtype="float32", flash=False, remat=False, **kwargs):
+                 dtype="float32", flash=False, remat=False,
+                 seq_parallel=False, **kwargs):
         super().__init__(**kwargs)
         self._units = units
         self._dtype = dtype
@@ -143,7 +171,8 @@ class BERTModel(HybridBlock):
             for i in range(num_layers):
                 layer = BERTEncoderLayer(units, hidden_size, num_heads,
                                          dropout, layer_norm_eps,
-                                         dtype=dtype, flash=flash)
+                                         dtype=dtype, flash=flash,
+                                         seq_parallel=seq_parallel)
                 self.register_child(layer, f"layer{i}")
                 setattr(self, f"layer{i}", layer)
             self.pooler = nn.Dense(units, in_units=units, flatten=False,
@@ -176,9 +205,9 @@ class BERTModel(HybridBlock):
                 # trades recompute FLOPs for activation HBM so bigger
                 # batches fit (see models/_remat.py for the key contract)
                 from ._remat import remat_call
-                x = remat_call(layer, x, mask)
+                x = remat_call(layer, x, mask, valid_length)
             else:
-                x = layer(x, mask)
+                x = layer(x, mask, valid_length)
         x = x.astype("float32")
         cls = x._op("slice_axis", axis=1, begin=0, end=1).reshape(
             (B, self._units))

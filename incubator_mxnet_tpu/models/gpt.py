@@ -70,31 +70,19 @@ class CausalSelfAttention(HybridBlock):
         v = qkv._op("slice_axis", axis=2, begin=2, end=3).reshape((B, T, H, D))
         mesh = None
         if self._seq_parallel:
-            from .. import autograd as _ag
-            from ..parallel.spmd import _ACTIVE_MESH
-            mesh = _ACTIVE_MESH.get()
-            if mesh is not None and (mesh.shape.get("sp", 1) <= 1
-                                     or T % mesh.shape["sp"]
-                                     or _ag.is_recording()):
-                # the ring call bypasses the eager tape — only take it
-                # inside a (non-recording) SPMD trace, never under
-                # autograd.record(), where it would silently detach
-                mesh = None
+            from ..parallel.ring_attention import active_ring_mesh
+            mesh = active_ring_mesh(T)
         if mesh is not None:
             from ..parallel.ring_attention import (ring_self_attention,
                                                    ring_flash_attention)
             from ..ops.pallas_attention import _pallas_available
-            b_ax = "dp" if mesh.shape.get("dp", 1) > 1 else (
-                "fsdp" if mesh.shape.get("fsdp", 1) > 1 else None)
             on_tpu = any(d.platform == "tpu" for d in jax.devices())
-            if self._flash and on_tpu and _pallas_available():
-                out = NDArray(ring_flash_attention(
-                    q._data, k._data, v._data, mesh=mesh, causal=True,
-                    batch_axis=b_ax))
-            else:
-                out = NDArray(ring_self_attention(
-                    q._data, k._data, v._data, mesh=mesh, causal=True,
-                    batch_axis=b_ax))
+            engine = ring_flash_attention if (
+                self._flash and on_tpu and _pallas_available()) \
+                else ring_self_attention
+            out = NDArray(engine(
+                q._data, k._data, v._data, mesh=mesh, causal=True,
+                batch_axis=("dp", "fsdp")))
         else:
             out = F.scaled_dot_product_attention(q, k, v, causal=True,
                                                  flash=self._flash)

@@ -24,7 +24,22 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from ..base import MXNetError
 
 __all__ = ["ring_self_attention", "ring_attention_block",
-           "ring_flash_attention", "ring_flash_attention_block"]
+           "ring_flash_attention", "ring_flash_attention_block",
+           "active_ring_mesh"]
+
+
+def active_ring_mesh(seq_len: int):
+    """The model-side gate for sequence-parallel attention dispatch:
+    returns the ACTIVE SPMD mesh when it has an ``sp`` axis that divides
+    ``seq_len`` and we are NOT recording on the eager tape (the ring call
+    bypasses it), else None. Shared by every seq_parallel model."""
+    from .. import autograd as _ag
+    from .spmd import _ACTIVE_MESH
+    mesh = _ACTIVE_MESH.get()
+    if mesh is None or mesh.shape.get("sp", 1) <= 1 \
+            or seq_len % mesh.shape["sp"] or _ag.is_recording():
+        return None
+    return mesh
 
 _NEG_INF = -1e30
 
@@ -33,11 +48,14 @@ def _stream_block(q, k, v, acc, row_max, row_sum, mask):
     """One flash-attention accumulation step.
 
     q: (B, Tq, H, D); k/v: (B, Tk, H, D); acc: (B, Tq, H, D);
-    row_max/row_sum: (B, Tq, H); mask: (Tq, Tk) additive or None.
+    row_max/row_sum: (B, Tq, H); mask: additive, either (Tq, Tk) shared
+    or (B, Tq, Tk) per-batch (the valid_length form), or None.
     """
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
     if mask is not None:
-        scores = scores + mask[None, None, :, :]
+        # (Tq, Tk) shared mask or (B, Tq, Tk) per-batch (valid_length)
+        scores = scores + (mask[None, None] if mask.ndim == 2
+                           else mask[:, None])
     blk_max = scores.max(axis=-1)                       # (B,H,Tq)
     blk_max = jnp.moveaxis(blk_max, 1, -1)              # (B,Tq,H)
     new_max = jnp.maximum(row_max, blk_max)
@@ -50,14 +68,16 @@ def _stream_block(q, k, v, acc, row_max, row_sum, mask):
     return acc, new_max, row_sum
 
 
-def ring_attention_block(q, k, v, axis_name: str = "sp",
+def ring_attention_block(q, k, v, valid_length=None,
+                         axis_name: str = "sp",
                          causal: bool = False, scale: Optional[float] = None,
                          *, vary_axes: tuple = ()):
     """Per-shard ring attention body (call inside ``shard_map``).
 
     q, k, v: local blocks (B, T_blk, H, D); the global sequence is the
-    concatenation over the ``axis_name`` mesh axis. Returns the local
-    output block (B, T_blk, H, D).
+    concatenation over the ``axis_name`` mesh axis. ``valid_length``
+    (B,) GLOBAL key lengths (the encoder key-padding form) masks keys at
+    global positions >= the length. Returns the local output block.
     """
     B, Tq, H, D = q.shape
     n = lax.axis_index(axis_name)
@@ -87,11 +107,19 @@ def ring_attention_block(q, k, v, axis_name: str = "sp",
         acc, row_max, row_sum, k_cur, v_cur = carry
         # after `step` rotations device n holds the block of device n-step
         src = (n - step) % size
+        pos_k = src * Tq + jnp.arange(k_cur.shape[1])
+        mask = None
         if causal:
-            pos_k = src * Tq + jnp.arange(k_cur.shape[1])
-            mask = jnp.where(pos_k[None, :] <= pos_q[:, None], 0.0, _NEG_INF)
-        else:
-            mask = None
+            mask = jnp.where(pos_k[None, :] <= pos_q[:, None], 0.0,
+                             _NEG_INF)
+        if valid_length is not None:
+            vl_mask = jnp.where(
+                pos_k[None, :] < valid_length.astype(jnp.int32)[:, None],
+                0.0, _NEG_INF)                        # (B, Tk)
+            vl_mask = jnp.broadcast_to(vl_mask[:, None],
+                                       (vl_mask.shape[0], Tq,
+                                        vl_mask.shape[1]))
+            mask = vl_mask if mask is None else mask[None] + vl_mask
         acc, row_max, row_sum = _stream_block(
             qf, k_cur.astype(jnp.float32), v_cur.astype(jnp.float32),
             acc, row_max, row_sum, mask)
@@ -108,13 +136,15 @@ def ring_attention_block(q, k, v, axis_name: str = "sp",
     return out.astype(q.dtype)
 
 
-def _ring_shard_map(make_block_fn, q, k, v, mesh, axis_name, batch_axis):
+def _ring_shard_map(make_block_fn, q, k, v, mesh, axis_name, batch_axis,
+                    valid_length=None):
     """Shared wrapper: validate the mesh/sequence contract and shard_map
     the per-block ring function over (batch_axis, axis_name).
 
     ``make_block_fn(batch_axis_or_None) -> block_fn`` — a builder, so
     every engine resolves the mesh's actual batch axis (the dense block
-    needs it for its fori_loop carry varying-type alignment)."""
+    needs it for its fori_loop carry varying-type alignment).
+    ``valid_length`` (B,) global key lengths ride along batch-sharded."""
     from . import mesh as _mesh_mod
 
     if mesh is None:
@@ -126,20 +156,33 @@ def _ring_shard_map(make_block_fn, q, k, v, mesh, axis_name, batch_axis):
         raise MXNetError(
             f"sequence length {q.shape[1]} not divisible by {axis_name} "
             f"axis size {sp}")
-    b_ax = batch_axis if batch_axis in mesh.shape else None
-    if b_ax is not None and mesh.shape[b_ax] == 1:
-        b_ax = None
-    block_fn = make_block_fn(b_ax)  # resolve the per-mesh batch axis
-    spec = PartitionSpec(b_ax, axis_name, None, None)
+    if batch_axis is None:
+        b_axes = ()
+    elif isinstance(batch_axis, str):
+        b_axes = (batch_axis,)
+    else:
+        b_axes = tuple(batch_axis)
+    b_axes = tuple(a for a in b_axes
+                   if a in mesh.shape and mesh.shape[a] > 1)
+    b_entry = b_axes if len(b_axes) > 1 else (
+        b_axes[0] if b_axes else None)
+    block_fn = make_block_fn(b_axes)  # resolve the per-mesh batch axes
+    spec = PartitionSpec(b_entry, axis_name, None, None)
+    in_specs = [spec, spec, spec]
+    args = [q, k, v]
+    if valid_length is not None:
+        in_specs.append(PartitionSpec(b_entry))
+        args.append(valid_length)
     mapped = jax.shard_map(block_fn, mesh=mesh,
-                           in_specs=(spec, spec, spec), out_specs=spec)
-    return mapped(q, k, v)
+                           in_specs=tuple(in_specs), out_specs=spec)
+    return mapped(*args)
 
 
 def ring_self_attention(q, k, v, mesh: Optional[Mesh] = None,
                         axis_name: str = "sp", causal: bool = False,
                         scale: Optional[float] = None,
-                        batch_axis: Optional[str] = "dp"):
+                        batch_axis: Optional[str] = "dp",
+                        valid_length=None):
     """Exact self-attention with the sequence sharded over ``axis_name``.
 
     q, k, v: global (B, T, H, D) arrays; T must divide by the ``sp`` axis
@@ -147,12 +190,11 @@ def ring_self_attention(q, k, v, mesh: Optional[Mesh] = None,
     ppermute ring), jit-safe, and composable with data parallelism via
     ``batch_axis``.
     """
-    def fn_builder(b_ax):
+    def fn_builder(b_axes):
         return partial(ring_attention_block, axis_name=axis_name,
-                       causal=causal, scale=scale,
-                       vary_axes=(b_ax,) if b_ax else ())
+                       causal=causal, scale=scale, vary_axes=b_axes)
     return _ring_shard_map(fn_builder, q, k, v, mesh, axis_name,
-                           batch_axis)
+                           batch_axis, valid_length=valid_length)
 
 
 # --------------------------------------------------------------------- #
@@ -309,7 +351,7 @@ def ring_flash_attention(q, k, v, mesh: Optional[Mesh] = None,
     CPU). Same contract: global (B, T, H, D), T divisible by the sp
     size, differentiable end to end."""
     return _ring_shard_map(
-        lambda b_ax: partial(ring_flash_attention_block,
-                             axis_name=axis_name, causal=causal,
-                             scale=scale, interpret=interpret),
+        lambda b_axes: partial(ring_flash_attention_block,
+                               axis_name=axis_name, causal=causal,
+                               scale=scale, interpret=interpret),
         q, k, v, mesh, axis_name, batch_axis)
