@@ -29,7 +29,8 @@ from .. import initializer as init
 from .. import random as _rand
 
 __all__ = ["GPTModel", "gpt_mini", "gpt_small", "lm_loss",
-           "greedy_generate"]
+           "greedy_generate", "cached_generate", "init_kv_cache",
+           "decode_forward"]
 
 
 class CausalSelfAttention(HybridBlock):
@@ -250,3 +251,139 @@ def gpt_small(**kwargs) -> GPTModel:
     return GPTModel(vocab_size=50257, units=768, hidden_size=3072,
                     num_layers=12, num_heads=12, max_length=1024,
                     **kwargs)
+
+
+# --------------------------------------------------------------------- #
+# KV-cached incremental decode (the reference's stateful incremental
+# inference path — RNN states / GluonNLP decoder states — re-designed
+# for XLA: caches are fixed-shape (B, max_len, H, D) buffers updated
+# with dynamic_update_slice, so prefill + every decode step compile to
+# static-shape programs and generation is O(T) per new token instead of
+# the O(T^2) full-prefix recompute of ``greedy_generate``.)
+# --------------------------------------------------------------------- #
+
+def _attn_decode(attn: CausalSelfAttention, x, k_buf, v_buf, start_pos):
+    """Run attention for positions [start_pos, start_pos+Tin) against the
+    cache. x: (B, Tin, units); k_buf/v_buf: (B, Tmax, H, D) jnp arrays.
+    Returns (out (B, Tin, units), k_buf, v_buf)."""
+    B, Tin = x.shape[0], x.shape[1]
+    H, D = attn._heads, attn._units // attn._heads
+    Tmax = k_buf.shape[1]
+    qkv = attn.qkv(x).reshape((B, Tin, 3, H, D))
+    q = qkv._op("slice_axis", axis=2, begin=0, end=1).reshape(
+        (B, Tin, H, D))._data
+    k = qkv._op("slice_axis", axis=2, begin=1, end=2).reshape(
+        (B, Tin, H, D))._data
+    v = qkv._op("slice_axis", axis=2, begin=2, end=3).reshape(
+        (B, Tin, H, D))._data
+    k_buf = lax.dynamic_update_slice(k_buf, k.astype(k_buf.dtype),
+                                     (0, start_pos, 0, 0))
+    v_buf = lax.dynamic_update_slice(v_buf, v.astype(v_buf.dtype),
+                                     (0, start_pos, 0, 0))
+    # causal mask against GLOBAL cache positions (static shapes: iota);
+    # attention itself reuses the shared sdpa op so masking/softmax
+    # numerics stay identical to the training path
+    from ..ops.attention import scaled_dot_product_attention as _sdpa
+    pos_q = start_pos + lax.broadcasted_iota(jnp.int32, (Tin, Tmax), 0)
+    pos_k = lax.broadcasted_iota(jnp.int32, (Tin, Tmax), 1)
+    mask = (pos_k <= pos_q)[None, None]            # (1, 1, Tin, Tmax)
+    out = _sdpa(q, k_buf.astype(q.dtype), v_buf.astype(q.dtype),
+                mask=mask)
+    out = NDArray(out.reshape(B, Tin, attn._units))
+    return attn.proj(out), k_buf, v_buf
+
+
+def _block_decode(blk: GPTBlock, x, k_buf, v_buf, start_pos):
+    h, k_buf, v_buf = _attn_decode(blk.attn, blk.ln1(x), k_buf, v_buf,
+                                   start_pos)
+    x = x + h
+    g = blk.ffn_out(NDArray(jax.nn.gelu(
+        blk.ffn_in(blk.ln2(x))._data, approximate=False)))
+    return x + g, k_buf, v_buf
+
+
+def init_kv_cache(model: GPTModel, batch_size: int, max_len=None,
+                  dtype=None):
+    """Fresh (k, v) cache buffers for every layer."""
+    H = model.block0.attn._heads
+    D = model._units // H
+    Tmax = int(max_len or model.max_length)
+    dt = jnp.dtype(dtype) if dtype else jnp.dtype(model._dtype)
+    mk = lambda: jnp.zeros((batch_size, Tmax, H, D), dt)
+    return [(mk(), mk()) for _ in range(model.num_layers)]
+
+
+def decode_forward(model: GPTModel, ids, caches, start_pos,
+                   last_only=False):
+    """Forward positions [start_pos, start_pos+Tin) with KV caches.
+    ids: (B, Tin) int32; returns (logits, caches) — logits over all Tin
+    positions, or only the last one when ``last_only`` (prefill wants
+    one next-token row, not a (B, T0, vocab) tensor)."""
+    B, Tin = ids.shape
+    ids_nd = ids if isinstance(ids, NDArray) else NDArray(ids)
+    pos = NDArray(start_pos + lax.broadcasted_iota(jnp.int32, (B, Tin), 1))
+    x = model.word_embed(ids_nd) + model.position_embed(pos)
+    if model._dtype != "float32":
+        x = x.astype(model._dtype)
+    new_caches = []
+    for i in range(model.num_layers):
+        blk = getattr(model, f"block{i}")
+        k_buf, v_buf = caches[i]
+        x, k_buf, v_buf = _block_decode(blk, x, k_buf, v_buf, start_pos)
+        new_caches.append((k_buf, v_buf))
+    if last_only:
+        x = x._op("slice_axis", axis=1, begin=Tin - 1, end=Tin)
+    # cast BEFORE the final norm, exactly like GPTModel.hybrid_forward
+    # (ln_f returns its input dtype — norming bf16 then casting would
+    # feed bf16-rounded activations into the vocab projection and break
+    # token parity with the training/greedy path)
+    x = model.ln_f(x.astype("float32"))
+    embed_w = model.word_embed.weight.data()
+    logits = x._op("dot", embed_w, transpose_b=True)
+    return logits, new_caches
+
+
+def cached_generate(model: GPTModel, prompt_ids, max_new_tokens=32,
+                    temperature: float = 0.0):
+    """KV-cached autoregressive decode: one prefill pass over the prompt,
+    then one single-token program per step (both jit-compiled once).
+    Same contract/output as ``greedy_generate``."""
+    ids = prompt_ids._data if isinstance(prompt_ids, NDArray) \
+        else jnp.asarray(prompt_ids)
+    B, T0 = ids.shape
+    total = T0 + int(max_new_tokens)
+    if total > model.max_length:
+        raise MXNetError(f"decode length {total} exceeds max_length "
+                         f"{model.max_length}")
+    from ..gluon.block import _hybrid_trace_scope
+    from .. import autograd
+
+    caches = init_kv_cache(model, B, max_len=total)
+    key = _rand.new_key()
+
+    with _hybrid_trace_scope(), autograd._ModeScope(recording=False,
+                                                    training=False):
+        logits, caches = decode_forward(model, NDArray(ids.astype(
+            jnp.int32)), caches, 0, last_only=True)
+        last = logits._data[:, 0]
+
+        buf = jnp.zeros((B, total), jnp.int32)
+        buf = lax.dynamic_update_slice(buf, ids.astype(jnp.int32), (0, 0))
+
+        def step(t, carry):
+            buf, last, key, lcaches = carry
+            if temperature > 0:
+                key, sub = jax.random.split(key)
+                nxt = jax.random.categorical(sub, last / temperature,
+                                             axis=-1)
+            else:
+                nxt = jnp.argmax(last, axis=-1)
+            buf = lax.dynamic_update_slice(
+                buf, nxt.astype(jnp.int32)[:, None], (0, t))
+            logits, ncaches = decode_forward(
+                model, NDArray(nxt.astype(jnp.int32)[:, None]), lcaches, t)
+            return (buf, logits._data[:, 0], key, ncaches)
+
+        buf, _, _, _ = lax.fori_loop(T0, total, step,
+                                     (buf, last, key, caches))
+    return NDArray(buf)
