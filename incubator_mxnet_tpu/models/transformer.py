@@ -27,7 +27,8 @@ from ..gluon.block import HybridBlock
 from ..ndarray import NDArray
 
 __all__ = ["TransformerModel", "TransformerEncoder", "TransformerDecoder",
-           "transformer_base", "transformer_big", "beam_search_translate"]
+           "transformer_base", "transformer_big", "beam_search_translate",
+           "beam_search_translate_cached"]
 
 
 def _positional_encoding(max_len, units):
@@ -244,6 +245,41 @@ def transformer_big(**kwargs):
 # ------------------------------------------------------------------ #
 # Beam search (reference: GluonNLP BeamSearchTranslator semantics) —
 # one fixed-shape XLA program per signature.
+def _beam_advance(tokens, scores, finished, logp, t, K, V, eos_id):
+    """One beam-search selection step shared by the recompute and
+    KV-cached decoders: freeze finished beams to EOS-at-zero-cost,
+    take the global top-K continuations, reorder beam state."""
+    neg_inf = -1e9
+    B = tokens.shape[0]
+    eos_only = jnp.full((V,), neg_inf).at[eos_id].set(0.0)
+    logp = jnp.where(finished[:, :, None], eos_only[None, None], logp)
+    cand = scores[:, :, None] + logp                  # (B, K, V)
+    top_scores, top_idx = lax.top_k(cand.reshape(B, K * V), K)
+    beam_idx = top_idx // V
+    tok_idx = top_idx % V
+    tokens = jnp.take_along_axis(tokens, beam_idx[:, :, None], axis=1)
+    tokens = tokens.at[:, :, t + 1].set(tok_idx)
+    finished = jnp.take_along_axis(finished, beam_idx, axis=1) | \
+        (tok_idx == eos_id)
+    return tokens, top_scores, finished, beam_idx
+
+
+def _beam_finalize(tokens, scores, eos_id, max_length, alpha):
+    """Length-penalized re-ranking shared by both beam decoders
+    (GNMT lp = ((5+len)/6)^alpha)."""
+    from .. import ndarray as _nd
+    lengths = jnp.argmax(tokens[:, :, 1:] == eos_id, axis=-1) + 1
+    lengths = jnp.where(jnp.any(tokens[:, :, 1:] == eos_id, axis=-1),
+                        lengths, max_length)
+    lp = jnp.power((5.0 + lengths.astype(jnp.float32)) / 6.0, alpha)
+    final = scores / lp
+    order = jnp.argsort(-final, axis=1)
+    tokens = jnp.take_along_axis(tokens, order[:, :, None], axis=1)
+    final = jnp.take_along_axis(final, order, axis=1)
+    return _nd.NDArray(tokens[:, :, 1:]), _nd.NDArray(final)
+
+
+
 # ------------------------------------------------------------------ #
 def beam_search_translate(model: TransformerModel, src, beam_size=4,
                           max_length=32, bos_id=1, eos_id=2, alpha=0.6,
@@ -291,38 +327,145 @@ def beam_search_translate(model: TransformerModel, src, beam_size=4,
     scores = jnp.tile(jnp.asarray([[0.0] + [-1e9] * (K - 1)]), (B, 1))
     finished = jnp.zeros((B, K), bool)
 
-    neg_inf = -1e9
-
     def step(t, state):
         tokens, scores, finished = state
         all_logits = decode_logits(tokens.reshape(B * K, -1))
         logp = jax.nn.log_softmax(all_logits[:, t, :], axis=-1)
         logp = logp.reshape(B, K, V)
-        # finished beams may only emit EOS at zero cost
-        eos_only = jnp.full((V,), neg_inf).at[eos_id].set(0.0)
-        logp = jnp.where(finished[:, :, None], eos_only[None, None], logp)
-        cand = scores[:, :, None] + logp                  # (B, K, V)
-        flat = cand.reshape(B, K * V)
-        top_scores, top_idx = lax.top_k(flat, K)
-        beam_idx = top_idx // V
-        tok_idx = top_idx % V
-        tokens = jnp.take_along_axis(
-            tokens, beam_idx[:, :, None], axis=1)
-        tokens = tokens.at[:, :, t + 1].set(tok_idx)
-        finished = jnp.take_along_axis(finished, beam_idx, axis=1) | \
-            (tok_idx == eos_id)
-        return tokens, top_scores, finished
+        tokens, scores, finished, _ = _beam_advance(
+            tokens, scores, finished, logp, t, K, V, eos_id)
+        return tokens, scores, finished
 
     tokens, scores, finished = lax.fori_loop(
         0, max_length, step, (tokens, scores, finished))
 
-    # length penalty over the actual generated lengths
-    lengths = jnp.argmax(tokens[:, :, 1:] == eos_id, axis=-1) + 1
-    lengths = jnp.where(jnp.any(tokens[:, :, 1:] == eos_id, axis=-1),
-                        lengths, max_length)
-    lp = jnp.power((5.0 + lengths.astype(jnp.float32)) / 6.0, alpha)
-    final = scores / lp
-    order = jnp.argsort(-final, axis=1)
-    tokens = jnp.take_along_axis(tokens, order[:, :, None], axis=1)
-    final = jnp.take_along_axis(final, order, axis=1)
-    return _nd.NDArray(tokens[:, :, 1:]), _nd.NDArray(final)
+    return _beam_finalize(tokens, scores, eos_id, max_length, alpha)
+
+
+# ------------------------------------------------------------------ #
+# KV-cached beam search (reference: GluonNLP's stateful decoder
+# states in BeamSearchTranslator — re-designed for XLA: fixed-shape
+# per-layer self-attention caches live in the fori_loop carry and are
+# REORDERED with the surviving beams each step; cross-attention K/V
+# are projected from the encoder memory once. O(T) decoder work per new
+# token vs beam_search_translate's full-prefix recompute.)
+# ------------------------------------------------------------------ #
+
+def beam_search_translate_cached(model: TransformerModel, src,
+                                 beam_size=4, max_length=32, bos_id=1,
+                                 eos_id=2, alpha=0.6,
+                                 src_valid_length=None):
+    """Same contract/output as ``beam_search_translate`` with KV-cached
+    incremental decoding."""
+    from .. import ndarray as _nd
+    from ..ops.attention import scaled_dot_product_attention as _sdpa
+    from ..gluon.block import _hybrid_trace_scope
+    from .. import autograd as _ag
+
+    src = src._data if isinstance(src, NDArray) else jnp.asarray(src)
+    B, Ts = src.shape
+    K, V = beam_size, model.tgt_vocab
+    dec = model.decoder
+    H = dec.layers[0].self_attn._heads
+    units = dec._units
+    D = units // H
+    L = len(dec.layers)
+    Tmax = max_length + 1
+    if Tmax > dec._max_length:
+        raise MXNetError(
+            f"beam search max_length {max_length} needs a decoder "
+            f"max_length of at least {max_length + 1} "
+            f"(model has {dec._max_length})")
+
+    with _hybrid_trace_scope(), _ag._ModeScope(recording=False,
+                                               training=False):
+        memory, mask = model.encode(
+            NDArray(src), None if src_valid_length is None
+            else src_valid_length)
+        src_mask = None if mask is None else \
+            jnp.repeat(mask._data, K, axis=0)            # (B*K, Ts)
+
+        # cross-attention K/V: project at batch B once per layer, THEN
+        # repeat per beam (1/K the projection FLOPs of projecting the
+        # repeated memory)
+        mem_kv = []
+        for layer in dec.layers:
+            km = layer.cross_attn.k_proj(memory)._data.reshape(
+                B, Ts, H, D)
+            vm = layer.cross_attn.v_proj(memory)._data.reshape(
+                B, Ts, H, D)
+            mem_kv.append((jnp.repeat(km, K, axis=0),
+                           jnp.repeat(vm, K, axis=0)))
+
+        pe = dec._pe                                     # (Tmax_dec, u)
+
+        def decode_token(tok, t, caches):
+            """One decoder step. tok (B*K,) int32; caches: list of
+            (k_buf, v_buf) each (B*K, Tmax, H, D). Returns
+            (logits (B*K, V), new_caches)."""
+            x = dec.embed(NDArray(tok[:, None])) * math.sqrt(units)
+            x = NDArray(x._data +
+                        lax.dynamic_slice(pe, (t, 0), (1, units))[None])
+            new_caches = []
+            pos_k = lax.broadcasted_iota(jnp.int32, (1, Tmax), 1)
+            self_mask = (pos_k <= t)[None, None]         # (1,1,1,Tmax)
+            for li, layer in enumerate(dec.layers):
+                k_buf, v_buf = caches[li]
+                q = layer.self_attn.q_proj(x)._data.reshape(
+                    B * K, 1, H, D)
+                kk = layer.self_attn.k_proj(x)._data.reshape(
+                    B * K, 1, H, D)
+                vv = layer.self_attn.v_proj(x)._data.reshape(
+                    B * K, 1, H, D)
+                k_buf = lax.dynamic_update_slice(
+                    k_buf, kk.astype(k_buf.dtype), (0, t, 0, 0))
+                v_buf = lax.dynamic_update_slice(
+                    v_buf, vv.astype(v_buf.dtype), (0, t, 0, 0))
+                sa = _sdpa(q, k_buf, v_buf, mask=self_mask)
+                sa = layer.self_attn.out_proj(
+                    NDArray(sa.reshape(B * K, 1, units)))
+                x = layer.ln1(x + sa)
+                qc = layer.cross_attn.q_proj(x)._data.reshape(
+                    B * K, 1, H, D)
+                km, vm = mem_kv[li]
+                cm = None if src_mask is None else \
+                    src_mask[:, None, None, :]
+                ca = _sdpa(qc, km, vm, mask=cm)
+                ca = layer.cross_attn.out_proj(
+                    NDArray(ca.reshape(B * K, 1, units)))
+                x = layer.ln2(x + ca)
+                x = layer.ln3(x + layer.ffn(x))
+                new_caches.append((k_buf, v_buf))
+            logits = dec.proj(x)._data[:, 0]             # (B*K, V)
+            return logits, new_caches
+
+        mk = lambda: jnp.zeros((B * K, Tmax, H, D), jnp.float32)
+        caches = [(mk(), mk()) for _ in range(L)]
+
+        tokens = jnp.full((B, K, Tmax), eos_id, jnp.int32)
+        tokens = tokens.at[:, :, 0].set(bos_id)
+        scores = jnp.tile(jnp.asarray([[0.0] + [-1e9] * (K - 1)]), (B, 1))
+        finished = jnp.zeros((B, K), bool)
+
+        def reorder(buf, beam_idx):
+            """Gather cache rows by surviving beam (B, K) indices."""
+            shaped = buf.reshape((B, K) + buf.shape[1:])
+            idx = beam_idx.reshape((B, K) + (1,) * (buf.ndim - 1))
+            return jnp.take_along_axis(shaped, idx, axis=1).reshape(
+                buf.shape)
+
+        def step(t, state):
+            tokens, scores, finished, caches = state
+            tok = tokens.reshape(B * K, -1)[:, t]
+            logits, caches = decode_token(tok, t, caches)
+            logp = jax.nn.log_softmax(logits, axis=-1).reshape(B, K, V)
+            tokens, scores, finished, beam_idx = _beam_advance(
+                tokens, scores, finished, logp, t, K, V, eos_id)
+            caches = [(reorder(kb, beam_idx), reorder(vb, beam_idx))
+                      for kb, vb in caches]
+            return tokens, scores, finished, caches
+
+        tokens, scores, finished, _ = lax.fori_loop(
+            0, max_length, step, (tokens, scores, finished, caches))
+
+    return _beam_finalize(tokens, scores, eos_id, max_length, alpha)

@@ -34,8 +34,14 @@ def imperative_invoke(spec: _reg.OpSpec, *args, out=None, ctx=None, **kwargs):
     # resolve mode-dependent statics at call time (dropout/batchnorm)
     if spec.training_aware and kwargs.get("training") is None:
         kwargs["training"] = autograd.is_training()
-    # stochastic ops: thread a fresh key from the global stream as an input
-    if spec.needs_key and kwargs.get("key") is None:
+    # stochastic ops: thread a fresh key from the global stream as an
+    # input — EXCEPT training-aware ops outside training (inert dropout):
+    # they would burn a key they never use, and inside a jax trace the
+    # split would store a TRACER into the global stream (leaking it out
+    # of the transform and corrupting every later RNG call)
+    if spec.needs_key and kwargs.get("key") is None and not (
+            spec.training_aware and not kwargs.get("training")
+            and kwargs.get("mode", "training") != "always"):
         kwargs["key"] = _random.new_key()
     key_arr = kwargs.pop("key", None)
 

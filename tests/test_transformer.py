@@ -160,3 +160,36 @@ def test_flash_attention_path_matches_dense():
     np.testing.assert_allclose(dense(src, tgt).asnumpy(),
                                flash(src, tgt).asnumpy(),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_cached_beam_search_matches_and_rng_survives():
+    """KV-cached beam search must emit exactly beam_search_translate's
+    tokens/scores (plain + masked source), and the global RNG stream
+    must remain usable after a fori_loop-traced decode (regression: a
+    tracer used to leak into the global key via inert-dropout key
+    draws)."""
+    from incubator_mxnet_tpu.models.transformer import (
+        TransformerModel, beam_search_translate,
+        beam_search_translate_cached)
+
+    mx.random.seed(0)
+    m = TransformerModel(src_vocab=32, tgt_vocab=32, units=32,
+                         hidden_size=64, num_heads=4, num_layers=2,
+                         max_length=20)
+    m.initialize()
+    rng = np.random.RandomState(0)
+    src = nd.array(rng.randint(3, 32, (2, 6)), dtype="int32")
+    t1, s1 = beam_search_translate(m, src, beam_size=3, max_length=10)
+    _ = nd.random.uniform(0, 1, shape=(2,)).asnumpy()  # stream intact?
+    t2, s2 = beam_search_translate_cached(m, src, beam_size=3,
+                                          max_length=10)
+    np.testing.assert_array_equal(t1.asnumpy(), t2.asnumpy())
+    np.testing.assert_allclose(s1.asnumpy(), s2.asnumpy(), rtol=1e-4)
+
+    svl = nd.array(np.array([6, 4], np.int32))
+    t3, _ = beam_search_translate(m, src, beam_size=3, max_length=10,
+                                  src_valid_length=svl)
+    t4, _ = beam_search_translate_cached(m, src, beam_size=3,
+                                         max_length=10,
+                                         src_valid_length=svl)
+    np.testing.assert_array_equal(t3.asnumpy(), t4.asnumpy())
