@@ -221,3 +221,23 @@ def test_gpt_kv_cache_decode_matches_full_recompute():
     b1 = g.greedy_generate(mb, prompt, max_new_tokens=10).asnumpy()
     b2 = g.cached_generate(mb, prompt, max_new_tokens=10).asnumpy()
     np.testing.assert_array_equal(b1, b2)
+
+
+def test_gpt_decode_forward_logits_match_full_forward():
+    """Prefill logits from the KV-cache path must match the training
+    forward position-for-position (not just argmax parity)."""
+    from incubator_mxnet_tpu.models import gpt as g
+    from incubator_mxnet_tpu.gluon.block import _hybrid_trace_scope
+
+    mx.random.seed(2)
+    m = g.gpt_mini(vocab_size=64, max_length=32)
+    m.initialize()
+    rng = np.random.RandomState(0)
+    ids = nd.array(rng.randint(0, 64, (2, 16)), dtype="int32")
+    with autograd.predict_mode():
+        full = m(ids).asnumpy()                       # (2, 16, 64)
+        caches = g.init_kv_cache(m, 2, max_len=16)
+        with _hybrid_trace_scope():
+            logits, _ = g.decode_forward(m, ids, caches, 0)
+    np.testing.assert_allclose(logits.asnumpy(), full, rtol=2e-4,
+                               atol=2e-5)
