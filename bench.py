@@ -28,7 +28,10 @@ import time
 TPU_ATTEMPTS = int(os.environ.get("MXTPU_BENCH_ATTEMPTS", "3"))
 # first compile through the tunnel can be slow; a DEAD tunnel hangs until
 # this timeout, so it bounds worst-case bench wall-clock (tunable)
-TPU_TIMEOUT = int(os.environ.get("MXTPU_BENCH_TPU_TIMEOUT", "1500"))
+# successful TPU runs (compile through the tunnel + 13 steps) measured
+# ~4-6 min end to end; 900 s gives 2-3x headroom while bounding the cost
+# of a hard-down tunnel to ~45 min across the retry ladder
+TPU_TIMEOUT = int(os.environ.get("MXTPU_BENCH_TPU_TIMEOUT", "900"))
 CPU_TIMEOUT = int(os.environ.get("MXTPU_BENCH_CPU_TIMEOUT", "900"))
 BACKOFFS = (10, 30)
 
