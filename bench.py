@@ -4,35 +4,48 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 The metric is tokens/sec/chip on a fused BERT pretraining step (BASELINE.md
 config #3); vs_baseline is achieved MFU divided by the 0.45 north-star MFU.
 
-Resilience contract (BASELINE.md "Measurement protocol" + round-2 postmortem):
-the orchestrator retries the accelerator path up to 3 times with backoff on
-ANY child failure (transient `UNAVAILABLE` from the TPU tunnel included),
-falls back to the CPU smoke configuration, and ALWAYS exits 0 with a JSON
-line — carrying an "error" field instead of crashing when everything failed.
-The line records which platform actually ran.
+Resilience contract (BASELINE.md "Measurement protocol" + the round-3
+postmortem, VERDICT.md "What's weak" #1): the orchestrator operates under a
+hard TOTAL deadline (`MXTPU_BENCH_DEADLINE`, default 660 s) enforced by a
+watchdog thread that emits the best-known JSON line and exits 0 before the
+deadline expires — a dead TPU tunnel can no longer push wall-clock past the
+driver's window and produce rc=124 with no artifact. Order of operations:
+
+  1. bank a placeholder line immediately (carrying the last measured TPU
+     result from BENCH_MEASURED_*.json as `last_tpu`),
+  2. start a cheap tunnel-liveness probe subprocess (<=120 s) concurrently,
+  3. run the CPU smoke and bank its result,
+  4. only if the probe saw a TPU: run accelerator attempts, each capped to
+     the remaining budget,
+  5. with leftover budget: measured extras (ResNet-50 on the TPU path,
+     NMT cached-beam-search decode).
+
+Whatever has been banked when time runs out is what gets printed — exactly
+one JSON line, always, exit 0.
 
 Workloads (child mode, selected with --workload):
-  bert    — BERT-base pretraining, bf16 + Pallas flash attention + LAMB with
-            f32 master weights (the MFU flagship; default)
+  bert    — BERT-base/large pretraining, bf16 + Pallas flash attention +
+            LAMB with f32 master weights (the MFU flagship; default)
   resnet  — ResNet-50 ImageNet-shaped data-parallel training step, img/s/chip
-            (BASELINE.md config #2), reported in the "extra" field by the
-            orchestrator when MXTPU_BENCH_RESNET=1
+            (BASELINE.md config #2)
+  nmt     — Transformer KV-cached beam-search decode, tokens/s (config #4)
 """
 
 import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 TPU_ATTEMPTS = int(os.environ.get("MXTPU_BENCH_ATTEMPTS", "3"))
-# first compile through the tunnel can be slow; a DEAD tunnel hangs until
-# this timeout, so it bounds worst-case bench wall-clock (tunable)
-# successful TPU runs (compile through the tunnel + 13 steps) measured
-# ~4-6 min end to end; 900 s gives 2-3x headroom while bounding the cost
-# of a hard-down tunnel to ~45 min across the retry ladder
+# per-attempt cap; successful TPU runs (compile through the tunnel + 13
+# steps) measured ~4-6 min end to end. The TOTAL deadline below dominates:
+# attempts are additionally capped to the remaining budget.
 TPU_TIMEOUT = int(os.environ.get("MXTPU_BENCH_TPU_TIMEOUT", "900"))
-CPU_TIMEOUT = int(os.environ.get("MXTPU_BENCH_CPU_TIMEOUT", "900"))
+CPU_TIMEOUT = int(os.environ.get("MXTPU_BENCH_CPU_TIMEOUT", "300"))
+PROBE_TIMEOUT = int(os.environ.get("MXTPU_BENCH_PROBE_TIMEOUT", "120"))
+DEADLINE = int(os.environ.get("MXTPU_BENCH_DEADLINE", "660"))
 BACKOFFS = (10, 30)
 
 
@@ -217,69 +230,198 @@ def _run_resnet(on_tpu):
     }
 
 
+def _run_nmt(on_tpu):
+    """Transformer KV-cached beam-search decode throughput (BASELINE.md
+    config #4, the inference path — upstream scripts/nmt translation)."""
+    import numpy as np
+    import jax
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.models import transformer as tm
+
+    if on_tpu:
+        B, Ts, Tgen, K = 16, 64, 48, 4
+        model = tm.transformer_base(max_length=256)
+    else:
+        B, Ts, Tgen, K = 2, 16, 8, 2
+        model = tm.TransformerModel(src_vocab=1000, tgt_vocab=1000,
+                                    units=64, hidden_size=128, num_heads=4,
+                                    num_layers=2, max_length=64)
+    mx.random.seed(0)
+    model.initialize()
+
+    rng = np.random.RandomState(0)
+    src = nd.array(rng.randint(3, 1000, (B, Ts)), dtype="int32")
+
+    def run():
+        out, scores = tm.beam_search_translate_cached(
+            model, src, beam_size=K, max_length=Tgen)
+        return float(scores.asnumpy().sum())
+
+    run()  # compile
+    t0 = time.perf_counter()
+    reps = 3 if on_tpu else 1
+    for _ in range(reps):
+        run()
+    dt = time.perf_counter() - t0
+
+    # beam search runs on ONE device (no mesh distribution), so per-chip
+    # throughput is the single-device rate — do not divide by device count
+    return {
+        "metric": "nmt_cached_beam_decode_tokens_per_sec_per_chip",
+        "value": round(B * Tgen * reps / dt, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": 0.0,
+        "batch": B,
+        "beam": K,
+        "gen_len": Tgen,
+    }
+
+
 def _child_main(workload):
     import jax
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         jax.config.update("jax_platforms", "cpu")
     on_tpu = any(d.platform != "cpu" for d in jax.devices())
-    result = {"bert": _run_bert, "resnet": _run_resnet}[workload](on_tpu)
+    result = {"bert": _run_bert, "resnet": _run_resnet,
+              "nmt": _run_nmt}[workload](on_tpu)
     result["platform"] = jax.devices()[0].platform
     print("BENCH_RESULT " + json.dumps(result))
 
 
 # --------------------------------------------------------------------- #
-# orchestrator: retry accelerator, fall back to CPU, never crash
+# orchestrator: hard total deadline, banked best-known result, probe-first
 # --------------------------------------------------------------------- #
 
+_T0 = time.monotonic()
+
+
+def _remaining():
+    return DEADLINE - (time.monotonic() - _T0)
+
+
+class _Bank:
+    """Holds the best-known result; exactly one emit, watchdog or main."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._emitted = False
+        self.result = None
+
+    def update(self, result):
+        with self._lock:
+            if not self._emitted:
+                self.result = result
+
+    def merge(self, **fields):
+        with self._lock:
+            if not self._emitted and self.result is not None:
+                self.result.update(fields)
+
+    def emit(self):
+        with self._lock:
+            if self._emitted:
+                return False
+            self._emitted = True
+            print(json.dumps(self.result), flush=True)
+            return True
+
+
+def _last_measured_tpu():
+    """Newest BENCH_MEASURED_r*.json next to this file, as provenance for
+    rounds where the tunnel is down at snapshot time."""
+    import glob
+    here = os.path.dirname(os.path.abspath(__file__))
+    hits = sorted(glob.glob(os.path.join(here, "BENCH_MEASURED_r*.json")))
+    if not hits:
+        return None
+    try:
+        with open(hits[-1]) as f:
+            data = json.load(f)
+        data["source"] = os.path.basename(hits[-1])
+        return data
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+_ACTIVE_CHILD = None  # the in-flight child Popen, for watchdog cleanup
+_SPAWN_LOCK = threading.Lock()
+_SHUTTING_DOWN = False  # set by the watchdog before it kills + exits
+
+
 def _attempt(workload, platform, timeout):
-    """Run one child attempt; returns (result dict | None, error string)."""
+    """Run one child attempt; returns (result dict | None, error string).
+
+    The child Popen is registered in _ACTIVE_CHILD under _SPAWN_LOCK so
+    the deadline watchdog can kill a wedged TPU-init child rather than
+    orphan it holding the tunnel after os._exit — and no NEW child can
+    slip in between the watchdog's kill and its exit (the TOCTOU race)."""
+    global _ACTIVE_CHILD
+    if timeout <= 0:
+        return None, "budget exhausted"
     env = dict(os.environ)
     if platform == "cpu":
         env["JAX_PLATFORMS"] = "cpu"
-    try:
-        r = subprocess.run(
+    with _SPAWN_LOCK:
+        if _SHUTTING_DOWN:
+            return None, "deadline expired"
+        proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--run",
              "--workload", workload],
-            capture_output=True, text=True, timeout=timeout, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
             cwd=os.path.dirname(os.path.abspath(__file__)) or ".")
+        _ACTIVE_CHILD = proc
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
-        return None, f"timeout after {timeout}s"
-    for line in reversed(r.stdout.splitlines()):
+        proc.kill()
+        proc.communicate()
+        return None, f"timeout after {int(timeout)}s"
+    finally:
+        _ACTIVE_CHILD = None
+    for line in reversed(stdout.splitlines()):
         if line.startswith("BENCH_RESULT "):
             try:
                 return json.loads(line[len("BENCH_RESULT "):]), ""
             except json.JSONDecodeError as e:
                 return None, f"unparseable result line: {e}"
-    tail = (r.stderr or r.stdout or "").strip().splitlines()[-8:]
-    return None, f"rc={r.returncode}: " + " | ".join(tail)
+    tail = (stderr or stdout or "").strip().splitlines()[-8:]
+    return None, f"rc={proc.returncode}: " + " | ".join(tail)
 
 
-def _measure(workload):
-    """TPU with retries, then CPU fallback. Returns (result|None, errors)."""
-    errors = []
-    cpu_res = None
-    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
-        for i in range(TPU_ATTEMPTS):
-            res, err = _attempt(workload, None, TPU_TIMEOUT)
-            if res is not None and res.get("platform") != "cpu":
-                res["attempts"] = i + 1
-                return res, errors
-            if res is not None:
-                # no accelerator on this machine: the child already ran the
-                # full CPU smoke — keep it as the fallback, don't re-run
-                cpu_res = res
-                errors.append(f"attempt {i + 1} landed on cpu")
-                break
-            errors.append(err)
-            if i < TPU_ATTEMPTS - 1:
-                time.sleep(BACKOFFS[min(i, len(BACKOFFS) - 1)])
-    if cpu_res is None:
-        cpu_res, err = _attempt(workload, "cpu", CPU_TIMEOUT)
-        if cpu_res is None:
-            errors.append(err)
-            return None, errors
-    cpu_res["attempts"] = len(errors) + 1
-    return cpu_res, errors
+def _probe_tpu_start():
+    """Kick off a tunnel-liveness probe subprocess (non-blocking)."""
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return None
+    return subprocess.Popen(
+        [sys.executable, "-c",
+         "import jax; "
+         "print('PLATFORMS', ','.join(sorted({d.platform "
+         "for d in jax.devices()})))"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=dict(os.environ))
+
+
+def _probe_tpu_wait(proc, timeout):
+    """Tri-state probe outcome: "tpu" (saw an accelerator), "cpu"
+    (completed and definitively saw cpu-only — no point gambling an
+    attempt), or "timeout" (ambiguous: tunnel wedged OR transient flap)."""
+    if proc is None:
+        return "cpu"
+    try:
+        out, _ = proc.communicate(timeout=max(timeout, 1))
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        return "timeout"
+    for line in out.splitlines():
+        if line.startswith("PLATFORMS "):
+            plats = line.split(" ", 1)[1]
+            if any(p != "cpu" for p in plats.split(",")):
+                return "tpu"
+            return "cpu"
+    return "timeout"  # probe crashed — as ambiguous as a hang
 
 
 def main():
@@ -290,30 +432,116 @@ def main():
         _child_main(wl)
         return
 
-    result, errors = _measure("bert")
-    if result is None:
-        size = os.environ.get("MXTPU_BENCH_MODEL", "base")
-        result = {
-            "metric": f"bert_{size}_pretrain_tokens_per_sec_per_chip",
-            "value": 0.0,
-            "unit": "tokens/s/chip",
-            "vs_baseline": 0.0,
-            "platform": "none",
-        }
-    if errors:
-        # transient/retry history; "error" (the hard-failure marker) is
-        # reserved for the zero-value placeholder above
-        key = "error" if result.get("platform") == "none" else "retries"
-        result[key] = "; ".join(e for e in errors if e)[:500]
+    size = os.environ.get("MXTPU_BENCH_MODEL", "base")
+    bank = _Bank()
+    placeholder = {
+        "metric": f"bert_{size}_pretrain_tokens_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "tokens/s/chip",
+        "vs_baseline": 0.0,
+        "platform": "none",
+    }
+    last_tpu = _last_measured_tpu()
+    if last_tpu is not None:
+        placeholder["last_tpu"] = last_tpu
+    bank.update(placeholder)
 
-    if os.environ.get("MXTPU_BENCH_RESNET") == "1":
-        rn, rn_errors = _measure("resnet")
-        if rn is not None:
-            result["extra"] = rn
-        elif rn_errors:
-            result["extra"] = {"error": "; ".join(rn_errors)[:300]}
+    # watchdog: whatever is banked gets printed before the deadline, even
+    # if a child subprocess is wedged in TPU backend init
+    def _watchdog():
+        global _SHUTTING_DOWN
+        delay = max(_remaining() - 5, 1)
+        time.sleep(delay)
+        with _SPAWN_LOCK:  # no new child can spawn past this point
+            _SHUTTING_DOWN = True
+            child = _ACTIVE_CHILD
+            if child is not None:  # don't orphan a wedged child
+                try:
+                    child.kill()
+                except OSError:
+                    pass
+        if bank.emit():
+            os._exit(0)
 
-    print(json.dumps(result))
+    threading.Thread(target=_watchdog, daemon=True).start()
+
+    errors = []
+
+    # 1. tunnel probe, concurrent with the CPU smoke
+    probe = _probe_tpu_start()
+
+    # 2. CPU smoke — banks a real measured line early
+    cpu_res, err = _attempt("bert", "cpu",
+                            min(CPU_TIMEOUT, _remaining() - 30))
+    if cpu_res is not None:
+        if last_tpu is not None:
+            cpu_res["last_tpu"] = last_tpu
+        bank.update(cpu_res)
+    else:
+        errors.append(f"cpu: {err}")
+
+    # 3. accelerator attempts. A "tpu" probe earns the full retry ladder;
+    #    a "timeout" probe (which can be a transient flap caught at the
+    #    wrong moment) still gets ONE gamble attempt if the budget allows
+    #    — the banked CPU line + watchdog make that safe; a definitive
+    #    "cpu" probe gets none (the gamble would just re-run the same CPU
+    #    smoke for minutes).
+    verdict = _probe_tpu_wait(probe, min(PROBE_TIMEOUT, _remaining() - 20))
+    tpu_res = None
+    if probe is not None:
+        n_attempts = {"tpu": TPU_ATTEMPTS,
+                      "timeout": 1 if _remaining() > 240 else 0,
+                      "cpu": 0}[verdict]
+        if verdict != "tpu":
+            errors.append(f"tpu: liveness probe verdict={verdict}")
+        for i in range(n_attempts):
+            if _remaining() < 120:
+                errors.append("tpu: budget exhausted before attempt "
+                              f"{i + 1}")
+                break
+            res, err = _attempt("bert", None,
+                                min(TPU_TIMEOUT, _remaining() - 20))
+            if res is not None and res.get("platform") != "cpu":
+                res["attempts"] = i + 1
+                if errors:
+                    res["retries"] = "; ".join(errors)[:500]
+                tpu_res = res
+                bank.update(res)
+                break
+            errors.append(err if res is None
+                          else f"attempt {i + 1} landed on cpu")
+            if res is not None:
+                # child saw no TPU but DID complete the CPU smoke — bank
+                # it if step 2's CPU smoke failed, then stop burning budget
+                if bank.result.get("platform") == "none":
+                    if last_tpu is not None:
+                        res["last_tpu"] = last_tpu
+                    bank.update(res)
+                break
+            if i < n_attempts - 1 and _remaining() > 150:
+                time.sleep(BACKOFFS[min(i, len(BACKOFFS) - 1)])
+
+    # 4. measured extras with leftover budget (BASELINE configs #2/#4);
+    #    on the TPU path they are on by default, CPU opt-in via env
+    extras = {}
+    run_extras_cpu = os.environ.get("MXTPU_BENCH_RESNET") == "1"
+    platform = None if tpu_res is not None else "cpu"
+    if tpu_res is not None or run_extras_cpu:
+        if _remaining() > 180:
+            rn, err = _attempt("resnet", platform, _remaining() - 60)
+            extras["resnet"] = rn if rn is not None else {"error": err[:300]}
+        if _remaining() > 120:
+            nm, err = _attempt("nmt", platform, _remaining() - 30)
+            extras["nmt"] = nm if nm is not None else {"error": err[:300]}
+    if extras:
+        bank.merge(extra=extras)
+
+    if errors and tpu_res is None:
+        key = ("error" if bank.result.get("platform") == "none"
+               else "retries")
+        bank.merge(**{key: "; ".join(e for e in errors if e)[:500]})
+
+    bank.emit()
 
 
 if __name__ == "__main__":

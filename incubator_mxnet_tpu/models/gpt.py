@@ -318,7 +318,16 @@ def decode_forward(model: GPTModel, ids, caches, start_pos,
     """Forward positions [start_pos, start_pos+Tin) with KV caches.
     ids: (B, Tin) int32; returns (logits, caches) — logits over all Tin
     positions, or only the last one when ``last_only`` (prefill wants
-    one next-token row, not a (B, T0, vocab) tensor)."""
+    one next-token row, not a (B, T0, vocab) tensor).
+
+    INFERENCE-ONLY: dropout is never applied on this path, so results
+    diverge from ``model(ids)`` under an active training mode — guarded
+    below rather than silently wrong."""
+    from .. import autograd as _ag
+    if _ag.is_training():
+        raise MXNetError(
+            "decode_forward is inference-only (dropout is skipped); call "
+            "it under autograd.predict_mode()")
     B, Tin = ids.shape
     ids_nd = ids if isinstance(ids, NDArray) else NDArray(ids)
     pos = NDArray(start_pos + lax.broadcasted_iota(jnp.int32, (B, Tin), 1))

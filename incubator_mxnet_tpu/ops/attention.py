@@ -69,9 +69,12 @@ def _sdpa_blockwise(q, k, v, key_mask, causal, scale, block_k: int = 512):
         s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32))
         allow = m_blk[:, None, None, :]                       # (B,1,1,block)
         if causal:
+            # bottom-right aligned for Tq != Tk (KV-cache convention):
+            # query i sees keys [0, Tk-Tq+i]
             pos_k = blk_idx * block_k + jnp.arange(block_k)
             allow = jnp.logical_and(
-                allow, (pos_k[None, :] <= pos_q[:, None])[None, None])
+                allow,
+                (pos_k[None, :] <= pos_q[:, None] + (Tk - Tq))[None, None])
         s = jnp.where(allow, s, _NEG_INF)
         blk_max = jnp.moveaxis(s.max(axis=-1), 1, -1)         # (B,Tq,H)
         new_max = jnp.maximum(row_max, blk_max)
@@ -110,7 +113,10 @@ def scaled_dot_product_attention(q, k, v, mask=None, scale=None,
     if m is not None and m.ndim == 2:
         m = m[:, None, None, :]                               # key padding
     if causal:
-        cm = jnp.tril(jnp.ones((Tq, Tk), bool))[None, None]
+        # bottom-right aligned when Tq != Tk (queries sit at the END of
+        # the key buffer — the KV-cache decode convention; top-left
+        # alignment would let early cached queries see future keys)
+        cm = jnp.tril(jnp.ones((Tq, Tk), bool), k=Tk - Tq)[None, None]
         m = cm if m is None else jnp.logical_and(m, cm)
     return _sdpa_dense(q, k, v, m, scale)
 

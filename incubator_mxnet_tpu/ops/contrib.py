@@ -625,17 +625,26 @@ def gradientmultiplier(data, scalar=1.0):
 def group_adagrad_update(weight, grad, history, lr=0.01, rescale_grad=1.0,
                          clip_gradient=-1.0, epsilon=1e-5):
     """Row-wise AdaGrad (reference optimizer_op.cc GroupAdagrad — the
-    embedding-friendly variant: one accumulator per row)."""
+    embedding-friendly variant: one accumulator per row).
+
+    Conventions (upstream `python/mxnet/optimizer/contrib.py` GroupAdaGrad
+    documents ``div = grad / (sqrt(history) + epsilon)`` — epsilon sits
+    OUTSIDE the sqrt, unlike plain AdaGrad's ``sqrt(history + eps)``).
+    ``history`` may be (N,) or the reference's keepdims (N, 1, ...) shape;
+    the returned accumulator keeps the caller's shape."""
     g = grad * rescale_grad
     if clip_gradient is not None and clip_gradient > 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
+    hist_shape = history.shape
+    history = history.reshape(history.shape[0])
     red_axes = tuple(range(1, g.ndim))
     mean_sq = jnp.mean(jnp.square(g), axis=red_axes) if red_axes else \
         jnp.square(g)
     new_hist = history + mean_sq
     denom = jnp.sqrt(new_hist) + epsilon
     shape = (-1,) + (1,) * (g.ndim - 1)
-    return weight - lr * g / denom.reshape(shape), new_hist
+    return (weight - lr * g / denom.reshape(shape),
+            new_hist.reshape(hist_shape))
 
 
 # --------------------------------------------------------------------- #

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as _np
 from jax import lax
 
 from ..base import MXNetError
@@ -369,12 +370,33 @@ def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
 @register("histogram", aliases=("_histogram",), num_outputs=2)
 def histogram(data, bins=10, range=None):
     """(hist, bin_edges) over flattened data (reference:
-    src/operator/tensor/histogram.cc). ``bins`` int + optional range,
-    matching mx.nd.histogram's scalar form."""
+    src/operator/tensor/histogram.cc). ``bins`` is an int (+ optional
+    range) or an array of monotonically increasing bin edges, matching
+    both of mx.nd.histogram's calling forms."""
+    if not isinstance(bins, (int, _np.integer)):
+        # explicit bin edges: range is ignored (the reference's
+        # bin_cnt=None path)
+        edges = jnp.asarray(bins)
+        if edges.ndim != 1 or edges.shape[0] < 2:
+            raise MXNetError(
+                "histogram: bins must be an int or a 1-D array of at "
+                f"least 2 edges (got shape {tuple(edges.shape)})")
+        # monotonicity check (numpy/reference behavior) — on concrete
+        # values only; a traced edges array skips it (shape-only info)
+        if not isinstance(edges, jax.core.Tracer) and \
+                not bool(jnp.all(edges[1:] >= edges[:-1])):
+            raise MXNetError("histogram: bins must increase monotonically")
+        nb = int(edges.shape[0]) - 1
+        flat = data.reshape(-1)
+        idx = jnp.clip(jnp.searchsorted(edges, flat, side="right") - 1,
+                       0, nb - 1)
+        inside = (flat >= edges[0]) & (flat <= edges[-1])
+        hist = jnp.zeros((nb,), jnp.int32).at[idx].add(
+            inside.astype(jnp.int32))
+        return hist, edges
     if range is not None:
         lo, hi = range
         if hi < lo:
-            from ..base import MXNetError
             raise MXNetError("histogram: max must be larger than min "
                              f"(got range=({lo}, {hi}))")
     else:

@@ -410,6 +410,11 @@ def use_flash_attention(q, k, v, key_mask=None, causal=False, scale=None,
         and _pallas_available()
     if valid_length is None and key_mask is None:
         valid_length = jnp.full((B,), Tk, jnp.int32)
+    # the Pallas kernel's causal grid assumes square Tq == Tk; offset
+    # (KV-cache style) causal queries take the blockwise path, which is
+    # bottom-right aligned
+    if causal and Tq != Tk:
+        on_tpu = False
     if not (on_tpu and valid_length is not None and D <= 256):
         from .attention import _sdpa_blockwise
         sc = D ** -0.5 if scale is None else scale
@@ -435,9 +440,11 @@ def _prefix_causal_mask(B, Tq, Tk, valid_len, causal):
     k_pos = lax.broadcasted_iota(jnp.int32, (B, 1, 1, Tk), 3)
     mask = k_pos < valid_len.astype(jnp.int32).reshape(B, 1, 1, 1)
     if causal:
+        # bottom-right aligned for Tq != Tk (KV-cache convention)
         q_pos = lax.broadcasted_iota(jnp.int32, (Tq, Tk), 0)
         kk = lax.broadcasted_iota(jnp.int32, (Tq, Tk), 1)
-        mask = jnp.logical_and(mask, (kk <= q_pos)[None, None])
+        mask = jnp.logical_and(mask,
+                               (kk <= q_pos + (Tk - Tq))[None, None])
     return mask
 
 

@@ -146,3 +146,76 @@ def test_retain_device_native_semantics():
     z = sparse.zeros("row_sparse", (4, 2))
     kept0 = sparse.retain(z, [1, 3])
     assert kept0.asnumpy().sum() == 0
+
+
+# --------------------------------------------------------------------- #
+# Round-3 advisor findings (ADVICE.md round 3, all low severity)
+# --------------------------------------------------------------------- #
+
+def test_decode_forward_rejects_training_mode():
+    """gpt.decode_forward skips dropout, so it must refuse to run while
+    training mode is active instead of silently diverging from model()."""
+    from incubator_mxnet_tpu.models import gpt as g
+    from incubator_mxnet_tpu import autograd
+    from incubator_mxnet_tpu.base import MXNetError
+
+    mx.random.seed(0)
+    m = g.gpt_mini(vocab_size=32, max_length=16)
+    m.initialize()
+    ids = nd.array(np.zeros((1, 4)), dtype="int32")
+    caches = g.init_kv_cache(m, 1, max_len=8)
+    with autograd.record(train_mode=True):
+        with pytest.raises(MXNetError, match="inference-only"):
+            g.decode_forward(m, ids, caches, 0)
+
+
+def test_causal_mask_bottom_right_aligned_for_cached_queries():
+    """causal=True with Tq != Tk aligns the triangle bottom-right (the
+    KV-cache decode convention): query i attends keys [0, Tk-Tq+i]."""
+    from incubator_mxnet_tpu import autograd
+
+    rng = np.random.RandomState(0)
+    B, H, D, Tk, Tq = 1, 1, 4, 6, 2
+    q = rng.randn(B, Tq, H, D).astype(np.float32)
+    k = rng.randn(B, Tk, H, D).astype(np.float32)
+    v = rng.randn(B, Tk, H, D).astype(np.float32)
+    with autograd.predict_mode():
+        out = nd.scaled_dot_product_attention(
+            nd.array(q), nd.array(k), nd.array(v), causal=True).asnumpy()
+        # reference: full-length causal attention, last Tq rows
+        qf = np.concatenate([np.zeros((B, Tk - Tq, H, D), np.float32), q],
+                            axis=1)
+        full = nd.scaled_dot_product_attention(
+            nd.array(qf), nd.array(k), nd.array(v), causal=True).asnumpy()
+    np.testing.assert_allclose(out, full[:, Tk - Tq:], rtol=1e-5, atol=1e-5)
+
+
+def test_histogram_accepts_bin_edges_array():
+    x = np.array([0.1, 0.4, 0.5, 0.9, 1.0, 2.5], np.float32)
+    edges = np.array([0.0, 0.5, 1.0, 2.0], np.float32)
+    h, e = nd.histogram(nd.array(x), bins=nd.array(edges))
+    hn, en = np.histogram(x, bins=edges)
+    np.testing.assert_array_equal(h.asnumpy(), hn)
+    np.testing.assert_allclose(e.asnumpy(), en)
+
+
+def test_group_adagrad_accepts_keepdims_history():
+    """history may be (N,) or the reference's (N, 1); the accumulator
+    comes back in the caller's shape and both produce identical steps."""
+    rng = np.random.RandomState(0)
+    w = rng.randn(4, 3).astype(np.float32)
+    g = rng.randn(4, 3).astype(np.float32)
+    h_flat = np.abs(rng.randn(4)).astype(np.float32)
+
+    w1, h1 = nd.contrib.group_adagrad_update(
+        nd.array(w), nd.array(g), nd.array(h_flat), lr=0.1)
+    w2, h2 = nd.contrib.group_adagrad_update(
+        nd.array(w), nd.array(g), nd.array(h_flat.reshape(4, 1)), lr=0.1)
+    assert h1.shape == (4,) and h2.shape == (4, 1)
+    np.testing.assert_allclose(w1.asnumpy(), w2.asnumpy(), rtol=1e-6)
+    np.testing.assert_allclose(h1.asnumpy(), h2.asnumpy().ravel(),
+                               rtol=1e-6)
+    # epsilon OUTSIDE the sqrt (upstream GroupAdaGrad convention)
+    exp_h = h_flat + np.mean(np.square(g), axis=1)
+    exp_w = w - 0.1 * g / (np.sqrt(exp_h)[:, None] + 1e-5)
+    np.testing.assert_allclose(w1.asnumpy(), exp_w, rtol=1e-5)

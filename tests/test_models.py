@@ -241,3 +241,45 @@ def test_gpt_decode_forward_logits_match_full_forward():
             logits, _ = g.decode_forward(m, ids, caches, 0)
     np.testing.assert_allclose(logits.asnumpy(), full, rtol=2e-4,
                                atol=2e-5)
+
+
+def test_bert_mlm_onehot_gather_is_exact_gather():
+    """The MLM head's one-hot-matmul position gather must equal an index
+    gather EXACTLY (each one-hot row has a single 1.0, so the contraction
+    copies one value untouched) — in f32 AND bf16, forward and backward."""
+    rng = np.random.RandomState(3)
+    B, T, M, U = 2, 16, 5, 8
+    pos_np = rng.randint(0, T, (B, M))
+    for dtype in ("float32", "bfloat16"):
+        seq = nd.array(rng.randn(B, T, U).astype("float32")).astype(dtype)
+        pos = nd.array(pos_np, dtype="int32")
+        seq.attach_grad()
+        with autograd.record():
+            onehot = nd.one_hot(pos, depth=T, dtype=dtype)
+            out = nd.batch_dot(onehot, seq)
+            loss = (out * out).sum()
+        loss.backward()
+        g_matmul = seq.grad.asnumpy().astype(np.float32)
+
+        ref = nd.batch_take(seq, pos)
+        assert (out.asnumpy() == ref.asnumpy()).all()
+
+        seq.attach_grad()
+        with autograd.record():
+            out2 = nd.batch_take(seq, pos)
+            loss2 = (out2 * out2).sum()
+        loss2.backward()
+        g_gather = seq.grad.asnumpy().astype(np.float32)
+        np.testing.assert_allclose(g_matmul, g_gather, rtol=1e-6, atol=1e-6)
+
+
+def test_bert_seq_output_keeps_compute_dtype():
+    """bf16 models return the sequence output in bf16 (the f32 cast that
+    used to sit here poisoned every downstream matmul); pooled stays f32."""
+    mx.random.seed(4)
+    model = bert_tiny(dtype="bfloat16")
+    model.initialize()
+    ids = nd.array(np.zeros((2, 8)), dtype="int32")
+    seq, pooled = model(ids, None, None)
+    assert seq.dtype == "bfloat16", seq.dtype
+    assert pooled.dtype == "float32", pooled.dtype

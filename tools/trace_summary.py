@@ -1,0 +1,198 @@
+"""Summarize a jax.profiler chrome trace: top device ops + collective overlap.
+
+Reads the `*.trace.json.gz` a `jax.profiler.trace(dir)` capture writes, keeps
+only device-side events (process whose name mentions TPU/GPU/device), and
+prints/writes:
+
+  - total device-busy time over the capture window
+  - top-N ops by accumulated duration
+  - collective time (all-reduce / all-gather / reduce-scatter /
+    collective-permute / all-to-all fusions), split into *overlapped*
+    (concurrent with non-collective device work) and *exposed*
+
+This is the 5-line perf-evidence summary BASELINE.md's measurement protocol
+asks to sit next to each BENCH json (reference: upstream kept equivalent
+evidence in profiler output checked by `docs/.../perf.md` instructions).
+
+Usage: python tools/trace_summary.py TRACE_DIR [-o SUMMARY.md]
+"""
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import re
+from collections import Counter
+
+COLLECTIVE_MARKERS = (
+    "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
+    "all-to-all", "allreduce", "allgather",
+)
+
+
+def _find_trace_file(trace_dir):
+    hits = sorted(glob.glob(
+        os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True))
+    if not hits:
+        raise FileNotFoundError(f"no *.trace.json.gz under {trace_dir}")
+    return hits[-1]
+
+
+def _device_op_lanes(events):
+    """(pid, tid) pairs for per-op device lanes.
+
+    The profiler emits one process per device with lanes `Steps`,
+    `XLA Modules`, `XLA Ops`, `Async XLA Ops`, ... — the module lane wraps
+    the whole step (counting it would double every op and make overlap
+    trivially 100%), so keep only the op-level lanes.
+    """
+    dev_pids = set()
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            name = (e.get("args") or {}).get("name", "")
+            if any(k in name.lower() for k in ("tpu", "gpu", "/device:")):
+                dev_pids.add(e.get("pid"))
+    lanes = set()
+    for e in events:
+        if (e.get("ph") == "M" and e.get("name") == "thread_name"
+                and e.get("pid") in dev_pids):
+            lane = (e.get("args") or {}).get("name", "")
+            if "ops" in lane.lower() or "overlay" in lane.lower():
+                lanes.add((e.get("pid"), e.get("tid")))
+    return lanes
+
+
+def _merge_intervals(spans):
+    """Union of [start, end) intervals; returns merged list + total length."""
+    if not spans:
+        return [], 0.0
+    spans = sorted(spans)
+    merged = [list(spans[0])]
+    for s, t in spans[1:]:
+        if s <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], t)
+        else:
+            merged.append([s, t])
+    return merged, sum(t - s for s, t in merged)
+
+
+def _overlap_len(spans, merged_other):
+    """Total length of `spans` covered by the merged interval set."""
+    total = 0.0
+    import bisect
+    starts = [s for s, _ in merged_other]
+    for s, t in spans:
+        i = bisect.bisect_right(starts, s) - 1
+        i = max(i, 0)
+        while i < len(merged_other) and merged_other[i][0] < t:
+            os_, ot = merged_other[i]
+            lo, hi = max(s, os_), min(t, ot)
+            if hi > lo:
+                total += hi - lo
+            i += 1
+    return total
+
+
+def summarize(trace_dir, top=12):
+    path = _find_trace_file(trace_dir)
+    with gzip.open(path, "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", [])
+    lanes = _device_op_lanes(events)
+
+    per_op = Counter()
+    # overlap accounting is PER DEVICE (pid): a collective on chip 0 is
+    # only "overlapped" if chip 0 itself computes concurrently — compute
+    # on another chip must not count, and per-chip sums must not be
+    # compared against a single union (that made exposed go negative on
+    # multi-chip traces)
+    coll_by_dev, compute_by_dev = {}, {}
+    t_min, t_max = float("inf"), float("-inf")
+    for e in events:
+        if e.get("ph") != "X" or (e.get("pid"), e.get("tid")) not in lanes:
+            continue
+        name, ts, dur = e.get("name", "?"), e.get("ts"), e.get("dur")
+        if ts is None or dur is None:
+            continue
+        per_op[name] += dur
+        t_min, t_max = min(t_min, ts), max(t_max, ts + dur)
+        span, pid = (ts, ts + dur), e.get("pid")
+        if any(m in name.lower() for m in COLLECTIVE_MARKERS):
+            coll_by_dev.setdefault(pid, []).append(span)
+        else:
+            compute_by_dev.setdefault(pid, []).append(span)
+
+    if not per_op:
+        return f"# Trace summary\n\nNo device events found in {path}\n"
+
+    n_dev = len(set(coll_by_dev) | set(compute_by_dev))
+    busy_compute = busy_coll = overlapped = 0.0
+    for pid, spans in compute_by_dev.items():
+        _, b = _merge_intervals(spans)
+        busy_compute += b
+    for pid, spans in coll_by_dev.items():
+        merged_c, b = _merge_intervals(spans)
+        busy_coll += b
+        merged_compute, _ = _merge_intervals(compute_by_dev.get(pid, []))
+        overlapped += _overlap_len(merged_c, merged_compute)
+    exposed = busy_coll - overlapped
+    window = (t_max - t_min) * max(n_dev, 1)  # device-seconds
+
+    lines = [
+        "# Trace summary",
+        "",
+        f"- source: `{os.path.relpath(path)}`",
+        f"- capture window: {window / 1e3:.1f} device-ms across {n_dev} "
+        f"device(s); busy (non-collective compute): "
+        f"{busy_compute / 1e3:.1f} ms"
+        f" ({100 * busy_compute / window:.1f}% of window)",
+        f"- collective time: {busy_coll / 1e3:.2f} ms — overlapped with"
+        f" compute: {overlapped / 1e3:.2f} ms"
+        f" ({(100 * overlapped / busy_coll) if busy_coll else 0:.0f}%),"
+        f" exposed: {exposed / 1e3:.2f} ms",
+        "",
+        f"Top {top} op families by accumulated time (per-layer clones like"
+        " `fusion.N` grouped by base name):",
+        "",
+        "| op family | instances | total ms | % of busy |",
+        "|---|---|---|---|",
+    ]
+    total_busy = busy_compute + busy_coll
+    family = Counter()
+    fam_count = Counter()
+    for name, dur in per_op.items():
+        base = re.sub(r"\.\d+$", "", name)
+        family[base] += dur
+        fam_count[base] += 1
+    for name, dur in family.most_common(top):
+        lines.append(
+            f"| `{name[:70]}` | {fam_count[name]} | {dur / 1e3:.2f} | "
+            f"{100 * dur / total_busy:.1f}% |")
+    lines += ["", f"Top {top} individual ops:", "",
+              "| op | total ms | % of busy |", "|---|---|---|"]
+    for name, dur in per_op.most_common(top):
+        lines.append(
+            f"| `{name[:80]}` | {dur / 1e3:.2f} | "
+            f"{100 * dur / total_busy:.1f}% |")
+    return "\n".join(lines) + "\n"
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace_dir")
+    ap.add_argument("-o", "--out", default=None,
+                    help="write the summary markdown here (default: stdout)")
+    ap.add_argument("--top", type=int, default=12)
+    args = ap.parse_args()
+    md = summarize(args.trace_dir, top=args.top)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(md)
+        print(f"wrote {args.out}")
+    else:
+        print(md)
+
+
+if __name__ == "__main__":
+    main()
