@@ -101,7 +101,10 @@ def _run_bert(on_tpu):
         dtype = "float32"
         steps, warmup = 3, 1
         flash = False
-    remat = os.environ.get("MXTPU_BENCH_REMAT", "0") == "1"
+    remat_env = os.environ.get("MXTPU_BENCH_REMAT", "0")
+    # "0" off; "1" whole-layer remat; "dots" selective (save matmul
+    # outputs, recompute elementwise only)
+    remat = {"0": False, "1": True}.get(remat_env, remat_env)
     dropout = float(os.environ.get("MXTPU_BENCH_DROPOUT", "0.1"))
 
     mx.random.seed(0)

@@ -2,16 +2,16 @@
 mx2onnx/_op_translations.py and onnx2mx/import_model.py; file-level
 citations, SURVEY.md caveat).
 
-Two-stage design so the conversion logic is testable in builds without
-the ``onnx`` wheel (this build ships none — the gate in
-``contrib/__init__`` stays for the package itself):
+Two-stage design, environment-independent:
 
   1. ``graph_to_ir(sym, params, input_shapes)`` — pure-Python lowering of
      the symbol graph to ONNX-shaped node dicts (op_type, inputs,
      outputs, attrs, initializers). No onnx dependency.
-  2. ``export_model(...)`` / ``import_model(...)`` — thin proto
-     (de)serialization through ``onnx.helper``; raise MXNetError with
-     the documented gate message when ``onnx`` is absent.
+  2. ``export_model(...)`` / ``import_model(...)`` — proto
+     (de)serialization. Uses the real ``onnx`` package when installed
+     (adds ``onnx.checker`` validation); otherwise the vendored
+     wire-format layer in ``_onnx_proto.py`` writes/reads spec-compliant
+     ``.onnx`` bytes directly, so export/import work in THIS build too.
 
 Covered op set (the reference's CNN export core): Convolution,
 FullyConnected, Pooling (incl. global), Activation/relu/sigmoid/tanh,
@@ -31,15 +31,13 @@ from ..base import MXNetError
 __all__ = ["graph_to_ir", "export_model", "import_model", "ir_to_symbol"]
 
 
-def _onnx_or_raise():
+def _maybe_onnx():
+    """The real onnx package if installed, else None (vendored fallback)."""
     try:
         import onnx  # noqa: F401
         return onnx
-    except ImportError as e:
-        raise MXNetError(
-            "contrib.onnx needs the onnx package, which is not part of "
-            "this build. Use HybridBlock.export / SymbolBlock for native "
-            "serialization.") from e
+    except ImportError:
+        return None
 
 
 def _tup(v, n):
@@ -188,30 +186,32 @@ def export_model(sym, params, input_shapes, onnx_file: str,
                  model_name: str = "incubator_mxnet_tpu",
                  opset: int = 13) -> str:
     """Serialize ``sym`` + ``params`` to an ONNX file. Mirrors the
-    reference's ``onnx_mxnet.export_model``. Needs the onnx package."""
-    onnx = _onnx_or_raise()
-    from onnx import TensorProto, helper, numpy_helper
+    reference's ``onnx_mxnet.export_model``. Writes through the vendored
+    wire-format layer; validates with onnx.checker when the real package
+    happens to be installed."""
+    from . import _onnx_proto as op
 
     ir = graph_to_ir(sym, params, input_shapes)
-    nodes = [helper.make_node(n["op_type"], n["inputs"], n["outputs"],
-                              name=n["name"], **n["attrs"])
+    nodes = [op.node_bytes(n["op_type"], n["inputs"], n["outputs"],
+                           name=n["name"], attrs=n["attrs"])
              for n in ir["nodes"]]
-    graph_inputs = [
-        helper.make_tensor_value_info(i["name"], TensorProto.FLOAT,
-                                      i["shape"]) for i in ir["inputs"]]
-    graph_outputs = [
-        helper.make_tensor_value_info(o["name"], TensorProto.FLOAT, None)
-        for o in ir["outputs"]]
-    inits = [numpy_helper.from_array(v.astype(_np.float32)
-                                     if v.dtype != _np.int64 else v,
-                                     name=k)
+    graph_inputs = [op.value_info_bytes(i["name"], op.FLOAT, i["shape"])
+                    for i in ir["inputs"]]
+    graph_outputs = [op.value_info_bytes(o["name"], op.FLOAT, None)
+                     for o in ir["outputs"]]
+    inits = [op.tensor_bytes(k, v.astype(_np.float32)
+                             if v.dtype != _np.int64 else v)
              for k, v in ir["initializers"].items()]
-    graph = helper.make_graph(nodes, model_name, graph_inputs,
-                              graph_outputs, initializer=inits)
-    model = helper.make_model(
-        graph, opset_imports=[helper.make_opsetid("", opset)])
-    onnx.checker.check_model(model)
-    onnx.save(model, onnx_file)
+    graph = op.graph_bytes(nodes, model_name, graph_inputs,
+                           graph_outputs, inits)
+    blob = op.model_bytes(graph, opset=opset)
+    onnx = _maybe_onnx()
+    if onnx is not None:
+        model = onnx.ModelProto()
+        model.ParseFromString(blob)
+        onnx.checker.check_model(model)
+    with open(onnx_file, "wb") as f:
+        f.write(blob)
     return onnx_file
 
 
@@ -313,22 +313,18 @@ def ir_to_symbol(nodes, inputs, initializers):
 
 def import_model(onnx_file: str):
     """Load an ONNX file → (sym, arg_params, aux_params). Mirrors the
-    reference's ``onnx_mxnet.import_model``. Needs the onnx package."""
-    _onnx_or_raise()
-    import onnx
-    from onnx import numpy_helper
+    reference's ``onnx_mxnet.import_model``. Reads through the vendored
+    wire-format layer (also parses files written by the real library)."""
+    from . import _onnx_proto as op
 
-    model = onnx.load(onnx_file)
-    g = model.graph
-    initializers = {t.name: numpy_helper.to_array(t) for t in g.initializer}
-    inputs = [{"name": i.name,
-               "shape": [d.dim_value for d in
-                         i.type.tensor_type.shape.dim]}
-              for i in g.input if i.name not in initializers]
-    nodes = [{"op_type": n.op_type, "name": n.name or n.output[0],
-              "inputs": list(n.input), "outputs": list(n.output),
-              "attrs": {a.name: onnx.helper.get_attribute_value(a)
-                        for a in n.attribute}}
-             for n in g.node]
+    with open(onnx_file, "rb") as f:
+        parsed = op.parse_model(f.read())
+    g = parsed["graph"]
+    initializers = g["initializers"]
+    inputs = [i for i in g["inputs"] if i["name"] not in initializers]
+    nodes = [{"op_type": n["op_type"],
+              "name": n["name"] or n["outputs"][0],
+              "inputs": n["inputs"], "outputs": n["outputs"],
+              "attrs": n["attrs"]} for n in g["nodes"]]
     sym, arg_params = ir_to_symbol(nodes, inputs, initializers)
     return sym, arg_params, {}

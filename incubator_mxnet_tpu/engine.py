@@ -63,8 +63,15 @@ def wait_all() -> None:
     import jax
     import jax.numpy as jnp
 
+    from .ndarray.ndarray import _needs_fetch_fence
+
     for dev in jax.devices():
-        jax.device_put(jnp.zeros(()), dev).block_until_ready()
+        probe = jax.device_put(jnp.zeros(()), dev)
+        probe.block_until_ready()
+        if _needs_fetch_fence():
+            # axon tunnel: block_until_ready is a no-op — a device fetch
+            # is the only real fence (see NDArray.wait_to_read)
+            jax.device_get(probe)
 
 
 _bulk_size = 0

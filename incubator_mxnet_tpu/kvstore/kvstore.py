@@ -51,6 +51,7 @@ class KVStore(_base.KVStoreBase):
         self._updater = None
         self._optimizer = None
         self._compression_params = None
+        self._residuals: Dict = {}  # per-key 2-bit error-feedback state
         self._distributed = kv_type.startswith("dist")
         if self._distributed:
             # multi-host SPMD: process index/count from the JAX runtime
@@ -79,23 +80,32 @@ class KVStore(_base.KVStoreBase):
         for k, v in zip(keys, values):
             v0 = v[0] if _is_list(v) else v
             self._data[k] = v0.copy()
+            # a (re-)initialized key starts a fresh compression stream:
+            # stale error-feedback residual must not bias the first push
+            self._residuals.pop(k, None)
 
     def _normalize(self, key, value):
         if _is_list(key):
             return list(key), list(value)
         return [key], [value]
 
-    def _reduce(self, vals) -> NDArray:
+    def _reduce(self, vals, key=None) -> NDArray:
         """Sum a list of (possibly differently-placed) arrays — the analogue
         of CommDevice/CommCPU reduce (reference src/kvstore/comm.h). XLA
         handles cross-device moves; topology tuning is the compiler's job
-        (SURVEY.md §2.3 tree-reduce row)."""
+        (SURVEY.md §2.3 tree-reduce row).
+
+        Gradient compression mirrors the reference's placement
+        (src/kvstore/gradient_compression.cc): the intra-process reduce is
+        uncompressed; the worker→server hop compresses. '2bit' quantizes
+        with a persistent per-key error-feedback residual and, across
+        processes, ships REAL packed 2-bit codes (N/4 bytes on DCN);
+        'bf16' halves the wire bytes of the cross-process hop."""
         if not _is_list(vals):
             vals = [vals]
         ctype = (self._compression_params or {}).get("type", "2bit")
-        if self._compression_params is not None and ctype == "2bit":
-            vals = [self._compress_decompress(v) for v in vals]
-        elif self._compression_params is not None and ctype == "bf16":
+        compressing = self._compression_params is not None
+        if compressing and ctype == "bf16":
             # apply the bf16 rounding on every hop (numerics contract);
             # the cross-process hop below additionally sends bf16 bytes
             vals = [NDArray(v._data.astype(jnp.bfloat16)
@@ -104,31 +114,23 @@ class KVStore(_base.KVStoreBase):
         total = vals[0]._data
         for v in vals[1:]:
             total = total + jax.device_put(v._data, dev)
-        if self._distributed:
+        if compressing and ctype == "2bit":
+            from ..parallel.collectives import host_allreduce_2bit
+            threshold = self._compression_params.get("threshold", 0.5)
+            total, new_res = host_allreduce_2bit(
+                total, self._residuals.get(key), threshold)
+            self._residuals[key] = new_res
+        elif self._distributed:
             from ..parallel.collectives import host_allreduce
-            # type='bf16' compresses the CROSS-PROCESS hop with real
-            # wire savings (the TPU-idiomatic compressed collective);
-            # '2bit' keeps the reference's numerics emulation above
             total = host_allreduce(
                 total,
                 compression="bf16" if ctype == "bf16" else None)
         return NDArray(total)
 
-    def _compress_decompress(self, v: NDArray) -> NDArray:
-        """2-bit gradient compression with error feedback (reference:
-        src/kvstore/gradient_compression.cc). Emulated compress→decompress
-        keeps the numerics contract; on TPU the bandwidth win comes from
-        bf16/int8 collective dtypes instead."""
-        threshold = self._compression_params.get("threshold", 0.5)
-        data = v._data
-        quant = jnp.where(data > threshold / 2, threshold,
-                          jnp.where(data < -threshold / 2, -threshold, 0.0))
-        return NDArray(quant.astype(data.dtype))
-
     def push(self, key, value, priority=0):
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
-            reduced = self._reduce(v)
+            reduced = self._reduce(v, key=k)
             if k not in self._data:
                 self._data[k] = reduced
                 continue
@@ -155,7 +157,7 @@ class KVStore(_base.KVStoreBase):
         keys, values = self._normalize(key, value)
         _, outs = self._normalize(key, out if out is not None else value)
         for k, v, o in zip(keys, values, outs):
-            reduced = self._reduce(v)
+            reduced = self._reduce(v, key=k)
             targets = o if _is_list(o) else [o]
             for t in targets:
                 t._data = jax.device_put(
@@ -217,9 +219,11 @@ class KVStore(_base.KVStoreBase):
         if ctype not in ("2bit", "bf16"):
             raise MXNetError(
                 f"unsupported gradient compression type {ctype!r}; "
-                f"supported: '2bit' (reference numerics emulation), "
+                f"supported: '2bit' (packed 2-bit codes + error-feedback "
+                f"residual on the cross-process hop), "
                 f"'bf16' (compressed cross-process collective)")
         self._compression_params = params
+        self._residuals.clear()  # new compression config = fresh stream
 
     # -- misc parity ----------------------------------------------------- #
     def barrier(self):

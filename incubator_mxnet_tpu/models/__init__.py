@@ -5,11 +5,11 @@ from the external GluonNLP scripts the baselines cite, BASELINE.md)."""
 from . import lenet
 from .lenet import LeNet
 from . import bert
-from .bert import (BERTModel, BERTForPretraining, bert_base, bert_large,
-                   bert_tiny)
+from .bert import (BERTModel, BERTForPretraining, BERTClassifier,
+                   bert_base, bert_large, bert_tiny)
 
-__all__ = ["LeNet", "BERTModel", "BERTForPretraining", "bert_base",
-           "bert_large", "bert_tiny"]
+__all__ = ["LeNet", "BERTModel", "BERTForPretraining", "BERTClassifier",
+           "bert_base", "bert_large", "bert_tiny"]
 
 
 def __getattr__(name):

@@ -33,8 +33,8 @@ from ..gluon import nn
 from ..gluon.block import HybridBlock
 from .. import initializer as init
 
-__all__ = ["BERTModel", "BERTForPretraining", "bert_base", "bert_large",
-           "bert_tiny"]
+__all__ = ["BERTModel", "BERTForPretraining", "BERTClassifier",
+           "bert_base", "bert_large", "bert_tiny"]
 
 
 class BERTSelfAttention(HybridBlock):
@@ -191,9 +191,12 @@ class BERTModel(HybridBlock):
         if token_types is not None:
             emb = emb + self.token_type_embed(token_types)
         emb = constrain(emb, ("dp", "fsdp"), None, None)
-        x = self.embed_dropout(self.embed_ln(emb))
+        # enter the compute dtype BEFORE the embedding LN/dropout: both
+        # are (B, T, units) elementwise passes, and LN computes its
+        # statistics in f32 internally regardless of stream dtype
         if self._dtype != "float32":
-            x = x.astype(self._dtype)
+            emb = emb.astype(self._dtype)
+        x = self.embed_dropout(self.embed_ln(emb))
         mask = None
         if valid_length is not None:
             ar = F.arange(0, T, dtype="float32").reshape((1, T))
@@ -203,9 +206,12 @@ class BERTModel(HybridBlock):
             if self._remat:
                 # rematerialize each encoder layer in the backward pass:
                 # trades recompute FLOPs for activation HBM so bigger
-                # batches fit (see models/_remat.py for the key contract)
-                from ._remat import remat_call
-                x = remat_call(layer, x, mask, valid_length)
+                # batches fit (see models/_remat.py for the key contract);
+                # remat="dots" keeps matmul outputs and recomputes only
+                # elementwise work
+                from ._remat import remat_call, resolve_policy
+                x = remat_call(layer, x, mask, valid_length,
+                               policy=resolve_policy(self._remat))
             else:
                 x = layer(x, mask, valid_length)
         # sequence output stays in the compute dtype: casting the whole
@@ -270,6 +276,12 @@ class BERTForPretraining(HybridBlock):
         dt = self.bert._dtype
         scores = F.dot(h.astype(dt), embed_w.astype(dt), transpose_b=True) \
             + mlm_bias.astype(dt)
+        # vocab-sharded logits on tp meshes: the decoder matmul inherits
+        # the embedding table's vocab-dim sharding instead of allgathering
+        # a (B, M, vocab) replicated tensor; the loss's logsumexp then
+        # reduces across tp via an XLA psum
+        from ..parallel.spmd import constrain
+        scores = constrain(scores, ("dp", "fsdp"), None, "tp")
         return scores, self.nsp(pooled)
 
 
@@ -311,3 +323,28 @@ def bert_base(**kwargs) -> BERTModel:
 def bert_large(**kwargs) -> BERTModel:
     return BERTModel(vocab_size=30522, units=1024, hidden_size=4096,
                      num_layers=24, num_heads=16, **kwargs)
+
+
+class BERTClassifier(HybridBlock):
+    """Sentence(-pair) classification head on a BERT encoder (parity:
+    GluonNLP bert.BERTClassifier — the fine-tuning surface of
+    scripts/bert/finetune_classifier.py).
+
+    forward(input_ids, token_types, valid_length) -> (B, num_classes)
+    logits from a dropout + dense head over the pooled [CLS] output.
+    """
+
+    def __init__(self, bert: BERTModel, num_classes=2, dropout=0.1,
+                 **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.bert = bert
+            self.dropout = nn.Dropout(dropout)
+            self.classifier = nn.Dense(
+                num_classes, in_units=bert._units,
+                weight_initializer=init.TruncNorm(stdev=0.02))
+
+    def hybrid_forward(self, F, input_ids, token_types=None,
+                       valid_length=None):
+        _, pooled = self.bert(input_ids, token_types, valid_length)
+        return self.classifier(self.dropout(pooled))

@@ -147,6 +147,7 @@ class GPTModel(HybridBlock):
         self.hidden_size = hidden_size
         self._dtype = dtype
         self._remat = remat
+        self._seq_parallel = seq_parallel
         self.max_length = max_length
         with self.name_scope():
             self.word_embed = nn.Embedding(
@@ -175,20 +176,35 @@ class GPTModel(HybridBlock):
         x = self.embed_dropout(x)
         if self._dtype != "float32":
             x = x.astype(self._dtype)
-        from ._remat import remat_call
+        from ._remat import remat_call, resolve_policy
+        pol = resolve_policy(self._remat)
         for i in range(self.num_layers):
             blk = getattr(self, f"block{i}")
-            x = remat_call(blk, x) if self._remat else blk(x)
-        x = self.ln_f(x.astype("float32"))
-        logits = F.dot(x, self.word_embed.weight.data(), transpose_b=True)
+            x = remat_call(blk, x, policy=pol) if self._remat else blk(x)
+        # ln_f computes statistics in f32 but returns the input dtype, so
+        # the (B, T, vocab) LM-head matmul runs at the compute dtype's MXU
+        # rate (an f32 cast here poisoned the biggest matmul in the model);
+        # losses do their log-sum-exp reduction with f32 accumulation
+        x = self.ln_f(x)
+        embed_w = self.word_embed.weight.data()
+        logits = F.dot(x, embed_w.astype(x.dtype), transpose_b=True)
+        # vocab-sharded logits on tp meshes (see BERTForPretraining)
+        from ..parallel.spmd import constrain
+        seq_ax = "sp" if self._seq_parallel else None
+        logits = constrain(logits, ("dp", "fsdp"), seq_ax, "tp")
         return logits
 
 
 def lm_loss(model: GPTModel, input_ids, labels, weights=None):
-    """Next-token cross entropy, shaped for SPMDTrainer.forward_loss."""
+    """Next-token cross entropy, shaped for SPMDTrainer.forward_loss.
+
+    CE as pick − logsumexp with f32 accumulation: the (B, T, vocab)
+    log-prob tensor is never materialized and bf16 logits lose no
+    reduction precision (same streaming form as BERT's MLM loss)."""
     logits = model(input_ids)
-    logp = logits.log_softmax(axis=-1)
-    ll = logp.pick(labels, axis=-1)                   # (B, T)
+    label_scores = logits.pick(labels, axis=-1)       # (B, T)
+    lse = logits._op("logsumexp", axis=-1)
+    ll = label_scores.astype("float32") - lse
     if weights is None:
         return -ll.mean()
     denom = weights.sum() + 1e-6

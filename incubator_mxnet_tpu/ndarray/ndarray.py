@@ -58,6 +58,25 @@ def _as_jax(x, dtype=None):
     return arr
 
 
+_FETCH_FENCE = None  # None = unprobed; bool once probed
+
+
+def _needs_fetch_fence() -> bool:
+    """True on backends where ``block_until_ready`` does not actually
+    block (the axon TPU tunnel — verified empirically, bench.py:121 in
+    round 3). Probed once per process from the backend platform name."""
+    global _FETCH_FENCE
+    if _FETCH_FENCE is None:
+        try:
+            d = jax.devices()[0]
+            plat = str(getattr(getattr(d, "client", None), "platform",
+                               d.platform))
+            _FETCH_FENCE = "axon" in plat.lower()
+        except Exception:  # pragma: no cover
+            _FETCH_FENCE = False
+    return _FETCH_FENCE
+
+
 def _wrap(data) -> "NDArray":
     return NDArray(data)
 
@@ -142,8 +161,16 @@ class NDArray:
         return self.asscalar()
 
     def wait_to_read(self):
-        """Block until the value is computed (reference ``WaitToRead``)."""
+        """Block until the value is computed (reference ``WaitToRead``).
+
+        On tunneled remote backends whose ``block_until_ready`` is a
+        no-op (observed on the axon transport — see bench.py), a
+        one-scalar device fetch provides the real fence: device_get of
+        any value derived from this array cannot return before the
+        producing computation finishes."""
         jax.block_until_ready(self._data)
+        if _needs_fetch_fence():
+            jax.device_get(jnp.ravel(self._data)[:1])
         return self
 
     def __float__(self):

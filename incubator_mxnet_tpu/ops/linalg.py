@@ -97,6 +97,15 @@ def linalg_syevd(A):
     return jnp.swapaxes(v, -1, -2), w
 
 
+@register("linalg_gesvd", num_outputs=3)
+def linalg_gesvd(A):
+    """Full SVD: A = U diag(L) V (reference: linalg_gesvd — note the
+    reference returns V with rows as right singular vectors, i.e.
+    A = U L V, not V^T)."""
+    u, s, vt = jnp.linalg.svd(A, full_matrices=False)
+    return u, s, vt
+
+
 @register("linalg_sumlogdiag")
 def linalg_sumlogdiag(A):
     """sum(log(diag(A))) per matrix (reference: linalg_sumlogdiag)."""

@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..base import MXNetError
 from .registry import register
 
 
@@ -22,6 +23,31 @@ def _symmetric_scale(min_r, max_r, bits=8):
     amax = jnp.maximum(jnp.abs(min_r), jnp.abs(max_r))
     qmax = float(2 ** (bits - 1) - 1)  # 127
     return jnp.where(amax > 0, amax / qmax, 1.0)
+
+
+@register("quantize", aliases=("_contrib_quantize",), num_outputs=3)
+def quantize(data, min_range, max_range, out_type="uint8"):
+    """float → int with EXPLICIT input range tensors (reference:
+    quantization/quantize.cc — the v1 surface; quantize_v2 below is the
+    calibrated form). Returns (quantized, min_range, max_range).
+
+    ``out_type='uint8'`` (the reference default) is AFFINE: zero point at
+    round(-min/scale), scale = (max-min)/255. ``'int8'`` is symmetric."""
+    min_r = jnp.asarray(min_range, jnp.float32).reshape(())
+    max_r = jnp.asarray(max_range, jnp.float32).reshape(())
+    if out_type == "int8":
+        scale = _symmetric_scale(min_r, max_r)
+        q = jnp.clip(jnp.round(data / scale), -127, 127).astype(jnp.int8)
+    elif out_type == "uint8":
+        scale = (max_r - min_r) / 255.0
+        zero = jnp.round(-min_r / scale)
+        q = jnp.clip(jnp.round(data / scale) + zero, 0, 255) \
+            .astype(jnp.uint8)
+    else:
+        raise MXNetError(
+            f"quantize: out_type must be 'uint8' or 'int8', got "
+            f"{out_type!r}")
+    return q, min_r, max_r
 
 
 @register("quantize_v2", aliases=("_contrib_quantize_v2",), num_outputs=3)

@@ -492,6 +492,26 @@ def diag(data, k=0):
     return jnp.diag(data, k=k) if data.ndim <= 2 else jnp.diagonal(data, offset=k, axis1=-2, axis2=-1)
 
 
+@register("tril")
+def tril(data, k=0):
+    """Lower triangle (reference: np-namespace mx.np.tril,
+    src/operator/numpy/np_tril_op.cc)."""
+    return jnp.tril(data, k=k)
+
+
+@register("triu")
+def triu(data, k=0):
+    """Upper triangle (reference: np-namespace mx.np.triu)."""
+    return jnp.triu(data, k=k)
+
+
+@register("meshgrid", num_outputs=None)
+def meshgrid(*arrays, indexing="xy"):
+    """Coordinate grids from 1-D axes (reference: np-namespace
+    mx.np.meshgrid). Returns a list of len(arrays) arrays."""
+    return list(jnp.meshgrid(*arrays, indexing=indexing))
+
+
 @register("depth_to_space")
 def depth_to_space(data, block_size):
     n, c, h, w = data.shape

@@ -60,6 +60,15 @@ def host_allreduce(x: jax.Array, op: str = "sum",
     array; all processes get the elementwise reduction. Single-process is
     the identity (the in-process multi-device reduction already happened in
     the caller).
+
+    SCALING NOTE: this is allgather-then-sum — O(P) wire bytes per
+    reduction, fine at the P<=4 scale the tests run but the wrong shape
+    at P=16+ where the reference's key-sharded server aggregation
+    (src/kvstore/kvstore_dist_server.h) is O(1) per worker. Large-P
+    training should keep the reduction INSIDE the compiled SPMD step
+    (psum over a global mesh — SPMDTrainer does this), where XLA emits
+    proper ring/tree collectives; this eager helper is the kvstore
+    facade's transport, not the fast path.
     """
     if jax.process_count() == 1:
         return x
@@ -76,6 +85,74 @@ def host_allreduce(x: jax.Array, op: str = "sum",
         return jnp.sum(gathered.astype(jnp.float32), axis=0)
     gathered = multihost_utils.process_allgather(x)  # (n_proc, ...)
     return jnp.sum(gathered, axis=0)
+
+
+# ----------------------------------------------------------------------- #
+# 2-bit stochastic-threshold gradient compression (reference:
+# src/kvstore/gradient_compression.cc — the dist_sync wire format).
+# Codes: 0 → 0, 1 → +threshold, 2 → -threshold; 4 codes packed per uint8
+# byte, so the DCN hop carries N/4 bytes instead of 4N (16x). The
+# quantization error is kept in a persistent per-key RESIDUAL and added
+# back before the next quantization (error feedback) — without it the
+# scheme does not converge.
+# ----------------------------------------------------------------------- #
+
+def _pack_2bit(codes: jax.Array) -> jax.Array:
+    """(N,) uint8 codes in {0,1,2} → (ceil(N/4),) packed uint8. The four
+    2-bit fields are disjoint, so a sum of shifted fields IS the bitwise
+    or (accumulated in uint32 to dodge integer-promotion surprises)."""
+    n = codes.shape[0]
+    pad = (-n) % 4
+    c = jnp.pad(codes, (0, pad)).reshape(-1, 4).astype(jnp.uint32)
+    shifts = jnp.arange(0, 8, 2, dtype=jnp.uint32)
+    return jnp.sum(c << shifts[None, :], axis=1).astype(jnp.uint8)
+
+
+def _unpack_2bit(packed: jax.Array, n: int) -> jax.Array:
+    """(ceil(N/4),) packed uint8 → (N,) uint8 codes."""
+    shifts = jnp.arange(0, 8, 2, dtype=jnp.uint8)
+    c = (packed[:, None] >> shifts[None, :]) & jnp.uint8(3)
+    return c.reshape(-1)[:n]
+
+
+def quantize_2bit(x: jax.Array, residual: Optional[jax.Array],
+                  threshold: float):
+    """Quantize ``x + residual`` to 2-bit codes.
+
+    Returns (packed_uint8, dequantized, new_residual). The cut points sit
+    at ±threshold/2 so the dequantized value is the nearest of
+    {-threshold, 0, +threshold}."""
+    c = x if residual is None else x + residual
+    codes = jnp.where(
+        c >= threshold / 2, jnp.uint8(1),
+        jnp.where(c <= -threshold / 2, jnp.uint8(2), jnp.uint8(0)))
+    deq = (jnp.where(codes == 1, threshold,
+                     jnp.where(codes == 2, -threshold, 0.0))
+           .astype(x.dtype))
+    return _pack_2bit(codes.reshape(-1)), deq, c - deq
+
+
+def host_allreduce_2bit(x: jax.Array, residual: Optional[jax.Array],
+                        threshold: float = 0.5):
+    """Cross-process allreduce with REAL 2-bit wire compression.
+
+    Each process quantizes its local contribution (with its own error-
+    feedback residual), ships the packed uint8 codes (N/4 bytes) over
+    DCN, and every process sums the dequantized contributions — the
+    worker→server push format of the reference's dist_sync compression.
+    Returns (reduced, new_residual)."""
+    packed, deq, new_res = quantize_2bit(x, residual, threshold)
+    if jax.process_count() == 1:
+        # kvstore-as-local-server: the push still quantizes (numerics
+        # contract), there is just no second contribution to sum
+        return deq, new_res
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(packed)  # (P, N/4) uint8
+    codes = jax.vmap(lambda p: _unpack_2bit(p, x.size))(gathered)
+    signs = jnp.where(codes == 1, 1.0, jnp.where(codes == 2, -1.0, 0.0))
+    total = jnp.sum(signs, axis=0).reshape(x.shape) * threshold
+    return total.astype(x.dtype), new_res
 
 
 def host_broadcast(x: jax.Array, root: int = 0) -> jax.Array:

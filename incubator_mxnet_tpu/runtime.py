@@ -26,6 +26,12 @@ class Feature:
         return f"[{'✔' if self.enabled else '✖'} {self.name}]"
 
 
+def _fetch_fence() -> bool:
+    from .ndarray.ndarray import _needs_fetch_fence
+
+    return _needs_fetch_fence()
+
+
 def _probe() -> Dict[str, bool]:
     import jax
 
@@ -66,6 +72,10 @@ def _probe() -> Dict[str, bool]:
         "SIGNAL_HANDLER": True,
         "PROFILER": True,
         "AMP": True,
+        # False on tunneled backends (axon) where block_until_ready is a
+        # no-op; wait_to_read/wait_all add a device-fetch fence there
+        # (see ndarray._needs_fetch_fence) so user timing stays correct
+        "TRUSTWORTHY_BLOCK_UNTIL_READY": not _fetch_fence(),
     }
 
 

@@ -53,6 +53,42 @@ def main():
     store2.pull("g", out=out2)
     np.testing.assert_allclose(out2.asnumpy(), 3.0)
 
+    # ---- 2-bit compression: wire bytes = N/4, convergence via error
+    # feedback (reference: src/kvstore/gradient_compression.cc) -------- #
+    from incubator_mxnet_tpu.parallel import collectives as coll
+    n = 103  # deliberately not divisible by 4
+    packed, deq, res = coll.quantize_2bit(
+        jax.numpy.ones((n,), jax.numpy.float32), None, 0.5)
+    assert packed.size == (n + 3) // 4 and packed.dtype == jax.numpy.uint8, \
+        (packed.size, packed.dtype)  # the array that crosses DCN
+
+    store3 = kvs.create("dist_sync")
+    store3.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    store3.init("h", nd.array(np.zeros(4, np.float32)))
+    # every rank pushes a constant 0.3 with threshold 0.5: push 1 rounds
+    # UP to 0.5 (0.3 >= threshold/2) leaving residual -0.2; push 2 sees
+    # 0.3 - 0.2 = 0.1 -> 0 with residual 0.1 — classic error feedback
+    g = nd.array(np.full(4, 0.3, np.float32))
+    store3.push("h", g)
+    out3 = nd.zeros((4,))
+    store3.pull("h", out=out3)
+    np.testing.assert_allclose(out3.asnumpy(), 1.0)  # 0.5 x 2 workers
+    store3.push("h", g)
+    store3.pull("h", out=out3)
+    np.testing.assert_allclose(out3.asnumpy(), 0.0)
+    # over many pushes the error-fed quantized stream tracks the true
+    # sum: 20 pushes of 0.3 x 2 workers = 12.0 within one threshold step
+    store3b = kvs.create("dist_sync")
+    store3b.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    store3b.init("acc", nd.array(np.zeros(4, np.float32)))
+    acc = np.zeros(4, np.float32)
+    for _ in range(20):
+        store3b.push("acc", g)
+        o = nd.zeros((4,))
+        store3b.pull("acc", out=o)
+        acc += o.asnumpy()
+    np.testing.assert_allclose(acc, 12.0, atol=1.0)
+
     # ---- fused SPMD step over the global 8-device mesh --------------- #
     mx.random.seed(42)  # identical init on every rank (SPMD contract)
     net = gluon.nn.Sequential()

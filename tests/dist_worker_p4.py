@@ -1,0 +1,91 @@
+"""4-process worker: a dp2 x fsdp2 x tp2 mesh whose dp AND fsdp axes
+cross process boundaries (VERDICT r3 next-round #7 — the 2-process test
+only exercised a pure-dp mesh).
+
+Topology: 4 processes x 2 virtual CPU devices = 8 global devices.
+Device i lives on process i//2; with the canonical axis order the mesh
+assigns dp = i//4 (crosses processes 0,1 vs 2,3), fsdp = (i//2) % 2
+(crosses 0 vs 1 and 2 vs 3), tp = i % 2 (intra-process). Two fused SPMD
+steps on a tensor-parallel-sharded MLP; every rank must end with
+identical parameters and the same loss the single-process 8-device run
+produces (the parent test computes that reference and compares).
+
+Mirrors the scope growth of the reference's
+tests/nightly/dist_sync_kvstore.py / dist_device_sync_kvstore.py.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from incubator_mxnet_tpu.parallel import mesh as pmesh  # noqa: E402
+
+pmesh.initialize()
+
+import numpy as np  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu import nd, gluon, parallel  # noqa: E402
+
+
+def build_and_train():
+    """Shared by this worker and the parent's single-process reference:
+    same seed, same mesh shape, same data -> same trajectory."""
+    mx.random.seed(7)
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(32, in_units=16, activation="relu"),
+            gluon.nn.Dense(4, in_units=32))
+    net.initialize()
+    # column-parallel then row-parallel over tp (Megatron layout)
+    net._children["0"].weight._sharding = P("tp", None)
+    net._children["0"].bias._sharding = P("tp")
+    net._children["1"].weight._sharding = P(None, "tp")
+
+    rng = np.random.RandomState(3)
+    X = rng.randn(8, 16).astype(np.float32)
+    y = rng.randint(0, 4, size=(8,))
+
+    mesh = pmesh.build_mesh(axis_sizes={"dp": 2, "fsdp": 2, "tp": 2})
+    tr = parallel.SPMDTrainer(
+        net, loss=gluon.loss.SoftmaxCrossEntropyLoss(), optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+        mesh=mesh)
+    loss = None
+    for _ in range(2):
+        loss = tr.step(nd.array(X), nd.array(y))
+    return net, mesh, float(loss.asnumpy())
+
+
+def main():
+    assert jax.process_count() == 4, jax.process_count()
+    assert len(jax.devices()) == 8, len(jax.devices())
+
+    net, mesh, loss_val = build_and_train()
+    assert np.isfinite(loss_val), loss_val
+
+    from jax.experimental import multihost_utils
+    from jax.sharding import NamedSharding
+
+    # tp/fsdp-sharded params are not fully addressable per process —
+    # re-shard to fully replicated first, then compare across ranks
+    rep = jax.jit(lambda x: x,
+                  out_shardings=NamedSharding(mesh, P()))
+    for name, p in sorted(net.collect_params().items()):
+        full = np.asarray(jax.device_get(
+            rep(p.data()._data).addressable_data(0)))
+        gathered = multihost_utils.process_allgather(full)
+        for r in range(1, 4):
+            np.testing.assert_allclose(gathered[r], gathered[0],
+                                       rtol=1e-6, atol=1e-7)
+    print(f"DIST4_LOSS {loss_val:.6f}")
+    print("DIST4_WORKER_OK", jax.process_index())
+
+
+if __name__ == "__main__":
+    main()

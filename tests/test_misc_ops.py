@@ -469,3 +469,39 @@ def test_histogram_inverted_range_and_mask_dtype():
     m = nd.contrib.isnan(y)
     assert str(m.dtype) in ("float32", "<dtype: 'float32'>"), m.dtype
     np.testing.assert_allclose((1.0 - m).asnumpy(), [1.0, 0.0])
+
+
+def test_tril_triu_meshgrid():
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    np.testing.assert_array_equal(nd.tril(nd.array(a)).asnumpy(),
+                                  np.tril(a))
+    np.testing.assert_array_equal(nd.triu(nd.array(a), k=-1).asnumpy(),
+                                  np.triu(a, k=-1))
+    xs, ys = nd.meshgrid(nd.array([1.0, 2.0]), nd.array([3.0, 4.0, 5.0]))
+    ex, ey = np.meshgrid([1.0, 2.0], [3.0, 4.0, 5.0])
+    np.testing.assert_array_equal(xs.asnumpy(), ex)
+    np.testing.assert_array_equal(ys.asnumpy(), ey)
+
+
+def test_quantize_v1_explicit_range_and_gesvd():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 5).astype(np.float32)
+    # reference default: uint8 AFFINE over [min, max]
+    q, lo, hi = nd.contrib.quantize(nd.array(x), nd.array([-3.0]),
+                                    nd.array([3.0]))
+    assert q.dtype == "uint8"
+    scale = 6.0 / 255
+    zero = np.round(3.0 / scale)
+    np.testing.assert_allclose((q.asnumpy().astype(np.float32) - zero)
+                               * scale, x, atol=scale)
+    # int8 symmetric form
+    q8, _, _ = nd.contrib.quantize(nd.array(x), nd.array([-3.0]),
+                                   nd.array([3.0]), out_type="int8")
+    assert q8.dtype == "int8"
+    np.testing.assert_allclose(q8.asnumpy() * (3.0 / 127), x,
+                               atol=3.0 / 127)
+
+    A = rng.randn(3, 5).astype(np.float32)
+    U, L, V = nd.linalg_gesvd(nd.array(A))
+    rec = (U.asnumpy() * L.asnumpy()[None, :]) @ V.asnumpy()
+    np.testing.assert_allclose(rec, A, rtol=1e-4, atol=1e-5)

@@ -113,7 +113,7 @@ def test_bert_remat_matches_no_remat():
              nd.ones((B, M)),
              nd.array(rng.randint(0, 2, (B,)), dtype="int32"))
     losses = {}
-    for remat in (False, True):
+    for remat in (False, True, "dots"):
         mx.random.seed(9)
         model = bm.bert_tiny(vocab_size=128, max_length=T, remat=remat,
                              dropout=0.0)
@@ -127,6 +127,9 @@ def test_bert_remat_matches_no_remat():
             L = tr.step(*batch)
         losses[remat] = float(L.asnumpy())
     assert abs(losses[True] - losses[False]) < 1e-5, losses
+    # selective remat ("dots": save matmul outputs, recompute elementwise)
+    # must also be a memory-only transform
+    assert abs(losses["dots"] - losses[False]) < 1e-5, losses
 
 
 def test_gpt_train_and_generate():
@@ -173,7 +176,7 @@ def test_gpt_remat_parity():
     rng = np.random.RandomState(1)
     X = rng.randint(0, 64, (8, 12)).astype(np.int32)
     losses = {}
-    for remat in (False, True):
+    for remat in (False, True, "dots"):
         mx.random.seed(4)
         m = gm.gpt_mini(vocab_size=64, max_length=16, dropout=0.0,
                         remat=remat)
@@ -186,6 +189,9 @@ def test_gpt_remat_parity():
                         nd.array(X[:, 1:], dtype="int32"))
         losses[remat] = float(L.asnumpy())
     assert abs(losses[True] - losses[False]) < 1e-5, losses
+    # selective remat ("dots": save matmul outputs, recompute elementwise)
+    # must also be a memory-only transform
+    assert abs(losses["dots"] - losses[False]) < 1e-5, losses
 
 
 def test_gpt_kv_cache_decode_matches_full_recompute():
@@ -283,3 +289,36 @@ def test_bert_seq_output_keeps_compute_dtype():
     seq, pooled = model(ids, None, None)
     assert seq.dtype == "bfloat16", seq.dtype
     assert pooled.dtype == "float32", pooled.dtype
+
+
+def test_bert_classifier_finetunes():
+    """BERTClassifier (GluonNLP finetune_classifier surface): logits
+    shape and a few SPMD fine-tuning steps reduce the loss."""
+    from incubator_mxnet_tpu.models import BERTClassifier
+    from incubator_mxnet_tpu.gluon import loss as gloss
+
+    mx.random.seed(5)
+    clf = BERTClassifier(bert_tiny(vocab_size=64, max_length=16),
+                         num_classes=3, dropout=0.0)
+    clf.initialize()
+    rng = np.random.RandomState(0)
+    B, T = 8, 12
+    ids = nd.array(rng.randint(0, 64, (B, T)), dtype="int32")
+    tt = nd.array(rng.randint(0, 2, (B, T)), dtype="int32")
+    vl = nd.array(np.full((B,), T), dtype="int32")
+    y = nd.array(rng.randint(0, 3, (B,)), dtype="int32")
+    out = clf(ids, tt, vl)
+    assert out.shape == (B, 3)
+
+    sce = gloss.SoftmaxCrossEntropyLoss()
+
+    def clf_loss(model, i, t, v, labels):
+        return sce(model(i, t, v), labels).mean()
+
+    tr = parallel.SPMDTrainer(
+        clf, forward_loss=clf_loss, optimizer="adam",
+        optimizer_params={"learning_rate": 5e-4})
+    l0 = float(tr.step(ids, tt, vl, y).asnumpy())
+    for _ in range(10):
+        ll = float(tr.step(ids, tt, vl, y).asnumpy())
+    assert ll < l0, (l0, ll)

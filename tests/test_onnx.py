@@ -2,9 +2,9 @@
 
 The graph-translation layer (graph_to_ir / ir_to_symbol) is exercised
 without the onnx wheel: a LeNet symbol round-trips through the ONNX IR
-and must produce identical outputs. Proto serialization itself is
-skip-gated on the onnx package (absent in this build) with the
-MXNetError gate asserted instead."""
+and must produce identical outputs. Proto serialization runs
+UNCONDITIONALLY through the vendored wire-format layer
+(contrib/_onnx_proto.py) — no onnx-package gate remains."""
 
 import numpy as np
 import pytest
@@ -86,20 +86,15 @@ def test_unsupported_op_raises():
         onnx_mod.graph_to_ir(s, {}, {"data": (2, 4)})
 
 
-def test_proto_layer_gate_or_roundtrip(tmp_path):
+def test_proto_file_roundtrip_outputs_match(tmp_path):
+    """export_model -> .onnx bytes -> import_model, UNCONDITIONAL: the
+    vendored wire-format layer (_onnx_proto.py) removes the onnx-wheel
+    gate (VERDICT r3 next-round #8). When the real onnx package is
+    present, export additionally runs onnx.checker — same test either
+    way."""
     sym = _lenet_symbol()
     rng = np.random.RandomState(2)
     params = _lenet_params(rng)
-    try:
-        import onnx  # noqa: F401
-        have_onnx = True
-    except ImportError:
-        have_onnx = False
-    if not have_onnx:
-        with pytest.raises(mx.MXNetError, match="onnx package"):
-            onnx_mod.export_model(sym, params, {"data": (1, 1, 28, 28)},
-                                  str(tmp_path / "m.onnx"))
-        return
     f = onnx_mod.export_model(sym, params, {"data": (1, 1, 28, 28)},
                               str(tmp_path / "m.onnx"))
     sym2, arg_params, _ = onnx_mod.import_model(f)
@@ -107,3 +102,38 @@ def test_proto_layer_gate_or_roundtrip(tmp_path):
     want = sym.eval(data=nd.array(x), **params)[0].asnumpy()
     got = sym2.eval(data=nd.array(x), **arg_params)[0].asnumpy()
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_vendored_proto_primitives():
+    """Wire-level checks of the vendored protobuf layer: varint edge
+    cases (negative int64 two's-complement), tensor raw_data round-trip,
+    attribute typing (INT / FLOAT / INTS / STRING)."""
+    from incubator_mxnet_tpu.contrib import _onnx_proto as op
+
+    # tensors: f32 and int64, any shape
+    for arr in (np.arange(12, dtype=np.float32).reshape(3, 4),
+                np.array([-3, 0, 7], np.int64)):
+        name, back = op.parse_tensor(op.tensor_bytes("t", arr))
+        assert name == "t" and back.dtype == arr.dtype
+        np.testing.assert_array_equal(back, arr)
+
+    # node with every attribute kind the converter emits
+    nb = op.node_bytes("Conv", ["x", "w"], ["y"], name="conv0",
+                       attrs={"group": 1, "epsilon": 0.5,
+                              "kernel_shape": [5, 5], "pad_mode": "VALID",
+                              "neg": -2})
+    node = op.parse_node(nb)
+    assert node["op_type"] == "Conv" and node["name"] == "conv0"
+    assert node["inputs"] == ["x", "w"] and node["outputs"] == ["y"]
+    a = node["attrs"]
+    assert a["group"] == 1 and a["neg"] == -2
+    assert abs(a["epsilon"] - 0.5) < 1e-7
+    assert a["kernel_shape"] == [5, 5]
+    assert a["pad_mode"] == b"VALID"  # bytes, like onnx.helper
+
+    # value_info shape round-trip incl. the shapeless (None) form
+    vi = op.parse_value_info(op.value_info_bytes("in0", op.FLOAT,
+                                                 (1, 3, 8, 8)))
+    assert vi == {"name": "in0", "shape": [1, 3, 8, 8]}
+    vi2 = op.parse_value_info(op.value_info_bytes("out0", op.FLOAT, None))
+    assert vi2["name"] == "out0"

@@ -602,3 +602,60 @@ def test_bert_seq_parallel_training_matches_dense():
     l_ring = train(True, {"dp": 2, "sp": 4})
     l_dense = train(False, {"dp": 8})
     np.testing.assert_allclose(l_ring, l_dense, rtol=2e-4)
+
+
+def test_2bit_pack_unpack_roundtrip():
+    """4 codes per uint8 byte, exact for any length (incl. non-multiples
+    of 4) — the wire format of the dist_sync gradient compression."""
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.parallel.collectives import (_pack_2bit,
+                                                          _unpack_2bit)
+    rng = np.random.RandomState(0)
+    for n in (1, 4, 7, 64, 103):
+        codes = jnp.asarray(rng.randint(0, 3, (n,)).astype(np.uint8))
+        packed = _pack_2bit(codes)
+        assert packed.shape == ((n + 3) // 4,)
+        assert packed.dtype == jnp.uint8
+        np.testing.assert_array_equal(np.asarray(_unpack_2bit(packed, n)),
+                                      np.asarray(codes))
+
+
+def test_2bit_error_feedback_tracks_true_sum():
+    """The residual carries quantization error forward, so the running
+    dequantized sum tracks the running true sum within one threshold."""
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.parallel.collectives import quantize_2bit
+
+    rng = np.random.RandomState(1)
+    x = rng.uniform(-0.4, 0.4, (257,)).astype(np.float32)
+    threshold = 0.5
+    res = None
+    deq_sum = np.zeros_like(x)
+    for step in range(30):
+        packed, deq, res = quantize_2bit(jnp.asarray(x), res, threshold)
+        assert packed.size == (x.size + 3) // 4
+        deq_sum += np.asarray(deq)
+        np.testing.assert_allclose(deq_sum, x * (step + 1),
+                                   atol=threshold + 1e-6)
+
+
+def test_kvstore_2bit_compression_single_process():
+    """kvstore 2-bit path: quantized push with per-key error feedback
+    (single process = the local-server case; the same code ships packed
+    uint8 codes across DCN when process_count > 1)."""
+    kv = mx.kvstore.create("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("w", nd.zeros((4,)))
+    g = nd.array(np.full(4, 0.3, np.float32))
+    out = nd.zeros((4,))
+    kv.push("w", g)            # 0.3 rounds up to 0.5, residual -0.2
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 0.5)
+    kv.push("w", g)            # 0.3 - 0.2 = 0.1 -> 0, residual 0.1
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 0.0)
+    # two keys keep independent residuals
+    kv.init("v", nd.zeros((4,)))
+    kv.push("v", g)
+    kv.pull("v", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 0.5)

@@ -1,0 +1,178 @@
+"""Operator-registry audit against SURVEY.md §2.1's op-family inventory.
+
+Probes a curated list of representative upstream operator names per
+family (`src/operator/**` registration surface as catalogued in
+SURVEY.md) against the live `mx.nd` / `mx.nd.contrib` / `mx.nd.sparse`
+namespaces and writes docs/OP_AUDIT.md: per-family presence counts and an
+explicit justification for every absent name — the audit VERDICT r3
+next-round #9 asked for (zero unexplained absences).
+
+Usage: python tools/op_audit.py  (writes docs/OP_AUDIT.md)
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import incubator_mxnet_tpu as mx  # noqa: E402
+
+# family -> list of representative upstream op names (SURVEY.md §2.1
+# "Operator library" row; names follow the reference's mx.nd surface)
+FAMILIES = {
+    "tensor/elemwise": [
+        "abs", "exp", "log", "sqrt", "square", "sign", "rsqrt", "cbrt",
+        "relu", "sigmoid", "tanh", "erf", "gamma", "gammaln", "floor",
+        "ceil", "round", "rint", "trunc", "reciprocal", "negative",
+        "logical_not", "clip", "add_n",
+    ],
+    "tensor/broadcast+reduce": [
+        "broadcast_add", "broadcast_sub", "broadcast_mul", "broadcast_div",
+        "broadcast_maximum", "broadcast_minimum", "broadcast_power",
+        "broadcast_equal", "broadcast_greater", "broadcast_to",
+        "broadcast_like", "sum", "mean", "prod", "max", "min", "argmax",
+        "argmin", "norm", "logsumexp",
+    ],
+    "tensor/matrix+dot": [
+        "dot", "batch_dot", "transpose", "reshape", "flatten", "concat",
+        "stack", "split", "tile", "repeat", "pad", "flip", "reverse",
+        "swapaxes", "expand_dims", "squeeze", "diag", "tril", "triu",
+        "meshgrid", "space_to_depth", "depth_to_space",
+    ],
+    "tensor/indexing": [
+        "take", "batch_take", "pick", "gather_nd", "scatter_nd", "one_hot",
+        "where", "slice", "slice_axis", "slice_like", "index_copy",
+        "index_add", "boolean_mask", "sequence_mask", "sequence_last",
+        "sequence_reverse", "embedding",
+    ],
+    "tensor/init": [
+        "zeros", "ones", "full", "arange", "linspace", "eye",
+        "zeros_like", "ones_like",
+    ],
+    "tensor/ordering": ["sort", "argsort", "topk", "histogram"],
+    "nn/core": [
+        "FullyConnected", "Convolution", "Deconvolution", "BatchNorm",
+        "LayerNorm", "InstanceNorm", "GroupNorm", "Pooling", "Activation",
+        "softmax", "log_softmax", "masked_softmax", "Dropout", "Embedding",
+        "CTCLoss", "SoftmaxOutput", "gelu", "LeakyReLU",
+    ],
+    "rnn": ["RNN", "LSTM", "GRU"],  # fused via gluon.rnn layers
+    "random": [
+        "uniform", "normal", "gamma", "exponential", "poisson",
+        "negative_binomial", "generalized_negative_binomial", "multinomial",
+        "shuffle", "randint", "bernoulli",
+    ],  # probed with random_/sample_ prefixes too (the nd surface names)
+    "optimizer": [
+        "sgd_update", "sgd_mom_update", "adam_update", "lamb_update_phase1",
+        "lamb_update_phase2", "ftml_update", "ftrl_update", "rmsprop_update",
+        "rmspropalex_update", "adagrad_update", "adadelta_update",
+        "signsgd_update", "signum_update", "nag_mom_update",
+        "multi_sgd_update", "multi_sgd_mom_update", "multi_sum_sq",
+        "multi_lars", "mp_sgd_update", "mp_sgd_mom_update",
+    ],  # upstream LARS = multi_sum_sq + multi_lars (no lars_update op)
+    "contrib/detection": [
+        "MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection", "box_nms",
+        "box_iou", "bipartite_matching", "ROIAlign", "Proposal",
+        "mrcnn_mask_target",
+    ],
+    "contrib/transformer": [
+        "interleaved_matmul_selfatt_qk", "interleaved_matmul_selfatt_valatt",
+        "interleaved_matmul_encdec_qk", "interleaved_matmul_encdec_valatt",
+        "div_sqrt_dim", "sldwin_atten_mask_like", "sldwin_atten_score",
+        "sldwin_atten_context",
+    ],
+    "contrib/misc": [
+        "index_copy", "AdaptiveAvgPooling2D", "BilinearResize2D",
+        "DeformableConvolution", "count_sketch", "hawkes_ll", "isnan",
+        "isinf", "isfinite", "group_adagrad_update", "boolean_mask",
+        "foreach", "while_loop", "cond", "gradientmultiplier",
+    ],
+    "quantization": [
+        "quantize", "dequantize", "quantize_v2", "quantized_conv",
+        "quantized_fully_connected",
+    ],
+    "linalg": [
+        "gemm", "gemm2", "potrf", "trsm", "trmm", "syrk", "det", "inverse",
+        "slogdet", "gesvd", "syevd", "gelqf", "sumlogdiag", "extractdiag",
+        "makediag",
+    ],
+    "sparse": ["retain", "row_sparse_array", "csr_matrix"],
+}
+
+# every absence must appear here with a reason
+JUSTIFIED_ABSENT = {
+    "fusion/*": "NVRTC pointwise fusion is XLA's job on TPU (SURVEY §7.3 "
+                "substitution; rtc.py gates the user surface).",
+    "subgraph/*": "graph-partition offload (oneDNN/TensorRT) replaced by "
+                  "XLA partitioning; ONNX path exists in contrib.onnx.",
+    "cudnn/mkldnn wrappers": "vendor-kernel dispatch is XLA:TPU's job.",
+}
+
+
+def _has(ns, name):
+    return hasattr(ns, name)
+
+
+def main():
+    nd = mx.nd
+    spaces = [nd, getattr(nd, "contrib", None), getattr(nd, "sparse", None),
+              getattr(nd, "linalg", None), getattr(mx, "sym", None)]
+    from incubator_mxnet_tpu.gluon import rnn as grnn
+
+    lines = [
+        "# Operator-registry audit (round 4)",
+        "",
+        "Generated by `tools/op_audit.py` — SURVEY.md §2.1 op families vs "
+        "the live namespaces. Names are probed on `mx.nd`, `mx.nd.contrib`,"
+        " `mx.nd.sparse`, `mx.nd.linalg`, `mx.sym`, and `gluon.rnn`.",
+        "",
+        "| family | probed | present | absent |",
+        "|---|---|---|---|",
+    ]
+    absent_all = []
+    total = found_total = 0
+    for fam, names in FAMILIES.items():
+        present, absent = [], []
+        for n in names:
+            ok = any(s is not None and _has(s, n) for s in spaces)
+            if not ok and fam == "rnn":
+                ok = _has(grnn, n)
+            if not ok and fam == "random":
+                ok = any(s is not None and
+                         (_has(s, "random_" + n) or _has(s, "sample_" + n))
+                         for s in spaces)
+            if not ok and fam == "linalg":
+                ok = _has(nd, "linalg_" + n) or (
+                    hasattr(nd, "linalg") and _has(nd.linalg, n))
+            (present if ok else absent).append(n)
+        total += len(names)
+        found_total += len(present)
+        lines.append(f"| {fam} | {len(names)} | {len(present)} | "
+                     f"{', '.join(absent) if absent else '—'} |")
+        absent_all += [(fam, n) for n in absent]
+
+    lines += ["", f"**Totals: {found_total}/{total} probed names present.**",
+              ""]
+    if absent_all:
+        lines += ["## Absences and justifications", ""]
+        for fam, n in absent_all:
+            lines.append(f"- `{fam}/{n}`: UNEXPLAINED — add or justify.")
+    lines += ["", "## Families substituted wholesale (SURVEY §7.3)", ""]
+    for k, v in JUSTIFIED_ABSENT.items():
+        lines.append(f"- `{k}`: {v}")
+    out = os.path.join(os.path.dirname(__file__), "..", "docs",
+                       "OP_AUDIT.md")
+    with open(out, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(f"wrote {out}: {found_total}/{total} present, "
+          f"{len(absent_all)} absent")
+    for fam, n in absent_all:
+        print(f"  ABSENT {fam}/{n}")
+
+
+if __name__ == "__main__":
+    main()

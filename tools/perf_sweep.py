@@ -38,6 +38,10 @@ CONFIGS = [
     {"name": "b56", "env": {"MXTPU_BENCH_BATCH": "56"}},
     {"name": "b64-remat", "env": {"MXTPU_BENCH_BATCH": "64",
                                   "MXTPU_BENCH_REMAT": "1"}},
+    {"name": "b64-remat-dots", "env": {"MXTPU_BENCH_BATCH": "64",
+                                       "MXTPU_BENCH_REMAT": "dots"}},
+    {"name": "b96-remat-dots", "env": {"MXTPU_BENCH_BATCH": "96",
+                                       "MXTPU_BENCH_REMAT": "dots"}},
     {"name": "b48-rbg-nodrop", "env": {"MXTPU_BENCH_BATCH": "48",
                                        "JAX_DEFAULT_PRNG_IMPL": "rbg",
                                        "MXTPU_BENCH_DROPOUT": "0"}},
@@ -46,6 +50,12 @@ CONFIGS = [
     {"name": "large-b16-remat", "env": {"MXTPU_BENCH_MODEL": "large",
                                         "MXTPU_BENCH_BATCH": "16",
                                         "MXTPU_BENCH_REMAT": "1"}},
+    {"name": "large-b24-remat-dots", "env": {"MXTPU_BENCH_MODEL": "large",
+                                             "MXTPU_BENCH_BATCH": "24",
+                                             "MXTPU_BENCH_REMAT": "dots"}},
+    {"name": "large-b32-remat-dots", "env": {"MXTPU_BENCH_MODEL": "large",
+                                             "MXTPU_BENCH_BATCH": "32",
+                                             "MXTPU_BENCH_REMAT": "dots"}},
 ]
 
 

@@ -1,0 +1,46 @@
+#!/bin/bash
+# Unattended TPU measurement ladder. The axon tunnel is up in short,
+# unpredictable windows (see TPU_STATUS.md); this loop probes every 3
+# minutes and, inside a window, runs each not-yet-measured bench config
+# once, banking one JSON per config under TPU_RUNS_r04/. Re-entrant:
+# configs that already produced a JSON are skipped, so a second window
+# resumes where the first died.
+cd "$(dirname "$0")/.." || exit 1
+LOG=TPU_RUNS_r04
+mkdir -p "$LOG"
+
+run() { # run NAME TIMEOUT [ENV=VAL...]
+  local name=$1 to=$2; shift 2
+  [ -s "$LOG/$name.json" ] && return 0
+  echo "$(date -u +%H:%M:%S) start $name" >> "$LOG/watch.log"
+  env "$@" timeout "$to" python bench.py --run --workload "${WL:-bert}" \
+    > "$LOG/$name.out" 2> "$LOG/$name.err"
+  grep BENCH_RESULT "$LOG/$name.out" | tail -1 | sed 's/BENCH_RESULT //' \
+    > "$LOG/$name.json" || true
+  [ -s "$LOG/$name.json" ] || rm -f "$LOG/$name.json"
+  echo "$(date -u +%H:%M:%S) done $name: $(head -c 200 "$LOG/$name.json" 2>/dev/null)" >> "$LOG/watch.log"
+}
+
+while true; do
+  if timeout 90 python -c "import jax; assert any(d.platform!='cpu' for d in jax.devices())" 2>/dev/null; then
+    echo "$(date -u +%H:%M:%S) window OPEN" >> "$LOG/watch.log"
+    run base-b48 700
+    run base-b48-trace 700 MXTPU_BENCH_TRACE=trace_r4
+    run large-b16 950 MXTPU_BENCH_MODEL=large MXTPU_BENCH_BATCH=16
+    run large-b24-dots 950 MXTPU_BENCH_MODEL=large MXTPU_BENCH_BATCH=24 MXTPU_BENCH_REMAT=dots
+    run large-b32-dots 950 MXTPU_BENCH_MODEL=large MXTPU_BENCH_BATCH=32 MXTPU_BENCH_REMAT=dots
+    run b64-dots 700 MXTPU_BENCH_BATCH=64 MXTPU_BENCH_REMAT=dots
+    run b96-dots 700 MXTPU_BENCH_BATCH=96 MXTPU_BENCH_REMAT=dots
+    run b48-rbg 700 JAX_DEFAULT_PRNG_IMPL=rbg
+    run b48-nodrop 700 MXTPU_BENCH_DROPOUT=0
+    WL=resnet run resnet-b64 700
+    WL=nmt run nmt-decode 700
+    echo "$(date -u +%H:%M:%S) ladder pass complete" >> "$LOG/watch.log"
+    # everything measured? stop probing.
+    n=$(ls "$LOG"/*.json 2>/dev/null | wc -l)
+    [ "$n" -ge 11 ] && { echo "$(date -u +%H:%M:%S) ALL DONE" >> "$LOG/watch.log"; exit 0; }
+  else
+    echo "$(date -u +%H:%M:%S) down" >> "$LOG/watch.log"
+  fi
+  sleep 180
+done
