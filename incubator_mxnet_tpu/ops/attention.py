@@ -52,7 +52,10 @@ def _sdpa_blockwise(q, k, v, key_mask, causal, scale, block_k: int = 512):
         key_mask = jnp.pad(key_mask, ((0, 0), (0, pk)))
     nk = (Tk + pk) // block_k
 
-    qf = (q * scale).astype(jnp.float32)
+    # operands keep the input dtype (bf16 -> full-rate MXU); scores and
+    # the streaming statistics accumulate in f32, and the scale is
+    # applied to the f32 scores (scaling a bf16 q would round it)
+    qf = q
     k_blocks = jnp.moveaxis(k.reshape(B, nk, block_k, H, D), 1, 0)
     v_blocks = jnp.moveaxis(v.reshape(B, nk, block_k, H, D), 1, 0)
     m_blocks = jnp.moveaxis(key_mask.reshape(B, nk, block_k), 1, 0)
@@ -66,7 +69,8 @@ def _sdpa_blockwise(q, k, v, key_mask, causal, scale, block_k: int = 512):
     def body(carry, inp):
         acc, row_max, row_sum = carry
         blk_idx, k_blk, v_blk, m_blk = inp
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32))
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk,
+                       preferred_element_type=jnp.float32) * scale
         allow = m_blk[:, None, None, :]                       # (B,1,1,block)
         if causal:
             # bottom-right aligned for Tq != Tk (KV-cache convention):
@@ -81,7 +85,8 @@ def _sdpa_blockwise(q, k, v, key_mask, causal, scale, block_k: int = 512):
         corr = jnp.exp(row_max - new_max)
         p = jnp.exp(s - jnp.moveaxis(new_max, -1, 1)[..., None])
         acc = acc * corr[..., None] + jnp.einsum(
-            "bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32))
+            "bhqk,bkhd->bqhd", p.astype(v_blk.dtype), v_blk,
+            preferred_element_type=jnp.float32)
         row_sum = row_sum * corr + jnp.moveaxis(p.sum(-1), 1, -1)
         return (acc, new_max, row_sum), None
 

@@ -77,7 +77,11 @@ def _flash_kernel(vl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32) * scale          # (bq, D)
+    # dot OPERANDS stay in the input dtype (bf16 inputs hit the MXU at
+    # full rate — an f32 upcast here quarters matmul throughput); all
+    # ACCUMULATION (s, m, l, acc) is f32 via preferred_element_type.
+    # The scale is applied to the f32 scores, not the narrow operands.
+    q = q_ref[0, 0]                                      # (bq, D)
     bq, D = q.shape
     vl = vl_ref[0, 0]                                    # valid key length
     q_pos = qi * block_q + lax.broadcasted_iota(
@@ -85,9 +89,9 @@ def _flash_kernel(vl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
 
     def body(j, carry):
         m, l, acc = carry
-        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         k_pos = j * block_k + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         mask = k_pos < vl
@@ -99,7 +103,7 @@ def _flash_kernel(vl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1)
         acc_new = acc * alpha[:, None] + jnp.dot(
-            p, v, preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
     m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
@@ -189,8 +193,10 @@ def _flash_bwd_dq_kernel(vl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32)                   # (bq, D)
-    do = do_ref[0, 0].astype(jnp.float32)                 # (bq, D)
+    # same dtype discipline as the forward kernel: dot operands keep the
+    # input dtype (bf16 -> full-rate MXU), accumulators/statistics f32
+    q = q_ref[0, 0]                                       # (bq, D)
+    do = do_ref[0, 0]                                     # (bq, D)
     lse = lse_ref[0, 0].astype(jnp.float32)               # (bq,)
     delta = delta_ref[0, 0].astype(jnp.float32)           # (bq,)
     vl = vl_ref[0, 0]
@@ -199,8 +205,8 @@ def _flash_bwd_dq_kernel(vl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         jnp.int32, (block_q, block_k), 0)
 
     def body(j, dq):
-        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         k_pos = j * block_k + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
@@ -209,7 +215,7 @@ def _flash_bwd_dq_kernel(vl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             mask = mask & (k_pos <= q_pos)
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = (p * (dp - delta[:, None]) * scale).astype(k.dtype)
         return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
     dq = lax.fori_loop(0, n_k_blocks, body, jnp.zeros((bq, D), jnp.float32))
@@ -222,8 +228,9 @@ def _flash_bwd_dkv_kernel(vl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     from jax.experimental import pallas as pl
 
     ki = pl.program_id(2)
-    k = k_ref[0, 0].astype(jnp.float32)                   # (bk, D)
-    v = v_ref[0, 0].astype(jnp.float32)                   # (bk, D)
+    # dot operands keep the input dtype; accumulators f32 (see forward)
+    k = k_ref[0, 0]                                       # (bk, D)
+    v = v_ref[0, 0]                                       # (bk, D)
     vl = vl_ref[0, 0]
     bk, D = k.shape
     k_pos = ki * block_k + lax.broadcasted_iota(
@@ -231,8 +238,8 @@ def _flash_bwd_dkv_kernel(vl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     def body(i, carry):
         dk, dv = carry
-        q = q_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[0, 0, pl.ds(i * block_q, block_q), :]
+        do = do_ref[0, 0, pl.ds(i * block_q, block_q), :]
         lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)].astype(jnp.float32)
         delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)] \
             .astype(jnp.float32)
@@ -243,9 +250,10 @@ def _flash_bwd_dkv_kernel(vl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                 jnp.int32, (block_q, block_k), 0)
             mask = mask & (k_pos <= q_pos)
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)   # (bq, bk)
-        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dv = dv + jnp.dot(p.astype(do.dtype).T, do,
+                          preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
         dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
         return dk, dv
 

@@ -44,14 +44,18 @@ def active_ring_mesh(seq_len: int):
 _NEG_INF = -1e30
 
 
-def _stream_block(q, k, v, acc, row_max, row_sum, mask):
+def _stream_block(q, k, v, acc, row_max, row_sum, mask, scale=1.0):
     """One flash-attention accumulation step.
 
     q: (B, Tq, H, D); k/v: (B, Tk, H, D); acc: (B, Tq, H, D);
     row_max/row_sum: (B, Tq, H); mask: additive, either (Tq, Tk) shared
     or (B, Tq, Tk) per-batch (the valid_length form), or None.
     """
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+    # dot operands keep their input dtype (bf16 rides the MXU at full
+    # rate); scores/statistics accumulate in f32 with the scale applied
+    # to the f32 scores (scaling a bf16 q would round it)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
     if mask is not None:
         # (Tq, Tk) shared mask or (B, Tq, Tk) per-batch (valid_length)
         scores = scores + (mask[None, None] if mask.ndim == 2
@@ -61,7 +65,8 @@ def _stream_block(q, k, v, acc, row_max, row_sum, mask):
     new_max = jnp.maximum(row_max, blk_max)
     corr = jnp.exp(row_max - new_max)                   # (B,Tq,H)
     p = jnp.exp(scores - jnp.moveaxis(new_max, -1, 1)[..., None])  # (B,H,Tq,Tk)
-    blk_out = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    blk_out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                         preferred_element_type=jnp.float32)
     blk_sum = jnp.moveaxis(p.sum(axis=-1), 1, -1)       # (B,Tq,H)
     acc = acc * corr[..., None] + blk_out
     row_sum = row_sum * corr + blk_sum
@@ -84,7 +89,6 @@ def ring_attention_block(q, k, v, valid_length=None,
     size = lax.psum(1, axis_name)
     if scale is None:
         scale = D ** -0.5
-    q = q * scale
 
     acc = jnp.zeros(q.shape, jnp.float32)
     row_max = jnp.full((B, Tq, H), _NEG_INF, jnp.float32)
@@ -99,7 +103,7 @@ def ring_attention_block(q, k, v, valid_length=None,
     acc, row_max, row_sum = jax.tree_util.tree_map(
         lambda x: lax.pcast(x, cast_axes, to="varying"),
         (acc, row_max, row_sum))
-    qf = q.astype(jnp.float32)
+    qf = q  # input dtype into the block einsums (f32 accumulation inside)
 
     pos_q = n * Tq + jnp.arange(Tq)
 
@@ -121,8 +125,7 @@ def ring_attention_block(q, k, v, valid_length=None,
                                         vl_mask.shape[1]))
             mask = vl_mask if mask is None else mask[None] + vl_mask
         acc, row_max, row_sum = _stream_block(
-            qf, k_cur.astype(jnp.float32), v_cur.astype(jnp.float32),
-            acc, row_max, row_sum, mask)
+            qf, k_cur, v_cur, acc, row_max, row_sum, mask, scale=scale)
         # rotate k/v one hop around the ring (device i -> i+1)
         perm = [(i, (i + 1) % size) for i in range(size)]
         k_nxt = lax.ppermute(k_cur, axis_name, perm)

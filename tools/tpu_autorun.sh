@@ -36,6 +36,7 @@ while true; do
     WL=resnet run resnet-b64 700
     WL=nmt run nmt-decode 700
     echo "$(date -u +%H:%M:%S) ladder pass complete" >> "$LOG/watch.log"
+    python tools/collect_runs.py >> "$LOG/watch.log" 2>&1
     # everything measured? stop probing.
     n=$(ls "$LOG"/*.json 2>/dev/null | wc -l)
     [ "$n" -ge 11 ] && { echo "$(date -u +%H:%M:%S) ALL DONE" >> "$LOG/watch.log"; exit 0; }
