@@ -84,16 +84,21 @@ class BERTSelfAttention(HybridBlock):
         if self._seq_parallel and (mask is None or valid_length is not None):
             from ..parallel.ring_attention import active_ring_mesh
             mesh = active_ring_mesh(T)
+        # LENGTH form passes through both branches — it is what lets the
+        # Pallas flash kernel engage on TPU (a boolean mask alone forces
+        # the jnp fallback; see sdpa docstring)
+        vl = valid_length.astype("int32") \
+            if valid_length is not None else None
         if mesh is not None:
             from ..parallel.ring_attention import ring_self_attention
-            vl = valid_length.astype("int32")._data \
-                if valid_length is not None else None
             out = NDArray(ring_self_attention(
                 q._data, k._data, v._data, mesh=mesh, causal=False,
-                batch_axis=("dp", "fsdp"), valid_length=vl))
+                batch_axis=("dp", "fsdp"),
+                valid_length=vl._data if vl is not None else None))
         else:
             out = F.scaled_dot_product_attention(q, k, v, mask=mask,
-                                                 flash=self._flash)
+                                                 flash=self._flash,
+                                                 valid_length=vl)
         out = constrain(out, ("dp", "fsdp"), seq_ax, "tp", None)
         out = out.reshape((B, T, self._units))
         return constrain(self.dropout(self.proj(out)),

@@ -99,11 +99,21 @@ def _sdpa_blockwise(q, k, v, key_mask, causal, scale, block_k: int = 512):
 
 @register("scaled_dot_product_attention", aliases=("sdpa",))
 def scaled_dot_product_attention(q, k, v, mask=None, scale=None,
-                                 causal=False, flash=False):
+                                 causal=False, flash=False,
+                                 valid_length=None):
     """Multi-head attention core. q/k/v: (B, T, H, D). ``mask`` is either a
     key-padding mask (B, Tk) or broadcastable to (B, H, Tq, Tk), True =
     attend. Returns (B, Tq, H, D). ``flash=True`` uses the blockwise
-    streaming evaluation (key-padding/causal masks only)."""
+    streaming evaluation (key-padding/causal masks only).
+
+    ``valid_length`` (B,) key lengths: the TPU Pallas kernel needs the
+    mask in LENGTH form — a (B, Tk) boolean ``mask`` alone sends the
+    flash path to the jnp fallback (a boolean mask cannot be converted
+    back to lengths under jit), so length-mask callers should pass this
+    through for the real kernel to engage. When BOTH ``mask`` and
+    ``valid_length`` are given they must describe the same keep-set
+    (the kernel uses the lengths, other paths AND the two — this cannot
+    be validated under jit, see use_flash_attention)."""
     D = q.shape[-1]
     if scale is None:
         scale = D ** -0.5
@@ -112,11 +122,18 @@ def scaled_dot_product_attention(q, k, v, mask=None, scale=None,
         # otherwise — same streaming-softmax math either way
         from .pallas_attention import use_flash_attention
         return use_flash_attention(q, k, v, key_mask=mask, causal=causal,
-                                   scale=scale)
+                                   scale=scale, valid_length=valid_length)
     Tq, Tk = q.shape[1], k.shape[1]
     m = mask
     if m is not None and m.ndim == 2:
         m = m[:, None, None, :]                               # key padding
+    if valid_length is not None:
+        # honor the length form on the dense path too (silently
+        # attending padding keys would be wrong whenever the caller
+        # passes lengths without a boolean mask)
+        vlm = (lax.broadcasted_iota(jnp.int32, (1, 1, 1, Tk), 3) <
+               valid_length.astype(jnp.int32)[:, None, None, None])
+        m = vlm if m is None else jnp.logical_and(m.astype(bool), vlm)
     if causal:
         # bottom-right aligned when Tq != Tk (queries sit at the END of
         # the key buffer — the KV-cache decode convention; top-left

@@ -32,6 +32,7 @@ mesh — via ``interpret=True`` or the pure-jnp blockwise path.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -411,11 +412,20 @@ def use_flash_attention(q, k, v, key_mask=None, causal=False, scale=None,
     contiguous-prefix form every bucketing/padding pipeline produces.
     Arbitrary boolean masks fall back to the pure-jnp blockwise path
     (same math, XLA-fused). Dispatch is static: no data-dependent
-    branching, safe under jit."""
+    branching, safe under jit.
+
+    PRECEDENCE when both key_mask and valid_length are given: the two
+    must describe the same keep-set (a prefix per batch row). The TPU
+    kernel consumes the lengths; the fallback ANDs both, so a
+    non-prefix key_mask combined with lengths would diverge between
+    platforms — that combination is a caller bug which cannot be
+    validated under jit (the check would be data-dependent)."""
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     on_tpu = any(d.platform == "tpu" for d in jax.devices()) \
         and _pallas_available()
+    if os.environ.get("MXTPU_FLASH_FORCE_FALLBACK") == "1":
+        on_tpu = False  # A/B lever: measure jnp blockwise vs the kernel
     if valid_length is None and key_mask is None:
         valid_length = jnp.full((B,), Tk, jnp.int32)
     # the Pallas kernel's causal grid assumes square Tq == Tk; offset
@@ -426,9 +436,11 @@ def use_flash_attention(q, k, v, key_mask=None, causal=False, scale=None,
     if not (on_tpu and valid_length is not None and D <= 256):
         from .attention import _sdpa_blockwise
         sc = D ** -0.5 if scale is None else scale
-        if key_mask is None and valid_length is not None:
-            key_mask = lax.broadcasted_iota(jnp.int32, (B, Tk), 1) < \
+        if valid_length is not None:
+            vlm = lax.broadcasted_iota(jnp.int32, (B, Tk), 1) < \
                 valid_length.astype(jnp.int32)[:, None]
+            key_mask = vlm if key_mask is None else \
+                jnp.logical_and(key_mask.astype(bool), vlm)
         return _sdpa_blockwise(q, k, v, key_mask, causal, sc)
     out = flash_attention_bhtd(q.transpose(0, 2, 1, 3),
                                k.transpose(0, 2, 1, 3),

@@ -248,3 +248,51 @@ def test_kernel_bf16_operands_match_f32_reference():
     for gf, gr in zip(g_flash, g_f32):
         np.testing.assert_allclose(np.asarray(gf, np.float32),
                                    np.asarray(gr), rtol=0.1, atol=0.1)
+
+
+def test_sdpa_valid_length_equals_boolean_mask():
+    """sdpa(flash=True, valid_length=vl) must equal the (B,Tk) boolean
+    mask form — valid_length is the form that engages the TPU Pallas
+    kernel (a boolean mask alone falls back to the jnp path), so the
+    two spellings must be interchangeable."""
+    from incubator_mxnet_tpu import nd
+
+    rng = np.random.RandomState(0)
+    B, T, H, D = 2, 24, 2, 8
+    q = nd.array(rng.randn(B, T, H, D).astype(np.float32))
+    k = nd.array(rng.randn(B, T, H, D).astype(np.float32))
+    v = nd.array(rng.randn(B, T, H, D).astype(np.float32))
+    vl = np.array([T, 13], np.int32)
+    mask = nd.array((np.arange(T)[None, :] < vl[:, None])
+                    .astype(np.float32))
+    out_mask = nd.scaled_dot_product_attention(q, k, v, mask=mask,
+                                               flash=True)
+    out_vl = nd.scaled_dot_product_attention(
+        q, k, v, flash=True, valid_length=nd.array(vl, dtype="int32"))
+    # rows beyond a batch's valid length attend nothing in the vl form;
+    # compare the valid region
+    a, b = out_mask.asnumpy(), out_vl.asnumpy()
+    np.testing.assert_allclose(a[0], b[0], rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(a[1, :13], b[1, :13], rtol=1e-5, atol=1e-5)
+
+
+def test_sdpa_dense_path_honors_valid_length():
+    """The non-flash dense path must mask padding keys when only
+    valid_length (no boolean mask) is given."""
+    from incubator_mxnet_tpu import nd
+
+    rng = np.random.RandomState(1)
+    B, T, H, D = 2, 10, 1, 4
+    q = rng.randn(B, T, H, D).astype(np.float32)
+    k = rng.randn(B, T, H, D).astype(np.float32)
+    v = rng.randn(B, T, H, D).astype(np.float32)
+    vl = np.array([T, 6], np.int32)
+    mask = nd.array((np.arange(T)[None, :] < vl[:, None])
+                    .astype(np.float32))
+    out_vl = nd.scaled_dot_product_attention(
+        nd.array(q), nd.array(k), nd.array(v),
+        valid_length=nd.array(vl, dtype="int32"))           # flash=False
+    out_mask = nd.scaled_dot_product_attention(
+        nd.array(q), nd.array(k), nd.array(v), mask=mask)
+    np.testing.assert_allclose(out_vl.asnumpy(), out_mask.asnumpy(),
+                               rtol=1e-5, atol=1e-5)

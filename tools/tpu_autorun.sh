@@ -33,13 +33,14 @@ while true; do
     run b96-dots 700 MXTPU_BENCH_BATCH=96 MXTPU_BENCH_REMAT=dots
     run b48-rbg 700 JAX_DEFAULT_PRNG_IMPL=rbg
     run b48-nodrop 700 MXTPU_BENCH_DROPOUT=0
+    run b48-jnpflash 700 MXTPU_FLASH_FORCE_FALLBACK=1
     WL=resnet run resnet-b64 700
     WL=nmt run nmt-decode 700
     echo "$(date -u +%H:%M:%S) ladder pass complete" >> "$LOG/watch.log"
     python tools/collect_runs.py >> "$LOG/watch.log" 2>&1
     # everything measured? stop probing.
     n=$(ls "$LOG"/*.json 2>/dev/null | wc -l)
-    [ "$n" -ge 11 ] && { echo "$(date -u +%H:%M:%S) ALL DONE" >> "$LOG/watch.log"; exit 0; }
+    [ "$n" -ge 12 ] && { echo "$(date -u +%H:%M:%S) ALL DONE" >> "$LOG/watch.log"; exit 0; }
   else
     echo "$(date -u +%H:%M:%S) down" >> "$LOG/watch.log"
   fi
