@@ -36,7 +36,8 @@ def lib() -> Optional[ctypes.CDLL]:
             if os.environ.get("MXTPU_NO_NATIVE"):
                 return None
             try:
-                subprocess.run(["make", "-C", _SRC_DIR], check=True,
+                subprocess.run(["make", "-C", _SRC_DIR, "io"],
+                               check=True,
                                capture_output=True, timeout=120)
             except Exception:
                 return None

@@ -1,0 +1,99 @@
+"""ctypes binding for the native JPEG decode engine
+(src/io/image_decode_native.cc).
+
+Batched, GIL-free decode + bilinear resize on a C++ thread pool — the
+TPU-native counterpart of the reference's decode threads in
+src/io/iter_image_recordio_2.cc. Auto-builds with the sibling IO
+library; callers must handle ``lib() is None`` (no toolchain / no
+libjpeg) and fall back to cv2.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+_LOCK = threading.Lock()
+_LIB = None
+_TRIED = False
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "..", "src")
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "..", "lib",
+                         "libmxtpu_image.so")
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        if not os.path.exists(_LIB_PATH):
+            if os.environ.get("MXTPU_NO_NATIVE"):
+                return None
+            try:
+                subprocess.run(["make", "-C", _SRC_DIR, "image"],
+                               check=True,
+                               capture_output=True, timeout=120)
+            except Exception:
+                return None
+        try:
+            l = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        l.mxtpu_img_dims.restype = ctypes.c_int
+        l.mxtpu_img_dims.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+        l.mxtpu_img_decode_batch.restype = ctypes.c_int
+        l.mxtpu_img_decode_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int, ctypes.c_int, ctypes.c_void_p,
+            ctypes.c_int]
+        _LIB = l
+        return _LIB
+
+
+def decode_batch(payloads: Sequence[bytes], out_h: int, out_w: int,
+                 n_threads: int = 0) -> Optional[np.ndarray]:
+    """Decode JPEG byte strings to (N, out_h, out_w, 3) uint8 RGB with
+    bilinear resize, on a C++ thread pool. None when the native lib is
+    unavailable; raises ValueError on a malformed payload."""
+    l = lib()
+    if l is None or not payloads:
+        return None if l is None else np.zeros((0, out_h, out_w, 3),
+                                               np.uint8)
+    blob = b"".join(payloads)
+    lengths = np.array([len(p) for p in payloads], np.int64)
+    offsets = np.zeros(len(payloads), np.int64)
+    np.cumsum(lengths[:-1], out=offsets[1:])
+    out = np.empty((len(payloads), out_h, out_w, 3), np.uint8)
+    if n_threads <= 0:
+        n_threads = min(len(payloads), os.cpu_count() or 4)
+    rc = l.mxtpu_img_decode_batch(
+        blob, offsets.ctypes.data_as(ctypes.c_void_p),
+        lengths.ctypes.data_as(ctypes.c_void_p), len(payloads),
+        out_h, out_w, out.ctypes.data_as(ctypes.c_void_p), n_threads)
+    if rc != 0:
+        raise ValueError(
+            f"native JPEG decode failed for batch item {-rc - 1}")
+    return out
+
+
+def image_dims(payload: bytes):
+    """(width, height) of a JPEG without a full decode; None when the
+    native lib is unavailable; raises ValueError on malformed input."""
+    l = lib()
+    if l is None:
+        return None
+    w = ctypes.c_int()
+    h = ctypes.c_int()
+    if l.mxtpu_img_dims(payload, len(payload), ctypes.byref(w),
+                        ctypes.byref(h)) != 0:
+        raise ValueError("native JPEG header parse failed")
+    return w.value, h.value

@@ -49,6 +49,12 @@ def _probe() -> Dict[str, bool]:
     except Exception:  # pragma: no cover
         has_native_io = False
     try:
+        from .io import _native_image
+
+        has_native_jpeg = _native_image.lib() is not None
+    except Exception:  # pragma: no cover
+        has_native_jpeg = False
+    try:
         import cv2  # noqa: F401
 
         has_opencv = True
@@ -68,6 +74,7 @@ def _probe() -> Dict[str, bool]:
         # IO (reference: OPENCV/LIBJPEG rows)
         "OPENCV": has_opencv,
         "NATIVE_RECORDIO": has_native_io,
+        "NATIVE_JPEG": has_native_jpeg,
         "INT64_TENSOR_SIZE": True,
         "SIGNAL_HANDLER": True,
         "PROFILER": True,
