@@ -84,7 +84,10 @@ def _flash_kernel(vl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
     # The scale is applied to the f32 scores, not the narrow operands.
     q = q_ref[0, 0]                                      # (bq, D)
     bq, D = q.shape
-    vl = vl_ref[0, 0]                                    # valid key length
+    # lengths ride along as the full (B, 1) array in SMEM (Mosaic requires
+    # SMEM blocks tiled 8x128 OR equal to the array dims; (1,1) blocks of
+    # a (B,1) array violate that) — each program picks its batch row.
+    vl = vl_ref[pl.program_id(0), 0]                     # valid key length
     q_pos = qi * block_q + lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
 
@@ -92,7 +95,8 @@ def _flash_kernel(vl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
         m, l, acc = carry
         k = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
         v = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
+            precision=lax.Precision.DEFAULT) * scale
         k_pos = j * block_k + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         mask = k_pos < vl
@@ -104,7 +108,8 @@ def _flash_kernel(vl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1)
         acc_new = acc * alpha[:, None] + jnp.dot(
-            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32,
+            precision=lax.Precision.DEFAULT)
         return m_new, l_new, acc_new
 
     m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
@@ -113,7 +118,10 @@ def _flash_kernel(vl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
     m, l, acc = lax.fori_loop(0, n_k_blocks, body, (m0, l0, acc0))
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0, 0] = m + jnp.log(l_safe)
+    # lse carries a trailing singleton lane dim: Mosaic requires the last
+    # two block dims (8, 128)-tiled or equal to the array dims, which a
+    # (1, 1, block_q) block of a (B, H, Tq) array is not.
+    lse_ref[0, 0] = (m + jnp.log(l_safe))[:, None]
 
 
 def _pad_to(x, axis, multiple):
@@ -156,7 +164,7 @@ def _flash_fwd_lse(q, k, v, valid_len, causal=False, scale=None,
         kernel,
         grid=(B, H, Tq_p // block_q),
         in_specs=[
-            pl.BlockSpec((1, 1), lambda b, h, i: (b, 0),
+            pl.BlockSpec((B, 1), lambda b, h, i: (0, 0),
                          memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
             pl.BlockSpec((1, 1, Tk_p, D), lambda b, h, i: (b, h, 0, 0)),
@@ -164,15 +172,15 @@ def _flash_fwd_lse(q, k, v, valid_len, causal=False, scale=None,
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i: (b, h, i)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, Tq_p, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, Tq_p), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, Tq_p, 1), jnp.float32),
         ],
         interpret=interpret,
     )(vl, q, k, v)
-    return out[:, :, :Tq, :], lse[:, :, :Tq]
+    return out[:, :, :Tq, :], lse[:, :, :Tq, 0]
 
 
 def _flash_forward(q, k, v, valid_len, causal=False, scale=None,
@@ -198,9 +206,9 @@ def _flash_bwd_dq_kernel(vl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     # input dtype (bf16 -> full-rate MXU), accumulators/statistics f32
     q = q_ref[0, 0]                                       # (bq, D)
     do = do_ref[0, 0]                                     # (bq, D)
-    lse = lse_ref[0, 0].astype(jnp.float32)               # (bq,)
-    delta = delta_ref[0, 0].astype(jnp.float32)           # (bq,)
-    vl = vl_ref[0, 0]
+    lse = lse_ref[0, 0, :, 0].astype(jnp.float32)         # (bq,)
+    delta = delta_ref[0, 0, :, 0].astype(jnp.float32)     # (bq,)
+    vl = vl_ref[pl.program_id(0), 0]
     bq, D = q.shape
     q_pos = qi * block_q + lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
@@ -208,16 +216,19 @@ def _flash_bwd_dq_kernel(vl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     def body(j, dq):
         k = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
         v = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
+            precision=lax.Precision.DEFAULT) * scale
         k_pos = j * block_k + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         mask = k_pos < vl
         if causal:
             mask = mask & (k_pos <= q_pos)
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
-        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32,
+            precision=lax.Precision.DEFAULT)
         ds = (p * (dp - delta[:, None]) * scale).astype(k.dtype)
-        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32,
+            precision=lax.Precision.DEFAULT)
 
     dq = lax.fori_loop(0, n_k_blocks, body, jnp.zeros((bq, D), jnp.float32))
     dq_ref[0, 0] = dq.astype(dq_ref.dtype)
@@ -232,7 +243,7 @@ def _flash_bwd_dkv_kernel(vl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     # dot operands keep the input dtype; accumulators f32 (see forward)
     k = k_ref[0, 0]                                       # (bk, D)
     v = v_ref[0, 0]                                       # (bk, D)
-    vl = vl_ref[0, 0]
+    vl = vl_ref[pl.program_id(0), 0]
     bk, D = k.shape
     k_pos = ki * block_k + lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
@@ -241,10 +252,12 @@ def _flash_bwd_dkv_kernel(vl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dk, dv = carry
         q = q_ref[0, 0, pl.ds(i * block_q, block_q), :]
         do = do_ref[0, 0, pl.ds(i * block_q, block_q), :]
-        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)].astype(jnp.float32)
-        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)] \
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q), 0] \
             .astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q), 0] \
+            .astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
+            precision=lax.Precision.DEFAULT) * scale
         mask = k_pos < vl
         if causal:
             q_pos = i * block_q + lax.broadcasted_iota(
@@ -252,10 +265,13 @@ def _flash_bwd_dkv_kernel(vl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             mask = mask & (k_pos <= q_pos)
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)   # (bq, bk)
         dv = dv + jnp.dot(p.astype(do.dtype).T, do,
-                          preferred_element_type=jnp.float32)
-        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+                          preferred_element_type=jnp.float32,
+            precision=lax.Precision.DEFAULT)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32,
+            precision=lax.Precision.DEFAULT)
         ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
-        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32,
+            precision=lax.Precision.DEFAULT)
         return dk, dv
 
     dk0 = jnp.zeros((bk, D), jnp.float32)
@@ -286,8 +302,10 @@ def _flash_backward(q, k, v, valid_len, out, lse, g, causal=False,
 
     qp, _ = _pad_to(q, 2, block_q)
     dop, _ = _pad_to(g.astype(q.dtype), 2, block_q)
-    lsep, _ = _pad_to(lse, 2, block_q)
-    deltap, _ = _pad_to(delta, 2, block_q)
+    # trailing singleton lane dim for the same Mosaic tiling reason as the
+    # forward's lse output
+    lsep = _pad_to(lse, 2, block_q)[0][..., None]
+    deltap = _pad_to(delta, 2, block_q)[0][..., None]
     kp, _ = _pad_to(k, 2, block_k)
     vp, _ = _pad_to(v, 2, block_k)
     Tq_p, Tk_p = qp.shape[2], kp.shape[2]
@@ -301,14 +319,14 @@ def _flash_backward(q, k, v, valid_len, out, lse, g, causal=False,
         dq_kernel,
         grid=(B, H, n_q_blocks),
         in_specs=[
-            pl.BlockSpec((1, 1), lambda b, h, i: (b, 0),
+            pl.BlockSpec((B, 1), lambda b, h, i: (0, 0),
                          memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
             pl.BlockSpec((1, 1, Tk_p, D), lambda b, h, i: (b, h, 0, 0)),
             pl.BlockSpec((1, 1, Tk_p, D), lambda b, h, i: (b, h, 0, 0)),
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i: (b, h, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i: (b, h, i)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, D),
                                lambda b, h, i: (b, h, i, 0)),
@@ -323,14 +341,14 @@ def _flash_backward(q, k, v, valid_len, out, lse, g, causal=False,
         dkv_kernel,
         grid=(B, H, n_k_blocks),
         in_specs=[
-            pl.BlockSpec((1, 1), lambda b, h, j: (b, 0),
+            pl.BlockSpec((B, 1), lambda b, h, j: (0, 0),
                          memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, Tq_p, D), lambda b, h, j: (b, h, 0, 0)),
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, j: (b, h, j, 0)),
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, j: (b, h, j, 0)),
             pl.BlockSpec((1, 1, Tq_p, D), lambda b, h, j: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, Tq_p), lambda b, h, j: (b, h, 0)),
-            pl.BlockSpec((1, 1, Tq_p), lambda b, h, j: (b, h, 0)),
+            pl.BlockSpec((1, 1, Tq_p, 1), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Tq_p, 1), lambda b, h, j: (b, h, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, j: (b, h, j, 0)),
