@@ -69,6 +69,21 @@ def _pallas_available():
         return False
 
 
+def _tile_mask(bq, bk, vl, causal, q_off=0, k_off=0):
+    """(bq, bk) boolean attend-mask for one score tile: keys < ``vl``,
+    optionally causal (top-left aligned — square Tq == Tk only, enforced
+    by use_flash_attention). ``q_off``/``k_off`` position the tile inside
+    the full (Tq, Tk) score matrix. Shared by ALL kernels (streaming
+    fwd/dq/dkv and dense fwd/bwd) so mask semantics cannot drift between
+    paths."""
+    k_pos = k_off + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    mask = k_pos < vl
+    if causal:
+        q_pos = q_off + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        mask = mask & (k_pos <= q_pos)
+    return mask
+
+
 # --------------------------------------------------------------------- #
 # forward kernel
 # --------------------------------------------------------------------- #
@@ -88,8 +103,6 @@ def _flash_kernel(vl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
     # SMEM blocks tiled 8x128 OR equal to the array dims; (1,1) blocks of
     # a (B,1) array violate that) — each program picks its batch row.
     vl = vl_ref[pl.program_id(0), 0]                     # valid key length
-    q_pos = qi * block_q + lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
 
     def body(j, carry):
         m, l, acc = carry
@@ -97,11 +110,8 @@ def _flash_kernel(vl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
         v = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
             precision=lax.Precision.DEFAULT) * scale
-        k_pos = j * block_k + lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        mask = k_pos < vl
-        if causal:
-            mask = mask & (k_pos <= q_pos)
+        mask = _tile_mask(block_q, block_k, vl, causal,
+                          q_off=qi * block_q, k_off=j * block_k)
         s = jnp.where(mask, s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
@@ -135,15 +145,22 @@ def _pad_to(x, axis, multiple):
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
-                                             "block_k", "interpret"))
+                                             "block_k", "interpret",
+                                             "dense"))
 def _flash_fwd_lse(q, k, v, valid_len, causal=False, scale=None,
-                   block_q=None, block_k=None, interpret=False):
-    """q/k/v: (B, H, T, D). Returns (out, lse) with lse (B, H, Tq)."""
+                   block_q=None, block_k=None, interpret=False,
+                   dense=False):
+    """q/k/v: (B, H, T, D). Returns (out, lse) with lse (B, H, Tq).
+    ``dense`` (static; resolve via _use_dense in the NON-jitted callers,
+    like the block knobs, so it is part of the jit cache key) selects the
+    single-tile kernel over the streaming one."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
+    if dense:
+        return _dense_fwd_lse(q, k, v, valid_len, causal, scale, interpret)
     scale = D ** -0.5 if scale is None else scale
     block_q = min(block_q or 128, max(Tq, 8))
     block_k = min(block_k or 128, max(Tk, 8))
@@ -186,10 +203,179 @@ def _flash_fwd_lse(q, k, v, valid_len, causal=False, scale=None,
 def _flash_forward(q, k, v, valid_len, causal=False, scale=None,
                    block_q=None, block_k=None, interpret=False):
     """Forward-only entry (kept for tests / direct use)."""
-    block_q, block_k = _resolve_blocks(block_q, block_k)
+    dense = _use_dense(q.shape[2], k.shape[2])
+    if not dense:                 # blocks are dead args on the dense path
+        block_q, block_k = _resolve_blocks(block_q, block_k)
     return _flash_fwd_lse(q, k, v, valid_len, causal=causal, scale=scale,
                           block_q=block_q, block_k=block_k,
-                          interpret=interpret)[0]
+                          interpret=interpret, dense=dense)[0]
+
+
+# --------------------------------------------------------------------- #
+# dense single-tile kernels (short sequences)
+# --------------------------------------------------------------------- #
+#
+# Profiling the streaming kernels on v5e (trace_r4) showed per-program
+# grid overhead dominating at short T: grid (B, H, T/128) is 2304
+# programs of ~0.2 ms ideal compute each, and the step spent 42% of its
+# time in attention at ~5% MXU utilization. For T where the whole
+# (Tq, Tk) score tile fits comfortably in VMEM there is no reason to
+# stream: one program per (batch, head) computes the full softmax in a
+# single shot (no online-softmax carry, no fori_loop), and the backward
+# fuses dq/dk/dv into ONE kernel so s and p are rebuilt once instead of
+# twice. Programs drop 4-8x and each does T/block_q times more work.
+# Long sequences (> MXTPU_FLASH_DENSE_T, default 1024) keep the
+# streaming FlashAttention-2 kernels above.
+
+def _use_dense(Tq, Tk):
+    """Static dispatch (shapes are trace-time constants). The env knob is
+    read at trace time: like the block-size knobs it must not change
+    between calls inside one process (bench runs one config per
+    process)."""
+    # Default 512 = the largest shape validated on v5e hardware. The
+    # fused dense backward's single-program working set grows as T^2
+    # (s/p/dp f32 tiles); T=1024 pencils out near the VMEM budget and
+    # has not been run on a real chip — raise the knob only with a
+    # measurement in hand.
+    limit = _env_block("MXTPU_FLASH_DENSE_T", 512)
+    return max(Tq, Tk) <= limit
+
+
+def _dense_fwd_kernel(vl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                      scale, causal):
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0, 0]                                       # (Tqp, D)
+    k = k_ref[0, 0]                                       # (Tkp, D)
+    v = v_ref[0, 0]
+    vl = vl_ref[pl.program_id(0), 0]
+    Tqp, Tkp = q.shape[0], k.shape[0]
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
+                precision=lax.Precision.DEFAULT) * scale
+    s = jnp.where(_tile_mask(Tqp, Tkp, vl, causal), s, _NEG_INF)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[:, None])
+    l = jnp.maximum(jnp.sum(p, axis=-1), 1e-30)
+    o = jnp.dot(p.astype(v.dtype), v, preferred_element_type=jnp.float32,
+                precision=lax.Precision.DEFAULT) / l[:, None]
+    o_ref[0, 0] = o.astype(o_ref.dtype)
+    lse_ref[0, 0] = (m + jnp.log(l))[:, None]
+
+
+def _dense_bwd_kernel(vl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                      delta_ref, dq_ref, dk_ref, dv_ref, *, scale, causal):
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0, 0]                                       # (Tqp, D)
+    k = k_ref[0, 0]                                       # (Tkp, D)
+    v = v_ref[0, 0]
+    do = do_ref[0, 0]
+    lse = lse_ref[0, 0, :, 0].astype(jnp.float32)         # (Tqp,)
+    delta = delta_ref[0, 0, :, 0].astype(jnp.float32)
+    vl = vl_ref[pl.program_id(0), 0]
+    Tqp, Tkp = q.shape[0], k.shape[0]
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
+                precision=lax.Precision.DEFAULT) * scale
+    mask = _tile_mask(Tqp, Tkp, vl, causal)
+    p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)   # (Tqp, Tkp)
+    dv = jnp.dot(p.astype(do.dtype).T, do,
+                 preferred_element_type=jnp.float32,
+                 precision=lax.Precision.DEFAULT)
+    dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32,
+                 precision=lax.Precision.DEFAULT)
+    ds = (p * (dp - delta[:, None]) * scale).astype(k.dtype)
+    dq_ref[0, 0] = jnp.dot(ds, k, preferred_element_type=jnp.float32,
+                           precision=lax.Precision.DEFAULT) \
+        .astype(dq_ref.dtype)
+    dk_ref[0, 0] = jnp.dot(ds.T, q, preferred_element_type=jnp.float32,
+                           precision=lax.Precision.DEFAULT) \
+        .astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _dense_fwd_lse(q, k, v, valid_len, causal, scale, interpret):
+    """Single-tile forward: grid (B, H), whole (Tq, Tk) per program."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    scale = D ** -0.5 if scale is None else scale
+    q, _ = _pad_to(q, 2, 8)          # sublane alignment for q rows
+    k, _ = _pad_to(k, 2, 128)        # lane alignment for score columns
+    v, _ = _pad_to(v, 2, 128)
+    Tq_p, Tk_p = q.shape[2], k.shape[2]
+    vl = jnp.minimum(valid_len.astype(jnp.int32), Tk).reshape(B, 1)
+    kernel = functools.partial(_dense_fwd_kernel, scale=scale,
+                               causal=causal)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(B, H),
+        in_specs=[
+            pl.BlockSpec((B, 1), lambda b, h: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, Tq_p, D), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Tk_p, D), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Tk_p, D), lambda b, h: (b, h, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, Tq_p, D), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Tq_p, 1), lambda b, h: (b, h, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Tq_p, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Tq_p, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(vl, q, k, v)
+    return out[:, :, :Tq, :], lse[:, :, :Tq, 0]
+
+
+def _dense_backward(q, k, v, valid_len, lse, g, delta, causal, scale,
+                    interpret):
+    """Fused single-tile backward: ONE kernel for dq, dk and dv."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    scale = D ** -0.5 if scale is None else scale
+    qp, _ = _pad_to(q, 2, 8)
+    dop = _pad_to(g.astype(q.dtype), 2, 8)[0]
+    lsep = _pad_to(lse, 2, 8)[0][..., None]
+    deltap = _pad_to(delta, 2, 8)[0][..., None]
+    kp, _ = _pad_to(k, 2, 128)
+    vp, _ = _pad_to(v, 2, 128)
+    Tq_p, Tk_p = qp.shape[2], kp.shape[2]
+    vl = jnp.minimum(valid_len.astype(jnp.int32), Tk).reshape(B, 1)
+    kernel = functools.partial(_dense_bwd_kernel, scale=scale,
+                               causal=causal)
+    dq, dk, dv = pl.pallas_call(
+        kernel,
+        grid=(B, H),
+        in_specs=[
+            pl.BlockSpec((B, 1), lambda b, h: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, Tq_p, D), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Tk_p, D), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Tk_p, D), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Tq_p, D), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Tq_p, 1), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Tq_p, 1), lambda b, h: (b, h, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, Tq_p, D), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Tk_p, D), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Tk_p, D), lambda b, h: (b, h, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Tq_p, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Tk_p, D), k.dtype),
+            jax.ShapeDtypeStruct((B, H, Tk_p, D), v.dtype),
+        ],
+        interpret=interpret,
+    )(vl, qp, kp, vp, dop, lsep, deltap)
+    return dq[:, :, :Tq, :], dk[:, :, :Tk, :], dv[:, :, :Tk, :]
 
 
 # --------------------------------------------------------------------- #
@@ -210,19 +396,14 @@ def _flash_bwd_dq_kernel(vl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     delta = delta_ref[0, 0, :, 0].astype(jnp.float32)     # (bq,)
     vl = vl_ref[pl.program_id(0), 0]
     bq, D = q.shape
-    q_pos = qi * block_q + lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
 
     def body(j, dq):
         k = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
         v = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
             precision=lax.Precision.DEFAULT) * scale
-        k_pos = j * block_k + lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        mask = k_pos < vl
-        if causal:
-            mask = mask & (k_pos <= q_pos)
+        mask = _tile_mask(block_q, block_k, vl, causal,
+                          q_off=qi * block_q, k_off=j * block_k)
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32,
             precision=lax.Precision.DEFAULT)
@@ -245,8 +426,6 @@ def _flash_bwd_dkv_kernel(vl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     v = v_ref[0, 0]                                       # (bk, D)
     vl = vl_ref[pl.program_id(0), 0]
     bk, D = k.shape
-    k_pos = ki * block_k + lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
 
     def body(i, carry):
         dk, dv = carry
@@ -258,11 +437,8 @@ def _flash_bwd_dkv_kernel(vl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             .astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
             precision=lax.Precision.DEFAULT) * scale
-        mask = k_pos < vl
-        if causal:
-            q_pos = i * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            mask = mask & (k_pos <= q_pos)
+        mask = _tile_mask(block_q, block_k, vl, causal,
+                          q_off=i * block_q, k_off=ki * block_k)
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)   # (bq, bk)
         dv = dv + jnp.dot(p.astype(do.dtype).T, do,
                           preferred_element_type=jnp.float32,
@@ -282,23 +458,30 @@ def _flash_bwd_dkv_kernel(vl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
-                                             "block_k", "interpret"))
+                                             "block_k", "interpret",
+                                             "dense"))
 def _flash_backward(q, k, v, valid_len, out, lse, g, causal=False,
                     scale=None, block_q=None, block_k=None,
-                    interpret=False):
-    """Pallas backward: returns (dq, dk, dv). Shapes as forward."""
+                    interpret=False, dense=False):
+    """Pallas backward: returns (dq, dk, dv). Shapes as forward.
+    ``dense`` static, resolved by the non-jitted callers (see
+    _flash_fwd_lse)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     scale = D ** -0.5 if scale is None else scale
-    block_q = min(block_q or 128, max(Tq, 8))
-    block_k = min(block_k or 128, max(Tk, 8))
 
     # Δ = rowsum(dO ⊙ O): cheap elementwise+reduce, XLA fuses it
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)                              # (B, H, Tq)
+
+    if dense:
+        return _dense_backward(q, k, v, valid_len, lse, g, delta, causal,
+                               scale, interpret)
+    block_q = min(block_q or 128, max(Tq, 8))
+    block_k = min(block_k or 128, max(Tk, 8))
 
     qp, _ = _pad_to(q, 2, block_q)
     dop, _ = _pad_to(g.astype(q.dtype), 2, block_q)
@@ -395,21 +578,26 @@ def flash_attention_bhtd(q, k, v, valid_len, causal=False, scale=None,
 
 
 def _fwd(q, k, v, valid_len, causal, scale, interpret):
-    block_q, block_k = _resolve_blocks(None, None)
+    dense = _use_dense(q.shape[2], k.shape[2])
+    block_q, block_k = (None, None) if dense else _resolve_blocks(None,
+                                                                  None)
     out, lse = _flash_fwd_lse(q, k, v, valid_len, causal=causal,
                               scale=scale, block_q=block_q,
-                              block_k=block_k, interpret=interpret)
+                              block_k=block_k, interpret=interpret,
+                              dense=dense)
     return out, (q, k, v, valid_len, out, lse)
 
 
 def _bwd(causal, scale, interpret, res, g):
     q, k, v, valid_len, out, lse = res
     if _pallas_available():
-        block_q, block_k = _resolve_blocks(None, None)
+        dense = _use_dense(q.shape[2], k.shape[2])
+        block_q, block_k = (None, None) if dense else \
+            _resolve_blocks(None, None)
         dq, dk, dv = _flash_backward(q, k, v, valid_len, out, lse, g,
                                      causal=causal, scale=scale,
                                      block_q=block_q, block_k=block_k,
-                                     interpret=interpret)
+                                     interpret=interpret, dense=dense)
         return dq, dk, dv, None
     _, vjp = jax.vjp(
         lambda q_, k_, v_: _reference_blockwise(q_, k_, v_, valid_len,
@@ -522,7 +710,8 @@ def block_attn_lse(q, k, v, valid_len, causal=False, scale=None,
     non-differentiable."""
     if _pallas_runnable(interpret):
         return _flash_fwd_lse(q, k, v, valid_len, causal=causal,
-                              scale=scale, interpret=interpret)
+                              scale=scale, interpret=interpret,
+                              dense=_use_dense(q.shape[2], k.shape[2]))
     return _dense_attn_lse(q, k, v, valid_len, causal, scale)
 
 
@@ -558,7 +747,9 @@ def _block_bwd(causal, scale, interpret, res, g):
     if _pallas_runnable(interpret):
         dq, dk, dv = _flash_backward(q, k, v, valid_len, out, lse, g_out,
                                      causal=causal, scale=scale,
-                                     interpret=interpret)
+                                     interpret=interpret,
+                                     dense=_use_dense(q.shape[2],
+                                                      k.shape[2]))
         return dq, dk, dv, None
     dq, dk, dv = _dense_block_bwd(q, k, v, valid_len, out, lse, g_out,
                                   causal, scale)

@@ -233,11 +233,12 @@ def _block_bwd_any(q, k, v, vl, out, lse, g, causal, scale, interpret):
     once LSE is the full-row normalizer. Pallas kernels on TPU (or
     interpret mode), the shared residual-based dense math otherwise."""
     from ..ops.pallas_attention import (_dense_block_bwd, _flash_backward,
-                                        _pallas_runnable)
+                                        _pallas_runnable, _use_dense)
 
     if _pallas_runnable(interpret):
         return _flash_backward(q, k, v, vl, out, lse, g, causal=causal,
-                               scale=scale, interpret=interpret)
+                               scale=scale, interpret=interpret,
+                               dense=_use_dense(q.shape[2], k.shape[2]))
     return _dense_block_bwd(q, k, v, vl, out, lse, g, causal, scale)
 
 

@@ -16,6 +16,19 @@ from incubator_mxnet_tpu.ops.pallas_attention import (
     _flash_forward, flash_attention_bhtd, use_flash_attention)
 
 
+@pytest.fixture(params=["streaming", "dense"])
+def kernel_path(request, monkeypatch):
+    """Run kernel parity tests against BOTH Pallas paths: the streaming
+    FlashAttention-2 kernels (dense dispatch disabled via threshold 0)
+    and the dense single-tile kernels (threshold above every test
+    shape). The threshold is re-read per call in the non-jitted wrappers
+    and passed as a static jit arg, so flipping the env between tests
+    retraces instead of reusing the cached path."""
+    monkeypatch.setenv("MXTPU_FLASH_DENSE_T",
+                       "0" if request.param == "streaming" else "4096")
+    return request.param
+
+
 def _dense_ref(q, k, v, valid, causal):
     """(B,H,T,D) dense oracle."""
     B, H, Tq, D = q.shape
@@ -36,7 +49,7 @@ def _dense_ref(q, k, v, valid, causal):
 @pytest.mark.parametrize("Tq,Tk,vl", [(16, 16, (16, 9)),
                                       (32, 16, (16, 16)),
                                       (8, 24, (24, 5))])
-def test_kernel_interpret_matches_dense(causal, Tq, Tk, vl):
+def test_kernel_interpret_matches_dense(causal, Tq, Tk, vl, kernel_path):
     if causal and Tq != Tk:
         pytest.skip("causal assumes square")
     rng = np.random.RandomState(0)
@@ -56,8 +69,11 @@ def test_kernel_interpret_matches_dense(causal, Tq, Tk, vl):
         np.testing.assert_allclose(got[b], ref[b], rtol=2e-4, atol=2e-4)
 
 
-def test_kernel_blocking_invariance():
-    """Different block sizes must give identical results."""
+def test_kernel_blocking_invariance(monkeypatch):
+    """Different block sizes must give identical results (streaming path
+    only — the dense kernel has no blocks, so it is pinned off here to
+    keep the comparison meaningful)."""
+    monkeypatch.setenv("MXTPU_FLASH_DENSE_T", "0")
     rng = np.random.RandomState(1)
     q = jnp.asarray(rng.randn(1, 2, 32, 8).astype(np.float32))
     k = jnp.asarray(rng.randn(1, 2, 32, 8).astype(np.float32))
@@ -69,7 +85,7 @@ def test_kernel_blocking_invariance():
                                atol=1e-5)
 
 
-def test_gradients_match_dense():
+def test_gradients_match_dense(kernel_path):
     rng = np.random.RandomState(2)
     B, H, T, D = 1, 2, 16, 8
     q = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32))
@@ -117,7 +133,7 @@ def test_dispatch_fallback_on_cpu():
 
 
 @pytest.mark.parametrize("causal", [False, True])
-def test_pallas_backward_matches_dense_grads(causal):
+def test_pallas_backward_matches_dense_grads(causal, kernel_path):
     """The Pallas dq/dk/dv kernels (interpret mode) must match analytic
     gradients through the dense softmax oracle, including key-padding
     and causal masks."""
@@ -154,7 +170,8 @@ def test_pallas_backward_matches_dense_grads(causal):
                                rtol=2e-4, atol=2e-4)
 
 
-def test_pallas_backward_block_invariance():
+def test_pallas_backward_block_invariance(monkeypatch):
+    monkeypatch.setenv("MXTPU_FLASH_DENSE_T", "0")
     from incubator_mxnet_tpu.ops.pallas_attention import (
         _flash_backward, _flash_fwd_lse)
     rng = np.random.RandomState(5)
@@ -174,7 +191,7 @@ def test_pallas_backward_block_invariance():
                                    rtol=1e-5, atol=1e-5)
 
 
-def test_block_attn_lse_interpret_matches_dense():
+def test_block_attn_lse_interpret_matches_dense(kernel_path):
     """(out, lse) primitive through the Pallas kernels in interpret mode
     (the ring-attention building block)."""
     from incubator_mxnet_tpu.ops.pallas_attention import (
@@ -201,7 +218,7 @@ def test_block_attn_lse_interpret_matches_dense():
                                rtol=3e-4, atol=3e-4)
 
 
-def test_kernel_bf16_operands_match_f32_reference():
+def test_kernel_bf16_operands_match_f32_reference(kernel_path):
     """bf16 inputs keep bf16 DOT OPERANDS (full-rate MXU) with f32
     accumulation — outputs must track the f32 dense reference within
     bf16 tolerance, fwd and bwd."""
