@@ -179,6 +179,14 @@ def lamb_update_phase2(weight, g_update, r1=None, r2=None, lr=0.001,
                        lower_bound=-1.0, upper_bound=-1.0):
     """LAMB phase 2: trust-ratio scaling. r1/r2 may be passed precomputed
     (multi-tensor path) or are computed here."""
+    if r1 is None or r2 is None:
+        # Keep the norm reductions in their OWN kernels: without this
+        # barrier XLA fuses them into the phase-1 elementwise chain as a
+        # (scalar, scalar, matrix, matrix) multi-output fusion whose
+        # serialized tiling ran at ~35 GB/s on v5e (trace_r4,
+        # multiply_reduce_fusion ~2 ms per FFN weight ~= 48 ms/step at
+        # BERT-base B=48). The barrier is semantically the identity.
+        weight, g_update = jax.lax.optimization_barrier((weight, g_update))
     if r1 is None:
         r1 = jnp.sqrt(jnp.sum(jnp.square(weight)))
     if r2 is None:
