@@ -30,6 +30,7 @@ from .. import random as _rand
 
 from ..base import MXNetError
 from ..gluon import nn
+from ._attention import packed_flash_self_attention, use_packed_fast_path
 from ..gluon.block import HybridBlock
 from .. import initializer as init
 
@@ -73,10 +74,6 @@ class BERTSelfAttention(HybridBlock):
         H, D = self._heads, self._units // self._heads
         seq_ax = "sp" if self._seq_parallel else None
         qkv = self.qkv(x).reshape((B, T, 3, H, D))
-        qkv = constrain(qkv, ("dp", "fsdp"), seq_ax, None, "tp", None)
-        q = qkv._op("slice_axis", axis=2, begin=0, end=1).reshape((B, T, H, D))
-        k = qkv._op("slice_axis", axis=2, begin=1, end=2).reshape((B, T, H, D))
-        v = qkv._op("slice_axis", axis=2, begin=2, end=3).reshape((B, T, H, D))
         mesh = None
         # ring dispatch requires EXPLICIT valid lengths (or no mask):
         # an arbitrary key mask is NOT converted — a non-prefix mask
@@ -89,18 +86,34 @@ class BERTSelfAttention(HybridBlock):
         # the jnp fallback; see sdpa docstring)
         vl = valid_length.astype("int32") \
             if valid_length is not None else None
-        if mesh is not None:
-            from ..parallel.ring_attention import ring_self_attention
-            out = NDArray(ring_self_attention(
-                q._data, k._data, v._data, mesh=mesh, causal=False,
-                batch_axis=("dp", "fsdp"),
-                valid_length=vl._data if vl is not None else None))
+        if mesh is None and self._flash \
+                and (mask is None or
+                     (len(mask.shape) == 2 and vl is not None)) \
+                and use_packed_fast_path(D):
+            # packed fast path — see models/_attention.py
+            out = packed_flash_self_attention(
+                F, qkv, B, T, H, D, self._units, mask=mask,
+                valid_length=vl, seq_ax=seq_ax)
         else:
-            out = F.scaled_dot_product_attention(q, k, v, mask=mask,
-                                                 flash=self._flash,
-                                                 valid_length=vl)
-        out = constrain(out, ("dp", "fsdp"), seq_ax, "tp", None)
-        out = out.reshape((B, T, self._units))
+            qkv = constrain(qkv, ("dp", "fsdp"), seq_ax, None, "tp", None)
+            q = qkv._op("slice_axis", axis=2, begin=0,
+                        end=1).reshape((B, T, H, D))
+            k = qkv._op("slice_axis", axis=2, begin=1,
+                        end=2).reshape((B, T, H, D))
+            v = qkv._op("slice_axis", axis=2, begin=2,
+                        end=3).reshape((B, T, H, D))
+            if mesh is not None:
+                from ..parallel.ring_attention import ring_self_attention
+                out = NDArray(ring_self_attention(
+                    q._data, k._data, v._data, mesh=mesh, causal=False,
+                    batch_axis=("dp", "fsdp"),
+                    valid_length=vl._data if vl is not None else None))
+            else:
+                out = F.scaled_dot_product_attention(q, k, v, mask=mask,
+                                                     flash=self._flash,
+                                                     valid_length=vl)
+            out = constrain(out, ("dp", "fsdp"), seq_ax, "tp", None)
+            out = out.reshape((B, T, self._units))
         return constrain(self.dropout(self.proj(out)),
                          ("dp", "fsdp"), seq_ax, None)
 

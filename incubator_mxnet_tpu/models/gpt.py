@@ -23,6 +23,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..base import MXNetError
 from ..gluon import nn
+from ._attention import packed_flash_self_attention, use_packed_fast_path
 from ..gluon.block import HybridBlock
 from ..ndarray import NDArray
 from .. import initializer as init
@@ -65,30 +66,39 @@ class CausalSelfAttention(HybridBlock):
         H, D = self._heads, self._units // self._heads
         qkv = self.qkv(x).reshape((B, T, 3, H, D))
         seq_ax = "sp" if self._seq_parallel else None
-        qkv = constrain(qkv, ("dp", "fsdp"), seq_ax, None, "tp", None)
-        q = qkv._op("slice_axis", axis=2, begin=0, end=1).reshape((B, T, H, D))
-        k = qkv._op("slice_axis", axis=2, begin=1, end=2).reshape((B, T, H, D))
-        v = qkv._op("slice_axis", axis=2, begin=2, end=3).reshape((B, T, H, D))
         mesh = None
         if self._seq_parallel:
             from ..parallel.ring_attention import active_ring_mesh
             mesh = active_ring_mesh(T)
-        if mesh is not None:
-            from ..parallel.ring_attention import (ring_self_attention,
-                                                   ring_flash_attention)
-            from ..ops.pallas_attention import _pallas_available
-            on_tpu = any(d.platform == "tpu" for d in jax.devices())
-            engine = ring_flash_attention if (
-                self._flash and on_tpu and _pallas_available()) \
-                else ring_self_attention
-            out = NDArray(engine(
-                q._data, k._data, v._data, mesh=mesh, causal=True,
-                batch_axis=("dp", "fsdp")))
+        if mesh is None and self._flash and use_packed_fast_path(D):
+            # packed fast path — see models/_attention.py
+            out = packed_flash_self_attention(
+                F, qkv, B, T, H, D, self._units, causal=True,
+                seq_ax=seq_ax)
         else:
-            out = F.scaled_dot_product_attention(q, k, v, causal=True,
-                                                 flash=self._flash)
-        out = constrain(out, ("dp", "fsdp"), seq_ax, "tp", None)
-        out = out.reshape((B, T, self._units))
+            qkv = constrain(qkv, ("dp", "fsdp"), seq_ax, None, "tp", None)
+            q = qkv._op("slice_axis", axis=2, begin=0,
+                        end=1).reshape((B, T, H, D))
+            k = qkv._op("slice_axis", axis=2, begin=1,
+                        end=2).reshape((B, T, H, D))
+            v = qkv._op("slice_axis", axis=2, begin=2,
+                        end=3).reshape((B, T, H, D))
+            if mesh is not None:
+                from ..parallel.ring_attention import (ring_self_attention,
+                                                       ring_flash_attention)
+                from ..ops.pallas_attention import _pallas_available
+                on_tpu = any(d.platform == "tpu" for d in jax.devices())
+                engine = ring_flash_attention if (
+                    self._flash and on_tpu and _pallas_available()) \
+                    else ring_self_attention
+                out = NDArray(engine(
+                    q._data, k._data, v._data, mesh=mesh, causal=True,
+                    batch_axis=("dp", "fsdp")))
+            else:
+                out = F.scaled_dot_product_attention(q, k, v, causal=True,
+                                                     flash=self._flash)
+            out = constrain(out, ("dp", "fsdp"), seq_ax, "tp", None)
+            out = out.reshape((B, T, self._units))
         return constrain(self.dropout(self.proj(out)),
                          ("dp", "fsdp"), seq_ax, None)
 

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .registry import register
+from ..base import MXNetError
 
 _NEG_INF = -1e30
 
@@ -100,11 +101,18 @@ def _sdpa_blockwise(q, k, v, key_mask, causal, scale, block_k: int = 512):
 @register("scaled_dot_product_attention", aliases=("sdpa",))
 def scaled_dot_product_attention(q, k, v, mask=None, scale=None,
                                  causal=False, flash=False,
-                                 valid_length=None):
+                                 valid_length=None, layout="bthd"):
     """Multi-head attention core. q/k/v: (B, T, H, D). ``mask`` is either a
     key-padding mask (B, Tk) or broadcastable to (B, H, Tq, Tk), True =
     attend. Returns (B, Tq, H, D). ``flash=True`` uses the blockwise
     streaming evaluation (key-padding/causal masks only).
+
+    ``layout="bhtd"`` (flash only): q/k/v and the result are
+    (B, H, T, D) — the Pallas kernels' native layout. Callers that
+    produce a packed (3, B, H, T, D) projection (the transformer cells'
+    perf path, mirroring the rationale of the reference's interleaved
+    QKV layout in src/operator/contrib/transformer.cc) avoid the
+    per-tensor relayout transposes around every kernel call.
 
     ``valid_length`` (B,) key lengths: the TPU Pallas kernel needs the
     mask in LENGTH form — a (B, Tk) boolean ``mask`` alone sends the
@@ -117,12 +125,20 @@ def scaled_dot_product_attention(q, k, v, mask=None, scale=None,
     D = q.shape[-1]
     if scale is None:
         scale = D ** -0.5
+    if layout not in ("bthd", "bhtd"):
+        raise MXNetError(f"sdpa: unknown layout {layout!r}")
+    if layout == "bhtd" and not (flash and (mask is None or
+                                            mask.ndim == 2)):
+        raise MXNetError(
+            "sdpa: layout='bhtd' is the flash-path fast layout; use the "
+            "default layout for the dense/attention-weights path")
     if flash and (mask is None or mask.ndim == 2):
         # Pallas kernel on TPU (length-style masks), blockwise jnp
         # otherwise — same streaming-softmax math either way
         from .pallas_attention import use_flash_attention
         return use_flash_attention(q, k, v, key_mask=mask, causal=causal,
-                                   scale=scale, valid_length=valid_length)
+                                   scale=scale, valid_length=valid_length,
+                                   layout=layout)
     Tq, Tk = q.shape[1], k.shape[1]
     m = mask
     if m is not None and m.ndim == 2:

@@ -609,9 +609,29 @@ def _bwd(causal, scale, interpret, res, g):
 flash_attention_bhtd.defvjp(_fwd, _bwd)
 
 
+def tpu_kernel_eligible(D, causal=False, Tq=None, Tk=None):
+    """True when use_flash_attention will hand (length-maskable) inputs
+    to the Pallas TPU kernel rather than the jnp fallback. Shared with
+    the models' packed-qkv fast path so the caller-side relayout is only
+    done when the kernel actually consumes the bhtd layout."""
+    on = any(d.platform == "tpu" for d in jax.devices()) \
+        and _pallas_available()
+    if os.environ.get("MXTPU_FLASH_FORCE_FALLBACK") == "1":
+        on = False  # A/B lever: measure jnp blockwise vs the kernel
+    # the Pallas kernel's causal grid assumes square Tq == Tk; offset
+    # (KV-cache style) causal queries take the blockwise path, which is
+    # bottom-right aligned
+    if causal and Tq is not None and Tq != Tk:
+        on = False
+    return on and D <= 256
+
+
 def use_flash_attention(q, k, v, key_mask=None, causal=False, scale=None,
-                        valid_length=None):
-    """Dispatch helper for ops.attention: (B, T, H, D) in/out.
+                        valid_length=None, layout="bthd"):
+    """Dispatch helper for ops.attention: (B, T, H, D) in/out by
+    default; ``layout="bhtd"`` takes and returns (B, H, T, D) — the
+    kernels' native layout — so layout-aware callers (the packed-qkv
+    transformer cells) skip the per-tensor transposes entirely.
 
     The Pallas kernel runs on TPU when the mask is expressible as
     per-batch key LENGTHS (valid_length, or no mask at all) — the
@@ -626,20 +646,16 @@ def use_flash_attention(q, k, v, key_mask=None, causal=False, scale=None,
     non-prefix key_mask combined with lengths would diverge between
     platforms — that combination is a caller bug which cannot be
     validated under jit (the check would be data-dependent)."""
-    B, Tq, H, D = q.shape
-    Tk = k.shape[1]
-    on_tpu = any(d.platform == "tpu" for d in jax.devices()) \
-        and _pallas_available()
-    if os.environ.get("MXTPU_FLASH_FORCE_FALLBACK") == "1":
-        on_tpu = False  # A/B lever: measure jnp blockwise vs the kernel
+    if layout == "bhtd":
+        B, H, Tq, D = q.shape
+        Tk = k.shape[2]
+    else:
+        B, Tq, H, D = q.shape
+        Tk = k.shape[1]
     if valid_length is None and key_mask is None:
         valid_length = jnp.full((B,), Tk, jnp.int32)
-    # the Pallas kernel's causal grid assumes square Tq == Tk; offset
-    # (KV-cache style) causal queries take the blockwise path, which is
-    # bottom-right aligned
-    if causal and Tq != Tk:
-        on_tpu = False
-    if not (on_tpu and valid_length is not None and D <= 256):
+    if not (tpu_kernel_eligible(D, causal, Tq, Tk)
+            and valid_length is not None):
         from .attention import _sdpa_blockwise
         sc = D ** -0.5 if scale is None else scale
         if valid_length is not None:
@@ -647,7 +663,15 @@ def use_flash_attention(q, k, v, key_mask=None, causal=False, scale=None,
                 valid_length.astype(jnp.int32)[:, None]
             key_mask = vlm if key_mask is None else \
                 jnp.logical_and(key_mask.astype(bool), vlm)
+        if layout == "bhtd":    # blockwise math wants (B, T, H, D)
+            out = _sdpa_blockwise(q.transpose(0, 2, 1, 3),
+                                  k.transpose(0, 2, 1, 3),
+                                  v.transpose(0, 2, 1, 3),
+                                  key_mask, causal, sc)
+            return out.transpose(0, 2, 1, 3)
         return _sdpa_blockwise(q, k, v, key_mask, causal, sc)
+    if layout == "bhtd":
+        return flash_attention_bhtd(q, k, v, valid_length, causal, scale)
     out = flash_attention_bhtd(q.transpose(0, 2, 1, 3),
                                k.transpose(0, 2, 1, 3),
                                v.transpose(0, 2, 1, 3),

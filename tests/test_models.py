@@ -322,3 +322,54 @@ def test_bert_classifier_finetunes():
     for _ in range(10):
         ll = float(tr.step(ids, tt, vl, y).asnumpy())
     assert ll < l0, (l0, ll)
+
+
+def test_packed_fast_path_matches_unpacked():
+    """The packed (3,B,H,T,D) attention wiring (models/_attention.py)
+    must be numerically identical to the per-tensor path: forced on via
+    MXTPU_FORCE_PACKED on the CPU mesh, where both route to the same
+    blockwise math."""
+    import os
+    import numpy as np
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.models.bert import bert_tiny
+    from incubator_mxnet_tpu.models.gpt import gpt_mini
+
+    rng = np.random.RandomState(0)
+    ids = nd.array(rng.randint(0, 100, (2, 24)), dtype="int32")
+    vl = nd.array(np.array([24, 11]), dtype="int32")
+
+    def run_bert():
+        m = bert_tiny(flash=True)
+        m.initialize()
+        s, p = m(ids, None, vl)
+        return m, s.asnumpy()
+
+    os.environ.pop("MXTPU_FORCE_PACKED", None)
+    m1, base = run_bert()
+    os.environ["MXTPU_FORCE_PACKED"] = "1"
+    try:
+        m2 = bert_tiny(flash=True)
+        m2.initialize()
+        src = m1._collect_params_with_prefix()
+        dst = m2._collect_params_with_prefix()
+        for k_, v_ in src.items():
+            dst[k_].set_data(v_.data())
+        s2, _ = m2(ids, None, vl)
+        np.testing.assert_allclose(s2.asnumpy(), base, rtol=2e-4,
+                                   atol=2e-4)
+
+        g = gpt_mini(vocab_size=100, max_length=24, dropout=0.0, flash=True)
+        g.initialize()
+        out_packed = g(ids).asnumpy()
+        os.environ.pop("MXTPU_FORCE_PACKED", None)
+        g2 = gpt_mini(vocab_size=100, max_length=24, dropout=0.0, flash=True)
+        g2.initialize()
+        srcg = g._collect_params_with_prefix()
+        dstg = g2._collect_params_with_prefix()
+        for k_, v_ in srcg.items():
+            dstg[k_].set_data(v_.data())
+        np.testing.assert_allclose(g2(ids).asnumpy(), out_packed,
+                                   rtol=2e-4, atol=2e-4)
+    finally:
+        os.environ.pop("MXTPU_FORCE_PACKED", None)
