@@ -1,0 +1,56 @@
+#!/bin/bash
+# Combined round-4 measurement ladder (supersedes tpu_autorun.sh +
+# tpu_autorun2.sh). Ordered so a SHORT window banks the headline
+# numbers at HEAD first (dense kernels + packed qkv + LAMB barrier),
+# then traces, then the A/B probes, then the secondary workloads.
+# Re-entrant: a config with a banked .json (or .failed marker for
+# non-transient failures) is skipped on later passes.
+cd "$(dirname "$0")/.." || exit 1
+LOG=TPU_RUNS_r04
+mkdir -p "$LOG"
+
+run() { # run NAME TIMEOUT [ENV=VAL...]
+  local name=$1 to=$2; shift 2
+  [ -s "$LOG/$name.json" ] && return 0
+  [ -e "$LOG/$name.failed" ] && return 0
+  echo "$(date -u +%H:%M:%S) start $name" >> "$LOG/watch.log"
+  env "$@" timeout "$to" python bench.py --run --workload "${WL:-bert}" \
+    > "$LOG/$name.out" 2> "$LOG/$name.err"
+  local rc=$?
+  grep BENCH_RESULT "$LOG/$name.out" | tail -1 | sed 's/BENCH_RESULT //' \
+    > "$LOG/$name.json" || true
+  if [ ! -s "$LOG/$name.json" ]; then
+    rm -f "$LOG/$name.json"
+    [ "$rc" != 124 ] && tail -c 400 "$LOG/$name.err" > "$LOG/$name.failed"
+  fi
+  echo "$(date -u +%H:%M:%S) done $name rc=$rc: $(head -c 200 "$LOG/$name.json" 2>/dev/null)" >> "$LOG/watch.log"
+}
+
+ALL="b48-dense large-b32-dense b96-dense-dots b96-dense-trace large-b48-dense b128-dense-dots large-b32-dense-trace b48-rbg b48-nodrop b48-jnpflash resnet-b64 nmt-decode"
+while true; do
+  if timeout 90 python -c "import jax; assert any(d.platform!='cpu' for d in jax.devices())" 2>/dev/null; then
+    echo "$(date -u +%H:%M:%S) p3 window OPEN" >> "$LOG/watch.log"
+    run b48-dense 700
+    run large-b32-dense 950 MXTPU_BENCH_MODEL=large MXTPU_BENCH_BATCH=32 MXTPU_BENCH_REMAT=dots
+    run b96-dense-dots 700 MXTPU_BENCH_BATCH=96 MXTPU_BENCH_REMAT=dots
+    run b96-dense-trace 700 MXTPU_BENCH_BATCH=96 MXTPU_BENCH_REMAT=dots MXTPU_BENCH_TRACE=trace_r4b
+    run large-b48-dense 950 MXTPU_BENCH_MODEL=large MXTPU_BENCH_BATCH=48 MXTPU_BENCH_REMAT=dots
+    run b128-dense-dots 700 MXTPU_BENCH_BATCH=128 MXTPU_BENCH_REMAT=dots
+    run large-b32-dense-trace 950 MXTPU_BENCH_MODEL=large MXTPU_BENCH_BATCH=32 MXTPU_BENCH_REMAT=dots MXTPU_BENCH_TRACE=trace_r4large
+    run b48-rbg 700 JAX_DEFAULT_PRNG_IMPL=rbg
+    run b48-nodrop 700 MXTPU_BENCH_DROPOUT=0
+    run b48-jnpflash 700 MXTPU_FLASH_FORCE_FALLBACK=1
+    WL=resnet run resnet-b64 700
+    WL=nmt run nmt-decode 700
+    echo "$(date -u +%H:%M:%S) p3 pass complete" >> "$LOG/watch.log"
+    python tools/collect_runs.py >> "$LOG/watch.log" 2>&1
+    n=0
+    for c in $ALL; do
+      { [ -s "$LOG/$c.json" ] || [ -e "$LOG/$c.failed" ]; } && n=$((n+1))
+    done
+    [ "$n" -ge 12 ] && { echo "$(date -u +%H:%M:%S) P3 ALL DONE" >> "$LOG/watch.log"; exit 0; }
+  else
+    echo "$(date -u +%H:%M:%S) p3 down" >> "$LOG/watch.log"
+  fi
+  sleep 180
+done
