@@ -146,10 +146,10 @@ def _pad_to(x, axis, multiple):
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
                                              "block_k", "interpret",
-                                             "dense"))
+                                             "dense", "hpp"))
 def _flash_fwd_lse(q, k, v, valid_len, causal=False, scale=None,
                    block_q=None, block_k=None, interpret=False,
-                   dense=False):
+                   dense=False, hpp=None):
     """q/k/v: (B, H, T, D). Returns (out, lse) with lse (B, H, Tq).
     ``dense`` (static; resolve via _use_dense in the NON-jitted callers,
     like the block knobs, so it is part of the jit cache key) selects the
@@ -160,7 +160,8 @@ def _flash_fwd_lse(q, k, v, valid_len, causal=False, scale=None,
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     if dense:
-        return _dense_fwd_lse(q, k, v, valid_len, causal, scale, interpret)
+        return _dense_fwd_lse(q, k, v, valid_len, causal, scale, interpret,
+                              hpp)
     scale = D ** -0.5 if scale is None else scale
     block_q = min(block_q or 128, max(Tq, 8))
     block_k = min(block_k or 128, max(Tk, 8))
@@ -208,7 +209,8 @@ def _flash_forward(q, k, v, valid_len, causal=False, scale=None,
         block_q, block_k = _resolve_blocks(block_q, block_k)
     return _flash_fwd_lse(q, k, v, valid_len, causal=causal, scale=scale,
                           block_q=block_q, block_k=block_k,
-                          interpret=interpret, dense=dense)[0]
+                          interpret=interpret, dense=dense,
+                          hpp=_dense_hpp(q.shape[1]) if dense else None)[0]
 
 
 # --------------------------------------------------------------------- #
@@ -227,6 +229,14 @@ def _flash_forward(q, k, v, valid_len, causal=False, scale=None,
 # Long sequences (> MXTPU_FLASH_DENSE_T, default 1024) keep the
 # streaming FlashAttention-2 kernels above.
 
+def _dense_hpp(H, bwd=False):
+    """Static heads-per-program for the dense kernels, resolved in the
+    NON-jitted callers (cache-key correct, like block_q/block_k)."""
+    if bwd:
+        return _heads_per_program(H, "MXTPU_FLASH_BWD_HPP", 8)
+    return _heads_per_program(H, "MXTPU_FLASH_FWD_HPP", 16)
+
+
 def _use_dense(Tq, Tk):
     """Static dispatch (shapes are trace-time constants). The env knob is
     read at trace time: like the block-size knobs it must not change
@@ -241,60 +251,82 @@ def _use_dense(Tq, Tk):
     return max(Tq, Tk) <= limit
 
 
+def _heads_per_program(H, cap_env, cap_default):
+    """Largest divisor of H within the per-program VMEM budget. Per-
+    program MXU work at one (head, T<=512) tile is sub-microsecond —
+    comparable to Mosaic's per-program overhead — so packing several
+    heads into each program is what actually amortizes the grid cost.
+    Caps (fwd 16 / bwd 8 by default, env-tunable) keep the double-
+    buffered block set inside the ~16 MB/core VMEM."""
+    cap = max(1, _env_block(cap_env, cap_default))
+    hpp = 1
+    for d in range(1, H + 1):
+        if H % d == 0 and d <= cap:
+            hpp = d
+    return hpp
+
+
 def _dense_fwd_kernel(vl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
-                      scale, causal):
+                      scale, causal, hpp):
     from jax.experimental import pallas as pl
 
-    q = q_ref[0, 0]                                       # (Tqp, D)
-    k = k_ref[0, 0]                                       # (Tkp, D)
-    v = v_ref[0, 0]
     vl = vl_ref[pl.program_id(0), 0]
-    Tqp, Tkp = q.shape[0], k.shape[0]
-    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
-                precision=lax.Precision.DEFAULT) * scale
-    s = jnp.where(_tile_mask(Tqp, Tkp, vl, causal), s, _NEG_INF)
-    m = jnp.max(s, axis=-1)
-    p = jnp.exp(s - m[:, None])
-    l = jnp.maximum(jnp.sum(p, axis=-1), 1e-30)
-    o = jnp.dot(p.astype(v.dtype), v, preferred_element_type=jnp.float32,
-                precision=lax.Precision.DEFAULT) / l[:, None]
-    o_ref[0, 0] = o.astype(o_ref.dtype)
-    lse_ref[0, 0] = (m + jnp.log(l))[:, None]
+    for h in range(hpp):                       # unrolled head loop
+        q = q_ref[0, h]                                   # (Tqp, D)
+        k = k_ref[0, h]                                   # (Tkp, D)
+        v = v_ref[0, h]
+        Tqp, Tkp = q.shape[0], k.shape[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
+                    precision=lax.Precision.DEFAULT) * scale
+        s = jnp.where(_tile_mask(Tqp, Tkp, vl, causal), s, _NEG_INF)
+        m = jnp.max(s, axis=-1)
+        p = jnp.exp(s - m[:, None])
+        l = jnp.maximum(jnp.sum(p, axis=-1), 1e-30)
+        o = jnp.dot(p.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32,
+                    precision=lax.Precision.DEFAULT) / l[:, None]
+        o_ref[0, h] = o.astype(o_ref.dtype)
+        lse_ref[0, h] = (m + jnp.log(l))[:, None]
 
 
 def _dense_bwd_kernel(vl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                      delta_ref, dq_ref, dk_ref, dv_ref, *, scale, causal):
+                      delta_ref, dq_ref, dk_ref, dv_ref, *, scale,
+                      causal, hpp):
     from jax.experimental import pallas as pl
 
-    q = q_ref[0, 0]                                       # (Tqp, D)
-    k = k_ref[0, 0]                                       # (Tkp, D)
-    v = v_ref[0, 0]
-    do = do_ref[0, 0]
-    lse = lse_ref[0, 0, :, 0].astype(jnp.float32)         # (Tqp,)
-    delta = delta_ref[0, 0, :, 0].astype(jnp.float32)
     vl = vl_ref[pl.program_id(0), 0]
-    Tqp, Tkp = q.shape[0], k.shape[0]
-    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
-                precision=lax.Precision.DEFAULT) * scale
-    mask = _tile_mask(Tqp, Tkp, vl, causal)
-    p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)   # (Tqp, Tkp)
-    dv = jnp.dot(p.astype(do.dtype).T, do,
-                 preferred_element_type=jnp.float32,
-                 precision=lax.Precision.DEFAULT)
-    dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32,
-                 precision=lax.Precision.DEFAULT)
-    ds = (p * (dp - delta[:, None]) * scale).astype(k.dtype)
-    dq_ref[0, 0] = jnp.dot(ds, k, preferred_element_type=jnp.float32,
-                           precision=lax.Precision.DEFAULT) \
-        .astype(dq_ref.dtype)
-    dk_ref[0, 0] = jnp.dot(ds.T, q, preferred_element_type=jnp.float32,
-                           precision=lax.Precision.DEFAULT) \
-        .astype(dk_ref.dtype)
-    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+    for h in range(hpp):                       # unrolled head loop
+        q = q_ref[0, h]                                   # (Tqp, D)
+        k = k_ref[0, h]                                   # (Tkp, D)
+        v = v_ref[0, h]
+        do = do_ref[0, h]
+        lse = lse_ref[0, h, :, 0].astype(jnp.float32)     # (Tqp,)
+        delta = delta_ref[0, h, :, 0].astype(jnp.float32)
+        Tqp, Tkp = q.shape[0], k.shape[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
+                    precision=lax.Precision.DEFAULT) * scale
+        mask = _tile_mask(Tqp, Tkp, vl, causal)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)  # (Tqp, Tkp)
+        dv = jnp.dot(p.astype(do.dtype).T, do,
+                     preferred_element_type=jnp.float32,
+                     precision=lax.Precision.DEFAULT)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32,
+                     precision=lax.Precision.DEFAULT)
+        ds = (p * (dp - delta[:, None]) * scale).astype(k.dtype)
+        dq_ref[0, h] = jnp.dot(ds, k, preferred_element_type=jnp.float32,
+                               precision=lax.Precision.DEFAULT) \
+            .astype(dq_ref.dtype)
+        dk_ref[0, h] = jnp.dot(ds.T, q, preferred_element_type=jnp.float32,
+                               precision=lax.Precision.DEFAULT) \
+            .astype(dk_ref.dtype)
+        dv_ref[0, h] = dv.astype(dv_ref.dtype)
 
 
-def _dense_fwd_lse(q, k, v, valid_len, causal, scale, interpret):
-    """Single-tile forward: grid (B, H), whole (Tq, Tk) per program."""
+def _dense_fwd_lse(q, k, v, valid_len, causal, scale, interpret,
+                   hpp=None):
+    """Single-tile forward: grid (B, H/hpp), whole (Tq, Tk) tiles.
+    ``hpp`` (heads per program) is static — resolved by the NON-jitted
+    callers via _heads_per_program, like every other env knob."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -306,21 +338,23 @@ def _dense_fwd_lse(q, k, v, valid_len, causal, scale, interpret):
     v, _ = _pad_to(v, 2, 128)
     Tq_p, Tk_p = q.shape[2], k.shape[2]
     vl = jnp.minimum(valid_len.astype(jnp.int32), Tk).reshape(B, 1)
+    if hpp is None:
+        hpp = _heads_per_program(H, "MXTPU_FLASH_FWD_HPP", 16)
     kernel = functools.partial(_dense_fwd_kernel, scale=scale,
-                               causal=causal)
+                               causal=causal, hpp=hpp)
     out, lse = pl.pallas_call(
         kernel,
-        grid=(B, H),
+        grid=(B, H // hpp),
         in_specs=[
-            pl.BlockSpec((B, 1), lambda b, h: (0, 0),
+            pl.BlockSpec((B, 1), lambda b, g: (0, 0),
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, Tq_p, D), lambda b, h: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, Tk_p, D), lambda b, h: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, Tk_p, D), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec((1, hpp, Tq_p, D), lambda b, g: (b, g, 0, 0)),
+            pl.BlockSpec((1, hpp, Tk_p, D), lambda b, g: (b, g, 0, 0)),
+            pl.BlockSpec((1, hpp, Tk_p, D), lambda b, g: (b, g, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, Tq_p, D), lambda b, h: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, Tq_p, 1), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec((1, hpp, Tq_p, D), lambda b, g: (b, g, 0, 0)),
+            pl.BlockSpec((1, hpp, Tq_p, 1), lambda b, g: (b, g, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, Tq_p, D), q.dtype),
@@ -332,8 +366,10 @@ def _dense_fwd_lse(q, k, v, valid_len, causal, scale, interpret):
 
 
 def _dense_backward(q, k, v, valid_len, lse, g, delta, causal, scale,
-                    interpret):
-    """Fused single-tile backward: ONE kernel for dq, dk and dv."""
+                    interpret, hpp=None):
+    """Fused single-tile backward: ONE kernel for dq, dk and dv.
+    ``hpp`` static, resolved by non-jitted callers (see
+    _dense_fwd_lse)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -348,25 +384,27 @@ def _dense_backward(q, k, v, valid_len, lse, g, delta, causal, scale,
     vp, _ = _pad_to(v, 2, 128)
     Tq_p, Tk_p = qp.shape[2], kp.shape[2]
     vl = jnp.minimum(valid_len.astype(jnp.int32), Tk).reshape(B, 1)
+    if hpp is None:
+        hpp = _heads_per_program(H, "MXTPU_FLASH_BWD_HPP", 8)
     kernel = functools.partial(_dense_bwd_kernel, scale=scale,
-                               causal=causal)
+                               causal=causal, hpp=hpp)
     dq, dk, dv = pl.pallas_call(
         kernel,
-        grid=(B, H),
+        grid=(B, H // hpp),
         in_specs=[
-            pl.BlockSpec((B, 1), lambda b, h: (0, 0),
+            pl.BlockSpec((B, 1), lambda b, g: (0, 0),
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, Tq_p, D), lambda b, h: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, Tk_p, D), lambda b, h: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, Tk_p, D), lambda b, h: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, Tq_p, D), lambda b, h: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, Tq_p, 1), lambda b, h: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, Tq_p, 1), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec((1, hpp, Tq_p, D), lambda b, g: (b, g, 0, 0)),
+            pl.BlockSpec((1, hpp, Tk_p, D), lambda b, g: (b, g, 0, 0)),
+            pl.BlockSpec((1, hpp, Tk_p, D), lambda b, g: (b, g, 0, 0)),
+            pl.BlockSpec((1, hpp, Tq_p, D), lambda b, g: (b, g, 0, 0)),
+            pl.BlockSpec((1, hpp, Tq_p, 1), lambda b, g: (b, g, 0, 0)),
+            pl.BlockSpec((1, hpp, Tq_p, 1), lambda b, g: (b, g, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, Tq_p, D), lambda b, h: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, Tk_p, D), lambda b, h: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, Tk_p, D), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec((1, hpp, Tq_p, D), lambda b, g: (b, g, 0, 0)),
+            pl.BlockSpec((1, hpp, Tk_p, D), lambda b, g: (b, g, 0, 0)),
+            pl.BlockSpec((1, hpp, Tk_p, D), lambda b, g: (b, g, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, Tq_p, D), q.dtype),
@@ -459,10 +497,10 @@ def _flash_bwd_dkv_kernel(vl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
                                              "block_k", "interpret",
-                                             "dense"))
+                                             "dense", "hpp"))
 def _flash_backward(q, k, v, valid_len, out, lse, g, causal=False,
                     scale=None, block_q=None, block_k=None,
-                    interpret=False, dense=False):
+                    interpret=False, dense=False, hpp=None):
     """Pallas backward: returns (dq, dk, dv). Shapes as forward.
     ``dense`` static, resolved by the non-jitted callers (see
     _flash_fwd_lse)."""
@@ -479,7 +517,7 @@ def _flash_backward(q, k, v, valid_len, out, lse, g, causal=False,
 
     if dense:
         return _dense_backward(q, k, v, valid_len, lse, g, delta, causal,
-                               scale, interpret)
+                               scale, interpret, hpp)
     block_q = min(block_q or 128, max(Tq, 8))
     block_k = min(block_k or 128, max(Tk, 8))
 
@@ -584,7 +622,9 @@ def _fwd(q, k, v, valid_len, causal, scale, interpret):
     out, lse = _flash_fwd_lse(q, k, v, valid_len, causal=causal,
                               scale=scale, block_q=block_q,
                               block_k=block_k, interpret=interpret,
-                              dense=dense)
+                              dense=dense,
+                              hpp=_dense_hpp(q.shape[1]) if dense
+                              else None)
     return out, (q, k, v, valid_len, out, lse)
 
 
@@ -597,7 +637,9 @@ def _bwd(causal, scale, interpret, res, g):
         dq, dk, dv = _flash_backward(q, k, v, valid_len, out, lse, g,
                                      causal=causal, scale=scale,
                                      block_q=block_q, block_k=block_k,
-                                     interpret=interpret, dense=dense)
+                                     interpret=interpret, dense=dense,
+                                     hpp=_dense_hpp(q.shape[1], bwd=True)
+                                     if dense else None)
         return dq, dk, dv, None
     _, vjp = jax.vjp(
         lambda q_, k_, v_: _reference_blockwise(q_, k_, v_, valid_len,
@@ -733,9 +775,12 @@ def block_attn_lse(q, k, v, valid_len, causal=False, scale=None,
     steps (see parallel/ring_attention.py merge rule); it is
     non-differentiable."""
     if _pallas_runnable(interpret):
+        dense = _use_dense(q.shape[2], k.shape[2])
         return _flash_fwd_lse(q, k, v, valid_len, causal=causal,
                               scale=scale, interpret=interpret,
-                              dense=_use_dense(q.shape[2], k.shape[2]))
+                              dense=dense,
+                              hpp=_dense_hpp(q.shape[1]) if dense
+                              else None)
     return _dense_attn_lse(q, k, v, valid_len, causal, scale)
 
 
@@ -769,11 +814,12 @@ def _block_bwd(causal, scale, interpret, res, g):
     q, k, v, valid_len, out, lse = res
     g_out, _ = g                              # lse cotangent is dropped
     if _pallas_runnable(interpret):
+        dense = _use_dense(q.shape[2], k.shape[2])
         dq, dk, dv = _flash_backward(q, k, v, valid_len, out, lse, g_out,
                                      causal=causal, scale=scale,
-                                     interpret=interpret,
-                                     dense=_use_dense(q.shape[2],
-                                                      k.shape[2]))
+                                     interpret=interpret, dense=dense,
+                                     hpp=_dense_hpp(q.shape[1], bwd=True)
+                                     if dense else None)
         return dq, dk, dv, None
     dq, dk, dv = _dense_block_bwd(q, k, v, valid_len, out, lse, g_out,
                                   causal, scale)

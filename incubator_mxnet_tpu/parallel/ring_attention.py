@@ -232,13 +232,17 @@ def _block_bwd_any(q, k, v, vl, out, lse, g, causal, scale, interpret):
     backward identity: p_ij = exp(s_ij - LSE_i) is exact for every block
     once LSE is the full-row normalizer. Pallas kernels on TPU (or
     interpret mode), the shared residual-based dense math otherwise."""
-    from ..ops.pallas_attention import (_dense_block_bwd, _flash_backward,
-                                        _pallas_runnable, _use_dense)
+    from ..ops.pallas_attention import (_dense_block_bwd, _dense_hpp,
+                                        _flash_backward, _pallas_runnable,
+                                        _use_dense)
 
     if _pallas_runnable(interpret):
+        dense = _use_dense(q.shape[2], k.shape[2])
         return _flash_backward(q, k, v, vl, out, lse, g, causal=causal,
                                scale=scale, interpret=interpret,
-                               dense=_use_dense(q.shape[2], k.shape[2]))
+                               dense=dense,
+                               hpp=_dense_hpp(q.shape[1], bwd=True)
+                               if dense else None)
     return _dense_block_bwd(q, k, v, vl, out, lse, g, causal, scale)
 
 

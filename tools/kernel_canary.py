@@ -1,0 +1,52 @@
+"""TPU compile canary for the dense attention kernels.
+
+Run at window-open by tools/tpu_autorun3.sh BEFORE burning bench
+attempts: compiles + executes the dense fwd and fused bwd kernels at
+the default head-grouping (hpp > 1) on tiny shapes. Exit 0 = the
+kernels are good; non-zero = the ladder falls back to
+MXTPU_FLASH_FWD_HPP=1 MXTPU_FLASH_BWD_HPP=1 (the configuration
+hardware-validated on 2026-07-31) so a Mosaic regression cannot zero a
+measurement window.
+"""
+
+import sys
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    if not any(d.platform != "cpu" for d in jax.devices()):
+        print("canary: no TPU visible", file=sys.stderr)
+        return 2
+    from incubator_mxnet_tpu.ops.pallas_attention import (
+        flash_attention_bhtd)
+
+    # H=16, T=512 = the LARGEST config the ladder benches (BERT-large
+    # tiles), so a pass really does clear the runs it gates: hpp 16
+    # (fwd) / 8 (bwd) at the max score-tile size. Both mask variants
+    # (BERT non-causal + GPT causal) compile.
+    B, H, T, D = 2, 16, 512, 64
+    kq = jax.random.PRNGKey(0)
+    q, k, v = (jax.random.normal(jax.random.fold_in(kq, i),
+                                 (B, H, T, D), jnp.bfloat16)
+               for i in range(3))
+    vl = jnp.array([T, 100], jnp.int32)
+
+    ok = True
+    for causal in (False, True):
+        def loss(q, k, v, _c=causal):
+            return flash_attention_bhtd(q, k, v, vl, _c,
+                                        None).astype(jnp.float32).sum()
+
+        val, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+        fin = bool(jnp.isfinite(val)) and all(
+            bool(jnp.all(jnp.isfinite(g.astype(jnp.float32))))
+            for g in grads)
+        print(f"canary: causal={causal} val={float(val):.3f} finite={fin}")
+        ok = ok and fin
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

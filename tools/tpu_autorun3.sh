@@ -30,6 +30,19 @@ ALL="b48-dense large-b32-dense b96-dense-dots b96-dense-trace large-b48-dense b1
 while true; do
   if timeout 90 python -c "import jax; assert any(d.platform!='cpu' for d in jax.devices())" 2>/dev/null; then
     echo "$(date -u +%H:%M:%S) p3 window OPEN" >> "$LOG/watch.log"
+    # canary: if the head-grouped dense kernels fail Mosaic, fall back
+    # to the hpp=1 configuration hardware-validated earlier today so a
+    # kernel regression cannot zero the window. The HPP vars are cleared
+    # FIRST so a previous window's fallback cannot leak into the canary
+    # run and make it validate the wrong kernels.
+    unset MXTPU_FLASH_FWD_HPP MXTPU_FLASH_BWD_HPP
+    if timeout 420 python tools/kernel_canary.py >> "$LOG/canary.log" 2>&1; then
+      unset MXTPU_FLASH_FWD_HPP MXTPU_FLASH_BWD_HPP
+      echo "$(date -u +%H:%M:%S) canary OK (head-grouped kernels)" >> "$LOG/watch.log"
+    else
+      export MXTPU_FLASH_FWD_HPP=1 MXTPU_FLASH_BWD_HPP=1
+      echo "$(date -u +%H:%M:%S) canary FAILED -> hpp=1 fallback" >> "$LOG/watch.log"
+    fi
     run b48-dense 700
     run large-b32-dense 950 MXTPU_BENCH_MODEL=large MXTPU_BENCH_BATCH=32 MXTPU_BENCH_REMAT=dots
     run b96-dense-dots 700 MXTPU_BENCH_BATCH=96 MXTPU_BENCH_REMAT=dots
