@@ -26,7 +26,7 @@ run() { # run NAME TIMEOUT [ENV=VAL...]
   echo "$(date -u +%H:%M:%S) done $name rc=$rc: $(head -c 200 "$LOG/$name.json" 2>/dev/null)" >> "$LOG/watch.log"
 }
 
-ALL="b48-dense large-b32-dense b96-dense-dots b96-dense-trace large-b48-dense b128-dense-dots large-b32-dense-trace b48-rbg b48-nodrop b48-jnpflash resnet-b64 nmt-decode"
+ALL="b48-dense b48-dense-hpp1 large-b32-dense b96-dense-dots b96-dense-trace large-b48-dense b128-dense-dots large-b32-dense-trace b48-rbg b48-nodrop b48-jnpflash resnet-b64 nmt-decode"
 while true; do
   if timeout 90 python -c "import jax; assert any(d.platform!='cpu' for d in jax.devices())" 2>/dev/null; then
     echo "$(date -u +%H:%M:%S) p3 window OPEN" >> "$LOG/watch.log"
@@ -50,6 +50,7 @@ while true; do
     run large-b48-dense 950 MXTPU_BENCH_MODEL=large MXTPU_BENCH_BATCH=48 MXTPU_BENCH_REMAT=dots
     run b128-dense-dots 700 MXTPU_BENCH_BATCH=128 MXTPU_BENCH_REMAT=dots
     run large-b32-dense-trace 950 MXTPU_BENCH_MODEL=large MXTPU_BENCH_BATCH=32 MXTPU_BENCH_REMAT=dots MXTPU_BENCH_TRACE=trace_r4large
+    run b48-dense-hpp1 700 MXTPU_FLASH_FWD_HPP=1 MXTPU_FLASH_BWD_HPP=1
     run b48-rbg 700 JAX_DEFAULT_PRNG_IMPL=rbg
     run b48-nodrop 700 MXTPU_BENCH_DROPOUT=0
     run b48-jnpflash 700 MXTPU_FLASH_FORCE_FALLBACK=1
@@ -61,7 +62,7 @@ while true; do
     for c in $ALL; do
       { [ -s "$LOG/$c.json" ] || [ -e "$LOG/$c.failed" ]; } && n=$((n+1))
     done
-    [ "$n" -ge 12 ] && { echo "$(date -u +%H:%M:%S) P3 ALL DONE" >> "$LOG/watch.log"; exit 0; }
+    [ "$n" -ge 13 ] && { echo "$(date -u +%H:%M:%S) P3 ALL DONE" >> "$LOG/watch.log"; exit 0; }
   else
     echo "$(date -u +%H:%M:%S) p3 down" >> "$LOG/watch.log"
   fi
