@@ -41,6 +41,16 @@ __all__ = ["BERTModel", "BERTForPretraining", "BERTClassifier",
 class BERTSelfAttention(HybridBlock):
     """Multi-head self-attention with fused QKV projection.
 
+    DESIGN NOTE (deviation from the reference): the reference's attention
+    cell (GluonNLP MultiHeadAttentionCell) applies dropout to the
+    (B, H, Tq, Tk) attention PROBABILITIES; here the ``dropout`` rate is
+    applied once to the attention output instead. Streaming/flash
+    attention never materializes the probability matrix — prob-dropout
+    would force O(T^2) memory traffic and break the Pallas kernel's
+    online softmax — so the regularizer moves to the output projection,
+    the standard choice in flash-attention training stacks. Inference
+    (dropout off) is bit-identical either way.
+
     ``seq_parallel=True``: inside a (non-recording) SPMD trace whose mesh
     has an ``sp`` axis, attention rides the sequence-parallel ring
     (parallel/ring_attention.py) with the key-padding mask converted to
