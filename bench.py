@@ -29,6 +29,8 @@ Workloads (child mode, selected with --workload):
   resnet  — ResNet-50 ImageNet-shaped data-parallel training step, img/s/chip
             (BASELINE.md config #2)
   nmt     — Transformer KV-cached beam-search decode, tokens/s (config #4)
+  gpt     — GPT-2-small causal-LM pretraining, tokens/s/chip + MFU (the
+            decoder-side complement: causal dense kernels + packed qkv)
 """
 
 import json
@@ -79,6 +81,40 @@ def _bert_flops_per_step(B, T, M, L, units, hidden, vocab):
     return enc + attn + heads
 
 
+def _env_remat_dropout():
+    """Shared MXTPU_BENCH_REMAT / MXTPU_BENCH_DROPOUT parsing:
+    "0" off; "1" whole-layer remat; "dots" selective (save matmul
+    outputs, recompute elementwise only)."""
+    remat_env = os.environ.get("MXTPU_BENCH_REMAT", "0")
+    remat = {"0": False, "1": True}.get(remat_env, remat_env)
+    dropout = float(os.environ.get("MXTPU_BENCH_DROPOUT", "0.1"))
+    return remat, dropout
+
+
+def _measure_steps(step_fn, warmup, steps):
+    """Shared measurement harness for every training workload: warmup,
+    an asnumpy fence (the REAL sync point — block_until_ready is a
+    no-op on the axon tunnel backend, verified empirically), the
+    optional MXTPU_BENCH_TRACE profiler block (BASELINE.md protocol:
+    trace evidence for perf claims), then the timed loop. Returns
+    (dt_seconds, last_loss)."""
+    loss = None
+    for _ in range(warmup):
+        loss = step_fn()
+    float(loss.asnumpy())
+    trace_dir = os.environ.get("MXTPU_BENCH_TRACE")
+    if trace_dir:
+        import jax.profiler
+        with jax.profiler.trace(trace_dir):
+            loss = step_fn()
+            float(loss.asnumpy())
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step_fn()
+    float(loss.asnumpy())
+    return time.perf_counter() - t0, loss
+
+
 def _run_bert(on_tpu):
     import numpy as np
     import jax
@@ -105,11 +141,7 @@ def _run_bert(on_tpu):
         dtype = "float32"
         steps, warmup = 3, 1
         flash = False
-    remat_env = os.environ.get("MXTPU_BENCH_REMAT", "0")
-    # "0" off; "1" whole-layer remat; "dots" selective (save matmul
-    # outputs, recompute elementwise only)
-    remat = {"0": False, "1": True}.get(remat_env, remat_env)
-    dropout = float(os.environ.get("MXTPU_BENCH_DROPOUT", "0.1"))
+    remat, dropout = _env_remat_dropout()
 
     mx.random.seed(0)
     ctor = bert_mod.bert_large if size == "large" else bert_mod.bert_base
@@ -136,26 +168,7 @@ def _run_bert(on_tpu):
                           "multi_precision": dtype != "float32"},
         sharding="replicated")
 
-    for _ in range(warmup):
-        loss = trainer.step(*batch)
-    float(loss.asnumpy())  # real fence: block_until_ready is a no-op on
-    # the axon tunnel backend (verified empirically), so the fetch IS the
-    # synchronization point — the reference's asnumpy contract
-
-    trace_dir = os.environ.get("MXTPU_BENCH_TRACE")
-    if trace_dir:
-        # profiler evidence (BASELINE.md protocol): proves the Pallas
-        # kernel executes and shows comm/compute overlap in the step
-        import jax.profiler
-        with jax.profiler.trace(trace_dir):
-            loss = trainer.step(*batch)
-            float(loss.asnumpy())
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = trainer.step(*batch)
-    float(loss.asnumpy())
-    dt = time.perf_counter() - t0
+    dt, loss = _measure_steps(lambda: trainer.step(*batch), warmup, steps)
 
     n_chips = len(jax.devices())
     tokens_per_sec_chip = B * T * steps / dt / n_chips
@@ -166,6 +179,79 @@ def _run_bert(on_tpu):
 
     return {
         "metric": f"bert_{size}_pretrain_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec_chip, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "mfu": round(mfu, 4),
+        "batch": B,
+        "seq_len": T,
+        "dtype": dtype,
+        "flash": flash,
+    }
+
+
+def _gpt_flops_per_step(B, T, L, units, hidden, vocab):
+    """Honest fwd+bwd FLOP count for causal LM training (6x matmul
+    rule): decoder matmuls + causal O(T^2/2) attention + the full-vocab
+    LM head (dominant at GPT-2 vocab). Embedding gathers excluded."""
+    dec = 6.0 * B * T * L * (4 * units * units + 2 * units * hidden)
+    attn = 6.0 * L * B * T * T * units          # causal: half of full
+    head = 6.0 * B * T * units * vocab
+    return dec + attn + head
+
+
+def _run_gpt(on_tpu):
+    """GPT-2-small causal-LM pretraining throughput (tokens/s/chip +
+    MFU). Exercises the CAUSAL dense Pallas kernels + packed-qkv path —
+    the decoder-side complement to the BERT (encoder) headline."""
+    import numpy as np
+    import jax
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, parallel
+    from incubator_mxnet_tpu.models import gpt as gpt_mod
+
+    if on_tpu or os.environ.get("MXTPU_BENCH_TPU_CONFIG") == "1":
+        B = int(os.environ.get("MXTPU_BENCH_BATCH", "16"))
+        T = 512
+        dtype = "bfloat16"
+        steps, warmup = (10, 3) if on_tpu else (1, 1)
+        flash = True
+    else:
+        B, T = 2, 64
+        dtype = "float32"
+        steps, warmup = 3, 1
+        flash = False
+    remat, dropout = _env_remat_dropout()
+
+    mx.random.seed(0)
+    # gpt_small pins max_length=1024 (>= the benched T=512)
+    model = gpt_mod.gpt_small(dtype=dtype, flash=flash, remat=remat,
+                              dropout=dropout)
+    model.initialize()
+
+    rng = np.random.RandomState(0)
+    V = model.vocab_size
+    batch = (
+        nd.array(rng.randint(0, V, (B, T)), dtype="int32"),
+        nd.array(rng.randint(0, V, (B, T)), dtype="int32"),
+    )
+
+    trainer = parallel.SPMDTrainer(
+        model, forward_loss=gpt_mod.lm_loss, optimizer="adamw",
+        optimizer_params={"learning_rate": 1e-4,
+                          "multi_precision": dtype != "float32"},
+        sharding="replicated")
+
+    dt, loss = _measure_steps(lambda: trainer.step(*batch), warmup, steps)
+
+    n_chips = len(jax.devices())
+    tokens_per_sec_chip = B * T * steps / dt / n_chips
+    flops_per_step = _gpt_flops_per_step(
+        B, T, model.num_layers, model._units, model.hidden_size, V)
+    mfu = (flops_per_step * steps / dt) / (_peak_flops_per_chip() * n_chips)
+
+    return {
+        "metric": "gpt2_small_pretrain_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec_chip, 2),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.45, 4),
@@ -295,7 +381,7 @@ def _child_main(workload):
         jax.config.update("jax_platforms", "cpu")
     on_tpu = any(d.platform != "cpu" for d in jax.devices())
     result = {"bert": _run_bert, "resnet": _run_resnet,
-              "nmt": _run_nmt}[workload](on_tpu)
+              "nmt": _run_nmt, "gpt": _run_gpt}[workload](on_tpu)
     result["platform"] = jax.devices()[0].platform
     print("BENCH_RESULT " + json.dumps(result))
 

@@ -26,7 +26,7 @@ run() { # run NAME TIMEOUT [ENV=VAL...]
   echo "$(date -u +%H:%M:%S) done $name rc=$rc: $(head -c 200 "$LOG/$name.json" 2>/dev/null)" >> "$LOG/watch.log"
 }
 
-ALL="b48-dense b48-dense-hpp1 large-b32-dense b96-dense-dots b96-dense-trace large-b48-dense b128-dense-dots large-b32-dense-trace b48-rbg b48-nodrop b48-jnpflash resnet-b64 nmt-decode"
+ALL="b48-dense b48-dense-hpp1 large-b32-dense b96-dense-dots b96-dense-trace large-b48-dense b128-dense-dots large-b32-dense-trace b48-rbg b48-nodrop b48-jnpflash gpt-b16 gpt-b32-dots resnet-b64 nmt-decode"
 while true; do
   if timeout 90 python -c "import jax; assert any(d.platform!='cpu' for d in jax.devices())" 2>/dev/null; then
     echo "$(date -u +%H:%M:%S) p3 window OPEN" >> "$LOG/watch.log"
@@ -54,15 +54,18 @@ while true; do
     run b48-rbg 700 JAX_DEFAULT_PRNG_IMPL=rbg
     run b48-nodrop 700 MXTPU_BENCH_DROPOUT=0
     run b48-jnpflash 700 MXTPU_FLASH_FORCE_FALLBACK=1
+    WL=gpt run gpt-b16 700
+    WL=gpt run gpt-b32-dots 700 MXTPU_BENCH_BATCH=32 MXTPU_BENCH_REMAT=dots
     WL=resnet run resnet-b64 700
     WL=nmt run nmt-decode 700
     echo "$(date -u +%H:%M:%S) p3 pass complete" >> "$LOG/watch.log"
     python tools/collect_runs.py >> "$LOG/watch.log" 2>&1
-    n=0
+    n=0; total=0
     for c in $ALL; do
+      total=$((total+1))
       { [ -s "$LOG/$c.json" ] || [ -e "$LOG/$c.failed" ]; } && n=$((n+1))
     done
-    [ "$n" -ge 13 ] && { echo "$(date -u +%H:%M:%S) P3 ALL DONE" >> "$LOG/watch.log"; exit 0; }
+    [ "$n" -ge "$total" ] && { echo "$(date -u +%H:%M:%S) P3 ALL DONE" >> "$LOG/watch.log"; exit 0; }
   else
     echo "$(date -u +%H:%M:%S) p3 down" >> "$LOG/watch.log"
   fi
