@@ -94,6 +94,22 @@ def _overlap_len(spans, merged_other):
     return total
 
 
+def _scope_family(args_dict, hlo_name):
+    """Human attribution for a device op: the innermost named jit scope
+    from the op's tf_op metadata, tagged fwd/bwd (the AD-transpose
+    transform marks backward ops), falling back to the HLO base name.
+    This is what turns `transpose_jvp_jit__flash_backward___.5` into
+    `_flash_backward [bwd]` in the scope table."""
+    scope = (args_dict or {}).get("tf_op") or ""
+    fns = re.findall(r"jit\(([A-Za-z_][\w.]*)\)", scope)
+    fns = [f for f in fns if f not in ("step", "train_step", "main")]
+    direction = " [bwd]" if "transpose(" in scope else ""
+    if fns:
+        return fns[-1] + direction
+    base = re.sub(r"\.\d+$", "", hlo_name)
+    return base + direction
+
+
 def summarize(trace_dir, top=12):
     path = _find_trace_file(trace_dir)
     with gzip.open(path, "rt") as f:
@@ -101,6 +117,8 @@ def summarize(trace_dir, top=12):
     events = data.get("traceEvents", [])
     lanes = _device_op_lanes(events)
 
+    per_scope = Counter()
+    scope_count = Counter()
     per_op = Counter()
     # overlap accounting is PER DEVICE (pid): a collective on chip 0 is
     # only "overlapped" if chip 0 itself computes concurrently — compute
@@ -116,6 +134,9 @@ def summarize(trace_dir, top=12):
         if ts is None or dur is None:
             continue
         per_op[name] += dur
+        fam = _scope_family(e.get("args"), name)
+        per_scope[fam] += dur
+        scope_count[fam] += 1
         t_min, t_max = min(t_min, ts), max(t_max, ts + dur)
         span, pid = (ts, ts + dur), e.get("pid")
         if any(m in name.lower() for m in COLLECTIVE_MARKERS):
@@ -168,6 +189,15 @@ def summarize(trace_dir, top=12):
     for name, dur in family.most_common(top):
         lines.append(
             f"| `{name[:70]}` | {fam_count[name]} | {dur / 1e3:.2f} | "
+            f"{100 * dur / total_busy:.1f}% |")
+    lines += ["", f"Top {top} source scopes (innermost named jit scope"
+              " from op metadata; [bwd] = under the AD-transpose"
+              " transform):", "",
+              "| scope | instances | total ms | % of busy |",
+              "|---|---|---|---|"]
+    for name, dur in per_scope.most_common(top):
+        lines.append(
+            f"| `{name[:70]}` | {scope_count[name]} | {dur / 1e3:.2f} | "
             f"{100 * dur / total_busy:.1f}% |")
     lines += ["", f"Top {top} individual ops:", "",
               "| op | total ms | % of busy |", "|---|---|---|"]
