@@ -46,6 +46,12 @@ while true; do
     run b48-dense 700
     run large-b32-dense 950 MXTPU_BENCH_MODEL=large MXTPU_BENCH_BATCH=32 MXTPU_BENCH_REMAT=dots
     run b96-dense-dots 700 MXTPU_BENCH_BATCH=96 MXTPU_BENCH_REMAT=dots
+    if [ ! -s "$LOG/kernelbench.json" ]; then
+      timeout 700 python tools/kernel_bench.py > "$LOG/kernelbench.out" 2> "$LOG/kernelbench.err"
+      grep -o '{"kernel_bench.*' "$LOG/kernelbench.out" | tail -1 > "$LOG/kernelbench.json" || true
+      [ -s "$LOG/kernelbench.json" ] || rm -f "$LOG/kernelbench.json"
+      echo "$(date -u +%H:%M:%S) kernelbench: $(head -c 150 "$LOG/kernelbench.json" 2>/dev/null)" >> "$LOG/watch.log"
+    fi
     run b96-dense-trace 700 MXTPU_BENCH_BATCH=96 MXTPU_BENCH_REMAT=dots MXTPU_BENCH_TRACE=trace_r4b
     run large-b48-dense 950 MXTPU_BENCH_MODEL=large MXTPU_BENCH_BATCH=48 MXTPU_BENCH_REMAT=dots
     run b128-dense-dots 700 MXTPU_BENCH_BATCH=128 MXTPU_BENCH_REMAT=dots
