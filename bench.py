@@ -611,6 +611,20 @@ def main():
                 break
             errors.append(err if res is None
                           else f"attempt {i + 1} landed on cpu")
+            if (res is None and err and i < n_attempts - 1
+                    and os.environ.get("MXTPU_FLASH_FWD_HPP") != "1"
+                    and any(m in err for m in ("Mosaic", "mosaic",
+                                               "pallas_call", "Pallas"))):
+                # kernel-compile regression (not a tunnel flake): FORCE
+                # the hardware-validated kernel configuration for the
+                # remaining attempts (assignment, not setdefault — an
+                # operator-exported grouping override may be the very
+                # thing that broke) so one bad kernel variant cannot
+                # zero the driver's round artifact. Applied once.
+                os.environ["MXTPU_FLASH_FWD_HPP"] = "1"
+                os.environ["MXTPU_FLASH_BWD_HPP"] = "1"
+                errors.append("kernel error -> retrying with the pinned "
+                              "hpp=1 kernels")
             if res is not None:
                 # child saw no TPU but DID complete the CPU smoke — bank
                 # it if step 2's CPU smoke failed, then stop burning budget
