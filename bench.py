@@ -595,6 +595,7 @@ def main():
                       "cpu": 0}[verdict]
         if verdict != "tpu":
             errors.append(f"tpu: liveness probe verdict={verdict}")
+        kernel_pinned = False
         for i in range(n_attempts):
             if _remaining() < 120:
                 errors.append("tpu: budget exhausted before attempt "
@@ -612,19 +613,24 @@ def main():
             errors.append(err if res is None
                           else f"attempt {i + 1} landed on cpu")
             if (res is None and err and i < n_attempts - 1
-                    and os.environ.get("MXTPU_FLASH_FWD_HPP") != "1"
+                    and not kernel_pinned
                     and any(m in err for m in ("Mosaic", "mosaic",
                                                "pallas_call", "Pallas"))):
                 # kernel-compile regression (not a tunnel flake): FORCE
-                # the hardware-validated kernel configuration for the
-                # remaining attempts (assignment, not setdefault — an
-                # operator-exported grouping override may be the very
-                # thing that broke) so one bad kernel variant cannot
-                # zero the driver's round artifact. Applied once.
+                # the full hardware-validated kernel configuration for
+                # the remaining attempts — hpp=1 assigned outright and
+                # every other trace-time kernel knob cleared back to its
+                # validated default (an operator-exported override on
+                # ANY of them may be the very thing that broke). Local
+                # flag = applied exactly once.
+                kernel_pinned = True
                 os.environ["MXTPU_FLASH_FWD_HPP"] = "1"
                 os.environ["MXTPU_FLASH_BWD_HPP"] = "1"
+                for var in ("MXTPU_FLASH_DENSE_T", "MXTPU_FLASH_BLOCK_Q",
+                            "MXTPU_FLASH_BLOCK_K"):
+                    os.environ.pop(var, None)
                 errors.append("kernel error -> retrying with the pinned "
-                              "hpp=1 kernels")
+                              "validated kernel config")
             if res is not None:
                 # child saw no TPU but DID complete the CPU smoke — bank
                 # it if step 2's CPU smoke failed, then stop burning budget
