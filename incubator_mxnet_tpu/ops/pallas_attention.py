@@ -88,50 +88,75 @@ def _tile_mask(bq, bk, vl, causal, q_off=0, k_off=0):
 # forward kernel
 # --------------------------------------------------------------------- #
 
+def _largest_divisor(H, cap, per_head_bytes=0, budget=None):
+    """Largest divisor of H within ``cap`` and (optionally) a byte
+    budget — the single selection rule behind BOTH head-grouping
+    helpers so the dense and streaming paths cannot diverge."""
+    hpp = 1
+    for d in range(1, H + 1):
+        if H % d == 0 and d <= cap and (
+                budget is None or d * per_head_bytes <= budget):
+            hpp = d
+    return hpp
+
+
+def _stream_hpp(H, per_head_bytes):
+    """Heads per program for the STREAMING kernels: largest divisor of H
+    whose block set stays inside a ~2.5 MB per-program VMEM budget
+    (double-buffered by Pallas on top). Derived from static shapes only
+    — no env knob — so resolving it at trace time inside the jitted
+    wrappers cannot create a stale-cache hazard. Same rationale as the
+    dense kernels' grouping: per-program MXU work at one (head, tile)
+    is ~0.3 us, the same order as Mosaic's per-program overhead."""
+    return _largest_divisor(H, 8, per_head_bytes, 2_500_000)
+
+
 def _flash_kernel(vl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
-                  causal, block_q, block_k, n_k_blocks):
+                  causal, block_q, block_k, n_k_blocks, hpp):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(2)
-    # dot OPERANDS stay in the input dtype (bf16 inputs hit the MXU at
-    # full rate — an f32 upcast here quarters matmul throughput); all
-    # ACCUMULATION (s, m, l, acc) is f32 via preferred_element_type.
-    # The scale is applied to the f32 scores, not the narrow operands.
-    q = q_ref[0, 0]                                      # (bq, D)
-    bq, D = q.shape
     # lengths ride along as the full (B, 1) array in SMEM (Mosaic requires
     # SMEM blocks tiled 8x128 OR equal to the array dims; (1,1) blocks of
     # a (B,1) array violate that) — each program picks its batch row.
     vl = vl_ref[pl.program_id(0), 0]                     # valid key length
 
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
-        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
-            precision=lax.Precision.DEFAULT) * scale
-        mask = _tile_mask(block_q, block_k, vl, causal,
-                          q_off=qi * block_q, k_off=j * block_k)
-        s = jnp.where(mask, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1)
-        acc_new = acc * alpha[:, None] + jnp.dot(
-            p.astype(v.dtype), v, preferred_element_type=jnp.float32,
-            precision=lax.Precision.DEFAULT)
-        return m_new, l_new, acc_new
+    for h in range(hpp):                                 # unrolled heads
+        # dot OPERANDS stay in the input dtype (bf16 inputs hit the MXU
+        # at full rate — an f32 upcast here quarters matmul throughput);
+        # ACCUMULATION (s, m, l, acc) is f32 via preferred_element_type.
+        # The scale is applied to the f32 scores, not the narrow operands.
+        q = q_ref[0, h]                                  # (bq, D)
+        bq, D = q.shape
 
-    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc0 = jnp.zeros((block_q, D), jnp.float32)
-    m, l, acc = lax.fori_loop(0, n_k_blocks, body, (m0, l0, acc0))
-    l_safe = jnp.maximum(l, 1e-30)
-    o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    # lse carries a trailing singleton lane dim: Mosaic requires the last
-    # two block dims (8, 128)-tiled or equal to the array dims, which a
-    # (1, 1, block_q) block of a (B, H, Tq) array is not.
-    lse_ref[0, 0] = (m + jnp.log(l_safe))[:, None]
+        def body(j, carry, _h=h, _q=q):
+            m, l, acc = carry
+            k = k_ref[0, _h, pl.ds(j * block_k, block_k), :]
+            v = v_ref[0, _h, pl.ds(j * block_k, block_k), :]
+            s = jnp.dot(_q, k.T, preferred_element_type=jnp.float32,
+                precision=lax.Precision.DEFAULT) * scale
+            mask = _tile_mask(block_q, block_k, vl, causal,
+                              q_off=qi * block_q, k_off=j * block_k)
+            s = jnp.where(mask, s, _NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            p = jnp.exp(s - m_new[:, None])
+            alpha = jnp.exp(m - m_new)
+            l_new = l * alpha + jnp.sum(p, axis=-1)
+            acc_new = acc * alpha[:, None] + jnp.dot(
+                p.astype(v.dtype), v, preferred_element_type=jnp.float32,
+                precision=lax.Precision.DEFAULT)
+            return m_new, l_new, acc_new
+
+        m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((block_q,), jnp.float32)
+        acc0 = jnp.zeros((block_q, D), jnp.float32)
+        m, l, acc = lax.fori_loop(0, n_k_blocks, body, (m0, l0, acc0))
+        l_safe = jnp.maximum(l, 1e-30)
+        o_ref[0, h] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+        # lse carries a trailing singleton lane dim: Mosaic requires the
+        # last two block dims (8, 128)-tiled or equal to the array dims,
+        # which a (1, 1, block_q) block of a (B, H, Tq) array is not.
+        lse_ref[0, h] = (m + jnp.log(l_safe))[:, None]
 
 
 def _pad_to(x, axis, multiple):
@@ -174,23 +199,30 @@ def _flash_fwd_lse(q, k, v, valid_len, causal=False, scale=None,
     # valid_len caps at real Tk so padded keys never attend
     vl = jnp.minimum(valid_len.astype(jnp.int32), Tk).reshape(B, 1)
 
+    itemsize = q.dtype.itemsize
+    # per-head blocks: k+v (Tk_p) and q+o (block_q), plus the f32 lse
+    shpp = _stream_hpp(H, (2 * Tk_p + 2 * block_q) * D * itemsize
+                       + 4 * block_q)
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, n_k_blocks=n_k_blocks)
+        block_k=block_k, n_k_blocks=n_k_blocks, hpp=shpp)
 
     out, lse = pl.pallas_call(
         kernel,
-        grid=(B, H, Tq_p // block_q),
+        grid=(B, H // shpp, Tq_p // block_q),
         in_specs=[
-            pl.BlockSpec((B, 1), lambda b, h, i: (0, 0),
+            pl.BlockSpec((B, 1), lambda b, g, i: (0, 0),
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, Tk_p, D), lambda b, h, i: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, Tk_p, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, shpp, block_q, D),
+                         lambda b, g, i: (b, g, i, 0)),
+            pl.BlockSpec((1, shpp, Tk_p, D), lambda b, g, i: (b, g, 0, 0)),
+            pl.BlockSpec((1, shpp, Tk_p, D), lambda b, g, i: (b, g, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, shpp, block_q, D),
+                         lambda b, g, i: (b, g, i, 0)),
+            pl.BlockSpec((1, shpp, block_q, 1),
+                         lambda b, g, i: (b, g, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, Tq_p, D), q.dtype),
@@ -259,11 +291,7 @@ def _heads_per_program(H, cap_env, cap_default):
     Caps (fwd 16 / bwd 8 by default, env-tunable) keep the double-
     buffered block set inside the ~16 MB/core VMEM."""
     cap = max(1, _env_block(cap_env, cap_default))
-    hpp = 1
-    for d in range(1, H + 1):
-        if H % d == 0 and d <= cap:
-            hpp = d
-    return hpp
+    return _largest_divisor(H, cap)
 
 
 def _dense_fwd_kernel(vl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
@@ -422,77 +450,82 @@ def _dense_backward(q, k, v, valid_len, lse, g, delta, causal, scale,
 
 def _flash_bwd_dq_kernel(vl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                          delta_ref, dq_ref, *, scale, causal, block_q,
-                         block_k, n_k_blocks):
+                         block_k, n_k_blocks, hpp):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(2)
-    # same dtype discipline as the forward kernel: dot operands keep the
-    # input dtype (bf16 -> full-rate MXU), accumulators/statistics f32
-    q = q_ref[0, 0]                                       # (bq, D)
-    do = do_ref[0, 0]                                     # (bq, D)
-    lse = lse_ref[0, 0, :, 0].astype(jnp.float32)         # (bq,)
-    delta = delta_ref[0, 0, :, 0].astype(jnp.float32)     # (bq,)
     vl = vl_ref[pl.program_id(0), 0]
-    bq, D = q.shape
 
-    def body(j, dq):
-        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
-        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
-            precision=lax.Precision.DEFAULT) * scale
-        mask = _tile_mask(block_q, block_k, vl, causal,
-                          q_off=qi * block_q, k_off=j * block_k)
-        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
-        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32,
-            precision=lax.Precision.DEFAULT)
-        ds = (p * (dp - delta[:, None]) * scale).astype(k.dtype)
-        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32,
-            precision=lax.Precision.DEFAULT)
+    for h in range(hpp):                                  # unrolled heads
+        # same dtype discipline as the forward kernel: dot operands keep
+        # the input dtype (bf16 -> full-rate MXU), accumulators f32
+        q = q_ref[0, h]                                   # (bq, D)
+        do = do_ref[0, h]                                 # (bq, D)
+        lse = lse_ref[0, h, :, 0].astype(jnp.float32)     # (bq,)
+        delta = delta_ref[0, h, :, 0].astype(jnp.float32)
+        bq, D = q.shape
 
-    dq = lax.fori_loop(0, n_k_blocks, body, jnp.zeros((bq, D), jnp.float32))
-    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+        def body(j, dq, _h=h, _q=q, _do=do, _lse=lse, _delta=delta):
+            k = k_ref[0, _h, pl.ds(j * block_k, block_k), :]
+            v = v_ref[0, _h, pl.ds(j * block_k, block_k), :]
+            s = jnp.dot(_q, k.T, preferred_element_type=jnp.float32,
+                precision=lax.Precision.DEFAULT) * scale
+            mask = _tile_mask(block_q, block_k, vl, causal,
+                              q_off=qi * block_q, k_off=j * block_k)
+            p = jnp.where(mask, jnp.exp(s - _lse[:, None]), 0.0)
+            dp = jnp.dot(_do, v.T, preferred_element_type=jnp.float32,
+                precision=lax.Precision.DEFAULT)
+            ds = (p * (dp - _delta[:, None]) * scale).astype(k.dtype)
+            return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32,
+                precision=lax.Precision.DEFAULT)
+
+        dq = lax.fori_loop(0, n_k_blocks, body,
+                           jnp.zeros((bq, D), jnp.float32))
+        dq_ref[0, h] = dq.astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkv_kernel(vl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                           delta_ref, dk_ref, dv_ref, *, scale, causal,
-                          block_q, block_k, n_q_blocks):
+                          block_q, block_k, n_q_blocks, hpp):
     from jax.experimental import pallas as pl
 
     ki = pl.program_id(2)
-    # dot operands keep the input dtype; accumulators f32 (see forward)
-    k = k_ref[0, 0]                                       # (bk, D)
-    v = v_ref[0, 0]                                       # (bk, D)
     vl = vl_ref[pl.program_id(0), 0]
-    bk, D = k.shape
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, 0, pl.ds(i * block_q, block_q), :]
-        do = do_ref[0, 0, pl.ds(i * block_q, block_q), :]
-        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q), 0] \
-            .astype(jnp.float32)
-        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q), 0] \
-            .astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
-            precision=lax.Precision.DEFAULT) * scale
-        mask = _tile_mask(block_q, block_k, vl, causal,
-                          q_off=i * block_q, k_off=ki * block_k)
-        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)   # (bq, bk)
-        dv = dv + jnp.dot(p.astype(do.dtype).T, do,
-                          preferred_element_type=jnp.float32,
-            precision=lax.Precision.DEFAULT)
-        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32,
-            precision=lax.Precision.DEFAULT)
-        ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
-        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32,
-            precision=lax.Precision.DEFAULT)
-        return dk, dv
+    for h in range(hpp):                                  # unrolled heads
+        # dot operands keep the input dtype; accumulators f32 (see fwd)
+        k = k_ref[0, h]                                   # (bk, D)
+        v = v_ref[0, h]                                   # (bk, D)
+        bk, D = k.shape
 
-    dk0 = jnp.zeros((bk, D), jnp.float32)
-    dv0 = jnp.zeros((bk, D), jnp.float32)
-    dk, dv = lax.fori_loop(0, n_q_blocks, body, (dk0, dv0))
-    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
-    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+        def body(i, carry, _h=h, _k=k, _v=v):
+            dk, dv = carry
+            q = q_ref[0, _h, pl.ds(i * block_q, block_q), :]
+            do = do_ref[0, _h, pl.ds(i * block_q, block_q), :]
+            lse = lse_ref[0, _h, pl.ds(i * block_q, block_q), 0] \
+                .astype(jnp.float32)
+            delta = delta_ref[0, _h, pl.ds(i * block_q, block_q), 0] \
+                .astype(jnp.float32)
+            s = jnp.dot(q, _k.T, preferred_element_type=jnp.float32,
+                precision=lax.Precision.DEFAULT) * scale
+            mask = _tile_mask(block_q, block_k, vl, causal,
+                              q_off=i * block_q, k_off=ki * block_k)
+            p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)  # (bq,bk)
+            dv = dv + jnp.dot(p.astype(do.dtype).T, do,
+                              preferred_element_type=jnp.float32,
+                precision=lax.Precision.DEFAULT)
+            dp = jnp.dot(do, _v.T, preferred_element_type=jnp.float32,
+                precision=lax.Precision.DEFAULT)
+            ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
+            dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32,
+                precision=lax.Precision.DEFAULT)
+            return dk, dv
+
+        dk0 = jnp.zeros((bk, D), jnp.float32)
+        dv0 = jnp.zeros((bk, D), jnp.float32)
+        dk, dv = lax.fori_loop(0, n_q_blocks, body, (dk0, dv0))
+        dk_ref[0, h] = dk.astype(dk_ref.dtype)
+        dv_ref[0, h] = dv.astype(dv_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
@@ -533,47 +566,62 @@ def _flash_backward(q, k, v, valid_len, out, lse, g, causal=False,
     n_q_blocks, n_k_blocks = Tq_p // block_q, Tk_p // block_k
     vl = jnp.minimum(valid_len.astype(jnp.int32), Tk).reshape(B, 1)
 
+    itemsize = q.dtype.itemsize
+    # dq per-head blocks: k+v (Tk_p), q+do+dq (block_q), lse+delta f32
+    qhpp = _stream_hpp(H, (2 * Tk_p + 3 * block_q) * D * itemsize
+                       + 8 * block_q)
     dq_kernel = functools.partial(
         _flash_bwd_dq_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, n_k_blocks=n_k_blocks)
+        block_k=block_k, n_k_blocks=n_k_blocks, hpp=qhpp)
     dq = pl.pallas_call(
         dq_kernel,
-        grid=(B, H, n_q_blocks),
+        grid=(B, H // qhpp, n_q_blocks),
         in_specs=[
-            pl.BlockSpec((B, 1), lambda b, h, i: (0, 0),
+            pl.BlockSpec((B, 1), lambda b, g, i: (0, 0),
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, Tk_p, D), lambda b, h, i: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, Tk_p, D), lambda b, h, i: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, qhpp, block_q, D),
+                         lambda b, g, i: (b, g, i, 0)),
+            pl.BlockSpec((1, qhpp, Tk_p, D), lambda b, g, i: (b, g, 0, 0)),
+            pl.BlockSpec((1, qhpp, Tk_p, D), lambda b, g, i: (b, g, 0, 0)),
+            pl.BlockSpec((1, qhpp, block_q, D),
+                         lambda b, g, i: (b, g, i, 0)),
+            pl.BlockSpec((1, qhpp, block_q, 1),
+                         lambda b, g, i: (b, g, i, 0)),
+            pl.BlockSpec((1, qhpp, block_q, 1),
+                         lambda b, g, i: (b, g, i, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, D),
-                               lambda b, h, i: (b, h, i, 0)),
+        out_specs=pl.BlockSpec((1, qhpp, block_q, D),
+                               lambda b, g, i: (b, g, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B, H, Tq_p, D), q.dtype),
         interpret=interpret,
     )(vl, qp, kp, vp, dop, lsep, deltap)
 
+    # dkv per-head blocks: q+do (Tq_p), k+v+dk+dv (block_k), lse+delta
+    khpp = _stream_hpp(H, (2 * Tq_p + 4 * block_k) * D * itemsize
+                       + 8 * Tq_p)
     dkv_kernel = functools.partial(
         _flash_bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, n_q_blocks=n_q_blocks)
+        block_k=block_k, n_q_blocks=n_q_blocks, hpp=khpp)
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(B, H, n_k_blocks),
+        grid=(B, H // khpp, n_k_blocks),
         in_specs=[
-            pl.BlockSpec((B, 1), lambda b, h, j: (0, 0),
+            pl.BlockSpec((B, 1), lambda b, g, j: (0, 0),
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, Tq_p, D), lambda b, h, j: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, Tq_p, D), lambda b, h, j: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, Tq_p, 1), lambda b, h, j: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, Tq_p, 1), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, khpp, Tq_p, D), lambda b, g, j: (b, g, 0, 0)),
+            pl.BlockSpec((1, khpp, block_k, D),
+                         lambda b, g, j: (b, g, j, 0)),
+            pl.BlockSpec((1, khpp, block_k, D),
+                         lambda b, g, j: (b, g, j, 0)),
+            pl.BlockSpec((1, khpp, Tq_p, D), lambda b, g, j: (b, g, 0, 0)),
+            pl.BlockSpec((1, khpp, Tq_p, 1), lambda b, g, j: (b, g, 0, 0)),
+            pl.BlockSpec((1, khpp, Tq_p, 1), lambda b, g, j: (b, g, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((1, khpp, block_k, D),
+                         lambda b, g, j: (b, g, j, 0)),
+            pl.BlockSpec((1, khpp, block_k, D),
+                         lambda b, g, j: (b, g, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, Tk_p, D), k.dtype),
