@@ -26,7 +26,7 @@ def _bench_one(T, reps=20):
     import jax
     import jax.numpy as jnp
     from incubator_mxnet_tpu.ops.pallas_attention import (
-        flash_attention_bhtd, use_flash_attention)
+        flash_attention_bhtd)
 
     # interpret mode off-TPU lets the harness self-check on CPU
     interp = not any(d.platform != "cpu" for d in jax.devices())
