@@ -110,7 +110,9 @@ def main():
                     os.environ.pop(k_, None)
                 else:
                     os.environ[k_] = saved[k_]
-    print(json.dumps({"kernel_bench": results}))
+        # cumulative line after EVERY config: a timeout mid-run still
+        # leaves the last complete JSON for the ladder to bank
+        print(json.dumps({"kernel_bench": results}), flush=True)
     return 0
 
 
