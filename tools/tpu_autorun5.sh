@@ -1,0 +1,87 @@
+#!/bin/bash
+# Round-5 measurement ladder (supersedes tpu_autorun3.sh). Ordering per
+# VERDICT r4: (1) the north-star BERT-large config with the queued
+# kernel work live, then (2) ResNet-50 and (3) NMT decode — the two
+# workloads that have never produced a TPU number in four rounds — so
+# even a ~25-minute window banks all three. Headline BERT-base, traces,
+# kernel micro-bench, and the A/B probes follow.
+# Re-entrant: a config with a banked .json (or .failed marker for
+# non-transient failures) is skipped on later passes.
+cd "$(dirname "$0")/.." || exit 1
+LOG=TPU_RUNS_r05
+mkdir -p "$LOG"
+export MXTPU_ROUND=5
+
+run() { # run NAME TIMEOUT [ENV=VAL...]
+  local name=$1 to=$2; shift 2
+  [ -s "$LOG/$name.json" ] && return 0
+  [ -e "$LOG/$name.failed" ] && return 0
+  echo "$(date -u +%H:%M:%S) start $name" >> "$LOG/watch.log"
+  env "$@" timeout "$to" python bench.py --run --workload "${WL:-bert}" \
+    > "$LOG/$name.out" 2> "$LOG/$name.err"
+  local rc=$?
+  grep BENCH_RESULT "$LOG/$name.out" | tail -1 | sed 's/BENCH_RESULT //' \
+    > "$LOG/$name.json" || true
+  if [ ! -s "$LOG/$name.json" ]; then
+    rm -f "$LOG/$name.json"
+    [ "$rc" != 124 ] && tail -c 400 "$LOG/$name.err" > "$LOG/$name.failed"
+  fi
+  echo "$(date -u +%H:%M:%S) done $name rc=$rc: $(head -c 200 "$LOG/$name.json" 2>/dev/null)" >> "$LOG/watch.log"
+}
+
+ALL="large-b32-dense resnet-b64 nmt-decode b48-dense b96-dense-dots large-b32-dense-trace b96-dense-trace large-b48-dense b128-dense-dots b48-dense-hpp1 b48-rbg b48-nodrop b48-jnpflash gpt-b16 gpt-b32-dots"
+while true; do
+  if timeout 90 python -c "import jax; assert any(d.platform!='cpu' for d in jax.devices())" 2>/dev/null; then
+    echo "$(date -u +%H:%M:%S) p5 window OPEN" >> "$LOG/watch.log"
+    # canary: if the head-grouped dense kernels fail Mosaic, fall back
+    # to the hpp=1 configuration hardware-validated in round 4 so a
+    # kernel regression cannot zero the window. HPP vars cleared FIRST
+    # so a previous window's fallback cannot leak into the canary.
+    unset MXTPU_FLASH_FWD_HPP MXTPU_FLASH_BWD_HPP
+    if timeout 420 python tools/kernel_canary.py >> "$LOG/canary.log" 2>&1; then
+      unset MXTPU_FLASH_FWD_HPP MXTPU_FLASH_BWD_HPP
+      echo "$(date -u +%H:%M:%S) canary OK (head-grouped kernels)" >> "$LOG/watch.log"
+    else
+      export MXTPU_FLASH_FWD_HPP=1 MXTPU_FLASH_BWD_HPP=1
+      echo "$(date -u +%H:%M:%S) canary FAILED -> hpp=1 fallback" >> "$LOG/watch.log"
+    fi
+    # --- the three must-bank rungs, in priority order ---
+    run large-b32-dense 950 MXTPU_BENCH_MODEL=large MXTPU_BENCH_BATCH=32 MXTPU_BENCH_REMAT=dots
+    WL=resnet run resnet-b64 700
+    WL=nmt run nmt-decode 700
+    # --- headline base + batch scaling ---
+    run b48-dense 700
+    run b96-dense-dots 700 MXTPU_BENCH_BATCH=96 MXTPU_BENCH_REMAT=dots
+    # --- traces (evidence for the transpose-sink fix) ---
+    run large-b32-dense-trace 950 MXTPU_BENCH_MODEL=large MXTPU_BENCH_BATCH=32 MXTPU_BENCH_REMAT=dots MXTPU_BENCH_TRACE=trace_r5large
+    run b96-dense-trace 700 MXTPU_BENCH_BATCH=96 MXTPU_BENCH_REMAT=dots MXTPU_BENCH_TRACE=trace_r5b
+    if [ ! -s "$LOG/kernelbench.json" ]; then
+      timeout 700 python tools/kernel_bench.py > "$LOG/kernelbench.out" 2> "$LOG/kernelbench.err"
+      grep -o '{"kernel_bench.*' "$LOG/kernelbench.out" | tail -1 > "$LOG/kernelbench.json" || true
+      [ -s "$LOG/kernelbench.json" ] || rm -f "$LOG/kernelbench.json"
+      echo "$(date -u +%H:%M:%S) kernelbench: $(head -c 150 "$LOG/kernelbench.json" 2>/dev/null)" >> "$LOG/watch.log"
+    fi
+    # --- batch/remat frontier ---
+    run large-b48-dense 950 MXTPU_BENCH_MODEL=large MXTPU_BENCH_BATCH=48 MXTPU_BENCH_REMAT=dots
+    run b128-dense-dots 700 MXTPU_BENCH_BATCH=128 MXTPU_BENCH_REMAT=dots
+    # --- A/B probes ---
+    run b48-dense-hpp1 700 MXTPU_FLASH_FWD_HPP=1 MXTPU_FLASH_BWD_HPP=1
+    run b48-rbg 700 JAX_DEFAULT_PRNG_IMPL=rbg
+    run b48-nodrop 700 MXTPU_BENCH_DROPOUT=0
+    run b48-jnpflash 700 MXTPU_FLASH_FORCE_FALLBACK=1
+    # --- secondary workloads ---
+    WL=gpt run gpt-b16 700
+    WL=gpt run gpt-b32-dots 700 MXTPU_BENCH_BATCH=32 MXTPU_BENCH_REMAT=dots
+    echo "$(date -u +%H:%M:%S) p5 pass complete" >> "$LOG/watch.log"
+    python tools/collect_runs.py >> "$LOG/watch.log" 2>&1
+    n=0; total=0
+    for c in $ALL; do
+      total=$((total+1))
+      { [ -s "$LOG/$c.json" ] || [ -e "$LOG/$c.failed" ]; } && n=$((n+1))
+    done
+    [ "$n" -ge "$total" ] && { echo "$(date -u +%H:%M:%S) P5 ALL DONE" >> "$LOG/watch.log"; exit 0; }
+  else
+    echo "$(date -u +%H:%M:%S) p5 down" >> "$LOG/watch.log"
+  fi
+  sleep 180
+done
