@@ -12,6 +12,7 @@ host→device copy behind the previous step's compute.
 from __future__ import annotations
 
 import multiprocessing
+import os
 import queue
 import threading
 from typing import Callable, Optional
@@ -67,6 +68,16 @@ class DataLoader:
 
     Parameters mirror the reference: batch_size, shuffle, sampler,
     last_batch, batch_sampler, batchify_fn, num_workers, prefetch.
+
+    ``num_workers > 0`` with the default process pool starts workers via
+    ``forkserver`` (never ``fork`` — forking the JAX-threaded parent can
+    deadlock a worker in a copied lock). Like every spawn-family start
+    method this re-imports ``__main__`` in the worker, so scripts that
+    build a worker DataLoader must use the standard
+    ``if __name__ == "__main__":`` idiom. Datasets/batchify_fns must be
+    picklable; set ``MXTPU_WORKER_CONTEXT=fork`` to opt back into fork,
+    or ``thread_pool=True`` for a ThreadPool with none of these
+    constraints.
     """
 
     def __init__(self, dataset: Dataset, batch_size=None, shuffle=False,
@@ -107,7 +118,28 @@ class DataLoader:
                                         initializer=_worker_init,
                                         initargs=(dataset,))
             else:
-                ctx = multiprocessing.get_context("fork")
+                # Never fork the JAX-threaded parent: os.fork() from a
+                # multithreaded process can deadlock a worker in a copied
+                # lock (the reference needed explicit fork handlers for
+                # the same class of bug — src/initialize.cc, file-level
+                # citation). forkserver execs a fresh server process and
+                # forks workers from THAT, so no JAX thread is ever
+                # copied; spawn is the fallback, fork an explicit opt-in
+                # via MXTPU_WORKER_CONTEXT for non-picklable datasets.
+                name = os.environ.get("MXTPU_WORKER_CONTEXT")
+                if name is not None:
+                    try:  # explicit opt-in must not be silently dropped
+                        ctx = multiprocessing.get_context(name)
+                    except ValueError:
+                        raise MXNetError(
+                            f"MXTPU_WORKER_CONTEXT={name!r} is not a "
+                            f"start method on this platform (want fork/"
+                            f"forkserver/spawn)")
+                else:
+                    try:
+                        ctx = multiprocessing.get_context("forkserver")
+                    except ValueError:  # platform without forkserver
+                        ctx = multiprocessing.get_context("spawn")
                 self._pool = ctx.Pool(self._num_workers,
                                       initializer=_worker_init,
                                       initargs=(dataset,))

@@ -203,6 +203,28 @@ def test_dataloader_workers_prefetch_zero():
     assert len(list(loader)) == 3
 
 
+def test_dataloader_process_workers_never_fork():
+    # forking the JAX-threaded parent risks a worker deadlocking in a
+    # copied lock; the default process context must be fork-free (the
+    # reference needed fork handlers in src/initialize.cc for this)
+    import warnings
+
+    ds = ArrayDataset(np.arange(48, dtype=np.float32).reshape(24, 2))
+    with warnings.catch_warnings():
+        # CPython emits fork-in-multithreaded-process as
+        # DeprecationWarning (3.12) / RuntimeWarning (earlier)
+        warnings.simplefilter("error", RuntimeWarning)
+        warnings.simplefilter("error", DeprecationWarning)
+        loader = DataLoader(ds, batch_size=4, num_workers=2)
+        assert loader._pool._ctx.get_start_method() in ("forkserver",
+                                                        "spawn")
+        batches = list(loader)
+    assert len(batches) == 6
+    np.testing.assert_allclose(
+        np.concatenate([b.asnumpy() for b in batches]),
+        np.arange(48, dtype=np.float32).reshape(24, 2))
+
+
 def test_image_record_iter_small_images(tmp_path):
     # images smaller than data_shape must be upsized, not crash np.stack
     from incubator_mxnet_tpu.io.recordio import IRHeader, IndexedRecordIO, \
