@@ -202,16 +202,25 @@ class Block:
         self._children[name or str(len(self._children))] = block
 
     # -- save/load ------------------------------------------------------ #
-    def save_parameters(self, filename, deduplicate=False):
-        """Structural-name save (parity: Block.save_parameters)."""
+    def save_parameters(self, filename, deduplicate=False,
+                        format="mxtpu"):
+        """Structural-name save (parity: Block.save_parameters).
+        ``format="mxnet"`` emits the reference 1.x ``.params`` layout."""
         from ..ndarray import save as nd_save
         params = self._collect_params_with_prefix()
-        nd_save(filename, {k: p.data() for k, p in params.items()})
+        nd_save(filename, {k: p.data() for k, p in params.items()},
+                format=format)
 
     def load_parameters(self, filename, ctx=None, allow_missing=False,
                         ignore_extra=False, cast_dtype=False):
         from ..ndarray import load as nd_load
         loaded = nd_load(filename)
+        if loaded and any(k.startswith(("arg:", "aux:"))
+                          for k in loaded):
+            # Module-style checkpoint (save_checkpoint prefixes every
+            # name; the reference's load_parameters strips them too)
+            loaded = {k.split(":", 1)[1] if k.startswith(("arg:", "aux:"))
+                      else k: v for k, v in loaded.items()}
         params = self._collect_params_with_prefix()
         for name, p in params.items():
             if name in loaded:

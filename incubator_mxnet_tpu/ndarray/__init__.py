@@ -124,11 +124,12 @@ def waitall():
         _jax.block_until_ready(d)
 
 
-def save(fname: str, data):
-    """Save NDArrays (parity: ``mx.nd.save``; format re-designed — see
-    utils/serialization). Accepts list or dict of NDArrays."""
+def save(fname: str, data, format: str = "mxtpu"):
+    """Save NDArrays (parity: ``mx.nd.save``). Accepts list or dict of
+    NDArrays. ``format="mxnet"`` writes the reference's 1.x ``.params``
+    binary layout for migration; load auto-detects either format."""
     from ..utils import serialization
-    serialization.save_ndarrays(fname, data)
+    serialization.save_ndarrays(fname, data, format=format)
 
 
 def load(fname: str):

@@ -1,14 +1,30 @@
 """NDArray / parameter serialization.
 
-Re-design of the reference's ``.params`` format (`NDArray::Save/Load`,
-`src/ndarray/ndarray.cc`: magic header + name→array dict, device stripped —
-file-level citation, SURVEY.md caveat).
+Two on-disk formats, auto-detected on load by magic:
 
-Format (v1): little-endian
+**MXTPU v1** (the native format) — little-endian
     8 bytes  magic  b'MXTPU\\x00\\x01\\x00'
     8 bytes  header length N (uint64)
     N bytes  JSON header: {"names": [...], "arrays": [{dtype, shape}, ...]}
     raw buffers, each 64-byte aligned, in header order (C-contiguous)
+
+**MXNet 1.x ``.params``** (migration compat; SURVEY §5.4 "keep .params
+read/write compat as a migration tool") — the reference's binary layout
+(`NDArray::Save/Load` in `src/ndarray/ndarray.cc` + the list container
+in `MXNDArrayListSave`, file-level citations, SURVEY.md caveat;
+implemented from the public format since the reference mount is empty —
+byte-level fixtures in tests/test_serialization_mxnet.py pin it down):
+    uint64  0x112 (kMXAPINDArrayListMagic)
+    uint64  0 (reserved)
+    uint64  array count, then per array:
+        uint32  0xF993fac9 (NDARRAY_V2_MAGIC; V3 0xF993faca also read)
+        int32   storage type (0 = dense; sparse records are rejected)
+        uint32  ndim, then int64 × ndim shape
+        int32   dev_type, int32 dev_id (written 1,0 = cpu; ignored on read)
+        int32   mshadow type flag (0 f32, 1 f64, 2 f16, 3 u8, 4 i32,
+                5 i8, 6 i64, 7 bool, 12 bf16)
+        raw C-order little-endian buffer
+    uint64  name count, then per name: uint64 length + utf-8 bytes
 
 Arrays are always materialized on host before save (the reference strips
 device too); load returns host arrays that callers place onto devices.
@@ -28,6 +44,17 @@ from ..base import MXNetError
 MAGIC = b"MXTPU\x00\x01\x00"
 _ALIGN = 64
 
+# MXNet 1.x constants (src/ndarray/ndarray.cc / c_api.cc, file-level)
+_MX_LIST_MAGIC = 0x112
+_NDARRAY_V2_MAGIC = 0xF993FAC9
+_NDARRAY_V3_MAGIC = 0xF993FACA
+_MX_DENSE_STYPE = 0
+# mshadow type flags <-> numpy/ml_dtypes names
+_MX_TYPE_FLAGS = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,
+                  "int32": 4, "int8": 5, "int64": 6, "bool": 7,
+                  "bfloat16": 12}
+_MX_FLAG_NAMES = {v: k for k, v in _MX_TYPE_FLAGS.items()}
+
 
 def _tohost(arr) -> np.ndarray:
     if hasattr(arr, "_data"):
@@ -42,27 +69,44 @@ def _dtype_str(a: np.ndarray) -> str:
     return str(a.dtype)
 
 
-def save_ndarrays(fname: str, data) -> None:
+def _to_bytes(a: np.ndarray) -> bytes:
+    """C-order raw buffer; bfloat16 goes through a uint16 view (numpy
+    can't serialize the ml_dtypes dtype directly)."""
+    a = np.ascontiguousarray(a)
+    if a.dtype.name == "bfloat16":
+        a = a.view(np.uint16)
+    return a.tobytes(order="C")
+
+
+def save_ndarrays(fname: str, data, format: str = "mxtpu") -> None:
+    """Save a dict/list of NDArrays. ``format="mxnet"`` writes the
+    reference's 1.x ``.params`` binary layout for migration."""
     if isinstance(data, dict):
         names = list(data.keys())
         arrays = [_tohost(v) for v in data.values()]
+        named = True
     elif isinstance(data, (list, tuple)):
         names = [str(i) for i in range(len(data))]
         arrays = [_tohost(v) for v in data]
+        named = False
     else:
         names = ["0"]
         arrays = [_tohost(data)]
+        named = False
+
+    if format == "mxnet":
+        _save_mxnet(fname, names if named else [], arrays)
+        return
+    if format != "mxtpu":
+        raise MXNetError(f"unknown params format {format!r} "
+                         f"(want 'mxtpu' or 'mxnet')")
 
     metas = []
     bufs = []
     for a in arrays:
-        if a.dtype.name == "bfloat16":
-            buf = a.view(np.uint16).tobytes(order="C")
-            metas.append({"dtype": "bfloat16", "shape": list(a.shape)})
-        else:
-            buf = np.ascontiguousarray(a).tobytes(order="C")
-            metas.append({"dtype": _dtype_str(a), "shape": list(a.shape)})
-        bufs.append(buf)
+        name = "bfloat16" if a.dtype.name == "bfloat16" else _dtype_str(a)
+        metas.append({"dtype": name, "shape": list(a.shape)})
+        bufs.append(_to_bytes(a))
 
     header = json.dumps({"names": names, "arrays": metas}).encode("utf-8")
     with open(fname, "wb") as f:
@@ -78,8 +122,114 @@ def save_ndarrays(fname: str, data) -> None:
             pos += len(buf)
 
 
+def _np_for_flag(flag: int, fname: str):
+    name = _MX_FLAG_NAMES.get(flag)
+    if name is None:
+        raise MXNetError(f"{fname}: unsupported mshadow type flag {flag}")
+    if name == "bfloat16":
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def _save_mxnet(fname: str, names: List[str], arrays) -> None:
+    """Write the reference ``.params`` list container (dense only)."""
+    with open(fname, "wb") as f:
+        f.write(struct.pack("<QQQ", _MX_LIST_MAGIC, 0, len(arrays)))
+        for a in arrays:
+            name = ("bfloat16" if a.dtype.name == "bfloat16"
+                    else str(a.dtype))
+            flag = _MX_TYPE_FLAGS.get(name)
+            if flag is None:
+                raise MXNetError(
+                    f"dtype {name} has no MXNet 1.x type flag; save in "
+                    f"the native format instead")
+            f.write(struct.pack("<Ii", _NDARRAY_V2_MAGIC,
+                                _MX_DENSE_STYPE))
+            f.write(struct.pack("<I", a.ndim))
+            f.write(struct.pack(f"<{a.ndim}q", *a.shape))
+            f.write(struct.pack("<ii", 1, 0))  # cpu ctx, stripped on load
+            f.write(struct.pack("<i", flag))
+            f.write(_to_bytes(a))
+        f.write(struct.pack("<Q", len(names)))
+        for n in names:
+            b = n.encode("utf-8")
+            f.write(struct.pack("<Q", len(b)))
+            f.write(b)
+
+
+def _read_exact(f, n, fname):
+    raw = f.read(n)
+    if len(raw) != n:
+        raise MXNetError(f"{fname}: truncated .params file")
+    return raw
+
+
+def _load_mxnet(fname: str):
+    """Read the reference ``.params`` list container (dense V2/V3)."""
+    from ..ndarray import NDArray
+    import jax.numpy as jnp
+
+    with open(fname, "rb") as f:
+        magic, _reserved, count = struct.unpack(
+            "<QQQ", _read_exact(f, 24, fname))
+        assert magic == _MX_LIST_MAGIC
+        arrays = []
+        for _ in range(count):
+            (nd_magic,) = struct.unpack("<I", _read_exact(f, 4, fname))
+            if nd_magic not in (_NDARRAY_V2_MAGIC, _NDARRAY_V3_MAGIC):
+                raise MXNetError(
+                    f"{fname}: pre-V2 (legacy) NDArray record "
+                    f"0x{nd_magic:x} not supported; re-save with a "
+                    f"MXNet >= 1.3 build")
+            (stype,) = struct.unpack("<i", _read_exact(f, 4, fname))
+            if stype != _MX_DENSE_STYPE:
+                raise MXNetError(
+                    f"{fname}: sparse storage type {stype} not "
+                    f"supported by the migration loader")
+            (ndim,) = struct.unpack("<I", _read_exact(f, 4, fname))
+            shape = struct.unpack(
+                f"<{ndim}q", _read_exact(f, 8 * ndim, fname))
+            struct.unpack("<ii", _read_exact(f, 8, fname))  # ctx dropped
+            (flag,) = struct.unpack("<i", _read_exact(f, 4, fname))
+            dt = _np_for_flag(flag, fname)
+            n_items = int(np.prod(shape)) if shape else 1
+            raw = _read_exact(f, n_items * dt.itemsize, fname)
+            arrays.append(np.frombuffer(raw, dtype=dt).reshape(shape))
+        (n_names,) = struct.unpack("<Q", _read_exact(f, 8, fname))
+        names = []
+        for _ in range(n_names):
+            (ln,) = struct.unpack("<Q", _read_exact(f, 8, fname))
+            names.append(_read_exact(f, ln, fname).decode("utf-8"))
+    if names and len(names) != len(arrays):
+        raise MXNetError(f"{fname}: {len(arrays)} arrays but "
+                         f"{len(names)} names")
+    # narrow 64-bit records explicitly (framework is 32-bit, x64 off) so
+    # jnp.asarray doesn't emit a truncation warning per array — but
+    # never silently wrap values the narrow type can't hold
+    narrowed = []
+    for a in arrays:
+        if a.dtype == np.int64:
+            if a.size and (a.max() > np.iinfo(np.int32).max
+                           or a.min() < np.iinfo(np.int32).min):
+                raise MXNetError(
+                    f"{fname}: int64 record holds values outside the "
+                    f"int32 range; the 32-bit runtime cannot represent "
+                    f"them losslessly")
+            a = a.astype(np.int32)
+        elif a.dtype == np.float64:
+            a = a.astype(np.float32)  # precision loss only, as on TPU
+        narrowed.append(a)
+    arrays = narrowed
+    out = [NDArray(jnp.asarray(a)) for a in arrays]
+    if not names:
+        return out
+    return dict(zip(names, out))
+
+
 def load_ndarrays(fname: str):
-    """Returns dict name→NDArray (or list if names are all indices)."""
+    """Returns dict name→NDArray (or list if names are all indices).
+    Format auto-detected: native MXTPU, or reference ``.params``."""
     from ..ndarray import NDArray
     import jax.numpy as jnp
     import ml_dtypes
@@ -87,7 +237,12 @@ def load_ndarrays(fname: str):
     with open(fname, "rb") as f:
         magic = f.read(len(MAGIC))
         if magic != MAGIC:
-            raise MXNetError(f"{fname}: not a MXTPU params file")
+            if (len(magic) == 8
+                    and struct.unpack("<Q", magic)[0] == _MX_LIST_MAGIC):
+                return _load_mxnet(fname)
+            raise MXNetError(
+                f"{fname}: neither a MXTPU params file nor a MXNet 1.x "
+                f".params file")
         (hlen,) = struct.unpack("<Q", f.read(8))
         header = json.loads(f.read(hlen).decode("utf-8"))
         pos = len(MAGIC) + 8 + hlen

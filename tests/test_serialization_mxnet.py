@@ -1,0 +1,171 @@
+"""Reference .params (MXNet 1.x binary layout) migration tests.
+
+The fixture below is constructed BY HAND with struct.pack, field by
+field from the documented layout (src/ndarray/ndarray.cc NDArray::Save,
+c_api.cc MXNDArrayListSave — file-level citations, SURVEY.md caveat:
+the reference mount is empty, so the layout is pinned by these byte
+fixtures rather than by diffing real reference output).
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from incubator_mxnet_tpu import gluon, nd
+from incubator_mxnet_tpu.base import MXNetError
+
+LIST_MAGIC = 0x112
+V2 = 0xF993FAC9
+V3 = 0xF993FACA
+
+
+def _fixture_bytes(nd_magic=V2):
+    """Two named dense arrays, byte-for-byte per the 1.x layout."""
+    w = np.arange(6, dtype=np.float32).reshape(2, 3)
+    b = np.array([7, -8], dtype=np.int64)
+    out = struct.pack("<QQQ", LIST_MAGIC, 0, 2)
+    # array 0: float32 (2,3)
+    out += struct.pack("<Ii", nd_magic, 0)          # magic, dense stype
+    out += struct.pack("<I", 2) + struct.pack("<2q", 2, 3)
+    out += struct.pack("<ii", 1, 0)                 # cpu ctx
+    out += struct.pack("<i", 0)                     # kFloat32
+    out += w.tobytes()
+    # array 1: int64 (2,)
+    out += struct.pack("<Ii", nd_magic, 0)
+    out += struct.pack("<I", 1) + struct.pack("<1q", 2)
+    out += struct.pack("<ii", 1, 0)
+    out += struct.pack("<i", 6)                     # kInt64
+    out += b.tobytes()
+    # names
+    out += struct.pack("<Q", 2)
+    for name in (b"dense0_weight", b"dense0_bias"):
+        out += struct.pack("<Q", len(name)) + name
+    return out, w, b
+
+
+@pytest.mark.parametrize("magic", [V2, V3])
+def test_load_hand_built_reference_fixture(tmp_path, magic):
+    raw, w, b = _fixture_bytes(magic)
+    p = tmp_path / "ref.params"
+    p.write_bytes(raw)
+    loaded = nd.load(str(p))
+    assert set(loaded) == {"dense0_weight", "dense0_bias"}
+    np.testing.assert_array_equal(loaded["dense0_weight"].asnumpy(), w)
+    # 64-bit records narrow to 32-bit under the framework's x64-off
+    # policy; values are preserved
+    np.testing.assert_array_equal(loaded["dense0_bias"].asnumpy(), b)
+    assert loaded["dense0_bias"].asnumpy().dtype == np.int32
+
+
+def test_writer_is_byte_exact_against_fixture(tmp_path):
+    # hand-build the expected bytes with the second array as int32 (the
+    # framework holds 32-bit arrays, so that is what the writer emits)
+    w = np.arange(6, dtype=np.float32).reshape(2, 3)
+    b = np.array([7, -8], dtype=np.int32)
+    raw = struct.pack("<QQQ", LIST_MAGIC, 0, 2)
+    raw += struct.pack("<Ii", V2, 0) + struct.pack("<I", 2)
+    raw += struct.pack("<2q", 2, 3) + struct.pack("<ii", 1, 0)
+    raw += struct.pack("<i", 0) + w.tobytes()
+    raw += struct.pack("<Ii", V2, 0) + struct.pack("<I", 1)
+    raw += struct.pack("<1q", 2) + struct.pack("<ii", 1, 0)
+    raw += struct.pack("<i", 4) + b.tobytes()
+    raw += struct.pack("<Q", 2)
+    for name in (b"dense0_weight", b"dense0_bias"):
+        raw += struct.pack("<Q", len(name)) + name
+    p = tmp_path / "ours.params"
+    nd.save(str(p), {"dense0_weight": nd.array(w),
+                     "dense0_bias": nd.array(b, dtype="int32")},
+            format="mxnet")
+    assert p.read_bytes() == raw
+
+
+def test_roundtrip_all_dtypes(tmp_path):
+    import ml_dtypes
+
+    rng = np.random.RandomState(0)
+    data = {
+        "f32": rng.randn(3, 4).astype(np.float32),
+        "f16": rng.randn(4).astype(np.float16),
+        "bf16": rng.randn(2, 2).astype(ml_dtypes.bfloat16),
+        "u8": rng.randint(0, 255, (5,)).astype(np.uint8),
+        "i8": rng.randint(-7, 7, (5,)).astype(np.int8),
+        "i32": rng.randint(-9, 9, (3,)).astype(np.int32),
+        "scalar": np.float32(3.5),
+    }
+    p = tmp_path / "all.params"
+    nd.save(str(p), {k: nd.array(v, dtype=str(v.dtype))
+                     for k, v in data.items()}, format="mxnet")
+    loaded = nd.load(str(p))
+    for k, v in data.items():
+        got = loaded[k].asnumpy()
+        assert got.dtype == v.dtype, k
+        np.testing.assert_array_equal(got, np.asarray(v), err_msg=k)
+
+
+def test_unnamed_list_roundtrip(tmp_path):
+    p = tmp_path / "list.params"
+    nd.save(str(p), [nd.array([1.0, 2.0]), nd.array([[3.0]])],
+            format="mxnet")
+    loaded = nd.load(str(p))
+    assert isinstance(loaded, list) and len(loaded) == 2
+    np.testing.assert_array_equal(loaded[0].asnumpy(), [1.0, 2.0])
+
+
+def test_block_params_migrate_through_reference_format(tmp_path):
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(4, activation="relu"), gluon.nn.Dense(2))
+    net.initialize()
+    x = nd.array(np.random.RandomState(0).randn(3, 5).astype(np.float32))
+    want = net(x).asnumpy()
+
+    p = tmp_path / "net.params"
+    net.save_parameters(str(p), format="mxnet")
+    assert p.read_bytes()[:8] == struct.pack("<Q", LIST_MAGIC)
+
+    net2 = gluon.nn.Sequential()
+    net2.add(gluon.nn.Dense(4, activation="relu"), gluon.nn.Dense(2))
+    net2.load_parameters(str(p))
+    np.testing.assert_allclose(net2(x).asnumpy(), want, rtol=1e-6)
+
+
+def test_module_style_arg_aux_prefixes_stripped(tmp_path):
+    net = gluon.nn.Dense(3)
+    net.initialize()
+    x = nd.array(np.ones((2, 4), dtype=np.float32))
+    want = net(x).asnumpy()
+    params = {f"arg:{k}": p.data()
+              for k, p in net._collect_params_with_prefix().items()}
+    p = tmp_path / "module.params"
+    nd.save(str(p), params, format="mxnet")
+    net2 = gluon.nn.Dense(3)
+    net2.load_parameters(str(p))
+    np.testing.assert_allclose(net2(x).asnumpy(), want, rtol=1e-6)
+
+
+def test_errors_sparse_legacy_truncated(tmp_path):
+    raw, _, _ = _fixture_bytes()
+    # sparse stype record
+    bad = bytearray(raw)
+    struct.pack_into("<i", bad, 28, 1)  # stype row_sparse on array 0
+    p = tmp_path / "sparse.params"
+    p.write_bytes(bytes(bad))
+    with pytest.raises(MXNetError, match="sparse"):
+        nd.load(str(p))
+    # legacy (pre-V2) magic
+    bad = bytearray(raw)
+    struct.pack_into("<I", bad, 24, 0xF993FAC8)
+    p2 = tmp_path / "legacy.params"
+    p2.write_bytes(bytes(bad))
+    with pytest.raises(MXNetError, match="legacy"):
+        nd.load(str(p2))
+    # truncated
+    p3 = tmp_path / "trunc.params"
+    p3.write_bytes(raw[:40])
+    with pytest.raises(MXNetError, match="truncated"):
+        nd.load(str(p3))
+    # garbage magic
+    p4 = tmp_path / "garbage.params"
+    p4.write_bytes(b"\x00" * 32)
+    with pytest.raises(MXNetError, match="neither"):
+        nd.load(str(p4))
