@@ -81,11 +81,11 @@ def _bert_flops_per_step(B, T, M, L, units, hidden, vocab):
     return enc + attn + heads
 
 
-def _env_remat_dropout():
+def _env_remat_dropout(default_remat="0"):
     """Shared MXTPU_BENCH_REMAT / MXTPU_BENCH_DROPOUT parsing:
     "0" off; "1" whole-layer remat; "dots" selective (save matmul
     outputs, recompute elementwise only)."""
-    remat_env = os.environ.get("MXTPU_BENCH_REMAT", "0")
+    remat_env = os.environ.get("MXTPU_BENCH_REMAT", default_remat)
     remat = {"0": False, "1": True}.get(remat_env, remat_env)
     dropout = float(os.environ.get("MXTPU_BENCH_DROPOUT", "0.1"))
     return remat, dropout
@@ -98,6 +98,7 @@ def _measure_steps(step_fn, warmup, steps):
     optional MXTPU_BENCH_TRACE profiler block (BASELINE.md protocol:
     trace evidence for perf claims), then the timed loop. Returns
     (dt_seconds, last_loss)."""
+    assert warmup >= 1, "warmup must compile+fence before the timed loop"
     loss = None
     for _ in range(warmup):
         loss = step_fn()
@@ -115,6 +116,34 @@ def _measure_steps(step_fn, warmup, steps):
     return time.perf_counter() - t0, loss
 
 
+def _resolve_bert_config(size, on_tpu):
+    """(B, T, M, dtype, steps, warmup, flash, remat, dropout) for one
+    bench run. With no env knobs the accelerator defaults come from
+    ops.kernel_policy (the best-measured config per model size); env
+    knobs override so the ladder's A/B rungs can pin configs."""
+    if on_tpu or os.environ.get("MXTPU_BENCH_TPU_CONFIG") == "1":
+        # MXTPU_BENCH_TPU_CONFIG=1 forces the accelerator code paths
+        # (bf16 + flash + T=512 + LAMB masters) on CPU — a dress
+        # rehearsal that catches trace-time bugs in the exact config a
+        # rare tunnel window would otherwise burn a ladder rung on
+        from incubator_mxnet_tpu.ops.kernel_policy import training_plan
+        T, M = 512, 76
+        dims = {"base": (12, 768, 3072), "large": (24, 1024, 4096)}[size]
+        plan = training_plan(*dims, vocab=30522, seq_len=T)
+        B = int(os.environ.get("MXTPU_BENCH_BATCH", str(plan["batch"])))
+        dtype = "bfloat16"
+        steps, warmup = (10, 3) if on_tpu else (1, 1)
+        flash = True
+        remat, dropout = _env_remat_dropout(default_remat=plan["remat"])
+    else:  # CPU smoke mode so the bench is runnable anywhere
+        B, T, M = 4, 128, 20
+        dtype = "float32"
+        steps, warmup = 3, 1
+        flash = False
+        remat, dropout = _env_remat_dropout()
+    return B, T, M, dtype, steps, warmup, flash, remat, dropout
+
+
 def _run_bert(on_tpu):
     import numpy as np
     import jax
@@ -125,23 +154,8 @@ def _run_bert(on_tpu):
     size = os.environ.get("MXTPU_BENCH_MODEL", "base")
     if size not in ("base", "large"):
         raise ValueError(f"MXTPU_BENCH_MODEL must be base|large, got {size!r}")
-    if on_tpu or os.environ.get("MXTPU_BENCH_TPU_CONFIG") == "1":
-        # MXTPU_BENCH_TPU_CONFIG=1 forces the accelerator code paths
-        # (bf16 + flash + T=512 + LAMB masters) on CPU — a dress
-        # rehearsal that catches trace-time bugs in the exact config a
-        # rare tunnel window would otherwise burn a ladder rung on
-        default_b = "16" if size == "large" else "48"
-        B = int(os.environ.get("MXTPU_BENCH_BATCH", default_b))
-        T, M = 512, 76
-        dtype = "bfloat16"
-        steps, warmup = (10, 3) if on_tpu else (1, 1)
-        flash = True
-    else:  # CPU smoke mode so the bench is runnable anywhere
-        B, T, M = 4, 128, 20
-        dtype = "float32"
-        steps, warmup = 3, 1
-        flash = False
-    remat, dropout = _env_remat_dropout()
+    B, T, M, dtype, steps, warmup, flash, remat, dropout = \
+        _resolve_bert_config(size, on_tpu)
 
     mx.random.seed(0)
     ctor = bert_mod.bert_large if size == "large" else bert_mod.bert_base

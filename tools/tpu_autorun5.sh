@@ -29,7 +29,7 @@ run() { # run NAME TIMEOUT [ENV=VAL...]
   echo "$(date -u +%H:%M:%S) done $name rc=$rc: $(head -c 200 "$LOG/$name.json" 2>/dev/null)" >> "$LOG/watch.log"
 }
 
-ALL="large-b32-dense resnet-b64 nmt-decode b48-dense b96-dense-dots large-b32-dense-trace b96-dense-trace large-b48-dense b128-dense-dots b48-dense-hpp1 b48-rbg b48-nodrop b48-jnpflash gpt-b16 gpt-b32-dots"
+ALL="large-b32-dense resnet-b64 nmt-decode base-default b48-dense b96-dense-dots large-b32-dense-trace b96-dense-trace large-b48-dense b128-dense-dots default-hpp1 default-rbg default-nodrop default-jnpflash gpt-b16 gpt-b32-dots"
 while true; do
   if timeout 90 python -c "import jax; assert any(d.platform!='cpu' for d in jax.devices())" 2>/dev/null; then
     echo "$(date -u +%H:%M:%S) p5 window OPEN" >> "$LOG/watch.log"
@@ -50,7 +50,10 @@ while true; do
     WL=resnet run resnet-b64 700
     WL=nmt run nmt-decode 700
     # --- headline base + batch scaling ---
-    run b48-dense 700
+    # base-default runs with NO knobs: audits that the kernel_policy
+    # defaults reproduce the best measured config (expect ~= b96-dots)
+    run base-default 700
+    run b48-dense 700 MXTPU_BENCH_BATCH=48 MXTPU_BENCH_REMAT=0
     run b96-dense-dots 700 MXTPU_BENCH_BATCH=96 MXTPU_BENCH_REMAT=dots
     # --- traces (evidence for the transpose-sink fix) ---
     run large-b32-dense-trace 950 MXTPU_BENCH_MODEL=large MXTPU_BENCH_BATCH=32 MXTPU_BENCH_REMAT=dots MXTPU_BENCH_TRACE=trace_r5large
@@ -64,11 +67,12 @@ while true; do
     # --- batch/remat frontier ---
     run large-b48-dense 950 MXTPU_BENCH_MODEL=large MXTPU_BENCH_BATCH=48 MXTPU_BENCH_REMAT=dots
     run b128-dense-dots 700 MXTPU_BENCH_BATCH=128 MXTPU_BENCH_REMAT=dots
-    # --- A/B probes ---
-    run b48-dense-hpp1 700 MXTPU_FLASH_FWD_HPP=1 MXTPU_FLASH_BWD_HPP=1
-    run b48-rbg 700 JAX_DEFAULT_PRNG_IMPL=rbg
-    run b48-nodrop 700 MXTPU_BENCH_DROPOUT=0
-    run b48-jnpflash 700 MXTPU_FLASH_FORCE_FALLBACK=1
+    # --- A/B probes (each relative to the no-knob policy default,
+    #     so the delta vs base-default isolates one variable) ---
+    run default-hpp1 700 MXTPU_FLASH_FWD_HPP=1 MXTPU_FLASH_BWD_HPP=1
+    run default-rbg 700 JAX_DEFAULT_PRNG_IMPL=rbg
+    run default-nodrop 700 MXTPU_BENCH_DROPOUT=0
+    run default-jnpflash 700 MXTPU_FLASH_FORCE_FALLBACK=1
     # --- secondary workloads ---
     WL=gpt run gpt-b16 700
     WL=gpt run gpt-b32-dots 700 MXTPU_BENCH_BATCH=32 MXTPU_BENCH_REMAT=dots
