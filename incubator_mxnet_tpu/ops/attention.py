@@ -91,10 +91,14 @@ def _sdpa_blockwise(q, k, v, key_mask, causal, scale, block_k: int = 512):
         row_sum = row_sum * corr + jnp.moveaxis(p.sum(-1), 1, -1)
         return (acc, new_max, row_sum), None
 
-    (acc, _, row_sum), _ = lax.scan(
+    (acc, row_max, row_sum), _ = lax.scan(
         body, (acc0, max0, sum0),
         (jnp.arange(nk), k_blocks, v_blocks, m_blocks))
     out = acc / jnp.maximum(row_sum[..., None], 1e-30)
+    # fully-masked rows (all-False key mask): row_max never left
+    # _NEG_INF, so p was uniformly 1 and out is the mean of V — zero
+    # them instead (same contract as the Pallas kernels)
+    out = jnp.where((row_max > _NEG_INF / 2)[..., None], out, 0.0)
     return out.astype(q.dtype)
 
 

@@ -152,11 +152,18 @@ def _flash_kernel(vl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
         acc0 = jnp.zeros((block_q, D), jnp.float32)
         m, l, acc = lax.fori_loop(0, n_k_blocks, body, (m0, l0, acc0))
         l_safe = jnp.maximum(l, 1e-30)
-        o_ref[0, h] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+        # fully-masked rows (vl==0, or padded q rows past vl): m never
+        # left _NEG_INF, so p was uniformly 1 and acc/l is the mean of V
+        # — zero the output and pin lse to _NEG_INF (finite, so ring
+        # merges weight the row out without producing NaN)
+        row_ok = m > _NEG_INF / 2
+        o_ref[0, h] = jnp.where(row_ok[:, None], acc / l_safe[:, None],
+                                0.0).astype(o_ref.dtype)
         # lse carries a trailing singleton lane dim: Mosaic requires the
         # last two block dims (8, 128)-tiled or equal to the array dims,
         # which a (1, 1, block_q) block of a (B, H, Tq) array is not.
-        lse_ref[0, h] = (m + jnp.log(l_safe))[:, None]
+        lse_ref[0, h] = jnp.where(row_ok, m + jnp.log(l_safe),
+                                  _NEG_INF)[:, None]
 
 
 def _pad_to(x, axis, multiple):
@@ -313,8 +320,14 @@ def _dense_fwd_kernel(vl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         o = jnp.dot(p.astype(v.dtype), v,
                     preferred_element_type=jnp.float32,
                     precision=lax.Precision.DEFAULT) / l[:, None]
-        o_ref[0, h] = o.astype(o_ref.dtype)
-        lse_ref[0, h] = (m + jnp.log(l))[:, None]
+        # zero fully-masked rows (vl==0 / padded q rows) instead of the
+        # uniform mean of V, and pin their lse to _NEG_INF (see the
+        # streaming kernel for the rationale)
+        row_ok = m > _NEG_INF / 2
+        o_ref[0, h] = jnp.where(row_ok[:, None], o, 0.0) \
+            .astype(o_ref.dtype)
+        lse_ref[0, h] = jnp.where(row_ok, m + jnp.log(l),
+                                  _NEG_INF)[:, None]
 
 
 def _dense_bwd_kernel(vl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
@@ -637,6 +650,21 @@ def _flash_backward(q, k, v, valid_len, out, lse, g, causal=False,
 # custom-vjp entry
 # --------------------------------------------------------------------- #
 
+class _Static:
+    """Pytree-static residual carrier: the forward's trace-time kernel
+    decision (dense vs streaming) rides through the custom_vjp residuals
+    as treedef aux data, so the backward can never disagree with the
+    forward even if MXTPU_FLASH_DENSE_T changes between the fwd and bwd
+    traces (the documented 'must not change within one process'
+    invariant, now enforced structurally)."""
+
+    def __init__(self, value):
+        self.value = value
+
+
+jax.tree_util.register_pytree_node(
+    _Static, lambda s: ((), s.value), lambda aux, _: _Static(aux))
+
 def _reference_blockwise(q, k, v, valid_len, causal, scale):
     """jnp online-softmax reference in (B,H,T,D) layout — the fallback
     backward recomputes through this (scan-structured, so autodiff keeps
@@ -673,13 +701,13 @@ def _fwd(q, k, v, valid_len, causal, scale, interpret):
                               dense=dense,
                               hpp=_dense_hpp(q.shape[1]) if dense
                               else None)
-    return out, (q, k, v, valid_len, out, lse)
+    return out, (q, k, v, valid_len, out, lse, _Static(dense))
 
 
 def _bwd(causal, scale, interpret, res, g):
-    q, k, v, valid_len, out, lse = res
+    q, k, v, valid_len, out, lse, static = res
     if _pallas_available():
-        dense = _use_dense(q.shape[2], k.shape[2])
+        dense = static.value            # the forward's decision, verbatim
         block_q, block_k = (None, None) if dense else \
             _resolve_blocks(None, None)
         dq, dk, dv = _flash_backward(q, k, v, valid_len, out, lse, g,
@@ -706,6 +734,10 @@ def tpu_kernel_eligible(D, causal=False, Tq=None, Tk=None):
     done when the kernel actually consumes the bhtd layout."""
     on = any(d.platform == "tpu" for d in jax.devices()) \
         and _pallas_available()
+    if os.environ.get("MXTPU_FLASH_INTERPRET") == "1":
+        # test lever: route the dispatcher to the real kernels in
+        # Pallas interpret mode on CPU (packed-layout parity coverage)
+        on = _pallas_available()
     if os.environ.get("MXTPU_FLASH_FORCE_FALLBACK") == "1":
         on = False  # A/B lever: measure jnp blockwise vs the kernel
     # the Pallas kernel's causal grid assumes square Tq == Tk; offset
@@ -760,12 +792,14 @@ def use_flash_attention(q, k, v, key_mask=None, causal=False, scale=None,
                                   key_mask, causal, sc)
             return out.transpose(0, 2, 1, 3)
         return _sdpa_blockwise(q, k, v, key_mask, causal, sc)
+    interp = os.environ.get("MXTPU_FLASH_INTERPRET") == "1"
     if layout == "bhtd":
-        return flash_attention_bhtd(q, k, v, valid_length, causal, scale)
+        return flash_attention_bhtd(q, k, v, valid_length, causal, scale,
+                                    interp)
     out = flash_attention_bhtd(q.transpose(0, 2, 1, 3),
                                k.transpose(0, 2, 1, 3),
                                v.transpose(0, 2, 1, 3),
-                               valid_length, causal, scale)
+                               valid_length, causal, scale, interp)
     return out.transpose(0, 2, 1, 3)
 
 
@@ -803,7 +837,11 @@ def _dense_attn_lse(q, k, v, valid_len, causal, scale):
     out = jnp.einsum("bhqk,bhkd->bhqd", p,
                      v.astype(jnp.float32)) / \
         jnp.maximum(l, 1e-30)[..., None]
-    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    # match the kernels: fully-masked rows are zero with lse=_NEG_INF
+    row_ok = m > _NEG_INF / 2
+    out = jnp.where(row_ok[..., None], out, 0.0)
+    lse = jnp.where(row_ok, m + jnp.log(jnp.maximum(l, 1e-30)),
+                    _NEG_INF)
     return out.astype(q.dtype), lse
 
 
@@ -835,7 +873,10 @@ def block_attn_lse(q, k, v, valid_len, causal=False, scale=None,
 def _block_fwd(q, k, v, valid_len, causal, scale, interpret):
     out, lse = block_attn_lse(q, k, v, valid_len, causal, scale,
                               interpret)
-    return (out, lse), (q, k, v, valid_len, out, lse)
+    # None = jnp-fallback path taken; else the dense/streaming decision
+    dense = (_use_dense(q.shape[2], k.shape[2])
+             if _pallas_runnable(interpret) else None)
+    return (out, lse), (q, k, v, valid_len, out, lse, _Static(dense))
 
 
 def _dense_block_bwd(q, k, v, valid_len, out, lse, g, causal, scale):
@@ -859,10 +900,10 @@ def _dense_block_bwd(q, k, v, valid_len, out, lse, g, causal, scale):
 
 
 def _block_bwd(causal, scale, interpret, res, g):
-    q, k, v, valid_len, out, lse = res
+    q, k, v, valid_len, out, lse, static = res
     g_out, _ = g                              # lse cotangent is dropped
-    if _pallas_runnable(interpret):
-        dense = _use_dense(q.shape[2], k.shape[2])
+    if static.value is not None and _pallas_runnable(interpret):
+        dense = static.value            # the forward's decision, verbatim
         dq, dk, dv = _flash_backward(q, k, v, valid_len, out, lse, g_out,
                                      causal=causal, scale=scale,
                                      interpret=interpret, dense=dense,

@@ -313,3 +313,45 @@ def test_sdpa_dense_path_honors_valid_length():
         nd.array(q), nd.array(k), nd.array(v), mask=mask)
     np.testing.assert_allclose(out_vl.asnumpy(), out_mask.asnumpy(),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_fully_masked_rows_zero_output_and_safe_grads(kernel_path):
+    """ADVICE r4: a fully-masked query row (vl==0, or q rows past the
+    valid prefix) must produce ZERO output — not the uniform mean of V —
+    with lse pinned to a finite -inf surrogate, and zero (not NaN)
+    gradients. Checked on both kernel families and the jnp fallback."""
+    rng = np.random.RandomState(0)
+    B, H, T, D = 2, 2, 16, 8
+    q = jnp.asarray(rng.randn(B, H, T, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, H, T, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, H, T, D), jnp.float32)
+    vl = jnp.asarray([0, 5], jnp.int32)        # row 0 fully masked
+
+    def loss(q, k, v):
+        return flash_attention_bhtd(q, k, v, vl, False, None, True).sum()
+
+    out = flash_attention_bhtd(q, k, v, vl, False, None, True)
+    out_np = np.asarray(out)
+    # batch 0: every row fully masked -> all zeros
+    np.testing.assert_array_equal(out_np[0], 0.0)
+    # batch 1: rows attend the 5-key prefix regardless of q position
+    # (prefix mask, non-causal) -> finite and nonzero
+    assert np.isfinite(out_np[1]).all() and np.abs(out_np[1]).sum() > 0
+
+    dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for g in (dq, dk, dv):
+        assert np.isfinite(np.asarray(g)).all()
+    np.testing.assert_array_equal(np.asarray(dq)[0], 0.0)
+    # masked-out keys (beyond the prefix) contribute nothing
+    np.testing.assert_array_equal(np.asarray(dk)[1, :, 5:], 0.0)
+
+    # jnp fallback path agrees (dispatcher with a boolean mask routes
+    # to _sdpa_blockwise)
+    from incubator_mxnet_tpu.ops.attention import _sdpa_blockwise
+    km = np.arange(T)[None, :] < np.asarray([0, 5])[:, None]
+    fb = _sdpa_blockwise(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                         v.transpose(0, 2, 1, 3), jnp.asarray(km), False,
+                         D ** -0.5)
+    np.testing.assert_array_equal(np.asarray(fb)[0], 0.0)
+    np.testing.assert_allclose(np.asarray(fb).transpose(0, 2, 1, 3),
+                               out_np, rtol=2e-5, atol=2e-5)
