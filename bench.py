@@ -321,21 +321,21 @@ def _run_resnet(on_tpu):
                           "multi_precision": dtype != "float32"},
         sharding="replicated")
 
-    for _ in range(warmup):
-        loss = trainer.step(x, y)
-    float(loss.asnumpy())
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = trainer.step(x, y)
-    float(loss.asnumpy())
-    dt = time.perf_counter() - t0
+    dt, _ = _measure_steps(lambda: trainer.step(x, y), warmup, steps)
 
     n_chips = len(jax.devices())
+    img_per_sec_chip = B * steps / dt / n_chips
+    # ResNet-50 fwd at 224^2 is the standard ~4.1 GFLOP/img (mul+add
+    # counted); training ~= 3x fwd (fwd + dgrad + wgrad). Scale by
+    # spatial area for the CPU-smoke side length.
+    fwd_flops = 4.1e9 * (side / 224.0) ** 2
+    mfu = (img_per_sec_chip * 3.0 * fwd_flops) / _peak_flops_per_chip()
     return {
         "metric": "resnet50_train_img_per_sec_per_chip",
-        "value": round(B * steps / dt / n_chips, 2),
+        "value": round(img_per_sec_chip, 2),
         "unit": "img/s/chip",
         "vs_baseline": 0.0,
+        "mfu": round(mfu, 4),
         "batch": B,
         "dtype": dtype,
     }
