@@ -377,3 +377,61 @@ def adadelta_update(weight, grad, acc_g, acc_delta, rho=0.9, epsilon=1e-5,
         jnp.sqrt(new_acc_g + epsilon) * g
     new_acc_delta = rho * acc_delta + (1 - rho) * jnp.square(delta)
     return weight - delta, new_acc_g, new_acc_delta
+
+
+# ------------------------------------------------------------------ #
+# preloaded_* variants: learning rates / weight decays ride as DEVICE
+# arrays instead of host attrs, so an LR schedule updates without
+# re-setting op attrs (reference: preloaded_multi_sgd_update family in
+# src/operator/contrib/preloaded_multi_sgd-inl.h — file-level citation,
+# SURVEY.md caveat). Indexing a jnp vector yields 0-d arrays that flow
+# straight into the scalar arithmetic of the per-tensor kernels.
+# ------------------------------------------------------------------ #
+
+@register("preloaded_multi_sgd_update", num_outputs=None, wrap_list=True)
+def preloaded_multi_sgd_update(weights, grads, lrs, wds, rescale_grad=1.0,
+                               clip_gradient=-1.0):
+    return tuple(
+        sgd_update(w, g, lr=lrs[i], wd=wds[i], rescale_grad=rescale_grad,
+                   clip_gradient=clip_gradient)
+        for i, (w, g) in enumerate(zip(weights, grads)))
+
+
+@register("preloaded_multi_sgd_mom_update", num_outputs=None,
+          wrap_list=True)
+def preloaded_multi_sgd_mom_update(weights, grads, moms, lrs, wds,
+                                   momentum=0.0, rescale_grad=1.0,
+                                   clip_gradient=-1.0):
+    outs = []
+    for i, (w, g, m) in enumerate(zip(weights, grads, moms)):
+        outs.append(sgd_mom_update(
+            w, g, m, lr=lrs[i], wd=wds[i], momentum=momentum,
+            rescale_grad=rescale_grad, clip_gradient=clip_gradient))
+    return tuple(x for pair in outs for x in pair)
+
+
+@register("preloaded_multi_mp_sgd_update", num_outputs=None,
+          wrap_list=True)
+def preloaded_multi_mp_sgd_update(weights, grads, weights32, lrs, wds,
+                                  rescale_grad=1.0, clip_gradient=-1.0):
+    outs = []
+    for i, (w, g, w32) in enumerate(zip(weights, grads, weights32)):
+        outs.append(mp_sgd_update(
+            w, g, w32, lr=lrs[i], wd=wds[i], rescale_grad=rescale_grad,
+            clip_gradient=clip_gradient))
+    return tuple(x for pair in outs for x in pair)
+
+
+@register("preloaded_multi_mp_sgd_mom_update", num_outputs=None,
+          wrap_list=True)
+def preloaded_multi_mp_sgd_mom_update(weights, grads, moms, weights32,
+                                      lrs, wds, momentum=0.0,
+                                      rescale_grad=1.0,
+                                      clip_gradient=-1.0):
+    outs = []
+    for i, (w, g, m, w32) in enumerate(zip(weights, grads, moms,
+                                           weights32)):
+        outs.append(mp_sgd_mom_update(
+            w, g, m, w32, lr=lrs[i], wd=wds[i], momentum=momentum,
+            rescale_grad=rescale_grad, clip_gradient=clip_gradient))
+    return tuple(x for trio in outs for x in trio)

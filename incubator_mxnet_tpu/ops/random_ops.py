@@ -77,7 +77,8 @@ def sample_uniform(low, high, shape=None, dtype=None, key=None):
     out_shape = tuple(low.shape) + _shape(shape)
     u = jax.random.uniform(key, out_shape, dtype=low.dtype)
     bshape = low.shape + (1,) * len(_shape(shape))
-    return low.reshape(bshape) + u * (high - low).reshape(bshape)
+    return _sample_dtype(
+        low.reshape(bshape) + u * (high - low).reshape(bshape), dtype)
 
 
 @register("sample_normal", needs_key=True)
@@ -85,7 +86,80 @@ def sample_normal(mu, sigma, shape=None, dtype=None, key=None):
     out_shape = tuple(mu.shape) + _shape(shape)
     z = jax.random.normal(key, out_shape, dtype=mu.dtype)
     bshape = mu.shape + (1,) * len(_shape(shape))
-    return mu.reshape(bshape) + z * sigma.reshape(bshape)
+    return _sample_dtype(
+        mu.reshape(bshape) + z * sigma.reshape(bshape), dtype)
+
+
+def _sample_dtype(out, dtype):
+    """Honor an explicit dtype request (reference multisample_op
+    contract); None keeps the parameter array's dtype."""
+    if dtype is None:
+        return out
+    from ..ndarray.ndarray import _to_jnp_dtype
+    return out.astype(_to_jnp_dtype(dtype))
+
+
+@register("sample_gamma", needs_key=True)
+def sample_gamma(alpha, beta, shape=None, dtype=None, key=None):
+    """Per-distribution batched Gamma(alpha, beta) (multisample_op.cc):
+    one draw of `shape` per leading element of alpha/beta."""
+    out_shape = tuple(alpha.shape) + _shape(shape)
+    bshape = alpha.shape + (1,) * len(_shape(shape))
+    g = jax.random.gamma(key, alpha.reshape(bshape), out_shape,
+                         dtype=alpha.dtype)
+    return _sample_dtype(g * beta.reshape(bshape), dtype)
+
+
+@register("sample_exponential", needs_key=True)
+def sample_exponential(lam, shape=None, dtype=None, key=None):
+    out_shape = tuple(lam.shape) + _shape(shape)
+    bshape = lam.shape + (1,) * len(_shape(shape))
+    e = jax.random.exponential(key, out_shape, dtype=lam.dtype)
+    return _sample_dtype(e / lam.reshape(bshape), dtype)
+
+
+@register("sample_poisson", needs_key=True)
+def sample_poisson(lam, shape=None, dtype="float32", key=None):
+    from ..ndarray.ndarray import _to_jnp_dtype
+    out_shape = tuple(lam.shape) + _shape(shape)
+    bshape = lam.shape + (1,) * len(_shape(shape))
+    return jax.random.poisson(key, lam.reshape(bshape), out_shape) \
+        .astype(_to_jnp_dtype(dtype))
+
+
+@register("sample_negative_binomial", needs_key=True)
+def sample_negative_binomial(k, p, shape=None, dtype="float32", key=None):
+    """Per-element NB(k, p) via the Poisson(Gamma) compound (the same
+    construction as random_negative_binomial)."""
+    from ..ndarray.ndarray import _to_jnp_dtype
+    kg, kp = jax.random.split(key)
+    out_shape = tuple(k.shape) + _shape(shape)
+    bshape = k.shape + (1,) * len(_shape(shape))
+    rate = jax.random.gamma(kg, k.reshape(bshape), out_shape) \
+        * ((1.0 - p) / p).reshape(bshape)
+    return jax.random.poisson(kp, rate, out_shape).astype(
+        _to_jnp_dtype(dtype))
+
+
+@register("sample_generalized_negative_binomial", needs_key=True)
+def sample_generalized_negative_binomial(mu, alpha, shape=None,
+                                         dtype="float32", key=None):
+    """Per-element GNB(mu, alpha): Poisson with a
+    Gamma(1/alpha, mu*alpha)-mixed rate. alpha==0 elements are the
+    zero-dispersion limit, plain Poisson(mu) — dividing by alpha there
+    would produce NaN rates (and -1 samples)."""
+    from ..ndarray.ndarray import _to_jnp_dtype
+    kg, kp = jax.random.split(key)
+    out_shape = tuple(mu.shape) + _shape(shape)
+    bshape = mu.shape + (1,) * len(_shape(shape))
+    a = alpha.reshape(bshape)
+    mub = mu.reshape(bshape)
+    safe_a = jnp.where(a == 0, 1.0, a)
+    rate = jnp.where(
+        a == 0, mub,
+        jax.random.gamma(kg, 1.0 / safe_a, out_shape) * (mub * safe_a))
+    return jax.random.poisson(kp, rate, out_shape).astype(
+        _to_jnp_dtype(dtype))
 
 
 @register("sample_multinomial", aliases=("_sample_multinomial",), needs_key=True)

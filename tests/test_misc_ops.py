@@ -505,3 +505,59 @@ def test_quantize_v1_explicit_range_and_gesvd():
     U, L, V = nd.linalg_gesvd(nd.array(A))
     rec = (U.asnumpy() * L.asnumpy()[None, :]) @ V.asnumpy()
     np.testing.assert_allclose(rec, A, rtol=1e-4, atol=1e-5)
+
+
+def test_sample_family_per_element_params():
+    """sample_* ops draw one batch of `shape` per LEADING element of the
+    parameter arrays (reference multisample_op.cc convention)."""
+    mx.random.seed(0)
+    al = nd.array(np.array([1.0, 20.0], np.float32))
+    be = nd.array(np.array([2.0, 0.5], np.float32))
+    g = nd.sample_gamma(al, be, shape=(8000,)).asnumpy()
+    assert g.shape == (2, 8000)
+    assert 1.6 < g[0].mean() < 2.4          # mean = alpha*beta = 2
+    assert 9.0 < g[1].mean() < 11.0         # 20*0.5 = 10
+
+    lam = nd.array(np.array([0.5, 4.0], np.float32))
+    e = nd.sample_exponential(lam, shape=(8000,)).asnumpy()
+    assert 1.8 < e[0].mean() < 2.2 and 0.22 < e[1].mean() < 0.28
+
+    p = nd.sample_poisson(lam, shape=(8000,)).asnumpy()
+    assert 0.4 < p[0].mean() < 0.6 and 3.8 < p[1].mean() < 4.2
+
+    k = nd.array(np.array([5.0], np.float32))
+    pr = nd.array(np.array([0.5], np.float32))
+    num = nd.sample_negative_binomial(k, pr, shape=(8000,)).asnumpy()
+    assert 4.5 < num.mean() < 5.5           # mean = k(1-p)/p = 5
+
+    mu = nd.array(np.array([3.0], np.float32))
+    alpha = nd.array(np.array([0.2], np.float32))
+    gn = nd.sample_generalized_negative_binomial(
+        mu, alpha, shape=(8000,)).asnumpy()
+    assert 2.7 < gn.mean() < 3.3 and 3.9 < gn.var() < 6.0
+
+
+def test_preloaded_multi_sgd_family():
+    """lrs/wds as device arrays must match the attr-based multi_* ops."""
+    rng = np.random.RandomState(0)
+    ws = [nd.array(rng.randn(3, 2).astype(np.float32)) for _ in range(2)]
+    gs = [nd.array(rng.randn(3, 2).astype(np.float32)) for _ in range(2)]
+    lrs, wds = [0.1, 0.02], [0.01, 0.0]
+    want = nd.multi_sgd_update(ws, gs, lrs=lrs, wds=wds)
+    got = nd.preloaded_multi_sgd_update(
+        ws, gs, nd.array(np.array(lrs, np.float32)),
+        nd.array(np.array(wds, np.float32)))
+    for w_, g_ in zip(want, got):
+        np.testing.assert_allclose(g_.asnumpy(), w_.asnumpy(), rtol=1e-6)
+
+    ms = [nd.zeros((3, 2)) for _ in range(2)]
+    got_mom = nd.preloaded_multi_sgd_mom_update(
+        ws, gs, ms, nd.array(np.array(lrs, np.float32)),
+        nd.array(np.array(wds, np.float32)), momentum=0.9)
+    assert len(got_mom) == 4                # (w, mom) per tensor
+    w32 = [nd.array(w.asnumpy().astype(np.float32)) for w in ws]
+    got_mp = nd.preloaded_multi_mp_sgd_update(
+        ws, gs, w32, nd.array(np.array(lrs, np.float32)),
+        nd.array(np.array(wds, np.float32)))
+    np.testing.assert_allclose(got_mp[0].asnumpy(), want[0].asnumpy(),
+                               rtol=1e-6)

@@ -618,7 +618,12 @@ NONDIFF = {
     "multi_mp_sgd_update": "optimizer",
     "multi_sgd_mom_update": "optimizer", "multi_sgd_update": "optimizer",
     "multi_sum_sq": "optimizer-infra reduction",
-    "nag_mom_update": "optimizer", "rmsprop_update": "optimizer",
+    "nag_mom_update": "optimizer",
+    "preloaded_multi_sgd_update": "optimizer",
+    "preloaded_multi_sgd_mom_update": "optimizer",
+    "preloaded_multi_mp_sgd_update": "optimizer",
+    "preloaded_multi_mp_sgd_mom_update": "optimizer",
+    "rmsprop_update": "optimizer",
     "rmspropalex_update": "optimizer", "sgd_mom_update": "optimizer",
     "sgd_update": "optimizer", "signsgd_update": "optimizer",
     "signum_update": "optimizer",
@@ -650,6 +655,9 @@ SKIP = {
     "random_randint": "sampler", "random_randn": "sampler",
     "random_uniform": "sampler", "sample_multinomial": "sampler",
     "sample_normal": "sampler", "sample_uniform": "sampler",
+    "sample_gamma": "sampler", "sample_exponential": "sampler",
+    "sample_poisson": "sampler", "sample_negative_binomial": "sampler",
+    "sample_generalized_negative_binomial": "sampler",
     "RNN": "fused packed-parameter op; gradients covered by the "
            "trajectory tests in tests/test_rnn.py",
     "linalg_gelqf": "decomposition gradient; finite differences "
