@@ -26,8 +26,10 @@ one JSON line, always, exit 0.
 Workloads (child mode, selected with --workload):
   bert    — BERT-base/large pretraining, bf16 + Pallas flash attention +
             LAMB with f32 master weights (the MFU flagship; default)
-  resnet  — ResNet-50 ImageNet-shaped data-parallel training step, img/s/chip
-            (BASELINE.md config #2)
+  resnet  — ResNet-50 ImageNet-shaped data-parallel training step,
+            img/s/chip (BASELINE.md config #2)
+  ssd     — SSD-300 detection training step (MultiBox ops), img/s/chip
+            (BASELINE.md config #5)
   nmt     — Transformer KV-cached beam-search decode, tokens/s (config #4)
   gpt     — GPT-2-small causal-LM pretraining, tokens/s/chip + MFU (the
             decoder-side complement: causal dense kernels + packed qkv)
@@ -341,6 +343,63 @@ def _run_resnet(on_tpu):
     }
 
 
+def _run_ssd(on_tpu):
+    """SSD-300 detection training step (BASELINE.md config #5 —
+    validates the contrib/custom-op path under training: MultiBoxPrior
+    anchors, MultiBoxTarget matching, masked CE + smooth-L1; upstream
+    GluonCV scripts/detection/ssd/train_ssd.py, file-level citation)."""
+    import numpy as np
+    import jax
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, parallel
+    from incubator_mxnet_tpu.models.ssd import ssd_300
+
+    if on_tpu or os.environ.get("MXTPU_BENCH_TPU_CONFIG") == "1":
+        B = int(os.environ.get("MXTPU_BENCH_SSD_BATCH", "32"))
+        side = 300
+        steps, warmup = (10, 3) if on_tpu else (1, 1)
+    else:
+        B, side = 4, 96
+        steps, warmup = 2, 1
+
+    mx.random.seed(0)
+    net = ssd_300(num_classes=20)
+    net.initialize()
+
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.rand(B, 3, side, side).astype(np.float32))
+    labels = np.full((B, 2, 5), -1.0, np.float32)
+    for b in range(B):
+        for o in range(2):
+            x1, y1 = rng.uniform(0.0, 0.6, 2)
+            w, h = rng.uniform(0.2, 0.35, 2)
+            labels[b, o] = (rng.randint(0, 20), x1, y1,
+                            min(x1 + w, 1.0), min(y1 + h, 1.0))
+    y = nd.array(labels)
+
+    def fwd_loss(model, xb, yb):
+        anchors, cls_preds, box_preds = model(xb)
+        box_t, box_m, cls_t = model.training_targets(anchors, cls_preds,
+                                                     yb)
+        return model.loss(cls_preds, box_preds, box_t, box_m, cls_t)
+
+    trainer = parallel.SPMDTrainer(
+        net, forward_loss=fwd_loss, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.05, "momentum": 0.9,
+                          "wd": 5e-4}, sharding="replicated")
+
+    dt, _ = _measure_steps(lambda: trainer.step(x, y), warmup, steps)
+    n_chips = len(jax.devices())
+    return {
+        "metric": "ssd300_train_img_per_sec_per_chip",
+        "value": round(B * steps / dt / n_chips, 2),
+        "unit": "img/s/chip",
+        "vs_baseline": 0.0,
+        "batch": B,
+        "side": side,
+    }
+
+
 def _run_nmt(on_tpu):
     """Transformer KV-cached beam-search decode throughput (BASELINE.md
     config #4, the inference path — upstream scripts/nmt translation)."""
@@ -395,7 +454,8 @@ def _child_main(workload):
         jax.config.update("jax_platforms", "cpu")
     on_tpu = any(d.platform != "cpu" for d in jax.devices())
     result = {"bert": _run_bert, "resnet": _run_resnet,
-              "nmt": _run_nmt, "gpt": _run_gpt}[workload](on_tpu)
+              "nmt": _run_nmt, "gpt": _run_gpt,
+              "ssd": _run_ssd}[workload](on_tpu)
     result["platform"] = jax.devices()[0].platform
     print("BENCH_RESULT " + json.dumps(result))
 

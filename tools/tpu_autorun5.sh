@@ -29,7 +29,7 @@ run() { # run NAME TIMEOUT [ENV=VAL...]
   echo "$(date -u +%H:%M:%S) done $name rc=$rc: $(head -c 200 "$LOG/$name.json" 2>/dev/null)" >> "$LOG/watch.log"
 }
 
-ALL="large-b32-dense resnet-b64 nmt-decode base-default b48-dense b96-dense-dots large-b32-dense-trace b96-dense-trace large-b48-dense b128-dense-dots default-hpp1 default-rbg default-nodrop default-jnpflash gpt-b16 gpt-b32-dots"
+ALL="large-b32-dense resnet-b64 nmt-decode ssd-b32 base-default b48-dense b96-dense-dots large-b32-dense-trace b96-dense-trace large-b48-dense b128-dense-dots default-hpp1 default-rbg default-nodrop default-jnpflash gpt-b16 gpt-b32-dots"
 while true; do
   if timeout 90 python -c "import jax; assert any(d.platform!='cpu' for d in jax.devices())" 2>/dev/null; then
     echo "$(date -u +%H:%M:%S) p5 window OPEN" >> "$LOG/watch.log"
@@ -49,6 +49,7 @@ while true; do
     run large-b32-dense 950 MXTPU_BENCH_MODEL=large MXTPU_BENCH_BATCH=32 MXTPU_BENCH_REMAT=dots
     WL=resnet run resnet-b64 700
     WL=nmt run nmt-decode 700
+    WL=ssd run ssd-b32 700
     # --- headline base + batch scaling ---
     # base-default runs with NO knobs: audits that the kernel_policy
     # defaults reproduce the best measured config (expect ~= b96-dots)
