@@ -71,6 +71,13 @@ def graph_to_ir(sym, params: Dict, input_shapes: Dict[str, Sequence[int]]):
     Returns {"nodes", "inputs", "outputs", "initializers"}."""
     graph = json.loads(sym.tojson())
     nodes_in = graph["nodes"]
+    # tojson stringifies attr values (reference nnvm Map<string,string>
+    # convention); parse literals back before reading kernel/stride/...
+    from ..symbol.symbol import _coerce_attr
+    for n in nodes_in:
+        if n.get("attrs"):
+            n["attrs"] = {k: _coerce_attr(k, v)
+                          for k, v in n["attrs"].items()}
     heads = graph["heads"]
 
     def np_of(v):

@@ -287,7 +287,13 @@ class Symbol:
             spec = {
                 "op": n.op,
                 "name": n.name,
-                "attrs": n.attrs,
+                # the reference's nnvm reads node attrs as a
+                # Map<string, string>: stringify values on write so a
+                # saved file opens in reference MXNet tooling too;
+                # fromjson coerces literals back, so our own round trip
+                # is lossless
+                "attrs": {k: v if isinstance(v, str) else str(v)
+                          for k, v in n.attrs.items()},
                 "inputs": [[node_id[id(src)], idx, 0]
                            for src, idx in n.inputs],
             }
@@ -388,11 +394,28 @@ def Group(symbols: Sequence[Symbol]) -> Symbol:
     return Symbol(heads)
 
 
+def _coerce_attr(k, v):
+    """Reference ``-symbol.json`` files stringify EVERY attr value
+    (nnvm json.cc writes "num_hidden": "4", "kernel": "(3, 3)",
+    "no_bias": "True"); parse literals back, keep genuine strings
+    (act_type="relu", dtype="float32") as-is. Dunder user attrs
+    (``__init__``, ``__lr_mult__``, ...) are string-typed BY CONTRACT
+    in the reference attr API — never coerce those."""
+    if not isinstance(v, str) or k.startswith("__"):
+        return v
+    import ast
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
 def fromjson(text: str) -> Symbol:
     payload = json.loads(text)
     nodes: List[_Node] = []
     for spec in payload["nodes"]:
         attrs = spec.get("attrs") or spec.get("param") or {}
+        attrs = {k: _coerce_attr(k, v) for k, v in attrs.items()}
         inputs = [(nodes[i], idx) for i, idx, *_ in spec.get("inputs", [])]
         nodes.append(_Node(spec["op"], spec["name"], inputs, attrs,
                            spec.get("annotations")))
