@@ -18,14 +18,19 @@ __all__ = ["save_checkpoint", "load_checkpoint"]
 
 def save_checkpoint(prefix: str, epoch: int, symbol: Symbol,
                     arg_params: Dict[str, NDArray],
-                    aux_params: Dict[str, NDArray]) -> None:
-    """Parity: ``mx.model.save_checkpoint`` / `callback.do_checkpoint`."""
+                    aux_params: Dict[str, NDArray],
+                    format: str = "mxtpu") -> None:
+    """Parity: ``mx.model.save_checkpoint`` / `callback.do_checkpoint`.
+    ``format="mxnet"`` writes the reference's 1.x ``.params`` binary
+    layout, so the resulting ``<prefix>-symbol.json`` +
+    ``<prefix>-NNNN.params`` pair opens in reference tooling
+    (load_checkpoint auto-detects either layout)."""
     if symbol is not None:
         symbol.save(f"{prefix}-symbol.json")
     payload = {}
     payload.update({f"arg:{k}": v for k, v in (arg_params or {}).items()})
     payload.update({f"aux:{k}": v for k, v in (aux_params or {}).items()})
-    nd_save(f"{prefix}-{epoch:04d}.params", payload)
+    nd_save(f"{prefix}-{epoch:04d}.params", payload, format=format)
 
 
 def load_checkpoint(prefix: str, epoch: int

@@ -136,6 +136,10 @@ def ring_attention_block(q, k, v, valid_length=None,
     carry = lax.fori_loop(0, size, body, carry)
     acc, row_max, row_sum = carry[:3]
     out = acc / jnp.maximum(row_sum[..., None], 1e-30)
+    # fully-masked rows (vl==0): row_max never rose from the additive
+    # -inf floor and p degenerated to uniform — zero them (the same
+    # masked-row contract as ops.pallas_attention / _sdpa_blockwise)
+    out = jnp.where((row_max > _NEG_INF / 2)[..., None], out, 0.0)
     return out.astype(q.dtype)
 
 

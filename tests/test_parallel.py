@@ -659,3 +659,29 @@ def test_kvstore_2bit_compression_single_process():
     kv.push("v", g)
     kv.pull("v", out=out)
     np.testing.assert_allclose(out.asnumpy(), 0.5)
+
+
+def test_ring_attention_fully_masked_row_is_zero():
+    """vl==0 rows: every ring chunk reports lse=_NEG_INF for the row;
+    the merge must weight it out to an exact zero (not NaN, not the
+    mean of V) — the r5 masked-row contract across the ring."""
+    mesh = pmesh.build_mesh(axis_sizes={"sp": 4})
+    B, T, H, D = 2, 32, 2, 8
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+    vl = jnp.asarray([0, 20], jnp.int32)      # batch 0 fully masked
+
+    out = parallel.ring_self_attention(q, k, v, mesh=mesh, causal=False,
+                                       batch_axis=None, valid_length=vl)
+    out_np = np.asarray(out)
+    assert np.isfinite(out_np).all()
+    np.testing.assert_array_equal(out_np[0], 0.0)
+    # batch 1 matches dense attention over the 20-key prefix
+    s = np.einsum("qhd,khd->hqk", np.asarray(q)[1],
+                  np.asarray(k)[1][:20]) / np.sqrt(D)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    want = np.einsum("hqk,khd->qhd", p, np.asarray(v)[1][:20])
+    np.testing.assert_allclose(out_np[1], want, rtol=2e-5, atol=2e-5)
