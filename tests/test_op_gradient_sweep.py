@@ -444,6 +444,15 @@ GRAD_CASES = {
                                output_dim=4),
         [_a((5, 4))]),
     "Dropout_placeholder": None,
+    # fused packed-parameter RNN: lstm gate packing 4*(I*H + H*H + 2H)
+    "RNN": lambda: (
+        lambda d, p: (lambda o: o[0] if isinstance(o, list) else o)(
+            nd.RNN(d, p, nd.array(np.zeros((1, 2, 4), np.float32)),
+                   nd.array(np.zeros((1, 2, 4), np.float32)),
+                   state_size=4, num_layers=1, mode="lstm")),
+        [_a((3, 2, 3), lo=-0.5, hi=0.5),
+         _a((4 * (3 * 4 + 4 * 4 + 2 * 4),), seed=1, lo=-0.3, hi=0.3)],
+        {"rtol": 3e-2, "atol": 3e-3}),
     "CTCLoss": lambda: (
         lambda x: nd.CTCLoss(x, nd.array(np.array([[1, 2], [2, 1]],
                                                   np.float32))),
@@ -658,8 +667,6 @@ SKIP = {
     "sample_gamma": "sampler", "sample_exponential": "sampler",
     "sample_poisson": "sampler", "sample_negative_binomial": "sampler",
     "sample_generalized_negative_binomial": "sampler",
-    "RNN": "fused packed-parameter op; gradients covered by the "
-           "trajectory tests in tests/test_rnn.py",
     "linalg_gelqf": "decomposition gradient; finite differences "
                     "unstable under Q/L sign convention",
     "linalg_gesvd": "SVD gradient; finite differences unstable under "
