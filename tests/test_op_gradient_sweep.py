@@ -391,6 +391,22 @@ GRAD_CASES = {
     "linalg_extracttrian": lambda: (nd.linalg_extracttrian, [_a((3, 3))]),
     "linalg_makediag": lambda: (nd.linalg_makediag, [_a((3,))]),
     "linalg_maketrian": lambda: (nd.linalg_maketrian, [_a((6,))]),
+    # decompositions: heads chosen invariant to the sign/ordering
+    # conventions (fixed projections; U*U for eigenvectors; singular
+    # values alone for SVD) so finite differences are well-defined
+    "linalg_gelqf": lambda: (
+        lambda a: (lambda LQ: LQ[0].sum()
+                   + (LQ[1] * _a((3, 4), seed=9)).sum())(
+            nd.linalg_gelqf(a)),
+        [_a((3, 4), lo=-0.5, hi=0.5)], {"rtol": 3e-2, "atol": 3e-3}),
+    "linalg_syevd": lambda: (
+        lambda a: (lambda Ul: Ul[1].sum()
+                   + (Ul[0] * Ul[0] * _a((3, 3), seed=9)).sum())(
+            nd.linalg_syevd(a)),
+        [_spd(3)], {"rtol": 3e-2, "atol": 3e-3}),
+    "linalg_gesvd": lambda: (
+        lambda a: nd.linalg_gesvd(a)[1].sum(),
+        [_a((3, 4), lo=-0.5, hi=0.5)], {"rtol": 3e-2, "atol": 3e-3}),
     # -- neural layers -------------------------------------------------- #
     "FullyConnected": lambda: (
         lambda x, w, b: nd.FullyConnected(x, w, b, num_hidden=3),
@@ -667,12 +683,6 @@ SKIP = {
     "sample_gamma": "sampler", "sample_exponential": "sampler",
     "sample_poisson": "sampler", "sample_negative_binomial": "sampler",
     "sample_generalized_negative_binomial": "sampler",
-    "linalg_gelqf": "decomposition gradient; finite differences "
-                    "unstable under Q/L sign convention",
-    "linalg_gesvd": "SVD gradient; finite differences unstable under "
-                    "sign/ordering convention",
-    "linalg_syevd": "eigendecomposition gradient; finite differences "
-                    "unstable under sign/ordering convention",
 }
 
 
