@@ -1,0 +1,80 @@
+"""Migrate a reference MXNet 1.x checkpoint in and out.
+
+Demonstrates the binary-compat path (SURVEY §5.4 "keep .params
+read/write compat as a migration tool"; ref layouts:
+`src/ndarray/ndarray.cc` NDArray::Save, nnvm json — file-level
+citations, SURVEY.md caveat):
+
+  1. writes a checkpoint PAIR in the reference layout
+     (-symbol.json + -NNNN.params with arg:/aux: prefixes),
+  2. loads it back through the auto-detecting loaders,
+  3. verifies byte-level format + prediction identity,
+  4. re-saves in the native MXTPU format.
+
+With a real reference-written checkpoint, replace step 1 with your
+files — the load path is identical.
+
+    python examples/migrate_checkpoint.py
+"""
+
+import os
+import struct
+import tempfile
+
+import numpy as np
+
+# force CPU before any jax work so the example runs anywhere
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu import nd  # noqa: E402
+
+
+def main():
+    # a small symbolic net, as a reference user would have built it
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=16)
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    out = mx.sym.FullyConnected(act, name="fc2", num_hidden=4)
+
+    rng = np.random.RandomState(0)
+    arg_params = {
+        "fc1_weight": nd.array(rng.randn(16, 8).astype(np.float32) * 0.1),
+        "fc1_bias": nd.array(np.zeros(16, np.float32)),
+        "fc2_weight": nd.array(rng.randn(4, 16).astype(np.float32) * 0.1),
+        "fc2_bias": nd.array(np.zeros(4, np.float32)),
+    }
+
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "net")
+        # 1. write the REFERENCE layout
+        mx.model.save_checkpoint(prefix, 0, out, arg_params, {},
+                                 format="mxnet")
+        raw = open(f"{prefix}-0000.params", "rb").read()
+        assert struct.unpack("<Q", raw[:8])[0] == 0x112
+        print(f"wrote reference-layout pair: {prefix}-symbol.json + "
+              f"{prefix}-0000.params ({len(raw)} bytes, magic 0x112)")
+
+        # 2. load back (format auto-detected)
+        sym, arg, aux = mx.model.load_checkpoint(prefix, 0)
+        x = nd.array(rng.randn(2, 8).astype(np.float32))
+        ex = sym.bind(None, dict(arg, data=x))
+        pred = ex.forward()[0].asnumpy()
+
+        # 3. identity vs the original parameters
+        ex0 = out.bind(None, dict(arg_params, data=x))
+        np.testing.assert_allclose(pred, ex0.forward()[0].asnumpy(),
+                                   rtol=1e-6)
+        print(f"reloaded and verified: predictions identical, "
+              f"shape {pred.shape}")
+
+        # 4. re-save native
+        nd.save(os.path.join(d, "native.params"), arg)
+        print("re-saved in the native MXTPU format — migration done")
+
+
+if __name__ == "__main__":
+    main()

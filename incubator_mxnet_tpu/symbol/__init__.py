@@ -27,6 +27,37 @@ __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
            "register_pass", "rewrite"]
 
 
+# tensor params the reference's symbolic API auto-creates as Variables
+# named "{node}_{param}" when not passed (python/mxnet/symbol/register.py
+# generated wrappers; file-level citation, SURVEY.md caveat). A
+# whitelist, so required ATTR slots (axis, shape, ...) can never be
+# captured as phantom variables.
+_IMPLICIT_PARAM_NAMES = frozenset({
+    "weight", "bias", "gamma", "beta", "moving_mean", "moving_var",
+    "parameters", "state", "state_cell",
+})
+
+
+def _implicit_wanted(p, params, values):
+    """Should the missing tensor param ``p`` become an implicit
+    Variable? Required (no-default) tensors: always. Optional ones are
+    gated exactly as the reference gates them, with the gating attr
+    read at its OWN signature default (Deconvolution declares
+    no_bias=True, so it gets no phantom bias)."""
+    if p.default is inspect.Parameter.empty:
+        return True
+    defaults = {q.name: q.default for q in params
+                if q.default is not inspect.Parameter.empty}
+    if p.name == "bias":
+        return not values.get("no_bias", defaults.get("no_bias", False))
+    if p.name == "state_cell":
+        return values.get("mode", defaults.get("mode")) == "lstm"
+    if p.name == "gamma":  # LeakyReLU: learnable slope only for prelu
+        return values.get("act_type",
+                          defaults.get("act_type")) == "prelu"
+    return False
+
+
 def _invoke_symbol(op_name: str, *args, name: Optional[str] = None,
                    **kwargs) -> Symbol:
     """Compose a graph node (the symbolic twin of imperative_invoke)."""
@@ -37,6 +68,13 @@ def _invoke_symbol(op_name: str, *args, name: Optional[str] = None,
 
     params = list(inspect.signature(spec.fn).parameters.values())
     has_varargs = any(p.kind is p.VAR_POSITIONAL for p in params)
+
+    from ..name import current as _current_name_mgr
+    mgr = _current_name_mgr()
+    if mgr is not None:
+        final_name = mgr.get(name, op_name.lower())
+    else:
+        final_name = name or _auto_name(op_name)
 
     inputs = []   # (node, out_idx) in positional order
     attrs = {}
@@ -66,6 +104,23 @@ def _invoke_symbol(op_name: str, *args, name: Optional[str] = None,
             if p.kind is inspect.Parameter.VAR_KEYWORD:
                 continue
             if p.name not in values:
+                # reference parity: unprovided PARAMETER tensors become
+                # implicit Variables "{node}_{param}" — required ones
+                # always (weight, gamma, ...); optional ones per the
+                # op's own gating attr (bias unless no_bias at ITS
+                # declared default, state_cell only for lstm, LeakyReLU
+                # gamma only for prelu). Running statistics are marked
+                # __aux__ so the executor folds their updates and
+                # checkpoints write aux: keys.
+                if (collecting and p.name in _IMPLICIT_PARAM_NAMES
+                        and _implicit_wanted(p, params, values)):
+                    v_attrs = ({"__aux__": 1}
+                               if p.name in ("moving_mean", "moving_var")
+                               else {})
+                    inputs.append(
+                        Variable(f"{final_name}_{p.name}",
+                                 **v_attrs)._heads[0])
+                    continue
                 collecting = False  # missing slot ends the tensor prefix
                 continue
             v = values.pop(p.name)
@@ -88,13 +143,7 @@ def _invoke_symbol(op_name: str, *args, name: Optional[str] = None,
                 f"{op_name}: unexpected Symbol kwargs {leftover_syms}")
         attrs.update(values)
 
-    from ..name import current as _current_name_mgr
     from ..attribute import current_attrs as _scope_attrs
-    mgr = _current_name_mgr()
-    if mgr is not None:
-        final_name = mgr.get(name, op_name.lower())
-    else:
-        final_name = name or _auto_name(op_name)
     # scope attrs are ANNOTATIONS (placement hints etc.), kept apart
     # from op kwargs so execution never sees them
     node = _Node(op_name, final_name, inputs, attrs,

@@ -317,3 +317,48 @@ def test_load_reference_written_symbol_json(tmp_path):
     wn = [n for n in _json.loads(sym.tojson())["nodes"]
           if n["name"] == "fc1_weight"][0]
     assert wn["attrs"]["__dtype__"] == "0"
+
+
+def test_implicit_parameter_variables():
+    """Reference parity: mx.sym.FullyConnected(data, num_hidden=k)
+    auto-creates fc_weight/fc_bias Variables (no_bias suppresses bias);
+    BatchNorm auto-creates gamma/beta/moving stats."""
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, name="fc1", num_hidden=3)
+    args = fc.list_arguments()
+    assert args == ["data", "fc1_weight", "fc1_bias"], args
+
+    fc_nb = mx.sym.FullyConnected(data, name="fc2", num_hidden=3,
+                                  no_bias=True)
+    assert fc_nb.list_arguments() == ["data", "fc2_weight"]
+
+    bn = mx.sym.BatchNorm(fc, name="bn1")
+    assert "bn1_gamma" in bn.list_arguments()
+    # running stats are AUX states (the executor folds their updates,
+    # checkpoints write aux: keys), not trainable arguments
+    assert "bn1_moving_var" in bn.list_auxiliary_states()
+    assert "bn1_moving_mean" not in bn.list_arguments()
+
+    # gating attrs are read at their own signature defaults:
+    # Deconvolution declares no_bias=True -> no phantom bias
+    dc = mx.sym.Deconvolution(data, name="dc", kernel=(2, 2),
+                              num_filter=4)
+    assert dc.list_arguments() == ["data", "dc_weight"]
+    # lstm mode auto-creates state_cell; prelu auto-creates gamma
+    r = mx.sym.RNN(mx.sym.Variable("x"), name="rnn0", mode="lstm",
+                   state_size=8, num_layers=1)
+    assert "rnn0_state_cell" in r.list_arguments()
+    pr = mx.sym.LeakyReLU(data, name="pr", act_type="prelu")
+    assert "pr_gamma" in pr.list_arguments()
+    lr = mx.sym.LeakyReLU(data, name="lk")      # plain leaky: no gamma
+    assert lr.list_arguments() == ["data"]
+
+    # executes end to end with the implicit names bound
+    rng = np.random.RandomState(0)
+    ex = fc.bind(None, {
+        "data": nd.array(rng.randn(2, 5).astype(np.float32)),
+        "fc1_weight": nd.array(rng.randn(3, 5).astype(np.float32)),
+        "fc1_bias": nd.array(np.ones(3, np.float32)),
+    })
+    out = ex.forward()[0].asnumpy()
+    assert out.shape == (2, 3)
