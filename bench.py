@@ -716,8 +716,8 @@ def main():
             if i < n_attempts - 1 and _remaining() > 150:
                 time.sleep(BACKOFFS[min(i, len(BACKOFFS) - 1)])
 
-    # 4. measured extras with leftover budget (BASELINE configs #2/#4);
-    #    on the TPU path they are on by default, CPU opt-in via env
+    # 4. measured extras with leftover budget (BASELINE configs
+    #    #2/#4/#5); on the TPU path they are on by default, CPU opt-in
     extras = {}
     run_extras_cpu = os.environ.get("MXTPU_BENCH_RESNET") == "1"
     platform = None if tpu_res is not None else "cpu"
@@ -725,6 +725,9 @@ def main():
         if _remaining() > 180:
             rn, err = _attempt("resnet", platform, _remaining() - 60)
             extras["resnet"] = rn if rn is not None else {"error": err[:300]}
+        if _remaining() > 150:
+            sd, err = _attempt("ssd", platform, _remaining() - 45)
+            extras["ssd"] = sd if sd is not None else {"error": err[:300]}
         if _remaining() > 120:
             nm, err = _attempt("nmt", platform, _remaining() - 30)
             extras["nmt"] = nm if nm is not None else {"error": err[:300]}
