@@ -113,3 +113,117 @@ def __getattr__(name: str):
     np_function.__name__ = name
     np_function.__doc__ = fn.__doc__
     return np_function
+
+
+class _NpRandom:
+    """``mx.np.random`` — numpy.random-style surface over the
+    framework's key-threaded samplers (reference:
+    ``python/mxnet/numpy/random.py``, file-level citation — SURVEY.md
+    caveat). ``size`` is the numpy spelling of ``shape``; draws go
+    through the registered sampler ops, so the global seeded stream and
+    autograd semantics match ``mx.nd.random``."""
+
+    @staticmethod
+    def _nd():
+        from .ndarray import random as ndr
+        return ndr
+
+    def seed(self, s):
+        from . import random as _r
+        _r.seed(s)
+
+    def rand(self, *size):
+        return self._nd().uniform(0.0, 1.0, shape=size or None)
+
+    def randn(self, *size):
+        return self._nd().randn(*size)
+
+    def uniform(self, low=0.0, high=1.0, size=None):
+        return self._nd().uniform(low, high, shape=size)
+
+    def normal(self, loc=0.0, scale=1.0, size=None):
+        return self._nd().normal(loc, scale, shape=size)
+
+    def randint(self, low, high=None, size=None, dtype="int32"):
+        if high is None:
+            low, high = 0, low
+        return self._nd().randint(low, high, shape=size, dtype=dtype)
+
+    def gamma(self, shape, scale=1.0, size=None):
+        return self._nd().gamma(shape, scale, shape=size)
+
+    def exponential(self, scale=1.0, size=None):
+        return self._nd().exponential(1.0 / scale, shape=size)
+
+    def laplace(self, loc=0.0, scale=1.0, size=None):
+        return self._nd().laplace(loc, scale, shape=size)
+
+    def beta(self, a, b, size=None):
+        # Beta(a, b) = G1 / (G1 + G2) with G1~Gamma(a), G2~Gamma(b):
+        # composed from the registered gamma sampler so the draw stays
+        # on the seeded stream and the tape
+        g1 = self._nd().gamma(a, 1.0, shape=size)
+        g2 = self._nd().gamma(b, 1.0, shape=size)
+        return g1 / (g1 + g2)
+
+    @staticmethod
+    def _size_total(size):
+        total = 1
+        for d in (size if isinstance(size, tuple)
+                  else (size,) if size else ()):
+            total *= d
+        return total
+
+    def choice(self, a, size=None, replace=True, p=None):
+        n = int(a) if not hasattr(a, "shape") else a.shape[0]
+        if p is not None:
+            pa = p if hasattr(p, "shape") else array(p)
+            if pa.shape[0] != n:
+                raise MXNetError(
+                    f"choice: 'a' ({n}) and 'p' ({pa.shape[0]}) must "
+                    f"have the same size")
+            if replace:
+                idx = self._nd().multinomial(pa, shape=size)
+            else:
+                # weighted sampling WITHOUT replacement = Gumbel top-k:
+                # argsort(log p + Gumbel noise) descending, take k
+                total = self._size_total(size)
+                if total > n:
+                    raise MXNetError(
+                        "choice: cannot take more samples than "
+                        "population when replace=False")
+                from .ndarray import log as nd_log, topk
+                u = self._nd().uniform(1e-20, 1.0, shape=(n,))
+                g = -nd_log(-nd_log(u))
+                scores = nd_log(pa + 1e-38) + g
+                idx = topk(scores, k=total, ret_typ="indices",
+                           is_ascend=False)
+                idx = idx.reshape(size) if size else idx[0]
+        else:
+            if not replace:
+                total = self._size_total(size)
+                if total > n:
+                    raise MXNetError(
+                        "choice: cannot take more samples than "
+                        "population when replace=False")
+                perm = self._nd().shuffle(array(_onp.arange(n)))
+                idx = perm[:total].reshape(size) if size else perm[0]
+            else:
+                idx = self._nd().randint(0, n, shape=size)
+        if hasattr(a, "shape"):
+            from .ndarray import take
+            return take(a, idx, axis=0)
+        return idx
+
+    def shuffle(self, x):
+        """In place along axis 0, returns None (numpy contract)."""
+        x._data = self._nd().shuffle(x)._data
+        return None
+
+    def permutation(self, x):
+        if isinstance(x, int):
+            return self._nd().shuffle(array(_onp.arange(x)))
+        return self._nd().shuffle(x)
+
+
+random = _NpRandom()

@@ -146,3 +146,39 @@ def test_mx_npx_forwarding():
     sm = mx.npx.softmax(x)
     assert sm.shape == (1, 2)
     mx.npx.waitall()
+
+
+def test_mx_np_random_surface():
+    """mx.np.random — numpy.random-style API over the seeded stream."""
+    mx.random.seed(0)
+    r = mx.np.random
+    assert r.rand(3, 4).shape == (3, 4)
+    u = r.uniform(2.0, 4.0, size=(4000,)).asnumpy()
+    assert 2.9 < u.mean() < 3.1 and u.min() >= 2.0
+    b = r.beta(2.0, 5.0, size=(4000,)).asnumpy()
+    assert 0.24 < b.mean() < 0.33 and 0.0 <= b.min() <= b.max() <= 1.0
+    p = r.permutation(6).asnumpy()
+    assert sorted(p.tolist()) == [0, 1, 2, 3, 4, 5]
+    arr = mx.np.array([10.0, 20.0, 30.0, 40.0])
+    cs = r.choice(arr, size=(3,), replace=False).asnumpy()
+    assert len(set(cs.tolist())) == 3
+    # weighted sampling without replacement: distinct draws (Gumbel top-k)
+    cw = r.choice(5, size=(4,), replace=False,
+                  p=[0.92, 0.02, 0.02, 0.02, 0.02]).asnumpy()
+    assert len(set(cw.tolist())) == 4
+    # numpy contracts: shuffle is in place and returns None; p must
+    # match a; replace=False caps at the population size
+    x = mx.np.array([0.0, 1.0, 2.0, 3.0, 4.0, 5.0])
+    assert r.shuffle(x) is None
+    assert sorted(x.asnumpy().tolist()) == [0, 1, 2, 3, 4, 5]
+    import pytest as _pytest
+    from incubator_mxnet_tpu.base import MXNetError as _E
+    with _pytest.raises(_E):
+        r.choice(10, size=(3,), p=[0.5, 0.5])
+    with _pytest.raises(_E):
+        r.choice(3, size=(5,), replace=False)
+    r.seed(7)
+    a1 = r.rand(4).asnumpy()
+    r.seed(7)
+    a2 = r.rand(4).asnumpy()
+    assert (a1 == a2).all()
