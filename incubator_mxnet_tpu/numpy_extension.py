@@ -28,13 +28,23 @@ def waitall():
     wait_all()
 
 
+# snake_case names whose registry spelling has irregular capitalization
+_IRREGULAR_CAMEL = {
+    "leaky_relu": "LeakyReLU", "lrn": "LRN", "rnn": "RNN",
+    "roi_pooling": "ROIPooling", "roi_align": "ROIAlign",
+    "ctc_loss": "CTCLoss", "l2_normalization": "L2Normalization",
+    "svm_output": "SVMOutput",
+}
+
+
 def __getattr__(name: str):
     # registry-backed nn ops: npx.relu, npx.softmax, npx.batch_norm …
     attr = getattr(_nd, name, None)
     if attr is not None:
         return attr
     # snake_case → CamelCase registry aliases (npx.batch_norm → BatchNorm)
-    camel = "".join(p.capitalize() for p in name.split("_"))
+    camel = _IRREGULAR_CAMEL.get(
+        name, "".join(p.capitalize() for p in name.split("_")))
     attr = getattr(_nd, camel, None)
     if attr is not None:
         return attr
