@@ -9,7 +9,8 @@ from .block import HybridBlock
 __all__ = ["Loss", "L2Loss", "L1Loss", "SoftmaxCrossEntropyLoss",
            "SoftmaxCELoss", "SigmoidBinaryCrossEntropyLoss", "SigmoidBCELoss",
            "KLDivLoss", "HuberLoss", "HingeLoss", "SquaredHingeLoss",
-           "LogisticLoss", "TripletLoss", "CTCLoss", "CosineEmbeddingLoss"]
+           "LogisticLoss", "TripletLoss", "CTCLoss", "CosineEmbeddingLoss",
+           "PoissonNLLLoss"]
 
 
 def _apply_weighting(F, loss, weight=None, sample_weight=None):
@@ -239,3 +240,32 @@ class CosineEmbeddingLoss(Loss):
                        F.relu(cos - self._margin))
         loss = _apply_weighting(F, loss, self._weight, sample_weight)
         return loss
+
+
+class PoissonNLLLoss(Loss):
+    """Poisson negative log likelihood (parity: gluon.loss.PoissonNLLLoss).
+    ``from_logits=True``: pred is log-rate, L = exp(pred) - label*pred;
+    else L = pred - label*log(pred + eps). ``compute_full`` adds the
+    Stirling approximation of log(label!)."""
+
+    def __init__(self, weight=None, from_logits=True, batch_axis=0,
+                 compute_full=False, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._compute_full = compute_full
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None,
+                       epsilon=1e-08):
+        label = _reshape_like(F, label, pred)
+        if self._from_logits:
+            loss = F.exp(pred) - label * pred
+        else:
+            loss = pred - label * F.log(pred + epsilon)
+        if self._compute_full:
+            stirling = (label * F.log(label + epsilon) - label
+                        + 0.5 * F.log(2 * 3.141592653589793
+                                      * (label + epsilon)))
+            stirling = stirling * (label > 1)
+            loss = loss + stirling
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss)

@@ -12,3 +12,7 @@ from .conv_layers import (Conv1D, Conv2D, Conv3D, Conv1DTranspose,
                           AvgPool3D, GlobalMaxPool1D, GlobalMaxPool2D,
                           GlobalMaxPool3D, GlobalAvgPool1D, GlobalAvgPool2D,
                           GlobalAvgPool3D, ReflectionPad2D)
+
+# the reference re-exports the block base classes from gluon.nn too
+# (python/mxnet/gluon/nn/__init__.py imports from ..block)
+from ..block import Block, HybridBlock, SymbolBlock  # noqa: E402,F401

@@ -2,10 +2,12 @@
 
 from .rnn_cell import (RecurrentCell, HybridRecurrentCell, RNNCell, LSTMCell,
                        GRUCell, SequentialRNNCell, BidirectionalCell,
-                       ResidualCell, DropoutCell, ModifierCell)
+                       ResidualCell, DropoutCell, ModifierCell,
+                       ZoneoutCell, HybridSequentialRNNCell)
 from .rnn_layer import RNN, LSTM, GRU
 
 __all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
+           "ZoneoutCell", "HybridSequentialRNNCell",
            "GRUCell", "SequentialRNNCell", "BidirectionalCell",
            "ResidualCell", "DropoutCell", "ModifierCell", "RNN", "LSTM",
            "GRU"]

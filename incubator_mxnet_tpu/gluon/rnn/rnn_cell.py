@@ -14,6 +14,7 @@ from ...base import MXNetError
 from ..block import HybridBlock
 
 __all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
+           "ZoneoutCell", "HybridSequentialRNNCell",
            "GRUCell", "SequentialRNNCell", "BidirectionalCell",
            "ResidualCell", "DropoutCell", "ModifierCell"]
 
@@ -278,6 +279,48 @@ class ResidualCell(ModifierCell):
         return out + inputs, states
 
 
+class ZoneoutCell(ModifierCell):
+    """Zoneout regularization: each step keeps the PREVIOUS state with
+    probability ``zoneout_states`` (and the previous output with
+    ``zoneout_outputs``) instead of the new one (parity: ZoneoutCell;
+    Krueger et al. 2017). Training-mode gated like Dropout; at
+    inference the cell is a passthrough (the reference's
+    Dropout-generated mask becomes all-ones)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        super().__init__(base_cell)
+        self._zo = zoneout_outputs
+        self._zs = zoneout_states
+        self._prev_output = None
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None
+
+    def forward(self, inputs, states):
+        from ... import autograd as _ag
+        from ... import ndarray as _nd
+
+        out, next_states = self.base_cell(inputs, states)
+
+        def _mix(p, new, old):
+            # train/predict-mode gating matches Dropout (is_training,
+            # not is_recording); inference is an identity passthrough
+            if p == 0.0 or old is None or not _ag.is_training():
+                return new
+            mask = _nd.random.uniform(0.0, 1.0, shape=new.shape) < p
+            return _nd.where(mask, old, new)
+
+        prev_out = self._prev_output
+        if prev_out is None:
+            prev_out = _nd.zeros_like(out)
+        out = _mix(self._zo, out, prev_out)
+        next_states = [_mix(self._zs, ns, s)
+                       for ns, s in zip(next_states, states)]
+        self._prev_output = out
+        return out, next_states
+
+
 class DropoutCell(RecurrentCell):
     """Applies dropout to the input each step (parity: DropoutCell)."""
 
@@ -364,3 +407,8 @@ class BidirectionalCell(RecurrentCell):
         if merge_outputs or merge_outputs is None:
             outputs = nd.stack(*outputs, axis=axis)
         return outputs, l_states + r_states
+
+
+# the reference distinguishes hybrid/non-hybrid sequential containers;
+# one implementation serves both here (everything traces under jit)
+HybridSequentialRNNCell = SequentialRNNCell

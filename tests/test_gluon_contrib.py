@@ -176,3 +176,49 @@ def test_contrib_rnn_cells():
     np.testing.assert_array_equal(m1, m2)
     vcell.reset()
     assert vcell._input_mask is None
+
+
+def test_poisson_nll_zoneout_and_aliases():
+    """Round-5 parity fills: PoissonNLLLoss, ZoneoutCell,
+    HybridSequentialRNNCell, gluon.nn Block re-exports."""
+    from incubator_mxnet_tpu import autograd
+    from incubator_mxnet_tpu.gluon import loss as gloss, nn, rnn
+
+    assert nn.Block is not None and nn.HybridBlock is not None
+
+    l = gloss.PoissonNLLLoss(from_logits=True)
+    pred = nd.array(np.log(np.array([[2.0, 3.0]], np.float32)))
+    lab = nd.array(np.array([[2.0, 3.0]], np.float32))
+    want = float(np.mean([2 - 2 * np.log(2), 3 - 3 * np.log(3)]))
+    assert abs(float(l(pred, lab).asnumpy()) - want) < 1e-5
+
+    mx.random.seed(0)
+    cell = rnn.ZoneoutCell(rnn.RNNCell(4, prefix="z_"),
+                           zoneout_outputs=0.3, zoneout_states=0.5)
+    cell.initialize()
+    x = [nd.array(np.random.RandomState(i).randn(2, 3).astype(np.float32))
+         for i in range(3)]
+    outs, _ = cell.unroll(3, x, layout="TNC", merge_outputs=False)
+    assert outs[0].shape == (2, 4)
+    # inference is a PASSTHROUGH (reference semantics: the dropout mask
+    # becomes all-ones) — identical to the bare cell
+    cell.reset()
+    outs_ref, _ = cell.base_cell.unroll(3, x, layout="TNC",
+                                        merge_outputs=False)
+    cell.reset()
+    outs_z, _ = cell.unroll(3, x, layout="TNC", merge_outputs=False)
+    for a, b in zip(outs_z, outs_ref):
+        np.testing.assert_allclose(a.asnumpy(), b.asnumpy(), rtol=1e-6)
+    with autograd.record():
+        cell.reset()
+        outs_t, _ = cell.unroll(3, x, layout="TNC", merge_outputs=False)
+        s = outs_t[0].sum() + outs_t[1].sum() + outs_t[2].sum()
+    s.backward()  # stochastic zoneout path is differentiable
+
+    seq = rnn.HybridSequentialRNNCell()
+    seq.add(rnn.RNNCell(4, prefix="a_"))
+    seq.add(rnn.ResidualCell(rnn.RNNCell(4, prefix="b_")))
+    seq.initialize()
+    o, _ = seq.unroll(2, [nd.array(np.ones((2, 4), np.float32))] * 2,
+                      layout="TNC", merge_outputs=False)
+    assert o[0].shape == (2, 4)
