@@ -11,6 +11,7 @@ from __future__ import annotations
 import math
 
 import jax
+import re as _re
 import jax.numpy as jnp
 
 from . import random as _random
@@ -19,7 +20,7 @@ from .ndarray.ndarray import NDArray, _to_jnp_dtype
 
 __all__ = ["Initializer", "Uniform", "Normal", "Zero", "One", "Constant",
            "Xavier", "MSRAPrelu", "Orthogonal", "Bilinear", "LSTMBias",
-           "register", "create"]
+           "Mixed", "InitDesc", "Load", "register", "create"]
 
 _REGISTRY = Registry("initializer")
 register = _REGISTRY.register
@@ -35,6 +36,12 @@ class Initializer:
     def __call__(self, name, arr: NDArray):
         if not isinstance(name, str):
             name, arr = arr, name  # tolerate swapped order
+        # InitDesc: a per-parameter attrs["__init__"] overrides this
+        # initializer (reference: initializer.py InitDesc dispatch)
+        override = getattr(name, "attrs", {}).get("__init__")
+        if override:
+            create(override)(str(name), arr)
+            return
         self.init_weight(name, arr)
 
     def init_weight(self, name: str, arr: NDArray):
@@ -231,3 +238,71 @@ class TruncNorm(Initializer):
         key = _random.new_key()
         arr._data = (self.mean + self.stdev * jax.random.truncated_normal(
             key, -2.0, 2.0, arr.shape)).astype(arr.dtype)
+
+
+class InitDesc(str):
+    """Parameter-description string with attrs (parity: InitDesc) —
+    carries the attribute dict and global_init alongside the name."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        obj = super().__new__(cls, name)
+        obj.attrs = attrs or {}
+        obj.global_init = global_init
+        return obj
+
+
+class Mixed(Initializer):
+    """Per-name-pattern initializer dispatch (parity: Mixed): patterns
+    are regexes tried in order; the first match initializes."""
+
+    def __init__(self, patterns, initializers):
+        super().__init__(patterns=patterns, initializers=initializers)
+        if len(patterns) != len(initializers):
+            raise MXNetError("Mixed: len(patterns) != len(initializers)")
+        self._map = [(_re.compile(p), init)
+                     for p, init in zip(patterns, initializers)]
+
+    def init_weight(self, name, arr):
+        for prog, init in self._map:
+            if prog.match(name):
+                init(name, arr)
+                return
+        raise MXNetError(
+            f"Mixed: parameter {name!r} did not match any pattern — "
+            f"add a '.*' catch-all as the last pattern")
+
+
+@register("load")
+class Load:
+    """Initialize from a dict of saved arrays (parity: Load): exact
+    name match first, then with arg:/aux: prefixes stripped;
+    ``default_init`` covers the rest."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        if isinstance(param, str):
+            from .ndarray import load as nd_load
+            param = nd_load(param)
+        self.param = {}
+        for k, v in param.items():
+            self.param[k.split(":", 1)[1] if k.startswith(("arg:", "aux:"))
+                       else k] = v
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr):
+        if not isinstance(name, str):
+            name, arr = arr, name
+        if name in self.param:
+            src = self.param[name]
+            if tuple(src.shape) != tuple(arr.shape):
+                raise MXNetError(
+                    f"Load: shape mismatch for {name}: saved "
+                    f"{tuple(src.shape)} vs expected {tuple(arr.shape)}")
+            arr._data = src._data.astype(arr.dtype)
+            if self.verbose:
+                print(f"Initialized {name} from the loaded arrays")
+        elif self.default_init is not None:
+            self.default_init(name, arr)
+        else:
+            raise MXNetError(
+                f"Load: no saved value for {name!r} and no default_init")

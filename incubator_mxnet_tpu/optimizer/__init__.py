@@ -1,7 +1,8 @@
 """Optimizers (re-design of `python/mxnet/optimizer/` — SURVEY.md §2.2)."""
 
 from .optimizer import (Optimizer, SGD, NAG, Adam, AdamW, RMSProp, Ftrl,
-                        Signum, LAMB, AdaGrad, AdaDelta, Updater, create,
+                        Signum, LAMB, LARS, FTML, Adamax, Nadam, DCASGD,
+                        SGLD, AdaGrad, AdaDelta, Updater, create,
                         register, get_updater)
 from . import lr_scheduler
 from .lr_scheduler import LRScheduler

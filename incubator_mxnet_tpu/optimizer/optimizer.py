@@ -19,6 +19,7 @@ import math
 import pickle
 from typing import Dict, Optional
 
+import jax
 import jax.numpy as jnp
 
 from ..base import MXNetError, Registry
@@ -26,7 +27,8 @@ from ..ndarray import NDArray, array as nd_array, zeros as nd_zeros
 from ..ndarray.register import invoke_by_name
 
 __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "RMSProp", "Ftrl",
-           "Signum", "LAMB", "LARS", "FTML", "AdaGrad", "AdaDelta",
+           "Signum", "LAMB", "LARS", "FTML", "Adamax", "Nadam", "DCASGD",
+           "SGLD", "AdaGrad", "AdaDelta",
            "Updater", "create", "register", "get_updater"]
 
 _REGISTRY = Registry("optimizer")
@@ -573,3 +575,136 @@ class Updater:
 
 def get_updater(optimizer: Optimizer) -> Updater:
     return Updater(optimizer)
+
+
+@register("adamax")
+class Adamax(Optimizer):
+    """AdaMax — Adam with an infinity-norm second moment (reference:
+    optimizer.py Adamax, a pure-Python update there too)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        dt = str(weight.dtype)
+        return (nd_zeros(weight.shape, dtype=dt),
+                nd_zeros(weight.shape, dtype=dt))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._step_t(index)
+        lr = self._get_lr(index) / (1.0 - self.beta1 ** t)
+        # reference order: wd folds in BEFORE clipping
+        g = grad._data * self.rescale_grad             + self._get_wd(index) * weight._data
+        if self.clip_gradient is not None and self.clip_gradient > 0:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        m, u = state
+        m._data = self.beta1 * m._data + (1.0 - self.beta1) * g
+        u._data = jnp.maximum(self.beta2 * u._data, jnp.abs(g))
+        weight._data = weight._data - lr * m._data / (u._data
+                                                      + self.epsilon)
+
+
+@register("nadam")
+class Nadam(Optimizer):
+    """Nesterov Adam (reference: optimizer.py Nadam; Dozat 2016
+    schedule with the 0.96^(t*schedule_decay) momentum cache)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        dt = str(weight.dtype)
+        return (nd_zeros(weight.shape, dtype=dt),
+                nd_zeros(weight.shape, dtype=dt))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._step_t(index)
+        lr = self._get_lr(index)
+        # reference order: wd folds in BEFORE clipping
+        g = grad._data * self.rescale_grad             + self._get_wd(index) * weight._data
+        if self.clip_gradient is not None and self.clip_gradient > 0:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (
+            t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 ** (
+            (t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+
+        m, v = state
+        m._data = self.beta1 * m._data + (1.0 - self.beta1) * g
+        v._data = self.beta2 * v._data + (1.0 - self.beta2) * g * g
+        g_prime = g / (1.0 - self.m_schedule)
+        m_prime = m._data / (1.0 - m_schedule_next)
+        v_prime = v._data / (1.0 - self.beta2 ** t)
+        m_bar = (1.0 - momentum_t) * g_prime + momentum_t_1 * m_prime
+        weight._data = weight._data - lr * m_bar / (
+            jnp.sqrt(v_prime) + self.epsilon)
+
+
+@register("dcasgd")
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference: optimizer.py DCASGD) —
+    compensates gradient staleness with lambda * g^2 * (w - w_prev)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lamda=0.04,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        dt = str(weight.dtype)
+        mom = (nd_zeros(weight.shape, dtype=dt)
+               if self.momentum != 0.0 else None)
+        prev = NDArray(weight._data)          # copy of the weight
+        return (mom, prev)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient is not None and self.clip_gradient > 0:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        mom, prev = state
+        comp = (g + self._get_wd(index) * weight._data
+                + self.lamda * g * g * (weight._data - prev._data))
+        if mom is not None:
+            mom._data = self.momentum * mom._data - lr * comp
+            step = mom._data
+        else:
+            step = -lr * comp
+        prev._data = weight._data
+        weight._data = weight._data + step
+
+
+@register("sgld")
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (reference: optimizer.py
+    SGLD): half-gradient step plus N(0, lr) noise for posterior
+    sampling. Noise rides the framework's seeded key stream."""
+
+    def __init__(self, learning_rate=0.01, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient is not None and self.clip_gradient > 0:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + self._get_wd(index) * weight._data
+        from .. import random as _random  # deferred: import cycle
+        noise = jax.random.normal(_random.new_key(), weight.shape,
+                                  dtype=weight._data.dtype) * jnp.sqrt(
+            jnp.asarray(lr, weight._data.dtype))
+        weight._data = weight._data - 0.5 * lr * g + noise

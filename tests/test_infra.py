@@ -182,3 +182,51 @@ def test_mx_np_random_surface():
     r.seed(7)
     a2 = r.rand(4).asnumpy()
     assert (a1 == a2).all()
+
+
+def test_round5_optimizer_and_initializer_fills():
+    """Adamax/Nadam/DCASGD/SGLD converge (SGLD stays finite — it's a
+    sampler); Mixed/InitDesc/Load initializers behave per reference."""
+    import numpy as np
+    from incubator_mxnet_tpu import autograd, gluon, nd
+    from incubator_mxnet_tpu.base import MXNetError
+
+    mx.random.seed(0)
+    for name in ("adamax", "nadam", "dcasgd", "sgld"):
+        net = gluon.nn.Dense(4)
+        net.initialize()
+        tr = gluon.Trainer(net.collect_params(), name,
+                           {"learning_rate": 0.05})
+        X = nd.array(np.random.RandomState(0).randn(16, 6)
+                     .astype(np.float32))
+        Y = nd.array(np.random.RandomState(1).randn(16, 4)
+                     .astype(np.float32))
+        l2 = gluon.loss.L2Loss()
+        first = last = None
+        for _ in range(30):
+            with autograd.record():
+                l = l2(net(X), Y).mean()
+            l.backward()
+            tr.step(1)
+            last = float(l.asnumpy())
+            if first is None:
+                first = last
+        assert np.isfinite(last), name
+        if name != "sgld":
+            assert last < first, (name, first, last)
+
+    ini = mx.initializer
+    m = ini.Mixed([".*bias", ".*"], [ini.Zero(), ini.Constant(2.0)])
+    a, b = nd.zeros((3,)), nd.zeros((2, 2))
+    m("fc_bias", a)
+    m("fc_weight", b)
+    assert (a.asnumpy() == 0).all() and (b.asnumpy() == 2.0).all()
+    saved = {"arg:w": nd.array(np.arange(4, dtype=np.float32)
+                               .reshape(2, 2))}
+    ld = ini.Load(saved, default_init=ini.Zero())
+    w = nd.zeros((2, 2))
+    ld("w", w)
+    assert (w.asnumpy() == np.arange(4).reshape(2, 2)).all()
+    import pytest as _pytest
+    with _pytest.raises(MXNetError):
+        ld("w", nd.zeros((3, 3)))
