@@ -7,7 +7,7 @@ import logging
 import time
 
 __all__ = ["Speedometer", "do_checkpoint", "log_train_metric",
-           "ProgressBar"]
+           "ProgressBar", "LogValidationMetricsCallback"]
 
 
 class Speedometer:
@@ -90,3 +90,15 @@ def log_train_metric(period, auto_reset=False):
                 param.eval_metric.reset()
 
     return _callback
+
+
+class LogValidationMetricsCallback:
+    """Log every validation metric at epoch end (parity:
+    callback.LogValidationMetricsCallback)."""
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name,
+                         value)

@@ -12,7 +12,9 @@ from ..ndarray.ndarray import _as_jax
 
 __all__ = ["imread", "imdecode", "decode_to_numpy", "imresize",
            "resize_short", "fixed_crop", "center_crop", "random_crop",
-           "color_normalize", "ImageIter", "imdecode_resize_batch"]
+           "random_size_crop", "copyMakeBorder", "imrotate",
+           "random_rotate", "color_normalize", "ImageIter",
+           "imdecode_resize_batch"]
 
 
 def _resize_bilinear_np(img: np.ndarray, h: int, w: int) -> np.ndarray:
@@ -150,7 +152,8 @@ def resize_short(src, size, interp=1) -> NDArray:
 
 def fixed_crop(src, x0, y0, w, h, size=None, interp=1) -> NDArray:
     x = _np(src)[y0:y0 + h, x0:x0 + w]
-    if size is not None and (h, w) != tuple(size):
+    # size is (w, h), matching center_crop/random_crop and imresize
+    if size is not None and (w, h) != tuple(size):
         return imresize(x, size[0], size[1], interp)
     return NDArray(_as_jax(x))
 
@@ -173,6 +176,100 @@ def random_crop(src, size, interp=1):
     x0 = rng.randint(0, max(W - w, 0) + 1)
     y0 = rng.randint(0, max(H - h, 0) + 1)
     return fixed_crop(x, x0, y0, w, h), (x0, y0, w, h)
+
+
+def random_size_crop(src, size, area, ratio, interp=1, **kwargs):
+    """Random area/aspect crop then resize (parity: the Inception-style
+    training crop, mx.image.random_size_crop). ``area`` is a (min, max)
+    fraction (a scalar means (area, 1.0)); falls back to center_crop
+    when 10 attempts find no feasible box — the reference behavior."""
+    from .. import random as _random
+    x = _np(src)
+    H, W = x.shape[:2]
+    src_area = H * W
+    if np.isscalar(area):
+        area = (area, 1.0)
+    rng = _random.np_rng()
+    for _ in range(10):
+        target_area = rng.uniform(area[0], area[1]) * src_area
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        new_ratio = np.exp(rng.uniform(*log_ratio))
+        w = int(round(np.sqrt(target_area * new_ratio)))
+        h = int(round(np.sqrt(target_area / new_ratio)))
+        if w <= W and h <= H:
+            x0 = rng.randint(0, W - w + 1)
+            y0 = rng.randint(0, H - h + 1)
+            out = fixed_crop(x, x0, y0, w, h, size, interp)
+            return out, (x0, y0, w, h)
+    # infeasible after 10 draws: center-crop THEN resize to size
+    cw, ch = min(size[0], W), min(size[1], H)
+    x0 = max((W - cw) // 2, 0)
+    y0 = max((H - ch) // 2, 0)
+    return fixed_crop(x, x0, y0, cw, ch, size, interp), (x0, y0, cw, ch)
+
+
+def copyMakeBorder(src, top, bot, left, right, type=0, values=0.0):
+    """Pad an image with a constant border (parity: mx.image.
+    copyMakeBorder / cv2.copyMakeBorder BORDER_CONSTANT)."""
+    if type != 0:
+        raise MXNetError(
+            f"copyMakeBorder: only BORDER_CONSTANT (type=0) is "
+            f"implemented, got type={type}")
+    x = _np(src)
+    pads = [(top, bot), (left, right)] + [(0, 0)] * (x.ndim - 2)
+    out = np.pad(x, pads, mode="constant", constant_values=values)
+    return NDArray(_as_jax(out))
+
+
+def imrotate(src, rotation_degrees, zoom_in=False, zoom_out=False):
+    """Rotate around the center with bilinear sampling (parity:
+    mx.image.imrotate). Out-of-bounds samples are zero; ``zoom_in``
+    scales so no padding shows, ``zoom_out`` so no content is lost."""
+    if zoom_in and zoom_out:
+        raise MXNetError("imrotate: zoom_in and zoom_out are exclusive")
+    x = _np(src).astype(np.float32)
+    H, W = x.shape[:2]
+    theta = np.deg2rad(float(rotation_degrees))
+    c, s = np.cos(theta), np.sin(theta)
+    scale = 1.0
+    if zoom_in:
+        # largest scale whose rotated sampling window stays inside the
+        # source (identity at 0 degrees for ANY aspect ratio)
+        scale = min(W / (abs(W * c) + abs(H * s)),
+                    H / (abs(W * s) + abs(H * c)))
+    elif zoom_out:
+        # smallest scale whose window covers the whole source
+        scale = max((abs(W * c) + abs(H * s)) / W,
+                    (abs(W * s) + abs(H * c)) / H)
+    yy, xx = np.meshgrid(np.arange(H, dtype=np.float32),
+                         np.arange(W, dtype=np.float32), indexing="ij")
+    cx, cy = (W - 1) / 2.0, (H - 1) / 2.0
+    xs = (xx - cx) * scale
+    ys = (yy - cy) * scale
+    xsrc = c * xs + s * ys + cx
+    ysrc = -s * xs + c * ys + cy
+    x0 = np.floor(xsrc).astype(np.int32)
+    y0 = np.floor(ysrc).astype(np.int32)
+    fx = (xsrc - x0)[..., None]
+    fy = (ysrc - y0)[..., None]
+
+    def _at(yi, xi):
+        valid = ((yi >= 0) & (yi < H) & (xi >= 0) & (xi < W))[..., None]
+        samp = x[np.clip(yi, 0, H - 1), np.clip(xi, 0, W - 1)]
+        return np.where(valid, samp, 0.0)
+
+    out = ((1 - fy) * ((1 - fx) * _at(y0, x0) + fx * _at(y0, x0 + 1))
+           + fy * ((1 - fx) * _at(y0 + 1, x0) + fx * _at(y0 + 1, x0 + 1)))
+    return NDArray(_as_jax(out.astype(np.float32)))
+
+
+def random_rotate(src, angle_limits, zoom_in=False, zoom_out=False):
+    """Rotate by a uniform random angle in ``angle_limits`` (parity:
+    mx.image.random_rotate)."""
+    from .. import random as _random
+    lo, hi = angle_limits
+    angle = float(_random.np_rng().uniform(lo, hi))
+    return imrotate(src, angle, zoom_in=zoom_in, zoom_out=zoom_out)
 
 
 def color_normalize(src, mean, std=None) -> NDArray:

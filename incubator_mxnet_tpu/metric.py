@@ -16,7 +16,8 @@ import numpy as np
 from .base import MXNetError, Registry
 from .ndarray import NDArray
 
-__all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "MCC", "MAE",
+__all__ = ["EvalMetric", "Torch", "Caffe",
+           "Accuracy", "TopKAccuracy", "F1", "MCC", "MAE",
            "MSE", "RMSE", "CrossEntropy", "Perplexity", "NegativeLogLikelihood",
            "PearsonCorrelation", "Loss", "CompositeEvalMetric", "create"]
 
@@ -305,6 +306,23 @@ class Loss(EvalMetric):
             p = _as_jnp(pred)
             self.sum_metric = self.sum_metric + p.sum()
             self.num_inst += int(np.prod(p.shape)) or 1
+
+
+@register("torch")
+class Torch(Loss):
+    """Legacy framework-output logging metric (parity: metric.Torch —
+    the reference implements it as a renamed Loss)."""
+
+    def __init__(self, name="torch", **kwargs):
+        super().__init__(name, **kwargs)
+
+
+@register("caffe")
+class Caffe(Loss):
+    """Legacy framework-output logging metric (parity: metric.Caffe)."""
+
+    def __init__(self, name="caffe", **kwargs):
+        super().__init__(name, **kwargs)
 
 
 class CustomMetric(EvalMetric):

@@ -54,3 +54,22 @@ def test_augmenter_dumps():
     import json
     name, kw = json.loads(a.dumps())
     assert name == "BrightnessJitterAug" and kw["brightness"] == 0.3
+
+
+def test_round5_image_additions():
+    """random_size_crop / copyMakeBorder / imrotate / random_rotate."""
+    mx.random.seed(0)
+    img = (np.random.RandomState(0).rand(20, 30, 3) * 255).astype(np.uint8)
+    out, box = mx.image.random_size_crop(img, (8, 8), area=(0.2, 0.9),
+                                         ratio=(0.7, 1.4))
+    assert out.shape == (8, 8, 3)
+    b = mx.image.copyMakeBorder(img, 2, 3, 4, 5, values=7.0)
+    assert b.shape == (25, 39, 3)
+    assert (b.asnumpy()[:2] == 7).all() and (b.asnumpy()[:, :4] == 7).all()
+    sq = np.zeros((9, 9, 1), np.float32)
+    sq[2, 4] = 1.0
+    np.testing.assert_allclose(mx.image.imrotate(sq, 0).asnumpy(), sq,
+                               atol=1e-5)
+    r90 = mx.image.imrotate(sq, 90).asnumpy()
+    assert abs(r90.sum() - 1.0) < 1e-4 and r90[2, 4] != 1.0
+    assert mx.image.random_rotate(sq, (-30, 30)).shape == sq.shape
