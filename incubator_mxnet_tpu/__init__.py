@@ -37,7 +37,7 @@ from . import base
 from .base import MXNetError
 from . import context
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, \
-    num_gpus, num_tpus, num_devices
+    num_gpus, num_tpus, num_devices, gpu_memory_info
 from . import random
 from . import autograd
 from . import ops

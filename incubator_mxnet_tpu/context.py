@@ -167,3 +167,19 @@ def current_context() -> Context:
     if any(d.platform != "cpu" for d in jax.devices()):
         return tpu(0)
     return cpu(0)
+
+
+def gpu_memory_info(device_id: int = 0):
+    """(free, total) accelerator memory in bytes (parity:
+    mx.context.gpu_memory_info → cudaMemGetInfo; here the PJRT
+    device's memory stats — HBM on TPU)."""
+    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    if not devs:
+        raise MXNetError("gpu_memory_info: no accelerator device")
+    if device_id >= len(devs):
+        raise MXNetError(f"gpu_memory_info: device_id {device_id} out of "
+                         f"range ({len(devs)} accelerator devices)")
+    stats = devs[device_id].memory_stats() or {}
+    total = stats.get("bytes_limit", 0)
+    used = stats.get("bytes_in_use", 0)
+    return (total - used, total)
