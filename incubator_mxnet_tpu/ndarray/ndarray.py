@@ -251,12 +251,28 @@ class NDArray:
     # ------------------------------------------------------------------ #
     # indexing
     # ------------------------------------------------------------------ #
+    @staticmethod
+    def _index_leaf(k):
+        """Array indexers: float dtypes are POSITIONS and cast to int32
+        (the reference's take-convention for ndarray indices); boolean
+        masks are rejected with a pointer at nd.boolean_mask (their
+        data-dependent output shape cannot trace under jit)."""
+        if isinstance(k, NDArray):
+            k = k._data
+        if hasattr(k, "dtype") and hasattr(k, "ndim"):
+            if k.dtype == jnp.bool_:
+                raise MXNetError(
+                    "boolean-mask indexing has a data-dependent shape; "
+                    "use nd.boolean_mask(data, mask) (or nd.where) "
+                    "instead")
+            if jnp.issubdtype(k.dtype, jnp.floating):
+                k = k.astype(jnp.int32)
+        return k
+
     def _index(self, key):
-        if isinstance(key, NDArray):
-            key = key._data
-        elif isinstance(key, tuple):
-            key = tuple(k._data if isinstance(k, NDArray) else k for k in key)
-        return key
+        if isinstance(key, tuple):
+            return tuple(self._index_leaf(k) for k in key)
+        return self._index_leaf(key)
 
     def __getitem__(self, key):
         from .register import invoke_by_name

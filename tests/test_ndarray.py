@@ -254,3 +254,24 @@ def test_where_clip_misc():
     assert nd.where(cond, x, y).asnumpy().tolist() == [1.0, 20.0, 3.0]
     assert nd.clip(x, 1.5, 2.5).asnumpy().tolist() == [1.5, 2.0, 2.5]
     assert nd.add_n(x, y, x).asnumpy().tolist() == [12.0, 24.0, 36.0]
+
+
+def test_array_indexer_conventions():
+    """Array indexers: float dtypes are POSITIONS (cast to int32, the
+    classic take convention); genuinely-boolean masks raise with a
+    pointer at nd.boolean_mask (data-dependent shape can't trace)."""
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.base import MXNetError
+    from incubator_mxnet_tpu.ndarray import NDArray
+
+    a = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    rows = a[nd.array([0.0, 2.0])]
+    np.testing.assert_array_equal(rows.asnumpy(),
+                                  [[0, 1, 2, 3], [8, 9, 10, 11]])
+    np.testing.assert_array_equal(
+        a[nd.array([1], dtype="int32")].asnumpy(), [[4, 5, 6, 7]])
+    with pytest.raises(MXNetError, match="boolean_mask"):
+        a[NDArray(jnp.asarray([True, False, True]))]
+    a[nd.array([0.0])] = 7.0
+    assert (a.asnumpy()[0] == 7).all()
