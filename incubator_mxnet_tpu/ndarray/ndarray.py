@@ -484,6 +484,10 @@ class NDArray:
     def clip(self, a_min, a_max):
         return self._op("clip", a_min=a_min, a_max=a_max)
 
+    def pad(self, mode="constant", pad_width=None, constant_value=0.0):
+        return self._op("pad", mode=mode, pad_width=pad_width,
+                        constant_value=constant_value)
+
     def abs(self):
         return self._op("abs")
 

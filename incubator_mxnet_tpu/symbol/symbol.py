@@ -279,6 +279,14 @@ class Symbol:
         return Executor.simple_bind(self, ctx, grad_req, **shapes)
 
     # -- serialization ------------------------------------------------ #
+    def grad(self, wrt):
+        """Deprecated in the reference too (symbol.py Symbol.grad raises
+        for most ops since 1.0): gradients come from autograd or the
+        executor's fused backward."""
+        raise MXNetError(
+            "Symbol.grad is deprecated (as in the reference); bind the "
+            "symbol and use Executor.backward, or autograd.record")
+
     def tojson(self) -> str:
         nodes = _topo(self._heads)
         node_id = {id(n): i for i, n in enumerate(nodes)}
