@@ -10,9 +10,10 @@ registry the imperative front end uses (SURVEY.md §1 pillar b: one
 registration serves both front ends); execution compiles the DAG into one
 jitted XLA program (`executor.py`) instead of walking an engine queue.
 
-Graph JSON keeps the NNVM shape (`nodes`/`arg_nodes`/`heads`) so saved
-models are inspectable with the same tooling conventions, but attribute
-values are stored as native JSON values rather than strings.
+Graph JSON keeps the NNVM shape (`nodes`/`arg_nodes`/`heads`) AND the
+reference's all-strings attr convention on write (fromjson parses
+literals back), so saved files open in reference tooling and real
+reference `-symbol.json` files load here.
 """
 
 from __future__ import annotations
