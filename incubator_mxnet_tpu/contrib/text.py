@@ -186,3 +186,28 @@ class CustomEmbedding(TokenEmbedding):
         for t, v in token_to_vec.items():
             self._idx_to_vec[self._token_to_idx[t]] = _np.asarray(
                 v, _np.float32)
+
+
+# ------------------------------------------------------------------ #
+# reference submodule layout (python/mxnet/contrib/text/{embedding,
+# vocab,utils}.py): real module objects registered in sys.modules so
+# every import form works (`from ...text import embedding`,
+# `import ...text.embedding`, `from ...text.embedding import ...`)
+# ------------------------------------------------------------------ #
+import sys as _sys
+import types as _types
+
+
+def _submodule(name, **names):
+    mod = _types.ModuleType(__name__ + "." + name)
+    for k, v in names.items():
+        setattr(mod, k, v)
+    _sys.modules[mod.__name__] = mod
+    return mod
+
+
+embedding = _submodule("embedding", TokenEmbedding=TokenEmbedding,
+                       CustomEmbedding=CustomEmbedding)
+vocab = _submodule("vocab", Vocabulary=Vocabulary)
+utils = _submodule("utils",
+                   count_tokens_from_str=count_tokens_from_str)

@@ -12,12 +12,13 @@ from ..base import MXNetError
 from ..ndarray import NDArray
 from ..ndarray.ndarray import _as_jax
 from . import recordio
-from .recordio import MXRecordIO, IndexedRecordIO, pack, unpack, pack_img, \
+from .recordio import MXRecordIO, IndexedRecordIO, MXIndexedRecordIO, \
+    pack, unpack, pack_img, \
     unpack_img, IRHeader
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
-           "ImageRecordIter", "MNISTIter", "ResizeIter", "PrefetchingIter",
-           "recordio"]
+           "LibSVMIter", "ImageRecordIter", "ImageDetRecordIter",
+           "MNISTIter", "ResizeIter", "PrefetchingIter", "recordio"]
 
 
 class DataDesc(collections.namedtuple("DataDesc", ["name", "shape", "dtype",
@@ -190,6 +191,103 @@ class CSVIter(DataIter):
 
     def iter_next(self):
         return self._inner.iter_next()
+
+
+class LibSVMIter(DataIter):
+    """LibSVM text-format reader emitting CSR data batches (parity:
+    mx.io.LibSVMIter, reference src/io/iter_libsvm.cc). Lines are
+    ``label idx:val idx:val ...`` (0-based indices, the reference's
+    convention). The dataset is held as one CSR triple and sliced per
+    batch — never densified."""
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 label_shape=None, batch_size=1, round_batch=True,
+                 **kwargs):
+        super().__init__(batch_size)
+        self._feat_dim = int(data_shape[0] if isinstance(
+            data_shape, (tuple, list)) else data_shape)
+        labels, data, indices, indptr = [], [], [], [0]
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                for p in parts[1:]:
+                    k, v = p.split(":")
+                    indices.append(int(k))
+                    data.append(float(v))
+                indptr.append(len(data))
+        self._data = np.asarray(data, np.float32)
+        self._indices = np.asarray(indices, np.int32)
+        self._indptr = np.asarray(indptr, np.int64)
+        self._num = len(labels)
+        label = np.asarray(labels, np.float32).reshape(-1, 1)
+        if label_libsvm is not None:
+            ldim = int(label_shape[0] if isinstance(
+                label_shape, (tuple, list)) else (label_shape or 1))
+            llabels = []
+            with open(label_libsvm) as f:
+                for line in f:
+                    parts = line.split()
+                    if not parts:
+                        continue
+                    lrow = np.zeros(ldim, np.float32)
+                    for p in parts[1:]:
+                        k, v = p.split(":")
+                        lrow[int(k)] = float(v)
+                    llabels.append(lrow)
+            label = np.asarray(llabels, np.float32)
+        self._label = label
+        self._round = round_batch
+        self._cursor = 0
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size, self._feat_dim),
+                         np.float32)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label",
+                         (self.batch_size,) + self._label.shape[1:],
+                         np.float32)]
+
+    def reset(self):
+        self._cursor = 0
+
+    def iter_next(self):
+        return self._cursor < self._num
+
+    def _rows(self, lo, hi):
+        """CSR slice [lo, hi) as an (batch_size, feat_dim) CSRNDArray;
+        short final batches pad with empty rows (round_batch)."""
+        from ..ndarray.sparse import csr_matrix
+        sl = slice(self._indptr[lo], self._indptr[hi])
+        indptr = self._indptr[lo:hi + 1] - self._indptr[lo]
+        pad = self.batch_size - (hi - lo)
+        if pad:
+            indptr = np.concatenate(
+                [indptr, np.full(pad, indptr[-1], np.int64)])
+        return csr_matrix(
+            (self._data[sl], self._indices[sl], indptr),
+            shape=(self.batch_size, self._feat_dim))
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        lo = self._cursor
+        hi = min(lo + self.batch_size, self._num)
+        if not self._round and hi - lo < self.batch_size:
+            raise StopIteration
+        self._cursor = hi
+        lab = self._label[lo:hi]
+        pad = self.batch_size - (hi - lo)
+        if pad:
+            lab = np.concatenate(
+                [lab, np.zeros((pad,) + lab.shape[1:], np.float32)])
+        return DataBatch(data=[self._rows(lo, hi)],
+                         label=[NDArray(_as_jax(lab))], pad=pad)
 
 
 class MNISTIter(DataIter):
@@ -449,3 +547,27 @@ class PrefetchingIter(DataIter):
         if isinstance(item, Exception):
             raise item
         return item
+
+
+def ImageDetRecordIter(**kwargs):
+    """Detection RecordIO iterator (parity surface: mx.io.
+    ImageDetRecordIter) — delegates to image.ImageDetIter, translating
+    the reference's kwargs (mean_r/g/b -> mean tuple, std_*, resize)
+    and dropping its engine-tuning knobs (preprocess_threads etc.,
+    meaningless here)."""
+    from ..image.detection import ImageDetIter
+    mean = tuple(kwargs.pop(f"mean_{c}", 0.0) for c in "rgb")
+    std = tuple(kwargs.pop(f"std_{c}", 1.0) for c in "rgb")
+    passthrough = {}
+    for k in ("batch_size", "data_shape", "path_imgrec", "shuffle",
+              "max_objects", "aug_list", "resize", "rand_crop",
+              "rand_mirror"):
+        if k in kwargs:
+            passthrough[k] = kwargs.pop(k)
+    if any(mean):
+        passthrough["mean"] = mean
+    if std != (1.0, 1.0, 1.0):
+        passthrough["std"] = std
+    # remaining reference knobs (label_width, preprocess_threads,
+    # label_pad_width, ...) tune the C++ pipeline; ignored here
+    return ImageDetIter(**passthrough)

@@ -218,3 +218,8 @@ def _decode_img(payload: bytes, iscolor):
     from ..image import decode_to_numpy
 
     return decode_to_numpy(payload, flag=iscolor, to_rgb=bool(iscolor))
+
+
+# the reference's canonical class name (IndexedRecordIO kept as the
+# shorter local spelling)
+MXIndexedRecordIO = IndexedRecordIO

@@ -336,3 +336,31 @@ def test_native_jpeg_batch_decode_matches_cv2():
     # dims probe
     w_, h_ = ni.image_dims(payloads[0])
     assert (w_, h_) == (56, 40)
+
+
+def test_libsvm_iter_and_io_aliases(tmp_path):
+    """LibSVMIter emits CSR batches; reference alias names resolve."""
+    from incubator_mxnet_tpu.io import (ImageDetRecordIter, LibSVMIter,
+                                        MXIndexedRecordIO)
+    from incubator_mxnet_tpu.ndarray.sparse import CSRNDArray
+
+    p = tmp_path / "data.libsvm"
+    p.write_text("1 0:1.5 3:2.0\n0 1:0.5\n1 2:3.0 3:1.0\n0 0:2.5\n")
+    it = LibSVMIter(data_libsvm=str(p), data_shape=(4,), batch_size=2)
+    assert it.provide_data[0].shape == (2, 4)      # Module.fit-ready
+    batches = list(it)
+    assert len(batches) == 2
+    assert isinstance(batches[0].data[0], CSRNDArray)
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(),
+                               [[1.5, 0, 0, 2.0], [0, 0.5, 0, 0]])
+    assert float(batches[0].label[0].asnumpy().ravel()[0]) == 1.0
+    it.reset()
+    assert len(list(it)) == 2
+    # short final batch pads with empty CSR rows and reports pad
+    it5 = LibSVMIter(data_libsvm=str(p), data_shape=4, batch_size=3)
+    b = list(it5)
+    assert len(b) == 2 and b[-1].pad == 2
+    from incubator_mxnet_tpu.contrib.text.embedding import TokenEmbedding
+    assert TokenEmbedding is not None
+    assert MXIndexedRecordIO is recordio.IndexedRecordIO
+    assert ImageDetRecordIter is not None
