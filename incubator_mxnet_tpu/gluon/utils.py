@@ -66,3 +66,42 @@ def clip_global_norm(arrays: Sequence[NDArray], max_norm: float,
         warnings.warn(f"nan or inf is detected. Clipping results will be "
                       f"undefined: norm={norm_val}")
     return norm_val
+
+
+def check_sha1(filename, sha1_hash):
+    """True iff the file's SHA-1 matches (parity: gluon.utils.check_sha1)."""
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        for chunk in iter(lambda: f.read(1048576), b""):
+            sha1.update(chunk)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None,
+             retries=5, verify_ssl=True):
+    """Download ``url`` to ``path`` (parity: gluon.utils.download).
+    This environment has no egress; the surface exists for ported code
+    and raises the underlying URLError when the network is absent."""
+    import os as _os
+    import urllib.request
+
+    fname = path or url.split("/")[-1]
+    if _os.path.isdir(fname):
+        fname = _os.path.join(fname, url.split("/")[-1])
+    if not overwrite and _os.path.exists(fname) and (
+            sha1_hash is None or check_sha1(fname, sha1_hash)):
+        return fname
+    d = _os.path.dirname(_os.path.abspath(fname))
+    if d:
+        _os.makedirs(d, exist_ok=True)
+    last = None
+    for _ in range(max(1, retries)):
+        try:
+            urllib.request.urlretrieve(url, fname)
+            if sha1_hash and not check_sha1(fname, sha1_hash):
+                raise OSError(f"sha1 mismatch for {fname}")
+            return fname
+        except Exception as e:  # retry transient network errors
+            last = e
+    raise last

@@ -33,6 +33,8 @@ from collections import defaultdict
 from contextlib import contextmanager
 from typing import Dict, List, Optional
 
+from .base import MXNetError
+
 from .base import getenv_bool
 
 __all__ = ["set_config", "start", "stop", "dump", "dumps", "pause", "resume",
@@ -155,6 +157,49 @@ class ProfileEvent:
         if self._t is not None and is_running():
             _record(self.name, self.cat, self._t, _now_us() - self._t)
         self._t = None
+
+
+class Task(ProfileEvent):
+    """Named task duration event (parity: `profiler.Task` — a domain-
+    scoped ProfileEvent; domains are a labeling concept here)."""
+
+    def __init__(self, domain=None, name: str = "task"):
+        if isinstance(domain, str) and name == "task":
+            domain, name = None, domain  # tolerate Task("name")
+        super().__init__(name, cat=getattr(domain, "name", None)
+                         or (domain if isinstance(domain, str)
+                             else "task"))
+
+
+class Frame(ProfileEvent):
+    """Named frame duration event (parity: `profiler.Frame`)."""
+
+    def __init__(self, domain=None, name: str = "frame"):
+        if isinstance(domain, str) and name == "frame":
+            domain, name = None, domain
+        super().__init__(name, cat=getattr(domain, "name", None)
+                         or (domain if isinstance(domain, str)
+                             else "frame"))
+
+
+class Domain:
+    """Profiling category label (parity: `profiler.Domain`)."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __repr__(self):
+        return f"Domain({self.name!r})"
+
+
+def set_state(state="stop", profile_process="worker"):
+    """start/stop by name (parity: profiler.set_state)."""
+    if state in ("run", "start"):
+        start()
+    elif state == "stop":
+        stop()
+    else:
+        raise MXNetError(f"profiler.set_state: unknown state {state!r}")
 
 
 class Counter:
