@@ -44,6 +44,16 @@ def _as_jnp(x):
     return jnp.asarray(x)
 
 
+def _squeeze_label(label, pred):
+    """Labels shaped (B, 1) against (B, C) predictions: drop the
+    trailing singleton (the reference ravels labels) so ndim-based
+    argmax detection and broadcasting comparisons stay correct."""
+    if (label.ndim == pred.ndim and label.shape[-1] == 1
+            and pred.shape[-1] != 1):
+        return label.reshape(label.shape[:-1])
+    return label
+
+
 def _flat_pairs(labels, preds):
     if isinstance(labels, (list, tuple)):
         if not isinstance(preds, (list, tuple)) or len(labels) != len(preds):
@@ -91,6 +101,7 @@ class Accuracy(EvalMetric):
         for label, pred in _flat_pairs(labels, preds):
             label = _as_jnp(label)
             pred = _as_jnp(pred)
+            label = _squeeze_label(label, pred)
             if pred.ndim > label.ndim:
                 pred = jnp.argmax(pred, axis=self.axis)
             correct = (pred.astype(jnp.int32) ==
@@ -109,6 +120,7 @@ class TopKAccuracy(EvalMetric):
         for label, pred in _flat_pairs(labels, preds):
             label = _as_jnp(label).astype(jnp.int32)
             pred = _as_jnp(pred)
+            label = _squeeze_label(label, pred)
             top = jnp.argsort(pred, axis=-1)[..., -self.top_k:]
             hit = (top == label[..., None]).any(axis=-1).sum()
             self.sum_metric = self.sum_metric + hit
@@ -132,6 +144,7 @@ class F1(EvalMetric):
         for label, pred in _flat_pairs(labels, preds):
             label = np.asarray(_as_jnp(label)).astype(np.int32)
             pred = np.asarray(_as_jnp(pred))
+            label = _squeeze_label(label, pred)
             if pred.ndim > label.ndim:
                 pred = pred.argmax(-1)
             pred = pred.astype(np.int32)
@@ -161,6 +174,7 @@ class MCC(EvalMetric):
         for label, pred in _flat_pairs(labels, preds):
             label = np.asarray(_as_jnp(label)).astype(np.int32)
             pred = np.asarray(_as_jnp(pred))
+            label = _squeeze_label(label, pred)
             if pred.ndim > label.ndim:
                 pred = pred.argmax(-1)
             pred = pred.astype(np.int32)

@@ -349,14 +349,23 @@ class Module(BaseModule):
             raise MXNetError("forward: call bind first")
         if is_train is None:
             is_train = getattr(self, "_for_training", True)
+        def _feed(arr):
+            # sparse batch data (LibSVMIter CSR, row_sparse) densifies
+            # at the graph boundary: the symbolic executor's ops are
+            # dense-XLA programs (the reference dispatches per-op
+            # sparse kernels instead; SURVEY §7.3 substitution)
+            if hasattr(arr, "tostype") and getattr(arr, "stype",
+                                                   "default") != "default":
+                arr = arr.tostype("default")
+            return arr if isinstance(arr, NDArray) \
+                else NDArray(_as_jax(arr))
+
         feeds = {}
         for name, arr in zip(self._data_names, data_batch.data):
-            feeds[name] = arr if isinstance(arr, NDArray) \
-                else NDArray(_as_jax(arr))
+            feeds[name] = _feed(arr)
         if data_batch.label is not None:
             for name, arr in zip(self._label_names, data_batch.label):
-                feeds[name] = arr if isinstance(arr, NDArray) \
-                    else NDArray(_as_jax(arr))
+                feeds[name] = _feed(arr)
         self._exec.forward(is_train=is_train, **feeds)
 
     def backward(self, out_grads=None):

@@ -430,6 +430,13 @@ def softmax_output(data, label, grad_scale=1.0, ignore_label=-1,
     def _bwd(res, g):
         p, l = res
         depth = p.shape[axis]
+        l_primal = l      # cotangent must keep the ORIGINAL label shape
+        # the reference accepts labels with a trailing singleton class
+        # axis ((B, 1) from row-shaped iterators); squeeze it so the
+        # one_hot gradient keeps the data's shape instead of
+        # broadcasting (B,1,C) against (B,C)
+        if l.ndim == p.ndim and l.shape[axis] == 1:
+            l = jnp.squeeze(l, axis=axis)
         lab = l.astype(jnp.int32)
         oh = jax.nn.one_hot(lab, depth, dtype=p.dtype)
         if multi_output:
@@ -447,7 +454,7 @@ def softmax_output(data, label, grad_scale=1.0, ignore_label=-1,
         elif normalization == "valid" and use_ignore:
             valid = jnp.maximum(jnp.sum(l != ignore_label), 1)
             scale = scale / valid
-        return (grad * scale, jnp.zeros_like(l))
+        return (grad * scale, jnp.zeros_like(l_primal))
 
     _so.defvjp(_fwd, _bwd)
     return _so(data, label)

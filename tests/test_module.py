@@ -250,3 +250,43 @@ def test_module_checkpoint_reference_format_roundtrip(tmp_path):
     mod2.set_params(arg, aux)
     preds2 = mod2.predict(_toy_iter(shuffle=False))
     assert np.allclose(preds.asnumpy(), preds2.asnumpy(), atol=1e-5)
+
+
+def test_module_fit_with_column_labels_and_libsvm(tmp_path):
+    """(B, 1)-shaped labels (what row-shaped iterators like LibSVMIter
+    emit) must train and score correctly: SoftmaxOutput's fused
+    backward squeezes the trailing class axis (a broadcast there
+    silently produced (B, B, C) cotangents) and the classification
+    metrics ravel labels like the reference."""
+    rng = np.random.RandomState(0)
+    p = tmp_path / "train.libsvm"
+    with open(p, "w") as f:
+        for _ in range(64):
+            x = np.zeros(6, np.float32)
+            nz = rng.choice(6, 3, replace=False)
+            x[nz] = rng.randn(3)
+            f.write(f"{int(x.sum() > 0)} "
+                    + " ".join(f"{i}:{x[i]:.4f}" for i in nz) + "\n")
+    it = mx.io.LibSVMIter(data_libsvm=str(p), data_shape=(6,),
+                          batch_size=8)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=16)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=2)
+    net = mx.sym.SoftmaxOutput(net, mx.sym.Variable("softmax_label"),
+                               name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=5, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5})
+    it.reset()
+    acc = dict(mod.score(it, "acc"))["accuracy"]
+    assert 0.7 < acc <= 1.0, acc
+
+    # metrics accept (B, 1) labels without over-counting
+    pred = nd.array(np.array([[0.9, 0.1], [0.2, 0.8]], np.float32))
+    lab = nd.array(np.array([[0.0], [1.0]], np.float32))
+    # perfect deterministic predictions: each metric must be EXACTLY 1
+    for m in (mx.metric.Accuracy(), mx.metric.F1(), mx.metric.MCC(),
+              mx.metric.TopKAccuracy(top_k=1)):
+        m.update([lab], [pred])
+        assert abs(m.get()[1] - 1.0) < 1e-6, (type(m).__name__, m.get())
