@@ -258,6 +258,7 @@ def test_module_fit_with_column_labels_and_libsvm(tmp_path):
     backward squeezes the trailing class axis (a broadcast there
     silently produced (B, B, C) cotangents) and the classification
     metrics ravel labels like the reference."""
+    mx.random.seed(0)          # init must not depend on test order
     rng = np.random.RandomState(0)
     p = tmp_path / "train.libsvm"
     with open(p, "w") as f:
