@@ -31,7 +31,7 @@ class DataBatch:
     """(parity: mx.io.DataBatch)"""
 
     def __init__(self, data, label=None, pad=0, index=None,
-                 provide_data=None, provide_label=None):
+                 provide_data=None, provide_label=None, bucket_key=None):
         self.data = data if isinstance(data, (list, tuple)) else [data]
         self.label = label if label is None or isinstance(label, (list, tuple)) \
             else [label]
@@ -39,6 +39,8 @@ class DataBatch:
         self.index = index
         self.provide_data = provide_data
         self.provide_label = provide_label
+        if bucket_key is not None:  # BucketingModule routing (parity)
+            self.bucket_key = bucket_key
 
 
 class DataIter:
