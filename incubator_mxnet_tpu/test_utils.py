@@ -124,12 +124,89 @@ def check_numeric_gradient(fn: Callable, inputs: Sequence[NDArray],
                             names=(f"analytic_grad[{i}]", f"numeric_grad[{i}]"))
 
 
-def check_consistency(fn: Callable, inputs_np: Sequence[np.ndarray],
-                      ctx_list: Optional[Sequence[_ctx.Context]] = None,
-                      rtol=1e-4, atol=1e-5):
-    """Run ``fn`` with the same inputs on several contexts and assert outputs
-    match (parity: ``check_consistency`` — SURVEY.md §4 idiom 2; here the
-    backends are host devices vs the TPU chip)."""
+_DTYPE_TOL = {np.float16: (1e-2, 1e-2), np.float32: (1e-4, 1e-5),
+              np.float64: (1e-6, 1e-8)}
+
+
+def _check_consistency_sym(sym, ctx_list, rtol=None, atol=None):
+    """The reference calling form: ``check_consistency(sym, ctx_list)``
+    with ctx_list entries like ``{"ctx": mx.cpu(), "data": (2, 3),
+    "type_dict": {"data": np.float16}}`` — the fp16-vs-fp32 idiom of
+    tests/python/unittest/test_operator.py. One canonical set of
+    random inputs/params is generated in float64 and cast per entry;
+    outputs AND input gradients must agree within the loosest entry
+    dtype's tolerance."""
+    from .symbol.executor import Executor
+
+    rng = np.random.RandomState(0)
+    canonical: dict = {}
+    runs = []
+    worst = np.float64
+    for spec in ctx_list:
+        spec = dict(spec)
+        ctx = spec.pop("ctx", None)
+        type_dict = spec.pop("type_dict", {}) or {}
+        grad_req = spec.pop("grad_req", "write")
+        ex = Executor.simple_bind(sym, ctx, grad_req=grad_req, **spec)
+        for name, arr in ex.arg_dict.items():
+            if name not in canonical:
+                canonical[name] = rng.uniform(-1.0, 1.0, arr.shape)
+            elif canonical[name].shape != tuple(arr.shape):
+                raise MXNetError(
+                    f"check_consistency: arg {name!r} has shape "
+                    f"{tuple(arr.shape)} in one entry but "
+                    f"{canonical[name].shape} in another — entries "
+                    f"must agree on shapes")
+            dt = np.dtype(type_dict.get(name, np.float32))
+            if np.issubdtype(dt, np.floating) and                     np.dtype(worst).itemsize > dt.itemsize:
+                worst = dt.type
+            ex.arg_dict[name] = nd_array(
+                canonical[name].astype(dt if np.issubdtype(
+                    dt, np.floating) else np.float32))
+        out = ex.forward(is_train=(grad_req != "null"))
+        outs = [o.asnumpy().astype(np.float64) for o in out]
+        grads = {}
+        if grad_req != "null":
+            ex.backward()
+            grads = {n: g.asnumpy().astype(np.float64)
+                     for n, g in ex.grad_dict.items() if g is not None}
+        runs.append((ctx, type_dict, outs, grads))
+    trtol, tatol = _DTYPE_TOL.get(worst, (1e-4, 1e-5))
+    trtol = rtol if rtol is not None else trtol
+    tatol = atol if atol is not None else tatol
+    ref_ctx, _, ref_outs, ref_grads = runs[0]
+    for ctx, _, outs, grads in runs[1:]:
+        for r0, r1 in zip(ref_outs, outs):
+            assert_almost_equal(r0, r1, rtol=trtol, atol=tatol,
+                                names=(f"{ref_ctx}", f"{ctx}"))
+        for name in ref_grads:
+            if name in grads:
+                assert_almost_equal(ref_grads[name], grads[name],
+                                    rtol=trtol, atol=tatol,
+                                    names=(f"grad({name})@{ref_ctx}",
+                                           f"grad({name})@{ctx}"))
+    return [r[2] for r in runs]
+
+
+def check_consistency(fn, inputs_np=None,
+                      ctx_list: Optional[Sequence] = None,
+                      rtol=None, atol=None):
+    """Cross-context/dtype consistency (parity: ``check_consistency`` —
+    SURVEY.md §4 idiom 2). Two calling forms:
+
+    - reference form: ``check_consistency(sym, [{"ctx": ..., "data":
+      shape, "type_dict": {...}}, ...])`` — inputs synthesized once,
+      outputs and gradients compared across entries;
+    - function form: ``check_consistency(fn, inputs_np, ctx_list=
+      [Context, ...])`` — the same arrays run through ``fn`` per
+      context."""
+    from .symbol.symbol import Symbol
+
+    if isinstance(fn, Symbol):
+        return _check_consistency_sym(fn, inputs_np or ctx_list,
+                                      rtol=rtol, atol=atol)
+    rtol = 1e-4 if rtol is None else rtol
+    atol = 1e-5 if atol is None else atol
     if ctx_list is None:
         ctx_list = [_ctx.cpu(0), _ctx.tpu(0)]
     results = []

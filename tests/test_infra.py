@@ -230,3 +230,25 @@ def test_round5_optimizer_and_initializer_fills():
     import pytest as _pytest
     with _pytest.raises(MXNetError):
         ld("w", nd.zeros((3, 3)))
+
+
+def test_check_consistency_reference_form():
+    """check_consistency accepts the reference calling form (symbol +
+    ctx-dict list, the fp16-vs-fp32 test_operator idiom) comparing
+    forward outputs AND gradients at dtype-scaled tolerance."""
+    import numpy as np
+    from incubator_mxnet_tpu import test_utils as tu
+
+    sym = mx.sym.FullyConnected(mx.sym.Variable("data"), name="fc",
+                                num_hidden=4)
+    tu.check_consistency(sym, [
+        {"ctx": mx.cpu(), "data": (2, 3),
+         "type_dict": {"data": np.float32}},
+        {"ctx": mx.cpu(), "data": (2, 3),
+         "type_dict": {"data": np.float16}},
+    ])
+    with pytest.raises(mx.MXNetError, match="must agree on shapes"):
+        tu.check_consistency(sym, [
+            {"ctx": mx.cpu(), "data": (2, 3)},
+            {"ctx": mx.cpu(), "data": (2, 4)},
+        ])
