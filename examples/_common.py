@@ -1,0 +1,10 @@
+"""Shared example bootstrap: use the configured accelerator, fall back
+to CPU when its backend (e.g. a TPU tunnel) cannot initialize —
+imported for its side effect before the framework import."""
+
+import jax
+
+try:
+    jax.devices()
+except RuntimeError:
+    jax.config.update("jax_platforms", "cpu")

@@ -14,6 +14,8 @@ import argparse
 
 import numpy as np
 
+import _common  # noqa: F401  (accelerator-or-CPU bootstrap)
+
 import incubator_mxnet_tpu as mx
 from incubator_mxnet_tpu import metric as metric_mod
 from incubator_mxnet_tpu import nd, parallel

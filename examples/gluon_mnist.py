@@ -12,6 +12,8 @@ import os
 
 import numpy as np
 
+import _common  # noqa: F401  (accelerator-or-CPU bootstrap)
+
 import incubator_mxnet_tpu as mx
 from incubator_mxnet_tpu import nd, autograd, gluon
 from incubator_mxnet_tpu.models import LeNet

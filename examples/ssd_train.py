@@ -13,6 +13,8 @@ import argparse
 
 import numpy as np
 
+import _common  # noqa: F401  (accelerator-or-CPU bootstrap)
+
 import incubator_mxnet_tpu as mx
 from incubator_mxnet_tpu import nd, autograd, gluon
 from incubator_mxnet_tpu.models.ssd import ssd_300

@@ -124,10 +124,6 @@ def check_numeric_gradient(fn: Callable, inputs: Sequence[NDArray],
                             names=(f"analytic_grad[{i}]", f"numeric_grad[{i}]"))
 
 
-_DTYPE_TOL = {np.float16: (1e-2, 1e-2), np.float32: (1e-4, 1e-5),
-              np.float64: (1e-6, 1e-8)}
-
-
 def _check_consistency_sym(sym, ctx_list, rtol=None, atol=None):
     """The reference calling form: ``check_consistency(sym, ctx_list)``
     with ctx_list entries like ``{"ctx": mx.cpu(), "data": (2, 3),
@@ -138,10 +134,15 @@ def _check_consistency_sym(sym, ctx_list, rtol=None, atol=None):
     dtype's tolerance."""
     from .symbol.executor import Executor
 
+    if not ctx_list:
+        raise MXNetError(
+            "check_consistency(sym, ctx_list): ctx_list must be a "
+            "non-empty list of dicts like {'ctx': mx.cpu(), 'data': "
+            "(2, 3), 'type_dict': {'data': np.float16}}")
     rng = np.random.RandomState(0)
     canonical: dict = {}
     runs = []
-    worst = np.float64
+    worst = np.dtype(np.float64)
     for spec in ctx_list:
         spec = dict(spec)
         ctx = spec.pop("ctx", None)
@@ -149,31 +150,40 @@ def _check_consistency_sym(sym, ctx_list, rtol=None, atol=None):
         grad_req = spec.pop("grad_req", "write")
         ex = Executor.simple_bind(sym, ctx, grad_req=grad_req, **spec)
         for name, arr in ex.arg_dict.items():
+            dt = np.dtype(type_dict.get(name, np.float32))
             if name not in canonical:
-                canonical[name] = rng.uniform(-1.0, 1.0, arr.shape)
+                canonical[name] = (
+                    rng.randint(0, 4, arr.shape).astype(np.int64)
+                    if np.issubdtype(dt, np.integer)
+                    else rng.uniform(-1.0, 1.0, arr.shape))
             elif canonical[name].shape != tuple(arr.shape):
                 raise MXNetError(
                     f"check_consistency: arg {name!r} has shape "
                     f"{tuple(arr.shape)} in one entry but "
                     f"{canonical[name].shape} in another — entries "
                     f"must agree on shapes")
-            dt = np.dtype(type_dict.get(name, np.float32))
-            if np.issubdtype(dt, np.floating) and                     np.dtype(worst).itemsize > dt.itemsize:
-                worst = dt.type
-            ex.arg_dict[name] = nd_array(
-                canonical[name].astype(dt if np.issubdtype(
-                    dt, np.floating) else np.float32))
+            if np.issubdtype(dt, np.floating) and \
+                    worst.itemsize > dt.itemsize:
+                worst = dt
+            ex.arg_dict[name] = nd_array(canonical[name].astype(dt),
+                                         ctx=ctx)
         out = ex.forward(is_train=(grad_req != "null"))
-        outs = [o.asnumpy().astype(np.float64) for o in out]
+        raw = [o.asnumpy() for o in out]
+        outs = [r.astype(np.float64) for r in raw]
         grads = {}
         if grad_req != "null":
-            ex.backward()
+            # synthesized unit head gradients (in each output's own
+            # dtype) make multi-output symbols comparable (the
+            # reference projects with random heads)
+            ex.backward([nd_array(np.ones_like(r)) for r in raw])
             grads = {n: g.asnumpy().astype(np.float64)
-                     for n, g in ex.grad_dict.items() if g is not None}
+                     for n, g in ex.grad_dict.items()
+                     if g is not None
+                     and np.dtype(getattr(g._data, "dtype", np.float32))
+                     .kind == "f"}  # int args carry jax float0 tangents
         runs.append((ctx, type_dict, outs, grads))
-    trtol, tatol = _DTYPE_TOL.get(worst, (1e-4, 1e-5))
-    trtol = rtol if rtol is not None else trtol
-    tatol = atol if atol is not None else tatol
+    trtol = rtol if rtol is not None else _DTYPE_RTOL.get(worst, 1e-4)
+    tatol = atol if atol is not None else _DTYPE_ATOL.get(worst, 1e-5)
     ref_ctx, _, ref_outs, ref_grads = runs[0]
     for ctx, _, outs, grads in runs[1:]:
         for r0, r1 in zip(ref_outs, outs):

@@ -252,3 +252,24 @@ def test_check_consistency_reference_form():
             {"ctx": mx.cpu(), "data": (2, 3)},
             {"ctx": mx.cpu(), "data": (2, 4)},
         ])
+
+
+def test_check_consistency_multi_output_and_int_inputs():
+    """Reference-form check_consistency handles multi-output symbols
+    (synthesized unit head grads) and integer-typed inputs (valid
+    indices synthesized; float0 tangents excluded from comparison)."""
+    import numpy as np
+    from incubator_mxnet_tpu import test_utils as tu
+
+    ms = mx.sym.split(mx.sym.Variable("data"), num_outputs=2, axis=1)
+    tu.check_consistency(ms, [
+        {"ctx": mx.cpu(), "data": (2, 4)},
+        {"ctx": mx.cpu(), "data": (2, 4),
+         "type_dict": {"data": np.float16}},
+    ])
+    es = mx.sym.Embedding(mx.sym.Variable("data"), name="emb",
+                          input_dim=4, output_dim=3)
+    tu.check_consistency(es, [
+        {"ctx": mx.cpu(), "data": (5,), "type_dict": {"data": np.int32}},
+        {"ctx": mx.cpu(), "data": (5,), "type_dict": {"data": np.int32}},
+    ])
