@@ -35,6 +35,12 @@ stage_unit() {
   python -m pytest tests/ -q
 }
 
+stage_stepbench() {
+  echo "== stepbench: fused-step regression guard (steady-state compile"
+  echo "              count must stay at 1 per (shape, dtype) signature)"
+  JAX_PLATFORMS=cpu python tools/step_bench.py --smoke
+}
+
 stage_entry() {
   echo "== entry: driver entry points (single-chip compile is driver-side;"
   echo "          here the 8-device multichip dryrun must pass)"
@@ -48,7 +54,7 @@ ge.dryrun_multichip(8)"
 }
 
 stages=("$@")
-[ ${#stages[@]} -eq 0 ] && stages=(sanity native unit entry)
+[ ${#stages[@]} -eq 0 ] && stages=(sanity native unit stepbench entry)
 for s in "${stages[@]}"; do
   "stage_$s"
 done

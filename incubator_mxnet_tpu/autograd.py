@@ -259,6 +259,9 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             gbuf._data = gbuf._data + total.astype(gbuf.dtype)
         else:  # write
             gbuf._data = total.astype(gbuf.dtype)
+        # Trainer's stale-grad contract: a grad buffer backward has
+        # refilled is FRESH; Trainer.step marks it stale after applying
+        gbuf._fresh = True
 
     if not retain_graph:
         for node in order:

@@ -124,6 +124,9 @@ class Parameter:
 
     def _init_grad(self):
         self._grad = NDArray(jnp.zeros(self._data.shape, self._data.dtype))
+        # a freshly allocated grad buffer is STALE until backward fills
+        # it (reference _fresh_grad contract; Trainer warns/skips)
+        self._grad._fresh = False
         autograd.mark_variables([self._data], [self._grad], self._grad_req)
 
     def _finish_deferred_init(self):

@@ -6,17 +6,26 @@ updates over a Block's parameters; the reference's update_on_kvstore logic
 (server-side optimizer) collapses into post-reduction local updates, which
 is mathematically identical for sync training (SURVEY.md §3.2).
 
-The eager ``step()`` here is the correctness path; for TPU throughput use
-``parallel.SPMDTrainer`` which fuses fwd+bwd+psum+update into one jitted
-program (SURVEY.md §3.2: "the whole step becomes ONE jitted SPMD function").
+``step()``'s optimizer application runs FUSED by default: all trainable
+parameters are grouped by (dtype, storage type, hyperparameter signature)
+and each group updates in ONE jitted, donated call (optimizer/fused.py) —
+the per-parameter dispatch loop the reference's op-bulking engine existed
+to kill. Gradient reduction is likewise bucketed: one pushpull per
+dtype bucket instead of one per parameter. ``fuse_step=False`` (or
+optimizers with per-step host state) restores the eager per-param loop;
+for TPU throughput use ``parallel.SPMDTrainer`` which additionally fuses
+fwd+bwd+psum into the same program (SURVEY.md §3.2).
 """
 
 from __future__ import annotations
 
+import warnings
 from typing import Dict, List, Optional, Union
 
+import jax.numpy as jnp
+
 from .. import optimizer as opt_mod
-from ..base import MXNetError
+from ..base import MXNetError, getenv_bool, getenv_int
 from ..kvstore import create as kv_create
 from .parameter import Parameter, ParameterDict
 
@@ -26,7 +35,7 @@ __all__ = ["Trainer"]
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
-                 update_on_kvstore=None):
+                 update_on_kvstore=None, fuse_step=None):
         if isinstance(params, (dict, ParameterDict)):
             param_list = [params[k] for k in sorted(params.keys())] \
                 if isinstance(params, dict) else list(params.values())
@@ -50,6 +59,12 @@ class Trainer:
             **optimizer_params)
         self._updaters = [opt_mod.get_updater(self._optimizer)]
         self._scale = self._optimizer.rescale_grad
+        if fuse_step is None:
+            fuse_step = getenv_bool("MXTPU_FUSED_STEP", True)
+        self._fuse_step = fuse_step and getattr(
+            self._optimizer, "fusable", True)
+        self._fused = opt_mod.FusedApplier(self._optimizer) \
+            if self._fuse_step else None
 
         self._compression_params = compression_params
         self._kvstore = None
@@ -98,12 +113,71 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
+        work = []
         for i, p in enumerate(self._params):
             if p.grad_req == "null":
                 continue
             grads = p.list_grad()
             if self._kvstore.num_workers > 1 or len(grads) > 1:
-                self._kvstore.pushpull(i, grads, out=grads)
+                work.append((i, grads))
+        if not work:
+            return
+        from ..ndarray.sparse import RowSparseNDArray
+        bucketable = [(i, g) for i, g in work
+                      if len(g) == 1 and
+                      not isinstance(g[0], RowSparseNDArray)]
+        rest = [(i, g) for i, g in work
+                if len(g) != 1 or isinstance(g[0], RowSparseNDArray)]
+        if self._fuse_step and len(bucketable) > 1:
+            self._bucketed_pushpull(bucketable)
+        else:
+            rest = work
+        for i, grads in rest:
+            self._kvstore.pushpull(i, grads, out=grads)
+
+    def _bucketed_pushpull(self, work):
+        """One pushpull per (dtype, <=MXTPU_GRAD_BUCKET_MB) bucket instead
+        of one per parameter — the eager analogue of the reference's
+        gradient bulking (kvstore comm buckets). Bucket keys encode the
+        member composition, so dist-mode compression residuals stay
+        coherent per bucket while the trainable set is stable, and start
+        a FRESH residual stream if it changes (e.g. a layer is frozen
+        mid-training) instead of applying a stale residual to a
+        differently-shaped bucket."""
+        import zlib
+        from ..ndarray import NDArray
+        limit = getenv_int("MXTPU_GRAD_BUCKET_MB", 32) * (1 << 20)
+        by_dtype: Dict = {}
+        for i, grads in work:
+            by_dtype.setdefault(str(grads[0].dtype), []).append(
+                (i, grads[0]))
+        for dt, members in by_dtype.items():
+            start = 0
+            bucket_id = 0
+            while start < len(members):
+                end, nbytes = start, 0
+                while end < len(members):
+                    sz = members[end][1].size * \
+                        members[end][1]._data.dtype.itemsize
+                    if end > start and nbytes + sz > limit:
+                        break
+                    nbytes += sz
+                    end += 1
+                chunk = members[start:end]
+                flat = jnp.concatenate(
+                    [g._data.ravel() for _, g in chunk])
+                bucket = NDArray(flat)
+                comp = zlib.crc32(",".join(
+                    f"{i}:{g.size}" for i, g in chunk).encode())
+                key = f"__grad_bucket_{dt}_{bucket_id}_{comp:08x}"
+                self._kvstore.pushpull(key, bucket, out=bucket)
+                off = 0
+                for _, g in chunk:
+                    n = g.size
+                    g._data = bucket._data[off:off + n].reshape(g.shape)
+                    off += n
+                start = end
+                bucket_id += 1
 
     def allreduce_grads(self):
         self._init_kvstore()
@@ -111,16 +185,41 @@ class Trainer:
 
     def _update(self, ignore_stale_grad=False):
         updater = self._updaters[0]
+        fused_items = []
+        touched = []
         for i, p in enumerate(self._params):
             if p.grad_req == "null":
                 continue
             grad = p.grad()
+            if not getattr(grad, "_fresh", True):
+                # backward has not refilled this grad since the last step
+                # (reference Trainer's _fresh_grad contract)
+                if ignore_stale_grad:
+                    continue
+                warnings.warn(
+                    f"Gradient of Parameter `{p.name}` has not been "
+                    f"updated by backward since last `step`; the stale "
+                    f"gradient is applied anyway. Call step with "
+                    f"ignore_stale_grad=True to skip such parameters.",
+                    UserWarning, stacklevel=3)
+            touched.append(p)
             if getattr(p, "_grad_stype", "default") == "row_sparse":
                 # sparse-embedding contract (SURVEY.md §2.3 last row):
-                # convert to active rows so the optimizer touches only them
+                # convert to active rows so the optimizer touches only
+                # them — the index set changes shape per step, so this
+                # stays on the eager path even when fusing
                 from ..ndarray import sparse as _sparse
                 grad = _sparse.cast_storage(grad, "row_sparse")
-            updater(i, grad, p.data())
+                updater(i, grad, p.data())
+            elif self._fused is not None:
+                fused_items.append((i, p, grad))
+            else:
+                updater(i, grad, p.data())
+        if fused_items:
+            self._fused.apply(fused_items, updater)
+        for p in touched:
+            if p._grad is not None:
+                p._grad._fresh = False
 
     def update(self, batch_size, ignore_stale_grad=False):
         self._init_kvstore()

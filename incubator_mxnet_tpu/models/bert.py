@@ -249,7 +249,13 @@ class BERTModel(HybridBlock):
         # is tiny, is promoted
         cls = x._op("slice_axis", axis=1, begin=0, end=1).reshape(
             (B, self._units)).astype("float32")
-        pooled = self.pooler(cls)
+        from ..parallel.spmd import constrain
+        # batch-pin the pooled stream: the pooler Dense may be
+        # fsdp-sharded on out-features, and without this the partitioner
+        # propagates a units-over-fsdp layout into the tiny [CLS] path,
+        # paying a full rematerialization to reconcile it with the
+        # batch-sharded NSP head (the dp>=4 dryrun warning)
+        pooled = constrain(self.pooler(cls), ("dp", "fsdp"), None)
         return x, pooled
 
 
@@ -293,9 +299,17 @@ class BERTForPretraining(HybridBlock):
         # head runs in f32 (it is M=76 tokens — cheap); astype's VJP casts
         # the cotangent back to the compute dtype, so the f32 head cannot
         # poison the encoder backward stream
-        h = self.mlm_transform(gathered.astype("float32"))
+        from ..parallel.spmd import constrain
+        # keep the (B, M, units) head stream batch-sharded: mlm_transform's
+        # weight is fsdp-sharded (out-features), and unconstrained its
+        # output inherits a units-over-fsdp layout that the LN backward can
+        # only undo with a full rematerialization on dp>=4 meshes — the
+        # constraint makes the partitioner all-gather the small weight
+        # instead of resharding the activation
+        h = constrain(self.mlm_transform(gathered.astype("float32")),
+                      ("dp", "fsdp"), None, None)
         h = F.gelu(h)
-        h = self.mlm_ln(h)
+        h = constrain(self.mlm_ln(h), ("dp", "fsdp"), None, None)
         embed_w = self.bert.word_embed.weight.data()  # (vocab, units)
         # decoder matmul runs in the model compute dtype: with bf16 this
         # keeps the (B, M, vocab) logits half-width and the MXU at full

@@ -6,3 +6,5 @@ from .optimizer import (Optimizer, SGD, NAG, Adam, AdamW, RMSProp, Ftrl,
                         register, get_updater)
 from . import lr_scheduler
 from .lr_scheduler import LRScheduler
+from . import fused
+from .fused import FusedApplier, apply_updates

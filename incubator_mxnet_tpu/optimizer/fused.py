@@ -1,0 +1,240 @@
+"""Fused whole-tree optimizer step (multi-tensor apply).
+
+The reference kills per-parameter launch overhead with engine op bulking
+plus hand-fused multi-tensor kernels (`src/operator/contrib/
+preloaded_multi_sgd-inl.h`, the multi_* family in optimizer_op.cc —
+file-level citations, SURVEY.md caveat). The TPU-native translation is to
+put the WHOLE update into one XLA program: group trainable parameters by
+(dtype, storage type, hyperparameter signature) and apply each group's
+update as ONE jitted, donated call over the stacked pytree of
+(weights, grads, optimizer states).
+
+Two consumers share the same functional core (``apply_updates``):
+
+  - ``gluon.Trainer`` jits it per group via ``FusedApplier`` — the eager
+    per-parameter Python loop (one un-jitted dispatch per param per step)
+    collapses to one compiled call per group per step.
+  - ``parallel.SPMDTrainer`` calls it INSIDE its single jitted train step,
+    so fwd+bwd+reduce+update stay one XLA program.
+
+The imperative ``Optimizer`` subclasses are reused unchanged: inside the
+trace each parameter's update runs through ``update_multi_precision`` on
+NDArray views of the traced arrays, and XLA fuses the resulting
+elementwise chains across parameters. Step count, learning rate, and
+gradient rescale ride as traced scalars (``_traced_t`` / ``_traced_lr`` /
+a temporarily swapped ``rescale_grad``) so schedules and Adam/LAMB bias
+correction advance without recompiling.
+
+What does NOT fuse (falls back to the eager per-param path):
+
+  - optimizers with per-step host-side state (``fusable = False``:
+    Nadam's ``m_schedule``, SGLD's fresh host RNG key per update) —
+    baking those into a trace would freeze them at their step-1 values;
+  - ``row_sparse``-gradient parameters — their active-row index sets
+    change shape every step, which would retrace per step.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import jax.tree_util as jtu
+import numpy as np
+
+from ..base import getenv_bool
+from ..ndarray import NDArray
+
+__all__ = ["apply_updates", "FusedApplier", "hyperparam_signature"]
+
+
+def _is_nd(x):
+    return isinstance(x, NDArray)
+
+
+def apply_updates(optimizer, indices, weight_vals, grad_vals, states,
+                  t, lr, rescale_grad=None):
+    """Functional whole-tree optimizer application (call under a trace).
+
+    Parameters
+    ----------
+    optimizer : Optimizer — imperative optimizer, reused as the update rule.
+    indices : sequence of parameter indices (the optimizer's state keys).
+    weight_vals / grad_vals : sequences of jax arrays, aligned to indices.
+    states : sequence of optimizer-state pytrees with jax-array leaves.
+    t : traced step count — scalar, or a (len(indices),) vector for
+        per-parameter counts (Adam/LAMB bias correction).
+    lr : traced base learning rate (per-param multipliers apply inside).
+    rescale_grad : optional traced gradient rescale; when given it
+        temporarily replaces ``optimizer.rescale_grad`` so batch-size
+        changes do not force a retrace.
+
+    Returns ``(new_weights, new_states)`` — tuples aligned to indices,
+    with jax-array leaves. The optimizer's host-side counters are touched
+    at trace time only; callers own their true values.
+    """
+    new_weights: List = []
+    new_states: List = []
+    saved_rescale = optimizer.rescale_grad
+    optimizer._traced_lr = lr
+    if rescale_grad is not None:
+        optimizer.rescale_grad = rescale_grad
+    t_is_vec = getattr(t, "ndim", 0) >= 1
+    try:
+        for slot, (pi, w, g) in enumerate(
+                zip(indices, weight_vals, grad_vals)):
+            w_nd = NDArray(w)
+            g_nd = NDArray(g)
+            st = jtu.tree_map(NDArray, states[slot])
+            optimizer._traced_t = t[slot] if t_is_vec else t
+            optimizer.update_multi_precision(pi, w_nd, g_nd, st)
+            # pin output dtypes to the input dtypes: the traced t/lr
+            # scalars are f32 arrays, and jnp promotion would otherwise
+            # widen low-precision weights/state (breaking donation buffer
+            # reuse and the group's dtype key). Low-precision groups thus
+            # compute scalar-touched arithmetic in f32 and round back to
+            # the storage dtype — documented in docs/PERF_NOTES.md.
+            new_w = w_nd._data
+            if new_w.dtype != w.dtype:
+                new_w = new_w.astype(w.dtype)
+            new_weights.append(new_w)
+            new_states.append(jtu.tree_map(
+                lambda old, new: (
+                    new._data.astype(old.dtype)
+                    if _is_nd(new) and new._data.dtype != old.dtype
+                    else (new._data if _is_nd(new) else new)),
+                states[slot], st))
+    finally:
+        optimizer._traced_t = optimizer._traced_lr = None
+        optimizer.rescale_grad = saved_rescale
+    return tuple(new_weights), tuple(new_states)
+
+
+def hyperparam_signature(optimizer) -> Tuple:
+    """Hashable signature of every host scalar an update trace bakes in.
+
+    A fused trace captures the optimizer's scalar attributes (momentum,
+    betas, wd, clip_gradient, ...) as constants; if any of them changes the
+    jitted group function must be rebuilt. Step count, learning rate and
+    rescale_grad are excluded — they ride as traced inputs.
+    """
+    skip = {"num_update", "lr", "rescale_grad", "_traced_t", "_traced_lr"}
+    items = []
+    for k, v in sorted(vars(optimizer).items()):
+        if k in skip:
+            continue
+        if isinstance(v, (int, float, bool, str)) or v is None:
+            items.append((k, v))
+    return (type(optimizer).__name__, tuple(items))
+
+
+class FusedApplier:
+    """Whole-tree fused apply for ``Trainer``'s eager step.
+
+    Groups (index, param, grad) triples by (dtype, grad storage type),
+    and runs each group through ONE jitted call of ``apply_updates`` with
+    the weights and optimizer-state leaves donated. The jit cache is keyed
+    by (group key, member indices, hyperparameter signature, per-param
+    lr/wd multipliers, state treedef) — any change retraces exactly once,
+    steady state re-dispatches the cached executable.
+    """
+
+    def __init__(self, optimizer, donate: Optional[bool] = None):
+        self.optimizer = optimizer
+        if donate is None:
+            # donation is a no-op (plus a warning) on the CPU backend
+            donate = jax.default_backend() != "cpu" or \
+                getenv_bool("MXTPU_FUSED_DONATE", False)
+        self.donate = donate
+        self._jits: Dict = {}
+        self.trace_count = 0      # executions of a traced body (compiles)
+        self.call_count = 0       # fused group dispatches
+
+    # ------------------------------------------------------------------ #
+    def supported(self) -> bool:
+        return getattr(self.optimizer, "fusable", True)
+
+    def apply(self, items: Sequence, updater) -> None:
+        """Apply one fused update to ``items`` = [(index, param, grad)].
+
+        ``updater`` is the Trainer's ``Updater`` — optimizer state is
+        created into / read from ``updater.states`` so eager and fused
+        paths share one serializable state store (save_states parity).
+        """
+        opt = self.optimizer
+        groups: Dict = {}
+        for i, p, g in items:
+            if i not in updater.states:
+                updater.states[i] = opt.create_state_multi_precision(
+                    i, p.data())
+            gkey = (str(p.data().dtype),
+                    getattr(p, "_grad_stype", "default"))
+            groups.setdefault(gkey, []).append((i, p, g))
+        # commit the step's counters BEFORE dispatching: the eager path
+        # bumps _update_count before reading the lr, so the scheduler must
+        # see the post-bump num_update here too (scheduler(t), not t-1).
+        # Trace-time bumps inside update() land on already-bumped counts
+        # and are overwritten below, keeping the host counters exact.
+        counts = opt._index_update_count
+        new_counts = {i: counts.get(i, 0) + 1 for i, _, _ in items}
+        counts.update(new_counts)
+        opt.num_update = max(counts.values(), default=opt.num_update)
+        for gkey, group in groups.items():
+            self._apply_group(gkey, group, updater)
+        counts.update(new_counts)
+        opt.num_update = max(counts.values(), default=opt.num_update)
+
+    # ------------------------------------------------------------------ #
+    def _apply_group(self, gkey, group, updater) -> None:
+        opt = self.optimizer
+        indices = tuple(i for i, _, _ in group)
+        states = [updater.states[i] for i in indices]
+        state_leaves, state_tree = jtu.tree_flatten(
+            jtu.tree_map(lambda s: s._data if _is_nd(s) else s,
+                         tuple(states), is_leaf=_is_nd))
+        mults = tuple((float(getattr(p, "lr_mult", 1.0)),
+                       float(getattr(p, "wd_mult", 1.0)))
+                      for _, p, _ in group)
+        sig = (gkey, indices, state_tree,
+               hyperparam_signature(opt), mults)
+        fn = self._jits.get(sig)
+        if fn is None:
+            fn = self._build(indices, state_tree)
+            self._jits[sig] = fn
+
+        weight_vals = tuple(p.data()._data for _, p, _ in group)
+        grad_vals = tuple(g._data for _, _, g in group)
+        # apply() already committed this step's counts: use them directly
+        t_vec = np.asarray(
+            [opt._index_update_count.get(i, 1) for i in indices],
+            np.float32)
+        lr = np.float32(float(opt.learning_rate))
+        rescale = np.float32(float(opt.rescale_grad))
+
+        new_ws, new_state_leaves = fn(
+            weight_vals, grad_vals, tuple(state_leaves), t_vec, lr, rescale)
+        self.call_count += 1
+
+        for (_, p, _), new_w in zip(group, new_ws):
+            p.data()._data = new_w
+        new_states = jtu.tree_unflatten(state_tree, list(new_state_leaves))
+        jtu.tree_map(
+            lambda old, new: setattr(old, "_data", new) if _is_nd(old)
+            else None,
+            tuple(states), new_states, is_leaf=_is_nd)
+
+    def _build(self, indices, state_tree):
+        opt = self.optimizer
+        applier = self
+
+        def fused(weight_vals, grad_vals, state_leaves, t_vec, lr, rescale):
+            applier.trace_count += 1  # python body runs at trace time only
+            states = jtu.tree_unflatten(state_tree, list(state_leaves))
+            new_ws, new_states = apply_updates(
+                opt, indices, weight_vals, grad_vals, states, t_vec, lr,
+                rescale_grad=rescale)
+            return new_ws, tuple(jtu.tree_leaves(new_states))
+
+        donate = (0, 2) if self.donate else ()
+        return jax.jit(fused, donate_argnums=donate)

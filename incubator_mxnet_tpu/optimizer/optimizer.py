@@ -65,6 +65,12 @@ class Optimizer:
     """Base optimizer (parity surface: rescale_grad, clip_gradient, lr/wd
     multipliers, idx-keyed state, set_learning_rate)."""
 
+    #: update() is a pure function of (weight, grad, state, traced t/lr) —
+    #: safe to bake into a jitted whole-tree step (optimizer/fused.py).
+    #: Subclasses with per-step HOST state (schedule caches, host RNG
+    #: draws) must set this False to stay on the eager per-param path.
+    fusable = True
+
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
                  clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
                  multi_precision=False, param_dict=None, **kwargs):
@@ -424,9 +430,13 @@ class LARS(Optimizer):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
         sums = invoke_by_name("multi_sum_sq", [weight, grad])
+        # lr arrives as a TRACED scalar inside a fused/jitted step —
+        # build the (1,) vectors with jnp so the trace stays pure
+        lr_vec = NDArray(jnp.reshape(jnp.asarray(lr, jnp.float32), (1,)))
+        wd_vec = NDArray(jnp.reshape(jnp.asarray(wd, jnp.float32), (1,)))
         scaled = invoke_by_name(
-            "multi_lars", nd_array([lr]), sums[0:1], sums[1:2],
-            nd_array([wd]), eta=self.eta, eps=self.epsilon,
+            "multi_lars", lr_vec, sums[0:1], sums[1:2],
+            wd_vec, eta=self.eta, eps=self.epsilon,
             rescale_grad=self.rescale_grad)
         lr_eff = scaled._data[0]  # jnp scalar: trace-safe under jit
         if state is None:
@@ -612,6 +622,10 @@ class Nadam(Optimizer):
     """Nesterov Adam (reference: optimizer.py Nadam; Dozat 2016
     schedule with the 0.96^(t*schedule_decay) momentum cache)."""
 
+    # m_schedule is host state mutated every update — a fused trace
+    # would freeze it at its trace-time value
+    fusable = False
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, schedule_decay=0.004, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -692,6 +706,10 @@ class SGLD(Optimizer):
     """Stochastic Gradient Langevin Dynamics (reference: optimizer.py
     SGLD): half-gradient step plus N(0, lr) noise for posterior
     sampling. Noise rides the framework's seeded key stream."""
+
+    # draws a fresh HOST key per update — a fused trace would bake one
+    # key and replay identical noise every step
+    fusable = False
 
     def __init__(self, learning_rate=0.01, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)

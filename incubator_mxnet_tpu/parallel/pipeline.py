@@ -26,10 +26,10 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 import jax.tree_util as jtu
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..base import MXNetError
+from ..base import MXNetError, shard_map
 
 __all__ = ["pipeline_apply", "stack_stage_params"]
 

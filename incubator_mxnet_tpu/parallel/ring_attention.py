@@ -21,7 +21,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from ..base import MXNetError
+from ..base import MXNetError, pcast_varying, shard_map
 
 __all__ = ["ring_self_attention", "ring_attention_block",
            "ring_flash_attention", "ring_flash_attention_block",
@@ -101,7 +101,7 @@ def ring_attention_block(q, k, v, valid_length=None,
     cast_axes = (axis_name,) + tuple(a for a in vary_axes
                                      if a and a != axis_name)
     acc, row_max, row_sum = jax.tree_util.tree_map(
-        lambda x: lax.pcast(x, cast_axes, to="varying"),
+        lambda x: pcast_varying(x, cast_axes),
         (acc, row_max, row_sum))
     qf = q  # input dtype into the block einsums (f32 accumulation inside)
 
@@ -180,8 +180,8 @@ def _ring_shard_map(make_block_fn, q, k, v, mesh, axis_name, batch_axis,
     if valid_length is not None:
         in_specs.append(PartitionSpec(b_entry))
         args.append(valid_length)
-    mapped = jax.shard_map(block_fn, mesh=mesh,
-                           in_specs=tuple(in_specs), out_specs=spec)
+    mapped = shard_map(block_fn, mesh=mesh,
+                       in_specs=tuple(in_specs), out_specs=spec)
     return mapped(*args)
 
 

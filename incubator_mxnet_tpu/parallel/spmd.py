@@ -63,7 +63,16 @@ def constrain(x, *spec, mesh: Optional[Mesh] = None):
     and tp-hinted params (VERDICT r2 weak #3). Each ``spec`` entry is an
     axis name, a tuple of axis names, or None; axes absent from the mesh
     or of size 1 are dropped, and with no mesh (active or given) the
-    call returns ``x`` unchanged — so model code is mesh-agnostic."""
+    call returns ``x`` unchanged — so model code is mesh-agnostic.
+
+    NOTE: a combined batch entry over {dp, fsdp} is CANONICALIZED to
+    ``("fsdp", "dp")`` regardless of the order the caller wrote — the
+    batch dim is semantically "sharded over both", and fsdp-major is the
+    natural tile order of every fsdp-derived NamedSharding, so a single
+    canonical order here keeps batch constraints permutation-compatible
+    with the fsdp all-gather (the dp>=4 full-remat fix, PERF_NOTES
+    round 6). Callers needing dp-major tiles for this axis pair must
+    call ``lax.with_sharding_constraint`` directly."""
     if mesh is None:
         mesh = _ACTIVE_MESH.get()
     if mesh is None:
@@ -74,6 +83,8 @@ def constrain(x, *spec, mesh: Optional[Mesh] = None):
             ((e,) if e is not None else ())
         kept = tuple(a for a in axes
                      if a in mesh.shape and mesh.shape[a] > 1)
+        if set(kept) == {"dp", "fsdp"}:
+            kept = ("fsdp", "dp")
         entries.append(kept if len(kept) > 1 else
                        (kept[0] if kept else None))
     if all(e is None for e in entries):
@@ -305,30 +316,19 @@ class SPMDTrainer:
                 pure_loss, argnums=0, has_aux=True)(
                     train_vals, frozen_vals, key, *batch)
             opt_state = jtu.tree_unflatten(opt_tree, opt_leaves)
-            new_train = []
-            new_states = []
-            # the step counter and lr arrive as traced scalars so schedules
-            # and Adam/LAMB bias correction advance without recompiling
-            optimizer._traced_t, optimizer._traced_lr = t, lr
-            try:
-                for slot, (pi, w, g) in enumerate(
-                        zip(train_idx, train_vals, grads)):
-                    w_nd = NDArray(w)
-                    g_nd = NDArray(g)
-                    st = jtu.tree_map(NDArray, opt_state[slot])
-                    optimizer.update_multi_precision(pi, w_nd, g_nd, st)
-                    new_train.append(w_nd._data)
-                    new_states.append(jtu.tree_map(
-                        lambda s: s._data if isinstance(s, NDArray) else s, st,
-                        is_leaf=lambda s: isinstance(s, NDArray)))
-            finally:
-                optimizer._traced_t = optimizer._traced_lr = None
+            # whole-tree fused apply (optimizer/fused.py — shared with the
+            # eager Trainer's jitted group path); the step counter and lr
+            # arrive as traced scalars so schedules and Adam/LAMB bias
+            # correction advance without recompiling
+            from ..optimizer.fused import apply_updates
+            new_train, new_states = apply_updates(
+                optimizer, train_idx, train_vals, grads, opt_state, t, lr)
             return tuple(new_train), tuple(aux), \
                 tuple(jtu.tree_leaves(tuple(new_states))), loss_val
 
         mesh = self.mesh
         repl = NamedSharding(mesh, PartitionSpec())
-        batch_sh = NamedSharding(mesh, PartitionSpec(("dp", "fsdp")))
+        batch_sh = NamedSharding(mesh, PartitionSpec(("fsdp", "dp")))
         train_sh = tuple(
             _param_sharding(params[i], mesh, self.sharding_mode)
             for i in train_idx)
@@ -395,7 +395,7 @@ class SPMDTrainer:
             # be resharded cross-process
             key = _host_np.asarray(key)
             batch_sh = NamedSharding(self.mesh,
-                                     PartitionSpec(("dp", "fsdp")))
+                                     PartitionSpec(("fsdp", "dp")))
             def _globalize(b):
                 if len(b.devices()) > 1:
                     return b

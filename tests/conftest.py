@@ -18,3 +18,10 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-minute tests outside the tier-1 budget "
+        "(run with `pytest -m slow` or ci/run.sh's full stage_unit)")

@@ -161,6 +161,7 @@ def test_box_nms_symbolic():
     assert (out[0, :, 1] > 0).sum() == 1
 
 
+@pytest.mark.slow
 def test_ssd_end_to_end():
     from incubator_mxnet_tpu.models.ssd import ssd_300
     from incubator_mxnet_tpu import autograd, gluon
@@ -235,6 +236,7 @@ def test_ps_roi_align():
                 assert out[0, c, i, j] == pytest.approx(c * 4 + i * 2 + j)
 
 
+@pytest.mark.slow
 def test_faster_rcnn_forward_and_grad():
     """Faster R-CNN end-to-end: fixed-shape rois, valid coordinates,
     gradients reach the backbone through ROIAlign + Proposal."""

@@ -1,0 +1,292 @@
+"""Fused whole-tree optimizer step: equivalence + compile-count contract.
+
+The fused path (optimizer/fused.py) must be numerically interchangeable
+with the eager per-parameter loop it replaces — bit-exact for SGD (the
+traced computation is identical; XLA fusion may reorder f32 rounding, so
+"bit-exact" is asserted at 1e-9) and within documented f32 tolerance for
+Adam/LAMB — and must compile exactly once per (shape, dtype, hyperparam)
+group, never in steady state.
+"""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.gluon import nn
+
+
+def _build_net(seed=0, dtype="float32"):
+    mx.random.seed(seed)
+    net = nn.Sequential()
+    net.add(nn.Dense(16, in_units=8, activation="relu", dtype=dtype),
+            nn.Dense(4, in_units=16, dtype=dtype))
+    net.initialize()
+    return net
+
+
+def _train(fuse, opt, opt_params, steps=4, seed=0, dtype="float32"):
+    net = _build_net(seed, dtype)
+    tr = gluon.Trainer(net.collect_params(), opt, opt_params,
+                       fuse_step=fuse)
+    rng = np.random.RandomState(1)
+    losses = []
+    for _ in range(steps):
+        x = nd.array(rng.randn(8, 8).astype(np.float32)).astype(dtype)
+        y = nd.array(rng.randn(8, 4).astype(np.float32)).astype(dtype)
+        with autograd.record():
+            loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        tr.step(8)
+        losses.append(float(loss.asnumpy()))
+    weights = [p.data().asnumpy().astype(np.float64)
+               for p in net.collect_params().values()]
+    return losses, weights, tr
+
+
+@pytest.mark.parametrize("opt,opt_params,tol", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}, 1e-9),
+    ("adam", {"learning_rate": 0.01}, 1e-5),
+    ("lamb", {"learning_rate": 0.01}, 1e-5),
+])
+def test_fused_matches_eager(opt, opt_params, tol):
+    l_eager, w_eager, _ = _train(False, opt, opt_params)
+    l_fused, w_fused, tr = _train(True, opt, opt_params)
+    assert tr._fused is not None, "fused path did not engage"
+    np.testing.assert_allclose(l_fused, l_eager, rtol=1e-5, atol=1e-6)
+    for we, wf in zip(w_eager, w_fused):
+        np.testing.assert_allclose(wf, we, rtol=tol, atol=tol)
+
+
+def test_fused_steady_state_no_recompile():
+    """One trace per (shape, dtype, hyperparam) group — never per step."""
+    _, _, tr = _train(True, "adam", {"learning_rate": 0.01}, steps=3)
+    assert tr._fused.trace_count == len(tr._fused._jits) == 1
+    assert tr._fused.call_count == 3
+
+
+def test_fused_lr_change_does_not_recompile():
+    """lr rides as a traced scalar: schedules/set_learning_rate must not
+    trigger a retrace."""
+    net = _build_net()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 0.01}, fuse_step=True)
+    rng = np.random.RandomState(2)
+    for step, lr in enumerate([0.01, 0.005, 0.0025]):
+        tr.set_learning_rate(lr)
+        x = nd.array(rng.randn(4, 8).astype(np.float32))
+        with autograd.record():
+            loss = (net(x) ** 2).mean()
+        loss.backward()
+        tr.step(4)
+    assert tr._fused.trace_count == 1
+
+
+def test_fused_lr_scheduler_matches_eager():
+    """The scheduler must see the SAME update count on both paths —
+    scheduler(t), not scheduler(t-1) (the fused path commits counters
+    before reading the lr)."""
+    from incubator_mxnet_tpu.optimizer.lr_scheduler import FactorScheduler
+
+    def run(fuse):
+        net = _build_net(seed=11)
+        sched = FactorScheduler(step=2, factor=0.5, base_lr=0.1)
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1, "lr_scheduler": sched},
+                           fuse_step=fuse)
+        rng = np.random.RandomState(12)
+        for _ in range(5):
+            x = nd.array(rng.randn(4, 8).astype(np.float32))
+            with autograd.record():
+                loss = (net(x) ** 2).mean()
+            loss.backward()
+            tr.step(4)
+        return [p.data().asnumpy().astype(np.float64)
+                for p in net.collect_params().values()]
+
+    for we, wf in zip(run(False), run(True)):
+        np.testing.assert_allclose(wf, we, rtol=1e-6, atol=1e-7)
+
+
+def test_fused_hyperparam_change_recompiles_once():
+    """Changing a baked hyperparameter (wd) retraces exactly once."""
+    net = _build_net()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "wd": 0.0}, fuse_step=True)
+    rng = np.random.RandomState(3)
+
+    def one_step():
+        x = nd.array(rng.randn(4, 8).astype(np.float32))
+        with autograd.record():
+            loss = (net(x) ** 2).mean()
+        loss.backward()
+        tr.step(4)
+
+    one_step()
+    one_step()
+    assert tr._fused.trace_count == 1
+    tr.optimizer.wd = 1e-4
+    one_step()
+    one_step()
+    assert tr._fused.trace_count == 2
+
+
+def test_fused_mixed_dtype_groups():
+    """float32 + float16 params split into one fused group per dtype and
+    match the eager trajectory."""
+    from incubator_mxnet_tpu.gluon.parameter import Parameter
+
+    def build_and_train(fuse):
+        rng = np.random.RandomState(5)
+        params = []
+        for i, dt in enumerate(["float32", "float32", "float16",
+                                "float16"]):
+            p = Parameter(f"p{i}", shape=(6, 6), dtype=dt)
+            p.initialize()
+            p.set_data(nd.array(rng.randn(6, 6).astype(np.float32))
+                       .astype(dt))
+            params.append(p)
+        tr = gluon.Trainer(params, "sgd", {"learning_rate": 0.05},
+                           kvstore=None, fuse_step=fuse)
+        grng = np.random.RandomState(6)
+        for _ in range(3):
+            for p in params:
+                g = p.grad()
+                g._data = nd.array(grng.randn(6, 6).astype(np.float32)) \
+                    .astype(p.dtype)._data
+                g._fresh = True
+            tr.step(1)
+        return [p.data().asnumpy().astype(np.float64)
+                for p in params], tr
+
+    w_eager, _ = build_and_train(False)
+    w_fused, tr = build_and_train(True)
+    assert len(tr._fused._jits) == 2  # one jitted group per dtype
+    for we, wf in zip(w_eager, w_fused):
+        np.testing.assert_allclose(wf, we, rtol=2e-3, atol=2e-3)
+
+
+def test_fused_with_row_sparse_param():
+    """row_sparse-grad params stay on the eager lazy-rows path while the
+    dense rest fuses; the combined step matches the all-eager step."""
+    def build_and_train(fuse):
+        mx.random.seed(7)
+        net = nn.Sequential()
+        net.add(nn.Embedding(20, 4, sparse_grad=True),
+                nn.Dense(4, in_units=4))
+        net.initialize()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.5}, fuse_step=fuse)
+        idx = nd.array(np.array([3.0, 7.0, 3.0]))
+        for _ in range(2):
+            with autograd.record():
+                loss = (net(idx) ** 2).sum()
+            loss.backward()
+            tr.step(1)
+        return ([p.data().asnumpy().astype(np.float64)
+                 for p in net.collect_params().values()], net, tr)
+
+    w_eager, _, _ = build_and_train(False)
+    w_fused, net, tr = build_and_train(True)
+    assert tr._fused is not None
+    for we, wf in zip(w_eager, w_fused):
+        np.testing.assert_allclose(wf, we, rtol=1e-6, atol=1e-7)
+    # the sparse contract held: only looked-up embedding rows changed
+    emb_w = list(net.collect_params().values())[0].data().asnumpy()
+    mx.random.seed(7)
+    ref = nn.Embedding(20, 4, sparse_grad=True)
+    ref.initialize()
+    changed = np.abs(emb_w - ref.weight.data().asnumpy()).sum(axis=1) > 1e-7
+    assert changed[3] and changed[7] and changed.sum() == 2
+
+
+def test_ignore_stale_grad():
+    """Params whose grad was not refilled by backward since the last step
+    are SKIPPED with ignore_stale_grad=True, and warned about (but still
+    applied, deviation documented in docs/PERF_NOTES.md) otherwise."""
+    net = _build_net()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, fuse_step=True)
+    x = nd.array(np.random.RandomState(0).randn(4, 8).astype(np.float32))
+    with autograd.record():
+        loss = (net(x) ** 2).mean()
+    loss.backward()
+    tr.step(4)
+    w_after_first = [p.data().asnumpy().copy()
+                     for p in net.collect_params().values()]
+    # no backward in between: all grads are stale now
+    tr.step(4, ignore_stale_grad=True)
+    for p, w in zip(net.collect_params().values(), w_after_first):
+        np.testing.assert_array_equal(p.data().asnumpy(), w)
+    with pytest.warns(UserWarning, match="not been updated by backward"):
+        tr.step(4)
+    changed = any(
+        np.abs(p.data().asnumpy() - w).max() > 0
+        for p, w in zip(net.collect_params().values(), w_after_first))
+    assert changed  # stale grads applied (with the warning) when not ignored
+
+
+def test_bucketed_allreduce_roundtrip():
+    """Bucketed grad reduction: one pushpull per dtype bucket instead of
+    one per parameter, with an exact concat/split round-trip."""
+    from incubator_mxnet_tpu import kvstore as kv_mod
+
+    net = _build_net()
+    kv = kv_mod.create("device")
+    kv._num_workers = 2  # force the reduction path (identity on 1 copy)
+    calls = []
+    orig = kv.pushpull
+
+    def spy(key, value, out=None, priority=0):
+        calls.append(key)
+        return orig(key, value, out=out, priority=priority)
+
+    kv.pushpull = spy
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, kvstore=kv, fuse_step=True)
+    x = nd.array(np.random.RandomState(0).randn(4, 8).astype(np.float32))
+    with autograd.record():
+        loss = (net(x) ** 2).mean()
+    loss.backward()
+    before = [p.grad().asnumpy().copy()
+              for p in net.collect_params().values()]
+    tr.allreduce_grads()
+    after = [p.grad().asnumpy() for p in net.collect_params().values()]
+    for b, a in zip(before, after):
+        np.testing.assert_array_equal(a, b)
+    # 4 params, one dtype, small sizes -> exactly one bucket pushpull,
+    # keyed by bucket id + member composition (stable across steps)
+    assert len(calls) == 1 and calls[0].startswith("__grad_bucket_float32_0_")
+    loss2 = None
+    with autograd.record():
+        loss2 = (net(x) ** 2).mean()
+    loss2.backward()
+    tr.allreduce_grads()
+    assert calls[1] == calls[0]  # same composition -> same key
+
+
+def test_nonfusable_optimizer_falls_back():
+    """Optimizers with per-step host state must not fuse."""
+    net = _build_net()
+    tr = gluon.Trainer(net.collect_params(), "nadam",
+                       {"learning_rate": 0.01}, fuse_step=True)
+    assert tr._fused is None  # fell back to the eager per-param loop
+
+
+def test_fused_state_serialization_roundtrip(tmp_path):
+    """save_states/load_states sees the fused path's optimizer state."""
+    _, _, tr = _train(True, "adam", {"learning_rate": 0.01}, steps=2)
+    fname = str(tmp_path / "opt.states")
+    tr.save_states(fname)
+    _, _, tr2 = _train(True, "adam", {"learning_rate": 0.01}, steps=1)
+    tr2.load_states(fname)
+    st1 = tr._updaters[0].states
+    st2 = tr2._updaters[0].states
+    assert set(st1) == set(st2)
+    for k in st1:
+        m1, v1 = st1[k]
+        m2, v2 = st2[k]
+        np.testing.assert_allclose(m2.asnumpy(), m1.asnumpy())
+        np.testing.assert_allclose(v2.asnumpy(), v1.asnumpy())
+    assert tr2.optimizer._index_update_count == \
+        tr.optimizer._index_update_count

@@ -507,6 +507,7 @@ def test_quantize_v1_explicit_range_and_gesvd():
     np.testing.assert_allclose(rec, A, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_sample_family_per_element_params():
     """sample_* ops draw one batch of `shape` per LEADING element of the
     parameter arrays (reference multisample_op.cc convention)."""

@@ -71,6 +71,7 @@ def test_vgg11_forward_224():
     assert out.shape == (1, 3)
 
 
+@pytest.mark.slow
 def test_densenet121_forward_224():
     net = vision.get_model("densenet121", classes=3)
     net.initialize()
@@ -85,6 +86,7 @@ def test_alexnet_forward_224():
     assert out.shape == (1, 3)
 
 
+@pytest.mark.slow
 def test_inception_forward_299():
     net = vision.get_model("inceptionv3", classes=3)
     net.initialize()

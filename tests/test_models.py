@@ -96,6 +96,7 @@ def test_bert_flash_matches_dense():
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_bert_remat_matches_no_remat():
     """jax.checkpoint on encoder layers must not change the training
     trajectory (memory-only transform)."""
@@ -194,6 +195,7 @@ def test_gpt_remat_parity():
     assert abs(losses["dots"] - losses[False]) < 1e-5, losses
 
 
+@pytest.mark.slow
 def test_gpt_kv_cache_decode_matches_full_recompute():
     """cached_generate (prefill + per-token KV-cache steps) must emit
     exactly the tokens of greedy_generate's full-prefix recompute —

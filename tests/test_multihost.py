@@ -14,7 +14,17 @@ import pytest
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# jax's CPU backend only grew multiprocess collectives (the cross-process
+# device_put/assert_equal these workers hit inside SPMDTrainer) after the
+# 0.4 series; on older jax the workers die with "Multiprocess computations
+# aren't implemented on the CPU backend" regardless of framework code.
+_jax_ver = tuple(int(x) for x in __import__("jax").__version__.split(".")[:2])
+_needs_mp_cpu = pytest.mark.skipif(
+    _jax_ver < (0, 5),
+    reason="jax<0.5 CPU backend lacks multiprocess collectives")
 
+
+@_needs_mp_cpu
 def test_two_process_dist_sync_and_spmd_step():
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -38,6 +48,7 @@ def test_two_process_dist_sync_and_spmd_step():
     assert oks == 2, f"expected 2 worker OK markers, got: {r.stdout}"
 
 
+@_needs_mp_cpu
 def test_four_process_tp_fsdp_mesh_crosses_process_boundaries():
     """P=4 x 2 virtual devices: dp2 x fsdp2 x tp2 mesh whose dp/fsdp
     axes span process boundaries (VERDICT r3 #7). Asserts all ranks

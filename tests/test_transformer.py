@@ -1,6 +1,7 @@
 """Transformer NMT tests (BASELINE config #4: attention + beam search)."""
 
 import numpy as np
+import pytest
 
 import incubator_mxnet_tpu as mx
 from incubator_mxnet_tpu import autograd, gluon, nd
@@ -52,6 +53,7 @@ def test_causal_decoder():
     np.testing.assert_allclose(o1[:, :3], o2[:, :3], rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_training_overfits_copy_task():
     """Tiny copy task: loss must drop sharply (convergence smoke,
     reference nightly style)."""

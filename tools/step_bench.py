@@ -1,0 +1,195 @@
+"""Host-dispatch benchmark for the optimizer step: eager vs fused vs SPMD.
+
+Measures what the fused whole-tree optimizer step (optimizer/fused.py)
+buys on the host side: the eager path dispatches one un-jitted update op
+per parameter per step (the overhead MXNet 1.x's op-bulking engine
+existed to kill), the fused path dispatches ONE jitted call per
+(dtype, stype, hyperparam) group. Parameters are tiny so device compute
+is negligible and wall time ≈ host dispatch. CPU-measurable by design —
+no TPU needed to validate the host-side win.
+
+Also reports steady-state jit trace counts for the fused path: after
+warmup, re-stepping with fixed shapes must not retrace (one trace per
+(shape, dtype) signature, ever). ``--smoke`` runs a fast version of that
+check and exits non-zero on violation — wired into ci/run.sh as the
+tier-1 regression guard for the fused step.
+
+Usage:
+  python tools/step_bench.py                 # full bench, banks JSON
+  python tools/step_bench.py --smoke         # CI guard (fast, asserts)
+  python tools/step_bench.py --json OUT.json
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _build_params(n_params, shape, seed=0):
+    import numpy as np
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.gluon.parameter import Parameter
+    rng = np.random.RandomState(seed)
+    params = []
+    for i in range(n_params):
+        p = Parameter(f"p{i}", shape=shape)
+        p.initialize()
+        p.set_data(nd.array(rng.randn(*shape).astype(np.float32)))
+        params.append(p)
+    return params
+
+
+def _fill_grads(params, seed):
+    import numpy as np
+    import jax.numpy as jnp
+    rng = np.random.RandomState(seed)
+    for p in params:
+        g = p.grad()
+        g._data = jnp.asarray(rng.randn(*p.shape).astype(np.float32))
+        g._fresh = True
+
+
+def _block(params):
+    import jax
+    for p in params:
+        jax.block_until_ready(p.data()._data)
+
+
+def _time_steps(trainer, params, steps, warmup=3):
+    times = []
+    for s in range(warmup + steps):
+        _fill_grads(params, seed=100 + s)
+        t0 = time.perf_counter()
+        trainer.step(1)
+        _block(params)
+        dt = time.perf_counter() - t0
+        if s >= warmup:
+            times.append(dt)
+    times.sort()
+    return times[len(times) // 2]  # median
+
+
+def bench_trainer(fuse, n_params, shape, steps, optimizer="adam"):
+    from incubator_mxnet_tpu import gluon
+    params = _build_params(n_params, shape)
+    tr = gluon.Trainer(params, optimizer, {"learning_rate": 1e-3},
+                       kvstore=None, fuse_step=fuse)
+    med = _time_steps(tr, params, steps)
+    out = {"per_step_ms": med * 1e3}
+    if tr._fused is not None:
+        out["trace_count"] = tr._fused.trace_count
+        out["group_count"] = len(tr._fused._jits)
+        # steady-state guard: more steps with fixed shapes → no retrace
+        before = tr._fused.trace_count
+        for s in range(3):
+            _fill_grads(params, seed=900 + s)
+            tr.step(1)
+        _block(params)
+        out["steady_state_retraces"] = tr._fused.trace_count - before
+    return out, tr
+
+
+def bench_spmd(n_layers, units, steps):
+    """SPMD fused fwd+bwd+update step on the default (1-device) mesh —
+    the everything-in-one-program upper bound for comparison."""
+    import numpy as np
+    import jax
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, parallel
+    from incubator_mxnet_tpu.gluon import nn
+
+    mx.random.seed(0)
+    net = nn.Sequential()
+    for _ in range(n_layers):
+        net.add(nn.Dense(units, in_units=units))
+    net.initialize()
+    loss_fn = lambda out, y: ((out - y) ** 2).mean()
+    tr = parallel.SPMDTrainer(net, loss=loss_fn, optimizer="adam",
+                              optimizer_params={"learning_rate": 1e-3})
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(8, units).astype(np.float32))
+    y = nd.array(rng.randn(8, units).astype(np.float32))
+    times = []
+    for s in range(3 + steps):
+        t0 = time.perf_counter()
+        L = tr.step(x, y)
+        jax.block_until_ready(L._data)
+        dt = time.perf_counter() - t0
+        if s >= 3:
+            times.append(dt)
+    times.sort()
+    return {"per_step_ms": times[len(times) // 2] * 1e3,
+            "n_params": 2 * n_layers}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="fast CI guard: assert no steady-state retraces")
+    ap.add_argument("--json", default=None,
+                    help="bank results here (default BENCH_STEP.json at "
+                         "the repo root for a full run; none for --smoke)")
+    ap.add_argument("--params", type=int, default=50)
+    ap.add_argument("--dim", type=int, default=32)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--optimizer", default="adam")
+    args = ap.parse_args()
+
+    if args.smoke:
+        args.params, args.steps = 12, 3
+
+    shape = (args.dim, args.dim)
+    eager, _ = bench_trainer(False, args.params, shape, args.steps,
+                             args.optimizer)
+    fused, tr = bench_trainer(True, args.params, shape, args.steps,
+                              args.optimizer)
+    result = {
+        "config": {"n_params": args.params, "shape": list(shape),
+                   "optimizer": args.optimizer, "steps": args.steps,
+                   "backend": os.environ.get("JAX_PLATFORMS", "cpu")},
+        "eager": eager,
+        "fused": fused,
+        "host_dispatch_speedup": eager["per_step_ms"] / fused["per_step_ms"],
+    }
+    if not args.smoke:
+        result["spmd"] = bench_spmd(args.params // 2, args.dim, args.steps)
+
+    print(json.dumps(result, indent=2))
+
+    ok = True
+    if fused.get("steady_state_retraces", 0) != 0:
+        print("FAIL: fused step retraced in steady state "
+              f"({fused['steady_state_retraces']} retraces across 3 "
+              f"fixed-shape steps)", file=sys.stderr)
+        ok = False
+    if fused.get("trace_count", 0) > fused.get("group_count", 1):
+        print("FAIL: fused step compiled more than once per "
+              f"(shape, dtype) signature: {fused['trace_count']} traces "
+              f"for {fused['group_count']} group(s)", file=sys.stderr)
+        ok = False
+    if not args.smoke and result["host_dispatch_speedup"] < 5.0:
+        print(f"WARN: host dispatch speedup "
+              f"{result['host_dispatch_speedup']:.1f}x below the 5x bar",
+              file=sys.stderr)
+
+    out = args.json
+    if out is None and not args.smoke:
+        out = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "BENCH_STEP.json")
+    if out:
+        with open(out, "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+        print(f"banked {out}")
+
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -144,8 +144,11 @@ def summarize(trace_dir, top=12):
     per_scope = Counter()
     scope_count = Counter()
     per_op = Counter()
+    per_module = Counter()
+    module_count = Counter()
     coll_by_dev, compute_by_dev = {}, {}
     t_min, t_max = float("inf"), float("-inf")
+    has_dev_ordinal = False
     for e in events:
         if e.get("ph") != "X" or (e.get("pid"), e.get("tid")) not in lanes:
             continue
@@ -160,7 +163,23 @@ def summarize(trace_dir, top=12):
             per_scope[fam] += dur
             scope_count[fam] += 1
         t_min, t_max = min(t_min, ts), max(t_max, ts + dur)
-        span, pid = (ts, ts + dur), e.get("pid")
+        span = (ts, ts + dur)
+        if cpu_mode:
+            # virtual host mesh: the threadpool is shared, but each thunk
+            # event names its VIRTUAL device (device_ordinal) and program
+            # (run_id/hlo_module) — attribute spans per virtual device
+            # lane so the overlap split is per-device, not pool-level
+            args = e.get("args") or {}
+            dev = args.get("device_ordinal")
+            if dev is not None:
+                has_dev_ordinal = True
+            pid = ("vdev", dev)
+            mod = args.get("hlo_module")
+            if mod is not None:
+                per_module[mod] += dur
+                module_count[mod] += 1
+        else:
+            pid = e.get("pid")
         if any(m in name.lower() for m in COLLECTIVE_MARKERS):
             coll_by_dev.setdefault(pid, []).append(span)
         else:
@@ -175,7 +194,61 @@ def summarize(trace_dir, top=12):
         "",
         f"- source: `{os.path.relpath(path)}`",
     ]
-    if cpu_mode:
+    if cpu_mode and has_dev_ordinal:
+        # virtual host mesh WITH per-thunk device attribution
+        # (device_ordinal): compute the overlap split PER VIRTUAL DEVICE
+        # lane, exactly like the hardware branch — a collective on
+        # virtual device 4 counts as overlapped only when device 4
+        # itself computes concurrently. This replaces the old pool-level
+        # upper bound (VERDICT r5 item 5). Events WITHOUT a
+        # device_ordinal must not masquerade as a lane (their spans from
+        # different devices would interleave pool-style): pull them out
+        # and report them separately.
+        unattr_spans = coll_by_dev.pop(("vdev", None), []) + \
+            compute_by_dev.pop(("vdev", None), [])
+        n_dev = len(set(coll_by_dev) | set(compute_by_dev))
+        busy_compute = busy_coll = overlapped = 0.0
+        for pid, spans in compute_by_dev.items():
+            _, b = _merge_intervals(spans)
+            busy_compute += b
+        for pid, spans in coll_by_dev.items():
+            merged_c, b = _merge_intervals(spans)
+            busy_coll += b
+            merged_compute, _ = _merge_intervals(
+                compute_by_dev.get(pid, []))
+            overlapped += _overlap_len(merged_c, merged_compute)
+        exposed = busy_coll - overlapped
+        window = t_max - t_min
+        lines += [
+            "- **virtual host-mesh capture** (XLA:CPU): all virtual"
+            " devices share one `/host:CPU` threadpool, but each thunk"
+            " event names its virtual device (`device_ordinal`), so the"
+            " overlap split below is PER DEVICE LANE — a collective"
+            " counts as overlapped only when its own lane computes"
+            " concurrently (pool-level interleaving no longer inflates"
+            " it). Lane concurrency is still bounded by host cores, so"
+            " absolute times are not TPU-predictive; the split is.",
+            f"- capture window: {window / 1e3:.1f} ms wall-clock,"
+            f" {n_dev} virtual device lane(s); device work — compute:"
+            f" {busy_compute / 1e3:.1f} ms, collectives:"
+            f" {busy_coll / 1e3:.2f} ms"
+            f" ({100 * busy_coll / (busy_coll + busy_compute):.0f}% of"
+            f" device work)",
+            f"- collective time by lane: {busy_coll / 1e3:.2f} ms —"
+            f" overlapped with that lane's compute:"
+            f" {overlapped / 1e3:.2f} ms"
+            f" ({(100 * overlapped / busy_coll) if busy_coll else 0:.0f}%),"
+            f" exposed (lane idle but for the collective):"
+            f" {exposed / 1e3:.2f} ms",
+        ]
+        if unattr_spans:
+            unattr = sum(t - s for s, t in unattr_spans)
+            lines.append(
+                f"- {unattr / 1e3:.2f} ms of thunk work carried no"
+                f" device_ordinal and is excluded from the per-lane"
+                f" split above")
+        lines.append("")
+    elif cpu_mode:
         # One pid covers all virtual devices and concurrent spans from
         # different devices would collapse in an interval union, so
         # report device-WORK as raw sums (matching the op tables) and
@@ -192,11 +265,11 @@ def summarize(trace_dir, top=12):
         wall_exposed = wall_coll - wall_overlap
         window = t_max - t_min
         lines += [
-            "- **virtual host-mesh capture** (XLA:CPU): all virtual"
-            " devices share one `/host:CPU` threadpool; device-work"
-            " numbers are raw per-op sums, the overlap split is"
-            " wall-clock pool-level interleaving (an upper bound on"
-            " per-device overlap).",
+            "- **virtual host-mesh capture** (XLA:CPU, no per-thunk"
+            " device attribution): all virtual devices share one"
+            " `/host:CPU` threadpool; device-work numbers are raw"
+            " per-op sums, the overlap split is wall-clock pool-level"
+            " interleaving (an upper bound on per-device overlap).",
             f"- capture window: {window / 1e3:.1f} ms wall-clock,"
             f" {n_dev} trace process(es); device work — compute:"
             f" {work_comp / 1e3:.1f} ms, collectives:"
@@ -268,6 +341,15 @@ def summarize(trace_dir, top=12):
         for name, dur in per_scope.most_common(top):
             lines.append(
                 f"| `{name[:70]}` | {scope_count[name]} | "
+                f"{dur / 1e3:.2f} | {100 * dur / total_busy:.1f}% |")
+    elif per_module:
+        lines += ["", "Device work per compiled program (hlo_module"
+                  " from thunk metadata):", "",
+                  "| program | instances | total ms | % of busy |",
+                  "|---|---|---|---|"]
+        for name, dur in per_module.most_common(top):
+            lines.append(
+                f"| `{name[:70]}` | {module_count[name]} | "
                 f"{dur / 1e3:.2f} | {100 * dur / total_busy:.1f}% |")
     lines += ["", f"Top {top} individual ops:", "",
               "| op | total ms | % of busy |", "|---|---|---|"]
