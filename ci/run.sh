@@ -41,6 +41,12 @@ stage_stepbench() {
   JAX_PLATFORMS=cpu python tools/step_bench.py --smoke
 }
 
+stage_servebench() {
+  echo "== servebench: continuous-batching regression guard (the decode"
+  echo "               step must compile exactly once across occupancy churn)"
+  JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke
+}
+
 stage_entry() {
   echo "== entry: driver entry points (single-chip compile is driver-side;"
   echo "          here the 8-device multichip dryrun must pass)"
@@ -54,7 +60,7 @@ ge.dryrun_multichip(8)"
 }
 
 stages=("$@")
-[ ${#stages[@]} -eq 0 ] && stages=(sanity native unit stepbench entry)
+[ ${#stages[@]} -eq 0 ] && stages=(sanity native unit stepbench servebench entry)
 for s in "${stages[@]}"; do
   "stage_$s"
 done

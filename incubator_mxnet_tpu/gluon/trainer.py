@@ -241,3 +241,12 @@ class Trainer:
         with open(fname, "rb") as f:
             self._updaters[0].set_states(f.read())
         self._optimizer = self._updaters[0].optimizer
+        self._scale = self._optimizer.rescale_grad
+        if self._fused is not None:
+            # rebind the fused applier to the (possibly replaced)
+            # optimizer object — a stale reference would silently apply
+            # the discarded instance's lr/wd/rescale/update counts
+            from .. import optimizer as opt_mod
+            self._fuse_step = getattr(self._optimizer, "fusable", True)
+            self._fused = opt_mod.FusedApplier(self._optimizer) \
+                if self._fuse_step else None

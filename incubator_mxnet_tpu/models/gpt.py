@@ -288,13 +288,13 @@ def gpt_small(**kwargs) -> GPTModel:
 # the O(T^2) full-prefix recompute of ``greedy_generate``.)
 # --------------------------------------------------------------------- #
 
-def _attn_decode(attn: CausalSelfAttention, x, k_buf, v_buf, start_pos):
-    """Run attention for positions [start_pos, start_pos+Tin) against the
-    cache. x: (B, Tin, units); k_buf/v_buf: (B, Tmax, H, D) jnp arrays.
-    Returns (out (B, Tin, units), k_buf, v_buf)."""
+def _qkv_heads(attn: CausalSelfAttention, x):
+    """Project and split x (B, Tin, units) into per-head q, k, v jnp
+    arrays shaped (B, Tin, H, D). Shared by the dense KV-cache decode
+    path below and the paged-KV serving engine (serve/engine.py) so the
+    projection/split numerics cannot drift between the two caches."""
     B, Tin = x.shape[0], x.shape[1]
     H, D = attn._heads, attn._units // attn._heads
-    Tmax = k_buf.shape[1]
     qkv = attn.qkv(x).reshape((B, Tin, 3, H, D))
     q = qkv._op("slice_axis", axis=2, begin=0, end=1).reshape(
         (B, Tin, H, D))._data
@@ -302,6 +302,24 @@ def _attn_decode(attn: CausalSelfAttention, x, k_buf, v_buf, start_pos):
         (B, Tin, H, D))._data
     v = qkv._op("slice_axis", axis=2, begin=2, end=3).reshape(
         (B, Tin, H, D))._data
+    return q, k, v
+
+
+def _mlp(blk: GPTBlock, x):
+    """The decode-path FFN half of a block: ln2 → ffn_in → exact gelu →
+    ffn_out (no dropout — inference only). Shared with serve/engine.py."""
+    return blk.ffn_out(NDArray(jax.nn.gelu(
+        blk.ffn_in(blk.ln2(x))._data, approximate=False)))
+
+
+def _attn_decode(attn: CausalSelfAttention, x, k_buf, v_buf, start_pos):
+    """Run attention for positions [start_pos, start_pos+Tin) against the
+    cache. x: (B, Tin, units); k_buf/v_buf: (B, Tmax, H, D) jnp arrays.
+    Returns (out (B, Tin, units), k_buf, v_buf)."""
+    B, Tin = x.shape[0], x.shape[1]
+    H, D = attn._heads, attn._units // attn._heads
+    Tmax = k_buf.shape[1]
+    q, k, v = _qkv_heads(attn, x)
     k_buf = lax.dynamic_update_slice(k_buf, k.astype(k_buf.dtype),
                                      (0, start_pos, 0, 0))
     v_buf = lax.dynamic_update_slice(v_buf, v.astype(v_buf.dtype),
@@ -323,9 +341,7 @@ def _block_decode(blk: GPTBlock, x, k_buf, v_buf, start_pos):
     h, k_buf, v_buf = _attn_decode(blk.attn, blk.ln1(x), k_buf, v_buf,
                                    start_pos)
     x = x + h
-    g = blk.ffn_out(NDArray(jax.nn.gelu(
-        blk.ffn_in(blk.ln2(x))._data, approximate=False)))
-    return x + g, k_buf, v_buf
+    return x + _mlp(blk, x), k_buf, v_buf
 
 
 def init_kv_cache(model: GPTModel, batch_size: int, max_len=None,

@@ -1,0 +1,211 @@
+"""Ragged paged-KV decode attention (the serving-side Pallas kernel).
+
+The training kernels in ``ops.pallas_attention`` assume dense
+(B, H, T, D) K/V buffers — every query pays DMA + compute over the full
+``Tmax`` window regardless of how many tokens its sequence actually
+holds. For continuous-batching inference that is exactly backwards: the
+batch is a set of SLOTS at wildly different sequence lengths, and cache
+memory must scale with live tokens, not ``B × Tmax``. Following the
+ragged-paged-attention design (arxiv 2604.15464; the Gemma-on-TPU
+serving study 2605.25645 attributes most TPU serving wins to this
+batching + cache discipline), K/V live in a shared page pool
+
+    k_pool / v_pool : (num_pages, H, page_size, D)
+
+and each slot owns an ordered list of pages (its PAGE TABLE row). Page 0
+is the NULL page: never allocated, dead page-table entries point at it,
+and its contents are garbage by construction — every read of it is
+masked by the slot's length.
+
+Kernel design (per /opt/skills/guides/pallas_guide.md):
+  - grid (S, max_pages) under a ``PrefetchScalarGridSpec``: the page
+    table and per-slot lengths are scalar-prefetched, so the K/V
+    BlockSpec index_map dereferences ``page_table[s, j]`` to DMA exactly
+    the page that grid step needs — the kernel never sees a gather.
+  - the last grid dimension is sequential on TPU, so the online-softmax
+    state (m, l, acc) carries across pages in VMEM scratch: init at
+    j == 0, accumulate per live page, finalize (acc / l, masked rows
+    zeroed) at j == max_pages - 1.
+  - DEAD PAGES COST NOTHING: ``pl.when(j * page_size < length)`` skips
+    the compute, and because every dead entry indexes the null page the
+    block index is unchanged between consecutive dead steps — Pallas
+    skips the re-DMA. A slot at length L pays for ceil(L / page_size)
+    pages, not max_pages.
+  - one decode query per slot: scores are (1, page_size) rows per head,
+    dot operands stay in the input dtype, accumulation is f32 via
+    ``preferred_element_type`` (same dtype discipline as the training
+    kernels). Decode attention is a prefix mask — the query IS position
+    ``length - 1`` — so no causal triangle is needed.
+
+Falls back to a pure-jnp gather-and-mask reference off-TPU (the CPU
+serving path and the test oracle); ``MXTPU_FLASH_INTERPRET=1`` routes
+the dispatcher to the real kernel in interpret mode, mirroring
+``ops.pallas_attention``. Same masked-row contract as the training
+kernels: a slot with length 0 produces EXACTLY zero output.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .pallas_attention import _pallas_available, _pallas_runnable
+
+_NEG_INF = -1e30
+
+__all__ = ["ragged_paged_attention", "ragged_attention_reference"]
+
+
+def _ragged_kernel(pt_ref, ln_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_ref, l_ref, acc_ref, *, scale, page_size, n_pages,
+                   heads):
+    from jax.experimental import pallas as pl
+
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+    length = ln_ref[s]                          # live tokens this slot
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j * page_size < length)
+    def _accumulate():
+        for h in range(heads):                  # unrolled head loop
+            q = q_ref[0, h]                     # (1, D), input dtype
+            k = k_ref[0, h]                     # (page_size, D)
+            v = v_ref[0, h]
+            sc = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
+                         precision=lax.Precision.DEFAULT) * scale
+            pos = j * page_size + lax.broadcasted_iota(
+                jnp.int32, (1, page_size), 1)
+            sc = jnp.where(pos < length, sc, _NEG_INF)
+            m_prev = m_ref[h]                   # (1,)
+            l_prev = l_ref[h]
+            m_new = jnp.maximum(m_prev, jnp.max(sc, axis=-1))
+            p = jnp.exp(sc - m_new[:, None])    # (1, page_size) f32
+            alpha = jnp.exp(m_prev - m_new)
+            m_ref[h] = m_new
+            l_ref[h] = l_prev * alpha + jnp.sum(p, axis=-1)
+            acc_ref[h] = acc_ref[h] * alpha[:, None] + jnp.dot(
+                p.astype(v.dtype), v, preferred_element_type=jnp.float32,
+                precision=lax.Precision.DEFAULT)
+
+    @pl.when(j == n_pages - 1)
+    def _finalize():
+        for h in range(heads):
+            m = m_ref[h]
+            l_safe = jnp.maximum(l_ref[h], 1e-30)
+            # fully-masked slot (length 0): m never left _NEG_INF — emit
+            # exactly zero, the masked-row contract shared with the
+            # training kernels (ops.pallas_attention)
+            row_ok = m > _NEG_INF / 2
+            o_ref[0, h] = jnp.where(row_ok[:, None],
+                                    acc_ref[h] / l_safe[:, None],
+                                    0.0).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def _ragged_pallas(q, k_pool, v_pool, page_table, lengths, scale,
+                   interpret):
+    """q: (S, H, D); pools: (P, H, page_size, D); page_table:
+    (S, max_pages) int32; lengths: (S,) int32. Returns (S, H, D)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    S, H, D = q.shape
+    page_size = k_pool.shape[2]
+    n_pages = page_table.shape[1]
+    q4 = q[:, :, None, :]                       # (S, H, 1, D)
+
+    kernel = functools.partial(
+        _ragged_kernel, scale=scale, page_size=page_size,
+        n_pages=n_pages, heads=H)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                  # page_table, lengths
+        grid=(S, n_pages),
+        in_specs=[
+            pl.BlockSpec((1, H, 1, D), lambda s, j, pt, ln: (s, 0, 0, 0)),
+            pl.BlockSpec((1, H, page_size, D),
+                         lambda s, j, pt, ln: (pt[s, j], 0, 0, 0)),
+            pl.BlockSpec((1, H, page_size, D),
+                         lambda s, j, pt, ln: (pt[s, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, 1, D),
+                               lambda s, j, pt, ln: (s, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, 1), jnp.float32),        # m
+            pltpu.VMEM((H, 1), jnp.float32),        # l
+            pltpu.VMEM((H, 1, D), jnp.float32),     # acc
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, H, 1, D), q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      q4, k_pool, v_pool)
+    return out[:, :, 0, :]
+
+
+def ragged_attention_reference(q, k_pool, v_pool, page_table, lengths,
+                               scale=None):
+    """Pure-jnp oracle and CPU serving path: gather each slot's pages to
+    a dense (S, H, K, D) window, mask positions >= length, softmax with
+    f32 accumulation. Jit-friendly (static shapes; the gather is an XLA
+    gather over the pool's page axis)."""
+    S, H, D = q.shape
+    page_size = k_pool.shape[2]
+    n_pages = page_table.shape[1]
+    K = n_pages * page_size
+    sc = D ** -0.5 if scale is None else scale
+
+    def window(pool):
+        g = pool[page_table]                    # (S, n_pages, H, ps, D)
+        g = jnp.moveaxis(g, 2, 1)               # (S, H, n_pages, ps, D)
+        return g.reshape(S, H, K, D)
+
+    k = window(k_pool)
+    v = window(v_pool)
+    s = jnp.einsum("shd,shkd->shk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sc
+    pos = lax.broadcasted_iota(jnp.int32, (S, K), 1)
+    s = jnp.where((pos < lengths.astype(jnp.int32)[:, None])[:, None, :],
+                  s, _NEG_INF)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    out = jnp.einsum("shk,shkd->shd", p, v.astype(jnp.float32)) / \
+        jnp.maximum(l, 1e-30)[..., None]
+    row_ok = m > _NEG_INF / 2                   # length-0 slots → zero
+    return jnp.where(row_ok[..., None], out, 0.0).astype(q.dtype)
+
+
+def ragged_paged_attention(q, k_pool, v_pool, page_table, lengths,
+                           scale=None, interpret=None):
+    """Decode attention for one new token per slot against the paged KV
+    pool. q: (S, H, D); k_pool/v_pool: (num_pages, H, page_size, D);
+    page_table: (S, max_pages) int32 (dead entries 0 = null page);
+    lengths: (S,) int32 — number of live KV tokens INCLUDING the one
+    just written for this step. Returns (S, H, D).
+
+    Dispatch is static (mirrors ``ops.pallas_attention``): the Pallas
+    kernel on TPU, or anywhere under ``MXTPU_FLASH_INTERPRET=1`` /
+    ``interpret=True``; the jnp gather reference otherwise (the CPU
+    serving path). Both paths share the masked-row contract."""
+    if interpret is None:
+        interpret = os.environ.get("MXTPU_FLASH_INTERPRET") == "1"
+    sc = q.shape[-1] ** -0.5 if scale is None else scale
+    if _pallas_available() and _pallas_runnable(interpret):
+        return _ragged_pallas(q, k_pool, v_pool, page_table, lengths,
+                              sc, interpret)
+    return ragged_attention_reference(q, k_pool, v_pool, page_table,
+                                      lengths, sc)

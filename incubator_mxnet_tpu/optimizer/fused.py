@@ -180,13 +180,20 @@ class FusedApplier:
         new_counts = {i: counts.get(i, 0) + 1 for i, _, _ in items}
         counts.update(new_counts)
         opt.num_update = max(counts.values(), default=opt.num_update)
+        # read the schedule ONCE, before any group dispatch: a group's
+        # trace-time _update_count() calls inside apply_updates bump
+        # num_update mid-loop, so a per-group read would hand LATER
+        # groups scheduler(t+1) instead of scheduler(t) whenever an
+        # earlier group (re)traces (multi-dtype/stype sets only)
+        lr = np.float32(float(opt.learning_rate))
+        rescale = np.float32(float(opt.rescale_grad))
         for gkey, group in groups.items():
-            self._apply_group(gkey, group, updater)
+            self._apply_group(gkey, group, updater, lr, rescale)
         counts.update(new_counts)
         opt.num_update = max(counts.values(), default=opt.num_update)
 
     # ------------------------------------------------------------------ #
-    def _apply_group(self, gkey, group, updater) -> None:
+    def _apply_group(self, gkey, group, updater, lr, rescale) -> None:
         opt = self.optimizer
         indices = tuple(i for i, _, _ in group)
         states = [updater.states[i] for i in indices]
@@ -209,8 +216,6 @@ class FusedApplier:
         t_vec = np.asarray(
             [opt._index_update_count.get(i, 1) for i in indices],
             np.float32)
-        lr = np.float32(float(opt.learning_rate))
-        rescale = np.float32(float(opt.rescale_grad))
 
         new_ws, new_state_leaves = fn(
             weight_vals, grad_vals, tuple(state_leaves), t_vec, lr, rescale)

@@ -1,0 +1,31 @@
+"""Continuous-batching inference engine (the serving half of the
+ROADMAP north star — "serves heavy traffic from millions of users").
+
+The reference's inference story is per-request: a bound Module / a
+GluonNLP beam-search decoder owns one dense state per call
+(`python/mxnet/module/module.py` forward, `gluonnlp` BeamSearchSampler —
+file-level citations, SURVEY.md caveat). That shape dies under ragged
+traffic: every request pays attention and cache memory over ``Tmax``.
+This package replaces it with the TPU-serving discipline (arxiv
+2604.15464, 2605.25645):
+
+  - ``paged_kv``   — a shared KV page pool + per-slot page tables, so
+                     cache memory scales with LIVE tokens;
+  - ``engine``     — a fixed-slot continuous-batching scheduler whose
+                     decode step is ONE jitted program whose shapes
+                     never depend on occupancy (prefill-insert and
+                     EOS-eviction are host-side data edits, never
+                     retraces).
+
+The ragged decode-attention kernel itself lives in
+``ops.ragged_attention`` next to its training-side siblings.
+
+See docs/SERVING.md for the architecture and invariants.
+"""
+
+from .paged_kv import (NULL_PAGE, PageAllocator, init_kv_pools,
+                       write_prompt_kv, write_token_kv)
+from .engine import InferenceEngine, Request
+
+__all__ = ["InferenceEngine", "Request", "PageAllocator", "NULL_PAGE",
+           "init_kv_pools", "write_token_kv", "write_prompt_kv"]

@@ -1,0 +1,422 @@
+"""Continuous-batching inference engine over the paged KV cache.
+
+Design (the jit-once contract):
+
+  - The engine owns ``num_slots`` decode SLOTS. Occupancy (which slots
+    are live, at what lengths, with what sampling params) is pure DATA
+    — int32/float32 arrays fed to ONE jitted decode-step program whose
+    shapes never change. Prefill-insert and EOS-eviction are host-side
+    edits of those arrays plus page-allocator bookkeeping; in steady
+    state the decode step compiles exactly once (asserted by
+    ``tools/serve_bench.py --smoke`` and tests/test_serve.py).
+  - Prefill is a separate jitted program per PROMPT BUCKET (prompt
+    pages rounded up to a power of two), the BucketingModule trade-off:
+    a bounded, logarithmic family of prefill shapes instead of one per
+    prompt length.
+  - The decode step, per layer: project the one new token per slot,
+    scatter its K/V into each slot's tail page, then ragged paged
+    attention (ops/ragged_attention.py) over exactly the live pages.
+    Inactive slots ride along at length 0: they write to the null page,
+    attend nothing (zero output by the masked-row contract), and their
+    sampled token is discarded on the host — no shape anywhere depends
+    on how many slots are live.
+  - Per-slot sampling params: a (S,) temperature array is traced data;
+    greedy and categorical are both computed and selected per slot.
+  - tp sharding: pass ``mesh`` — pools are placed with the H axis
+    sharded over ``tp`` via the existing ``parallel.mesh`` machinery
+    and XLA propagates the layout through the step (attention runs the
+    jnp ragged path under tp; wiring the Pallas kernel through
+    shard_map is future work, documented in docs/SERVING.md).
+
+The reference's closest surface is the stateful Module/forward loop +
+GluonNLP's BeamSearchSampler (file-level citations, SURVEY.md caveat) —
+per-request, dense, and retrace-happy; this is its redesign for ragged
+multi-tenant decode.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from collections import deque
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from ..ops.attention import scaled_dot_product_attention as _sdpa
+from ..ops.ragged_attention import (ragged_attention_reference,
+                                    ragged_paged_attention)
+from .paged_kv import (NULL_PAGE, PageAllocator, init_kv_pools,
+                       write_prompt_kv, write_token_kv)
+
+__all__ = ["Request", "InferenceEngine"]
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request. ``temperature`` 0 = greedy; ``eos_id``
+    < 0 disables EOS stopping (generation runs to max_new_tokens)."""
+
+    prompt_ids: np.ndarray
+    max_new_tokens: int = 32
+    temperature: float = 0.0
+    eos_id: int = -1
+
+    # filled in by the engine
+    token_ids: List[int] = dataclasses.field(default_factory=list)
+    token_times: List[float] = dataclasses.field(default_factory=list)
+    submit_time: Optional[float] = None
+    finish_time: Optional[float] = None
+
+    def __post_init__(self):
+        self.prompt_ids = np.asarray(self.prompt_ids, np.int32).reshape(-1)
+        if self.prompt_ids.size == 0:
+            raise MXNetError("empty prompt")
+        if self.max_new_tokens < 1:
+            raise MXNetError("max_new_tokens must be >= 1")
+
+
+@dataclasses.dataclass
+class _Slot:
+    request: Request
+    reserved_pages: int          # worst-case pages (admission guarantee)
+    allocated: List[int] = dataclasses.field(default_factory=list)
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+class InferenceEngine:
+    """Fixed-slot continuous-batching decode over a GPT-style model
+    (models/gpt.py — anything exposing word_embed / position_embed /
+    blockN(ln1, attn.{qkv,proj}, ln2, ffn_*) / ln_f and tied LM head).
+
+    ``num_pages`` defaults to the worst case (every slot at max_len) so
+    admission never stalls; shrink it to trade admission concurrency
+    for cache memory — correctness is preserved by admission control
+    (a request is only admitted when its worst-case page count fits)."""
+
+    def __init__(self, model, num_slots=8, page_size=16, max_len=None,
+                 num_pages=None, dtype=None, mesh=None, interpret=None):
+        self.model = model
+        self.num_slots = int(num_slots)
+        self.page_size = int(page_size)
+        self.max_len = int(max_len or model.max_length)
+        if self.max_len > model.max_length:
+            raise MXNetError(f"max_len {self.max_len} exceeds model "
+                             f"max_length {model.max_length}")
+        self.max_pages = -(-self.max_len // self.page_size)
+        if num_pages is None:
+            num_pages = 1 + self.num_slots * self.max_pages
+        self.num_pages = int(num_pages)
+        self._dtype = dtype or model._dtype
+
+        H = model.block0.attn._heads
+        D = model._units // H
+        self._H, self._D = H, D
+        pools = init_kv_pools(model.num_layers, self.num_pages, H,
+                              self.page_size, D, self._dtype)
+        self._kpools = tuple(k for k, _ in pools)
+        self._vpools = tuple(v for _, v in pools)
+
+        self._mesh = None
+        if mesh is not None and dict(mesh.shape).get("tp", 1) > 1:
+            # H-axis tp sharding through parallel.mesh; the step's jnp
+            # ragged path partitions cleanly under jit (the Pallas
+            # kernel is per-chip — shard_map wiring is future work)
+            from ..parallel.mesh import named_sharding
+            self._mesh = mesh
+            sh = named_sharding(mesh, None, "tp", None, None)
+            self._kpools = tuple(jax.device_put(k, sh)
+                                 for k in self._kpools)
+            self._vpools = tuple(jax.device_put(v, sh)
+                                 for v in self._vpools)
+        self._interpret = interpret
+
+        # host-side occupancy state — DATA, never shapes
+        S = self.num_slots
+        self._page_table = np.zeros((S, self.max_pages), np.int32)
+        self._lengths = np.zeros((S,), np.int32)
+        self._temps = np.zeros((S,), np.float32)
+        self._alloc = PageAllocator(self.num_pages)
+        self._slots: List[Optional[_Slot]] = [None] * S
+        self._queue: deque = deque()
+        self._key = jax.random.PRNGKey(0)
+
+        self.decode_trace_count = 0
+        self.prefill_trace_count = 0
+        self.decode_steps = 0
+        self._decode_step = jax.jit(self._decode_step_fn,
+                                    donate_argnums=(0, 1))
+        self._prefill_jits = {}          # bucket_pages -> jitted fn
+
+    # ------------------------------------------------------------- #
+    # traced programs
+    # ------------------------------------------------------------- #
+
+    def _sample(self, logits, temps, key):
+        """Per-slot sampling: greedy where temp == 0, categorical
+        otherwise — both computed, selected per slot (shape-static)."""
+        keys = jax.random.split(key, logits.shape[0])
+
+        def one(lg, t, k):
+            greedy = jnp.argmax(lg, axis=-1)
+            samp = jax.random.categorical(
+                k, lg.astype(jnp.float32) / jnp.maximum(t, 1e-6), axis=-1)
+            return jnp.where(t > 0, samp, greedy)
+
+        return jax.vmap(one)(logits, temps, keys).astype(jnp.int32)
+
+    def _ragged_attn(self, q, kp, vp, page_table, lengths):
+        if self._mesh is not None:
+            return ragged_attention_reference(q, kp, vp, page_table,
+                                              lengths)
+        return ragged_paged_attention(q, kp, vp, page_table, lengths,
+                                      interpret=self._interpret)
+
+    def _decode_step_fn(self, kpools, vpools, tokens, page_table,
+                        lengths, temps, key):
+        """ONE decode token for every slot. All array shapes are fixed
+        by (num_slots, max_pages, model) — occupancy is data."""
+        self.decode_trace_count += 1         # trace-time only
+        from ..gluon.block import _hybrid_trace_scope
+        from .. import autograd
+        from ..models.gpt import _mlp, _qkv_heads
+
+        model = self.model
+        S, ps = self.num_slots, self.page_size
+        act = lengths > 0
+        pos = lengths                        # the new token's position
+        eff_len = jnp.where(act, lengths + 1, 0)
+        write_page = page_table[jnp.arange(S), pos // ps]   # NULL if dead
+        write_off = pos % ps
+
+        with _hybrid_trace_scope(), \
+                autograd._ModeScope(recording=False, training=False):
+            x = model.word_embed(NDArray(tokens[:, None])) + \
+                model.position_embed(NDArray(pos[:, None]))
+            if model._dtype != "float32":
+                x = x.astype(model._dtype)
+            new_k, new_v = [], []
+            for i in range(model.num_layers):
+                blk = getattr(model, f"block{i}")
+                q, k, v = _qkv_heads(blk.attn, blk.ln1(x))  # (S,1,H,D)
+                kp = write_token_kv(kpools[i], k[:, 0], write_page,
+                                    write_off)
+                vp = write_token_kv(vpools[i], v[:, 0], write_page,
+                                    write_off)
+                new_k.append(kp)
+                new_v.append(vp)
+                out = self._ragged_attn(q[:, 0].astype(kp.dtype), kp, vp,
+                                        page_table, eff_len)
+                out = NDArray(out.astype(q.dtype).reshape(
+                    S, 1, model._units))
+                x = x + blk.attn.proj(out)
+                x = x + _mlp(blk, x)
+            # cast BEFORE the final norm — token parity with
+            # decode_forward / the training path (see models/gpt.py)
+            x = model.ln_f(x.astype("float32"))
+            embed_w = model.word_embed.weight.data()
+            logits = x._op("dot", embed_w, transpose_b=True)._data[:, 0]
+        nxt = self._sample(logits, temps, key)
+        new_lengths = jnp.where(act, lengths + 1, 0)
+        return tuple(new_k), tuple(new_v), nxt, new_lengths
+
+    def _prefill_fn(self, kpools, vpools, ids, t0, pages, temp, key):
+        """Prompt forward for ONE request (ids (1, Tpad) padded): dense
+        causal attention inside the prompt (the prompt attends only
+        itself), K/V scattered into the slot's pages, and the FIRST
+        generated token sampled from the last real position's logits.
+        Tpad is the bucket shape — one compile per bucket, counted in
+        ``prefill_trace_count``."""
+        self.prefill_trace_count += 1        # trace-time only
+        from jax import lax
+        from ..gluon.block import _hybrid_trace_scope
+        from .. import autograd
+        from ..models.gpt import _mlp, _qkv_heads
+
+        model = self.model
+        Tpad = ids.shape[1]
+        with _hybrid_trace_scope(), \
+                autograd._ModeScope(recording=False, training=False):
+            pos = NDArray(lax.broadcasted_iota(jnp.int32, (1, Tpad), 1))
+            x = model.word_embed(NDArray(ids)) + model.position_embed(pos)
+            if model._dtype != "float32":
+                x = x.astype(model._dtype)
+            pos_q = lax.broadcasted_iota(jnp.int32, (Tpad, Tpad), 0)
+            pos_k = lax.broadcasted_iota(jnp.int32, (Tpad, Tpad), 1)
+            mask = ((pos_k <= pos_q) & (pos_k < t0))[None, None]
+            new_k, new_v = list(kpools), list(vpools)
+            for i in range(model.num_layers):
+                blk = getattr(model, f"block{i}")
+                q, k, v = _qkv_heads(blk.attn, blk.ln1(x))  # (1,Tpad,H,D)
+                new_k[i] = write_prompt_kv(new_k[i], k[0], pages)
+                new_v[i] = write_prompt_kv(new_v[i], v[0], pages)
+                out = _sdpa(q, k, v, mask=mask)
+                x = x + blk.attn.proj(NDArray(out.reshape(
+                    1, Tpad, model._units)))
+                x = x + _mlp(blk, x)
+            last = lax.dynamic_slice(
+                x._data, (0, t0 - 1, 0), (1, 1, model._units))
+            x = model.ln_f(NDArray(last).astype("float32"))
+            embed_w = model.word_embed.weight.data()
+            logits = x._op("dot", embed_w, transpose_b=True)._data[:, 0]
+        tok = self._sample(logits, temp[None], key)[0]
+        return tuple(new_k), tuple(new_v), tok
+
+    # ------------------------------------------------------------- #
+    # host-side scheduler
+    # ------------------------------------------------------------- #
+
+    @property
+    def active_count(self) -> int:
+        return sum(s is not None for s in self._slots)
+
+    @property
+    def _lazy_debt(self) -> int:
+        """Pages promised at admission but not yet physically taken."""
+        return sum(s.reserved_pages - len(s.allocated)
+                   for s in self._slots if s is not None)
+
+    def submit(self, request: Request):
+        request.submit_time = time.perf_counter()
+        self._queue.append(request)
+
+    def _next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _finish_token(self, slot_idx: int, token: int, dt: float) -> bool:
+        """Record one generated token; returns True when the request is
+        done (EOS or max_new_tokens)."""
+        slot = self._slots[slot_idx]
+        req = slot.request
+        req.token_ids.append(int(token))
+        req.token_times.append(dt)
+        return (len(req.token_ids) >= req.max_new_tokens or
+                (req.eos_id >= 0 and int(token) == req.eos_id))
+
+    def _evict(self, slot_idx: int):
+        slot = self._slots[slot_idx]
+        self._alloc.free(slot.allocated)
+        self._page_table[slot_idx, :] = NULL_PAGE
+        self._lengths[slot_idx] = 0
+        self._temps[slot_idx] = 0.0
+        slot.request.finish_time = time.perf_counter()
+        self._slots[slot_idx] = None
+
+    def _admit(self):
+        """FIFO admission into free slots, gated on worst-case pages."""
+        for slot_idx in range(self.num_slots):
+            if not self._queue or self._slots[slot_idx] is not None:
+                continue
+            req = self._queue[0]
+            t0 = int(req.prompt_ids.size)
+            total = t0 + req.max_new_tokens
+            if total > self.max_len:
+                raise MXNetError(
+                    f"request needs {total} positions > max_len "
+                    f"{self.max_len}")
+            need = -(-total // self.page_size)
+            if self._alloc.free_count - self._lazy_debt < need:
+                break                        # no cache budget yet — wait
+            self._queue.popleft()
+            t_start = time.perf_counter()
+            prompt_pages = -(-t0 // self.page_size)
+            pages = [self._alloc.alloc() for _ in range(prompt_pages)]
+            bucket = min(_next_pow2(prompt_pages), self.max_pages)
+            Tpad = bucket * self.page_size
+            ids = np.zeros((1, Tpad), np.int32)
+            ids[0, :t0] = req.prompt_ids
+            pages_arr = np.zeros((bucket,), np.int32)
+            pages_arr[:prompt_pages] = pages
+            fn = self._prefill_jits.get(bucket)
+            if fn is None:
+                fn = jax.jit(self._prefill_fn, donate_argnums=(0, 1))
+                self._prefill_jits[bucket] = fn
+            self._kpools, self._vpools, tok = fn(
+                self._kpools, self._vpools, ids,
+                np.int32(t0), pages_arr,
+                np.float32(req.temperature), self._next_key())
+            tok = int(np.asarray(tok))
+            self._slots[slot_idx] = _Slot(req, reserved_pages=need,
+                                          allocated=pages)
+            self._page_table[slot_idx, :] = NULL_PAGE
+            self._page_table[slot_idx, :prompt_pages] = pages
+            self._lengths[slot_idx] = t0
+            self._temps[slot_idx] = req.temperature
+            if self._finish_token(slot_idx, tok,
+                                  time.perf_counter() - t_start):
+                self._evict(slot_idx)
+
+    def _ensure_tail_pages(self):
+        """Lazily allocate the page the NEXT write position needs —
+        this is where cache memory tracks live tokens."""
+        for s in range(self.num_slots):
+            if self._slots[s] is None:
+                continue
+            pi = int(self._lengths[s]) // self.page_size
+            if self._page_table[s, pi] == NULL_PAGE:
+                page = self._alloc.alloc()
+                self._page_table[s, pi] = page
+                self._slots[s].allocated.append(page)
+
+    def step(self) -> int:
+        """Admit, then run ONE decode step for all slots. Returns the
+        number of live slots that advanced."""
+        self._admit()
+        live = [s for s in range(self.num_slots)
+                if self._slots[s] is not None]
+        if not live:
+            return 0
+        self._ensure_tail_pages()
+        tokens = np.zeros((self.num_slots,), np.int32)
+        for s in live:
+            tokens[s] = self._slots[s].request.token_ids[-1]
+        t_start = time.perf_counter()
+        self._kpools, self._vpools, nxt, lengths = self._decode_step(
+            self._kpools, self._vpools, tokens, self._page_table.copy(),
+            self._lengths.copy(), self._temps.copy(), self._next_key())
+        nxt = np.asarray(nxt)                # host sync point
+        self._lengths = np.asarray(lengths).copy()
+        dt = time.perf_counter() - t_start
+        self.decode_steps += 1
+        for s in live:
+            if self._finish_token(s, nxt[s], dt):
+                self._evict(s)
+        return len(live)
+
+    def run(self, requests, arrival_times=None, poll_sleep=1e-3):
+        """Drive ``requests`` to completion. ``arrival_times`` (seconds,
+        relative to call time) gates submission — the Poisson-arrival
+        harness of tools/serve_bench.py; None submits everything up
+        front (pure batch drain)."""
+        if arrival_times is None:
+            for r in requests:
+                self.submit(r)
+            pending = []
+        else:
+            pending = sorted(zip(arrival_times, requests),
+                             key=lambda p: p[0])
+        t0 = time.perf_counter()
+        while pending or self._queue or self.active_count:
+            now = time.perf_counter() - t0
+            while pending and pending[0][0] <= now:
+                self.submit(pending.pop(0)[1])
+            if self.step() == 0:
+                self._admit()
+                if not self.active_count:
+                    if pending:
+                        time.sleep(min(poll_sleep,
+                                       max(0.0, pending[0][0] - now)))
+                    elif self._queue:
+                        raise MXNetError(
+                            "queued requests cannot be admitted: page "
+                            "pool too small for any waiting request")
+        return requests

@@ -1,0 +1,101 @@
+"""Paged KV cache: a shared page pool + host-side page allocator.
+
+Layout (one pool pair per transformer layer):
+
+    k_pool / v_pool : (num_pages, H, page_size, D)
+
+chosen so each (page, head) slice is a contiguous (page_size, D) tile —
+the ragged kernel's per-head dot operand (ops/ragged_attention.py) —
+and so a tp mesh can shard the H axis with the existing
+``parallel.mesh`` machinery without splitting any page.
+
+Invariants (enforced by the engine, asserted in tests):
+  - **Page 0 is the NULL page.** The allocator never hands it out; every
+    dead page-table entry points at it; inactive slots' decode writes
+    land in it. Its contents are garbage BY DESIGN — correctness relies
+    on every read of it being masked by the slot's length, never on what
+    it holds.
+  - A slot at length L references exactly ceil(L / page_size) live
+    pages, contiguous in its page-table row; entries past that are 0.
+  - Pages are identity-free: eviction returns them to the free list and
+    any slot may reuse them without clearing (the next writer overwrites
+    the prefix it needs; the tail is masked).
+
+The allocator is deliberately host-side Python (a free list), matching
+the scheduler split: device programs are occupancy-oblivious, all
+allocation decisions ride in as int32 data.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import MXNetError
+
+NULL_PAGE = 0
+
+__all__ = ["NULL_PAGE", "PageAllocator", "init_kv_pools",
+           "write_token_kv", "write_prompt_kv"]
+
+
+class PageAllocator:
+    """Free-list allocator over pages 1..num_pages-1 (page 0 = null)."""
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise MXNetError("need >= 2 pages (page 0 is the null page)")
+        self.num_pages = num_pages
+        # LIFO reuse keeps the working set of hot pages small
+        self._free = list(range(num_pages - 1, 0, -1))
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def alloc(self) -> int:
+        if not self._free:
+            raise MXNetError("KV page pool exhausted — admission control "
+                             "should have prevented this (engine bug)")
+        return self._free.pop()
+
+    def free(self, pages) -> None:
+        for p in pages:
+            if p == NULL_PAGE:
+                raise MXNetError("attempted to free the null page")
+            self._free.append(int(p))
+
+
+def init_kv_pools(num_layers, num_pages, num_heads, page_size, head_dim,
+                  dtype="float32"):
+    """Fresh zeroed (k_pool, v_pool) pairs, one per layer."""
+    dt = jnp.dtype(dtype)
+    mk = lambda: jnp.zeros((num_pages, num_heads, page_size, head_dim), dt)
+    return [(mk(), mk()) for _ in range(num_layers)]
+
+
+def write_token_kv(pool, new, pages, offsets):
+    """Scatter one decode token's K (or V) per slot into the pool.
+
+    pool: (P, H, ps, D); new: (S, H, D); pages/offsets: (S,) int32 —
+    slot s writes ``new[s]`` to ``pool[pages[s], :, offsets[s], :]``.
+    Inactive slots carry pages[s] == NULL_PAGE, so their write lands in
+    the null page (harmless, never read unmasked). Static shapes; safe
+    under jit."""
+    H = pool.shape[1]
+    return pool.at[pages[:, None], jnp.arange(H)[None, :],
+                   offsets[:, None], :].set(new.astype(pool.dtype))
+
+
+def write_prompt_kv(pool, kv, pages):
+    """Scatter a whole prompt's K (or V) into its pages (prefill).
+
+    pool: (P, H, ps, D); kv: (Tpad, H, D) with Tpad == len(pages) * ps;
+    pages: (n_pages,) int32 with dead (beyond the prompt) entries
+    NULL_PAGE — those whole-page writes land in the null page. Duplicate
+    null indices are fine: the store order is unspecified but the value
+    is never read unmasked."""
+    n_pages = pages.shape[0]
+    ps = pool.shape[2]
+    paged = kv.reshape(n_pages, ps, kv.shape[1], kv.shape[2]) \
+        .transpose(0, 2, 1, 3)                  # (n_pages, H, ps, D)
+    return pool.at[pages].set(paged.astype(pool.dtype))

@@ -290,3 +290,54 @@ def test_fused_state_serialization_roundtrip(tmp_path):
         np.testing.assert_allclose(v2.asnumpy(), v1.asnumpy())
     assert tr2.optimizer._index_update_count == \
         tr.optimizer._index_update_count
+
+
+def test_fused_multi_group_scheduler_lr_consistent():
+    """Regression: with >= 2 dtype groups and an lr scheduler, the FIRST
+    group's trace-time _update_count() bumps used to inflate num_update
+    before LATER groups read the schedule — later groups trained with
+    scheduler(t+1). The schedule must be read once per step, before any
+    group dispatch, so fused matches eager on mixed-dtype sets."""
+    from incubator_mxnet_tpu.optimizer.lr_scheduler import FactorScheduler
+
+    def build(fuse):
+        mx.random.seed(0)
+        p32 = gluon.Parameter("p32", shape=(4, 4), dtype="float32")
+        p16 = gluon.Parameter("p16", shape=(4, 4), dtype="float16")
+        for p in (p32, p16):
+            p.initialize()
+        tr = gluon.Trainer(
+            [p32, p16], "sgd",
+            {"learning_rate": 0.5,
+             "lr_scheduler": FactorScheduler(step=1, factor=0.5)},
+            fuse_step=fuse)
+        rng = np.random.RandomState(3)
+        for s in range(3):
+            for p in (p32, p16):
+                g = p.grad()
+                g._data = nd.array(
+                    rng.randn(4, 4).astype(np.float32)).astype(
+                        p.dtype)._data
+                g._fresh = True
+            tr.step(1)
+        return [p32.data().asnumpy().astype(np.float64),
+                p16.data().asnumpy().astype(np.float64)], tr
+
+    w_eager, _ = build(False)
+    w_fused, tr = build(True)
+    assert tr._fused is not None and len(tr._fused._jits) >= 2, \
+        "test needs >= 2 fused dtype groups to cover the bug"
+    for we, wf in zip(w_eager, w_fused):
+        np.testing.assert_allclose(wf, we, rtol=2e-3, atol=2e-3)
+
+
+def test_fused_rebinds_after_load_states(tmp_path):
+    """Regression: load_states can replace the updater's optimizer
+    object; the fused applier must follow it (a stale reference applies
+    the discarded optimizer's lr/counters to the weights)."""
+    _, _, tr = _train(True, "sgd", {"learning_rate": 0.1})
+    f = str(tmp_path / "trainer.states")
+    tr.save_states(f)
+    tr.load_states(f)
+    assert tr._fused is not None
+    assert tr._fused.optimizer is tr._optimizer

@@ -29,7 +29,7 @@ run() { # run NAME TIMEOUT [ENV=VAL...]
   echo "$(date -u +%H:%M:%S) done $name rc=$rc: $(head -c 200 "$LOG/$name.json" 2>/dev/null)" >> "$LOG/watch.log"
 }
 
-ALL="large-b32-dense resnet-b64 nmt-decode ssd-b32 base-default b48-dense b96-dense-dots large-b32-dense-trace b96-dense-trace large-b48-dense b128-dense-dots default-hpp1 default-rbg default-nodrop default-jnpflash gpt-b16 gpt-b32-dots"
+ALL="large-b32-dense resnet-b64 nmt-decode ssd-b32 base-default b48-dense b96-dense-dots large-b32-dense-trace b96-dense-trace large-b48-dense b128-dense-dots default-hpp1 default-rbg default-nodrop default-jnpflash gpt-b16 gpt-b32-dots servebench"
 while true; do
   if timeout 90 python -c "import jax; assert any(d.platform!='cpu' for d in jax.devices())" 2>/dev/null; then
     echo "$(date -u +%H:%M:%S) p5 window OPEN" >> "$LOG/watch.log"
@@ -46,10 +46,36 @@ while true; do
       echo "$(date -u +%H:%M:%S) canary FAILED -> hpp=1 fallback" >> "$LOG/watch.log"
     fi
     # --- the three must-bank rungs, in priority order ---
-    run large-b32-dense 950 MXTPU_BENCH_MODEL=large MXTPU_BENCH_BATCH=32 MXTPU_BENCH_REMAT=dots
+    # Rung-1 wall-clock budget, pre-verified (VERDICT r5 item 1): 13
+    # steps (10 timed + 3 warmup) at the r4-measured 29,184 tok/s/chip
+    # for large-b32 is ~7 s of compute (B=32 x T=512 = 16,384 tok/step)
+    # + ~20-40 s compile + ~60 s import/data — ~2-3 min realistic, so a
+    # ~15-min window banks it even with the canary's worst case (420 s)
+    # in front. The 780 s timeout is the pathological bound only: it
+    # guarantees a hung rung can never eat a whole ~20-min window.
+    run large-b32-dense 780 MXTPU_BENCH_MODEL=large MXTPU_BENCH_BATCH=32 MXTPU_BENCH_REMAT=dots
     WL=resnet run resnet-b64 700
     WL=nmt run nmt-decode 700
     WL=ssd run ssd-b32 700
+    # --- kernel-policy anchor probes (VERDICT r5 item 5), promoted into
+    #     the must-bank block: _MEASURED_MAX_BATCH clamps base to 96 and
+    #     large to 32 on two measured anchors only — these two rungs are
+    #     the evidence needed to raise (or keep) those clamps, so they
+    #     must land in the same window as the headline numbers ---
+    run b128-dense-dots 700 MXTPU_BENCH_BATCH=128 MXTPU_BENCH_REMAT=dots
+    run large-b48-dense 780 MXTPU_BENCH_MODEL=large MXTPU_BENCH_BATCH=48 MXTPU_BENCH_REMAT=dots
+    # --- serve-bench rung: the first TPU decode/serving number (paged
+    #     KV + continuous batching, tools/serve_bench.py) ---
+    if [ ! -s "$LOG/servebench.json" ] && [ ! -e "$LOG/servebench.failed" ]; then
+      timeout 700 python tools/serve_bench.py \
+        --json "$LOG/servebench.json" > "$LOG/servebench.out" 2> "$LOG/servebench.err"
+      src=$?
+      if [ ! -s "$LOG/servebench.json" ]; then
+        rm -f "$LOG/servebench.json"
+        [ "$src" != 124 ] && tail -c 400 "$LOG/servebench.err" > "$LOG/servebench.failed"
+      fi
+      echo "$(date -u +%H:%M:%S) servebench rc=$src: $(head -c 150 "$LOG/servebench.json" 2>/dev/null)" >> "$LOG/watch.log"
+    fi
     # --- headline base + batch scaling ---
     # base-default runs with NO knobs: audits that the kernel_policy
     # defaults reproduce the best measured config (expect ~= b96-dots)
@@ -65,9 +91,9 @@ while true; do
       [ -s "$LOG/kernelbench.json" ] || rm -f "$LOG/kernelbench.json"
       echo "$(date -u +%H:%M:%S) kernelbench: $(head -c 150 "$LOG/kernelbench.json" 2>/dev/null)" >> "$LOG/watch.log"
     fi
-    # --- batch/remat frontier ---
-    run large-b48-dense 950 MXTPU_BENCH_MODEL=large MXTPU_BENCH_BATCH=48 MXTPU_BENCH_REMAT=dots
-    run b128-dense-dots 700 MXTPU_BENCH_BATCH=128 MXTPU_BENCH_REMAT=dots
+    # (batch/remat frontier rungs b128-dense-dots / large-b48-dense now
+    #  live in the must-bank block above as the kernel-policy anchor
+    #  probes)
     # --- A/B probes (each relative to the no-knob policy default,
     #     so the delta vs base-default isolates one variable) ---
     run default-hpp1 700 MXTPU_FLASH_FWD_HPP=1 MXTPU_FLASH_BWD_HPP=1
