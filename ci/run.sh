@@ -47,6 +47,12 @@ stage_servebench() {
   JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke
 }
 
+stage_ckptbench() {
+  echo "== ckptbench: elastic-checkpoint regression guard (async commit +"
+  echo "              keep-last-k GC + bit-exact capsule resume)"
+  JAX_PLATFORMS=cpu python tools/ckpt_bench.py --smoke
+}
+
 stage_entry() {
   echo "== entry: driver entry points (single-chip compile is driver-side;"
   echo "          here the 8-device multichip dryrun must pass)"
@@ -60,7 +66,7 @@ ge.dryrun_multichip(8)"
 }
 
 stages=("$@")
-[ ${#stages[@]} -eq 0 ] && stages=(sanity native unit stepbench servebench entry)
+[ ${#stages[@]} -eq 0 ] && stages=(sanity native unit stepbench servebench ckptbench entry)
 for s in "${stages[@]}"; do
   "stage_$s"
 done

@@ -1,0 +1,41 @@
+"""Elastic checkpointing subsystem.
+
+The production-TPU redesign of the reference's synchronous whole-tree
+``.params`` saves (SURVEY.md §5.4): async double-buffered sharded
+snapshots with atomic commit, preemption-safe final saves, bit-exact
+training resume, and serve warm-restart. See docs/CHECKPOINTING.md.
+
+Quick use::
+
+    from incubator_mxnet_tpu import checkpoint as ckpt
+
+    mgr = ckpt.CheckpointManager("/ckpts/run0", keep=3)
+    trainer.install_preemption(mgr, iterator=it)   # SIGTERM-safe
+    for step in range(n):
+        ...train...
+        if step % 100 == 0:
+            trainer.save_checkpoint(mgr, iterator=it)
+
+    # preempted? new process:
+    step = trainer.restore_checkpoint(mgr, iterator=it)   # bit-exact
+"""
+
+from .manager import CheckpointManager, gather_tree
+from .manifest import (FORMAT_VERSION, MANIFEST_NAME, gc_steps, list_steps,
+                       load_step, step_dir, write_step)
+from .capsule import (CAPSULE_MAGIC, dump_capsule_bytes, fill_state,
+                      flatten_state, is_capsule_bytes,
+                      load_capsule_bytes, load_capsule_file,
+                      restore_spmd, restore_trainer, restore_updater,
+                      save_capsule_file, spmd_capsule, trainer_capsule,
+                      updater_capsule)
+
+__all__ = [
+    "CheckpointManager", "gather_tree",
+    "write_step", "load_step", "list_steps", "gc_steps", "step_dir",
+    "FORMAT_VERSION", "MANIFEST_NAME",
+    "CAPSULE_MAGIC", "dump_capsule_bytes", "load_capsule_bytes",
+    "is_capsule_bytes", "save_capsule_file", "load_capsule_file",
+    "trainer_capsule", "restore_trainer", "spmd_capsule", "restore_spmd",
+    "updater_capsule", "restore_updater", "flatten_state", "fill_state",
+]

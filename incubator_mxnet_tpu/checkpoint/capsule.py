@@ -1,0 +1,440 @@
+"""The training CAPSULE: everything bit-exact resume needs, in one tree.
+
+A capsule is a flat ``name → array`` tree plus a JSON-able meta dict:
+
+    param/<name>      every Parameter (trainable AND frozen — BN stats)
+    opt/<i>/<j>       j-th optimizer-state leaf of param/slot i
+    rng/key           the global RNG stream key (random.get_state())
+    meta.num_update, meta.index_update_count
+                      optimizer step counters (Adam/LAMB bias
+                      correction + lr schedules depend on them)
+    meta.step         trainer step count (SPMD) / num_update (Trainer)
+    meta.iterator     DataIter.tell() position (io/__init__.py)
+
+Two encodings share the tree: the sharded step-directory format
+(manifest.py, via CheckpointManager) for periodic training snapshots,
+and a single-file BLOB (magic ``MXTPUCK\\x01``, crc32-checked) that
+``Trainer.save_states`` / ``Module`` checkpointing route through — the
+same magic-byte dispatch idiom as utils/serialization.py, so legacy
+pickle ``.states`` files keep loading.
+
+Optimizer-state trees are never pickled: on restore the state STRUCTURE
+is rebuilt by ``create_state_multi_precision`` against the restored
+weights and only the leaf buffers are filled from the capsule — so a
+fused applier rebinds cleanly (PR 1's load_states fix, end-to-end) and
+a capsule written by one process layout loads into another.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..base import MXNetError
+from .manifest import (_dtype_name, _np_dtype,
+                       _raw_bytes as _raw_buffer)
+
+__all__ = ["CAPSULE_MAGIC", "dump_capsule_bytes", "load_capsule_bytes",
+           "save_capsule_file", "load_capsule_file", "is_capsule_bytes",
+           "trainer_capsule", "restore_trainer",
+           "spmd_capsule", "restore_spmd",
+           "updater_capsule", "restore_updater",
+           "flatten_state", "fill_state"]
+
+CAPSULE_MAGIC = b"MXTPUCK\x01"
+CAPSULE_VERSION = 1
+
+
+def _is_nd(x):
+    return hasattr(x, "_data") and hasattr(x, "asnumpy")
+
+
+def _tohost(leaf) -> np.ndarray:
+    import jax
+    if _is_nd(leaf):
+        leaf = leaf._data
+    return np.asarray(jax.device_get(leaf))
+
+
+def _raw(a: np.ndarray) -> bytes:
+    # blob buffers are concatenated, so materialize the manifest
+    # writer's zero-copy view into bytes here
+    return bytes(_raw_buffer(a))
+
+
+# ---------------------------------------------------------------------- #
+# single-file blob encoding
+# ---------------------------------------------------------------------- #
+
+def dump_capsule_bytes(tree: Dict[str, object],
+                       meta: Optional[dict] = None) -> bytes:
+    bufs, recs = [], []
+    for name, leaf in tree.items():
+        a = _tohost(leaf)
+        buf = _raw(a)
+        recs.append({"name": name, "dtype": _dtype_name(a),
+                     "shape": list(a.shape), "nbytes": len(buf),
+                     "crc32": zlib.crc32(buf) & 0xFFFFFFFF})
+        bufs.append(buf)
+    header = json.dumps({"capsule_version": CAPSULE_VERSION,
+                         "meta": meta or {},
+                         "arrays": recs}).encode("utf-8")
+    out = [CAPSULE_MAGIC, struct.pack("<Q", len(header)), header]
+    out.extend(bufs)
+    return b"".join(out)
+
+
+def is_capsule_bytes(data: bytes) -> bool:
+    return data[:len(CAPSULE_MAGIC)] == CAPSULE_MAGIC
+
+
+def load_capsule_bytes(data: bytes
+                       ) -> Tuple[Dict[str, np.ndarray], dict]:
+    if not is_capsule_bytes(data):
+        raise MXNetError("not a MXTPU capsule blob (bad magic)")
+    off = len(CAPSULE_MAGIC)
+    (hlen,) = struct.unpack("<Q", data[off:off + 8])
+    off += 8
+    header = json.loads(data[off:off + hlen].decode("utf-8"))
+    off += hlen
+    out = {}
+    for rec in header["arrays"]:
+        buf = data[off:off + rec["nbytes"]]
+        if len(buf) != rec["nbytes"]:
+            raise MXNetError(
+                f"capsule blob truncated at array '{rec['name']}'")
+        if (zlib.crc32(buf) & 0xFFFFFFFF) != rec["crc32"]:
+            raise MXNetError(
+                f"capsule blob: array '{rec['name']}' failed crc32 "
+                f"verification — refusing to load corrupt state")
+        off += rec["nbytes"]
+        dt = _np_dtype(rec["dtype"])
+        out[rec["name"]] = np.frombuffer(buf, dtype=dt).reshape(
+            tuple(rec["shape"]))
+    return out, header.get("meta", {})
+
+
+def save_capsule_file(fname: str, tree: Dict[str, object],
+                      meta: Optional[dict] = None) -> None:
+    with open(fname, "wb") as f:
+        f.write(dump_capsule_bytes(tree, meta))
+
+
+def load_capsule_file(fname: str) -> Tuple[Dict[str, np.ndarray], dict]:
+    with open(fname, "rb") as f:
+        return load_capsule_bytes(f.read())
+
+
+# ---------------------------------------------------------------------- #
+# state-tree flatten/rebuild helpers
+# ---------------------------------------------------------------------- #
+
+def _flatten_state(st) -> Tuple[List, object]:
+    """Flatten one optimizer-state pytree to its NDArray leaves.
+    ``None`` leaves vanish (jax drops them); any other non-NDArray leaf
+    is a design error surfaced loudly."""
+    import jax.tree_util as jtu
+    leaves, treedef = jtu.tree_flatten(st, is_leaf=_is_nd)
+    for leaf in leaves:
+        if not _is_nd(leaf):
+            raise MXNetError(
+                f"optimizer state leaf of type {type(leaf).__name__} is "
+                f"not an NDArray; cannot capsule it")
+    return leaves, treedef
+
+
+def _fill_state(template, arrays: Dict[str, np.ndarray], prefix: str,
+                expect: Optional[int] = None):
+    """Rebuild a state pytree: ``template``'s structure, leaf values
+    from ``arrays[f'{prefix}/{j}']``."""
+    import jax.numpy as jnp
+    import jax.tree_util as jtu
+    from ..ndarray import NDArray
+    leaves, treedef = _flatten_state(template)
+    if expect is not None and len(leaves) != expect:
+        raise MXNetError(
+            f"capsule mismatch at {prefix}: checkpoint has {expect} "
+            f"state leaves, current optimizer creates {len(leaves)} — "
+            f"optimizer type or multi_precision setting changed")
+    new = []
+    for j, leaf in enumerate(leaves):
+        key = f"{prefix}/{j}"
+        if key not in arrays:
+            raise MXNetError(f"capsule missing optimizer state '{key}'")
+        a = arrays[key]
+        cur = leaf._data
+        if tuple(a.shape) != tuple(cur.shape) or \
+                _dtype_name(a) != _dtype_name_of(cur):
+            raise MXNetError(
+                f"capsule state '{key}' is {_dtype_name(a)}{a.shape}, "
+                f"expected {_dtype_name_of(cur)}{tuple(cur.shape)}")
+        new.append(NDArray(jnp.asarray(a)))
+    return jtu.tree_unflatten(treedef, new)
+
+
+def _dtype_name_of(jax_arr) -> str:
+    name = str(jax_arr.dtype)
+    return "bfloat16" if name == "bfloat16" else name
+
+
+# public names for external consumers (Module .states routing keys its
+# optimizer state by parameter NAME, so it drives these directly
+# instead of the index-keyed updater_capsule/restore_updater pair)
+flatten_state = _flatten_state
+fill_state = _fill_state
+
+
+def _check_param(name, a: np.ndarray, p) -> None:
+    cur = p.data()._data
+    if tuple(a.shape) != tuple(cur.shape):
+        raise MXNetError(
+            f"capsule param '{name}' shape {tuple(a.shape)} != current "
+            f"{tuple(cur.shape)}")
+    if _dtype_name(a) != _dtype_name_of(cur):
+        raise MXNetError(
+            f"capsule param '{name}' dtype {_dtype_name(a)} != current "
+            f"{_dtype_name_of(cur)} — refusing a silent cast "
+            f"(bit-exact resume contract)")
+
+
+def _rng_entry(tree: dict):
+    from .. import random as _random
+    tree["rng/key"] = np.asarray(_random.get_state())
+
+
+def _restore_rng(arrays: Dict[str, np.ndarray]):
+    if "rng/key" in arrays:
+        import jax.numpy as jnp
+        from .. import random as _random
+        _random.set_state(jnp.asarray(arrays["rng/key"]))
+
+
+def _iterator_meta(iterator) -> Optional[dict]:
+    if iterator is None:
+        return None
+    return iterator.tell()
+
+
+def _restore_iterator(iterator, meta: dict):
+    pos = meta.get("iterator")
+    if iterator is not None and pos is not None:
+        iterator.set_position(pos)
+
+
+# ---------------------------------------------------------------------- #
+# gluon.Trainer capsule
+# ---------------------------------------------------------------------- #
+
+def trainer_capsule(trainer, iterator=None,
+                    extra_meta: Optional[dict] = None
+                    ) -> Tuple[Dict[str, object], dict]:
+    """Capsule of a ``gluon.Trainer``: params + updater states + step
+    counters + scheduler position (num_update) + RNG + iterator."""
+    opt = trainer._optimizer
+    updater = trainer._updaters[0]
+    tree: Dict[str, object] = {}
+    for i, p in enumerate(trainer._params):
+        # positional keys: Parameter names are session-global
+        # auto-numbered ("dense4_weight"), so a fresh process's params
+        # only line up by CONSTRUCTION ORDER — the same contract the
+        # optimizer's index-keyed state already relies on. Names ride
+        # in meta.param_names for diagnostics and name-based loaders.
+        tree[f"param/{i}"] = p.data()
+    leaf_counts = {}
+    for i, st in updater.states.items():
+        leaves, _ = _flatten_state(st)
+        leaf_counts[str(i)] = len(leaves)
+        for j, leaf in enumerate(leaves):
+            tree[f"opt/{i}/{j}"] = leaf
+    _rng_entry(tree)
+    meta = {
+        "kind": "trainer",
+        "step": int(opt.num_update),
+        "num_update": int(opt.num_update),
+        "index_update_count": {str(k): int(v) for k, v in
+                               opt._index_update_count.items()},
+        "opt_leaf_counts": leaf_counts,
+        "param_names": [p.name for p in trainer._params],
+        "iterator": _iterator_meta(iterator),
+    }
+    meta.update(extra_meta or {})
+    return tree, meta
+
+
+def restore_trainer(trainer, arrays: Dict[str, np.ndarray], meta: dict,
+                    iterator=None) -> None:
+    import jax.numpy as jnp
+    if meta.get("kind") not in ("trainer", None):
+        raise MXNetError(f"capsule kind {meta.get('kind')!r} is not a "
+                         f"Trainer capsule")
+    opt = trainer._optimizer
+    updater = trainer._updaters[0]
+    names = meta.get("param_names") or []
+    if names and len(names) != len(trainer._params):
+        raise MXNetError(
+            f"capsule holds {len(names)} params, trainer has "
+            f"{len(trainer._params)} — model structure changed")
+    for i, p in enumerate(trainer._params):
+        key = f"param/{i}"
+        if key not in arrays:
+            raise MXNetError(f"capsule has no entry for parameter "
+                             f"{i} ('{p.name}')")
+        _check_param(f"{key} ('{p.name}')", arrays[key], p)
+        p.data()._data = jnp.asarray(arrays[key])
+    updater.states.clear()
+    for sidx, count in (meta.get("opt_leaf_counts") or {}).items():
+        i = int(sidx)
+        if i >= len(trainer._params):
+            raise MXNetError(
+                f"capsule optimizer state for param index {i} but the "
+                f"trainer only has {len(trainer._params)} params")
+        template = opt.create_state_multi_precision(
+            i, trainer._params[i].data())
+        updater.states[i] = _fill_state(template, arrays, f"opt/{i}",
+                                        expect=int(count))
+    opt.num_update = int(meta.get("num_update", 0))
+    opt._index_update_count = {
+        int(k): int(v)
+        for k, v in (meta.get("index_update_count") or {}).items()}
+    if trainer._fused is not None or trainer._fuse_step:
+        # rebind: fresh jit cache keyed against the restored state
+        # treedefs (mirrors Trainer.load_states' PR 1 fix)
+        from .. import optimizer as opt_mod
+        trainer._fused = opt_mod.FusedApplier(opt) \
+            if getattr(opt, "fusable", True) and trainer._fuse_step else None
+    _restore_rng(arrays)
+    _restore_iterator(iterator, meta)
+
+
+# ---------------------------------------------------------------------- #
+# Updater-only capsule (Trainer.save_states / Module .states routing)
+# ---------------------------------------------------------------------- #
+
+def updater_capsule(updater) -> Tuple[Dict[str, object], dict]:
+    opt = updater.optimizer
+    tree: Dict[str, object] = {}
+    leaf_counts = {}
+    for i, st in updater.states.items():
+        leaves, _ = _flatten_state(st)
+        leaf_counts[str(i)] = len(leaves)
+        for j, leaf in enumerate(leaves):
+            tree[f"opt/{i}/{j}"] = leaf
+    meta = {
+        "kind": "updater",
+        "num_update": int(opt.num_update),
+        "index_update_count": {str(k): int(v) for k, v in
+                               opt._index_update_count.items()},
+        "opt_leaf_counts": leaf_counts,
+    }
+    return tree, meta
+
+
+def restore_updater(updater, params: List, arrays: Dict[str, np.ndarray],
+                    meta: dict) -> None:
+    """Fill an Updater from a capsule; ``params`` is the index-aligned
+    Parameter list (state templates are rebuilt against their data)."""
+    opt = updater.optimizer
+    updater.states.clear()
+    for sidx, count in (meta.get("opt_leaf_counts") or {}).items():
+        i = int(sidx)
+        if i >= len(params):
+            raise MXNetError(
+                f"states capsule refers to param index {i}; only "
+                f"{len(params)} params bound")
+        template = opt.create_state_multi_precision(i, params[i].data())
+        updater.states[i] = _fill_state(template, arrays, f"opt/{i}",
+                                        expect=int(count))
+    opt.num_update = int(meta.get("num_update", 0))
+    opt._index_update_count = {
+        int(k): int(v)
+        for k, v in (meta.get("index_update_count") or {}).items()}
+
+
+# ---------------------------------------------------------------------- #
+# parallel.SPMDTrainer capsule
+# ---------------------------------------------------------------------- #
+
+def spmd_capsule(trainer, iterator=None,
+                 extra_meta: Optional[dict] = None
+                 ) -> Tuple[Dict[str, object], dict]:
+    if trainer._opt_state is None:
+        raise MXNetError(
+            "SPMDTrainer has no optimizer state yet (no step taken); "
+            "nothing to checkpoint — save Block parameters instead")
+    opt = trainer._optimizer
+    tree: Dict[str, object] = {}
+    for i, p in enumerate(trainer._params):
+        tree[f"param/{i}"] = p.data()      # positional (see trainer_capsule)
+    leaf_counts = {}
+    for slot, st in enumerate(trainer._opt_state):
+        leaves, _ = _flatten_state(st)
+        leaf_counts[str(slot)] = len(leaves)
+        for j, leaf in enumerate(leaves):
+            tree[f"opt/{slot}/{j}"] = leaf
+    _rng_entry(tree)
+    meta = {
+        "kind": "spmd",
+        "step": int(trainer.step_count),
+        "num_update": int(opt.num_update),
+        "index_update_count": {str(k): int(v) for k, v in
+                               opt._index_update_count.items()},
+        "opt_leaf_counts": leaf_counts,
+        "train_idx": [int(i) for i in trainer._train_idx],
+        "param_names": [p.name for p in trainer._params],
+        "sharding": trainer.sharding_mode,
+        "iterator": _iterator_meta(iterator),
+    }
+    meta.update(extra_meta or {})
+    return tree, meta
+
+
+def restore_spmd(trainer, arrays: Dict[str, np.ndarray], meta: dict,
+                 iterator=None) -> None:
+    import jax.numpy as jnp
+    if meta.get("kind") != "spmd":
+        raise MXNetError(f"capsule kind {meta.get('kind')!r} is not an "
+                         f"SPMDTrainer capsule")
+    not_ready = [p.name for p in trainer._params if p._data is None]
+    if not_ready:
+        raise MXNetError(f"cannot restore into uninitialized params "
+                         f"{not_ready}; call block.initialize() first")
+    if meta.get("train_idx") is not None and \
+            [int(i) for i in meta["train_idx"]] != \
+            [int(i) for i in trainer._train_idx]:
+        raise MXNetError(
+            "capsule trainable-parameter set differs from this "
+            "trainer's (grad_req changed?) — refusing to misalign "
+            "optimizer state")
+    names = meta.get("param_names") or []
+    if names and len(names) != len(trainer._params):
+        raise MXNetError(
+            f"capsule holds {len(names)} params, trainer has "
+            f"{len(trainer._params)} — model structure changed")
+    for i, p in enumerate(trainer._params):
+        key = f"param/{i}"
+        if key not in arrays:
+            raise MXNetError(f"capsule has no entry for parameter "
+                             f"{i} ('{p.name}')")
+        _check_param(f"{key} ('{p.name}')", arrays[key], p)
+        p.data()._data = jnp.asarray(arrays[key])
+    opt = trainer._optimizer
+    new_state = []
+    counts = meta.get("opt_leaf_counts") or {}
+    for slot, i in enumerate(trainer._train_idx):
+        template = opt.create_state_multi_precision(
+            i, trainer._params[i].data())
+        new_state.append(_fill_state(
+            template, arrays, f"opt/{slot}",
+            expect=int(counts.get(str(slot), 0)) or None))
+    trainer._opt_state = new_state
+    trainer.step_count = int(meta.get("step", 0))
+    opt.num_update = int(meta.get("num_update", 0))
+    opt._index_update_count = {
+        int(k): int(v)
+        for k, v in (meta.get("index_update_count") or {}).items()}
+    _restore_rng(arrays)
+    _restore_iterator(iterator, meta)

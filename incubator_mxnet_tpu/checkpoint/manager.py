@@ -1,0 +1,318 @@
+"""Async double-buffered checkpoint manager.
+
+The step loop's critical path pays ONLY the device→host gather of the
+state pytree (host-transfer DMAs are kicked off for every leaf first,
+then materialized — on TPU the copies overlap); serialization and disk
+I/O run on a bounded background writer thread. At most ONE snapshot is
+in flight: ``save(step=N+1)`` waits for N's *write* only if it has not
+finished yet, so with any sane save interval step N+1 never blocks on
+step N's disk I/O (tools/ckpt_bench.py measures the steady-state
+overhead; BENCH_CKPT.json banks it).
+
+Commit is atomic (manifest.py) and GC keeps the last ``keep`` committed
+steps. ``install_preemption_hook`` arms a SIGTERM handler that drains
+the in-flight snapshot and writes a final synchronous one before the
+process dies — the preemptible-TPU-pod contract (docs/CHECKPOINTING.md).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..base import MXNetError
+from . import manifest as _manifest
+
+__all__ = ["CheckpointManager", "gather_tree"]
+
+
+def _unwrap(leaf):
+    """NDArray / jax.Array / np.ndarray → the underlying array value."""
+    if hasattr(leaf, "_data"):          # NDArray without importing ndarray
+        leaf = leaf._data
+    return leaf
+
+
+def _sharding_spec_str(arr) -> Optional[str]:
+    try:
+        sh = arr.sharding
+        spec = getattr(sh, "spec", None)
+        return None if spec is None else str(spec)
+    except Exception:
+        return None
+
+
+def _full_index(shape):
+    return [(0, int(s)) for s in shape]
+
+
+def gather_tree(tree: Dict[str, object]) -> Dict[str, dict]:
+    """Device→host gather of a flat name→array tree into manifest
+    entries, deduplicated to this process's replica-0 addressable
+    shards (each unique piece of global data is written exactly once
+    across the job).
+
+    The gather is two-phase: phase 1 kicks off a non-blocking
+    device→host transfer for every leaf (``copy_to_host_async``), phase
+    2 materializes numpy views — so on real hardware the per-leaf DMAs
+    overlap instead of serializing.
+    """
+    leaves = {name: _unwrap(leaf) for name, leaf in tree.items()}
+    for arr in leaves.values():         # phase 1: start all the DMAs
+        if hasattr(arr, "copy_to_host_async"):
+            try:
+                arr.copy_to_host_async()
+            except Exception:
+                pass
+    entries: Dict[str, dict] = {}
+    for name, arr in leaves.items():    # phase 2: materialize
+        if isinstance(arr, (bool, int, float)):
+            arr = np.asarray(arr)
+        if isinstance(arr, np.ndarray):
+            entries[name] = {"shape": arr.shape,
+                             "dtype": _manifest._dtype_name(arr),
+                             "spec": None,
+                             "shards": [(_full_index(arr.shape),
+                                         np.ascontiguousarray(arr))]}
+            continue
+        spec = _sharding_spec_str(arr)
+        shards = []
+        try:
+            addressable = list(arr.addressable_shards)
+        except Exception:
+            addressable = []
+        multi = len(addressable) > 1 or jax.process_count() > 1
+        if addressable and multi:
+            for sh in addressable:
+                if sh.replica_id != 0:
+                    continue            # another device/process owns it
+                idx = []
+                for sl, dim in zip(sh.index, arr.shape):
+                    start = 0 if sl.start is None else int(sl.start)
+                    stop = int(dim) if sl.stop is None else int(sl.stop)
+                    idx.append((start, stop))
+                shards.append((idx, np.asarray(sh.data)))
+        else:
+            shards.append((_full_index(arr.shape), np.asarray(arr)))
+        host_dtype = _manifest._dtype_name(shards[0][1]) if shards \
+            else str(arr.dtype)
+        entries[name] = {"shape": tuple(int(s) for s in arr.shape),
+                         "dtype": host_dtype, "spec": spec,
+                         "shards": shards}
+    return entries
+
+
+class CheckpointManager:
+    """Directory of committed ``step_<N>`` snapshots with async writes.
+
+    Parameters
+    ----------
+    directory : checkpoint root (created if missing).
+    keep : keep-last-k garbage collection after each commit (None/0 =
+        keep everything).
+    async_save : write snapshots on the background thread (default);
+        ``False`` forces every save onto the critical path (the sync
+        baseline of tools/ckpt_bench.py).
+    """
+
+    def __init__(self, directory: str, keep: Optional[int] = 3,
+                 async_save: bool = True):
+        self.directory = os.fspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.keep = keep or 0
+        self.async_save = async_save
+        # RLock: the SIGTERM preemption handler runs ON the main thread
+        # and may interrupt save() INSIDE its critical section; the
+        # handler's drain (wait()) must be able to re-enter. Condition
+        # fully releases an RLock across wait() (via _release_save), so
+        # the writer thread still makes progress.
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+        self._pending: Optional[Tuple] = None   # (step, entries, meta)
+        self._error: Optional[BaseException] = None
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        self._sig_prev = None
+        self.committed_steps = 0                # cumulative commits
+
+    # -- background writer -------------------------------------------- #
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._writer_loop, daemon=True,
+                name="mxtpu-ckpt-writer")
+            self._thread.start()
+
+    def _writer_loop(self):
+        try:
+            # deprioritize the writer: on hosts where compute shares the
+            # cores (CPU backend; TPU hosts during input pipelines), the
+            # background serialize must lose scheduler contests against
+            # the step loop, not split them evenly
+            os.setpriority(os.PRIO_PROCESS, threading.get_native_id(), 10)
+        except (OSError, AttributeError):
+            pass
+        while True:
+            with self._cv:
+                while self._pending is None and not self._closed:
+                    # bounded wait: a SIGTERM handler can interrupt
+                    # save() between setting _pending and notify_all —
+                    # the notify is then lost while the handler itself
+                    # blocks in wait(); the timeout turns that lost
+                    # wakeup into at most a 200 ms stall instead of a
+                    # drain deadlock at the preemption deadline
+                    self._cv.wait(timeout=0.2)
+                if self._pending is None and self._closed:
+                    return
+                job = self._pending
+            try:
+                self._write(*job)
+            except BaseException as e:          # surfaced on next call
+                with self._cv:
+                    self._error = e
+            finally:
+                with self._cv:
+                    self._pending = None
+                    self._cv.notify_all()
+
+    def _write(self, step, entries, meta):
+        _manifest.write_step(
+            self.directory, step, entries, meta=meta,
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+            sync_fn=self._process_barrier)
+        self.committed_steps += 1
+        if self.keep:
+            _manifest.gc_steps(self.directory, self.keep)
+
+    @staticmethod
+    def _process_barrier():
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("mxtpu_ckpt_commit")
+
+    def _raise_pending_error(self):
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise MXNetError(
+                f"background checkpoint write failed: {err!r}") from err
+
+    # -- public API ---------------------------------------------------- #
+    def save(self, step: int, tree: Dict[str, object],
+             meta: Optional[dict] = None, block: bool = False) -> None:
+        """Snapshot ``tree`` (flat name→array) at ``step``.
+
+        Gathers device state to host on the caller thread (the only
+        critical-path cost), then hands off to the writer. With
+        ``block=True`` (or ``async_save=False``) the write itself also
+        runs here — used for final preemption saves and as the sync
+        baseline in benchmarks.
+        """
+        if self._closed:
+            raise MXNetError("CheckpointManager is closed")
+        entries = gather_tree(tree)
+        meta = dict(meta or {})
+        if not (self.async_save and not block):
+            self.wait()
+            self._write(step, entries, meta)
+            self._raise_pending_error()
+            return
+        self._ensure_thread()
+        with self._cv:
+            while self._pending is not None:    # bound: one in flight
+                self._cv.wait()
+            self._raise_pending_error()
+            self._pending = (step, entries, meta)
+            self._cv.notify_all()
+
+    def wait(self) -> None:
+        """Drain the in-flight snapshot (no-op when idle)."""
+        if self._thread is None:
+            self._raise_pending_error()
+            return
+        with self._cv:
+            while self._pending is not None:
+                self._cv.wait()
+        self._raise_pending_error()
+
+    def all_steps(self) -> List[int]:
+        return _manifest.list_steps(self.directory)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def restore(self, step: Optional[int] = None
+                ) -> Tuple[Dict[str, np.ndarray], dict]:
+        """Load a committed step (default: latest) → (arrays, meta)."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise MXNetError(
+                    f"no committed checkpoint under {self.directory}")
+        return _manifest.load_step(self.directory, step)
+
+    def close(self):
+        """Drain and shut down. Raises a latched background-write error
+        rather than swallowing it — a run must not end believing its
+        final async snapshot committed when the writer failed."""
+        try:
+            self.wait()
+        finally:
+            with self._cv:
+                self._closed = True
+                self._cv.notify_all()
+            if self._thread is not None:
+                self._thread.join(timeout=30)
+            self.uninstall_preemption_hook()
+
+    # -- preemption ---------------------------------------------------- #
+    def install_preemption_hook(self, state_fn: Callable[[], Tuple],
+                                exit_after: bool = True):
+        """Arm SIGTERM: drain the in-flight snapshot, then write a final
+        SYNCHRONOUS one from ``state_fn() -> (step, tree, meta)``.
+
+        With ``exit_after`` the previous SIGTERM disposition is
+        re-raised once the final snapshot is committed (so the process
+        still dies, but never with work lost since the last commit);
+        tests pass ``exit_after=False`` to observe the drain in-process.
+        Main-thread only (POSIX signal contract).
+        """
+        manager = self
+
+        def _handler(signum, frame):
+            manager.drain_and_save_final(state_fn)
+            if exit_after:
+                prev = manager.uninstall_preemption_hook()
+                if callable(prev):
+                    prev(signum, frame)
+                elif prev == signal.SIG_IGN:
+                    pass        # the process had opted to survive TERM
+                else:
+                    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                    os.kill(os.getpid(), signal.SIGTERM)
+
+        self._sig_prev = signal.signal(signal.SIGTERM, _handler)
+        return _handler
+
+    def uninstall_preemption_hook(self):
+        prev, self._sig_prev = self._sig_prev, None
+        if prev is not None:
+            signal.signal(signal.SIGTERM, prev)
+        return prev
+
+    def drain_and_save_final(self, state_fn: Callable[[], Tuple]):
+        """The preemption sequence, callable directly: drain, then one
+        blocking snapshot. Skips cleanly if that step is already on
+        disk (e.g. SIGTERM lands right after a periodic save)."""
+        self.wait()
+        step, tree, meta = state_fn()
+        if step in self.all_steps():
+            return
+        meta = dict(meta or {})
+        meta["preempted"] = True
+        self.save(int(step), tree, meta=meta, block=True)

@@ -233,13 +233,24 @@ class Trainer:
     # -- checkpoint ------------------------------------------------------ #
     def save_states(self, fname):
         """(parity: Trainer.save_states — optimizer state incl. momentum
-        buffers; SURVEY.md §5.4)."""
-        with open(fname, "wb") as f:
-            f.write(self._updaters[0].get_states(dump_optimizer=False))
+        buffers; SURVEY.md §5.4). Routed through the checkpoint
+        subsystem's capsule blob (crc32-checked, structure-free);
+        ``load_states`` auto-detects this and the legacy pickle layout
+        by magic byte, like utils/serialization.py does for params."""
+        from .. import checkpoint as _ckpt
+        tree, meta = _ckpt.updater_capsule(self._updaters[0])
+        _ckpt.save_capsule_file(fname, tree, meta)
 
     def load_states(self, fname):
+        from .. import checkpoint as _ckpt
         with open(fname, "rb") as f:
-            self._updaters[0].set_states(f.read())
+            payload = f.read()
+        if _ckpt.is_capsule_bytes(payload):
+            arrays, meta = _ckpt.load_capsule_bytes(payload)
+            _ckpt.restore_updater(self._updaters[0], self._params,
+                                  arrays, meta)
+        else:                            # legacy pickle .states payload
+            self._updaters[0].set_states(payload)
         self._optimizer = self._updaters[0].optimizer
         self._scale = self._optimizer.rescale_grad
         if self._fused is not None:
@@ -250,3 +261,37 @@ class Trainer:
             self._fuse_step = getattr(self._optimizer, "fusable", True)
             self._fused = opt_mod.FusedApplier(self._optimizer) \
                 if self._fuse_step else None
+
+    # -- elastic checkpointing (checkpoint/ subsystem) ------------------- #
+    def save_checkpoint(self, manager, step=None, iterator=None,
+                        block=False):
+        """Snapshot the FULL training capsule (params, optimizer state,
+        scheduler num_update, RNG, iterator position) into ``manager``
+        asynchronously. ``step`` defaults to the optimizer's update
+        count. Returns the step saved."""
+        from .. import checkpoint as _ckpt
+        tree, meta = _ckpt.trainer_capsule(self, iterator=iterator)
+        if step is None:
+            step = meta["step"]
+        manager.save(int(step), tree, meta=meta, block=block)
+        return int(step)
+
+    def restore_checkpoint(self, manager, step=None, iterator=None):
+        """Bit-exact resume from ``manager`` (default: latest committed
+        step). Returns the restored step."""
+        from .. import checkpoint as _ckpt
+        arrays, meta = manager.restore(step)
+        _ckpt.restore_trainer(self, arrays, meta, iterator=iterator)
+        return int(meta.get("step", 0))
+
+    def install_preemption(self, manager, iterator=None, exit_after=True):
+        """Arm SIGTERM: drain any in-flight snapshot and write one final
+        synchronous capsule before the process dies."""
+        from .. import checkpoint as _ckpt
+
+        def _state():
+            tree, meta = _ckpt.trainer_capsule(self, iterator=iterator)
+            return meta["step"], tree, meta
+
+        return manager.install_preemption_hook(_state,
+                                               exit_after=exit_after)

@@ -44,7 +44,11 @@ class DataBatch:
 
 
 class DataIter:
-    """Epoch-based iterator (parity: mx.io.DataIter)."""
+    """Epoch-based iterator (parity: mx.io.DataIter), extended with the
+    position-export contract the checkpoint capsule records
+    (docs/CHECKPOINTING.md): ``tell()`` returns a JSON-able dict,
+    ``set_position(state)`` restores it so resumed training replays the
+    exact remaining batch sequence."""
 
     def __init__(self, batch_size=0):
         self.batch_size = batch_size
@@ -54,6 +58,18 @@ class DataIter:
 
     def reset(self):
         pass
+
+    # -- resumable-position contract (checkpoint capsule) ----------- #
+    def tell(self) -> dict:
+        """Exportable position. Subclasses without one refuse loudly so
+        a capsule never silently records a non-resumable iterator."""
+        raise MXNetError(
+            f"{type(self).__name__} does not support position export; "
+            f"wrap data in NDArrayIter or add tell()/set_position()")
+
+    def set_position(self, state: dict):
+        raise MXNetError(
+            f"{type(self).__name__} does not support position restore")
 
     def __next__(self):
         return self.next()
@@ -78,6 +94,15 @@ class DataIter:
 
     def getpad(self):
         return 0
+
+
+def _draw_shuffle_seed() -> int:
+    """One int from the global RNG stream (same stream position cost as
+    the np_rng() the shuffles previously consumed) — the recorded seed
+    makes an epoch's shuffle order reproducible from O(1) state."""
+    import jax
+    from .. import random as _random
+    return int(jax.device_get(_random.new_key())[0]) & 0x7FFFFFFF
 
 
 def _to_nd_list(arrs) -> List[NDArray]:
@@ -109,6 +134,7 @@ class NDArrayIter(DataIter):
         self._last = last_batch_handle
         self.num_data = self._data[0].shape[0] if self._data else 0
         self._order = np.arange(self.num_data)
+        self._shuffle_seed = None
         self._cursor = -batch_size
         self.reset()
 
@@ -124,8 +150,13 @@ class NDArrayIter(DataIter):
 
     def reset(self):
         if self._shuffle:
-            from .. import random as _random
-            _random.np_rng().shuffle(self._order)
+            # seed-recorded shuffle of a FRESH arange: the epoch order
+            # is then a pure function of one int, so tell() exports it
+            # O(1) instead of serializing the whole permutation into
+            # every checkpoint (millions of ints of JSON at scale)
+            self._shuffle_seed = _draw_shuffle_seed()
+            self._order = np.arange(self.num_data)
+            np.random.RandomState(self._shuffle_seed).shuffle(self._order)
         # roll_over: a short tail is not emitted at epoch end; its samples
         # are prepended to the first batch of the next epoch (reference
         # NDArrayIter contract)
@@ -166,6 +197,24 @@ class NDArrayIter(DataIter):
             return end - self.num_data
         return 0
 
+    def tell(self) -> dict:
+        # the epoch's shuffle seed travels with the cursor (O(1) state)
+        # so a mid-epoch resume re-derives the same remaining samples
+        return {"cursor": int(self._cursor), "num_data": self.num_data,
+                "shuffle_seed": self._shuffle_seed}
+
+    def set_position(self, state: dict):
+        if state.get("num_data") is not None and \
+                int(state["num_data"]) != self.num_data:
+            raise MXNetError(
+                f"iterator position is for {state['num_data']} samples, "
+                f"this iterator has {self.num_data}")
+        if state.get("shuffle_seed") is not None:
+            self._shuffle_seed = int(state["shuffle_seed"])
+            self._order = np.arange(self.num_data)
+            np.random.RandomState(self._shuffle_seed).shuffle(self._order)
+        self._cursor = int(state["cursor"])
+
 
 class CSVIter(DataIter):
     """CSV reader (parity: mx.io.CSVIter, reference src/io/iter_csv.cc)."""
@@ -193,6 +242,12 @@ class CSVIter(DataIter):
 
     def iter_next(self):
         return self._inner.iter_next()
+
+    def tell(self) -> dict:
+        return self._inner.tell()
+
+    def set_position(self, state: dict):
+        self._inner.set_position(state)
 
 
 class LibSVMIter(DataIter):
@@ -260,6 +315,12 @@ class LibSVMIter(DataIter):
 
     def iter_next(self):
         return self._cursor < self._num
+
+    def tell(self) -> dict:
+        return {"cursor": int(self._cursor)}
+
+    def set_position(self, state: dict):
+        self._cursor = int(state["cursor"])
 
     def _rows(self, lo, hi):
         """CSR slice [lo, hi) as an (batch_size, feat_dim) CSRNDArray;
@@ -332,6 +393,12 @@ class MNISTIter(DataIter):
     def iter_next(self):
         return self._inner.iter_next()
 
+    def tell(self) -> dict:
+        return self._inner.tell()
+
+    def set_position(self, state: dict):
+        self._inner.set_position(state)
+
 
 class ImageRecordIter(DataIter):
     """RecordIO image iterator (parity: mx.io.ImageRecordIter, reference
@@ -379,13 +446,14 @@ class ImageRecordIter(DataIter):
             idx = idx[part_index::num_parts]
         self._indices = idx
         self._order = np.array(idx)
+        self._shuffle_seed = None
         self.reset()
 
     def reset(self):
         if self._shuffle:
-            from .. import random as _random
+            self._shuffle_seed = _draw_shuffle_seed()
             self._order = np.array(self._indices)
-            _random.np_rng().shuffle(self._order)
+            np.random.RandomState(self._shuffle_seed).shuffle(self._order)
         self._cursor = 0
 
     def _read_records(self, ids):
@@ -429,6 +497,23 @@ class ImageRecordIter(DataIter):
 
     def iter_next(self):
         return self._cursor < len(self._order)
+
+    def tell(self) -> dict:
+        return {"cursor": int(self._cursor),
+                "num_records": len(self._indices),
+                "shuffle_seed": self._shuffle_seed}
+
+    def set_position(self, state: dict):
+        if state.get("num_records") is not None and \
+                int(state["num_records"]) != len(self._indices):
+            raise MXNetError(
+                f"iterator position is for {state['num_records']} "
+                f"records, this record set has {len(self._indices)}")
+        if state.get("shuffle_seed") is not None:
+            self._shuffle_seed = int(state["shuffle_seed"])
+            self._order = np.array(self._indices)
+            np.random.RandomState(self._shuffle_seed).shuffle(self._order)
+        self._cursor = int(state["cursor"])
 
     def next(self):
         if not self.iter_next():
@@ -481,10 +566,25 @@ class ResizeIter(DataIter):
             self._iter.reset()
             return self._iter.next()
 
+    def tell(self) -> dict:
+        return {"cur": int(self._cur), "inner": self._iter.tell()}
+
+    def set_position(self, state: dict):
+        self._cur = int(state["cur"])
+        if state.get("inner") is not None:
+            self._iter.set_position(state["inner"])
+
 
 class PrefetchingIter(DataIter):
     """Background-thread prefetch wrapper (parity: mx.io.PrefetchingIter,
-    reference dmlc ThreadedIter double-buffering)."""
+    reference dmlc ThreadedIter double-buffering).
+
+    Resumable: the producer thread runs AHEAD of the consumer, so the
+    inner iterator's own position is meaningless mid-stream; instead
+    the wrapper counts batches actually DELIVERED to the consumer.
+    ``set_position`` resets the inner iterator and replays that many
+    batches before restarting the prefetch thread — O(position) on
+    resume, zero overhead on the hot path."""
 
     def __init__(self, iters, rename_data=None, rename_label=None):
         import queue
@@ -496,7 +596,18 @@ class PrefetchingIter(DataIter):
         self._thread = None
         self._cancel = None
         self._exhausted = False
+        self._delivered = 0
+        self._epoch_start = self._try_tell()
         self._start()
+
+    def _try_tell(self):
+        """The inner iterator's position at the point the producer
+        starts — replayed on resume so a shuffled inner iterator
+        re-walks the SAME epoch order instead of reshuffling."""
+        try:
+            return self._iter.tell()
+        except MXNetError:
+            return None
 
     def _start(self):
         import threading
@@ -524,7 +635,7 @@ class PrefetchingIter(DataIter):
         self._thread = threading.Thread(target=run, daemon=True)
         self._thread.start()
 
-    def reset(self):
+    def _stop_producer(self):
         # cancel the old producer FIRST, then drain so its pending put
         # unblocks; only one thread ever touches self._iter at a time
         self._cancel.set()
@@ -536,7 +647,12 @@ class PrefetchingIter(DataIter):
         self._thread.join()
         while not self._queue.empty():
             self._queue.get_nowait()
+
+    def reset(self):
+        self._stop_producer()
         self._iter.reset()
+        self._delivered = 0
+        self._epoch_start = self._try_tell()
         self._start()
 
     def next(self):
@@ -548,7 +664,37 @@ class PrefetchingIter(DataIter):
             raise StopIteration
         if isinstance(item, Exception):
             raise item
+        self._delivered += 1
         return item
+
+    def tell(self) -> dict:
+        if self._epoch_start is None:
+            # without the inner's epoch-start state, a resume would
+            # reset() the inner (reshuffling it) and replay a DIFFERENT
+            # sample order — refuse loudly rather than record a
+            # position that silently diverges
+            raise MXNetError(
+                f"PrefetchingIter over "
+                f"{type(self._iter).__name__} is not resumable: the "
+                f"inner iterator does not support tell()")
+        return {"delivered": int(self._delivered),
+                "epoch_start": self._epoch_start}
+
+    def set_position(self, state: dict):
+        n = int(state["delivered"])
+        self._stop_producer()
+        self._iter.set_position(state["epoch_start"])
+        self._epoch_start = state["epoch_start"]
+        for _ in range(n):          # replay up to the delivered batch
+            try:
+                self._iter.next()
+            except StopIteration:
+                raise MXNetError(
+                    f"cannot restore PrefetchingIter position "
+                    f"{n}: inner iterator exhausted early")
+        self._delivered = n
+        self._exhausted = False
+        self._start()
 
 
 def ImageDetRecordIter(**kwargs):

@@ -329,17 +329,34 @@ class Module(BaseModule):
             for i, n in enumerate(self._param_names)}
         preload = getattr(self, "_preload_opt_states", None)
         if preload is not None:
-            import pickle
-
             import jax.tree_util as jtu
 
+            from .. import checkpoint as _ckpt
+
             with open(preload, "rb") as f:
-                saved = pickle.load(f)
-            for n, s in saved.items():
-                if n in self._opt_states:
-                    self._opt_states[n] = jtu.tree_map(
-                        lambda a: NDArray(_as_jax(a))
-                        if not isinstance(a, NDArray) else a, s)
+                payload = f.read()
+            if _ckpt.is_capsule_bytes(payload):
+                arrays, meta = _ckpt.load_capsule_bytes(payload)
+                for n, count in (meta.get("opt_leaf_counts")
+                                 or {}).items():
+                    if n in self._opt_states:
+                        self._opt_states[n] = _ckpt.fill_state(
+                            self._opt_states[n], arrays, f"opt/{n}",
+                            expect=int(count))
+                self._optimizer.num_update = int(
+                    meta.get("num_update", 0))
+                self._optimizer._index_update_count = {
+                    int(k): int(v) for k, v in
+                    (meta.get("index_update_count") or {}).items()}
+            else:                        # legacy pickle .states payload
+                import pickle
+
+                saved = pickle.loads(payload)
+                for n, s in saved.items():
+                    if n in self._opt_states:
+                        self._opt_states[n] = jtu.tree_map(
+                            lambda a: NDArray(_as_jax(a))
+                            if not isinstance(a, NDArray) else a, s)
             self._preload_opt_states = None
         self.optimizer_initialized = True
 
@@ -400,11 +417,28 @@ class Module(BaseModule):
         arg, aux = self.get_params()
         save_checkpoint(prefix, epoch, self._symbol, arg, aux)
         if save_optimizer_states:
-            import pickle
+            # routed through the checkpoint subsystem's capsule blob
+            # (crc32-checked; magic-dispatched on load so legacy pickle
+            # .states files keep working — SURVEY.md §5.4)
+            from .. import checkpoint as _ckpt
 
-            with open(f"{prefix}-{epoch:04d}.states", "wb") as f:
-                pickle.dump({n: _state_np(s)
-                             for n, s in self._opt_states.items()}, f)
+            tree, leaf_counts = {}, {}
+            for n, s in self._opt_states.items():
+                leaves, _ = _ckpt.flatten_state(s)
+                leaf_counts[n] = len(leaves)
+                for j, leaf in enumerate(leaves):
+                    tree[f"opt/{n}/{j}"] = leaf
+            meta = {"kind": "module-states",
+                    "opt_leaf_counts": leaf_counts,
+                    "num_update": int(self._optimizer.num_update),
+                    # per-param update counts MUST travel too:
+                    # Adam/LAMB bias correction restarts at t=1
+                    # without them while momenta hold late-step values
+                    "index_update_count": {
+                        str(k): int(v) for k, v in
+                        self._optimizer._index_update_count.items()}}
+            _ckpt.save_capsule_file(f"{prefix}-{epoch:04d}.states",
+                                    tree, meta)
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
@@ -418,11 +452,3 @@ class Module(BaseModule):
         if load_optimizer_states:
             mod._preload_opt_states = f"{prefix}-{epoch:04d}.states"
         return mod
-
-
-def _state_np(state):
-    import jax.tree_util as jtu
-
-    return jtu.tree_map(
-        lambda a: np.asarray(a._data if isinstance(a, NDArray) else a),
-        state)

@@ -240,6 +240,12 @@ class NDArray:
         d = _to_jnp_dtype(dtype)
         if not copy and self.dtype == d:
             return self
+        from .. import autograd as _ag
+        if _ag.is_recording():
+            # route through the registered Cast op so the dtype change
+            # lands on the tape — a bare jnp astype severs gradient
+            # flow through every mixed-precision forward
+            return self._op("cast", dtype=d)
         return NDArray(self._data.astype(d))
 
     def tostype(self, stype: str) -> "NDArray":

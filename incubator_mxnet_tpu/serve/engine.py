@@ -125,6 +125,17 @@ class InferenceEngine:
         self._kpools = tuple(k for k, _ in pools)
         self._vpools = tuple(v for _, v in pools)
 
+        # model params are TRACED INPUTS of the decode/prefill programs
+        # (not closure constants): warm-restarting new weights into a
+        # live engine is then pure data — the jitted decode step is
+        # reused at compile count 1 (see warm_start / test_serve.py)
+        self._eng_params = [p for p in model.collect_params().values()]
+        not_ready = [p.name for p in self._eng_params if p._data is None]
+        if not_ready:
+            raise MXNetError(f"uninitialized model parameters "
+                             f"{not_ready}; call model.initialize()")
+        self._param_vals = tuple(p.data()._data for p in self._eng_params)
+
         self._mesh = None
         if mesh is not None and dict(mesh.shape).get("tp", 1) > 1:
             # H-axis tp sharding through parallel.mesh; the step's jnp
@@ -152,8 +163,9 @@ class InferenceEngine:
         self.decode_trace_count = 0
         self.prefill_trace_count = 0
         self.decode_steps = 0
+        self.warm_restarts = 0
         self._decode_step = jax.jit(self._decode_step_fn,
-                                    donate_argnums=(0, 1))
+                                    donate_argnums=(1, 2))
         self._prefill_jits = {}          # bucket_pages -> jitted fn
 
     # ------------------------------------------------------------- #
@@ -173,6 +185,26 @@ class InferenceEngine:
 
         return jax.vmap(one)(logits, temps, keys).astype(jnp.int32)
 
+    def _bind_params(self, param_vals):
+        """Context manager: point every model Parameter at the traced
+        ``param_vals`` for the duration of the model math (the
+        SPMDTrainer pure_loss idiom), restoring the eager arrays after.
+        This is what makes weights DATA to the compiled programs."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def scope():
+            saved = [p._data for p in self._eng_params]
+            for p, v in zip(self._eng_params, param_vals):
+                p._data = NDArray(v)
+            try:
+                yield
+            finally:
+                for p, s in zip(self._eng_params, saved):
+                    p._data = s
+
+        return scope()
+
     def _ragged_attn(self, q, kp, vp, page_table, lengths):
         if self._mesh is not None:
             return ragged_attention_reference(q, kp, vp, page_table,
@@ -180,10 +212,11 @@ class InferenceEngine:
         return ragged_paged_attention(q, kp, vp, page_table, lengths,
                                       interpret=self._interpret)
 
-    def _decode_step_fn(self, kpools, vpools, tokens, page_table,
-                        lengths, temps, key):
+    def _decode_step_fn(self, param_vals, kpools, vpools, tokens,
+                        page_table, lengths, temps, key):
         """ONE decode token for every slot. All array shapes are fixed
-        by (num_slots, max_pages, model) — occupancy is data."""
+        by (num_slots, max_pages, model) — occupancy AND weights are
+        data."""
         self.decode_trace_count += 1         # trace-time only
         from ..gluon.block import _hybrid_trace_scope
         from .. import autograd
@@ -197,7 +230,7 @@ class InferenceEngine:
         write_page = page_table[jnp.arange(S), pos // ps]   # NULL if dead
         write_off = pos % ps
 
-        with _hybrid_trace_scope(), \
+        with self._bind_params(param_vals), _hybrid_trace_scope(), \
                 autograd._ModeScope(recording=False, training=False):
             x = model.word_embed(NDArray(tokens[:, None])) + \
                 model.position_embed(NDArray(pos[:, None]))
@@ -228,7 +261,8 @@ class InferenceEngine:
         new_lengths = jnp.where(act, lengths + 1, 0)
         return tuple(new_k), tuple(new_v), nxt, new_lengths
 
-    def _prefill_fn(self, kpools, vpools, ids, t0, pages, temp, key):
+    def _prefill_fn(self, param_vals, kpools, vpools, ids, t0, pages,
+                    temp, key):
         """Prompt forward for ONE request (ids (1, Tpad) padded): dense
         causal attention inside the prompt (the prompt attends only
         itself), K/V scattered into the slot's pages, and the FIRST
@@ -243,7 +277,7 @@ class InferenceEngine:
 
         model = self.model
         Tpad = ids.shape[1]
-        with _hybrid_trace_scope(), \
+        with self._bind_params(param_vals), _hybrid_trace_scope(), \
                 autograd._ModeScope(recording=False, training=False):
             pos = NDArray(lax.broadcasted_iota(jnp.int32, (1, Tpad), 1))
             x = model.word_embed(NDArray(ids)) + model.position_embed(pos)
@@ -338,10 +372,10 @@ class InferenceEngine:
             pages_arr[:prompt_pages] = pages
             fn = self._prefill_jits.get(bucket)
             if fn is None:
-                fn = jax.jit(self._prefill_fn, donate_argnums=(0, 1))
+                fn = jax.jit(self._prefill_fn, donate_argnums=(1, 2))
                 self._prefill_jits[bucket] = fn
             self._kpools, self._vpools, tok = fn(
-                self._kpools, self._vpools, ids,
+                self._param_vals, self._kpools, self._vpools, ids,
                 np.int32(t0), pages_arr,
                 np.float32(req.temperature), self._next_key())
             tok = int(np.asarray(tok))
@@ -381,8 +415,9 @@ class InferenceEngine:
             tokens[s] = self._slots[s].request.token_ids[-1]
         t_start = time.perf_counter()
         self._kpools, self._vpools, nxt, lengths = self._decode_step(
-            self._kpools, self._vpools, tokens, self._page_table.copy(),
-            self._lengths.copy(), self._temps.copy(), self._next_key())
+            self._param_vals, self._kpools, self._vpools, tokens,
+            self._page_table.copy(), self._lengths.copy(),
+            self._temps.copy(), self._next_key())
         nxt = np.asarray(nxt)                # host sync point
         self._lengths = np.asarray(lengths).copy()
         dt = time.perf_counter() - t_start
@@ -391,6 +426,82 @@ class InferenceEngine:
             if self._finish_token(s, nxt[s], dt):
                 self._evict(s)
         return len(live)
+
+    # ------------------------------------------------------------- #
+    # elastic checkpointing / warm restart (checkpoint/ subsystem)
+    # ------------------------------------------------------------- #
+
+    def warm_start(self, params=None, manager=None, step=None) -> None:
+        """Swap new model weights into the LIVE engine without
+        retracing: weights are traced inputs of the decode/prefill
+        programs, so as long as shapes and dtypes match, the compiled
+        steps are reused as-is (``decode_trace_count`` stays put —
+        asserted in tests/test_serve.py).
+
+        ``params``: dict keyed by Parameter name (a training capsule's
+        ``param/`` entries also accepted), or pass ``manager`` (+
+        optional ``step``) to pull the latest committed training
+        capsule straight from a CheckpointManager.
+        """
+        import jax.numpy as jnp
+        if params is None:
+            if manager is None:
+                raise MXNetError("warm_start needs params or a "
+                                 "CheckpointManager")
+            params, _meta = manager.restore(step)
+        # a training capsule also carries opt/<i>/<j> and rng/key
+        # entries — when param/ keys exist, ONLY they are weights;
+        # otherwise the dict itself is the name→array mapping
+        items = {k[len("param/"):]: v for k, v in params.items()
+                 if k.startswith("param/")} or params
+        flat = {}
+        for name, v in items.items():
+            flat[name] = v._data if isinstance(v, NDArray) else np.asarray(v)
+        positional = all(n.isdigit() for n in flat)
+        for i, p in enumerate(self._eng_params):
+            # capsules key params positionally ("param/<i>", construction
+            # order); plain dicts may key by Parameter name
+            lookup = str(i) if positional else p.name
+            if lookup not in flat:
+                raise MXNetError(f"warm_start: no value for parameter "
+                                 f"{i} ('{p.name}')")
+            new = jnp.asarray(flat[lookup])    # one conversion, reused
+            cur = p.data()._data
+            if new.shape != cur.shape or new.dtype != cur.dtype:
+                raise MXNetError(
+                    f"warm_start: parameter '{p.name}' is "
+                    f"{str(cur.dtype)}{tuple(cur.shape)} but new value "
+                    f"is {str(new.dtype)}{tuple(new.shape)}"
+                    f" — shape/dtype changes require a new engine")
+            p.data()._data = new
+        self._param_vals = tuple(p.data()._data
+                                 for p in self._eng_params)
+        self.warm_restarts += 1
+
+    def save_checkpoint(self, manager, step=None, block=False) -> int:
+        """Snapshot the serving weights into ``manager`` (async) so a
+        replacement process can ``warm_start(manager=...)``."""
+        tree = {f"param/{i}": p.data()
+                for i, p in enumerate(self._eng_params)}
+        meta = {"kind": "serve",
+                "param_names": [p.name for p in self._eng_params],
+                "step": int(step if step is not None
+                            else self.decode_steps)}
+        manager.save(int(meta["step"]), tree, meta=meta, block=block)
+        return int(meta["step"])
+
+    def install_preemption(self, manager, exit_after=True):
+        """SIGTERM → drain in-flight snapshot + final sync weight save
+        (the serving tier's preemption contract)."""
+
+        def _state():
+            tree = {f"param/{i}": p.data()
+                    for i, p in enumerate(self._eng_params)}
+            return self.decode_steps, tree, {"kind": "serve",
+                                             "step": self.decode_steps}
+
+        return manager.install_preemption_hook(_state,
+                                               exit_after=exit_after)
 
     def run(self, requests, arrival_times=None, poll_sleep=1e-3):
         """Drive ``requests`` to completion. ``arrival_times`` (seconds,

@@ -229,7 +229,10 @@ def _load_mxnet(fname: str):
 
 def load_ndarrays(fname: str):
     """Returns dict name→NDArray (or list if names are all indices).
-    Format auto-detected: native MXTPU, or reference ``.params``."""
+    Format auto-detected: native MXTPU, reference ``.params``, or a
+    checkpoint-capsule blob (its ``param/``-prefixed entries are
+    returned keyed by Parameter name, so ``collect_params().load``-style
+    consumers can open training capsules too)."""
     from ..ndarray import NDArray
     import jax.numpy as jnp
     import ml_dtypes
@@ -240,6 +243,22 @@ def load_ndarrays(fname: str):
             if (len(magic) == 8
                     and struct.unpack("<Q", magic)[0] == _MX_LIST_MAGIC):
                 return _load_mxnet(fname)
+            from ..checkpoint import capsule as _capsule
+            if magic == _capsule.CAPSULE_MAGIC:
+                arrays, meta = _capsule.load_capsule_file(fname)
+                names = meta.get("param_names") or []
+                out = {}
+                for key, a in arrays.items():
+                    if not key.startswith("param/"):
+                        continue
+                    idx = key[len("param/"):]
+                    name = names[int(idx)] \
+                        if idx.isdigit() and int(idx) < len(names) else idx
+                    out[name] = NDArray(jnp.asarray(a))
+                if not out:   # capsule without params: expose raw entries
+                    out = {k: NDArray(jnp.asarray(v))
+                           for k, v in arrays.items()}
+                return out
             raise MXNetError(
                 f"{fname}: neither a MXTPU params file nor a MXNet 1.x "
                 f".params file")

@@ -394,3 +394,27 @@ def test_create_graph_matches_first_order_semantics():
         s3 = g3.sum()
     s3.backward()
     np.testing.assert_allclose(x3.grad.asnumpy(), [24.0])  # 12 * 2
+
+
+def test_astype_records_cast_on_tape():
+    """Regression: NDArray.astype used to build a raw NDArray outside
+    the tape, silently severing gradient flow through every
+    mixed-precision forward (f32 -> f16 -> f32 trained nothing, with
+    only a stale-grad warning as the symptom). Inside record(), astype
+    must route through the Cast op so gradients flow end-to-end."""
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        h = x.astype("float16")
+        y = ((h * h).astype("float32")).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0, 4.0, 6.0],
+                               rtol=1e-3)
+    # f16 leaf parameters get real gradients through the cast chain
+    w = nd.array(np.float16([2.0, 3.0]), dtype="float16")
+    w.attach_grad()
+    with autograd.record():
+        z = (w.astype("float32") * nd.array([5.0, 7.0])).sum()
+    z.backward()
+    np.testing.assert_allclose(w.grad.astype("float32").asnumpy(),
+                               [5.0, 7.0])

@@ -1,0 +1,503 @@
+"""Elastic checkpointing subsystem tests (checkpoint/).
+
+Load-bearing claims: (1) commits are ATOMIC — a kill -9 mid-write can
+never yield a loadable torn checkpoint, the previous committed step
+always survives; (2) corruption fails LOUDLY with the shard named;
+(3) resume through the full capsule is BIT-EXACT for gluon.Trainer
+(multi-dtype fused groups + stepped lr scheduler — the PR 1 review
+fixes end-to-end) and for SPMDTrainer under dp2 and fsdp2; (4) the
+SIGTERM hook drains the in-flight snapshot and writes a final one;
+(5) serve warm-restart reuses the compiled decode step
+(tests/test_serve.py::test_warm_restart_*)."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.base import MXNetError
+from incubator_mxnet_tpu import checkpoint as ckpt
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.io import NDArrayIter, PrefetchingIter, ResizeIter
+from incubator_mxnet_tpu.optimizer.lr_scheduler import FactorScheduler
+
+
+# ------------------------------------------------------------------ #
+# manifest format
+# ------------------------------------------------------------------ #
+
+def test_manifest_roundtrip_and_gc(tmp_path):
+    import jax.numpy as jnp
+    root = str(tmp_path)
+    m = ckpt.CheckpointManager(root, keep=2)
+    tree = {"w": jnp.arange(24.0).reshape(4, 6),
+            "b": np.arange(3, dtype=np.int32),
+            "s": np.float32(2.5)}
+    for s in (1, 2, 3):
+        m.save(s, tree, meta={"tag": s}, block=True)
+    assert m.all_steps() == [2, 3]          # keep-last-2 GC ran
+    arrays, meta = m.restore()
+    assert meta["tag"] == 3
+    np.testing.assert_array_equal(arrays["w"],
+                                  np.arange(24.0).reshape(4, 6))
+    np.testing.assert_array_equal(arrays["b"], np.arange(3))
+    assert arrays["s"].shape == ()
+    # explicit step
+    arrays2, meta2 = m.restore(step=2)
+    assert meta2["tag"] == 2
+    m.close()
+
+
+def test_async_writer_commits_and_one_in_flight(tmp_path):
+    import jax.numpy as jnp
+    m = ckpt.CheckpointManager(str(tmp_path), keep=0)
+    tree = {"x": jnp.ones((64, 64))}
+    for s in range(4):
+        m.save(s, tree)                     # async; bounded at 1 in flight
+    m.wait()
+    assert m.all_steps() == [0, 1, 2, 3]
+    m.close()
+
+
+def test_background_write_error_surfaces(tmp_path):
+    import jax.numpy as jnp
+    m = ckpt.CheckpointManager(str(tmp_path), keep=0)
+    tree = {"x": jnp.ones((4,))}
+    m.save(7, tree, block=True)
+    m.save(7, tree)                         # async duplicate -> fails
+    with pytest.raises(MXNetError, match="background checkpoint write"):
+        m.wait()
+        m.save(8, tree)                     # error also reported here
+        m.wait()
+    m.close()
+
+
+def test_torn_tmp_and_manifestless_dirs_ignored(tmp_path):
+    import jax.numpy as jnp
+    root = str(tmp_path)
+    m = ckpt.CheckpointManager(root, keep=0)
+    m.save(5, {"x": jnp.ones((8,))}, block=True)
+    # a kill mid-write leaves a .tmp dir; a stray dir without manifest
+    # must also never be offered for restore
+    os.makedirs(os.path.join(root, "step_00000006.tmp"))
+    with open(os.path.join(root, "step_00000006.tmp", "shards_p0.bin"),
+              "wb") as f:
+        f.write(b"\x00" * 128)
+    os.makedirs(os.path.join(root, "step_00000007"))
+    assert m.all_steps() == [5]
+    arrays, _ = m.restore()
+    assert "x" in arrays
+    m.close()
+
+
+def test_stale_tmp_from_aborted_attempt_is_cleared(tmp_path):
+    """Regression: re-saving a step whose earlier attempt died mid-write
+    must NOT commit the aborted attempt's leftover rank files — their
+    manifests would merge after ours at load and overwrite fresh data."""
+    import jax.numpy as jnp
+    root = str(tmp_path)
+    stale = ckpt.step_dir(root, 4) + ".tmp"
+    os.makedirs(stale)
+    with open(os.path.join(stale, "shards_p1.bin"), "wb") as f:
+        f.write(b"\xde\xad" * 64)
+    with open(os.path.join(stale, "manifest.p1.json"), "w") as f:
+        f.write('{"arrays": {"w": {"shape": [4], "dtype": "float32", '
+                '"shards": [{"file": "shards_p1.bin", "offset": 0, '
+                '"nbytes": 16, "crc32": 0, "index": [[0, 4]]}]}}, '
+                '"meta": {}}')
+    m = ckpt.CheckpointManager(root, keep=0)
+    m.save(4, {"w": jnp.arange(4.0)}, block=True)
+    committed = ckpt.step_dir(root, 4)
+    assert not os.path.exists(os.path.join(committed, "shards_p1.bin"))
+    arrays, _ = m.restore(step=4)
+    np.testing.assert_array_equal(arrays["w"], np.arange(4.0))
+    m.close()
+
+
+def test_corrupt_shard_fails_loudly_naming_shard(tmp_path):
+    import jax.numpy as jnp
+    root = str(tmp_path)
+    m = ckpt.CheckpointManager(root, keep=0)
+    m.save(1, {"w": jnp.arange(256.0), "v": jnp.ones((16,))}, block=True)
+    shard = os.path.join(ckpt.step_dir(root, 1), "shards_p0.bin")
+    with open(shard, "r+b") as f:           # flip one byte mid-file
+        f.seek(100)
+        b = f.read(1)
+        f.seek(100)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(MXNetError) as ei:
+        m.restore(step=1)
+    msg = str(ei.value)
+    assert "shards_p0.bin" in msg and "crc32" in msg
+    m.close()
+
+
+def test_missing_shard_file_fails_loudly(tmp_path):
+    import jax.numpy as jnp
+    root = str(tmp_path)
+    m = ckpt.CheckpointManager(root, keep=0)
+    m.save(1, {"w": jnp.ones((8, 8))}, block=True)
+    os.remove(os.path.join(ckpt.step_dir(root, 1), "shards_p0.bin"))
+    with pytest.raises(MXNetError, match="shards_p0.bin"):
+        m.restore(step=1)
+    m.close()
+
+
+def test_kill9_mid_shard_previous_commit_survives(tmp_path):
+    """Fault injection: SIGKILL the process while the background writer
+    is mid-shard (deterministically, via the MXTPU_CKPT_WRITE_DELAY
+    throttle hook). The previously committed step must load; the torn
+    step must be invisible."""
+    root = str(tmp_path / "ckpts")
+    script = f"""
+import os
+os.environ['JAX_PLATFORMS'] = 'cpu'
+import numpy as np
+from incubator_mxnet_tpu import checkpoint as ckpt
+m = ckpt.CheckpointManager({root!r}, keep=0)
+tree1 = {{f'a{{i}}': np.full((32,), i, np.float32) for i in range(8)}}
+m.save(1, tree1, meta={{'ok': True}}, block=True)
+print('COMMITTED', flush=True)
+os.environ['MXTPU_CKPT_WRITE_DELAY'] = '0.05'
+big = {{f'b{{i}}': np.full((64,), i, np.float32) for i in range(200)}}
+m.save(2, big)                       # async: ~10s of throttled writing
+print('WRITING', flush=True)
+import time; time.sleep(60)
+"""
+    proc = subprocess.Popen([sys.executable, "-c", script],
+                            stdout=subprocess.PIPE, cwd=os.path.dirname(
+                                os.path.dirname(os.path.abspath(__file__))),
+                            text=True)
+    try:
+        tmp_dir = ckpt.step_dir(root, 2) + ".tmp"
+        deadline = time.time() + 120
+        saw_writing = False
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if "WRITING" in line:
+                saw_writing = True
+                break
+        assert saw_writing, "child never started the async write"
+        # wait until at least one shard byte of the torn step is on disk
+        shard = os.path.join(tmp_dir, "shards_p0.bin")
+        while time.time() < deadline:
+            if os.path.exists(shard) and os.path.getsize(shard) > 0:
+                break
+            time.sleep(0.01)
+        proc.kill()                          # SIGKILL mid-shard
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert os.path.exists(tmp_dir), "expected a torn .tmp dir"
+    assert ckpt.list_steps(root) == [1], "torn step leaked into commits"
+    arrays, meta = ckpt.load_step(root, 1)
+    assert meta == {"ok": True}
+    for i in range(8):
+        np.testing.assert_array_equal(arrays[f"a{i}"],
+                                      np.full((32,), i, np.float32))
+
+
+# ------------------------------------------------------------------ #
+# bit-exact resume: gluon.Trainer (multi-dtype fused + scheduler)
+# ------------------------------------------------------------------ #
+
+_RNG = np.random.RandomState(0)
+_X = _RNG.randn(80, 8).astype(np.float32)
+_Y = _RNG.randn(80, 8).astype(np.float32)
+
+
+def _make_trainer(seed):
+    """Two dtype groups (f32 + f16 Dense) + a stepped FactorScheduler:
+    resuming through the capsule must reproduce an uninterrupted run
+    exactly — this guards BOTH PR 1 review fixes (hoisted multi-group
+    scheduler lr read; fused applier rebind on load) end-to-end."""
+    mx.random.seed(seed)
+    net = nn.Sequential()
+    net.add(nn.Dense(16, in_units=8))
+    net.add(nn.Dense(8, in_units=16))
+    net.initialize()
+    for p in net[1].collect_params().values():
+        p.cast("float16")
+    sched = FactorScheduler(step=3, factor=0.5)
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 1e-2, "lr_scheduler": sched},
+                       kvstore=None, fuse_step=True)
+    return net, tr
+
+
+def _train_steps(net, tr, it, n, loss_fn):
+    out = []
+    for _ in range(n):
+        b = it.next()
+        x, y = b.data[0], b.label[0]
+        with autograd.record():
+            # explicit activation casts around the f16 layer: the
+            # supported mixed-precision idiom (astype records a Cast on
+            # the tape, so f16 params get real gradients)
+            h = net[0](x).astype("float16")
+            L = loss_fn(net[1](h).astype("float32"), y)
+        L.backward()
+        tr.step(x.shape[0])
+        out.append(float(L.mean().asnumpy()))
+    return out
+
+
+def test_trainer_capsule_resume_bit_exact_multi_dtype_scheduler(tmp_path):
+    loss_fn = gluon.loss.L2Loss()
+    net, tr = _make_trainer(0)
+    it = NDArrayIter(_X, _Y, batch_size=8, shuffle=True)
+    ref = _train_steps(net, tr, it, 8, loss_fn)
+    assert tr._fused is not None and len(tr._fused._jits) >= 2, \
+        "test needs >= 2 fused dtype groups"
+
+    net2, tr2 = _make_trainer(0)
+    it2 = NDArrayIter(_X, _Y, batch_size=8, shuffle=True)
+    _ = _train_steps(net2, tr2, it2, 4, loss_fn)
+    m = ckpt.CheckpointManager(str(tmp_path), keep=3)
+    saved = tr2.save_checkpoint(m, iterator=it2)
+    m.wait()
+    assert m.all_steps() == [saved]
+
+    # "new process": different seed so any missed restore diverges
+    net3, tr3 = _make_trainer(99)
+    it3 = NDArrayIter(_X, _Y, batch_size=8, shuffle=True)
+    got = tr3.restore_checkpoint(m, iterator=it3)
+    assert got == saved
+    res = _train_steps(net3, tr3, it3, 4, loss_fn)
+    assert res == ref[4:], (
+        f"resume diverged: {res} vs uninterrupted {ref[4:]}")
+    assert tr3._optimizer.num_update == tr._optimizer.num_update
+    assert tr3.learning_rate == tr.learning_rate
+    m.close()
+
+
+def test_save_states_routes_through_capsule_and_reads_legacy(tmp_path):
+    loss_fn = gluon.loss.L2Loss()
+    net, tr = _make_trainer(0)
+    it = NDArrayIter(_X, _Y, batch_size=8)
+    _train_steps(net, tr, it, 2, loss_fn)
+    fname = str(tmp_path / "t.states")
+    tr.save_states(fname)
+    with open(fname, "rb") as f:
+        assert f.read(8) == ckpt.CAPSULE_MAGIC   # new on-disk format
+    net2, tr2 = _make_trainer(0)
+    _train_steps(net2, tr2, NDArrayIter(_X, _Y, batch_size=8), 1, loss_fn)
+    tr2.load_states(fname)
+    assert tr2._optimizer.num_update == tr._optimizer.num_update
+    for i, st in tr._updaters[0].states.items():
+        got = tr2._updaters[0].states[i]
+        import jax.tree_util as jtu
+        for a, b in zip(jtu.tree_leaves(st, is_leaf=ckpt.capsule._is_nd),
+                        jtu.tree_leaves(got,
+                                        is_leaf=ckpt.capsule._is_nd)):
+            np.testing.assert_array_equal(a.asnumpy(), b.asnumpy())
+    # legacy pickle payloads still load (magic-byte dispatch)
+    legacy = str(tmp_path / "legacy.states")
+    with open(legacy, "wb") as f:
+        f.write(tr._updaters[0].get_states(dump_optimizer=False))
+    net3, tr3 = _make_trainer(0)
+    _train_steps(net3, tr3, NDArrayIter(_X, _Y, batch_size=8), 1, loss_fn)
+    tr3.load_states(legacy)
+    assert tr3._optimizer.num_update == tr._optimizer.num_update
+
+
+def test_load_ndarrays_opens_capsule_blob(tmp_path):
+    net, tr = _make_trainer(0)
+    it = NDArrayIter(_X, _Y, batch_size=8)
+    _train_steps(net, tr, it, 1, gluon.loss.L2Loss())
+    tree, meta = ckpt.trainer_capsule(tr)
+    fname = str(tmp_path / "run.capsule")
+    ckpt.save_capsule_file(fname, tree, meta)
+    loaded = nd.load(fname)
+    for p in tr._params:
+        np.testing.assert_array_equal(loaded[p.name].asnumpy(),
+                                      p.data().asnumpy())
+
+
+# ------------------------------------------------------------------ #
+# bit-exact resume: SPMDTrainer (dp2 / fsdp2)
+# ------------------------------------------------------------------ #
+
+@pytest.mark.parametrize("mode,axes", [("replicated", {"dp": 2}),
+                                       ("fsdp", {"fsdp": 2})])
+def test_spmd_capsule_resume_bit_exact(tmp_path, mode, axes):
+    import jax
+    from incubator_mxnet_tpu import parallel
+    from incubator_mxnet_tpu.parallel.mesh import build_mesh
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the virtual multi-device mesh")
+    mesh = build_mesh(devices=jax.devices()[:2], axis_sizes=axes)
+    xs, ys = nd.array(_X[:16]), nd.array(_Y[:16])
+
+    def make(seed):
+        mx.random.seed(seed)
+        net = nn.Sequential()
+        net.add(nn.Dense(16, in_units=8))
+        net.add(nn.Dense(8, in_units=16))
+        net.initialize()
+        tr = parallel.SPMDTrainer(
+            net, loss=lambda o, y: ((o - y) ** 2).mean(),
+            optimizer="adam", optimizer_params={"learning_rate": 1e-2},
+            mesh=mesh, sharding=mode)
+        return net, tr
+
+    _, tr = make(0)
+    ref = [float(tr.step(xs, ys).asnumpy()) for _ in range(6)]
+    _, tr2 = make(0)
+    _ = [float(tr2.step(xs, ys).asnumpy()) for _ in range(3)]
+    m = ckpt.CheckpointManager(str(tmp_path), keep=2)
+    saved = tr2.save_checkpoint(m)
+    m.wait()
+    _, tr3 = make(7)
+    got = tr3.restore_checkpoint(m)
+    assert got == saved == 3
+    res = [float(tr3.step(xs, ys).asnumpy()) for _ in range(3)]
+    assert res == ref[3:], (
+        f"{mode} resume diverged: {res} vs {ref[3:]}")
+    m.close()
+
+
+def test_spmd_fsdp_capsule_saves_unique_shards(tmp_path):
+    """fsdp-sharded state must checkpoint each global shard ONCE (the
+    addressable replica-0 dedup), and the manifest must record the
+    sharding spec."""
+    import jax
+    from incubator_mxnet_tpu import parallel
+    from incubator_mxnet_tpu.parallel.mesh import build_mesh
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the virtual multi-device mesh")
+    mesh = build_mesh(devices=jax.devices()[:2],
+                      axis_sizes={"fsdp": 2})
+    mx.random.seed(0)
+    net = nn.Dense(64, in_units=512)     # big enough for fsdp to shard
+    net.initialize()
+    tr = parallel.SPMDTrainer(
+        net, loss=lambda o, y: ((o - y) ** 2).mean(),
+        optimizer="sgd", optimizer_params={"learning_rate": 1e-2},
+        mesh=mesh, sharding="fsdp")
+    x = nd.array(_RNG.randn(8, 512).astype(np.float32))
+    y = nd.array(_RNG.randn(8, 64).astype(np.float32))
+    tr.step(x, y)
+    m = ckpt.CheckpointManager(str(tmp_path), keep=0)
+    tr.save_checkpoint(m, block=True)
+    import json
+    with open(os.path.join(ckpt.step_dir(str(tmp_path), 1),
+                           "manifest.json")) as f:
+        man = json.load(f)
+    w = man["arrays"]["param/0"]         # the (64, 512) weight
+    assert w["spec"] is not None and "fsdp" in w["spec"]
+    n_elems = sum(
+        int(np.prod([b - a for a, b in sh["index"]]))
+        for sh in w["shards"])
+    assert n_elems == 64 * 512           # each element saved exactly once
+    m.close()
+
+
+# ------------------------------------------------------------------ #
+# preemption
+# ------------------------------------------------------------------ #
+
+def test_sigterm_drains_inflight_and_saves_final_capsule(tmp_path):
+    loss_fn = gluon.loss.L2Loss()
+    net, tr = _make_trainer(0)
+    it = NDArrayIter(_X, _Y, batch_size=8)
+    _train_steps(net, tr, it, 3, loss_fn)
+    m = ckpt.CheckpointManager(str(tmp_path), keep=0)
+    # park a slow snapshot in flight, then preempt
+    os.environ["MXTPU_CKPT_WRITE_DELAY"] = "0.01"
+    try:
+        tr.save_checkpoint(m, step=100, iterator=it)
+        tr.install_preemption(m, iterator=it, exit_after=False)
+        os.kill(os.getpid(), signal.SIGTERM)
+    finally:
+        os.environ.pop("MXTPU_CKPT_WRITE_DELAY", None)
+        m.uninstall_preemption_hook()
+    # both the in-flight step AND the final sync capsule are committed
+    steps = m.all_steps()
+    assert 100 in steps
+    assert tr._optimizer.num_update in steps
+    arrays, meta = m.restore(step=tr._optimizer.num_update)
+    assert meta.get("preempted") is True
+    net2, tr2 = _make_trainer(1)
+    it2 = NDArrayIter(_X, _Y, batch_size=8)
+    tr2.restore_checkpoint(m, step=tr._optimizer.num_update,
+                           iterator=it2)
+    for a, b in zip(tr._params, tr2._params):
+        np.testing.assert_array_equal(a.data().asnumpy(),
+                                      b.data().asnumpy())
+    m.close()
+
+
+def test_sigterm_skips_when_step_already_committed(tmp_path):
+    loss_fn = gluon.loss.L2Loss()
+    net, tr = _make_trainer(0)
+    it = NDArrayIter(_X, _Y, batch_size=8)
+    _train_steps(net, tr, it, 2, loss_fn)
+    m = ckpt.CheckpointManager(str(tmp_path), keep=0)
+    tr.save_checkpoint(m, iterator=it, block=True)
+    before = m.all_steps()
+    tr.install_preemption(m, iterator=it, exit_after=False)
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)
+    finally:
+        m.uninstall_preemption_hook()
+    assert m.all_steps() == before       # no duplicate-step crash
+    m.close()
+
+
+# ------------------------------------------------------------------ #
+# iterator position export
+# ------------------------------------------------------------------ #
+
+def test_ndarrayiter_tell_set_position_mid_epoch_shuffled():
+    a = NDArrayIter(_X, _Y, batch_size=8, shuffle=True)
+    first = [a.next() for _ in range(4)]
+    pos = a.tell()
+    rest_ref = [b.data[0].asnumpy() for b in list(a)]
+    b_it = NDArrayIter(_X, _Y, batch_size=8, shuffle=True)
+    b_it.set_position(pos)
+    rest = [b.data[0].asnumpy() for b in list(b_it)]
+    assert len(rest) == len(rest_ref)
+    for r, rr in zip(rest, rest_ref):
+        np.testing.assert_array_equal(r, rr)
+
+
+def test_prefetching_iter_reports_resumable_position():
+    inner = NDArrayIter(_X, _Y, batch_size=8, shuffle=True)
+    pf = PrefetchingIter(inner)
+    seen = [pf.next().data[0].asnumpy() for _ in range(4)]
+    pos = pf.tell()
+    assert pos["delivered"] == 4
+    rest_ref = [b.data[0].asnumpy() for b in list(pf)]
+    # fresh wrapper (fresh inner) resumed from the exported position
+    pf2 = PrefetchingIter(NDArrayIter(_X, _Y, batch_size=8,
+                                      shuffle=True))
+    pf2.set_position(pos)
+    rest = [b.data[0].asnumpy() for b in list(pf2)]
+    assert len(rest) == len(rest_ref)
+    for r, rr in zip(rest, rest_ref):
+        np.testing.assert_array_equal(r, rr)
+
+
+def test_resize_iter_position_delegates():
+    r = ResizeIter(NDArrayIter(_X, _Y, batch_size=8), size=6)
+    r.next(), r.next()
+    pos = r.tell()
+    assert pos["cur"] == 2 and pos["inner"]["cursor"] >= 0
+    r2 = ResizeIter(NDArrayIter(_X, _Y, batch_size=8), size=6)
+    r2.set_position(pos)
+    np.testing.assert_array_equal(r2.next().data[0].asnumpy(),
+                                  r.next().data[0].asnumpy())
+
+
+def test_non_resumable_iterator_refuses_loudly():
+    from incubator_mxnet_tpu.io import DataIter
+    with pytest.raises(MXNetError, match="position export"):
+        DataIter().tell()

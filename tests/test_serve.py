@@ -166,6 +166,68 @@ def test_tp_sharded_pools_token_parity(model):
                                                  np.int32), ref)
 
 
+def test_warm_restart_swaps_weights_without_retrace(model, tmp_path):
+    """Elastic-checkpointing serve integration: warm_start pushes NEW
+    weights into a LIVE engine — tokens must match a fresh engine built
+    on those weights (proof the swap took effect) while the decode step
+    keeps its single compile (weights are traced inputs, not closure
+    constants)."""
+    from incubator_mxnet_tpu import checkpoint as ckpt
+
+    mx.random.seed(1234)
+    model_b = g.gpt_mini(vocab_size=64, max_length=64)
+    model_b.initialize()
+    rng = np.random.RandomState(11)
+    prompt = rng.randint(0, 64, size=(7,)).astype(np.int32)
+    ref_b = _solo_reference(model_b, prompt, 10)
+
+    eng = InferenceEngine(model, num_slots=2, page_size=8, max_len=64)
+    r0 = Request(prompt.copy(), max_new_tokens=10)
+    eng.run([r0])
+    assert eng.decode_trace_count == 1
+    prefills_before = eng.prefill_trace_count
+
+    # ship model_b's weights through a committed checkpoint, then warm
+    # restart the live engine from it
+    mgr = ckpt.CheckpointManager(str(tmp_path), keep=1)
+    eng_b = InferenceEngine(model_b, num_slots=2, page_size=8,
+                            max_len=64)
+    eng_b.save_checkpoint(mgr, block=True)
+    eng.warm_start(manager=mgr)
+    r1 = Request(prompt.copy(), max_new_tokens=10)
+    eng.run([r1])
+    np.testing.assert_array_equal(np.asarray(r1.token_ids, np.int32),
+                                  ref_b)
+    assert eng.decode_trace_count == 1, "warm restart retraced decode"
+    assert eng.prefill_trace_count == prefills_before, \
+        "warm restart retraced prefill"
+    assert eng.warm_restarts == 1
+    mgr.close()
+
+
+def test_warm_restart_accepts_full_training_capsule_tree(model):
+    """Regression: a TRAINING capsule also carries opt/<i>/<j> and
+    rng/key entries; warm_start must use only the param/ entries
+    instead of letting the extra keys break positional-key detection
+    (the advertised train-to-serve path)."""
+    eng = InferenceEngine(model, num_slots=2, page_size=8, max_len=64)
+    tree = {f"param/{i}": p.data().asnumpy()
+            for i, p in enumerate(eng._eng_params)}
+    tree["opt/0/0"] = np.zeros((1,), np.float32)
+    tree["rng/key"] = np.zeros((2,), np.uint32)
+    eng.warm_start(params=tree)
+    assert eng.warm_restarts == 1
+    assert eng.decode_trace_count == 0   # still nothing traced
+
+
+def test_warm_restart_rejects_shape_mismatch(model):
+    eng = InferenceEngine(model, num_slots=2, page_size=8, max_len=64)
+    bad = {str(i): np.zeros((1, 1), np.float32)
+           for i in range(len(eng._eng_params))}
+    with pytest.raises(MXNetError, match="shape/dtype"):
+        eng.warm_start(params=bad)
+
+
 def test_page_allocator_invariants():
     a = PageAllocator(5)
     assert a.free_count == 4                 # page 0 reserved

@@ -1,0 +1,242 @@
+"""Checkpointing overhead benchmark: async snapshots vs sync saves vs none.
+
+Measures the steady-state per-step cost the elastic checkpoint
+subsystem (checkpoint/) adds to an SPMD train loop, against the
+pre-subsystem behavior — a blocking whole-tree ``save_ndarrays`` on the
+critical path every save interval. The async path's only critical-path
+work is the device→host gather; serialization and disk I/O run on a
+deprioritized writer thread, so its overhead must stay **< 5%** (the
+acceptance bar; sync is shown for contrast). CPU-measurable by design.
+
+Methodology (the effect is smaller than CPU wall-clock jitter, so raw
+A/B run comparison is hopeless): ONE trainer per mode runs ALTERNATING
+windows — a plain window of ``--window`` steps, then an identical
+window whose ``--every``-th steps carry a save — and the overhead is
+the MEDIAN over paired (save_window / adjacent plain_window) ratios.
+Adjacent windows are ~1s apart, so machine drift cancels in each pair;
+the median filters scheduler spikes. ``save_step_ms`` isolates the step
+that carries the save: sync blocks there (serialize on the critical
+path), async pays only the gather.
+
+``--smoke`` (wired into ci/run.sh as the ``ckptbench`` stage) runs a
+fast structural guard: snapshots commit while stepping, the previous
+manifest stays loadable, and a mid-run capsule restores into a fresh
+trainer BIT-EXACTLY (next-step losses identical).
+
+Usage:
+  python tools/ckpt_bench.py                 # full bench, banks JSON
+  python tools/ckpt_bench.py --smoke         # CI guard (fast, asserts)
+  python tools/ckpt_bench.py --json OUT.json
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _build(units, layers, seed=0):
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import parallel
+    from incubator_mxnet_tpu.gluon import nn
+
+    mx.random.seed(seed)
+    net = nn.Sequential()
+    for _ in range(layers):
+        net.add(nn.Dense(units, in_units=units))
+    net.initialize()
+    tr = parallel.SPMDTrainer(
+        net, loss=lambda o, y: ((o - y) ** 2).mean(),
+        optimizer="adam", optimizer_params={"learning_rate": 1e-3})
+    return net, tr
+
+
+def _batch(units, batch):
+    import numpy as np
+    from incubator_mxnet_tpu import nd
+    rng = np.random.RandomState(0)
+    return (nd.array(rng.randn(batch, units).astype(np.float32)),
+            nd.array(rng.randn(batch, units).astype(np.float32)))
+
+
+def _median(xs):
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+def measure_mode(mode, units, layers, batch, window, every, pairs,
+                 warmup=3):
+    """Alternating plain/save windows on one trainer; paired ratios."""
+    import jax
+    from incubator_mxnet_tpu import checkpoint as ckpt
+    from incubator_mxnet_tpu.utils.serialization import save_ndarrays
+
+    net, tr = _build(units, layers)
+    x, y = _batch(units, batch)
+    ckdir = tempfile.mkdtemp(prefix=f"ckbench_{mode}_")
+    mgr = ckpt.CheckpointManager(ckdir, keep=2) if mode == "async" else None
+    saves = 0
+
+    def run_window(with_saves):
+        nonlocal saves
+        step_times, save_steps = [], []
+        t0 = time.perf_counter()
+        for s in range(window):
+            is_save = with_saves and (s + 1) % every == 0
+            ts = time.perf_counter()
+            L = tr.step(x, y)
+            if is_save:
+                if mode == "async":
+                    tr.save_checkpoint(mgr)
+                else:
+                    # the pre-subsystem critical path: host the whole
+                    # tree and serialize it before stepping on
+                    tree, _meta = ckpt.spmd_capsule(tr)
+                    save_ndarrays(os.path.join(ckdir, "sync.params"),
+                                  {k: v for k, v in tree.items()})
+                saves += 1
+            jax.block_until_ready(L._data)
+            dt = time.perf_counter() - ts
+            step_times.append(dt)
+            if is_save:
+                save_steps.append(dt)
+        if with_saves and mgr is not None:
+            mgr.wait()                   # drain: charge the tail honestly
+        total = time.perf_counter() - t0
+        return total / window, step_times, save_steps
+
+    try:
+        for _ in range(warmup):
+            jax.block_until_ready(tr.step(x, y)._data)
+        ratios, plain_means, save_means = [], [], []
+        all_steps, all_save_steps = [], []
+        for _ in range(pairs):
+            plain, st_p, _ = run_window(False)
+            saving, st_s, ss = run_window(True)
+            ratios.append(saving / plain)
+            plain_means.append(plain)
+            save_means.append(saving)
+            all_steps += st_p + st_s
+            all_save_steps += ss
+        committed = len(mgr.all_steps()) if mgr else (1 if saves else 0)
+    finally:
+        if mgr:
+            mgr.close()
+        shutil.rmtree(ckdir, ignore_errors=True)
+
+    all_steps.sort()
+    return {
+        "plain_window_step_ms": _median(plain_means) * 1e3,
+        "save_window_step_ms": _median(save_means) * 1e3,
+        "overhead_pct": (_median(ratios) - 1.0) * 100.0,
+        "save_step_ms": (_median(all_save_steps) * 1e3
+                         if all_save_steps else None),
+        "median_step_ms": _median(all_steps) * 1e3,
+        "p99_step_ms": all_steps[
+            min(len(all_steps) - 1, int(len(all_steps) * 0.99))] * 1e3,
+        "saves": saves,
+        "committed": committed,
+    }
+
+
+def smoke():
+    """Structural CI guard — fast, assertion-based."""
+    from incubator_mxnet_tpu import checkpoint as ckpt
+
+    units, layers, batch = 64, 2, 32
+    net, tr = _build(units, layers, seed=0)
+    x, y = _batch(units, batch)
+    ckdir = tempfile.mkdtemp(prefix="ckbench_smoke_")
+    mgr = ckpt.CheckpointManager(ckdir, keep=2)
+    ok = True
+    try:
+        ref = []
+        for s in range(6):
+            ref.append(float(tr.step(x, y).asnumpy()))
+            if s == 2:
+                tr.save_checkpoint(mgr)    # async, mid-run
+        mgr.wait()
+        if mgr.all_steps() != [3]:
+            print(f"FAIL: expected committed step [3], got "
+                  f"{mgr.all_steps()}", file=sys.stderr)
+            ok = False
+        _, tr2 = _build(units, layers, seed=9)
+        got = tr2.restore_checkpoint(mgr)
+        res = [float(tr2.step(x, y).asnumpy()) for _ in range(3)]
+        if res != ref[3:]:
+            print(f"FAIL: capsule resume not bit-exact: {res} vs "
+                  f"{ref[3:]}", file=sys.stderr)
+            ok = False
+        else:
+            print(f"smoke: resume from step {got} bit-exact over "
+                  f"{len(res)} steps; async commit + GC OK")
+    finally:
+        mgr.close()
+        shutil.rmtree(ckdir, ignore_errors=True)
+    return ok
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="fast CI guard: commit + bit-exact resume")
+    ap.add_argument("--json", default=None,
+                    help="bank results here (default BENCH_CKPT.json at "
+                         "the repo root for a full run)")
+    ap.add_argument("--units", type=int, default=512)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=512)
+    ap.add_argument("--window", type=int, default=20,
+                    help="steps per measurement window")
+    ap.add_argument("--every", type=int, default=20,
+                    help="save interval within a save window (steps)")
+    ap.add_argument("--pairs", type=int, default=8,
+                    help="plain/save window pairs per mode")
+    args = ap.parse_args()
+
+    if args.smoke:
+        sys.exit(0 if smoke() else 1)
+
+    cfg = dict(units=args.units, layers=args.layers, batch=args.batch,
+               window=args.window, every=args.every, pairs=args.pairs)
+    async_ = measure_mode("async", **cfg)
+    sync = measure_mode("sync", **cfg)
+
+    result = {
+        "config": {**cfg,
+                   "backend": os.environ.get("JAX_PLATFORMS", "cpu")},
+        "async": async_,
+        "sync_save_ndarrays": sync,
+    }
+    print(json.dumps(result, indent=2))
+
+    ok = True
+    if async_["overhead_pct"] >= 5.0:
+        print(f"FAIL: async checkpoint overhead "
+              f"{async_['overhead_pct']:.1f}% >= 5% bar",
+              file=sys.stderr)
+        ok = False
+    if async_["committed"] < 1:
+        print("FAIL: async run committed no snapshots", file=sys.stderr)
+        ok = False
+
+    out = args.json
+    if out is None:
+        out = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "BENCH_CKPT.json")
+    with open(out, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(f"banked {out}")
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()
