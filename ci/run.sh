@@ -43,7 +43,9 @@ stage_stepbench() {
 
 stage_servebench() {
   echo "== servebench: continuous-batching regression guard (the decode"
-  echo "               step must compile exactly once across occupancy churn)"
+  echo "               step must compile exactly once across occupancy churn,"
+  echo "               cache-hit admission must compile ZERO new programs, and"
+  echo "               chunked prefill must respect its per-step token budget)"
   JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke
 }
 

@@ -42,6 +42,16 @@ serving path and the test oracle); ``MXTPU_FLASH_INTERPRET=1`` routes
 the dispatcher to the real kernel in interpret mode, mirroring
 ``ops.pallas_attention``. Same masked-row contract as the training
 kernels: a slot with length 0 produces EXACTLY zero output.
+
+``ragged_prefill_attention`` is the chunked-prefill sibling: a CHUNK of
+C consecutive prompt tokens of ONE slot (absolute positions
+``q_start + i``) attends the slot's already-populated paged prefix plus
+the causal intra-chunk part — the chunk's own K/V is scattered into the
+pages first, so a single per-query prefix mask ``pos_k <= pos_q``
+covers both. Same kernel shape as decode (grid over the page axis,
+online-softmax scratch carried across pages, dead pages skipped via the
+repeated-null-page index trick), with C query rows per head instead of
+one; same jnp gather fallback as CPU path and oracle.
 """
 
 from __future__ import annotations
@@ -57,7 +67,8 @@ from .pallas_attention import _pallas_available, _pallas_runnable
 
 _NEG_INF = -1e30
 
-__all__ = ["ragged_paged_attention", "ragged_attention_reference"]
+__all__ = ["ragged_paged_attention", "ragged_attention_reference",
+           "ragged_prefill_attention", "ragged_prefill_reference"]
 
 
 def _ragged_kernel(pt_ref, ln_ref, q_ref, k_ref, v_ref, o_ref,
@@ -209,3 +220,177 @@ def ragged_paged_attention(q, k_pool, v_pool, page_table, lengths,
                               sc, interpret)
     return ragged_attention_reference(q, k_pool, v_pool, page_table,
                                       lengths, sc)
+
+
+# --------------------------------------------------------------------- #
+# prefill over a paged prefix (the chunked-prefill attention variant)
+# --------------------------------------------------------------------- #
+
+def _ragged_prefill_kernel(pr_ref, qi_ref, q_ref, k_ref, v_ref, o_ref,
+                           m_ref, l_ref, acc_ref, *, scale, page_size,
+                           n_pages, heads, chunk):
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(0)
+    start = qi_ref[0]                # first query's absolute position
+    n_real = qi_ref[1]               # live queries in the chunk
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # pages whose first key position is past the last real query's
+    # position contribute nothing to any live row — skip them, and
+    # (dead entries all indexing the null page) skip their re-DMA too
+    @pl.when(j * page_size < start + n_real)
+    def _accumulate():
+        for h in range(heads):                  # unrolled head loop
+            q = q_ref[0, h]                     # (chunk, D), input dtype
+            k = k_ref[0, h]                     # (page_size, D)
+            v = v_ref[0, h]
+            sc = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
+                         precision=lax.Precision.DEFAULT) * scale
+            pos_k = j * page_size + lax.broadcasted_iota(
+                jnp.int32, (chunk, page_size), 1)
+            pos_q = start + lax.broadcasted_iota(
+                jnp.int32, (chunk, page_size), 0)
+            # per-query prefix mask: query i (absolute pos start + i)
+            # sees keys [0, start + i] — the paged prefix AND the causal
+            # intra-chunk part in one predicate (the chunk's own K/V is
+            # already scattered into these pages)
+            sc = jnp.where(pos_k <= pos_q, sc, _NEG_INF)
+            m_prev = m_ref[h]                   # (chunk,)
+            l_prev = l_ref[h]
+            m_new = jnp.maximum(m_prev, jnp.max(sc, axis=-1))
+            p = jnp.exp(sc - m_new[:, None])    # (chunk, page_size) f32
+            alpha = jnp.exp(m_prev - m_new)
+            m_ref[h] = m_new
+            l_ref[h] = l_prev * alpha + jnp.sum(p, axis=-1)
+            acc_ref[h] = acc_ref[h] * alpha[:, None] + jnp.dot(
+                p.astype(v.dtype), v, preferred_element_type=jnp.float32,
+                precision=lax.Precision.DEFAULT)
+
+    @pl.when(j == n_pages - 1)
+    def _finalize():
+        for h in range(heads):
+            m = m_ref[h]
+            l_safe = jnp.maximum(l_ref[h], 1e-30)
+            # every live query attends at least position 0, so only rows
+            # that saw no page at all (possible when padded rows extend
+            # past every accumulated page) stay at _NEG_INF — emit zero
+            row_ok = m > _NEG_INF / 2
+            o_ref[0, h] = jnp.where(row_ok[:, None],
+                                    acc_ref[h] / l_safe[:, None],
+                                    0.0).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def _ragged_prefill_pallas(q, k_pool, v_pool, page_row, qinfo, scale,
+                           interpret):
+    """q: (C, H, D) chunk queries of ONE slot; pools: (P, H, ps, D);
+    page_row: (max_pages,) int32; qinfo: (2,) int32 = [q_start, n_real].
+    Returns (C, H, D)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    C, H, D = q.shape
+    page_size = k_pool.shape[2]
+    n_pages = page_row.shape[0]
+    q4 = q.transpose(1, 0, 2)[None]             # (1, H, C, D)
+
+    kernel = functools.partial(
+        _ragged_prefill_kernel, scale=scale, page_size=page_size,
+        n_pages=n_pages, heads=H, chunk=C)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                  # page_row, qinfo
+        grid=(n_pages,),
+        in_specs=[
+            pl.BlockSpec((1, H, C, D), lambda j, pr, qi: (0, 0, 0, 0)),
+            pl.BlockSpec((1, H, page_size, D),
+                         lambda j, pr, qi: (pr[j], 0, 0, 0)),
+            pl.BlockSpec((1, H, page_size, D),
+                         lambda j, pr, qi: (pr[j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, C, D),
+                               lambda j, pr, qi: (0, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, C), jnp.float32),        # m
+            pltpu.VMEM((H, C), jnp.float32),        # l
+            pltpu.VMEM((H, C, D), jnp.float32),     # acc
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((1, H, C, D), q.dtype),
+        interpret=interpret,
+    )(page_row.astype(jnp.int32), qinfo.astype(jnp.int32),
+      q4, k_pool, v_pool)
+    return out[0].transpose(1, 0, 2)
+
+
+def ragged_prefill_reference(q, k_pool, v_pool, page_row, q_start,
+                             scale=None):
+    """Pure-jnp oracle and CPU serving path for chunked prefill: gather
+    the slot's whole page window dense, apply the per-query prefix mask
+    ``pos_k <= q_start + i``, softmax with f32 accumulation. Same
+    numerics discipline as ``ragged_attention_reference``; jit-friendly
+    (``q_start`` is traced data)."""
+    C, H, D = q.shape
+    page_size = k_pool.shape[2]
+    n_pages = page_row.shape[0]
+    K = n_pages * page_size
+    sc = D ** -0.5 if scale is None else scale
+
+    def window(pool):
+        g = pool[page_row]                      # (n_pages, H, ps, D)
+        g = jnp.moveaxis(g, 1, 0)               # (H, n_pages, ps, D)
+        return g.reshape(H, K, D)
+
+    k = window(k_pool)
+    v = window(v_pool)
+    s = jnp.einsum("chd,hkd->chk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sc
+    pos_k = lax.broadcasted_iota(jnp.int32, (C, K), 1)
+    pos_q = q_start + lax.broadcasted_iota(jnp.int32, (C, K), 0)
+    s = jnp.where((pos_k <= pos_q)[:, None, :], s, _NEG_INF)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    out = jnp.einsum("chk,hkd->chd", p, v.astype(jnp.float32)) / \
+        jnp.maximum(l, 1e-30)[..., None]
+    row_ok = m > _NEG_INF / 2
+    return jnp.where(row_ok[..., None], out, 0.0).astype(q.dtype)
+
+
+def ragged_prefill_attention(q, k_pool, v_pool, page_row, q_start,
+                             n_real=None, scale=None, interpret=None):
+    """Chunked-prefill attention for ONE slot: C chunk queries at
+    absolute positions ``q_start + i`` attend the slot's paged prefix
+    plus the causal intra-chunk part. q: (C, H, D); k_pool/v_pool:
+    (num_pages, H, page_size, D); page_row: (max_pages,) int32 (dead
+    entries 0 = null page); q_start: scalar int32; n_real: live queries
+    (trailing padded rows emit garbage the caller discards — defaults
+    to C). Returns (C, H, D).
+
+    PRECONDITION (the engine's contract): the chunk's own K/V rows are
+    already scattered into the slot's pages, and every page covering
+    positions [0, q_start + n_real) is live. Dispatch is static
+    (mirrors ``ragged_paged_attention``): the Pallas kernel on TPU or
+    under ``MXTPU_FLASH_INTERPRET=1`` / ``interpret=True``; the jnp
+    gather reference otherwise (the CPU serving path)."""
+    if interpret is None:
+        interpret = os.environ.get("MXTPU_FLASH_INTERPRET") == "1"
+    sc = q.shape[-1] ** -0.5 if scale is None else scale
+    if n_real is None:
+        n_real = q.shape[0]
+    if _pallas_available() and _pallas_runnable(interpret):
+        qinfo = jnp.stack([jnp.asarray(q_start, jnp.int32),
+                           jnp.asarray(n_real, jnp.int32)])
+        return _ragged_prefill_pallas(q, k_pool, v_pool, page_row,
+                                      qinfo, sc, interpret)
+    return ragged_prefill_reference(q, k_pool, v_pool, page_row,
+                                    q_start, sc)

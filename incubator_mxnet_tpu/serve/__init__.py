@@ -23,9 +23,10 @@ The ragged decode-attention kernel itself lives in
 See docs/SERVING.md for the architecture and invariants.
 """
 
-from .paged_kv import (NULL_PAGE, PageAllocator, init_kv_pools,
-                       write_prompt_kv, write_token_kv)
+from .paged_kv import (NULL_PAGE, PageAllocator, PrefixIndex,
+                       init_kv_pools, write_prompt_kv, write_token_kv)
 from .engine import InferenceEngine, Request
 
-__all__ = ["InferenceEngine", "Request", "PageAllocator", "NULL_PAGE",
-           "init_kv_pools", "write_token_kv", "write_prompt_kv"]
+__all__ = ["InferenceEngine", "Request", "PageAllocator", "PrefixIndex",
+           "NULL_PAGE", "init_kv_pools", "write_token_kv",
+           "write_prompt_kv"]

@@ -20,6 +20,26 @@ Design (the jit-once contract):
     attend nothing (zero output by the masked-row contract), and their
     sampled token is discarded on the host — no shape anywhere depends
     on how many slots are live.
+  - PREFIX CACHING (copy-on-write page sharing): a host-side radix/hash
+    index (``paged_kv.PrefixIndex``) remembers which pages hold which
+    page-aligned prompt prefixes. Admission matches a new prompt's
+    longest cached prefix and maps those pages into the slot's page
+    table READ-ONLY (refcounted — they return to the free list only
+    when the last slot and the index let go); the boundary partial page
+    is COPIED into a private page; only the un-cached suffix pays
+    prefill compute. Shared pages are never written: decode writes land
+    at positions >= the prompt length, past every shared page.
+    ``warm_start`` flushes the index — cached K/V is weight-dependent.
+  - CHUNKED PREFILL (``chunk_pages``): instead of one monolithic
+    prompt-sized program between decode steps, the prompt is processed
+    in fixed-size page-aligned chunks (one pow2 bucket family)
+    interleaved with decode under a per-step TOKEN BUDGET, so a long
+    arrival no longer freezes TPOT for every active slot. Chunk queries
+    attend the slot's already-populated pages plus the causal
+    intra-chunk part (``ops.ragged_attention.ragged_prefill_attention``)
+    — chunk position/length/pages are data, so each chunk bucket
+    compiles exactly once, same contract as decode. The cache-hit
+    suffix path reuses the same chunk programs even in monolithic mode.
   - Per-slot sampling params: a (S,) temperature array is traced data;
     greedy and categorical are both computed and selected per slot.
   - tp sharding: pass ``mesh`` — pools are placed with the H axis
@@ -50,9 +70,11 @@ from ..base import MXNetError
 from ..ndarray import NDArray
 from ..ops.attention import scaled_dot_product_attention as _sdpa
 from ..ops.ragged_attention import (ragged_attention_reference,
-                                    ragged_paged_attention)
-from .paged_kv import (NULL_PAGE, PageAllocator, init_kv_pools,
-                       write_prompt_kv, write_token_kv)
+                                    ragged_paged_attention,
+                                    ragged_prefill_attention,
+                                    ragged_prefill_reference)
+from .paged_kv import (NULL_PAGE, PageAllocator, PrefixIndex,
+                       init_kv_pools, write_prompt_kv, write_token_kv)
 
 __all__ = ["Request", "InferenceEngine"]
 
@@ -70,6 +92,7 @@ class Request:
     # filled in by the engine
     token_ids: List[int] = dataclasses.field(default_factory=list)
     token_times: List[float] = dataclasses.field(default_factory=list)
+    token_stamps: List[float] = dataclasses.field(default_factory=list)
     submit_time: Optional[float] = None
     finish_time: Optional[float] = None
 
@@ -85,7 +108,16 @@ class Request:
 class _Slot:
     request: Request
     reserved_pages: int          # worst-case pages (admission guarantee)
-    allocated: List[int] = dataclasses.field(default_factory=list)
+    refs: List[int]              # pages this slot holds a refcount on
+    row: np.ndarray              # (max_pages,) page row; installed into
+                                 # the decode page table when prefill ends
+    t0: int                      # prompt length
+    prefill_pos: int             # prompt tokens whose K/V is populated
+    t_admit: float
+
+    @property
+    def prefilling(self) -> bool:
+        return self.prefill_pos < self.t0
 
 
 def _next_pow2(n: int) -> int:
@@ -100,10 +132,18 @@ class InferenceEngine:
     ``num_pages`` defaults to the worst case (every slot at max_len) so
     admission never stalls; shrink it to trade admission concurrency
     for cache memory — correctness is preserved by admission control
-    (a request is only admitted when its worst-case page count fits)."""
+    (a request is only admitted when its worst-case page count fits,
+    counting pages reclaimable from the prefix index).
+
+    ``prefix_cache`` (default on) enables copy-on-write prefix page
+    sharing; ``chunk_pages`` (a power of two, default None = the PR 2
+    monolithic prefill) enables chunked prefill with at most
+    ``token_budget`` prompt tokens processed per engine step (default
+    ``chunk_pages * page_size``)."""
 
     def __init__(self, model, num_slots=8, page_size=16, max_len=None,
-                 num_pages=None, dtype=None, mesh=None, interpret=None):
+                 num_pages=None, dtype=None, mesh=None, interpret=None,
+                 prefix_cache=True, chunk_pages=None, token_budget=None):
         self.model = model
         self.num_slots = int(num_slots)
         self.page_size = int(page_size)
@@ -116,6 +156,22 @@ class InferenceEngine:
             num_pages = 1 + self.num_slots * self.max_pages
         self.num_pages = int(num_pages)
         self._dtype = dtype or model._dtype
+
+        self.chunk_pages = None
+        if chunk_pages is not None:
+            cp = int(chunk_pages)
+            if cp < 1 or (cp & (cp - 1)):
+                raise MXNetError(f"chunk_pages must be a power of two, "
+                                 f"got {cp}")
+            self.chunk_pages = cp
+        self.token_budget = int(token_budget) if token_budget is not None \
+            else (self.chunk_pages or self.max_pages) * self.page_size
+        if self.chunk_pages is not None and \
+                self.token_budget < self.chunk_pages * self.page_size:
+            raise MXNetError(
+                f"token_budget {self.token_budget} below one chunk "
+                f"({self.chunk_pages * self.page_size} tokens) — a long "
+                f"prompt could never make progress")
 
         H = model.block0.attn._heads
         D = model._units // H
@@ -156,17 +212,30 @@ class InferenceEngine:
         self._lengths = np.zeros((S,), np.int32)
         self._temps = np.zeros((S,), np.float32)
         self._alloc = PageAllocator(self.num_pages)
+        self._prefix = PrefixIndex(self.page_size) if prefix_cache \
+            else None
         self._slots: List[Optional[_Slot]] = [None] * S
         self._queue: deque = deque()
         self._key = jax.random.PRNGKey(0)
+        self._prefill_rr = 0
 
         self.decode_trace_count = 0
-        self.prefill_trace_count = 0
+        self.prefill_trace_count = 0         # dense + chunk, total
+        self.prefill_trace_counts = {}       # ("dense"|"chunk", Tpad) -> n
+        self.copy_trace_count = 0
         self.decode_steps = 0
         self.warm_restarts = 0
+        self.prefix_lookups = 0
+        self.prefix_hits = 0
+        self.prefix_hit_tokens = 0
+        self.prefix_flushes = 0
+        self.prefix_reclaimed_pages = 0
+        self.max_step_prefill_tokens = 0
         self._decode_step = jax.jit(self._decode_step_fn,
                                     donate_argnums=(1, 2))
-        self._prefill_jits = {}          # bucket_pages -> jitted fn
+        self._prefill_jits = {}          # bucket_pages -> jitted dense fn
+        self._chunk_jits = {}            # bucket_pages -> jitted chunk fn
+        self._copy_jit = None
 
     # ------------------------------------------------------------- #
     # traced programs
@@ -211,6 +280,13 @@ class InferenceEngine:
                                               lengths)
         return ragged_paged_attention(q, kp, vp, page_table, lengths,
                                       interpret=self._interpret)
+
+    def _prefill_attn(self, q, kp, vp, page_row, start, n_real):
+        if self._mesh is not None:
+            return ragged_prefill_reference(q, kp, vp, page_row, start)
+        return ragged_prefill_attention(q, kp, vp, page_row, start,
+                                        n_real=n_real,
+                                        interpret=self._interpret)
 
     def _decode_step_fn(self, param_vals, kpools, vpools, tokens,
                         page_table, lengths, temps, key):
@@ -270,6 +346,9 @@ class InferenceEngine:
         Tpad is the bucket shape — one compile per bucket, counted in
         ``prefill_trace_count``."""
         self.prefill_trace_count += 1        # trace-time only
+        key_tc = ("dense", ids.shape[1])
+        self.prefill_trace_counts[key_tc] = \
+            self.prefill_trace_counts.get(key_tc, 0) + 1
         from jax import lax
         from ..gluon.block import _hybrid_trace_scope
         from .. import autograd
@@ -304,6 +383,80 @@ class InferenceEngine:
         tok = self._sample(logits, temp[None], key)[0]
         return tuple(new_k), tuple(new_v), tok
 
+    def _chunk_prefill_fn(self, param_vals, kpools, vpools, ids, start,
+                          n_real, page_row, temp, key):
+        """ONE prefill chunk of ONE slot's prompt: ids (1, Cpad) holds
+        ``n_real`` prompt tokens at absolute positions ``start + i``.
+        Their K/V is scattered into the slot's pages (padded tokens land
+        in the null page), then each chunk query attends the slot's
+        already-populated paged prefix plus the causal intra-chunk part
+        (``ragged_prefill_attention``). The last real row's logits are
+        always computed and sampled — the host uses the token only when
+        this is the final chunk. Cpad is the bucket shape; start /
+        lengths / pages / weights are data, so each chunk bucket
+        compiles exactly once (same contract as decode)."""
+        self.prefill_trace_count += 1        # trace-time only
+        key_tc = ("chunk", ids.shape[1])
+        self.prefill_trace_counts[key_tc] = \
+            self.prefill_trace_counts.get(key_tc, 0) + 1
+        from jax import lax
+        from ..gluon.block import _hybrid_trace_scope
+        from .. import autograd
+        from ..models.gpt import _mlp, _qkv_heads
+
+        model = self.model
+        ps = self.page_size
+        Cpad = ids.shape[1]
+        with self._bind_params(param_vals), _hybrid_trace_scope(), \
+                autograd._ModeScope(recording=False, training=False):
+            pos = start + lax.broadcasted_iota(jnp.int32, (1, Cpad), 1)
+            x = model.word_embed(NDArray(ids)) + \
+                model.position_embed(NDArray(pos))
+            if model._dtype != "float32":
+                x = x.astype(model._dtype)
+            live = lax.broadcasted_iota(jnp.int32, (Cpad,), 0) < n_real
+            page_idx = jnp.clip(pos[0] // ps, 0, self.max_pages - 1)
+            tok_pages = jnp.where(live, page_row[page_idx], NULL_PAGE)
+            tok_off = pos[0] % ps
+            new_k, new_v = list(kpools), list(vpools)
+            for i in range(model.num_layers):
+                blk = getattr(model, f"block{i}")
+                q, k, v = _qkv_heads(blk.attn, blk.ln1(x))  # (1,Cpad,H,D)
+                new_k[i] = write_token_kv(new_k[i], k[0], tok_pages,
+                                          tok_off)
+                new_v[i] = write_token_kv(new_v[i], v[0], tok_pages,
+                                          tok_off)
+                out = self._prefill_attn(q[0].astype(new_k[i].dtype),
+                                         new_k[i], new_v[i], page_row,
+                                         start, n_real)
+                x = x + blk.attn.proj(NDArray(out.astype(q.dtype).reshape(
+                    1, Cpad, model._units)))
+                x = x + _mlp(blk, x)
+            last = lax.dynamic_slice(
+                x._data, (0, n_real - 1, 0), (1, 1, model._units))
+            x = model.ln_f(NDArray(last).astype("float32"))
+            embed_w = model.word_embed.weight.data()
+            logits = x._op("dot", embed_w, transpose_b=True)._data[:, 0]
+        tok = self._sample(logits, temp[None], key)[0]
+        return tuple(new_k), tuple(new_v), tok
+
+    def _copy_page_fn(self, kpools, vpools, src, dst):
+        """COW boundary copy: duplicate one page's K/V across every
+        layer, so the cached partial page becomes this slot's private
+        page (the cached original stays read-only for its sharers).
+        src/dst are traced scalars — one compile, ever."""
+        self.copy_trace_count += 1           # trace-time only
+        new_k = tuple(p.at[dst].set(p[src]) for p in kpools)
+        new_v = tuple(p.at[dst].set(p[src]) for p in vpools)
+        return new_k, new_v
+
+    def _copy_page(self, src: int, dst: int):
+        if self._copy_jit is None:
+            self._copy_jit = jax.jit(self._copy_page_fn,
+                                     donate_argnums=(0, 1))
+        self._kpools, self._vpools = self._copy_jit(
+            self._kpools, self._vpools, np.int32(src), np.int32(dst))
+
     # ------------------------------------------------------------- #
     # host-side scheduler
     # ------------------------------------------------------------- #
@@ -314,8 +467,8 @@ class InferenceEngine:
 
     @property
     def _lazy_debt(self) -> int:
-        """Pages promised at admission but not yet physically taken."""
-        return sum(s.reserved_pages - len(s.allocated)
+        """Pages promised at admission but not yet physically held."""
+        return sum(s.reserved_pages - len(s.refs)
                    for s in self._slots if s is not None)
 
     def submit(self, request: Request):
@@ -333,20 +486,29 @@ class InferenceEngine:
         req = slot.request
         req.token_ids.append(int(token))
         req.token_times.append(dt)
+        req.token_stamps.append(time.perf_counter())
         return (len(req.token_ids) >= req.max_new_tokens or
                 (req.eos_id >= 0 and int(token) == req.eos_id))
 
     def _evict(self, slot_idx: int):
         slot = self._slots[slot_idx]
-        self._alloc.free(slot.allocated)
-        self._page_table[slot_idx, :] = NULL_PAGE
+        self._alloc.free(slot.refs)          # refcounted: shared pages
+        self._page_table[slot_idx, :] = NULL_PAGE  # survive via sharers
         self._lengths[slot_idx] = 0
         self._temps[slot_idx] = 0.0
         slot.request.finish_time = time.perf_counter()
         self._slots[slot_idx] = None
 
     def _admit(self):
-        """FIFO admission into free slots, gated on worst-case pages."""
+        """FIFO admission into free slots, gated on worst-case pages.
+
+        With the prefix cache on, admission first matches the prompt's
+        longest cached page-aligned prefix: matched full pages are
+        mapped copy-on-write (incref'd, read-only), the boundary
+        partial page is copied, and only the remaining suffix pays
+        prefill compute. Pages held only by the index count as
+        reclaimable budget — they are evicted (LRU) when the free list
+        alone cannot cover a request."""
         for slot_idx in range(self.num_slots):
             if not self._queue or self._slots[slot_idx] is not None:
                 continue
@@ -358,55 +520,200 @@ class InferenceEngine:
                     f"request needs {total} positions > max_len "
                     f"{self.max_len}")
             need = -(-total // self.page_size)
-            if self._alloc.free_count - self._lazy_debt < need:
-                break                        # no cache budget yet — wait
-            self._queue.popleft()
-            t_start = time.perf_counter()
             prompt_pages = -(-t0 // self.page_size)
-            pages = [self._alloc.alloc() for _ in range(prompt_pages)]
-            bucket = min(_next_pow2(prompt_pages), self.max_pages)
-            Tpad = bucket * self.page_size
-            ids = np.zeros((1, Tpad), np.int32)
-            ids[0, :t0] = req.prompt_ids
-            pages_arr = np.zeros((bucket,), np.int32)
-            pages_arr[:prompt_pages] = pages
-            fn = self._prefill_jits.get(bucket)
-            if fn is None:
-                fn = jax.jit(self._prefill_fn, donate_argnums=(1, 2))
-                self._prefill_jits[bucket] = fn
-            self._kpools, self._vpools, tok = fn(
-                self._param_vals, self._kpools, self._vpools, ids,
-                np.int32(t0), pages_arr,
-                np.float32(req.temperature), self._next_key())
-            tok = int(np.asarray(tok))
-            self._slots[slot_idx] = _Slot(req, reserved_pages=need,
-                                          allocated=pages)
+
+            shared: List[int] = []
+            partial = None
+            cached_len = 0
+            if self._prefix is not None:
+                self.prefix_lookups += 1
+                shared, partial, cached_len = \
+                    self._prefix.match(req.prompt_ids)
+                # pin matches NOW so reclaim below can't free them
+                for p in shared:
+                    self._alloc.incref(p)
+                if partial is not None:
+                    self._alloc.incref(partial[0])
+            n_new = need - len(shared)       # pages the free list owes
+            avail = self._alloc.free_count - self._lazy_debt
+            recl = self._prefix.reclaimable(self._alloc) \
+                if self._prefix is not None else 0
+            if avail + recl < n_new:
+                # no cache budget yet — unpin and wait for evictions
+                for p in shared:
+                    self._alloc.decref(p)
+                if partial is not None:
+                    self._alloc.decref(partial[0])
+                break
+            if avail < n_new:
+                self.prefix_reclaimed_pages += \
+                    self._prefix.reclaim(n_new - avail, self._alloc)
+            if cached_len:
+                self.prefix_hits += 1
+                self.prefix_hit_tokens += cached_len
+
+            self._queue.popleft()
+            priv = [self._alloc.alloc()
+                    for _ in range(prompt_pages - len(shared))]
+            row = np.zeros((self.max_pages,), np.int32)
+            row[:len(shared)] = shared
+            row[len(shared):prompt_pages] = priv
+            slot = _Slot(req, reserved_pages=need,
+                         refs=list(shared) + priv, row=row, t0=t0,
+                         prefill_pos=cached_len,
+                         t_admit=time.perf_counter())
+            self._slots[slot_idx] = slot
+            # decode-invisible until prefill completes: the decode step
+            # must neither attend a half-built prompt nor scatter its
+            # (dead-slot) write into a mapped — possibly SHARED — page
             self._page_table[slot_idx, :] = NULL_PAGE
-            self._page_table[slot_idx, :prompt_pages] = pages
-            self._lengths[slot_idx] = t0
-            self._temps[slot_idx] = req.temperature
-            if self._finish_token(slot_idx, tok,
-                                  time.perf_counter() - t_start):
-                self._evict(slot_idx)
+            self._lengths[slot_idx] = 0
+            self._temps[slot_idx] = 0.0
+            if partial is not None:
+                # COW: the boundary page becomes a private copy; drop
+                # the temporary pin on the cached source
+                self._copy_page(partial[0], int(row[len(shared)]))
+                self._alloc.decref(partial[0])
+
+            if self.chunk_pages is None:
+                # monolithic mode: prefill to completion inside _admit.
+                # A cache hit still runs the (chunk-program) suffix path
+                # — the dense program cannot start mid-prompt.
+                if cached_len == 0:
+                    self._dense_prefill(slot_idx)
+                else:
+                    while (self._slots[slot_idx] is slot and
+                           slot.prefilling):
+                        self._run_chunk(slot_idx)
+            # chunked mode: the slot prefills across subsequent step()
+            # calls under the token budget
+
+    def _dense_prefill(self, slot_idx: int):
+        """The PR 2 monolithic prompt program (one pow2-page bucket)."""
+        slot = self._slots[slot_idx]
+        req = slot.request
+        t0 = slot.t0
+        prompt_pages = -(-t0 // self.page_size)
+        bucket = min(_next_pow2(prompt_pages), self.max_pages)
+        Tpad = bucket * self.page_size
+        ids = np.zeros((1, Tpad), np.int32)
+        ids[0, :t0] = req.prompt_ids
+        pages_arr = np.zeros((bucket,), np.int32)
+        pages_arr[:prompt_pages] = slot.row[:prompt_pages]
+        fn = self._prefill_jits.get(bucket)
+        if fn is None:
+            fn = jax.jit(self._prefill_fn, donate_argnums=(1, 2))
+            self._prefill_jits[bucket] = fn
+        self._kpools, self._vpools, tok = fn(
+            self._param_vals, self._kpools, self._vpools, ids,
+            np.int32(t0), pages_arr,
+            np.float32(req.temperature), self._next_key())
+        slot.prefill_pos = t0
+        self._finish_prefill(slot_idx, int(np.asarray(tok)))
+
+    def _run_chunk(self, slot_idx: int) -> int:
+        """Process ONE prefill chunk for a prefilling slot; returns the
+        number of real prompt tokens processed. The chunk size is
+        ``chunk_pages * page_size`` (the tail, and the monolithic-mode
+        cache-hit suffix, bucket to the same pow2-page family)."""
+        slot = self._slots[slot_idx]
+        req = slot.request
+        start = slot.prefill_pos
+        remaining = slot.t0 - start
+        if self.chunk_pages is not None:
+            n = min(remaining, self.chunk_pages * self.page_size)
+        else:
+            n = remaining
+        bucket = min(_next_pow2(-(-n // self.page_size)), self.max_pages)
+        Cpad = bucket * self.page_size
+        ids = np.zeros((1, Cpad), np.int32)
+        ids[0, :n] = req.prompt_ids[start:start + n]
+        fn = self._chunk_jits.get(bucket)
+        if fn is None:
+            fn = jax.jit(self._chunk_prefill_fn, donate_argnums=(1, 2))
+            self._chunk_jits[bucket] = fn
+        self._kpools, self._vpools, tok = fn(
+            self._param_vals, self._kpools, self._vpools, ids,
+            np.int32(start), np.int32(n), slot.row.copy(),
+            np.float32(req.temperature), self._next_key())
+        slot.prefill_pos = start + n
+        if not slot.prefilling:
+            self._finish_prefill(slot_idx, int(np.asarray(tok)))
+        return n
+
+    def _finish_prefill(self, slot_idx: int, tok: int):
+        """Prompt fully populated: make the slot decode-visible, publish
+        its full prompt pages into the prefix index, and record the
+        first generated token."""
+        slot = self._slots[slot_idx]
+        self._page_table[slot_idx, :] = slot.row
+        self._lengths[slot_idx] = slot.t0
+        self._temps[slot_idx] = slot.request.temperature
+        if self._prefix is not None:
+            self._prefix.insert(slot.request.prompt_ids, slot.row,
+                                self._alloc)
+        if self._finish_token(slot_idx, tok,
+                              time.perf_counter() - slot.t_admit):
+            self._evict(slot_idx)
+
+    def _advance_prefill(self) -> int:
+        """Chunked-prefill scheduler: round-robin one chunk at a time
+        over prefilling slots, never exceeding ``token_budget`` real
+        prompt tokens per engine step. Returns tokens processed."""
+        budget = self.token_budget
+        spent = 0
+        progressed = True
+        while budget > 0 and progressed:
+            progressed = False
+            pf = [s for s in range(self.num_slots)
+                  if self._slots[s] is not None
+                  and self._slots[s].prefilling]
+            if not pf:
+                break
+            for k in range(len(pf)):
+                s = pf[(self._prefill_rr + k) % len(pf)]
+                slot = self._slots[s]
+                if slot is None or not slot.prefilling:
+                    continue
+                nxt = min(slot.t0 - slot.prefill_pos,
+                          self.chunk_pages * self.page_size)
+                if nxt > budget:
+                    continue
+                n = self._run_chunk(s)
+                budget -= n
+                spent += n
+                progressed = True
+            self._prefill_rr += 1
+        self.max_step_prefill_tokens = max(self.max_step_prefill_tokens,
+                                           spent)
+        return spent
 
     def _ensure_tail_pages(self):
         """Lazily allocate the page the NEXT write position needs —
-        this is where cache memory tracks live tokens."""
+        this is where cache memory tracks live tokens. Prefilling slots
+        are skipped: they are decode-invisible and their pages are
+        already mapped."""
         for s in range(self.num_slots):
-            if self._slots[s] is None:
+            slot = self._slots[s]
+            if slot is None or slot.prefilling:
                 continue
             pi = int(self._lengths[s]) // self.page_size
             if self._page_table[s, pi] == NULL_PAGE:
                 page = self._alloc.alloc()
                 self._page_table[s, pi] = page
-                self._slots[s].allocated.append(page)
+                slot.row[pi] = page
+                slot.refs.append(page)
 
     def step(self) -> int:
-        """Admit, then run ONE decode step for all slots. Returns the
-        number of live slots that advanced."""
+        """Admit, advance chunked prefill under the token budget, then
+        run ONE decode step for all decode-ready slots. Returns the
+        number of live slots that advanced a decode token."""
         self._admit()
+        if self.chunk_pages is not None:
+            self._advance_prefill()
         live = [s for s in range(self.num_slots)
-                if self._slots[s] is not None]
+                if self._slots[s] is not None
+                and not self._slots[s].prefilling]
         if not live:
             return 0
         self._ensure_tail_pages()
@@ -428,6 +735,45 @@ class InferenceEngine:
         return len(live)
 
     # ------------------------------------------------------------- #
+    # page accounting audit (tests / debugging)
+    # ------------------------------------------------------------- #
+
+    def audit_pages(self):
+        """Assert the global page invariant: every page 1..P-1 is EITHER
+        on the free list (refcount 0) OR live — and a live page's
+        refcount equals exactly the number of slot mappings plus index
+        entries that hold it. Raises MXNetError on any leak (page
+        unreachable but not free) or double grant (page free AND
+        referenced, or granted twice)."""
+        expect = [0] * self.num_pages
+        for slot in self._slots:
+            if slot is None:
+                continue
+            for p in slot.refs:
+                expect[p] += 1
+        if self._prefix is not None:
+            for p in self._prefix.held_pages():
+                expect[p] += 1
+        free = self._alloc._free
+        free_set = set(free)
+        if len(free_set) != len(free):
+            raise MXNetError("page audit: duplicate pages on the free "
+                             "list (double grant)")
+        if NULL_PAGE in free_set:
+            raise MXNetError("page audit: the null page is on the free "
+                             "list")
+        for p in range(1, self.num_pages):
+            rc = self._alloc.refcount(p)
+            if rc != expect[p]:
+                raise MXNetError(
+                    f"page audit: page {p} refcount {rc} != "
+                    f"{expect[p]} references held (slots + index)")
+            if (p in free_set) == (rc > 0):
+                state = "free AND referenced (double grant)" if rc > 0 \
+                    else "neither free nor referenced (leak)"
+                raise MXNetError(f"page audit: page {p} is {state}")
+
+    # ------------------------------------------------------------- #
     # elastic checkpointing / warm restart (checkpoint/ subsystem)
     # ------------------------------------------------------------- #
 
@@ -437,6 +783,10 @@ class InferenceEngine:
         programs, so as long as shapes and dtypes match, the compiled
         steps are reused as-is (``decode_trace_count`` stays put —
         asserted in tests/test_serve.py).
+
+        The prefix index is FLUSHED: cached K/V was computed under the
+        old weights, and serving it against new weights would silently
+        mix models (``prefix_flushes`` counts, asserted in tests).
 
         ``params``: dict keyed by Parameter name (a training capsule's
         ``param/`` entries also accepted), or pass ``manager`` (+
@@ -476,6 +826,11 @@ class InferenceEngine:
             p.data()._data = new
         self._param_vals = tuple(p.data()._data
                                  for p in self._eng_params)
+        if self._prefix is not None:
+            # cached K/V is weight-dependent — a prefix computed under
+            # the old weights must never be matched again
+            self._prefix.flush(self._alloc)
+            self.prefix_flushes += 1
         self.warm_restarts += 1
 
     def save_checkpoint(self, manager, step=None, block=False) -> int:

@@ -20,26 +20,45 @@ Invariants (enforced by the engine, asserted in tests):
   - Pages are identity-free: eviction returns them to the free list and
     any slot may reuse them without clearing (the next writer overwrites
     the prefix it needs; the tail is masked).
+  - **Pages are reference-counted.** A page may be mapped read-only into
+    several slots' page tables at once (prefix sharing) and retained by
+    the host-side prefix index; it returns to the free list only when
+    the last reference drops. A shared page is NEVER written: decode
+    writes land at positions >= the slot's prompt length, past every
+    shared prefix page, and the first partial page after a matched
+    prefix is COPIED into a private page before the slot writes it
+    (copy-on-write at page granularity).
 
-The allocator is deliberately host-side Python (a free list), matching
-the scheduler split: device programs are occupancy-oblivious, all
-allocation decisions ride in as int32 data.
+The allocator and the prefix index are deliberately host-side Python,
+matching the scheduler split: device programs are occupancy-oblivious,
+all allocation/sharing decisions ride in as int32 data.
 """
 
 from __future__ import annotations
 
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
 import jax.numpy as jnp
+import numpy as np
 
 from ..base import MXNetError
 
 NULL_PAGE = 0
 
-__all__ = ["NULL_PAGE", "PageAllocator", "init_kv_pools",
+__all__ = ["NULL_PAGE", "PageAllocator", "PrefixIndex", "init_kv_pools",
            "write_token_kv", "write_prompt_kv"]
 
 
 class PageAllocator:
-    """Free-list allocator over pages 1..num_pages-1 (page 0 = null)."""
+    """Reference-counted free-list allocator over pages 1..num_pages-1
+    (page 0 = null). ``alloc`` hands out a page at refcount 1;
+    ``incref`` adds a sharer; ``free``/``decref`` drops one reference
+    and returns the page to the free list when the last one goes.
+
+    Corruption is refused loudly instead of silently poisoning the free
+    list: freeing the null page, double-freeing a page already back on
+    the free list, or dropping a refcount below zero all raise."""
 
     def __init__(self, num_pages: int):
         if num_pages < 2:
@@ -47,22 +66,241 @@ class PageAllocator:
         self.num_pages = num_pages
         # LIFO reuse keeps the working set of hot pages small
         self._free = list(range(num_pages - 1, 0, -1))
+        self._rc = [0] * num_pages
 
     @property
     def free_count(self) -> int:
         return len(self._free)
 
+    def _check(self, page) -> int:
+        p = int(page)
+        if p == NULL_PAGE:
+            raise MXNetError("the null page (page 0) is never allocated, "
+                             "shared, or freed")
+        if not 0 < p < self.num_pages:
+            raise MXNetError(f"page {p} outside pool [1, "
+                             f"{self.num_pages})")
+        return p
+
+    def refcount(self, page) -> int:
+        return self._rc[self._check(page)]
+
     def alloc(self) -> int:
         if not self._free:
             raise MXNetError("KV page pool exhausted — admission control "
                              "should have prevented this (engine bug)")
-        return self._free.pop()
+        p = self._free.pop()
+        self._rc[p] = 1
+        return p
+
+    def incref(self, page) -> None:
+        """Add a reference to a LIVE page (prefix sharing / index
+        retention). Sharing a page that is on the free list would hand
+        the same page to two owners — refused."""
+        p = self._check(page)
+        if self._rc[p] <= 0:
+            raise MXNetError(f"incref on free page {p} — a page must be "
+                             f"live to be shared")
+        self._rc[p] += 1
+
+    def decref(self, page) -> bool:
+        """Drop one reference; returns True when the page went back to
+        the free list. A decref on a page whose refcount is already zero
+        is a double free (or a below-zero drop) and raises."""
+        p = self._check(page)
+        if self._rc[p] <= 0:
+            raise MXNetError(
+                f"double free: page {p} already has refcount 0 (it is "
+                f"on the free list) — refusing to corrupt the free list")
+        self._rc[p] -= 1
+        if self._rc[p] == 0:
+            self._free.append(p)
+            return True
+        return False
 
     def free(self, pages) -> None:
         for p in pages:
-            if p == NULL_PAGE:
-                raise MXNetError("attempted to free the null page")
-            self._free.append(int(p))
+            self.decref(p)
+
+
+@dataclasses.dataclass(eq=False)        # identity semantics: entries are
+class _PrefixEntry:                     # tracked by object, and ndarray
+    page: int                           # fields break generated __eq__
+    tokens: np.ndarray          # the page's token ids (full page)
+    depth: int                  # page index within its prompt chain
+    last_use: int
+
+
+class PrefixIndex:
+    """Host-side hash-radix index over page-aligned prompt prefixes.
+
+    A radix node is keyed by the BYTES OF THE WHOLE TOKEN PREFIX that
+    precedes its pages (int32, fixed width — byte-prefix equality is
+    token-prefix equality) and holds the SIBLING entries extending that
+    prefix (several prompt families may diverge at the same depth), so
+    lookups walk page by page exactly like a radix tree without storing
+    child pointers. Each entry holds its page's own tokens for
+    verification and the shared page id; the index owns one allocator
+    reference per entry.
+
+    Matching returns the longest cached page-aligned prefix as
+    read-only shared pages plus (when the boundary page's leading
+    tokens match) a partial page to copy — capped at ``t0 - 1`` tokens
+    so the LAST prompt token is always recomputed: its logits seed
+    first-token sampling, which cached K/V alone cannot provide.
+
+    ``flush`` drops every entry (cached K/V is weight-dependent — the
+    engine flushes on ``warm_start``); ``reclaim`` evicts
+    least-recently-used entries whose pages nobody else references,
+    which is how admission turns cache retention back into free pages
+    under pressure."""
+
+    def __init__(self, page_size: int):
+        self.page_size = int(page_size)
+        # radix node: preceding-prefix bytes -> sibling entries
+        self._nodes: Dict[bytes, List[_PrefixEntry]] = {}
+        self._clock = 0
+        self.flushes = 0
+
+    def __len__(self) -> int:
+        return sum(len(b) for b in self._nodes.values())
+
+    def held_pages(self) -> List[int]:
+        return [e.page for b in self._nodes.values() for e in b]
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def match(self, prompt_ids) -> Tuple[List[int],
+                                         Optional[Tuple[int, int]], int]:
+        """Longest cached page-aligned prefix of ``prompt_ids``.
+
+        Returns ``(shared, partial, cached_len)``: ``shared`` is the
+        list of full pages to map read-only (the caller must incref
+        them), ``partial`` is ``(src_page, n_tokens)`` for a boundary
+        page whose first ``n_tokens`` match (to copy into a private
+        page), or None, and ``cached_len == page_size * len(shared) +
+        n_tokens`` is the number of prompt tokens whose K/V is already
+        cached (always <= t0 - 1)."""
+        ps = self.page_size
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        t0 = prompt.size
+        shared: List[int] = []
+        m = 0
+        while True:
+            siblings = self._nodes.get(prompt[:m * ps].tobytes())
+            if not siblings:
+                break
+            rest = prompt[m * ps:]
+            full = None
+            if rest.size > ps:
+                for ent in siblings:
+                    if np.array_equal(ent.tokens, rest[:ps]):
+                        full = ent
+                        break
+            if full is not None:
+                # whole page matches and the prompt continues past it
+                full.last_use = self._tick()
+                shared.append(full.page)
+                m += 1
+                continue
+            # boundary page: the sibling with the longest common
+            # leading run, capped so at least one prompt token is left
+            # to recompute (its logits seed first-token sampling)
+            lim = min(ps, rest.size, t0 - 1 - m * ps)
+            best, best_n = None, 0
+            for ent in siblings:
+                n = 0
+                while n < lim and ent.tokens[n] == rest[n]:
+                    n += 1
+                if n > best_n:
+                    best, best_n = ent, n
+            if best is not None:
+                best.last_use = self._tick()
+                return shared, (best.page, best_n), m * ps + best_n
+            break
+        return shared, None, m * ps
+
+    def insert(self, prompt_ids, pages, allocator: PageAllocator) -> int:
+        """Publish the prompt's FULL pages (``pages[j]`` holds tokens
+        ``[j*ps, (j+1)*ps)``); the index increfs each newly-published
+        page. An existing sibling with the same content is kept (first
+        writer wins — duplicate K/V pages earn no second entry).
+        Returns the number of new entries."""
+        ps = self.page_size
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        added = 0
+        for j in range(prompt.size // ps):
+            key = prompt[:j * ps].tobytes()
+            toks = prompt[j * ps:(j + 1) * ps]
+            siblings = self._nodes.setdefault(key, [])
+            dup = next((e for e in siblings
+                        if np.array_equal(e.tokens, toks)), None)
+            if dup is not None:
+                dup.last_use = self._tick()
+                continue
+            allocator.incref(pages[j])
+            siblings.append(_PrefixEntry(
+                page=int(pages[j]), tokens=toks.copy(), depth=j,
+                last_use=self._tick()))
+            added += 1
+        return added
+
+    def reclaimable(self, allocator: PageAllocator) -> int:
+        """Pages that ``reclaim`` could return to the free list right
+        now: entries whose page nobody but the index references."""
+        return sum(1 for b in self._nodes.values() for e in b
+                   if allocator.refcount(e.page) == 1)
+
+    def _drop(self, key: bytes, ent: _PrefixEntry,
+              allocator: PageAllocator) -> int:
+        """Remove one entry and its now-unreachable descendants (every
+        entry under nodes whose key extends this entry's prefix).
+        Returns pages actually returned to the free list — descendant
+        pages still referenced by live slots merely lose the index's
+        ref."""
+        freed = 0
+        child_prefix = key + ent.tokens.tobytes()
+        for k in [k for k in self._nodes if k.startswith(child_prefix)]:
+            for e in self._nodes.pop(k):
+                if allocator.decref(e.page):
+                    freed += 1
+        bucket = self._nodes[key]
+        bucket.remove(ent)
+        if not bucket:
+            del self._nodes[key]
+        if allocator.decref(ent.page):
+            freed += 1
+        return freed
+
+    def reclaim(self, n: int, allocator: PageAllocator) -> int:
+        """Evict least-recently-used index-only entries until ``n``
+        pages returned to the free list (or candidates run out)."""
+        freed = 0
+        order = sorted(
+            [(k, e) for k, b in self._nodes.items() for e in b],
+            key=lambda kv: (kv[1].last_use, -kv[1].depth))
+        for key, ent in order:
+            if freed >= n:
+                break
+            bucket = self._nodes.get(key)
+            if bucket is None or ent not in bucket:
+                continue                      # cascaded away already
+            if allocator.refcount(ent.page) != 1:
+                continue                      # a live slot still maps it
+            freed += self._drop(key, ent, allocator)
+        return freed
+
+    def flush(self, allocator: PageAllocator) -> None:
+        """Drop every entry (cached K/V is weight-dependent): pages held
+        only by the index go back to the free list; pages still mapped
+        by live slots survive through the slots' own references."""
+        for bucket in self._nodes.values():
+            for e in bucket:
+                allocator.decref(e.page)
+        self._nodes.clear()
+        self.flushes += 1
 
 
 def init_kv_pools(num_layers, num_pages, num_heads, page_size, head_dim,
@@ -74,13 +312,16 @@ def init_kv_pools(num_layers, num_pages, num_heads, page_size, head_dim,
 
 
 def write_token_kv(pool, new, pages, offsets):
-    """Scatter one decode token's K (or V) per slot into the pool.
+    """Scatter one K (or V) row per entry into the pool.
 
-    pool: (P, H, ps, D); new: (S, H, D); pages/offsets: (S,) int32 —
-    slot s writes ``new[s]`` to ``pool[pages[s], :, offsets[s], :]``.
-    Inactive slots carry pages[s] == NULL_PAGE, so their write lands in
-    the null page (harmless, never read unmasked). Static shapes; safe
-    under jit."""
+    pool: (P, H, ps, D); new: (N, H, D); pages/offsets: (N,) int32 —
+    entry n writes ``new[n]`` to ``pool[pages[n], :, offsets[n], :]``.
+    Serves both the decode step (one token per SLOT, N = num_slots;
+    inactive slots carry pages[n] == NULL_PAGE) and chunked prefill
+    (one row per CHUNK TOKEN of a single slot, N = chunk length; padded
+    tokens carry NULL_PAGE) — either way dead writes land in the null
+    page, harmless and never read unmasked. Static shapes; safe under
+    jit."""
     H = pool.shape[1]
     return pool.at[pages[:, None], jnp.arange(H)[None, :],
                    offsets[:, None], :].set(new.astype(pool.dtype))

@@ -13,7 +13,9 @@ import jax
 import jax.numpy as jnp
 
 from incubator_mxnet_tpu.ops.ragged_attention import (
-    _ragged_pallas, ragged_attention_reference, ragged_paged_attention)
+    _ragged_pallas, _ragged_prefill_pallas, ragged_attention_reference,
+    ragged_paged_attention, ragged_prefill_attention,
+    ragged_prefill_reference)
 
 
 def _make_case(rng, S, H, D, page_size, max_pages, lengths,
@@ -155,6 +157,186 @@ def test_dispatcher_and_dtype():
     assert b16.dtype == jnp.bfloat16
     np.testing.assert_allclose(np.asarray(b16, np.float32),
                                np.asarray(ref), rtol=0.05, atol=0.05)
+
+
+# --------------------------------------------------------------------- #
+# prefill over a paged prefix (the chunked-prefill variant)
+# --------------------------------------------------------------------- #
+
+def _make_prefill_case(rng, H, D, ps, T, pages, num_pages=16,
+                       dtype=np.float32):
+    """A single slot's paged K/V for a T-token prompt laid out through
+    the (shuffled) ``pages`` list, plus the dense per-token rows for the
+    numpy oracle. The null page is poisoned — its contents must never
+    matter."""
+    kp = np.zeros((num_pages, H, ps, D), dtype)
+    vp = np.zeros((num_pages, H, ps, D), dtype)
+    tok_k = rng.randn(T, H, D).astype(dtype)
+    tok_v = rng.randn(T, H, D).astype(dtype)
+    for t in range(T):
+        kp[pages[t // ps], :, t % ps, :] = tok_k[t]
+        vp[pages[t // ps], :, t % ps, :] = tok_v[t]
+    kp[0] = 1e9
+    vp[0] = -1e9
+    return kp, vp, tok_k, tok_v
+
+
+def _prefill_oracle(q, tok_k, tok_v, q_start, n_real):
+    """Per-query dense softmax over keys [0, q_start + i] — plain numpy,
+    independent of every jnp code path."""
+    C, H, D = q.shape
+    out = np.zeros((C, H, D), np.float32)
+    for i in range(n_real):
+        L = q_start + i + 1
+        for h in range(H):
+            s = tok_k[:L, h].astype(np.float32) @ \
+                q[i, h].astype(np.float32) * (D ** -0.5)
+            p = np.exp(s - s.max())
+            p /= p.sum()
+            out[i, h] = p @ tok_v[:L, h].astype(np.float32)
+    return out
+
+
+@pytest.mark.parametrize("q_start,C", [
+    (0, 8),        # first chunk, page-aligned
+    (8, 8),        # chunk starting at a page boundary
+    (13, 8),       # chunk starting mid-page (partial-copy resume)
+    (16, 5),       # odd tail chunk
+])
+@pytest.mark.parametrize("impl", ["pallas_interpret", "jnp"])
+def test_prefill_matches_dense_causal_oracle(q_start, C, impl):
+    """Chunk queries at absolute positions q_start+i over a shuffled
+    page table must match the dense per-query causal softmax, for both
+    the kernel (interpret mode) and the jnp gather reference."""
+    rng = np.random.RandomState(10)
+    H, D, ps = 3, 16, 8
+    T = q_start + C
+    pages = [5, 2, 7][:-(-T // ps)]
+    row = np.zeros((4,), np.int32)
+    row[:len(pages)] = pages
+    kp, vp, tok_k, tok_v = _make_prefill_case(rng, H, D, ps, T, pages)
+    q = rng.randn(C, H, D).astype(np.float32)
+    if impl == "pallas_interpret":
+        got = _ragged_prefill_pallas(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(row), jnp.asarray([q_start, C], jnp.int32),
+            D ** -0.5, True)
+    else:
+        got = ragged_prefill_reference(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(row), np.int32(q_start))
+    ref = _prefill_oracle(q, tok_k, tok_v, q_start, C)
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_prefill_chunk_composition_matches_single_shot():
+    """Processing a prompt as {1-page, 2-page, odd-tail} chunks must
+    reproduce the single-shot full-prompt call row for row — the
+    composition property chunked prefill rests on (each chunk sees
+    earlier chunks only through the pages they populated)."""
+    rng = np.random.RandomState(11)
+    H, D, ps = 2, 16, 8
+    T = 21                                   # 2 full pages + odd tail
+    pages = [3, 9, 6]
+    row = np.zeros((4,), np.int32)
+    row[:3] = pages
+    kp, vp, tok_k, tok_v = _make_prefill_case(rng, H, D, ps, T, pages)
+    q = rng.randn(T, H, D).astype(np.float32)
+    full = np.asarray(ragged_prefill_reference(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(row), np.int32(0)))
+    for splits in ([8, 8, 5], [16, 5], [8, 13]):
+        start = 0
+        rows = []
+        for n in splits:
+            rows.append(np.asarray(ragged_prefill_reference(
+                jnp.asarray(q[start:start + n]), jnp.asarray(kp),
+                jnp.asarray(vp), jnp.asarray(row), np.int32(start))))
+            start += n
+        np.testing.assert_allclose(np.concatenate(rows), full,
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_prefill_padded_rows_do_not_affect_real_rows():
+    """The engine pads chunks to pow2-page buckets: the padded trailing
+    queries must not change any real row, for both implementations
+    (real rows compare against the unpadded call)."""
+    rng = np.random.RandomState(12)
+    H, D, ps = 2, 8, 8
+    T, n_real, Cpad = 19, 6, 16              # chunk [13, 19) padded to 16
+    q_start = 13
+    pages = [4, 1, 8]
+    row = np.zeros((3,), np.int32)
+    row[:3] = pages
+    kp, vp, _, _ = _make_prefill_case(rng, H, D, ps, T, pages,
+                                      num_pages=12)
+    q = rng.randn(Cpad, H, D).astype(np.float32)
+    exact_ref = np.asarray(ragged_prefill_reference(
+        jnp.asarray(q[:n_real]), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(row), np.int32(q_start)))
+    padded_ref = np.asarray(ragged_prefill_reference(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(row), np.int32(q_start)))
+    np.testing.assert_array_equal(padded_ref[:n_real], exact_ref)
+    padded_pal = np.asarray(_ragged_prefill_pallas(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(row), jnp.asarray([q_start, n_real], jnp.int32),
+        D ** -0.5, True))
+    np.testing.assert_allclose(padded_pal[:n_real], exact_ref,
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_prefill_null_page_contents_never_leak():
+    """Dead page-row entries (and padded-token scatter targets) point at
+    page 0 — repoisoning it must not change any real output row."""
+    rng = np.random.RandomState(13)
+    H, D, ps = 2, 8, 8
+    T = 11
+    pages = [7, 2]
+    row = np.zeros((4,), np.int32)           # entries 2, 3 are dead
+    row[:2] = pages
+    kp, vp, _, _ = _make_prefill_case(rng, H, D, ps, T, pages)
+    q = rng.randn(T, H, D).astype(np.float32)
+    base = np.asarray(ragged_prefill_reference(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(row), np.int32(0)))
+    kp2, vp2 = kp.copy(), vp.copy()
+    kp2[0], vp2[0] = -3e8, 3e8               # different poison
+    again = np.asarray(ragged_prefill_reference(
+        jnp.asarray(q), jnp.asarray(kp2), jnp.asarray(vp2),
+        jnp.asarray(row), np.int32(0)))
+    np.testing.assert_array_equal(base, again)
+    pal = np.asarray(_ragged_prefill_pallas(
+        jnp.asarray(q), jnp.asarray(kp2), jnp.asarray(vp2),
+        jnp.asarray(row), jnp.asarray([0, T], jnp.int32),
+        D ** -0.5, True))
+    np.testing.assert_allclose(pal, base, rtol=2e-5, atol=2e-5)
+
+
+def test_prefill_dispatcher_and_dtype():
+    """The public dispatcher runs the jnp path on the CPU backend; bf16
+    inputs keep f32 accumulation and track the f32 result."""
+    rng = np.random.RandomState(14)
+    H, D, ps = 2, 8, 8
+    T = 13
+    pages = [5, 3]
+    row = np.zeros((2,), np.int32)
+    row[:2] = pages
+    kp, vp, tok_k, tok_v = _make_prefill_case(rng, H, D, ps, T, pages)
+    q = rng.randn(T, H, D).astype(np.float32)
+    out = ragged_prefill_attention(jnp.asarray(q), jnp.asarray(kp),
+                                   jnp.asarray(vp), jnp.asarray(row),
+                                   np.int32(0))
+    ref = _prefill_oracle(q, tok_k, tok_v, 0, T)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5,
+                               atol=2e-5)
+    b16 = ragged_prefill_attention(
+        jnp.asarray(q, jnp.bfloat16), jnp.asarray(kp, jnp.bfloat16),
+        jnp.asarray(vp, jnp.bfloat16), jnp.asarray(row), np.int32(0))
+    assert b16.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(b16, np.float32), ref,
+                               rtol=0.06, atol=0.06)
 
 
 def test_kernel_page_table_permutation_invariance():

@@ -16,7 +16,8 @@ from incubator_mxnet_tpu import nd
 from incubator_mxnet_tpu.base import MXNetError
 from incubator_mxnet_tpu.models import gpt as g
 from incubator_mxnet_tpu.serve import InferenceEngine, Request
-from incubator_mxnet_tpu.serve.paged_kv import NULL_PAGE, PageAllocator
+from incubator_mxnet_tpu.serve.paged_kv import (NULL_PAGE, PageAllocator,
+                                                PrefixIndex)
 
 
 @pytest.fixture(scope="module")
@@ -68,7 +69,11 @@ def test_mixed_occupancy_no_cross_contamination_and_slot_reuse(model):
                                                  np.int32), ref)
     assert eng.decode_trace_count == 1, \
         "decode step retraced under occupancy churn"
-    assert eng._alloc.free_count == eng.num_pages - 1   # all reclaimed
+    # every page is either on the free list or retained by the prefix
+    # index (full prompt pages stay cached for reuse) — nothing leaked
+    eng.audit_pages()
+    assert eng._alloc.free_count == eng.num_pages - 1 - len(eng._prefix)
+    assert len(eng._prefix) > 0          # the full prompt pages cached
     assert (eng._page_table == NULL_PAGE).all()
     assert (eng._lengths == 0).all()
 
@@ -241,3 +246,338 @@ def test_page_allocator_invariants():
         a.free([NULL_PAGE])
     with pytest.raises(MXNetError):
         PageAllocator(1)
+
+
+def test_page_allocator_refcount_hardening():
+    """Free-list corruption is refused loudly: freeing the null page,
+    double-freeing a page already back on the free list, and dropping a
+    refcount below zero all raise instead of silently double-granting
+    pages later."""
+    a = PageAllocator(6)
+    # double free: the second decref finds refcount 0
+    p = a.alloc()
+    assert a.refcount(p) == 1
+    a.free([p])
+    assert a.refcount(p) == 0
+    with pytest.raises(MXNetError, match="double free"):
+        a.free([p])
+    assert a.free_count == 5                 # free list not corrupted
+    # refcount below zero through the sharing path
+    p = a.alloc()
+    a.incref(p)
+    assert a.refcount(p) == 2
+    assert not a.decref(p)                   # still live (a sharer left)
+    assert a.decref(p)                       # last ref → free list
+    with pytest.raises(MXNetError, match="double free"):
+        a.decref(p)
+    # the null page is never freeable or shareable
+    with pytest.raises(MXNetError, match="null page"):
+        a.decref(NULL_PAGE)
+    with pytest.raises(MXNetError, match="null page"):
+        a.incref(NULL_PAGE)
+    # sharing a free page would hand it to two owners
+    with pytest.raises(MXNetError, match="incref on free page"):
+        a.incref(p)
+    # a page freed by its last sharer reappears exactly once
+    q = a.alloc()
+    a.incref(q)
+    a.free([q, q])
+    assert sorted(a._free).count(q) == 1
+
+
+def test_prefix_index_radix_siblings_and_partial():
+    """Two prompt families diverging at the SAME depth must both stay
+    cached (radix siblings, not last-writer-wins), and a prompt ending
+    mid-page matches the boundary page as a partial COPY capped at
+    t0 - 1 tokens (the last token's logits must be recomputed)."""
+    ps = 4
+    a = PageAllocator(16)
+    ix = PrefixIndex(ps)
+    fam1 = np.arange(8, dtype=np.int32)              # pages [0-3],[4-7]
+    fam2 = np.arange(100, 108, dtype=np.int32)       # diverges at page 0
+    pg1 = [a.alloc(), a.alloc()]
+    pg2 = [a.alloc(), a.alloc()]
+    assert ix.insert(fam1, pg1, a) == 2
+    assert ix.insert(fam2, pg2, a) == 2              # sibling kept
+    # full-page match for a longer prompt of family 1
+    shared, partial, cached = ix.match(np.arange(16, dtype=np.int32))
+    assert shared == pg1 and partial is None and cached == 8
+    # family 2 still matchable (the sibling survived)
+    shared, partial, cached = ix.match(
+        np.arange(100, 116, dtype=np.int32))
+    assert shared == pg2 and cached == 8
+    # prompt ending mid-page: boundary page is a partial-copy source
+    shared, partial, cached = ix.match(np.arange(7, dtype=np.int32))
+    assert shared == [pg1[0]]
+    assert partial == (pg1[1], 2) and cached == 6    # capped < t0 = 7
+    # a prompt that IS entirely cached still leaves its last token:
+    # 8 tokens = 2 full pages, but only page 0 may be shared and the
+    # boundary page contributes at most t0 - 1 - ps = 3 tokens
+    shared, partial, cached = ix.match(np.arange(8, dtype=np.int32))
+    assert shared == [pg1[0]]
+    assert partial == (pg1[1], 3) and cached == 7
+    # no match at all
+    shared, partial, cached = ix.match(
+        np.arange(500, 512, dtype=np.int32))
+    assert shared == [] and partial is None and cached == 0
+
+
+def test_prefix_index_reclaim_lru_and_flush():
+    """reclaim frees LRU index-only pages (live-slot pages are skipped),
+    evicting a parent cascades its unreachable descendants, and flush
+    drops everything while slot-held pages survive via the slot refs."""
+    ps = 4
+    a = PageAllocator(16)
+    ix = PrefixIndex(ps)
+    fam1 = np.arange(8, dtype=np.int32)
+    fam2 = np.arange(100, 108, dtype=np.int32)
+    pg1 = [a.alloc(), a.alloc()]
+    pg2 = [a.alloc(), a.alloc()]
+    ix.insert(fam1, pg1, a)
+    # touch family 1 so family 2 becomes the LRU chain
+    ix.match(np.arange(12, dtype=np.int32))
+    ix.insert(fam2, pg2, a)
+    ix.match(np.arange(12, dtype=np.int32))
+    # drop the slots' own refs — pages now held only by the index
+    a.free(pg1 + pg2)
+    free0 = a.free_count
+    assert ix.reclaimable(a) == 4
+    freed = ix.reclaim(1, a)
+    # fam2's root page was LRU; evicting it cascades its child
+    assert freed == 2 and a.free_count == free0 + 2
+    assert ix.match(np.arange(100, 112, dtype=np.int32))[0] == []
+    assert ix.match(np.arange(12, dtype=np.int32))[0] == pg1
+    # a page still referenced by a live slot is not reclaimable
+    a.incref(pg1[0])
+    assert ix.reclaimable(a) == 1            # only the depth-1 page
+    ix.flush(a)
+    assert len(ix) == 0 and ix.flushes == 1
+    assert a.refcount(pg1[0]) == 1           # the slot's ref survived
+    a.free([pg1[0]])
+    assert a.free_count == a.num_pages - 1
+
+
+@pytest.mark.slow
+def test_prefix_cache_hit_token_parity(model):
+    """Requests sharing a persona prefix: later admissions must match
+    the cached pages (hit counted, suffix-only prefill) and emit
+    EXACTLY their solo tokens — shared pages are read-only, the
+    boundary page is copied, so sharing is invisible to every request."""
+    rng = np.random.RandomState(21)
+    persona = rng.randint(0, 64, size=(20,)).astype(np.int32)
+    prompts = [np.concatenate([persona,
+                               rng.randint(0, 64, size=(5,)).astype(
+                                   np.int32)]) for _ in range(3)]
+    refs = [_solo_reference(model, p, 8) for p in prompts]
+    eng = InferenceEngine(model, num_slots=2, page_size=8, max_len=64)
+    reqs = [Request(p.copy(), max_new_tokens=8) for p in prompts]
+    eng.run(reqs)
+    for req, ref in zip(reqs, refs):
+        np.testing.assert_array_equal(np.asarray(req.token_ids,
+                                                 np.int32), ref)
+    assert eng.prefix_hits >= 1, "no admission ever hit the cache"
+    assert eng.prefix_hit_tokens >= 16      # >= 2 full shared pages
+    assert eng.copy_trace_count <= 1        # COW copy compiled once
+    assert eng.decode_trace_count == 1
+    assert all(v == 1 for v in eng.prefill_trace_counts.values()), \
+        f"a prefill bucket retraced: {eng.prefill_trace_counts}"
+    eng.audit_pages()
+
+
+@pytest.mark.slow
+def test_shared_pages_cross_slot_isolation(model):
+    """Two same-persona requests decode CONCURRENTLY with the persona
+    pages mapped into both page tables (one read-only shared mapping):
+    each must still emit exactly its solo tokens, and a greedy request
+    next to a hot-sampling one stays bit-identical (sharing must not
+    leak sampling state either)."""
+    rng = np.random.RandomState(22)
+    persona = rng.randint(0, 64, size=(16,)).astype(np.int32)
+    p1 = np.concatenate([persona, rng.randint(0, 64, size=(4,)).astype(
+        np.int32)])
+    p2 = np.concatenate([persona, rng.randint(0, 64, size=(7,)).astype(
+        np.int32)])
+    refs = [_solo_reference(model, p, 10) for p in (p1, p2)]
+    eng = InferenceEngine(model, num_slots=2, page_size=8, max_len=64)
+    r1 = Request(p1.copy(), max_new_tokens=10)
+    r2 = Request(p2.copy(), max_new_tokens=10, temperature=1.1)
+    # same _admit pass: r1 cold-prefills + publishes, r2 hits and maps
+    # the SAME physical pages while r1 is still live
+    eng.run([r1, r2])
+    assert eng.prefix_hits == 1
+    np.testing.assert_array_equal(np.asarray(r1.token_ids, np.int32),
+                                  refs[0])
+    assert len(r2.token_ids) == 10
+    # greedy parity for the sharer too (own run, fresh engine: both
+    # slots greedy, r2 shares r1's persona pages)
+    eng2 = InferenceEngine(model, num_slots=2, page_size=8, max_len=64)
+    r1b = Request(p1.copy(), max_new_tokens=10)
+    r2b = Request(p2.copy(), max_new_tokens=10)
+    eng2.run([r1b, r2b])
+    assert eng2.prefix_hits == 1
+    np.testing.assert_array_equal(np.asarray(r2b.token_ids, np.int32),
+                                  refs[1])
+    eng2.audit_pages()
+
+
+def test_warm_start_flushes_prefix_cache(model):
+    """SATELLITE: after a weight swap a previously-cached prefix must
+    not be served from stale K/V — the index is flushed (asserted), the
+    same prompt re-admitted under new weights emits the NEW model's
+    tokens, and the decode step keeps its single compile."""
+    mx.random.seed(77)
+    model_b = g.gpt_mini(vocab_size=64, max_length=64)
+    model_b.initialize()
+    rng = np.random.RandomState(23)
+    prompt = rng.randint(0, 64, size=(20,)).astype(np.int32)
+    ref_a = _solo_reference(model, prompt, 8)
+    ref_b = _solo_reference(model_b, prompt, 8)
+    # distinguishable models (otherwise staleness would be invisible)
+    assert not np.array_equal(ref_a, ref_b)
+
+    eng = InferenceEngine(model, num_slots=2, page_size=8, max_len=64)
+    r0 = Request(prompt.copy(), max_new_tokens=8)
+    eng.run([r0])                            # publishes the prefix
+    r1 = Request(prompt.copy(), max_new_tokens=8)
+    eng.run([r1])                            # served WITH the cache
+    assert eng.prefix_hits == 1
+    np.testing.assert_array_equal(np.asarray(r1.token_ids, np.int32),
+                                  ref_a)
+
+    params_b = {str(i): p.data().asnumpy() for i, p in
+                enumerate(model_b.collect_params().values())}
+    eng.warm_start(params=params_b)
+    assert eng.prefix_flushes == 1
+    assert len(eng._prefix) == 0, "warm_start left stale prefix entries"
+    hits_before = eng.prefix_hits
+
+    r2 = Request(prompt.copy(), max_new_tokens=8)
+    eng.run([r2])
+    # stale K/V would reproduce ref_a here; the flush forces a cold
+    # prefill under the new weights
+    np.testing.assert_array_equal(np.asarray(r2.token_ids, np.int32),
+                                  ref_b)
+    assert eng.prefix_hits == hits_before    # the re-admission was a miss
+    assert eng.decode_trace_count == 1, "weight swap retraced decode"
+    eng.audit_pages()
+
+
+def test_chunk_config_validation(model):
+    with pytest.raises(MXNetError, match="power of two"):
+        InferenceEngine(model, num_slots=2, page_size=8, max_len=64,
+                        chunk_pages=3)
+    with pytest.raises(MXNetError, match="token_budget"):
+        InferenceEngine(model, num_slots=2, page_size=8, max_len=64,
+                        chunk_pages=2, token_budget=8)
+
+
+@pytest.mark.slow
+def test_chunked_prefill_respects_token_budget_and_interleaves(model):
+    """A long-prompt arrival under chunked prefill must never process
+    more than token_budget prompt tokens per engine step, and decode
+    for already-live slots must keep advancing BETWEEN its chunks (the
+    TPOT-freeze fix — a monolithic prefill would run to completion
+    inside one admission)."""
+    rng = np.random.RandomState(24)
+    shorts = [Request(rng.randint(0, 64, size=(4,)).astype(np.int32),
+                      max_new_tokens=24) for _ in range(2)]
+    long_req = Request(rng.randint(0, 64, size=(40,)).astype(np.int32),
+                       max_new_tokens=4)
+    eng = InferenceEngine(model, num_slots=3, page_size=8, max_len=64,
+                          prefix_cache=False, chunk_pages=1)
+    for r in shorts:
+        eng.submit(r)
+    while any(not r.token_ids for r in shorts):
+        eng.step()                           # shorts admitted + decoding
+    ds0 = eng.decode_steps
+    eng.submit(long_req)
+    while not long_req.token_ids:
+        eng.step()
+    # 40 tokens / (1 page * 8) budget = 5 chunks → >= 5 steps, and the
+    # shorts decoded through every one of them
+    assert eng.decode_steps - ds0 >= 5
+    assert min(len(r.token_ids) for r in shorts) >= 5
+    assert eng.max_step_prefill_tokens <= eng.token_budget
+    while any(eng._slots):
+        eng.step()
+    ref_long = _solo_reference(model, long_req.prompt_ids, 4)
+    np.testing.assert_array_equal(
+        np.asarray(long_req.token_ids, np.int32), ref_long)
+    for r in shorts:
+        np.testing.assert_array_equal(
+            np.asarray(r.token_ids, np.int32),
+            _solo_reference(model, r.prompt_ids, 24))
+    assert eng.decode_trace_count == 1
+    eng.audit_pages()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("chunk_pages", [1, 2])
+def test_chunked_prefill_parity_across_chunk_sizes(model, chunk_pages):
+    """SATELLITE: chunked processing must emit bit-identical tokens to
+    the monolithic path across chunk sizes {1 page, 2 pages} and
+    prompts covering {sub-page, exact-page, odd-tail} lengths, at mixed
+    occupancy with staggered arrivals. The oracle is the solo
+    dense-cache decode — the same bar the monolithic engine meets, so
+    equality here IS first-token parity with PR 2 prefill."""
+    rng = np.random.RandomState(25)
+    lens = (3, 16, 17, 9, 26)                # odd tails + exact pages
+    news = (10, 6, 12, 8, 9)
+    prompts = [rng.randint(0, 64, size=(n,)).astype(np.int32)
+               for n in lens]
+    refs = [_solo_reference(model, p, k) for p, k in zip(prompts, news)]
+    eng = InferenceEngine(model, num_slots=3, page_size=8, max_len=64,
+                          prefix_cache=False, chunk_pages=chunk_pages)
+    reqs = [Request(p, max_new_tokens=k) for p, k in zip(prompts, news)]
+    eng.run(reqs, arrival_times=[0.0, 0.0, 0.01, 0.02, 0.03])
+    for req, ref in zip(reqs, refs):
+        np.testing.assert_array_equal(np.asarray(req.token_ids,
+                                                 np.int32), ref)
+    assert eng.decode_trace_count == 1
+    assert all(k[0] == "chunk" for k in eng.prefill_trace_counts), \
+        "chunked engine ran a dense prefill"
+    assert all(v == 1 for v in eng.prefill_trace_counts.values()), \
+        f"a chunk bucket retraced: {eng.prefill_trace_counts}"
+    assert eng.max_step_prefill_tokens <= eng.token_budget
+    eng.audit_pages()
+
+
+@pytest.mark.slow
+def test_prefix_churn_accounting_no_leak_no_double_grant(model):
+    """SATELLITE: churn admissions/evictions with shared prefixes
+    through a POOL SMALL ENOUGH TO FORCE RECLAIM and audit after every
+    step: every page is at all times either live-referenced (slots +
+    index, refcount exact) or on the free list — no leak, no double
+    grant. Token parity holds for every request despite the sharing and
+    index evictions."""
+    rng = np.random.RandomState(26)
+    personas = [rng.randint(0, 64, size=(16,)).astype(np.int32)
+                for _ in range(3)]
+    prompts = [np.concatenate([personas[i % 3],
+                               rng.randint(0, 64, size=(3 + i % 5,))
+                               .astype(np.int32)])
+               for i in range(9)]
+    news = [4 + (i % 3) for i in range(9)]
+    refs = [_solo_reference(model, p, k) for p, k in zip(prompts, news)]
+    # worst case per request: ceil((23+6)/8)=4 pages; 2 slots → up to 8
+    # live pages; 9 usable pages leaves no headroom for the 6 persona
+    # pages the index wants to retain → admissions must reclaim
+    eng = InferenceEngine(model, num_slots=2, page_size=8, max_len=64,
+                          num_pages=10)
+    for p, k in zip(prompts, news):
+        eng.submit(Request(p.copy(), max_new_tokens=k))
+    reqs = [r for r in eng._queue]
+    steps = 0
+    while eng._queue or eng.active_count:
+        eng.step()
+        eng.audit_pages()                    # invariant holds mid-churn
+        steps += 1
+        assert steps < 2000
+    for req, ref in zip(reqs, refs):
+        np.testing.assert_array_equal(np.asarray(req.token_ids,
+                                                 np.int32), ref)
+    assert eng.prefix_hits > 0
+    assert eng.prefix_reclaimed_pages > 0, \
+        "pool never pressured the index — test is not exercising reclaim"
+    assert eng.decode_trace_count == 1
+    eng.audit_pages()

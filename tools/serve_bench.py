@@ -1,4 +1,5 @@
-"""Serving benchmark: continuous batching vs looped per-request decode.
+"""Serving benchmark: continuous batching vs looped per-request decode,
+plus the round-9 serving levers — prefix caching and chunked prefill.
 
 Measures what the serve/ subsystem buys over the repo's previous only
 inference path (per-request ``cached_generate`` over dense (B, Tmax)
@@ -10,21 +11,39 @@ baseline serves the SAME request set one at a time. Reported:
     completion) for both paths, and the speedup;
   - p50/p99 time-per-output-token (TPOT) across all generated tokens
     (each token is stamped with the decode-step wall time that emitted
-    it; the first token carries its prefill time — so p99 captures the
-    prefill-insert stalls continuous batching is supposed to hide);
+    it; the first token carries its prefill time), AND p50/p99
+    INTER-TOKEN latency from absolute token timestamps — unlike the
+    per-step time, the gap between consecutive tokens of one request
+    also captures stalls caused by OTHER requests' prefills, which is
+    exactly the spike chunked prefill exists to fix;
   - steady-state compile discipline: the decode step must have compiled
-    EXACTLY ONCE across the whole run despite occupancy churn.
+    EXACTLY ONCE across the whole run despite occupancy churn, and
+    every prefill/chunk bucket exactly once.
 
-``--smoke`` is the CI guard (ci/run.sh servebench stage): a fast run
-that exits non-zero on any steady-state decode retrace. CPU-measurable
-by design — the scheduler/cache win (batch 8 decode streams into one
-program instead of 8 programs of batch 1) does not need a TPU to show.
+Round-9 workloads (banked next to the original comparison):
+
+  - ``shared_prefix``: N personas × M requests (a long shared system
+    prompt per persona + a short unique suffix) served cold
+    (prefix_cache off) vs warm (on) over the SAME arrival trace —
+    banks prefix-hit rate and the tokens/s win from paying prefill
+    only for the suffix;
+  - ``long_prompt_mixed``: a stream of short prompts decoding while
+    long prompts arrive, monolithic prefill vs chunked
+    (decode-interleaved under a token budget) — banks the inter-token
+    p99 the long arrivals used to spike.
+
+``--smoke`` is the CI guard (ci/run.sh servebench stage): fast runs
+that exit non-zero on any steady-state decode retrace, on a cache-hit
+admission compiling ANY new program, or on chunked prefill exceeding
+its per-step token budget. CPU-measurable by design.
 
 Fairness notes for the baseline: every request uses the same
 (prompt_pad, total) shape so ``cached_generate`` compiles ONCE (warmed
 outside the timed window) — the 3x bar is against its best case, not
 its retrace pathology. Arrivals gate the baseline too: it may not start
-a request before that request arrived.
+a request before that request arrived. The cold/warm and
+monolithic/chunked comparisons replay identical request sets and
+arrival traces.
 
 Usage:
   python tools/serve_bench.py                # full bench, banks
@@ -53,6 +72,26 @@ def _build(seed=0, vocab=64, max_length=256):
     return model
 
 
+def _build_round9(smoke):
+    """Model for the prefix-caching / chunked-prefill workloads. The
+    full run uses a 4-layer 256-unit model: on gpt_mini a whole prefill
+    is DISPATCH-bound on CPU (one program call costs the same at 16 or
+    104 tokens), which would understate a lever whose win is prompt
+    COMPUTE skipped/split. Smoke keeps gpt_mini — it asserts contracts,
+    not magnitudes."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.models import gpt as g
+    from incubator_mxnet_tpu.models.gpt import GPTModel
+    mx.random.seed(1)
+    if smoke:
+        model = g.gpt_mini(vocab_size=64, max_length=256)
+    else:
+        model = GPTModel(vocab_size=64, units=256, hidden_size=1024,
+                         num_layers=4, num_heads=8, max_length=256)
+    model.initialize()
+    return model
+
+
 def _make_requests(n, prompt_len, max_new, rate_hz, vocab, seed=0):
     """n requests, fixed shape (fair single-compile baseline), Poisson
     arrival times at ``rate_hz``."""
@@ -74,29 +113,55 @@ def _percentile(xs, q):
     return xs[idx]
 
 
-def bench_engine(model, reqs, arrivals, num_slots, page_size):
-    from incubator_mxnet_tpu.serve import InferenceEngine
-    eng = InferenceEngine(model, num_slots=num_slots,
-                          page_size=page_size)
-    t0 = time.perf_counter()
-    eng.run(reqs, arrival_times=arrivals)
-    wall = time.perf_counter() - t0
+def _itl_gaps(reqs):
+    """Inter-token latencies from absolute token timestamps: the gap a
+    USER sees between consecutive tokens of one request — including
+    stalls caused by other requests' prefills, which per-decode-step
+    timing cannot see."""
+    gaps = []
+    for r in reqs:
+        st = r.token_stamps
+        gaps.extend(b - a for a, b in zip(st, st[1:]))
+    return gaps
+
+
+def _engine_stats(eng, reqs, wall, decode_steps0=0):
+    """Stats for the timed window (``decode_steps0`` = steps already
+    spent in an untimed warmup). Compile counts stay CUMULATIVE over the
+    engine's whole lifetime — that is the jit-once contract."""
     tokens = sum(len(r.token_ids) for r in reqs)
     # every request's FIRST token is emitted by its prefill program, not
     # a decode step — exclude them so mean_occupancy is per-decode-step
     decode_tokens = tokens - len(reqs)
+    steps = eng.decode_steps - decode_steps0
     tpot = [dt for r in reqs for dt in r.token_times]
+    itl = _itl_gaps(reqs)
     return {
         "tokens": tokens,
         "wall_s": wall,
         "tokens_per_s": tokens / wall,
         "tpot_p50_ms": _percentile(tpot, 50) * 1e3,
         "tpot_p99_ms": _percentile(tpot, 99) * 1e3,
-        "decode_steps": eng.decode_steps,
+        "itl_p50_ms": _percentile(itl, 50) * 1e3,
+        "itl_p99_ms": _percentile(itl, 99) * 1e3,
+        "itl_max_ms": (max(itl) if itl else 0.0) * 1e3,
+        "decode_steps": steps,
         "decode_trace_count": eng.decode_trace_count,
         "prefill_trace_count": eng.prefill_trace_count,
-        "mean_occupancy": decode_tokens / max(eng.decode_steps, 1),
+        "prefill_trace_counts": {f"{k[0]}{k[1]}": v for k, v in
+                                 sorted(eng.prefill_trace_counts.items())},
+        "mean_occupancy": decode_tokens / max(steps, 1),
     }
+
+
+def bench_engine(model, reqs, arrivals, num_slots, page_size, **eng_kw):
+    from incubator_mxnet_tpu.serve import InferenceEngine
+    eng = InferenceEngine(model, num_slots=num_slots,
+                          page_size=page_size, **eng_kw)
+    t0 = time.perf_counter()
+    eng.run(reqs, arrival_times=arrivals)
+    wall = time.perf_counter() - t0
+    return eng, _engine_stats(eng, reqs, wall)
 
 
 def bench_baseline(model, reqs, arrivals, max_new):
@@ -135,11 +200,219 @@ def bench_baseline(model, reqs, arrivals, max_new):
     }
 
 
+# --------------------------------------------------------------------- #
+# round-9 workloads
+# --------------------------------------------------------------------- #
+
+def _persona_requests(personas, per_persona, prefix_len, suffix_len,
+                      max_new, rate_hz, vocab, seed=7, suffix_seed=11):
+    """N personas × M requests: shared long prefix + unique suffix,
+    interleaved round-robin over a Poisson arrival trace (so different
+    personas churn through the slots together). ``seed`` fixes the
+    persona heads and arrivals; ``suffix_seed`` varies the tails (a
+    warmup set and a measured set share personas, never suffixes)."""
+    import numpy as np
+    from incubator_mxnet_tpu.serve import Request
+    rng = np.random.RandomState(seed)
+    heads = [rng.randint(0, vocab, size=(prefix_len,)).astype(np.int32)
+             for _ in range(personas)]
+    n = personas * per_persona
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_hz, size=n))
+    arrivals[0] = 0.0
+    srng = np.random.RandomState(suffix_seed)
+    reqs = []
+    for i in range(n):
+        head = heads[i % personas]
+        tail = srng.randint(0, vocab, size=(suffix_len,)).astype(np.int32)
+        reqs.append(Request(np.concatenate([head, tail]),
+                            max_new_tokens=max_new))
+    return reqs, arrivals.tolist()
+
+
+def bench_shared_prefix(model, *, personas, per_persona, prefix_len,
+                        suffix_len, max_new, slots, page_size, rate_hz):
+    """Cold (prefix_cache off) vs warm (on) over the SAME persona
+    workload and arrival trace. Both engines first drain an untimed
+    WARMUP set (same personas, different suffixes): it pre-compiles
+    every program on both sides — the comparison is pure steady-state
+    serving — and on the warm engine it also populates the prefix
+    index, so the timed window measures the HIT path, exactly the
+    production shape (personas live much longer than any one request)."""
+    from incubator_mxnet_tpu.serve import InferenceEngine
+    vocab = model.vocab_size
+    engines = {"cold": InferenceEngine(model, num_slots=slots,
+                                       page_size=page_size,
+                                       prefix_cache=False),
+               "warm": InferenceEngine(model, num_slots=slots,
+                                       page_size=page_size,
+                                       prefix_cache=True)}
+    stats = {}
+    hitinfo = {}
+    for name, eng in engines.items():
+        # TWO warmup rounds per persona: round one compiles the cold
+        # path and populates the index, round two compiles the HIT path
+        # (suffix chunks + COW copy) — the timed window then compiles
+        # nothing on either engine (asserted by the smoke run)
+        wreqs, _ = _persona_requests(personas, 2, prefix_len,
+                                     suffix_len, max_new, rate_hz,
+                                     vocab, suffix_seed=1011)
+        eng.run(wreqs)                       # untimed warmup
+        reqs, arrivals = _persona_requests(personas, per_persona,
+                                           prefix_len, suffix_len,
+                                           max_new, rate_hz, vocab)
+        lookups0, hits0 = eng.prefix_lookups, eng.prefix_hits
+        hit_toks0, steps0 = eng.prefix_hit_tokens, eng.decode_steps
+        t0 = time.perf_counter()
+        eng.run(reqs, arrival_times=arrivals)
+        wall = time.perf_counter() - t0
+        stats[name] = _engine_stats(eng, reqs, wall, steps0)
+        prompt_tokens = sum(r.prompt_ids.size for r in reqs)
+        hitinfo[name] = {
+            "lookups": eng.prefix_lookups - lookups0,
+            "hits": eng.prefix_hits - hits0,
+            "hit_tokens": eng.prefix_hit_tokens - hit_toks0,
+            "hit_rate": (eng.prefix_hit_tokens - hit_toks0) /
+                        prompt_tokens,
+        }
+    out = {
+        "config": {"personas": personas, "per_persona": per_persona,
+                   "prefix_len": prefix_len, "suffix_len": suffix_len,
+                   "max_new": max_new, "slots": slots,
+                   "page_size": page_size, "rate_hz": rate_hz},
+        "cold": stats["cold"],
+        "warm": stats["warm"],
+        "prefix_lookups": hitinfo["warm"]["lookups"],
+        "prefix_hits": hitinfo["warm"]["hits"],
+        "prefix_hit_tokens": hitinfo["warm"]["hit_tokens"],
+        "prefix_hit_rate": hitinfo["warm"]["hit_rate"],
+        "warm_over_cold_tokens_per_s": (stats["warm"]["tokens_per_s"] /
+                                        stats["cold"]["tokens_per_s"]),
+    }
+    return engines["warm"], out
+
+
+def _long_mixed_requests(n_short, short_len, short_new, n_long,
+                         long_len, long_new, vocab, seed=9,
+                         long_at0=0.4, long_gap=0.6):
+    """Short prompts decoding while long prompts arrive mid-stream —
+    ``long_at0``/``long_gap`` place the long arrivals INSIDE the
+    shorts' decode window (no overlap, no stall, no signal)."""
+    import numpy as np
+    from incubator_mxnet_tpu.serve import Request
+    rng = np.random.RandomState(seed)
+    reqs, arrivals = [], []
+    for i in range(n_short):
+        reqs.append(Request(rng.randint(0, vocab, size=(short_len,))
+                            .astype(np.int32), max_new_tokens=short_new))
+        arrivals.append(0.02 * i)
+    for j in range(n_long):
+        reqs.append(Request(rng.randint(0, vocab, size=(long_len,))
+                            .astype(np.int32), max_new_tokens=long_new))
+        arrivals.append(long_at0 + long_gap * j)
+    return reqs, arrivals
+
+
+def bench_long_prompt_mixed(model, *, n_short, short_len, short_new,
+                            n_long, long_len, long_new, slots,
+                            page_size, chunk_pages, long_at0=0.4,
+                            long_gap=0.6, repeats=3):
+    """Monolithic vs chunked prefill over the SAME long-prompt-mixed
+    trace; the metric is inter-token p99 — the decode stall a long
+    arrival inflicts on every other active request. Both engines drain
+    an untimed warmup (one short + one long request) so every program
+    is pre-compiled and the timed windows compare pure prefill COMPUTE
+    scheduling, not trace time.
+
+    This host's CPU jitter is on the order of the effect (2 cores —
+    the same problem ckpt_bench hit), so the comparison runs
+    ``repeats`` PAIRED ALTERNATING windows (mono, chunked, mono,
+    chunked, ...) on the two persistent engines and banks the
+    per-engine elementwise MEDIAN — a single window can swing 2x
+    either way."""
+    import copy
+    from incubator_mxnet_tpu.serve import InferenceEngine
+    vocab = model.vocab_size
+    reqs, arrivals = _long_mixed_requests(n_short, short_len, short_new,
+                                          n_long, long_len, long_new,
+                                          vocab, long_at0=long_at0,
+                                          long_gap=long_gap)
+    engines = {
+        "monolithic": InferenceEngine(model, num_slots=slots,
+                                      page_size=page_size,
+                                      prefix_cache=False),
+        "chunked": InferenceEngine(model, num_slots=slots,
+                                   page_size=page_size,
+                                   prefix_cache=False,
+                                   chunk_pages=chunk_pages),
+    }
+    windows = {name: [] for name in engines}
+    for name, eng in engines.items():
+        wreqs, _ = _long_mixed_requests(1, short_len, 2, 1, long_len, 2,
+                                        vocab, seed=33)
+        eng.run(wreqs)                       # untimed warmup compile
+    import gc
+    for _ in range(repeats):
+        for name, eng in engines.items():    # alternating pairs
+            r = copy.deepcopy(reqs)
+            gc.collect()                     # a GC pause mid-window
+            steps0 = eng.decode_steps        # reads as a fake stall
+            t0 = time.perf_counter()
+            eng.run(r, arrival_times=list(arrivals))
+            wall = time.perf_counter() - t0
+            windows[name].append(_engine_stats(eng, r, wall, steps0))
+
+    def _median_stats(ws):
+        agg = dict(ws[-1])                   # non-numerics from last
+        for k, v in ws[-1].items():
+            if isinstance(v, (int, float)):
+                vals = sorted(w[k] for w in ws)
+                agg[k] = vals[len(vals) // 2]
+        agg["windows_itl_p99_ms"] = [w["itl_p99_ms"] for w in ws]
+        agg["windows_itl_max_ms"] = [w["itl_max_ms"] for w in ws]
+        return agg
+
+    mono = _median_stats(windows["monolithic"])
+    chunked = _median_stats(windows["chunked"])
+    # common-mode host drift hits both engines of a window pair alike —
+    # the median of per-PAIR ratios is the drift-robust improvement
+    def _pair_median(key):
+        rs = sorted(m[key] / max(c[key], 1e-9) for m, c in
+                    zip(windows["monolithic"], windows["chunked"]))
+        return rs[len(rs) // 2]
+    eng_c = engines["chunked"]
+    out = {
+        "config": {"n_short": n_short, "short_len": short_len,
+                   "short_new": short_new, "n_long": n_long,
+                   "long_len": long_len, "long_new": long_new,
+                   "slots": slots, "page_size": page_size,
+                   "chunk_pages": chunk_pages,
+                   "token_budget": eng_c.token_budget,
+                   "repeats": repeats},
+        "monolithic": mono,
+        "chunked": chunked,
+        "max_step_prefill_tokens": eng_c.max_step_prefill_tokens,
+        "itl_p99_improvement": _pair_median("itl_p99_ms"),
+        "itl_max_improvement": _pair_median("itl_max_ms"),
+    }
+    return eng_c, out
+
+
+def _check_compile_discipline(tag, stats, errors):
+    if stats["decode_trace_count"] != 1:
+        errors.append(f"{tag}: decode step compiled "
+                      f"{stats['decode_trace_count']} times (must be 1)")
+    bad = {k: v for k, v in stats["prefill_trace_counts"].items()
+           if v != 1}
+    if bad:
+        errors.append(f"{tag}: prefill buckets retraced: {bad}")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
-                    help="fast CI guard: assert exactly one decode-step "
-                         "compile in steady state")
+                    help="fast CI guard: assert the jit-once contract, "
+                         "zero-compile cache-hit admission, and the "
+                         "chunked-prefill token budget")
     ap.add_argument("--json", default=None,
                     help="bank results here (default BENCH_SERVE.json "
                          "at the repo root for a full run)")
@@ -153,6 +426,8 @@ def main():
                          "~all 8 slots busy on a CPU host")
     args = ap.parse_args()
 
+    errors = []
+
     if args.smoke:
         args.requests, args.max_new = 12, 12
 
@@ -160,8 +435,9 @@ def main():
     vocab = model.vocab_size
     reqs, arrivals = _make_requests(args.requests, args.prompt_len,
                                     args.max_new, args.rate, vocab)
-    engine = bench_engine(model, reqs, arrivals, args.slots,
-                          args.page_size)
+    _, engine = bench_engine(model, reqs, arrivals, args.slots,
+                             args.page_size)
+    _check_compile_discipline("engine", engine, errors)
 
     result = {
         "config": {"requests": args.requests, "slots": args.slots,
@@ -171,6 +447,91 @@ def main():
                    "backend": os.environ.get("JAX_PLATFORMS", "cpu")},
         "engine": engine,
     }
+
+    model9 = _build_round9(args.smoke)
+
+    # ---- round-9: long-prompt-mixed (chunked prefill) -------------- #
+    # runs FIRST after the model build: its inter-token percentiles are
+    # the jitter-sensitive measurement, so it gets the quietest heap
+    if args.smoke:
+        lp_cfg = dict(n_short=4, short_len=8, short_new=24, n_long=1,
+                      long_len=160, long_new=4, slots=4,
+                      page_size=args.page_size, chunk_pages=2,
+                      long_at0=0.03, repeats=1)
+    else:
+        # a stream of long arrivals landing while a few slots decode
+        # for a long time, 8 stalls per window so a window's p99 sits
+        # deep inside the stall cluster
+        lp_cfg = dict(n_short=6, short_len=16, short_new=96, n_long=8,
+                      long_len=224, long_new=4, slots=args.slots,
+                      page_size=args.page_size, chunk_pages=4,
+                      long_at0=0.15, long_gap=0.12, repeats=3)
+    eng_c, longmix = bench_long_prompt_mixed(model9, **lp_cfg)
+    _check_compile_discipline("long_prompt_mixed.monolithic",
+                              longmix["monolithic"], errors)
+    _check_compile_discipline("long_prompt_mixed.chunked",
+                              longmix["chunked"], errors)
+    if eng_c.max_step_prefill_tokens > eng_c.token_budget:
+        errors.append(
+            f"chunked prefill exceeded the per-step token budget: "
+            f"{eng_c.max_step_prefill_tokens} > {eng_c.token_budget}")
+    result["long_prompt_mixed"] = longmix
+
+    # ---- round-9: shared-prefix (prefix caching) ------------------- #
+    if args.smoke:
+        sp_cfg = dict(personas=2, per_persona=3, prefix_len=40,
+                      suffix_len=6, max_new=6, slots=4,
+                      page_size=args.page_size, rate_hz=100.0)
+    else:
+        # long shared system prompt + short answer — the production
+        # shape prefix caching targets; rate 300/s keeps the engine
+        # compute-bound so tokens/s measures serving, not idle arrival
+        # gaps
+        sp_cfg = dict(personas=4, per_persona=6, prefix_len=224,
+                      suffix_len=8, max_new=8, slots=args.slots,
+                      page_size=args.page_size, rate_hz=300.0)
+    eng_w, shared = bench_shared_prefix(model9, **sp_cfg)
+    _check_compile_discipline("shared_prefix.cold", shared["cold"],
+                              errors)
+    _check_compile_discipline("shared_prefix.warm", shared["warm"],
+                              errors)
+    if shared["prefix_hits"] < (sp_cfg["personas"] *
+                                (sp_cfg["per_persona"] - 1)) // 2:
+        errors.append(f"shared_prefix: too few cache hits "
+                      f"({shared['prefix_hits']}) — prefix index broken?")
+    result["shared_prefix"] = shared
+
+    # cache-hit admission on the WARM engine must compile NOTHING new:
+    # every program (decode, chunk buckets, COW copy) already exists
+    before = (eng_w.decode_trace_count, eng_w.prefill_trace_count,
+              eng_w.copy_trace_count)
+    hits_before = eng_w.prefix_hits
+    import numpy as np
+    from incubator_mxnet_tpu.serve import Request
+    rng = np.random.RandomState(123)
+    # rebuild persona heads deterministically (same seed as the workload)
+    heads_rng = np.random.RandomState(7)
+    heads = [heads_rng.randint(0, vocab,
+                               size=(sp_cfg["prefix_len"],))
+             .astype(np.int32) for _ in range(sp_cfg["personas"])]
+    again = [Request(np.concatenate(
+        [heads[i % sp_cfg["personas"]],
+         rng.randint(0, vocab, size=(sp_cfg["suffix_len"],))
+         .astype(np.int32)]), max_new_tokens=4)
+        for i in range(sp_cfg["personas"])]
+    eng_w.run(again)
+    after = (eng_w.decode_trace_count, eng_w.prefill_trace_count,
+             eng_w.copy_trace_count)
+    result["shared_prefix"]["cache_hit_admission_new_programs"] = \
+        sum(after) - sum(before)
+    if after != before:
+        errors.append(f"cache-hit admission compiled new programs: "
+                      f"{before} -> {after}")
+    if eng_w.prefix_hits != hits_before + len(again):
+        errors.append(f"cache-hit admissions missed: "
+                      f"{eng_w.prefix_hits - hits_before}/{len(again)}")
+
+    # ---- baseline comparison (full runs only) ---------------------- #
     if not args.smoke:
         reqs_b, arrivals_b = _make_requests(
             args.requests, args.prompt_len, args.max_new, args.rate,
@@ -183,16 +544,21 @@ def main():
 
     print(json.dumps(result, indent=2))
 
-    ok = True
-    if engine["decode_trace_count"] != 1:
-        print(f"FAIL: decode step compiled "
-              f"{engine['decode_trace_count']} times across occupancy "
-              f"churn (must be exactly 1)", file=sys.stderr)
-        ok = False
-    if not args.smoke and result["throughput_speedup"] < 3.0:
-        print(f"WARN: serving speedup "
-              f"{result['throughput_speedup']:.1f}x below the 3x bar",
-              file=sys.stderr)
+    for e in errors:
+        print(f"FAIL: {e}", file=sys.stderr)
+    if not args.smoke:
+        if result["throughput_speedup"] < 3.0:
+            print(f"WARN: serving speedup "
+                  f"{result['throughput_speedup']:.1f}x below the 3x "
+                  f"bar", file=sys.stderr)
+        if shared["warm_over_cold_tokens_per_s"] < 1.1:
+            print(f"WARN: prefix caching won only "
+                  f"{shared['warm_over_cold_tokens_per_s']:.2f}x "
+                  f"tokens/s on the persona workload", file=sys.stderr)
+        if longmix["itl_p99_improvement"] < 1.1:
+            print(f"WARN: chunked prefill improved inter-token p99 "
+                  f"only {longmix['itl_p99_improvement']:.2f}x",
+                  file=sys.stderr)
 
     out = args.json
     if out is None and not args.smoke:
@@ -204,7 +570,7 @@ def main():
             f.write("\n")
         print(f"banked {out}")
 
-    sys.exit(0 if ok else 1)
+    sys.exit(0 if not errors else 1)
 
 
 if __name__ == "__main__":
