@@ -49,6 +49,15 @@ stage_servebench() {
   JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke
 }
 
+stage_chaossmoke() {
+  echo "== chaossmoke: resilience guard (seeded faults — NaN weights,"
+  echo "               corrupt/dropped page writes, allocator starvation,"
+  echo "               host stalls, SIGTERM mid-serve; fails on any"
+  echo "               non-terminal request, cross-slot contamination,"
+  echo "               page-audit violation, or steady-state retrace)"
+  JAX_PLATFORMS=cpu python tools/chaos_bench.py --smoke
+}
+
 stage_ckptbench() {
   echo "== ckptbench: elastic-checkpoint regression guard (async commit +"
   echo "              keep-last-k GC + bit-exact capsule resume)"
@@ -68,7 +77,7 @@ ge.dryrun_multichip(8)"
 }
 
 stages=("$@")
-[ ${#stages[@]} -eq 0 ] && stages=(sanity native unit stepbench servebench ckptbench entry)
+[ ${#stages[@]} -eq 0 ] && stages=(sanity native unit stepbench servebench chaossmoke ckptbench entry)
 for s in "${stages[@]}"; do
   "stage_$s"
 done

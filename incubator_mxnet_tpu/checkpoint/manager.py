@@ -13,6 +13,15 @@ Commit is atomic (manifest.py) and GC keeps the last ``keep`` committed
 steps. ``install_preemption_hook`` arms a SIGTERM handler that drains
 the in-flight snapshot and writes a final synchronous one before the
 process dies — the preemptible-TPU-pod contract (docs/CHECKPOINTING.md).
+
+Transient IO errors (an NFS blip, a full-then-GC'd disk, a flaky
+object-store fuse mount) are retried with bounded exponential backoff
+before the error latches: ``MXTPU_CKPT_RETRY_ATTEMPTS`` (default 3)
+total attempts, ``MXTPU_CKPT_RETRY_BACKOFF`` (default 0.1 s) base
+delay, doubling per retry. ``MXTPU_CKPT_FAIL_WRITES=n`` fault-injects
+``n`` transient failures (one per attempt) for tests — n failures
+under the attempt bound still commit; n >= the bound latches the error
+exactly as a persistent outage would (docs/RESILIENCE.md).
 """
 
 from __future__ import annotations
@@ -20,6 +29,7 @@ from __future__ import annotations
 import os
 import signal
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -139,6 +149,8 @@ class CheckpointManager:
         self._thread: Optional[threading.Thread] = None
         self._sig_prev = None
         self.committed_steps = 0                # cumulative commits
+        self.write_retries = 0                  # transient IO retries
+        self._injected_failures = 0             # MXTPU_CKPT_FAIL_WRITES
 
     # -- background writer -------------------------------------------- #
     def _ensure_thread(self):
@@ -180,7 +192,41 @@ class CheckpointManager:
                     self._pending = None
                     self._cv.notify_all()
 
+    def _maybe_inject_write_failure(self):
+        """``MXTPU_CKPT_FAIL_WRITES=n``: the first n write ATTEMPTS (not
+        snapshots) raise a transient OSError — the deterministic fault
+        the retry loop is tested against."""
+        budget = int(os.environ.get("MXTPU_CKPT_FAIL_WRITES", "0") or 0)
+        if self._injected_failures < budget:
+            self._injected_failures += 1
+            raise OSError(
+                f"injected transient checkpoint write failure "
+                f"({self._injected_failures}/{budget})")
+
     def _write(self, step, entries, meta):
+        """One snapshot write with bounded exponential-backoff retry on
+        TRANSIENT IO errors (OSError); structural errors (MXNetError —
+        e.g. the step already committed) are never retried. After the
+        last attempt the error propagates and latches exactly as
+        before."""
+        attempts = max(1, int(os.environ.get(
+            "MXTPU_CKPT_RETRY_ATTEMPTS", "3") or 3))
+        backoff = float(os.environ.get(
+            "MXTPU_CKPT_RETRY_BACKOFF", "0.1") or 0.1)
+        for attempt in range(attempts):
+            try:
+                self._maybe_inject_write_failure()
+                self._write_once(step, entries, meta)
+                return
+            except MXNetError:
+                raise
+            except OSError:
+                if attempt + 1 >= attempts:
+                    raise
+                self.write_retries += 1
+                time.sleep(backoff * (2 ** attempt))
+
+    def _write_once(self, step, entries, meta):
         _manifest.write_step(
             self.directory, step, entries, meta=meta,
             process_index=jax.process_index(),

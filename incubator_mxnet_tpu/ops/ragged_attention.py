@@ -88,10 +88,17 @@ def _ragged_kernel(pt_ref, ln_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(j * page_size < length)
     def _accumulate():
+        valid = (j * page_size + lax.broadcasted_iota(
+            jnp.int32, (page_size, 1), 0)) < length
         for h in range(heads):                  # unrolled head loop
             q = q_ref[0, h]                     # (1, D), input dtype
             k = k_ref[0, h]                     # (page_size, D)
-            v = v_ref[0, h]
+            # SELECT masked rows out of V (not just zero-weight them):
+            # a freed page can be reused carrying non-finite garbage in
+            # positions past the new owner's length, and 0 * NaN = NaN
+            # would leak it through the weighted sum — masked reads
+            # must never matter, even poisoned ones
+            v = jnp.where(valid, v_ref[0, h], 0.0)
             sc = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
                          precision=lax.Precision.DEFAULT) * scale
             pos = j * page_size + lax.broadcasted_iota(
@@ -115,8 +122,12 @@ def _ragged_kernel(pt_ref, ln_ref, q_ref, k_ref, v_ref, o_ref,
             l_safe = jnp.maximum(l_ref[h], 1e-30)
             # fully-masked slot (length 0): m never left _NEG_INF — emit
             # exactly zero, the masked-row contract shared with the
-            # training kernels (ops.pallas_attention)
-            row_ok = m > _NEG_INF / 2
+            # training kernels (ops.pallas_attention). Negated-compare
+            # form so a NaN running max (poisoned K/V page) fails the
+            # dead-row test and PROPAGATES instead of being silently
+            # zeroed — the serving engine's non-finite guard depends on
+            # corruption staying visible in the output.
+            row_ok = ~(m <= _NEG_INF / 2)
             o_ref[0, h] = jnp.where(row_ok[:, None],
                                     acc_ref[h] / l_safe[:, None],
                                     0.0).astype(o_ref.dtype)
@@ -189,14 +200,21 @@ def ragged_attention_reference(q, k_pool, v_pool, page_table, lengths,
     s = jnp.einsum("shd,shkd->shk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * sc
     pos = lax.broadcasted_iota(jnp.int32, (S, K), 1)
-    s = jnp.where((pos < lengths.astype(jnp.int32)[:, None])[:, None, :],
-                  s, _NEG_INF)
+    valid = pos < lengths.astype(jnp.int32)[:, None]
+    s = jnp.where(valid[:, None, :], s, _NEG_INF)
+    # select masked positions out of V: a reused page may carry
+    # non-finite garbage past this slot's length and 0 * NaN = NaN
+    # would leak it through the weighted sum (same contract as the
+    # Pallas kernel)
+    v = jnp.where(valid[:, None, :, None], v, 0.0)
     m = jnp.max(s, axis=-1)
     p = jnp.exp(s - m[..., None])
     l = jnp.sum(p, axis=-1)
     out = jnp.einsum("shk,shkd->shd", p, v.astype(jnp.float32)) / \
         jnp.maximum(l, 1e-30)[..., None]
-    row_ok = m > _NEG_INF / 2                   # length-0 slots → zero
+    # negated compare: length-0 slots → zero, but a NaN max (poisoned
+    # page) PROPAGATES so the engine's non-finite guard can see it
+    row_ok = ~(m <= _NEG_INF / 2)
     return jnp.where(row_ok[..., None], out, 0.0).astype(q.dtype)
 
 
@@ -246,10 +264,15 @@ def _ragged_prefill_kernel(pr_ref, qi_ref, q_ref, k_ref, v_ref, o_ref,
     # (dead entries all indexing the null page) skip their re-DMA too
     @pl.when(j * page_size < start + n_real)
     def _accumulate():
+        # positions past the last real query's view are masked for
+        # EVERY row — select them out of V so reused-page garbage
+        # (possibly non-finite) cannot leak through 0-weight terms
+        valid = (j * page_size + lax.broadcasted_iota(
+            jnp.int32, (page_size, 1), 0)) < start + n_real
         for h in range(heads):                  # unrolled head loop
             q = q_ref[0, h]                     # (chunk, D), input dtype
             k = k_ref[0, h]                     # (page_size, D)
-            v = v_ref[0, h]
+            v = jnp.where(valid, v_ref[0, h], 0.0)
             sc = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
                          precision=lax.Precision.DEFAULT) * scale
             pos_k = j * page_size + lax.broadcasted_iota(
@@ -279,8 +302,10 @@ def _ragged_prefill_kernel(pr_ref, qi_ref, q_ref, k_ref, v_ref, o_ref,
             l_safe = jnp.maximum(l_ref[h], 1e-30)
             # every live query attends at least position 0, so only rows
             # that saw no page at all (possible when padded rows extend
-            # past every accumulated page) stay at _NEG_INF — emit zero
-            row_ok = m > _NEG_INF / 2
+            # past every accumulated page) stay at _NEG_INF — emit zero.
+            # Negated compare: NaN (poisoned page) propagates, see the
+            # decode kernel's finalize
+            row_ok = ~(m <= _NEG_INF / 2)
             o_ref[0, h] = jnp.where(row_ok[:, None],
                                     acc_ref[h] / l_safe[:, None],
                                     0.0).astype(o_ref.dtype)
@@ -357,12 +382,20 @@ def ragged_prefill_reference(q, k_pool, v_pool, page_row, q_start,
     pos_k = lax.broadcasted_iota(jnp.int32, (C, K), 1)
     pos_q = q_start + lax.broadcasted_iota(jnp.int32, (C, K), 0)
     s = jnp.where((pos_k <= pos_q)[:, None, :], s, _NEG_INF)
+    # select positions no query may see out of V (reused-page garbage
+    # must not leak through 0-weight terms — see the decode reference);
+    # positions a LATER query legitimately reads stay as-is: if they
+    # are poisoned, that query is poisoned, which is the point
+    never_read = lax.broadcasted_iota(jnp.int32, (K,), 0) >= \
+        q_start + C
+    v = jnp.where(never_read[None, :, None], 0.0, v)
     m = jnp.max(s, axis=-1)
     p = jnp.exp(s - m[..., None])
     l = jnp.sum(p, axis=-1)
     out = jnp.einsum("chk,hkd->chd", p, v.astype(jnp.float32)) / \
         jnp.maximum(l, 1e-30)[..., None]
-    row_ok = m > _NEG_INF / 2
+    # negated compare: padded rows → zero, NaN propagates (see decode)
+    row_ok = ~(m <= _NEG_INF / 2)
     return jnp.where(row_ok[..., None], out, 0.0).astype(q.dtype)
 
 

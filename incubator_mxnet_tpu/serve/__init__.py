@@ -25,8 +25,9 @@ See docs/SERVING.md for the architecture and invariants.
 
 from .paged_kv import (NULL_PAGE, PageAllocator, PrefixIndex,
                        init_kv_pools, write_prompt_kv, write_token_kv)
+from .outcomes import Outcome
 from .engine import InferenceEngine, Request
 
-__all__ = ["InferenceEngine", "Request", "PageAllocator", "PrefixIndex",
-           "NULL_PAGE", "init_kv_pools", "write_token_kv",
+__all__ = ["InferenceEngine", "Request", "Outcome", "PageAllocator",
+           "PrefixIndex", "NULL_PAGE", "init_kv_pools", "write_token_kv",
            "write_prompt_kv"]

@@ -1,0 +1,276 @@
+"""Deterministic fault injection (chaos) for the serving engine.
+
+Production TPU serving dies from the faults nobody unit-tested: a
+checkpoint with a NaN in it warm-started into a live fleet, a DMA that
+corrupted one KV page, an allocator squeezed to starvation by a noisy
+neighbour, a host stall that blows every deadline, a preemption SIGTERM
+mid-decode. This module makes those faults INJECTABLE, SEEDED and
+REPRODUCIBLE, so `tools/chaos_bench.py` (ci/run.sh ``chaossmoke``
+stage) can assert the resilience contract instead of hoping:
+
+  - every request ends in a structured terminal ``Outcome``;
+  - unfaulted requests emit BIT-IDENTICAL tokens to a fault-free run
+    (no cross-slot contamination — slots are isolated by construction);
+  - ``audit_pages()`` passes after EVERY scheduler step, faults
+    included (pages reclaimed exactly, never leaked or double-granted);
+  - the decode step still compiles exactly once (the guard flag and
+    all fault handling are pure data / host-side bookkeeping).
+
+Injectors hook the scheduler through ``InferenceEngine.run``'s
+``before_step`` callback — they fire at a given scheduler ITERATION
+(not wall time), so a batch-submitted workload replays the same fault
+at the same point every run. All randomness comes from the injector's
+own seeded ``RandomState``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .engine import InferenceEngine, Request
+from .outcomes import Outcome
+
+__all__ = ["ChaosInjector", "NaNWeights", "CorruptPageWrite",
+           "PagePressure", "DelayedSteps", "run_chaos",
+           "assert_all_terminal", "assert_health_consistent"]
+
+
+class ChaosInjector:
+    """Base: a seeded fault with an injection log and an ``affected``
+    set — the requests whose OUTPUT the fault may legitimately change.
+    Everything outside ``affected`` must stay bit-identical to a
+    fault-free run (the cross-contamination invariant)."""
+
+    name = "chaos"
+
+    def __init__(self, seed: int = 0):
+        self.rng = np.random.RandomState(seed)
+        self.log: List[str] = []
+        self.affected: List[Request] = []
+        self.fired = False
+
+    def _mark(self, *requests: Request):
+        for r in requests:
+            # identity, not ==: Request is a dataclass whose generated
+            # __eq__ compares ndarray fields elementwise
+            if not any(r is a for a in self.affected):
+                self.affected.append(r)
+
+    def on_step(self, engine: InferenceEngine, step_idx: int) -> None:
+        raise NotImplementedError
+
+
+class NaNWeights(ChaosInjector):
+    """Poison the serving weights at step ``at_step`` — the
+    'warm-started a bad checkpoint' fault. ``n_entries`` random entries
+    of the EMBEDDING table get NaN: the tied LM head multiplies every
+    slot's hidden state by that table, so any poisoned entry makes some
+    logit non-finite for EVERY live slot — the guard must quarantine
+    them all (FAILED_NONFINITE), and every request admitted while the
+    poison stands must fail at its prefill guard. The swap goes through
+    ``warm_start`` — pure data, decode compile count must stay 1."""
+
+    name = "nan_weights"
+
+    def __init__(self, at_step: int, n_entries: int = 4, seed: int = 0):
+        super().__init__(seed)
+        self.at_step = at_step
+        self.n_entries = n_entries
+
+    def on_step(self, engine, step_idx):
+        if self.fired or step_idx < self.at_step:
+            return
+        self.fired = True
+        params = {str(i): np.asarray(p.data().asnumpy())
+                  for i, p in enumerate(engine._eng_params)}
+        # the embedding/tied-head table is params["0"] by construction
+        # order (word_embed first); fall back to the largest 2-D tensor
+        emb_key = "0"
+        if params[emb_key].ndim != 2:
+            emb_key = max((k for k, v in params.items() if v.ndim == 2),
+                          key=lambda k: params[k].size)
+        tab = params[emb_key].copy()
+        flat = tab.reshape(-1)
+        idx = self.rng.choice(flat.size, size=min(self.n_entries,
+                                                  flat.size),
+                              replace=False)
+        flat[idx] = np.nan
+        params[emb_key] = tab
+        engine.warm_start(params=params)
+        # every request not already terminal is poisoned from here on
+        for slot in engine._slots:
+            if slot is not None:
+                self._mark(slot.request)
+        self._mark(*engine._queue)
+        self.log.append(f"step {step_idx}: NaN-poisoned {len(idx)} "
+                        f"entries of param[{emb_key}] via warm_start")
+
+    def mark_submitted_after(self, request: Request):
+        """Requests submitted after the poison fired are affected too —
+        the harness calls this from its submit wrapper."""
+        if self.fired:
+            self._mark(request)
+
+
+class CorruptPageWrite(ChaosInjector):
+    """Corrupt one LIVE, PRIVATE (refcount-1) mapped KV page of a
+    decoding slot at step ``at_step`` — the 'DMA wrote garbage /
+    dropped the write' fault, at page granularity across every layer's
+    K and V pool.
+
+    ``mode='nan'``: the slot's attention output goes non-finite the
+    next decode step — the guard must quarantine exactly that slot.
+    ``mode='zero'``: a dropped write — finite garbage the guard CANNOT
+    see; the slot's request is marked affected (its tokens may
+    legitimately change) and the invariant asserted is that NO OTHER
+    request changes (cross-slot isolation) and all accounting stays
+    exact. Defers to the next step when no candidate slot is live."""
+
+    name = "corrupt_page"
+
+    def __init__(self, at_step: int, mode: str = "nan", seed: int = 0):
+        super().__init__(seed)
+        if mode not in ("nan", "zero"):
+            raise MXNetError(f"corrupt mode {mode!r} not in nan|zero")
+        self.at_step = at_step
+        self.mode = mode
+        self.page: Optional[int] = None
+
+    def on_step(self, engine, step_idx):
+        if self.fired or step_idx < self.at_step:
+            return
+        ps = engine.page_size
+        cands = []
+        for s in range(engine.num_slots):
+            slot = engine._slots[s]
+            if slot is None or slot.prefilling:
+                continue
+            n_read = -(-int(engine._lengths[s]) // ps)
+            for p in slot.row[:n_read]:
+                p = int(p)
+                if p and engine._alloc.refcount(p) == 1:
+                    cands.append((s, p))
+        if not cands:
+            return                       # defer until a slot is live
+        self.fired = True
+        s, page = cands[self.rng.randint(len(cands))]
+        val = np.nan if self.mode == "nan" else 0.0
+        newk, newv = [], []
+        for kp, vp in zip(engine._kpools, engine._vpools):
+            k = np.asarray(kp).copy()
+            v = np.asarray(vp).copy()
+            k[page] = val
+            v[page] = val
+            newk.append(jnp.asarray(k))
+            newv.append(jnp.asarray(v))
+        engine._kpools = tuple(newk)
+        engine._vpools = tuple(newv)
+        self.page = page
+        self._mark(engine._slots[s].request)
+        self.log.append(f"step {step_idx}: {self.mode}-corrupted page "
+                        f"{page} (slot {s}, refcount 1) in all layers")
+
+
+class PagePressure(ChaosInjector):
+    """Squeeze the allocator: at ``hold_at`` take ``n`` pages (default
+    ALL free pages — full starvation) out of circulation through the
+    allocator's own ``hold`` bookkeeping, and release them after
+    ``release_after`` scheduler steps (None = never). Pure scheduling
+    pressure — no request's DATA is touched, so every request that
+    completes must still be bit-identical to the fault-free run; the
+    rest must end DEADLINE_EXPIRED / FAILED_UNSERVABLE (watchdog or
+    stall), never wedge."""
+
+    name = "page_pressure"
+
+    def __init__(self, hold_at: int, release_after: Optional[int] = None,
+                 n: Optional[int] = None, seed: int = 0):
+        super().__init__(seed)
+        self.hold_at = hold_at
+        self.release_after = release_after
+        self.n = n
+        self.held: List[int] = []
+
+    def on_step(self, engine, step_idx):
+        if not self.fired and step_idx >= self.hold_at:
+            self.fired = True
+            self.held = engine._alloc.hold(
+                self.n if self.n is not None else engine._alloc.free_count)
+            self.log.append(f"step {step_idx}: held {len(self.held)} "
+                            f"pages (free now {engine._alloc.free_count})")
+        elif (self.held and self.release_after is not None
+              and step_idx >= self.hold_at + self.release_after):
+            engine._alloc.release_held(self.held)
+            self.log.append(f"step {step_idx}: released "
+                            f"{len(self.held)} held pages")
+            self.held = []
+
+
+class DelayedSteps(ChaosInjector):
+    """Host stall: sleep ``sleep_s`` before every scheduler step in
+    [``start``, ``end``) — models a preempted host / GC storm / slow
+    interconnect. Drives deadline expiry deterministically when
+    ``sleep_s`` dwarfs the requests' ``deadline_s``."""
+
+    name = "delayed_steps"
+
+    def __init__(self, start: int, end: int, sleep_s: float,
+                 seed: int = 0):
+        super().__init__(seed)
+        self.start = start
+        self.end = end
+        self.sleep_s = sleep_s
+        self.stalled_steps = 0
+
+    def on_step(self, engine, step_idx):
+        if self.start <= step_idx < self.end:
+            self.fired = True
+            self.stalled_steps += 1
+            time.sleep(self.sleep_s)
+
+
+def run_chaos(engine: InferenceEngine, requests, injectors,
+              arrival_times=None, audit_every_step: bool = True,
+              poll_sleep: float = 1e-3):
+    """Drive ``requests`` through ``engine`` with ``injectors`` firing
+    via the scheduler's ``before_step`` hook, auditing the page
+    invariant after EVERY step (faults included). Returns the requests;
+    raises if any request failed to reach a terminal outcome."""
+
+    def before(eng, i):
+        for inj in injectors:
+            inj.on_step(eng, i)
+
+    def after(eng, i):
+        if audit_every_step:
+            eng.audit_pages()
+
+    engine.run(requests, arrival_times=arrival_times,
+               poll_sleep=poll_sleep, before_step=before,
+               after_step=after)
+    assert_all_terminal(requests)
+    return requests
+
+
+def assert_all_terminal(requests):
+    missing = [i for i, r in enumerate(requests) if r.outcome is None]
+    if missing:
+        raise MXNetError(f"requests {missing} did not reach a terminal "
+                         f"outcome — the engine failed quiescence")
+
+
+def assert_health_consistent(engine: InferenceEngine, requests):
+    """The engine's health counters must equal the per-request outcome
+    tally — a counter drifting from the outcomes it summarizes would
+    lie to the operator exactly when it matters."""
+    tally = {o.value: 0 for o in Outcome}
+    for r in requests:
+        tally[r.outcome.value] += 1
+    if tally != engine.health:
+        raise MXNetError(f"health counters {engine.health} != outcome "
+                         f"tally {tally}")

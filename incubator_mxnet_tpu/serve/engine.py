@@ -73,21 +73,30 @@ from ..ops.ragged_attention import (ragged_attention_reference,
                                     ragged_paged_attention,
                                     ragged_prefill_attention,
                                     ragged_prefill_reference)
+from .outcomes import Outcome
 from .paged_kv import (NULL_PAGE, PageAllocator, PrefixIndex,
                        init_kv_pools, write_prompt_kv, write_token_kv)
 
-__all__ = ["Request", "InferenceEngine"]
+__all__ = ["Request", "InferenceEngine", "Outcome"]
 
 
 @dataclasses.dataclass
 class Request:
     """One generation request. ``temperature`` 0 = greedy; ``eos_id``
-    < 0 disables EOS stopping (generation runs to max_new_tokens)."""
+    < 0 disables EOS stopping (generation runs to max_new_tokens).
+    ``deadline_s`` (seconds, relative to submit) bounds the request's
+    total queue + serve time: past it the request is dropped from the
+    queue or evicted mid-decode with outcome DEADLINE_EXPIRED (partial
+    tokens are kept). Every request submitted to the engine ends with
+    ``outcome`` set to exactly one terminal Outcome (serve/outcomes.py);
+    ``detail`` carries the human-readable cause for the failure
+    outcomes and ``retry_after_s`` the backpressure hint on SHED."""
 
     prompt_ids: np.ndarray
     max_new_tokens: int = 32
     temperature: float = 0.0
     eos_id: int = -1
+    deadline_s: Optional[float] = None
 
     # filled in by the engine
     token_ids: List[int] = dataclasses.field(default_factory=list)
@@ -95,6 +104,10 @@ class Request:
     token_stamps: List[float] = dataclasses.field(default_factory=list)
     submit_time: Optional[float] = None
     finish_time: Optional[float] = None
+    outcome: Optional[Outcome] = None
+    detail: str = ""
+    retry_after_s: Optional[float] = None
+    _deadline_abs: Optional[float] = None
 
     def __post_init__(self):
         self.prompt_ids = np.asarray(self.prompt_ids, np.int32).reshape(-1)
@@ -102,6 +115,8 @@ class Request:
             raise MXNetError("empty prompt")
         if self.max_new_tokens < 1:
             raise MXNetError("max_new_tokens must be >= 1")
+        if self.deadline_s is not None and self.deadline_s <= 0:
+            raise MXNetError("deadline_s must be > 0 (or None)")
 
 
 @dataclasses.dataclass
@@ -114,6 +129,8 @@ class _Slot:
     t0: int                      # prompt length
     prefill_pos: int             # prompt tokens whose K/V is populated
     t_admit: float
+    stall_count: int = 0         # consecutive zero-progress steps (the
+                                 # watchdog's evidence; reset on progress)
 
     @property
     def prefilling(self) -> bool:
@@ -139,11 +156,43 @@ class InferenceEngine:
     sharing; ``chunk_pages`` (a power of two, default None = the PR 2
     monolithic prefill) enables chunked prefill with at most
     ``token_budget`` prompt tokens processed per engine step (default
-    ``chunk_pages * page_size``)."""
+    ``chunk_pages * page_size``).
+
+    Resilience knobs (docs/RESILIENCE.md — every request ends in a
+    structured terminal ``Outcome`` instead of success-or-exception):
+
+    - ``max_queue``: bounded admission queue depth — a submit beyond it
+      is SHED with a ``retry_after_s`` hint instead of growing the
+      queue without bound;
+    - ``max_queue_delay_s``: estimated-queue-delay admission limit (an
+      EWMA of observed slot-residence times scales the queue backlog
+      BEYOND today's free slots — zero on an idle engine, which must
+      never shed on its own steady-state latency) — load is shed
+      BEFORE the queue builds a deadline-busting backlog;
+    - ``guard_nonfinite`` (default on): the decode/prefill programs
+      compute a cheap per-slot non-finite flag (one logits reduction on
+      device) and SIGN-ENCODE it into the sampled tokens (token t on a
+      poisoned slot reads -t - 1) — pure DATA riding the existing
+      token transfer, so the jit-once contract is untouched and no
+      extra program output or host sync is paid; a flagged slot is
+      quarantined and failed with FAILED_NONFINITE rather than
+      sampling garbage forever;
+    - ``watchdog_steps``: a slot making zero progress for this many
+      consecutive engine steps (e.g. page-starved for its tail page)
+      is evicted with FAILED_UNSERVABLE — a stuck slot never wedges
+      the engine;
+    - ``max_slot_wall_s``: per-slot wall-clock cap (engine-imposed
+      deadline) — exceeded slots are evicted DEADLINE_EXPIRED;
+    - ``stall_steps``: consecutive fully-idle scheduler polls (nothing
+      decoding, queue head unadmittable) before the head request is
+      failed FAILED_UNSERVABLE instead of waiting forever."""
 
     def __init__(self, model, num_slots=8, page_size=16, max_len=None,
                  num_pages=None, dtype=None, mesh=None, interpret=None,
-                 prefix_cache=True, chunk_pages=None, token_budget=None):
+                 prefix_cache=True, chunk_pages=None, token_budget=None,
+                 max_queue=None, max_queue_delay_s=None,
+                 guard_nonfinite=True, watchdog_steps=1024,
+                 max_slot_wall_s=None, stall_steps=500):
         self.model = model
         self.num_slots = int(num_slots)
         self.page_size = int(page_size)
@@ -218,6 +267,16 @@ class InferenceEngine:
         self._queue: deque = deque()
         self._key = jax.random.PRNGKey(0)
         self._prefill_rr = 0
+
+        # resilience state (docs/RESILIENCE.md)
+        self.max_queue = None if max_queue is None else int(max_queue)
+        self.max_queue_delay_s = max_queue_delay_s
+        self.guard_nonfinite = bool(guard_nonfinite)
+        self.watchdog_steps = int(watchdog_steps)
+        self.max_slot_wall_s = max_slot_wall_s
+        self.stall_steps = int(stall_steps)
+        self.health: dict = {o.value: 0 for o in Outcome}
+        self._ewma_service_s: Optional[float] = None
 
         self.decode_trace_count = 0
         self.prefill_trace_count = 0         # dense + chunk, total
@@ -335,6 +394,17 @@ class InferenceEngine:
             logits = x._op("dot", embed_w, transpose_b=True)._data[:, 0]
         nxt = self._sample(logits, temps, key)
         new_lengths = jnp.where(act, lengths + 1, 0)
+        # per-slot non-finite guard: one (S, vocab)→(S,) reduction,
+        # SIGN-ENCODED into the sampled tokens (token t on a poisoned
+        # slot reads -t - 1) — pure data riding the existing token
+        # transfer, so the jit-once contract is untouched (asserted), a
+        # poisoned slot is visible the step it poisons, and the guard
+        # adds no program output and no extra host sync (its measured
+        # cost as a separate output was ~4% tokens/s on the CPU
+        # dispatch floor; see BENCH_SERVE.json guard_overhead)
+        if self.guard_nonfinite:
+            bad = jnp.any(~jnp.isfinite(logits), axis=-1) & act
+            nxt = jnp.where(bad, -nxt - 1, nxt)
         return tuple(new_k), tuple(new_v), nxt, new_lengths
 
     def _prefill_fn(self, param_vals, kpools, vpools, ids, t0, pages,
@@ -381,6 +451,9 @@ class InferenceEngine:
             embed_w = model.word_embed.weight.data()
             logits = x._op("dot", embed_w, transpose_b=True)._data[:, 0]
         tok = self._sample(logits, temp[None], key)[0]
+        if self.guard_nonfinite:             # sign-encoded, see decode
+            tok = jnp.where(jnp.any(~jnp.isfinite(logits)),
+                            -tok - 1, tok)
         return tuple(new_k), tuple(new_v), tok
 
     def _chunk_prefill_fn(self, param_vals, kpools, vpools, ids, start,
@@ -438,6 +511,9 @@ class InferenceEngine:
             embed_w = model.word_embed.weight.data()
             logits = x._op("dot", embed_w, transpose_b=True)._data[:, 0]
         tok = self._sample(logits, temp[None], key)[0]
+        if self.guard_nonfinite:             # sign-encoded, see decode
+            tok = jnp.where(jnp.any(~jnp.isfinite(logits)),
+                            -tok - 1, tok)
         return tuple(new_k), tuple(new_v), tok
 
     def _copy_page_fn(self, kpools, vpools, src, dst):
@@ -471,33 +547,188 @@ class InferenceEngine:
         return sum(s.reserved_pages - len(s.refs)
                    for s in self._slots if s is not None)
 
-    def submit(self, request: Request):
+    # health counters (asserted consistent with per-request outcomes in
+    # tests/test_resilience.py)
+    @property
+    def completed(self) -> int:
+        return self.health[Outcome.EOS.value] + \
+            self.health[Outcome.MAX_TOKENS.value]
+
+    @property
+    def shed(self) -> int:
+        return self.health[Outcome.SHED.value]
+
+    @property
+    def expired(self) -> int:
+        return self.health[Outcome.DEADLINE_EXPIRED.value]
+
+    @property
+    def quarantined(self) -> int:
+        return self.health[Outcome.FAILED_NONFINITE.value]
+
+    @property
+    def unservable(self) -> int:
+        return self.health[Outcome.FAILED_UNSERVABLE.value]
+
+    def _record_terminal(self, request: Request, outcome: Outcome,
+                         detail: str = "",
+                         retry_after: Optional[float] = None):
+        """The single point where a request becomes terminal — exactly
+        once, with the health counter kept consistent."""
+        if request.outcome is not None:
+            raise MXNetError(
+                f"request already terminal ({request.outcome}) — "
+                f"double-finish is an engine bug")
+        request.outcome = outcome
+        request.detail = detail
+        request.retry_after_s = retry_after
+        request.finish_time = time.perf_counter()
+        self.health[outcome.value] += 1
+
+    def _observe_service(self, t_admit: float):
+        """EWMA of SLOT-RESIDENCE time (admit -> finish) for completed
+        requests — the unit the queue-delay estimate multiplies. NOT
+        submit -> finish: that would fold past queue wait back into the
+        estimate and double-count delay under load."""
+        served = time.perf_counter() - t_admit
+        self._ewma_service_s = served if self._ewma_service_s is None \
+            else 0.2 * served + 0.8 * self._ewma_service_s
+
+    def _estimated_queue_delay(self) -> Optional[float]:
+        """Rough admission-delay estimate for a NEWLY submitted
+        request: how many service generations must complete before it
+        gets a slot, scaled by the EWMA of observed slot-residence
+        times. Zero when the queue fits today's free slots — an idle
+        engine must never shed on its own steady-state latency. None
+        until a first completion calibrates the EWMA."""
+        if self._ewma_service_s is None:
+            return None
+        free = self.num_slots - self.active_count
+        if len(self._queue) < free:
+            return 0.0
+        waves = (len(self._queue) - free) // self.num_slots + 1
+        return waves * self._ewma_service_s
+
+    def submit(self, request: Request) -> bool:
+        """Admission-queue entry with load shedding. Returns True when
+        the request was queued; False when it was refused — already
+        terminal with SHED (queue bounds exceeded, ``retry_after_s``
+        set) or FAILED_UNSERVABLE (it could NEVER be served: more
+        positions than ``max_len`` or more worst-case pages than the
+        whole pool — failing fast beats wedging the queue head)."""
         request.submit_time = time.perf_counter()
+        if request.deadline_s is not None:
+            request._deadline_abs = request.submit_time + request.deadline_s
+        total = int(request.prompt_ids.size) + request.max_new_tokens
+        need = -(-total // self.page_size)
+        if total > self.max_len or need > self.num_pages - 1:
+            self._record_terminal(
+                request, Outcome.FAILED_UNSERVABLE,
+                f"request needs {total} positions / {need} pages but the "
+                f"engine caps at max_len {self.max_len} / "
+                f"{self.num_pages - 1} usable pages")
+            return False
+        est = self._estimated_queue_delay()
+        if self.max_queue is not None and len(self._queue) >= self.max_queue:
+            self._record_terminal(
+                request, Outcome.SHED,
+                f"admission queue at depth limit {self.max_queue}",
+                retry_after=est if est else 0.05)
+            return False
+        if self.max_queue_delay_s is not None and est is not None \
+                and est > self.max_queue_delay_s:
+            self._record_terminal(
+                request, Outcome.SHED,
+                f"estimated queue delay {est:.3f}s exceeds "
+                f"{self.max_queue_delay_s}s",
+                retry_after=est)
+            return False
         self._queue.append(request)
+        return True
 
     def _next_key(self):
         self._key, sub = jax.random.split(self._key)
         return sub
 
-    def _finish_token(self, slot_idx: int, token: int, dt: float) -> bool:
-        """Record one generated token; returns True when the request is
-        done (EOS or max_new_tokens)."""
+    def _finish_token(self, slot_idx: int, token: int,
+                      dt: float) -> Optional[Outcome]:
+        """Record one generated token; returns the success outcome when
+        the request's own stopping condition hit (EOS / max_new_tokens),
+        else None."""
         slot = self._slots[slot_idx]
         req = slot.request
         req.token_ids.append(int(token))
         req.token_times.append(dt)
         req.token_stamps.append(time.perf_counter())
-        return (len(req.token_ids) >= req.max_new_tokens or
-                (req.eos_id >= 0 and int(token) == req.eos_id))
+        if req.eos_id >= 0 and int(token) == req.eos_id:
+            return Outcome.EOS
+        if len(req.token_ids) >= req.max_new_tokens:
+            return Outcome.MAX_TOKENS
+        return None
 
-    def _evict(self, slot_idx: int):
+    def _evict(self, slot_idx: int, outcome: Outcome, detail: str = ""):
         slot = self._slots[slot_idx]
         self._alloc.free(slot.refs)          # refcounted: shared pages
         self._page_table[slot_idx, :] = NULL_PAGE  # survive via sharers
         self._lengths[slot_idx] = 0
         self._temps[slot_idx] = 0.0
-        slot.request.finish_time = time.perf_counter()
         self._slots[slot_idx] = None
+        if outcome.ok:
+            self._observe_service(slot.t_admit)
+        self._record_terminal(slot.request, outcome, detail)
+
+    def _quarantine(self, slot_idx: int, detail: str):
+        """Fail a poisoned slot (non-finite logits): evict it — pages
+        reclaimed, its output never published — and flush the prefix
+        index, since a corrupt SHARED page would otherwise keep
+        poisoning every future cache hit (the index cannot tell which
+        cached page went bad; dropping retention is cheap and safe —
+        live slots keep their own page references)."""
+        self._evict(slot_idx, Outcome.FAILED_NONFINITE, detail)
+        if self._prefix is not None and len(self._prefix):
+            self._prefix.flush(self._alloc)
+            self.prefix_flushes += 1
+
+    def _expire_queue(self):
+        """Host-side deadline enforcement for QUEUED requests: a
+        request whose deadline passes before admission is dropped
+        terminally (mid-queue expiry) instead of being admitted into
+        work it can no longer use."""
+        if not any(r._deadline_abs is not None for r in self._queue):
+            return
+        now = time.perf_counter()
+        keep = deque()
+        for req in self._queue:
+            if req._deadline_abs is not None and now > req._deadline_abs:
+                self._record_terminal(
+                    req, Outcome.DEADLINE_EXPIRED,
+                    f"deadline ({req.deadline_s}s) passed while queued")
+            else:
+                keep.append(req)
+        self._queue = keep
+
+    def _expire_slots(self):
+        """Host-side deadline enforcement for DECODING slots: evict
+        (pages reclaimed) any slot past its request deadline or the
+        engine's per-slot wall cap — before spending another decode
+        step on it. Partial tokens are kept."""
+        now = time.perf_counter()
+        for s in range(self.num_slots):
+            slot = self._slots[s]
+            if slot is None:
+                continue
+            dl = slot.request._deadline_abs
+            if dl is not None and now > dl:
+                phase = "prefill" if slot.prefilling else "decode"
+                self._evict(s, Outcome.DEADLINE_EXPIRED,
+                            f"deadline ({slot.request.deadline_s}s) "
+                            f"passed mid-{phase}")
+                continue
+            if self.max_slot_wall_s is not None and \
+                    now - slot.t_admit > self.max_slot_wall_s:
+                self._evict(s, Outcome.DEADLINE_EXPIRED,
+                            f"per-slot wall cap {self.max_slot_wall_s}s "
+                            f"exceeded")
 
     def _admit(self):
         """FIFO admission into free slots, gated on worst-case pages.
@@ -514,11 +745,9 @@ class InferenceEngine:
                 continue
             req = self._queue[0]
             t0 = int(req.prompt_ids.size)
+            # submit() fail-fasts requests that can never fit, so here
+            # ``need`` is always <= the usable pool
             total = t0 + req.max_new_tokens
-            if total > self.max_len:
-                raise MXNetError(
-                    f"request needs {total} positions > max_len "
-                    f"{self.max_len}")
             need = -(-total // self.page_size)
             prompt_pages = -(-t0 // self.page_size)
 
@@ -609,7 +838,11 @@ class InferenceEngine:
             np.int32(t0), pages_arr,
             np.float32(req.temperature), self._next_key())
         slot.prefill_pos = t0
-        self._finish_prefill(slot_idx, int(np.asarray(tok)))
+        tok = int(np.asarray(tok))
+        if tok < 0:                          # sign-encoded guard flag
+            self._quarantine(slot_idx, "non-finite logits in prefill")
+            return
+        self._finish_prefill(slot_idx, tok)
 
     def _run_chunk(self, slot_idx: int) -> int:
         """Process ONE prefill chunk for a prefilling slot; returns the
@@ -637,8 +870,16 @@ class InferenceEngine:
             np.int32(start), np.int32(n), slot.row.copy(),
             np.float32(req.temperature), self._next_key())
         slot.prefill_pos = start + n
+        tok = int(np.asarray(tok))
+        if tok < 0:                          # sign-encoded guard flag
+            # poisoned mid-prompt: fail NOW — later chunks would only
+            # propagate the contamination (and the prompt's pages must
+            # never reach the prefix index)
+            self._quarantine(slot_idx, "non-finite logits in prefill "
+                                       f"chunk at {start}")
+            return n
         if not slot.prefilling:
-            self._finish_prefill(slot_idx, int(np.asarray(tok)))
+            self._finish_prefill(slot_idx, tok)
         return n
 
     def _finish_prefill(self, slot_idx: int, tok: int):
@@ -652,9 +893,10 @@ class InferenceEngine:
         if self._prefix is not None:
             self._prefix.insert(slot.request.prompt_ids, slot.row,
                                 self._alloc)
-        if self._finish_token(slot_idx, tok,
-                              time.perf_counter() - slot.t_admit):
-            self._evict(slot_idx)
+        done = self._finish_token(slot_idx, tok,
+                                  time.perf_counter() - slot.t_admit)
+        if done is not None:
+            self._evict(slot_idx, done)
 
     def _advance_prefill(self) -> int:
         """Chunked-prefill scheduler: round-robin one chunk at a time
@@ -688,50 +930,87 @@ class InferenceEngine:
                                            spent)
         return spent
 
-    def _ensure_tail_pages(self):
+    def _ensure_tail_pages(self) -> List[int]:
         """Lazily allocate the page the NEXT write position needs —
         this is where cache memory tracks live tokens. Prefilling slots
         are skipped: they are decode-invisible and their pages are
-        already mapped."""
+        already mapped.
+
+        A slot whose tail page cannot be allocated (pool starved even
+        after reclaiming prefix-index retention) is STALLED, not
+        crashed: it sits out this decode step (returned here, masked to
+        length 0 with a NULL page row so its dead write cannot touch a
+        real — possibly shared — page) and the watchdog evicts it
+        FAILED_UNSERVABLE after ``watchdog_steps`` of zero progress."""
+        stalled: List[int] = []
         for s in range(self.num_slots):
             slot = self._slots[s]
             if slot is None or slot.prefilling:
                 continue
             pi = int(self._lengths[s]) // self.page_size
             if self._page_table[s, pi] == NULL_PAGE:
+                if self._alloc.free_count == 0 and self._prefix is not None:
+                    self.prefix_reclaimed_pages += \
+                        self._prefix.reclaim(1, self._alloc)
+                if self._alloc.free_count == 0:
+                    slot.stall_count += 1
+                    if slot.stall_count > self.watchdog_steps:
+                        self._evict(s, Outcome.FAILED_UNSERVABLE,
+                                    f"watchdog: tail page starved for "
+                                    f"{slot.stall_count} steps")
+                    else:
+                        stalled.append(s)
+                    continue
                 page = self._alloc.alloc()
                 self._page_table[s, pi] = page
                 slot.row[pi] = page
                 slot.refs.append(page)
+            slot.stall_count = 0
+        return stalled
 
     def step(self) -> int:
-        """Admit, advance chunked prefill under the token budget, then
-        run ONE decode step for all decode-ready slots. Returns the
-        number of live slots that advanced a decode token."""
+        """Enforce deadlines, admit, advance chunked prefill under the
+        token budget, then run ONE decode step for all decode-ready
+        slots. Returns the number of live slots that advanced a decode
+        token."""
+        self._expire_queue()
+        self._expire_slots()
         self._admit()
         if self.chunk_pages is not None:
             self._advance_prefill()
+        stalled = self._ensure_tail_pages()
         live = [s for s in range(self.num_slots)
                 if self._slots[s] is not None
-                and not self._slots[s].prefilling]
+                and not self._slots[s].prefilling and s not in stalled]
         if not live:
             return 0
-        self._ensure_tail_pages()
         tokens = np.zeros((self.num_slots,), np.int32)
         for s in live:
             tokens[s] = self._slots[s].request.token_ids[-1]
+        lengths_dev = self._lengths.copy()
+        table_dev = self._page_table.copy()
+        for s in stalled:                    # decode-invisible this step
+            lengths_dev[s] = 0
+            table_dev[s, :] = NULL_PAGE
         t_start = time.perf_counter()
         self._kpools, self._vpools, nxt, lengths = self._decode_step(
             self._param_vals, self._kpools, self._vpools, tokens,
-            self._page_table.copy(), self._lengths.copy(),
-            self._temps.copy(), self._next_key())
+            table_dev, lengths_dev, self._temps.copy(), self._next_key())
         nxt = np.asarray(nxt)                # host sync point
-        self._lengths = np.asarray(lengths).copy()
+        bad = nxt < 0                        # sign-encoded guard flag
+        new_lengths = np.asarray(lengths).copy()
+        for s in stalled:                    # their true length is kept
+            new_lengths[s] = self._lengths[s]
+        self._lengths = new_lengths
         dt = time.perf_counter() - t_start
         self.decode_steps += 1
         for s in live:
-            if self._finish_token(s, nxt[s], dt):
-                self._evict(s)
+            if bad[s]:
+                self._quarantine(s, "non-finite logits in decode")
+                continue
+            done = self._finish_token(s, nxt[s], dt)
+            if done is not None:
+                self._evict(s, done)
         return len(live)
 
     # ------------------------------------------------------------- #
@@ -754,6 +1033,8 @@ class InferenceEngine:
         if self._prefix is not None:
             for p in self._prefix.held_pages():
                 expect[p] += 1
+        for p in self._alloc.held:           # chaos-harness page holds
+            expect[p] += 1
         free = self._alloc._free
         free_set = set(free)
         if len(free_set) != len(free):
@@ -858,11 +1139,40 @@ class InferenceEngine:
         return manager.install_preemption_hook(_state,
                                                exit_after=exit_after)
 
-    def run(self, requests, arrival_times=None, poll_sleep=1e-3):
-        """Drive ``requests`` to completion. ``arrival_times`` (seconds,
-        relative to call time) gates submission — the Poisson-arrival
-        harness of tools/serve_bench.py; None submits everything up
-        front (pure batch drain)."""
+    def shutdown(self, detail: str = "engine shutdown"):
+        """Graceful stop (SIGTERM / replica drain): every in-flight and
+        queued request becomes terminal — active slots are evicted
+        (pages reclaimed, partial tokens kept) and the queue is failed —
+        all with SHED, the 'retry me on another replica' signal. The
+        engine stays structurally valid (``audit_pages`` passes) and
+        idle afterwards."""
+        for s in range(self.num_slots):
+            if self._slots[s] is not None:
+                self._evict(s, Outcome.SHED, detail)
+        while self._queue:
+            self._record_terminal(self._queue.popleft(), Outcome.SHED,
+                                  detail)
+
+    def run(self, requests, arrival_times=None, poll_sleep=1e-3,
+            before_step=None, after_step=None):
+        """Drive ``requests`` until EVERY one is terminal (structured
+        ``Outcome`` — never an exception for per-request conditions).
+        ``arrival_times`` (seconds, relative to call time) gates
+        submission — the Poisson-arrival harness of
+        tools/serve_bench.py; None submits everything up front (pure
+        batch drain).
+
+        ``before_step(engine, i)`` / ``after_step(engine, i)`` bracket
+        every scheduler iteration ``i`` — the chaos harness's injection
+        and per-step audit hooks (serve/chaos.py).
+
+        A queue head that cannot be admitted while the engine is
+        otherwise idle (page starvation — e.g. the pool is chaos-held
+        or fragmented by retention) is failed FAILED_UNSERVABLE after
+        ``stall_steps`` consecutive idle polls; requests too large to
+        EVER fit were already failed at submit. The engine keeps
+        serving everything else — one doomed request no longer raises
+        out of the serving loop."""
         if arrival_times is None:
             for r in requests:
                 self.submit(r)
@@ -871,18 +1181,35 @@ class InferenceEngine:
             pending = sorted(zip(arrival_times, requests),
                              key=lambda p: p[0])
         t0 = time.perf_counter()
+        stall = 0
+        it = 0
         while pending or self._queue or self.active_count:
             now = time.perf_counter() - t0
             while pending and pending[0][0] <= now:
                 self.submit(pending.pop(0)[1])
-            if self.step() == 0:
-                self._admit()
-                if not self.active_count:
-                    if pending:
-                        time.sleep(min(poll_sleep,
-                                       max(0.0, pending[0][0] - now)))
-                    elif self._queue:
-                        raise MXNetError(
-                            "queued requests cannot be admitted: page "
-                            "pool too small for any waiting request")
+            if before_step is not None:
+                before_step(self, it)
+            n = self.step()
+            if after_step is not None:
+                after_step(self, it)
+            it += 1
+            if n > 0 or self.active_count:
+                stall = 0
+                continue
+            if self._queue:
+                # nothing decoding, nothing prefilling, head unadmitted
+                stall += 1
+                if stall > self.stall_steps:
+                    head = self._queue.popleft()
+                    self._record_terminal(
+                        head, Outcome.FAILED_UNSERVABLE,
+                        f"page-starved: head of an idle engine for "
+                        f"{stall} polls (free={self._alloc.free_count})")
+                    stall = 0
+                else:
+                    time.sleep(poll_sleep)   # let deadlines/holds move
+            elif pending:
+                stall = 0
+                time.sleep(min(poll_sleep,
+                               max(0.0, pending[0][0] - now)))
         return requests

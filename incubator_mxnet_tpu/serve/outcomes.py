@@ -1,0 +1,51 @@
+"""Structured terminal outcomes for serving requests.
+
+Every request handed to the engine ends in EXACTLY ONE terminal
+outcome — success-or-exception is not a contract a serving tier can
+offer under overload and faults (docs/RESILIENCE.md). The taxonomy:
+
+  EOS                 stopped at the request's eos_id (success)
+  MAX_TOKENS          generated max_new_tokens (success)
+  DEADLINE_EXPIRED    the request's deadline (or the engine's per-slot
+                      wall cap) passed — queued requests are dropped,
+                      decoding slots are evicted with their pages
+                      reclaimed; partial tokens are kept
+  SHED                refused at admission (bounded queue depth /
+                      estimated queue delay over the limit) or failed
+                      by an engine shutdown; ``retry_after_s`` carries
+                      the backpressure hint
+  FAILED_NONFINITE    the slot's logits went non-finite (poisoned
+                      weights / corrupt KV) — quarantined and failed
+                      rather than sampling garbage forever
+  FAILED_UNSERVABLE   the request can never (or did not, within the
+                      watchdog/stall budget) get the pages it needs —
+                      too large for the pool, or page-starved
+
+``EOS`` and ``MAX_TOKENS`` are the success outcomes (``.ok``); the
+other four are the failure surface the chaos harness (serve/chaos.py,
+tools/chaos_bench.py) drives and asserts.
+"""
+
+from __future__ import annotations
+
+import enum
+
+__all__ = ["Outcome"]
+
+
+class Outcome(enum.Enum):
+    EOS = "EOS"
+    MAX_TOKENS = "MAX_TOKENS"
+    DEADLINE_EXPIRED = "DEADLINE_EXPIRED"
+    SHED = "SHED"
+    FAILED_NONFINITE = "FAILED_NONFINITE"
+    FAILED_UNSERVABLE = "FAILED_UNSERVABLE"
+
+    @property
+    def ok(self) -> bool:
+        """True for the success outcomes (the request's own stopping
+        condition, not an engine intervention)."""
+        return self in (Outcome.EOS, Outcome.MAX_TOKENS)
+
+    def __str__(self) -> str:  # readable in logs / JSON dumps
+        return self.value

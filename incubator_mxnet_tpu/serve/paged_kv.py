@@ -67,10 +67,37 @@ class PageAllocator:
         # LIFO reuse keeps the working set of hot pages small
         self._free = list(range(num_pages - 1, 0, -1))
         self._rc = [0] * num_pages
+        self._held: List[int] = []
 
     @property
     def free_count(self) -> int:
         return len(self._free)
+
+    @property
+    def held(self) -> Tuple[int, ...]:
+        """Pages taken out of circulation by ``hold`` (chaos-harness
+        allocator pressure) — accounted for by the engine's page audit."""
+        return tuple(self._held)
+
+    def hold(self, n: int) -> List[int]:
+        """Take up to ``n`` pages out of circulation (refcount 1, owned
+        by the holder): the deterministic allocator-pressure fault of
+        serve/chaos.py — admission and tail allocation see a genuinely
+        smaller pool, through the allocator's own bookkeeping so the
+        page audit stays exact. Returns the pages actually held."""
+        pages = [self.alloc() for _ in range(min(max(n, 0),
+                                                 self.free_count))]
+        self._held.extend(pages)
+        return pages
+
+    def release_held(self, pages=None) -> int:
+        """Return held pages (default: all of them) to the free list."""
+        if pages is None:
+            pages = list(self._held)
+        for p in pages:
+            self._held.remove(p)
+            self.decref(p)
+        return len(pages)
 
     def _check(self, page) -> int:
         p = int(page)

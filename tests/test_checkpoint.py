@@ -78,6 +78,66 @@ def test_background_write_error_surfaces(tmp_path):
     m.close()
 
 
+def test_transient_write_failures_retried_then_commit(tmp_path,
+                                                      monkeypatch):
+    """SATELLITE (round 10): n transient IO failures under the attempt
+    bound are retried with backoff and the snapshot still COMMITS —
+    the writer thread no longer latches a whole run's checkpointing on
+    one NFS blip. Fault-injected via MXTPU_CKPT_FAIL_WRITES."""
+    import jax.numpy as jnp
+    monkeypatch.setenv("MXTPU_CKPT_RETRY_ATTEMPTS", "3")
+    monkeypatch.setenv("MXTPU_CKPT_RETRY_BACKOFF", "0.01")
+    monkeypatch.setenv("MXTPU_CKPT_FAIL_WRITES", "2")
+    m = ckpt.CheckpointManager(str(tmp_path), keep=0)
+    m.save(1, {"x": jnp.ones((8,))})        # async
+    m.wait()                                # no error surfaced
+    assert m.all_steps() == [1]
+    assert m.write_retries == 2
+    # the injection budget is consumed — later saves are clean
+    m.save(2, {"x": jnp.ones((8,))}, block=True)
+    assert m.all_steps() == [1, 2]
+    assert m.write_retries == 2
+    m.close()
+
+
+def test_persistent_write_failure_latches_after_retries(tmp_path,
+                                                        monkeypatch):
+    """n+1 failures (>= the attempt bound) exhaust the retries and the
+    error latches exactly as a persistent outage must — surfaced on the
+    next wait()/save(), naming the injected failure."""
+    import jax.numpy as jnp
+    monkeypatch.setenv("MXTPU_CKPT_RETRY_ATTEMPTS", "3")
+    monkeypatch.setenv("MXTPU_CKPT_RETRY_BACKOFF", "0.01")
+    monkeypatch.setenv("MXTPU_CKPT_FAIL_WRITES", "3")
+    m = ckpt.CheckpointManager(str(tmp_path), keep=0)
+    m.save(1, {"x": jnp.ones((8,))})        # async: all 3 attempts fail
+    with pytest.raises(MXNetError,
+                       match="background checkpoint write"):
+        m.wait()
+    assert m.all_steps() == []
+    assert m.write_retries == 2             # retried before latching
+    m.close()
+
+
+def test_sync_write_failure_raises_after_retries(tmp_path, monkeypatch):
+    """The retry loop also guards the synchronous path (final
+    preemption saves): under the bound it commits, over it the OSError
+    propagates to the caller."""
+    import jax.numpy as jnp
+    monkeypatch.setenv("MXTPU_CKPT_RETRY_ATTEMPTS", "2")
+    monkeypatch.setenv("MXTPU_CKPT_RETRY_BACKOFF", "0.01")
+    monkeypatch.setenv("MXTPU_CKPT_FAIL_WRITES", "1")
+    m = ckpt.CheckpointManager(str(tmp_path), keep=0)
+    m.save(3, {"x": jnp.ones((4,))}, block=True)    # 1 failure, retried
+    assert m.all_steps() == [3]
+    monkeypatch.setenv("MXTPU_CKPT_FAIL_WRITES", "3")
+    m._injected_failures = 0
+    with pytest.raises(OSError, match="injected transient"):
+        m.save(4, {"x": jnp.ones((4,))}, block=True)
+    assert m.all_steps() == [3]
+    m.close()
+
+
 def test_torn_tmp_and_manifestless_dirs_ignored(tmp_path):
     import jax.numpy as jnp
     root = str(tmp_path)

@@ -384,6 +384,7 @@ def test_switch_moe_capacity_drops_tokens():
     assert nonzero_rows == 1  # only the first routed token fits
 
 
+@pytest.mark.slow   # 13-21s (round-10 tier-1 budget repair); ci stage_unit runs it
 def test_ring_flash_attention_matches_dense():
     """Ring attention with the (out, lse) flash-block engine must equal
     dense attention — jnp fallback path on the CPU mesh, both causal
@@ -557,6 +558,7 @@ def test_gpt_seq_parallel_training_matches_dense():
                                    err_msg=f"{na} vs {nb}")
 
 
+@pytest.mark.slow   # 13-21s (round-10 tier-1 budget repair); ci stage_unit runs it
 def test_bert_seq_parallel_training_matches_dense():
     """Encoder long-context: BERT trained on a dp2 x sp4 mesh with
     seq_parallel=True (key-padding masks ride the ring as global valid

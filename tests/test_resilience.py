@@ -1,0 +1,437 @@
+"""Resilience-layer tests (serve/outcomes.py, serve/chaos.py, the
+engine's overload/fault handling — docs/RESILIENCE.md).
+
+The load-bearing claims: (1) EVERY request submitted to the engine ends
+in exactly one structured terminal Outcome — overload, deadlines,
+poisoned math and page starvation included; (2) the engine's health
+counters are consistent with the per-request outcomes; (3) fault
+handling is pure data / host bookkeeping — the decode step never
+retraces; (4) pages are reclaimed exactly under every failure path
+(audit_pages); (5) faults stay confined to the requests they hit —
+other slots' tokens are bit-identical to a fault-free run."""
+
+import time
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.base import MXNetError
+from incubator_mxnet_tpu.models import gpt as g
+from incubator_mxnet_tpu.serve import (InferenceEngine, Outcome,
+                                       PageAllocator, Request)
+from incubator_mxnet_tpu.serve.chaos import (CorruptPageWrite,
+                                             DelayedSteps, NaNWeights,
+                                             PagePressure,
+                                             assert_health_consistent,
+                                             run_chaos)
+
+VOCAB = 64
+
+
+@pytest.fixture(scope="module")
+def model():
+    mx.random.seed(0)
+    m = g.gpt_mini(vocab_size=VOCAB, max_length=64)
+    m.initialize()
+    return m
+
+
+def _fresh_model(seed=0):
+    """Function-scoped model for tests that POISON weights — the
+    module fixture must never see NaN."""
+    mx.random.seed(seed)
+    m = g.gpt_mini(vocab_size=VOCAB, max_length=64)
+    m.initialize()
+    return m
+
+
+def _prompt(rng, n):
+    return rng.randint(0, VOCAB, size=(n,)).astype(np.int32)
+
+
+def _solo_reference(model, prompt, max_new):
+    out = g.cached_generate(model, nd.array(prompt[None, :],
+                                            dtype="int32"),
+                            max_new_tokens=max_new).asnumpy()
+    return out[0, prompt.size:]
+
+
+def _nan_params(eng, rng, n_entries=4):
+    """Engine params with a few NaN entries in the embedding table."""
+    params = {str(i): np.asarray(p.data().asnumpy())
+              for i, p in enumerate(eng._eng_params)}
+    tab = params["0"].copy()
+    flat = tab.reshape(-1)
+    flat[rng.choice(flat.size, size=n_entries, replace=False)] = np.nan
+    params["0"] = tab
+    return params
+
+
+# ------------------------------------------------------------------ #
+# outcome taxonomy: every terminal outcome reachable in a unit test
+# ------------------------------------------------------------------ #
+
+def test_success_outcomes_eos_and_max_tokens(model):
+    rng = np.random.RandomState(1)
+    prompt = _prompt(rng, 6)
+    ref = _solo_reference(model, prompt, 10)
+    eng = InferenceEngine(model, num_slots=2, page_size=8, max_len=64)
+    r_max = Request(prompt.copy(), max_new_tokens=10)
+    r_eos = Request(prompt.copy(), max_new_tokens=10, eos_id=int(ref[2]))
+    eng.run([r_max, r_eos])
+    assert r_max.outcome == Outcome.MAX_TOKENS and r_max.outcome.ok
+    assert r_eos.outcome == Outcome.EOS and r_eos.outcome.ok
+    assert eng.completed == 2 and eng.health["EOS"] == 1
+    assert_health_consistent(eng, [r_max, r_eos])
+    eng.audit_pages()
+
+
+def test_shed_at_queue_depth_limit(model):
+    """Bounded admission queue: the flood beyond ``max_queue`` is shed
+    with a retry-after hint, the rest is served normally."""
+    rng = np.random.RandomState(2)
+    eng = InferenceEngine(model, num_slots=1, page_size=8, max_len=64,
+                          max_queue=2)
+    reqs = [Request(_prompt(rng, 5), max_new_tokens=3)
+            for _ in range(6)]
+    accepted = [eng.submit(r) for r in reqs]
+    # 1 admitted... no: submit only queues; 2 fit the queue bound
+    assert accepted == [True, True, False, False, False, False]
+    shed = [r for r in reqs if r.outcome == Outcome.SHED]
+    assert len(shed) == 4 and eng.shed == 4
+    assert all(r.retry_after_s is not None and r.retry_after_s > 0
+               for r in shed)
+    assert all("depth limit" in r.detail for r in shed)
+    eng.run([])                              # drain the two queued
+    assert all(r.outcome is not None for r in reqs)
+    assert eng.completed == 2
+    assert_health_consistent(eng, reqs)
+    eng.audit_pages()
+
+
+def test_shed_on_estimated_queue_delay(model):
+    """EWMA-based delay shedding: after one completion calibrates the
+    slot-residence estimate, a BACKLOG beyond the free slots under a
+    tight delay limit sheds — but an idle engine (queue fits free
+    slots, estimated delay zero) must keep admitting: a tier that
+    sheds 100% of traffic at zero load because its own steady-state
+    latency exceeds the limit is the bug, not the feature."""
+    rng = np.random.RandomState(3)
+    eng = InferenceEngine(model, num_slots=1, page_size=8, max_len=64,
+                          max_queue_delay_s=1e-9)
+    first = Request(_prompt(rng, 5), max_new_tokens=3)
+    eng.run([first])                         # calibrates the EWMA
+    assert first.outcome.ok and eng._ewma_service_s is not None
+    # idle engine, empty queue: estimated delay is 0 — NOT shed, and
+    # it is served to a success outcome
+    idle_ok = Request(_prompt(rng, 5), max_new_tokens=3)
+    assert eng.submit(idle_ok)
+    # a second submit now has a backlog beyond the free slot count:
+    # waves >= 1, estimate > the (tiny) limit -> shed with the hint
+    late = Request(_prompt(rng, 5), max_new_tokens=3)
+    assert not eng.submit(late)
+    assert late.outcome == Outcome.SHED
+    assert "estimated queue delay" in late.detail
+    assert late.retry_after_s is not None and late.retry_after_s > 0
+    eng.run([])                              # drain the admitted one
+    assert idle_ok.outcome is not None and idle_ok.outcome.ok
+
+
+def test_deadline_expired_mid_queue(model):
+    """A queued request whose deadline passes before a slot frees is
+    dropped terminally — it never occupies a slot."""
+    rng = np.random.RandomState(4)
+    eng = InferenceEngine(model, num_slots=1, page_size=8, max_len=64)
+    hog = Request(_prompt(rng, 5), max_new_tokens=40)
+    doomed = Request(_prompt(rng, 5), max_new_tokens=4,
+                     deadline_s=0.001)
+    eng.run([hog, doomed])
+    assert hog.outcome is not None and hog.outcome.ok
+    assert doomed.outcome == Outcome.DEADLINE_EXPIRED
+    assert "queued" in doomed.detail
+    assert doomed.token_ids == []            # never served
+    assert eng.expired == 1
+    assert_health_consistent(eng, [hog, doomed])
+    eng.audit_pages()
+
+
+def test_deadline_expired_mid_decode(model):
+    """A decoding slot past its deadline is evicted with its pages
+    reclaimed; the partial tokens are kept (they were real)."""
+    rng = np.random.RandomState(5)
+    eng = InferenceEngine(model, num_slots=1, page_size=8, max_len=64)
+    # warm the programs so the deadline measures decode, not compile
+    eng.run([Request(_prompt(rng, 5), max_new_tokens=2)])
+    req = Request(_prompt(rng, 5), max_new_tokens=50, deadline_s=0.02)
+    eng.run([req])
+    assert req.outcome == Outcome.DEADLINE_EXPIRED
+    assert "decode" in req.detail or "prefill" in req.detail
+    assert 0 < len(req.token_ids) < 50
+    assert eng.expired == 1
+    eng.audit_pages()
+    assert eng.decode_trace_count == 1
+
+
+def test_per_slot_wall_cap(model):
+    """``max_slot_wall_s`` is an engine-imposed deadline: no request
+    may hold a slot longer, whatever its own deadline says."""
+    rng = np.random.RandomState(6)
+    eng = InferenceEngine(model, num_slots=1, page_size=8, max_len=64,
+                          max_slot_wall_s=0.02)
+    r2 = Request(_prompt(rng, 5), max_new_tokens=50)
+    eng.run([r2])
+    assert r2.outcome == Outcome.DEADLINE_EXPIRED
+    assert "wall cap" in r2.detail
+    eng.audit_pages()
+
+
+def test_nonfinite_quarantine_mid_decode():
+    """Weights poisoned AFTER a request is decoding: the per-slot guard
+    flag fails the slot the very next decode step — no garbage token is
+    ever recorded, the decode step does not retrace."""
+    model = _fresh_model(101)
+    rng = np.random.RandomState(7)
+    eng = InferenceEngine(model, num_slots=2, page_size=8, max_len=64)
+    req = Request(_prompt(rng, 6), max_new_tokens=40)
+    eng.submit(req)
+    eng.step()                               # admit + prefill + decode
+    eng.step()
+    tokens_before = len(req.token_ids)
+    assert tokens_before >= 2
+    eng.warm_start(params=_nan_params(eng, rng))
+    while req.outcome is None:
+        eng.step()
+    assert req.outcome == Outcome.FAILED_NONFINITE
+    assert "decode" in req.detail
+    # the poisoned step's token was never recorded — the guard fires
+    # before _finish_token, so no garbage token reaches the stream
+    assert len(req.token_ids) == tokens_before
+    assert eng.quarantined == 1
+    assert eng.decode_trace_count == 1, "guard flag retraced decode"
+    eng.audit_pages()
+
+
+def test_nonfinite_quarantine_in_prefill():
+    """Poisoned weights present at admission: the prefill guard fails
+    the request before it ever becomes decode-visible, and its prompt
+    pages are NOT published into the prefix index."""
+    model = _fresh_model(102)
+    rng = np.random.RandomState(8)
+    eng = InferenceEngine(model, num_slots=2, page_size=8, max_len=64)
+    eng.warm_start(params=_nan_params(eng, rng))
+    req = Request(_prompt(rng, 20), max_new_tokens=8)
+    eng.run([req])
+    assert req.outcome == Outcome.FAILED_NONFINITE
+    assert "prefill" in req.detail
+    assert req.token_ids == []
+    assert len(eng._prefix) == 0, \
+        "poisoned prompt pages were published to the prefix index"
+    eng.audit_pages()
+    assert eng._alloc.free_count == eng.num_pages - 1
+
+
+def test_unservable_fail_fast_at_submit(model):
+    """A request that can NEVER fit (positions or worst-case pages)
+    fails at submit — no exception, no queue head-of-line wedge."""
+    rng = np.random.RandomState(9)
+    eng = InferenceEngine(model, num_slots=1, page_size=8, max_len=64,
+                          num_pages=3)
+    too_long = Request(_prompt(rng, 30), max_new_tokens=60)
+    assert not eng.submit(too_long)
+    assert too_long.outcome == Outcome.FAILED_UNSERVABLE
+    too_many_pages = Request(_prompt(rng, 8), max_new_tokens=16)
+    assert not eng.submit(too_many_pages)
+    assert too_many_pages.outcome == Outcome.FAILED_UNSERVABLE
+    assert eng.unservable == 2
+    assert not eng._queue
+
+
+def test_watchdog_evicts_page_starved_slot(model):
+    """Full allocator starvation mid-decode: the stalled slot sits out
+    decode steps (its masked write cannot touch a real page) and the
+    watchdog fails it after ``watchdog_steps`` of zero progress —
+    engine audit stays exact throughout, with the held pages counted."""
+    rng = np.random.RandomState(10)
+    eng = InferenceEngine(model, num_slots=2, page_size=8, max_len=64,
+                          watchdog_steps=6, prefix_cache=False)
+    req = Request(_prompt(rng, 7), max_new_tokens=40)
+    eng.submit(req)
+    eng.step()
+    assert req.outcome is None
+    held = eng._alloc.hold(10 ** 6)          # capped at free_count
+    assert eng._alloc.free_count == 0
+    steps = 0
+    while req.outcome is None and steps < 50:
+        eng.step()
+        eng.audit_pages()
+        steps += 1
+    assert req.outcome == Outcome.FAILED_UNSERVABLE
+    assert "watchdog" in req.detail
+    assert steps <= 8
+    eng._alloc.release_held()
+    eng.audit_pages()
+    assert eng._alloc.free_count == eng.num_pages - 1
+    assert len(held) > 0
+
+
+def test_run_fails_starved_queue_head_and_keeps_serving(model):
+    """Queue-head starvation while the engine is idle: after
+    ``stall_steps`` idle polls the head goes FAILED_UNSERVABLE and the
+    requests behind it are still served (no head-of-line wedge)."""
+    rng = np.random.RandomState(11)
+    eng = InferenceEngine(model, num_slots=1, page_size=8, max_len=64,
+                          num_pages=6, stall_steps=5, prefix_cache=False)
+    held = eng._alloc.hold(3)                # leave 2 free pages
+    big = Request(_prompt(rng, 8), max_new_tokens=16)   # needs 3 pages
+    small = Request(_prompt(rng, 5), max_new_tokens=8)  # needs 2 pages
+    ref = _solo_reference(model, small.prompt_ids, 8)
+    eng.run([big, small], poll_sleep=1e-4)
+    assert big.outcome == Outcome.FAILED_UNSERVABLE
+    assert "page-starved" in big.detail
+    assert small.outcome is not None and small.outcome.ok
+    np.testing.assert_array_equal(np.asarray(small.token_ids, np.int32),
+                                  ref)
+    eng._alloc.release_held(held)
+    eng.audit_pages()
+
+
+def test_shutdown_reaches_quiescence(model):
+    """shutdown() (the SIGTERM drain path): every active and queued
+    request becomes terminal SHED, pages are reclaimed, the engine is
+    reusable."""
+    rng = np.random.RandomState(12)
+    eng = InferenceEngine(model, num_slots=2, page_size=8, max_len=64)
+    reqs = [Request(_prompt(rng, 5), max_new_tokens=30)
+            for _ in range(4)]
+    for r in reqs:
+        eng.submit(r)
+    eng.step()
+    eng.step()
+    eng.shutdown("preemption drain")
+    assert all(r.outcome == Outcome.SHED for r in reqs)
+    assert all(r.detail == "preemption drain" for r in reqs)
+    assert eng.active_count == 0 and not eng._queue
+    assert_health_consistent(eng, reqs)
+    eng.audit_pages()
+    # the engine itself is still healthy: serve another request
+    again = Request(_prompt(rng, 5), max_new_tokens=3)
+    eng.run([again])
+    assert again.outcome is not None and again.outcome.ok
+    assert eng.decode_trace_count == 1
+
+
+def test_double_finish_is_refused(model):
+    rng = np.random.RandomState(13)
+    eng = InferenceEngine(model, num_slots=1, page_size=8, max_len=64)
+    req = Request(_prompt(rng, 5), max_new_tokens=2)
+    eng.run([req])
+    assert req.outcome is not None
+    with pytest.raises(MXNetError, match="already terminal"):
+        eng._record_terminal(req, Outcome.SHED)
+
+
+def test_allocator_hold_release_accounting():
+    """The chaos pressure hook goes through the allocator's own
+    bookkeeping: held pages have refcount 1, are listed, and release
+    restores the free list exactly; over-hold is capped."""
+    a = PageAllocator(8)
+    held = a.hold(3)
+    assert len(held) == 3 and a.free_count == 4
+    assert sorted(a.held) == sorted(held)
+    assert all(a.refcount(p) == 1 for p in held)
+    more = a.hold(100)                       # capped at what's left
+    assert len(more) == 4 and a.free_count == 0
+    a.release_held(held)
+    assert a.free_count == 3 and sorted(a.held) == sorted(more)
+    a.release_held()
+    assert a.free_count == 7 and a.held == ()
+
+
+# ------------------------------------------------------------------ #
+# chaos injectors (the heavier end-to-end scenarios live in
+# tools/chaos_bench.py --smoke, the ci chaossmoke stage)
+# ------------------------------------------------------------------ #
+
+@pytest.mark.slow
+def test_chaos_corrupt_page_isolation():
+    """NaN page corruption: exactly the mapped slot's request is
+    quarantined; every other request's tokens are bit-identical to the
+    fault-free run; audit passes every step; decode compiled once."""
+    rng = np.random.RandomState(20)
+    prompts = [_prompt(rng, 4 + 3 * i) for i in range(5)]
+    news = [6 + 2 * i for i in range(5)]
+
+    model_a = _fresh_model(103)
+    base = [Request(p.copy(), max_new_tokens=k)
+            for p, k in zip(prompts, news)]
+    eng_a = InferenceEngine(model_a, num_slots=2, page_size=8,
+                            max_len=64, prefix_cache=False)
+    run_chaos(eng_a, base, [])               # fault-free baseline
+    baseline = [list(r.token_ids) for r in base]
+
+    model_b = _fresh_model(103)
+    reqs = [Request(p.copy(), max_new_tokens=k)
+            for p, k in zip(prompts, news)]
+    eng_b = InferenceEngine(model_b, num_slots=2, page_size=8,
+                            max_len=64, prefix_cache=False)
+    inj = CorruptPageWrite(at_step=3, mode="nan", seed=1)
+    run_chaos(eng_b, reqs, [inj])
+    assert inj.fired and len(inj.affected) == 1
+    hit = inj.affected[0]
+    assert hit.outcome == Outcome.FAILED_NONFINITE
+    for r, bt in zip(reqs, baseline):
+        if r is not hit:
+            assert r.outcome.ok and list(r.token_ids) == bt
+    assert eng_b.decode_trace_count == 1
+    assert_health_consistent(eng_b, reqs)
+
+
+@pytest.mark.slow
+def test_chaos_transient_page_pressure_full_parity():
+    """Allocator pressure is pure scheduling: held pages slow things
+    down but change NO data — with the pressure released, every request
+    completes bit-identical to the fault-free run."""
+    rng = np.random.RandomState(21)
+    prompts = [_prompt(rng, 4 + 3 * i) for i in range(5)]
+    news = [6 + 2 * i for i in range(5)]
+
+    model_a = _fresh_model(104)
+    base = [Request(p.copy(), max_new_tokens=k)
+            for p, k in zip(prompts, news)]
+    eng_a = InferenceEngine(model_a, num_slots=2, page_size=8,
+                            max_len=64, prefix_cache=False)
+    run_chaos(eng_a, base, [])
+    baseline = [list(r.token_ids) for r in base]
+
+    model_b = _fresh_model(104)
+    reqs = [Request(p.copy(), max_new_tokens=k)
+            for p, k in zip(prompts, news)]
+    eng_b = InferenceEngine(model_b, num_slots=2, page_size=8,
+                            max_len=64, prefix_cache=False,
+                            watchdog_steps=200)
+    inj = PagePressure(hold_at=2, release_after=12)
+    run_chaos(eng_b, reqs, [inj])
+    assert inj.fired
+    for r, bt in zip(reqs, baseline):
+        assert r.outcome.ok and list(r.token_ids) == bt
+    assert eng_b._alloc.held == ()
+    assert_health_consistent(eng_b, reqs)
+
+
+def test_chaos_delayed_steps_expire_deadlines(model):
+    """Host stalls (DelayedSteps) blow the requests' deadlines: every
+    request still terminates — DEADLINE_EXPIRED or ok — never wedged."""
+    rng = np.random.RandomState(22)
+    eng = InferenceEngine(model, num_slots=2, page_size=8, max_len=64)
+    # warm so compile time doesn't eat the deadlines
+    eng.run([Request(_prompt(rng, 5), max_new_tokens=2)])
+    reqs = [Request(_prompt(rng, 5), max_new_tokens=30,
+                    deadline_s=0.25) for _ in range(3)]
+    inj = DelayedSteps(start=2, end=10 ** 9, sleep_s=0.1)
+    run_chaos(eng, reqs, [inj])
+    assert all(r.outcome is not None for r in reqs)
+    assert any(r.outcome == Outcome.DEADLINE_EXPIRED for r in reqs)
+    eng.audit_pages()
+    assert eng.decode_trace_count == 1

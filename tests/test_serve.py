@@ -48,6 +48,7 @@ def test_single_request_matches_cached_generate(model):
     assert eng.decode_trace_count == 1
 
 
+@pytest.mark.slow   # 13-21s (round-10 tier-1 budget repair); ci stage_unit runs it
 def test_mixed_occupancy_no_cross_contamination_and_slot_reuse(model):
     """5 ragged requests through 3 slots with staggered arrivals: every
     request's tokens must equal its SOLO dense-cache decode (continuous
@@ -115,7 +116,11 @@ def test_per_slot_sampling_isolation(model):
 def test_admission_control_waits_for_pages(model):
     """A pool too small for two concurrent requests serializes them
     (second waits for eviction) instead of corrupting the cache; a pool
-    too small for ANY request raises."""
+    too small for ANY request fails THAT request with the
+    FAILED_UNSERVABLE terminal outcome — regression for the old
+    behavior where run() raised RuntimeError/MXNetError out of the
+    serving loop and took every other in-flight request down with it."""
+    from incubator_mxnet_tpu.serve import Outcome
     rng = np.random.RandomState(5)
     prompts = [rng.randint(0, 64, size=(8,)).astype(np.int32)
                for _ in range(2)]
@@ -129,10 +134,21 @@ def test_admission_control_waits_for_pages(model):
     for req, ref in zip(reqs, refs):
         np.testing.assert_array_equal(np.asarray(req.token_ids,
                                                  np.int32), ref)
+    # the old crash path: a request that can NEVER fit the pool, mixed
+    # with one that can — the doomed one fails loudly (terminal outcome,
+    # detail naming the capacity), the other is served to completion
     tiny = InferenceEngine(model, num_slots=1, page_size=8, max_len=64,
-                           num_pages=2)
-    with pytest.raises(MXNetError):
-        tiny.run([Request(prompts[0], max_new_tokens=16)])
+                           num_pages=3)
+    doomed = Request(prompts[0], max_new_tokens=16)   # needs 3 > 2 pages
+    servable = Request(prompts[1], max_new_tokens=8)  # needs 2 pages
+    tiny.run([doomed, servable])
+    assert doomed.outcome == Outcome.FAILED_UNSERVABLE
+    assert "pages" in doomed.detail
+    assert servable.outcome is not None and servable.outcome.ok
+    np.testing.assert_array_equal(
+        np.asarray(servable.token_ids, np.int32), refs[1])
+    assert tiny.unservable == 1
+    tiny.audit_pages()
 
 
 def test_decode_shapes_independent_of_occupancy(model):
@@ -149,6 +165,7 @@ def test_decode_shapes_independent_of_occupancy(model):
     assert all(len(r.token_ids) == 3 + i for i, r in enumerate(reqs))
 
 
+@pytest.mark.slow   # 13-21s (round-10 tier-1 budget repair); ci stage_unit runs it
 def test_tp_sharded_pools_token_parity(model):
     """Pools sharded over the tp mesh axis (H dim) through
     parallel.mesh must reproduce the unsharded tokens exactly — the
@@ -420,6 +437,7 @@ def test_shared_pages_cross_slot_isolation(model):
     eng2.audit_pages()
 
 
+@pytest.mark.slow   # 13-21s (round-10 tier-1 budget repair); ci stage_unit runs it
 def test_warm_start_flushes_prefix_cache(model):
     """SATELLITE: after a weight swap a previously-cached prefix must
     not be served from stale K/V — the index is flushed (asserted), the

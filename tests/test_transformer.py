@@ -164,6 +164,7 @@ def test_flash_attention_path_matches_dense():
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow   # 13-21s (round-10 tier-1 budget repair); ci stage_unit runs it
 def test_cached_beam_search_matches_and_rng_survives():
     """KV-cached beam search must emit exactly beam_search_translate's
     tokens/scores (plain + masked source), and the global RNG stream

@@ -1,0 +1,502 @@
+"""Chaos bench: drive the serving engine through seeded fault
+scenarios and ASSERT the resilience invariants (docs/RESILIENCE.md).
+
+Every scenario replays the same mixed workload (shared-prefix + unique
+prompts, ragged lengths, chunked prefill, prefix cache on) against a
+fresh engine with one deterministic fault injected
+(serve/chaos.py), and checks:
+
+  1. QUIESCENCE — 100% of requests reach a structured terminal
+     Outcome; the engine never wedges and never raises out of the
+     serving loop;
+  2. ISOLATION — every request the fault did NOT touch emits tokens
+     BIT-IDENTICAL to the fault-free baseline run (no cross-slot
+     contamination through the shared page pool, the prefix cache, or
+     the batched decode step);
+  3. ACCOUNTING — ``audit_pages()`` passes after EVERY scheduler step,
+     fault handling included (no page leaked or double-granted on any
+     eviction path);
+  4. COMPILE DISCIPLINE — the decode step compiled exactly once and
+     every prefill/chunk bucket exactly once across the whole faulted
+     run (the non-finite guard flag and all fault handling are pure
+     data / host bookkeeping — zero steady-state retraces);
+  5. scenario-specific outcome expectations (a NaN fault must
+     quarantine, overload must shed with retry-after, a deadline storm
+     must expire, starvation must not corrupt survivors).
+
+Scenarios: nan_weights, corrupt_page (NaN), dropped_write (zeroed
+page — undetectable by the guard, isolation still asserted),
+starvation_transient, starvation_full, overload_shed, deadline_storm,
+sigterm (subprocess: cooperative SIGTERM drain + final weight
+snapshot + every request terminal).
+
+``--smoke`` is the CI guard (ci/run.sh chaossmoke stage): the same
+scenarios at a size that runs in minutes on CPU; exits non-zero on any
+violated invariant.
+
+Usage:
+  python tools/chaos_bench.py --smoke          # CI guard
+  python tools/chaos_bench.py                  # larger sweep
+  python tools/chaos_bench.py --json OUT.json
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+# --------------------------------------------------------------------- #
+# workload
+# --------------------------------------------------------------------- #
+
+def _build_model(seed=0, vocab=64, max_length=128):
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.models import gpt as g
+    mx.random.seed(seed)
+    model = g.gpt_mini(vocab_size=vocab, max_length=max_length)
+    model.initialize()
+    return model
+
+
+def _make_requests(n, vocab, seed, deadline_s=None, max_len=128):
+    """Mixed greedy workload: ~half share a persona prefix (exercises
+    COW page sharing under faults), ragged lengths and budgets. Greedy
+    everywhere so token parity is assertable."""
+    import numpy as np
+    from incubator_mxnet_tpu.serve import Request
+    rng = np.random.RandomState(seed)
+    persona = rng.randint(0, vocab, size=(18,)).astype(np.int32)
+    reqs = []
+    for i in range(n):
+        if i % 2 == 0:
+            tail = rng.randint(0, vocab, size=(3 + i % 7,)).astype(np.int32)
+            prompt = np.concatenate([persona, tail])
+        else:
+            prompt = rng.randint(0, vocab,
+                                 size=(4 + 3 * (i % 5),)).astype(np.int32)
+        max_new = 4 + 2 * (i % 6)
+        assert prompt.size + max_new <= max_len
+        reqs.append(Request(prompt, max_new_tokens=max_new,
+                            deadline_s=deadline_s))
+    return reqs
+
+
+def _engine(model, **kw):
+    from incubator_mxnet_tpu.serve import InferenceEngine
+    cfg = dict(num_slots=4, page_size=8, max_len=128, chunk_pages=1,
+               prefix_cache=True)
+    cfg.update(kw)
+    return InferenceEngine(model, **cfg)
+
+
+# --------------------------------------------------------------------- #
+# invariants
+# --------------------------------------------------------------------- #
+
+def _check_invariants(tag, eng, reqs, baseline, affected, errors,
+                      allow_non_ok=True):
+    """The shared post-scenario assertion block; ``affected`` is the
+    set of requests (by identity) whose output the fault may change."""
+    from incubator_mxnet_tpu.serve.chaos import assert_health_consistent
+    from incubator_mxnet_tpu.base import MXNetError
+    for i, r in enumerate(reqs):
+        if r.outcome is None:
+            errors.append(f"{tag}: request {i} non-terminal")
+    try:
+        assert_health_consistent(eng, reqs)
+    except MXNetError as e:
+        errors.append(f"{tag}: {e}")
+    try:
+        eng.audit_pages()
+    except MXNetError as e:
+        errors.append(f"{tag}: final audit failed: {e}")
+    if eng.decode_trace_count != 1:
+        errors.append(f"{tag}: decode compiled "
+                      f"{eng.decode_trace_count} times (must be 1)")
+    bad_buckets = {k: v for k, v in eng.prefill_trace_counts.items()
+                   if v != 1}
+    if bad_buckets:
+        errors.append(f"{tag}: prefill buckets retraced: {bad_buckets}")
+    aff_ids = {id(r) for r in affected}
+    mismatches = unaffected_ok = 0
+    for r, base_tokens in zip(reqs, baseline):
+        if id(r) in aff_ids:
+            continue
+        if r.outcome is not None and r.outcome.ok:
+            unaffected_ok += 1
+            if list(r.token_ids) != base_tokens:
+                mismatches += 1
+        elif not allow_non_ok:
+            errors.append(f"{tag}: unaffected request ended {r.outcome}")
+    if mismatches:
+        errors.append(f"{tag}: {mismatches} unaffected requests diverged "
+                      f"from the fault-free run (cross-contamination)")
+    return {"outcomes": {o: n for o, n in eng.health.items() if n},
+            "unaffected_ok": unaffected_ok,
+            "affected": len(affected),
+            "decode_trace_count": eng.decode_trace_count,
+            "prefill_buckets": len(eng.prefill_trace_counts)}
+
+
+def _audit_hook(errors, tag):
+    from incubator_mxnet_tpu.base import MXNetError
+
+    def after(eng, i):
+        try:
+            eng.audit_pages()
+        except MXNetError as e:     # record once, with the step index
+            errors.append(f"{tag}: audit failed at step {i}: {e}")
+            raise
+
+    return after
+
+
+def run_scenarios(n_requests, errors):
+    """All in-process scenarios. Fresh model (same seed → identical
+    weights) and fresh engine per scenario so faults cannot leak."""
+    from incubator_mxnet_tpu.serve import Outcome
+    from incubator_mxnet_tpu.serve.chaos import (CorruptPageWrite,
+                                                 DelayedSteps,
+                                                 NaNWeights,
+                                                 PagePressure, run_chaos)
+    results = {}
+    vocab = 64
+
+    # ---- fault-free baseline -------------------------------------- #
+    model = _build_model()
+    eng = _engine(model)
+    reqs = _make_requests(n_requests, vocab, seed=42)
+    t0 = time.perf_counter()
+    run_chaos(eng, reqs, [], audit_every_step=True)
+    wall = time.perf_counter() - t0
+    baseline = [list(r.token_ids) for r in reqs]
+    stats = _check_invariants("baseline", eng, reqs, baseline, set(),
+                              errors, allow_non_ok=False)
+    if not all(r.outcome is not None and r.outcome.ok for r in reqs):
+        errors.append("baseline: not every request succeeded")
+    stats["wall_s"] = wall
+    results["baseline"] = stats
+
+    # ---- NaN weights at warm_start -------------------------------- #
+    model = _build_model()
+    eng = _engine(model)
+    reqs = _make_requests(n_requests, vocab, seed=42)
+    inj = NaNWeights(at_step=6, seed=7)
+    run_chaos(eng, reqs, [inj],
+              audit_every_step=True)
+    stats = _check_invariants("nan_weights", eng, reqs, baseline,
+                              inj.affected, errors, allow_non_ok=False)
+    if not inj.fired:
+        errors.append("nan_weights: injector never fired")
+    if eng.quarantined == 0:
+        errors.append("nan_weights: nothing quarantined")
+    for r in inj.affected:
+        if r.outcome != Outcome.FAILED_NONFINITE:
+            errors.append(f"nan_weights: poisoned request ended "
+                          f"{r.outcome}, not FAILED_NONFINITE")
+    stats["log"] = inj.log
+    results["nan_weights"] = stats
+
+    # ---- one corrupt (NaN) page write ------------------------------ #
+    # prefix_cache off: every mapped page is private, so the fault's
+    # blast radius is provably one slot
+    model = _build_model()
+    eng = _engine(model, prefix_cache=False)
+    reqs = _make_requests(n_requests, vocab, seed=42)
+    inj = CorruptPageWrite(at_step=5, mode="nan", seed=3)
+    run_chaos(eng, reqs, [inj], audit_every_step=True)
+    stats = _check_invariants("corrupt_page", eng, reqs, baseline,
+                              inj.affected, errors, allow_non_ok=False)
+    if not inj.fired:
+        errors.append("corrupt_page: injector never fired")
+    if len(inj.affected) != 1:
+        errors.append(f"corrupt_page: blast radius "
+                      f"{len(inj.affected)} != 1 slot")
+    for r in inj.affected:
+        if r.outcome != Outcome.FAILED_NONFINITE:
+            errors.append(f"corrupt_page: poisoned request ended "
+                          f"{r.outcome}, not FAILED_NONFINITE")
+    stats["log"] = inj.log
+    results["corrupt_page"] = stats
+
+    # ---- one dropped (zeroed) page write --------------------------- #
+    # finite garbage the guard cannot see: the invariant is pure
+    # isolation — the hit request may emit anything, everyone else is
+    # bit-identical, accounting exact
+    model = _build_model()
+    eng = _engine(model, prefix_cache=False)
+    reqs = _make_requests(n_requests, vocab, seed=42)
+    inj = CorruptPageWrite(at_step=5, mode="zero", seed=3)
+    run_chaos(eng, reqs, [inj], audit_every_step=True)
+    stats = _check_invariants("dropped_write", eng, reqs, baseline,
+                              inj.affected, errors, allow_non_ok=False)
+    if not inj.fired:
+        errors.append("dropped_write: injector never fired")
+    stats["log"] = inj.log
+    results["dropped_write"] = stats
+
+    # ---- transient allocator pressure ------------------------------ #
+    model = _build_model()
+    eng = _engine(model, watchdog_steps=400)
+    reqs = _make_requests(n_requests, vocab, seed=42)
+    inj = PagePressure(hold_at=4, release_after=25)
+    run_chaos(eng, reqs, [inj], audit_every_step=True)
+    stats = _check_invariants("starvation_transient", eng, reqs,
+                              baseline, inj.affected, errors,
+                              allow_non_ok=False)
+    if not all(r.outcome is not None and r.outcome.ok for r in reqs):
+        errors.append("starvation_transient: a request failed although "
+                      "the pressure was released")
+    stats["log"] = inj.log
+    results["starvation_transient"] = stats
+
+    # ---- full starvation (never released) -------------------------- #
+    # watchdog + stall handling must fail the starved requests loudly
+    # and keep serving with whatever pages evictions recycle — the held
+    # pages stay held, audited, to the end
+    model = _build_model()
+    eng = _engine(model, watchdog_steps=10, stall_steps=15)
+    reqs = _make_requests(n_requests, vocab, seed=42)
+    inj = PagePressure(hold_at=4, release_after=None)
+    run_chaos(eng, reqs, [inj], audit_every_step=True,
+              poll_sleep=1e-4)
+    stats = _check_invariants("starvation_full", eng, reqs, baseline,
+                              reqs, errors)  # scheduling faults: check
+    # accounting/compile only — but completed requests must STILL be
+    # bit-identical (pressure is not a data fault)
+    for r, base_tokens in zip(reqs, baseline):
+        if r.outcome is not None and r.outcome.ok and \
+                list(r.token_ids) != base_tokens:
+            errors.append("starvation_full: a completed request "
+                          "diverged from the fault-free run")
+    if eng._alloc.held:
+        eng._alloc.release_held()
+    try:
+        eng.audit_pages()
+    except Exception as e:
+        errors.append(f"starvation_full: post-release audit failed: {e}")
+    stats["log"] = inj.log
+    results["starvation_full"] = stats
+
+    # ---- overload shed --------------------------------------------- #
+    model = _build_model()
+    eng = _engine(model, max_queue=3)
+    reqs = _make_requests(n_requests, vocab, seed=42)
+    run_chaos(eng, reqs, [], audit_every_step=True)
+    stats = _check_invariants("overload_shed", eng, reqs, baseline,
+                              [r for r in reqs
+                               if r.outcome is not None
+                               and not r.outcome.ok], errors)
+    if eng.shed == 0:
+        errors.append("overload_shed: queue bound never shed")
+    from incubator_mxnet_tpu.serve import Outcome as _O
+    for r in reqs:
+        if r.outcome == _O.SHED and (r.retry_after_s is None
+                                     or r.retry_after_s <= 0):
+            errors.append("overload_shed: shed without retry_after_s")
+    results["overload_shed"] = stats
+
+    # ---- deadline storm (host stalls) ------------------------------ #
+    model = _build_model()
+    eng = _engine(model)
+    # warm the programs so compile time is not the stall under test
+    warm = _make_requests(2, vocab, seed=9)
+    eng.run(warm)
+    reqs = _make_requests(n_requests, vocab, seed=42, deadline_s=0.4)
+    inj = DelayedSteps(start=3, end=10 ** 9, sleep_s=0.12)
+    run_chaos(eng, reqs, [inj], audit_every_step=True)
+    for i, r in enumerate(reqs):
+        if r.outcome is None:
+            errors.append(f"deadline_storm: request {i} non-terminal")
+    if eng.expired == 0:
+        errors.append("deadline_storm: stalls expired nothing")
+    if eng.decode_trace_count != 1:
+        errors.append("deadline_storm: decode retraced")
+    try:
+        eng.audit_pages()
+    except Exception as e:
+        errors.append(f"deadline_storm: audit failed: {e}")
+    results["deadline_storm"] = {
+        "outcomes": {o: n for o, n in eng.health.items() if n},
+        "stalled_steps": inj.stalled_steps}
+
+    return results
+
+
+# --------------------------------------------------------------------- #
+# SIGTERM mid-serve (subprocess scenario)
+# --------------------------------------------------------------------- #
+
+def _child_main(ckpt_dir):
+    """Serve a long workload; on SIGTERM: drain to a final committed
+    weight snapshot, shut the engine down (every request terminal),
+    audit, report JSON, exit 0. Cooperative stop flag — the signal
+    handler only flips it, so no engine invariant can be torn by a
+    mid-bookkeeping interrupt."""
+    from incubator_mxnet_tpu import checkpoint as ckpt
+    from incubator_mxnet_tpu.serve.chaos import assert_health_consistent
+
+    model = _build_model()
+    eng = _engine(model)
+    reqs = _make_requests(64, 64, seed=42)
+    stop = {"flag": False}
+    signal.signal(signal.SIGTERM,
+                  lambda *_: stop.__setitem__("flag", True))
+    for r in reqs:
+        eng.submit(r)
+    announced = False
+    while (eng._queue or eng.active_count) and not stop["flag"]:
+        eng.step()
+        eng.audit_pages()
+        if not announced and eng.decode_steps >= 2:
+            print("SERVING", flush=True)
+            announced = True
+    mgr = ckpt.CheckpointManager(ckpt_dir, keep=1)
+    preempted = bool(stop["flag"])
+    if preempted:
+        eng.save_checkpoint(mgr, block=True)   # final sync snapshot
+        eng.shutdown("SIGTERM preemption drain")
+    mgr.close()
+    eng.audit_pages()
+    assert_health_consistent(eng, reqs)
+    report = {
+        "preempted": preempted,
+        "all_terminal": all(r.outcome is not None for r in reqs),
+        "outcomes": {o: n for o, n in eng.health.items() if n},
+        "decode_trace_count": eng.decode_trace_count,
+        "committed_steps": mgr.all_steps(),
+    }
+    print("REPORT " + json.dumps(report), flush=True)
+    return 0
+
+
+def run_sigterm_scenario(errors):
+    """Parent: spawn the child, SIGTERM it mid-serve, assert the drain
+    contract — exit 0, all requests terminal, a committed weight
+    snapshot a replacement replica could warm_start from.
+
+    stdout is drained through a reader THREAD: a child that wedges
+    inside ``eng.step()`` after announcing SERVING (exactly the
+    failure class this stage exists to catch — the cooperative SIGTERM
+    handler only flips a flag, so a wedged step never observes it)
+    emits nothing further, and a blocking ``readline()`` would hang
+    the whole chaossmoke CI stage instead of failing it."""
+    import queue as _queue
+    import threading
+    with tempfile.TemporaryDirectory() as d:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--child",
+             "--ckpt-dir", d],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        lines: "_queue.Queue" = _queue.Queue()
+
+        def _drain(stream):
+            for ln in iter(stream.readline, ""):
+                lines.put(ln)
+            lines.put(None)                  # EOF sentinel
+
+        threading.Thread(target=_drain, args=(proc.stdout,),
+                         daemon=True).start()
+        report = None
+        rc = None
+        try:
+            deadline = time.time() + 600
+            while time.time() < deadline:
+                try:
+                    line = lines.get(timeout=min(
+                        5.0, max(0.1, deadline - time.time())))
+                except _queue.Empty:
+                    continue                 # re-check the deadline
+                if line is None:
+                    break
+                if line.startswith("SERVING"):
+                    time.sleep(0.2)          # land mid-serve
+                    proc.send_signal(signal.SIGTERM)
+                elif line.startswith("REPORT "):
+                    report = json.loads(line[len("REPORT "):])
+            try:
+                rc = proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                errors.append("sigterm: child wedged — no exit within "
+                              "the scenario deadline")
+                return {"rc": None}
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        if rc != 0:
+            errors.append(f"sigterm: child exited {rc}: "
+                          f"{proc.stderr.read()[-2000:]}")
+            return {"rc": rc}
+        if report is None:
+            errors.append("sigterm: child never reported")
+            return {"rc": rc}
+        if not report["preempted"]:
+            errors.append("sigterm: child finished before the signal "
+                          "landed — scenario did not exercise the drain")
+        if not report["all_terminal"]:
+            errors.append("sigterm: requests left non-terminal after "
+                          "the drain")
+        if report["decode_trace_count"] != 1:
+            errors.append("sigterm: decode retraced in the child")
+        if not report["committed_steps"]:
+            errors.append("sigterm: no weight snapshot committed")
+        else:
+            stepdir = os.path.join(
+                d, f"step_{report['committed_steps'][-1]:08d}")
+            if not os.path.isdir(stepdir):
+                errors.append("sigterm: reported step dir missing")
+        return report
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI guard: the same scenarios, small workload")
+    ap.add_argument("--json", default=None)
+    ap.add_argument("--requests", type=int, default=None)
+    ap.add_argument("--skip-sigterm", action="store_true",
+                    help="in-process scenarios only")
+    ap.add_argument("--child", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--ckpt-dir", default=None, help=argparse.SUPPRESS)
+    args = ap.parse_args()
+
+    if args.child:
+        sys.exit(_child_main(args.ckpt_dir))
+
+    n = args.requests or (10 if args.smoke else 24)
+    errors = []
+    t0 = time.perf_counter()
+    results = run_scenarios(n, errors)
+    if not args.skip_sigterm:
+        results["sigterm"] = run_sigterm_scenario(errors)
+    results["wall_s_total"] = time.perf_counter() - t0
+    results["n_requests"] = n
+
+    print(json.dumps(results, indent=2))
+    for e in errors:
+        print(f"FAIL: {e}", file=sys.stderr)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=2)
+            f.write("\n")
+        print(f"banked {args.json}")
+    if not errors:
+        print("chaos: all scenarios quiescent, isolated, audited, "
+              "compile-clean")
+    sys.exit(0 if not errors else 1)
+
+
+if __name__ == "__main__":
+    main()
