@@ -43,9 +43,13 @@ stage_stepbench() {
 
 stage_servebench() {
   echo "== servebench: continuous-batching regression guard (the decode"
-  echo "               step must compile exactly once across occupancy churn,"
-  echo "               cache-hit admission must compile ZERO new programs, and"
-  echo "               chunked prefill must respect its per-step token budget)"
+  echo "               family must compile exactly once per program — W=1"
+  echo "               narrow + K+1-wide verify — across occupancy churn and"
+  echo "               mixed-agreement speculation; cache-hit admission must"
+  echo "               compile ZERO new programs; chunked prefill must respect"
+  echo "               its per-step token budget; zero-agreement speculation"
+  echo "               must stay bit-identical to plain decode at the same"
+  echo "               step count and within noise of its tokens/s)"
   JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke
 }
 

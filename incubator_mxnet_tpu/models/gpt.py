@@ -312,6 +312,20 @@ def _mlp(blk: GPTBlock, x):
         blk.ffn_in(blk.ln2(x))._data, approximate=False)))
 
 
+def _lm_head(model: GPTModel, x):
+    """Final norm + tied vocab projection for the decode paths: cast to
+    f32 BEFORE ``ln_f`` (norming bf16 then casting would feed
+    bf16-rounded activations into the vocab projection and break token
+    parity with the training/greedy path — see ``decode_forward``).
+    Shared by the dense KV-cache decode below and every serving-engine
+    program (prefill, chunk, K-wide speculative verify) so head
+    numerics cannot drift between the caches or between verify
+    positions. x: (B, T, units) NDArray → (B, T, vocab) NDArray."""
+    x = model.ln_f(x.astype("float32"))
+    embed_w = model.word_embed.weight.data()
+    return x._op("dot", embed_w, transpose_b=True)
+
+
 def _attn_decode(attn: CausalSelfAttention, x, k_buf, v_buf, start_pos):
     """Run attention for positions [start_pos, start_pos+Tin) against the
     cache. x: (B, Tin, units); k_buf/v_buf: (B, Tmax, H, D) jnp arrays.
@@ -384,14 +398,7 @@ def decode_forward(model: GPTModel, ids, caches, start_pos,
         new_caches.append((k_buf, v_buf))
     if last_only:
         x = x._op("slice_axis", axis=1, begin=Tin - 1, end=Tin)
-    # cast BEFORE the final norm, exactly like GPTModel.hybrid_forward
-    # (ln_f returns its input dtype — norming bf16 then casting would
-    # feed bf16-rounded activations into the vocab projection and break
-    # token parity with the training/greedy path)
-    x = model.ln_f(x.astype("float32"))
-    embed_w = model.word_embed.weight.data()
-    logits = x._op("dot", embed_w, transpose_b=True)
-    return logits, new_caches
+    return _lm_head(model, x), new_caches
 
 
 def cached_generate(model: GPTModel, prompt_ids, max_new_tokens=32,

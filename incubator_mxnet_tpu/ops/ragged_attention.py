@@ -68,7 +68,8 @@ from .pallas_attention import _pallas_available, _pallas_runnable
 _NEG_INF = -1e30
 
 __all__ = ["ragged_paged_attention", "ragged_attention_reference",
-           "ragged_prefill_attention", "ragged_prefill_reference"]
+           "ragged_prefill_attention", "ragged_prefill_reference",
+           "ragged_verify_attention", "ragged_verify_reference"]
 
 
 def _ragged_kernel(pt_ref, ln_ref, q_ref, k_ref, v_ref, o_ref,
@@ -178,25 +179,23 @@ def _ragged_pallas(q, k_pool, v_pool, page_table, lengths, scale,
     return out[:, :, 0, :]
 
 
-def ragged_attention_reference(q, k_pool, v_pool, page_table, lengths,
-                               scale=None):
-    """Pure-jnp oracle and CPU serving path: gather each slot's pages to
-    a dense (S, H, K, D) window, mask positions >= length, softmax with
-    f32 accumulation. Jit-friendly (static shapes; the gather is an XLA
-    gather over the pool's page axis)."""
+def _gather_window(pool, page_table):
+    """(S, H, K, D) dense window of a slot's pages — the expensive
+    gather over the pool's page axis, shared by the reference paths."""
+    S, n_pages = page_table.shape
+    _, H, page_size, D = pool.shape
+    g = pool[page_table]                        # (S, n_pages, H, ps, D)
+    g = jnp.moveaxis(g, 2, 1)                   # (S, H, n_pages, ps, D)
+    return g.reshape(S, H, n_pages * page_size, D)
+
+
+def _reference_core(q, k, v, lengths, sc):
+    """Masked online-softmax attention over a pre-gathered window.
+    q: (S, H, D); k/v: (S, H, K, D). Factored out so the verify
+    reference can reuse ONE gather across its W query rows while each
+    row runs bitwise the same computation as the decode reference."""
     S, H, D = q.shape
-    page_size = k_pool.shape[2]
-    n_pages = page_table.shape[1]
-    K = n_pages * page_size
-    sc = D ** -0.5 if scale is None else scale
-
-    def window(pool):
-        g = pool[page_table]                    # (S, n_pages, H, ps, D)
-        g = jnp.moveaxis(g, 2, 1)               # (S, H, n_pages, ps, D)
-        return g.reshape(S, H, K, D)
-
-    k = window(k_pool)
-    v = window(v_pool)
+    K = k.shape[2]
     s = jnp.einsum("shd,shkd->shk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * sc
     pos = lax.broadcasted_iota(jnp.int32, (S, K), 1)
@@ -216,6 +215,19 @@ def ragged_attention_reference(q, k_pool, v_pool, page_table, lengths,
     # page) PROPAGATES so the engine's non-finite guard can see it
     row_ok = ~(m <= _NEG_INF / 2)
     return jnp.where(row_ok[..., None], out, 0.0).astype(q.dtype)
+
+
+def ragged_attention_reference(q, k_pool, v_pool, page_table, lengths,
+                               scale=None):
+    """Pure-jnp oracle and CPU serving path: gather each slot's pages to
+    a dense (S, H, K, D) window, mask positions >= length, softmax with
+    f32 accumulation. Jit-friendly (static shapes; the gather is an XLA
+    gather over the pool's page axis)."""
+    D = q.shape[-1]
+    sc = D ** -0.5 if scale is None else scale
+    k = _gather_window(k_pool, page_table)
+    v = _gather_window(v_pool, page_table)
+    return _reference_core(q, k, v, lengths, sc)
 
 
 def ragged_paged_attention(q, k_pool, v_pool, page_table, lengths,
@@ -358,17 +370,20 @@ def _ragged_prefill_pallas(q, k_pool, v_pool, page_row, qinfo, scale,
 
 
 def ragged_prefill_reference(q, k_pool, v_pool, page_row, q_start,
-                             scale=None):
+                             scale=None, n_real=None):
     """Pure-jnp oracle and CPU serving path for chunked prefill: gather
     the slot's whole page window dense, apply the per-query prefix mask
     ``pos_k <= q_start + i``, softmax with f32 accumulation. Same
     numerics discipline as ``ragged_attention_reference``; jit-friendly
-    (``q_start`` is traced data)."""
+    (``q_start`` is traced data). ``n_real`` is the count of live
+    (non-padded) chunk rows, default C."""
     C, H, D = q.shape
     page_size = k_pool.shape[2]
     n_pages = page_row.shape[0]
     K = n_pages * page_size
     sc = D ** -0.5 if scale is None else scale
+    if n_real is None:
+        n_real = C
 
     def window(pool):
         g = pool[page_row]                      # (n_pages, H, ps, D)
@@ -382,12 +397,24 @@ def ragged_prefill_reference(q, k_pool, v_pool, page_row, q_start,
     pos_k = lax.broadcasted_iota(jnp.int32, (C, K), 1)
     pos_q = q_start + lax.broadcasted_iota(jnp.int32, (C, K), 0)
     s = jnp.where((pos_k <= pos_q)[:, None, :], s, _NEG_INF)
-    # select positions no query may see out of V (reused-page garbage
-    # must not leak through 0-weight terms — see the decode reference);
-    # positions a LATER query legitimately reads stay as-is: if they
-    # are poisoned, that query is poisoned, which is the point
+    # select positions no LIVE query may see out of V (reused-page
+    # garbage must not leak through 0-weight terms — see the decode
+    # reference): a live row i < n_real reads positions
+    # <= q_start + i <= q_start + n_real - 1, all freshly written, so
+    # zeroing from q_start + n_real changes no live row's math. The
+    # bound must be n_real, not C: on a PARTIAL chunk the positions in
+    # [q_start + n_real, q_start + C) are UNWRITTEN — a recycled page
+    # can carry a quarantined slot's non-finite K/V there, and
+    # 0 * NaN = NaN would poison every live row of this chunk (found
+    # by the chaos corrupt_page scenario under speculation, whose
+    # wide verify writes NaN into more offsets of the victim's pages
+    # before quarantine frees them). Same rule as the Pallas kernel's
+    # ``pos < start + n_real`` select. Positions a later LIVE query
+    # legitimately reads stay as-is: if they are poisoned, that query
+    # is poisoned, which is the point; padded rows may now read zeros,
+    # but their output was already contractually garbage.
     never_read = lax.broadcasted_iota(jnp.int32, (K,), 0) >= \
-        q_start + C
+        q_start + n_real
     v = jnp.where(never_read[None, :, None], 0.0, v)
     m = jnp.max(s, axis=-1)
     p = jnp.exp(s - m[..., None])
@@ -397,6 +424,225 @@ def ragged_prefill_reference(q, k_pool, v_pool, page_row, q_start,
     # negated compare: padded rows → zero, NaN propagates (see decode)
     row_ok = ~(m <= _NEG_INF / 2)
     return jnp.where(row_ok[..., None], out, 0.0).astype(q.dtype)
+
+
+# --------------------------------------------------------------------- #
+# multi-query verify over a paged prefix (the speculative-decoding
+# draft-then-verify attention variant)
+# --------------------------------------------------------------------- #
+
+def _ragged_verify_kernel(pt_ref, ln_ref, dl_ref, q_ref, k_ref, v_ref,
+                          o_ref, m_ref, l_ref, acc_ref, *, scale,
+                          page_size, n_pages, heads, window):
+    """Decode kernel generalized to ``window`` queries per slot: query
+    row r of slot s sits at absolute position ``lengths[s] - 1 + r``
+    (row 0 IS the ordinary decode query) and attends keys
+    ``[0, lengths[s] - 1 + r]`` — the slot's paged prefix plus the
+    causal intra-window part in one predicate, exactly the
+    chunked-prefill masking with a per-SLOT dynamic start. Same
+    online-softmax scratch carried across the page axis, same
+    dead-page skip via the repeated-null-page index, same NaN
+    propagation / masked-V-select contract as the decode kernel."""
+    from jax.experimental import pallas as pl
+
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+    length = ln_ref[s]               # keys visible to query row 0
+    dl = dl_ref[s]                   # slot's REAL draft count this step
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # the last CONSUMED row (row dl — accepted drafts + the
+    # bonus/correction) sees keys up to length + dl - 1, and that is
+    # also the last position freshly written this step; pages wholly
+    # past it (and every page of a dead slot) contribute nothing —
+    # dead entries all index the null page, so skipping also skips the
+    # re-DMA
+    @pl.when((length > 0) & (j * page_size < length + dl))
+    def _accumulate():
+        # positions no CONSUMED row may ever see are selected out of V
+        # so reused-page garbage (possibly non-finite) cannot leak
+        # through 0-weight terms. The bound must be the slot's real
+        # written extent length + dl, NOT length + window - 1: when a
+        # slot drafts fewer than window - 1 tokens, positions in
+        # [length + dl, length + window - 1) are UNWRITTEN — a recycled
+        # page can carry a quarantined slot's non-finite K/V there, and
+        # 0 * NaN = NaN would poison every consumed row, falsely
+        # quarantining a healthy slot (same rule as the chunked-prefill
+        # kernel's n_real bound). Rows past dl may now read fewer
+        # positions than their nominal visibility; their output is
+        # discarded by the engine and never feeds acceptance (the op's
+        # documented PRECONDITION).
+        valid = (j * page_size + lax.broadcasted_iota(
+            jnp.int32, (page_size, 1), 0)) < length + dl
+        for h in range(heads):                  # unrolled head loop
+            q = q_ref[0, h]                     # (window, D), input dtype
+            k = k_ref[0, h]                     # (page_size, D)
+            v = jnp.where(valid, v_ref[0, h], 0.0)
+            sc = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
+                         precision=lax.Precision.DEFAULT) * scale
+            pos_k = j * page_size + lax.broadcasted_iota(
+                jnp.int32, (window, page_size), 1)
+            row = lax.broadcasted_iota(
+                jnp.int32, (window, page_size), 0)
+            # row r (absolute position length - 1 + r) sees keys
+            # [0, length - 1 + r]: prefix + causal intra-window in one
+            # predicate
+            sc = jnp.where(pos_k < length + row, sc, _NEG_INF)
+            m_prev = m_ref[h]                   # (window,)
+            l_prev = l_ref[h]
+            m_new = jnp.maximum(m_prev, jnp.max(sc, axis=-1))
+            p = jnp.exp(sc - m_new[:, None])    # (window, page_size) f32
+            alpha = jnp.exp(m_prev - m_new)
+            m_ref[h] = m_new
+            l_ref[h] = l_prev * alpha + jnp.sum(p, axis=-1)
+            acc_ref[h] = acc_ref[h] * alpha[:, None] + jnp.dot(
+                p.astype(v.dtype), v, preferred_element_type=jnp.float32,
+                precision=lax.Precision.DEFAULT)
+
+    @pl.when(j == n_pages - 1)
+    def _finalize():
+        for h in range(heads):
+            m = m_ref[h]
+            l_safe = jnp.maximum(l_ref[h], 1e-30)
+            # dead slots (length 0) never accumulate: every row stays
+            # at _NEG_INF — emit exactly zero. Negated compare so a NaN
+            # running max (poisoned page) PROPAGATES, see the decode
+            # kernel's finalize
+            row_ok = ~(m <= _NEG_INF / 2)
+            o_ref[0, h] = jnp.where(row_ok[:, None],
+                                    acc_ref[h] / l_safe[:, None],
+                                    0.0).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def _ragged_verify_pallas(q, k_pool, v_pool, page_table, lengths,
+                          draft_len, scale, interpret):
+    """q: (S, W, H, D) — W verify queries per slot; pools:
+    (P, H, page_size, D); page_table: (S, max_pages) int32; lengths:
+    (S,) int32 = keys visible to query row 0 (0 = dead slot);
+    draft_len: (S,) int32 = the slot's real draft count (index of its
+    last consumed row, bounding the freshly-written extent).
+    Returns (S, W, H, D)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    S, W, H, D = q.shape
+    page_size = k_pool.shape[2]
+    n_pages = page_table.shape[1]
+    q4 = q.transpose(0, 2, 1, 3)                # (S, H, W, D)
+
+    kernel = functools.partial(
+        _ragged_verify_kernel, scale=scale, page_size=page_size,
+        n_pages=n_pages, heads=H, window=W)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,          # page_table, lengths, draft_len
+        grid=(S, n_pages),
+        in_specs=[
+            pl.BlockSpec((1, H, W, D),
+                         lambda s, j, pt, ln, dl: (s, 0, 0, 0)),
+            pl.BlockSpec((1, H, page_size, D),
+                         lambda s, j, pt, ln, dl: (pt[s, j], 0, 0, 0)),
+            pl.BlockSpec((1, H, page_size, D),
+                         lambda s, j, pt, ln, dl: (pt[s, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, W, D),
+                               lambda s, j, pt, ln, dl: (s, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, W), jnp.float32),        # m
+            pltpu.VMEM((H, W), jnp.float32),        # l
+            pltpu.VMEM((H, W, D), jnp.float32),     # acc
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, H, W, D), q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      draft_len.astype(jnp.int32), q4, k_pool, v_pool)
+    return out.transpose(0, 2, 1, 3)
+
+
+def ragged_verify_reference(q, k_pool, v_pool, page_table, lengths,
+                            scale=None):
+    """Pure-jnp verify path: one ``ragged_attention_reference`` call
+    per query offset — query row r of slot s attends
+    ``lengths[s] + r`` keys (0 for dead slots). DELIBERATELY a loop of
+    the decode reference at identical per-call shapes rather than a
+    wider einsum: on the CPU serving path each verify position then
+    reproduces the single-query decode numerics BITWISE, which is what
+    the engine's greedy speculative-vs-sequential token parity rests
+    on. The expensive part — the pool gather — is row-INDEPENDENT, so
+    it runs ONCE and all W rows share the window (the values each row
+    sees are identical to a fresh gather, so per-row numerics are
+    unchanged); only the cheap mask + softmax + einsums repeat per
+    row. This keeps the zero-agreement floor of the W-wide verify
+    program near the single-query decode program's cost instead of
+    W x it."""
+    W = q.shape[1]
+    D = q.shape[-1]
+    sc = D ** -0.5 if scale is None else scale
+    lengths = lengths.astype(jnp.int32)
+    k = _gather_window(k_pool, page_table)
+    v = _gather_window(v_pool, page_table)
+    outs = []
+    for r in range(W):
+        lr = jnp.where(lengths > 0, lengths + r, 0)
+        outs.append(_reference_core(q[:, r], k, v, lr, sc))
+    return jnp.stack(outs, axis=1)
+
+
+def ragged_verify_attention(q, k_pool, v_pool, page_table, lengths,
+                            draft_len=None, scale=None, interpret=None):
+    """Multi-query decode (speculative verify) attention: W queries per
+    slot — row 0 is the ordinary decode query at position
+    ``lengths[s] - 1``, row r sits at position ``lengths[s] - 1 + r``
+    and attends the slot's paged prefix plus the causal intra-window
+    part (keys ``[0, lengths[s] - 1 + r]``). q: (S, W, H, D);
+    k_pool/v_pool: (num_pages, H, page_size, D); page_table:
+    (S, max_pages) int32 (dead entries 0 = null page); lengths: (S,)
+    int32 = keys visible to row 0, i.e. the slot's pre-step KV length
+    PLUS ONE for the token written this step (0 = dead slot → exactly
+    zero output, the masked-row contract). Returns (S, W, H, D).
+
+    PRECONDITION (the engine's contract): K/V for every position a
+    LIVE row may read — [0, lengths[s] - 1 + r] for the rows whose
+    output is consumed — are already scattered into the slot's pages.
+    Rows past the slot's real draft window may read stale/garbage tail
+    positions; their output is discarded by the caller and never
+    feeds acceptance (see serve/engine.py).
+
+    ``draft_len`` (S,) int32 gives each slot's real draft count — the
+    index of its last consumed row. The Pallas kernel uses it to bound
+    the V-select at the slot's freshly-written extent
+    ``lengths[s] + draft_len[s]`` so stale non-finite garbage past it
+    (a recycled page from a quarantined slot) cannot leak into
+    consumed rows through 0-weight terms; the jnp reference is per-row
+    exact and needs no bound. Default None = W - 1 for every slot
+    (every window position freshly written — callers that fill the
+    whole window).
+
+    Dispatch is static (mirrors ``ragged_paged_attention``): the
+    Pallas kernel on TPU or under ``MXTPU_FLASH_INTERPRET=1`` /
+    ``interpret=True``; the per-position jnp reference loop otherwise
+    (the CPU serving path and oracle)."""
+    if interpret is None:
+        interpret = os.environ.get("MXTPU_FLASH_INTERPRET") == "1"
+    sc = q.shape[-1] ** -0.5 if scale is None else scale
+    if draft_len is None:
+        draft_len = jnp.full((q.shape[0],), q.shape[1] - 1, jnp.int32)
+    if _pallas_available() and _pallas_runnable(interpret):
+        return _ragged_verify_pallas(q, k_pool, v_pool, page_table,
+                                     lengths, jnp.asarray(draft_len),
+                                     sc, interpret)
+    return ragged_verify_reference(q, k_pool, v_pool, page_table,
+                                   lengths, sc)
 
 
 def ragged_prefill_attention(q, k_pool, v_pool, page_row, q_start,
@@ -426,4 +672,4 @@ def ragged_prefill_attention(q, k_pool, v_pool, page_row, q_start,
         return _ragged_prefill_pallas(q, k_pool, v_pool, page_row,
                                       qinfo, sc, interpret)
     return ragged_prefill_reference(q, k_pool, v_pool, page_row,
-                                    q_start, sc)
+                                    q_start, sc, n_real=n_real)

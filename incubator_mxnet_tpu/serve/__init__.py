@@ -24,10 +24,13 @@ See docs/SERVING.md for the architecture and invariants.
 """
 
 from .paged_kv import (NULL_PAGE, PageAllocator, PrefixIndex,
-                       init_kv_pools, write_prompt_kv, write_token_kv)
+                       init_kv_pools, write_block_kv, write_prompt_kv,
+                       write_token_kv)
 from .outcomes import Outcome
+from .draft import make_ngram_drafter, ngram_propose
 from .engine import InferenceEngine, Request
 
 __all__ = ["InferenceEngine", "Request", "Outcome", "PageAllocator",
            "PrefixIndex", "NULL_PAGE", "init_kv_pools", "write_token_kv",
-           "write_prompt_kv"]
+           "write_prompt_kv", "write_block_kv", "ngram_propose",
+           "make_ngram_drafter"]

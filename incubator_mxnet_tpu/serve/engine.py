@@ -7,8 +7,10 @@ Design (the jit-once contract):
     — int32/float32 arrays fed to ONE jitted decode-step program whose
     shapes never change. Prefill-insert and EOS-eviction are host-side
     edits of those arrays plus page-allocator bookkeeping; in steady
-    state the decode step compiles exactly once (asserted by
-    ``tools/serve_bench.py --smoke`` and tests/test_serve.py).
+    state the decode step compiles exactly once — exactly once PER
+    decode-family program when speculation is on, see below —
+    (asserted by ``tools/serve_bench.py --smoke`` and
+    tests/test_serve.py).
   - Prefill is a separate jitted program per PROMPT BUCKET (prompt
     pages rounded up to a power of two), the BucketingModule trade-off:
     a bounded, logarithmic family of prefill shapes instead of one per
@@ -40,8 +42,39 @@ Design (the jit-once contract):
     — chunk position/length/pages are data, so each chunk bucket
     compiles exactly once, same contract as decode. The cache-hit
     suffix path reuses the same chunk programs even in monolithic mode.
+  - SPECULATIVE DECODING (``spec_k``): decode is dispatch/bandwidth-
+    bound at one token per slot per step, so the engine drafts up to K
+    candidate next-tokens per slot HOST-SIDE (n-gram/prompt-lookup over
+    the slot's own prompt + emitted history — serve/draft.py, no second
+    model) and the decode step VERIFIES all K+1 positions in the same
+    single program call: the draft tokens' K/V are written into the
+    slot's tail pages up front, every position is scored through a
+    multi-query ragged attention variant
+    (``ops.ragged_attention.ragged_verify_attention`` — the slot's
+    paged prefix plus causal intra-window masking in one predicate),
+    and the accepted prefix length is computed ON DEVICE (greedy:
+    longest run of drafts matching the argmax chain — bit-identical to
+    sequential decode by construction; temperature: rejection-sampled
+    acceptance, so the output distribution is provably unchanged). The
+    accepted lengths come back as a per-slot data vector feeding the
+    SAME ragged lengths/page machinery — drafts, acceptance and the
+    per-slot RNG keys are pure data, so the decode family still
+    compiles exactly once PER PROGRAM: the W=1 narrow step (bitwise
+    the non-speculative decode — it runs whenever no slot drafted,
+    via adaptive gating: ``spec_patience`` fully-rejected windows
+    stop a slot's drafting, ``spec_probe_every`` re-probes) and the
+    K+1-wide verify, two shape-keyed entries in one jit cache
+    (``decode_trace_count`` / ``verify_trace_count``). A slot whose
+    drafts all miss (or that drafted nothing) advances exactly
+    today's 1 token/step. Rejected drafts leave stale K/V above the
+    accepted length — harmless by the same masked-read contract that
+    covers reused pages, and overwritten by the next step's writes.
   - Per-slot sampling params: a (S,) temperature array is traced data;
     greedy and categorical are both computed and selected per slot.
+    Every admitted request carries its own RNG key (``Request.seed``,
+    engine-assigned when unset) folded with the TOKEN'S SEQUENCE
+    POSITION for every draw — sampling is reproducible per request and
+    independent of occupancy, chunking, and speculation depth.
   - tp sharding: pass ``mesh`` — pools are placed with the H axis
     sharded over ``tp`` via the existing ``parallel.mesh`` machinery
     and XLA propagates the layout through the step (attention runs the
@@ -65,6 +98,7 @@ from typing import List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from ..base import MXNetError
 from ..ndarray import NDArray
@@ -72,12 +106,18 @@ from ..ops.attention import scaled_dot_product_attention as _sdpa
 from ..ops.ragged_attention import (ragged_attention_reference,
                                     ragged_paged_attention,
                                     ragged_prefill_attention,
-                                    ragged_prefill_reference)
+                                    ragged_prefill_reference,
+                                    ragged_verify_attention,
+                                    ragged_verify_reference)
+from .draft import make_ngram_drafter
 from .outcomes import Outcome
 from .paged_kv import (NULL_PAGE, PageAllocator, PrefixIndex,
-                       init_kv_pools, write_prompt_kv, write_token_kv)
+                       init_kv_pools, write_block_kv, write_prompt_kv,
+                       write_token_kv)
 
 __all__ = ["Request", "InferenceEngine", "Outcome"]
+
+_NEG_BIG = -1e30
 
 
 @dataclasses.dataclass
@@ -87,18 +127,27 @@ class Request:
     ``deadline_s`` (seconds, relative to submit) bounds the request's
     total queue + serve time: past it the request is dropped from the
     queue or evicted mid-decode with outcome DEADLINE_EXPIRED (partial
-    tokens are kept). Every request submitted to the engine ends with
+    tokens are kept). ``seed`` pins the request's own sampling RNG
+    stream (temperature draws are then reproducible across engines,
+    occupancy mixes, chunking, and speculation depth); None lets the
+    engine assign one. Every request submitted to the engine ends with
     ``outcome`` set to exactly one terminal Outcome (serve/outcomes.py);
     ``detail`` carries the human-readable cause for the failure
-    outcomes and ``retry_after_s`` the backpressure hint on SHED."""
+    outcomes and ``retry_after_s`` the backpressure hint on SHED.
+    ``drafted_tokens``/``accepted_tokens`` count this request's
+    speculative drafting activity (accepted <= drafted; both 0 when
+    the engine does not speculate)."""
 
     prompt_ids: np.ndarray
     max_new_tokens: int = 32
     temperature: float = 0.0
     eos_id: int = -1
     deadline_s: Optional[float] = None
+    seed: Optional[int] = None
 
     # filled in by the engine
+    drafted_tokens: int = 0
+    accepted_tokens: int = 0
     token_ids: List[int] = dataclasses.field(default_factory=list)
     token_times: List[float] = dataclasses.field(default_factory=list)
     token_stamps: List[float] = dataclasses.field(default_factory=list)
@@ -129,8 +178,12 @@ class _Slot:
     t0: int                      # prompt length
     prefill_pos: int             # prompt tokens whose K/V is populated
     t_admit: float
+    key: np.ndarray = None       # (2,) uint32 per-request RNG key
     stall_count: int = 0         # consecutive zero-progress steps (the
                                  # watchdog's evidence; reset on progress)
+    spec_streak: int = 0         # consecutive FULLY-REJECTED draft
+                                 # windows (adaptive gating's evidence;
+                                 # reset on any acceptance)
 
     @property
     def prefilling(self) -> bool:
@@ -185,14 +238,40 @@ class InferenceEngine:
       deadline) — exceeded slots are evicted DEADLINE_EXPIRED;
     - ``stall_steps``: consecutive fully-idle scheduler polls (nothing
       decoding, queue head unadmittable) before the head request is
-      failed FAILED_UNSERVABLE instead of waiting forever."""
+      failed FAILED_UNSERVABLE instead of waiting forever.
+
+    Speculative decoding knobs (docs/SERVING.md):
+
+    - ``spec_k`` (default 0 = off): draft up to K candidate tokens per
+      slot per step and verify all K + 1 positions in the one jitted
+      decode program; greedy output stays bit-identical to the
+      non-speculative path, temperature output keeps its exact
+      distribution (rejection-sampled acceptance). A step accepts
+      1..K+1 tokens per slot — 1 (exactly today's decode) when the
+      drafts miss or none were found;
+    - ``draft_fn``: ``(history, k) -> int32[0..k]`` draft proposer;
+      default is n-gram/prompt-lookup drafting over the slot's own
+      prompt + emitted tokens (``serve.draft.ngram_propose``) with
+      max order ``draft_ngram``;
+    - ``spec_patience`` / ``spec_probe_every``: adaptive gating — a
+      slot whose last ``spec_patience`` draft windows were ALL fully
+      rejected stops drafting (0 disables gating); steps where no slot
+      drafted run the W=1 program, bitwise the non-speculative decode
+      step, so zero-agreement traffic converges to the plain-decode
+      floor. Gated slots probe again every ``spec_probe_every``-th
+      engine step (shared clock — one wide step per probe, however
+      many slots probe); newly admitted requests always draft
+      immediately (fresh slot state), so churny traffic re-tests
+      agreement without waiting for the clock."""
 
     def __init__(self, model, num_slots=8, page_size=16, max_len=None,
                  num_pages=None, dtype=None, mesh=None, interpret=None,
                  prefix_cache=True, chunk_pages=None, token_budget=None,
                  max_queue=None, max_queue_delay_s=None,
                  guard_nonfinite=True, watchdog_steps=1024,
-                 max_slot_wall_s=None, stall_steps=500):
+                 max_slot_wall_s=None, stall_steps=500,
+                 spec_k=0, draft_fn=None, draft_ngram=3,
+                 spec_patience=2, spec_probe_every=64):
         self.model = model
         self.num_slots = int(num_slots)
         self.page_size = int(page_size)
@@ -255,11 +334,32 @@ class InferenceEngine:
                                  for v in self._vpools)
         self._interpret = interpret
 
+        self.spec_k = int(spec_k)
+        if self.spec_k < 0:
+            raise MXNetError(f"spec_k must be >= 0, got {self.spec_k}")
+        if self.spec_k >= self.max_len:
+            raise MXNetError(f"spec_k {self.spec_k} >= max_len "
+                             f"{self.max_len}")
+        self._spec_w = self.spec_k + 1       # verify window (queries/slot)
+        self._draft_fn = draft_fn if draft_fn is not None \
+            else make_ngram_drafter(max_order=int(draft_ngram))
+        # adaptive draft gating: a slot whose last ``spec_patience``
+        # draft windows were FULLY rejected stops drafting (probing
+        # again on every ``spec_probe_every``-th decode step, all gated
+        # slots on the SAME step so probes cost one wide step, not
+        # many). Zero-draft steps then run the W=1 program — the
+        # zero-agreement floor is the non-speculative engine's own
+        # step, not a K+1-wide verify of hopeless drafts.
+        # spec_patience=0 disables gating (draft every step).
+        self.spec_patience = int(spec_patience)
+        self.spec_probe_every = max(1, int(spec_probe_every))
+
         # host-side occupancy state — DATA, never shapes
         S = self.num_slots
         self._page_table = np.zeros((S, self.max_pages), np.int32)
         self._lengths = np.zeros((S,), np.int32)
         self._temps = np.zeros((S,), np.float32)
+        self._slot_keys = np.zeros((S, 2), np.uint32)
         self._alloc = PageAllocator(self.num_pages)
         self._prefix = PrefixIndex(self.page_size) if prefix_cache \
             else None
@@ -278,7 +378,17 @@ class InferenceEngine:
         self.health: dict = {o.value: 0 for o in Outcome}
         self._ewma_service_s: Optional[float] = None
 
-        self.decode_trace_count = 0
+        # speculative-decoding observability (docs/SERVING.md): drafted
+        # vs accepted counts feed accept_rate; per-request twins live on
+        # Request.drafted_tokens / .accepted_tokens
+        self.drafted_tokens = 0
+        self.accepted_tokens = 0
+        self.spec_steps = 0                  # steps run K+1 wide
+        self.spec_gated_steps = 0            # steps adaptive gating
+                                             # suppressed all drafting
+
+        self.decode_trace_count = 0          # W=1 decode program traces
+        self.verify_trace_count = 0          # K+1-wide verify traces
         self.prefill_trace_count = 0         # dense + chunk, total
         self.prefill_trace_counts = {}       # ("dense"|"chunk", Tpad) -> n
         self.copy_trace_count = 0
@@ -300,18 +410,19 @@ class InferenceEngine:
     # traced programs
     # ------------------------------------------------------------- #
 
-    def _sample(self, logits, temps, key):
-        """Per-slot sampling: greedy where temp == 0, categorical
-        otherwise — both computed, selected per slot (shape-static)."""
-        keys = jax.random.split(key, logits.shape[0])
-
-        def one(lg, t, k):
-            greedy = jnp.argmax(lg, axis=-1)
-            samp = jax.random.categorical(
-                k, lg.astype(jnp.float32) / jnp.maximum(t, 1e-6), axis=-1)
-            return jnp.where(t > 0, samp, greedy)
-
-        return jax.vmap(one)(logits, temps, keys).astype(jnp.int32)
+    def _sample_one(self, logits, temp, pos_key):
+        """Greedy/temperature sample of ONE token from (V,) logits.
+        ``pos_key`` is the request's RNG key folded with the sampled
+        token's SEQUENCE POSITION (the engine-wide convention: the draw
+        for position p uses ``fold_in(fold_in(request_key, p), 0)``),
+        so whichever program computes it — dense prefill, chunk tail,
+        or a verify emission point — produces the identical draw."""
+        cat_key = jax.random.fold_in(pos_key, 0)
+        greedy = jnp.argmax(logits, axis=-1)
+        samp = jax.random.categorical(
+            cat_key, logits.astype(jnp.float32) / jnp.maximum(temp, 1e-6),
+            axis=-1)
+        return jnp.where(temp > 0, samp, greedy).astype(jnp.int32)
 
     def _bind_params(self, param_vals):
         """Context manager: point every model Parameter at the traced
@@ -340,72 +451,191 @@ class InferenceEngine:
         return ragged_paged_attention(q, kp, vp, page_table, lengths,
                                       interpret=self._interpret)
 
+    def _verify_attn(self, q, kp, vp, page_table, lengths, draft_len):
+        """Multi-query (speculative verify) decode attention: q is
+        (S, W, H, D), ``lengths`` counts keys visible to query row 0
+        (0 = dead slot), ``draft_len`` the slot's real draft count
+        bounding the kernel's V-select at the freshly-written extent.
+        The W = 1 narrow program routes through ``_ragged_attn`` — the
+        PR 2 single-query decode step, LITERALLY (on CPU the verify
+        reference's row 0 is the same ``_reference_core`` call, so
+        this changes nothing there; on TPU it keeps the specialized
+        decode kernel the narrow path's kernel). Under tp meshes the
+        jnp reference partitions cleanly, same as the single-query
+        path."""
+        if q.shape[1] == 1:
+            out = self._ragged_attn(q[:, 0], kp, vp, page_table,
+                                    lengths)
+            return out[:, None]
+        if self._mesh is not None:
+            return ragged_verify_reference(q, kp, vp, page_table,
+                                           lengths)
+        return ragged_verify_attention(q, kp, vp, page_table, lengths,
+                                       draft_len=draft_len,
+                                       interpret=self._interpret)
+
     def _prefill_attn(self, q, kp, vp, page_row, start, n_real):
         if self._mesh is not None:
-            return ragged_prefill_reference(q, kp, vp, page_row, start)
+            return ragged_prefill_reference(q, kp, vp, page_row, start,
+                                            n_real=n_real)
         return ragged_prefill_attention(q, kp, vp, page_row, start,
                                         n_real=n_real,
                                         interpret=self._interpret)
 
+    def _accept_emit(self, logits, tokens, draft_len, temps, slot_keys,
+                     pos, act):
+        """On-device draft acceptance — the speculative-decoding core.
+
+        ``logits`` (S, W, V) scores token positions ``pos + 1``;
+        ``tokens[:, 0]`` is the last accepted token, ``tokens[:, 1:]``
+        the draft candidates (column j+1 proposed for position
+        ``pos[:, j] + 1``). Greedy slots accept the longest prefix of
+        drafts matching the argmax chain — BIT-IDENTICAL to running
+        that many sequential decode steps, since an accepted draft IS
+        the argmax its predecessor produced. Temperature slots use
+        rejection sampling against the deterministic draft proposal
+        (q = point mass): draft d at position p is accepted with
+        probability softmax(logits/T)[d]; on rejection the emission is
+        sampled from the residual (softmax with d's mass removed) — so
+        the emitted distribution is exactly the non-speculative one.
+        Every RNG draw is keyed by ``fold_in(request_key, position)``
+        (categorical: sub-fold 0, acceptance uniform: sub-fold 1) —
+        reproducible per request, independent of occupancy and K.
+
+        Returns ``(emitted (S, W) int32, n_emit (S,) int32)``: columns
+        ``[0, n_emit)`` of ``emitted`` are real tokens (accepted drafts
+        then the correction/bonus sample), later columns are dead."""
+        S, W = tokens.shape
+        V = logits.shape[-1]
+        jj = lax.broadcasted_iota(jnp.int32, (S, W), 1)
+        jpos = pos + 1                   # position of column j's token
+        pos_keys = jax.vmap(
+            lambda key, row: jax.vmap(
+                lambda p: jax.random.fold_in(key, p))(row)
+        )(slot_keys, jpos)                               # (S, W, 2)
+        cat_keys = jax.vmap(jax.vmap(
+            lambda k: jax.random.fold_in(k, 0)))(pos_keys)
+        acc_keys = jax.vmap(jax.vmap(
+            lambda k: jax.random.fold_in(k, 1)))(pos_keys)
+        u = jax.vmap(jax.vmap(jax.random.uniform))(acc_keys)   # (S, W)
+
+        greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        scaled = logits.astype(jnp.float32) / \
+            jnp.maximum(temps, 1e-6)[:, None, None]
+        logp = jax.nn.log_softmax(scaled, axis=-1)       # (S, W, V)
+        # column j tests/replaces the token at position jpos[:, j] —
+        # the draft in tokens column j + 1 (the wrapped last column is
+        # never valid: draft_len <= W - 1)
+        d_next = jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)
+        p_next = jnp.take_along_axis(logp, d_next[..., None],
+                                     axis=-1)[..., 0]    # log p_j(d)
+        accept = jnp.where((temps > 0)[:, None],
+                           jnp.log(u) < p_next,          # P[accept]=p(d)
+                           d_next == greedy_tok)
+        valid = jj < draft_len[:, None]
+        chain = jnp.cumprod((accept & valid).astype(jnp.int32), axis=1)
+        n_acc = jnp.sum(chain, axis=1).astype(jnp.int32)
+        # residual for a REJECTED draft at column j: q was a point mass
+        # at d, so max(p - q, 0) is p with d's mass removed — mask d's
+        # logit out and renormalize via the categorical itself. Columns
+        # with no draft (j >= draft_len) sample plain p — the bonus
+        # token when every draft was accepted.
+        res_logits = scaled + jax.nn.one_hot(
+            d_next, V, dtype=jnp.float32) * \
+            jnp.where(valid, _NEG_BIG, 0.0)[..., None]
+        samp = jax.vmap(jax.vmap(jax.random.categorical))(
+            cat_keys, res_logits).astype(jnp.int32)
+        final = jnp.where((temps > 0)[:, None], samp, greedy_tok)
+        emitted = jnp.where(jj < n_acc[:, None], d_next, final)
+        n_emit = jnp.where(act, n_acc + 1, 0).astype(jnp.int32)
+        return emitted, n_emit
+
     def _decode_step_fn(self, param_vals, kpools, vpools, tokens,
-                        page_table, lengths, temps, key):
-        """ONE decode token for every slot. All array shapes are fixed
-        by (num_slots, max_pages, model) — occupancy AND weights are
-        data."""
-        self.decode_trace_count += 1         # trace-time only
+                        draft_len, page_table, lengths, temps,
+                        slot_keys):
+        """ONE decode/verify step for every slot: W token positions per
+        slot — the last accepted token plus up to W - 1 draft
+        candidates — embedded, written into the tail pages, and scored
+        in this single program call. W is taken from ``tokens``'
+        (S, W) shape, so the SAME function yields the engine's two
+        decode-family programs: the W=1 decode step (bitwise the PR 2
+        single-token step — it runs whenever no slot drafted, so the
+        zero-agreement floor pays no verify width) and the
+        W = spec_k + 1 verify step. Each traces exactly once
+        (``decode_trace_count`` / ``verify_trace_count``); within a
+        width, occupancy, drafts, acceptance, sampling keys AND
+        weights are data."""
+        if tokens.shape[1] == 1:             # trace-time only
+            self.decode_trace_count += 1
+        else:
+            self.verify_trace_count += 1
         from ..gluon.block import _hybrid_trace_scope
         from .. import autograd
-        from ..models.gpt import _mlp, _qkv_heads
+        from ..models.gpt import _lm_head, _mlp, _qkv_heads
 
         model = self.model
         S, ps = self.num_slots, self.page_size
+        W = tokens.shape[1]
         act = lengths > 0
-        pos = lengths                        # the new token's position
-        eff_len = jnp.where(act, lengths + 1, 0)
-        write_page = page_table[jnp.arange(S), pos // ps]   # NULL if dead
+        jj = lax.broadcasted_iota(jnp.int32, (S, W), 1)
+        pos = lengths[:, None] + jj          # column j's token position
+        used = jj <= draft_len[:, None]      # real token columns
+        # K/V writes: real columns land at their position's page (the
+        # host pre-mapped the whole draft window); padded columns and
+        # dead slots write to the null page, harmless and never read
+        # unmasked
+        page_idx = jnp.clip(pos // ps, 0, self.max_pages - 1)
+        write_page = jnp.where(act[:, None] & used,
+                               jnp.take_along_axis(page_table, page_idx,
+                                                   axis=1),
+                               NULL_PAGE)
         write_off = pos % ps
+        # padded columns of a nearly-finished slot can index past the
+        # table — clamp for the (masked, discarded) embedding lookup
+        emb_pos = jnp.minimum(pos, model.max_length - 1)
+        eff_len = jnp.where(act, lengths + 1, 0)
 
         with self._bind_params(param_vals), _hybrid_trace_scope(), \
                 autograd._ModeScope(recording=False, training=False):
-            x = model.word_embed(NDArray(tokens[:, None])) + \
-                model.position_embed(NDArray(pos[:, None]))
+            x = model.word_embed(NDArray(tokens)) + \
+                model.position_embed(NDArray(emb_pos))
             if model._dtype != "float32":
                 x = x.astype(model._dtype)
             new_k, new_v = [], []
             for i in range(model.num_layers):
                 blk = getattr(model, f"block{i}")
-                q, k, v = _qkv_heads(blk.attn, blk.ln1(x))  # (S,1,H,D)
-                kp = write_token_kv(kpools[i], k[:, 0], write_page,
-                                    write_off)
-                vp = write_token_kv(vpools[i], v[:, 0], write_page,
-                                    write_off)
+                q, k, v = _qkv_heads(blk.attn, blk.ln1(x))  # (S,W,H,D)
+                kp = write_block_kv(kpools[i], k, write_page, write_off)
+                vp = write_block_kv(vpools[i], v, write_page, write_off)
                 new_k.append(kp)
                 new_v.append(vp)
-                out = self._ragged_attn(q[:, 0].astype(kp.dtype), kp, vp,
-                                        page_table, eff_len)
+                out = self._verify_attn(q.astype(kp.dtype), kp, vp,
+                                        page_table, eff_len, draft_len)
                 out = NDArray(out.astype(q.dtype).reshape(
-                    S, 1, model._units))
+                    S, W, model._units))
                 x = x + blk.attn.proj(out)
                 x = x + _mlp(blk, x)
-            # cast BEFORE the final norm — token parity with
-            # decode_forward / the training path (see models/gpt.py)
-            x = model.ln_f(x.astype("float32"))
-            embed_w = model.word_embed.weight.data()
-            logits = x._op("dot", embed_w, transpose_b=True)._data[:, 0]
-        nxt = self._sample(logits, temps, key)
-        new_lengths = jnp.where(act, lengths + 1, 0)
-        # per-slot non-finite guard: one (S, vocab)→(S,) reduction,
-        # SIGN-ENCODED into the sampled tokens (token t on a poisoned
-        # slot reads -t - 1) — pure data riding the existing token
-        # transfer, so the jit-once contract is untouched (asserted), a
-        # poisoned slot is visible the step it poisons, and the guard
-        # adds no program output and no extra host sync (its measured
-        # cost as a separate output was ~4% tokens/s on the CPU
-        # dispatch floor; see BENCH_SERVE.json guard_overhead)
+            # shared head: f32 cast BEFORE ln_f + tied vocab projection
+            # (models/gpt.py::_lm_head — token parity with
+            # decode_forward / the training path)
+            logits = _lm_head(model, x)._data        # (S, W, V)
+        emitted, n_emit = self._accept_emit(logits, tokens, draft_len,
+                                            temps, slot_keys, pos, act)
+        new_lengths = jnp.where(act, lengths + n_emit, 0)
+        # per-slot non-finite guard: one logits reduction over the USED
+        # verify columns (later columns may legitimately read stale
+        # draft K/V — their logits are dead data), SIGN-ENCODED into
+        # the emitted tokens (column 0 reads -t - 1 on a poisoned slot)
+        # — pure data riding the existing token transfer, so the
+        # jit-once contract is untouched (asserted), a poisoned slot is
+        # visible the step it poisons, and NOTHING from a poisoned
+        # verify step is ever recorded (accepted drafts included; see
+        # step()). Cost banked in BENCH_SERVE.json guard_overhead.
         if self.guard_nonfinite:
-            bad = jnp.any(~jnp.isfinite(logits), axis=-1) & act
-            nxt = jnp.where(bad, -nxt - 1, nxt)
-        return tuple(new_k), tuple(new_v), nxt, new_lengths
+            bad = jnp.any(jnp.any(~jnp.isfinite(logits), axis=-1) &
+                          used, axis=-1) & act
+            emitted = jnp.where(bad[:, None], -emitted - 1, emitted)
+        return tuple(new_k), tuple(new_v), emitted, n_emit, new_lengths
 
     def _prefill_fn(self, param_vals, kpools, vpools, ids, t0, pages,
                     temp, key):
@@ -447,10 +677,12 @@ class InferenceEngine:
                 x = x + _mlp(blk, x)
             last = lax.dynamic_slice(
                 x._data, (0, t0 - 1, 0), (1, 1, model._units))
-            x = model.ln_f(NDArray(last).astype("float32"))
-            embed_w = model.word_embed.weight.data()
-            logits = x._op("dot", embed_w, transpose_b=True)._data[:, 0]
-        tok = self._sample(logits, temp[None], key)[0]
+            from ..models.gpt import _lm_head
+            logits = _lm_head(model, NDArray(last))._data[:, 0]
+        # the first generated token occupies position t0: its draw is
+        # keyed by fold_in(request_key, t0), the engine-wide convention
+        tok = self._sample_one(logits[0], temp,
+                               jax.random.fold_in(key, t0))
         if self.guard_nonfinite:             # sign-encoded, see decode
             tok = jnp.where(jnp.any(~jnp.isfinite(logits)),
                             -tok - 1, tok)
@@ -507,10 +739,13 @@ class InferenceEngine:
                 x = x + _mlp(blk, x)
             last = lax.dynamic_slice(
                 x._data, (0, n_real - 1, 0), (1, 1, model._units))
-            x = model.ln_f(NDArray(last).astype("float32"))
-            embed_w = model.word_embed.weight.data()
-            logits = x._op("dot", embed_w, transpose_b=True)._data[:, 0]
-        tok = self._sample(logits, temp[None], key)[0]
+            from ..models.gpt import _lm_head
+            logits = _lm_head(model, NDArray(last))._data[:, 0]
+        # on the FINAL chunk start + n_real == t0, so the draw key
+        # matches the dense prefill's exactly — chunked vs monolithic
+        # prefill emit the identical first token even at temperature
+        tok = self._sample_one(logits[0], temp,
+                               jax.random.fold_in(key, start + n_real))
         if self.guard_nonfinite:             # sign-encoded, see decode
             tok = jnp.where(jnp.any(~jnp.isfinite(logits)),
                             -tok - 1, tok)
@@ -650,6 +885,13 @@ class InferenceEngine:
         self._key, sub = jax.random.split(self._key)
         return sub
 
+    @property
+    def accept_rate(self) -> float:
+        """Fraction of drafted tokens the verify step accepted (0.0
+        when the engine never drafted)."""
+        return self.accepted_tokens / self.drafted_tokens \
+            if self.drafted_tokens else 0.0
+
     def _finish_token(self, slot_idx: int, token: int,
                       dt: float) -> Optional[Outcome]:
         """Record one generated token; returns the success outcome when
@@ -672,6 +914,7 @@ class InferenceEngine:
         self._page_table[slot_idx, :] = NULL_PAGE  # survive via sharers
         self._lengths[slot_idx] = 0
         self._temps[slot_idx] = 0.0
+        self._slot_keys[slot_idx] = 0
         self._slots[slot_idx] = None
         if outcome.ok:
             self._observe_service(slot.t_admit)
@@ -787,11 +1030,17 @@ class InferenceEngine:
             row = np.zeros((self.max_pages,), np.int32)
             row[:len(shared)] = shared
             row[len(shared):prompt_pages] = priv
+            # per-request RNG key: pinned by Request.seed (reproducible
+            # across engines/occupancy), engine-split otherwise
+            skey = np.asarray(jax.random.PRNGKey(int(req.seed))
+                              if req.seed is not None
+                              else self._next_key(), np.uint32)
             slot = _Slot(req, reserved_pages=need,
                          refs=list(shared) + priv, row=row, t0=t0,
                          prefill_pos=cached_len,
-                         t_admit=time.perf_counter())
+                         t_admit=time.perf_counter(), key=skey)
             self._slots[slot_idx] = slot
+            self._slot_keys[slot_idx] = skey
             # decode-invisible until prefill completes: the decode step
             # must neither attend a half-built prompt nor scatter its
             # (dead-slot) write into a mapped — possibly SHARED — page
@@ -836,7 +1085,7 @@ class InferenceEngine:
         self._kpools, self._vpools, tok = fn(
             self._param_vals, self._kpools, self._vpools, ids,
             np.int32(t0), pages_arr,
-            np.float32(req.temperature), self._next_key())
+            np.float32(req.temperature), slot.key)
         slot.prefill_pos = t0
         tok = int(np.asarray(tok))
         if tok < 0:                          # sign-encoded guard flag
@@ -868,7 +1117,7 @@ class InferenceEngine:
         self._kpools, self._vpools, tok = fn(
             self._param_vals, self._kpools, self._vpools, ids,
             np.int32(start), np.int32(n), slot.row.copy(),
-            np.float32(req.temperature), self._next_key())
+            np.float32(req.temperature), slot.key)
         slot.prefill_pos = start + n
         tok = int(np.asarray(tok))
         if tok < 0:                          # sign-encoded guard flag
@@ -930,11 +1179,69 @@ class InferenceEngine:
                                            spent)
         return spent
 
-    def _ensure_tail_pages(self) -> List[int]:
-        """Lazily allocate the page the NEXT write position needs —
+    def _propose_drafts(self) -> dict:
+        """Host-side drafting (pure data): up to ``spec_k`` candidate
+        tokens per decode-ready slot from its OWN prompt + emitted
+        history, capped at ``max_new_tokens - emitted - 1`` so the
+        accepted output can never exceed the request's token budget —
+        which also keeps every write of the draft window inside the
+        admission-time worst-case page reservation. Out-of-vocab
+        proposals from a custom ``draft_fn`` are truncated at the first
+        invalid token rather than fed to the embedding.
+
+        Adaptive gating: a slot whose last ``spec_patience`` draft
+        windows were ALL fully rejected is skipped (its drafts are
+        hopeless — randomish text the n-gram drafter cannot predict),
+        probing again on every ``spec_probe_every``-th engine decode
+        step. All gated slots share the probe clock, so a probe costs
+        ONE wide step. Returns ``(drafts, gated)`` where ``gated``
+        records whether gating suppressed at least one slot — when it
+        suppressed them ALL, step() runs the W=1 program and the
+        zero-agreement workload pays the plain decode price."""
+        drafts: dict = {}
+        gated = False
+        if self.spec_k == 0:
+            return drafts, gated
+        vocab = self.model.vocab_size
+        probe = self.spec_patience == 0 or \
+            self.decode_steps % self.spec_probe_every == 0
+        for s in range(self.num_slots):
+            slot = self._slots[s]
+            if slot is None or slot.prefilling:
+                continue
+            req = slot.request
+            kmax = min(self.spec_k,
+                       req.max_new_tokens - len(req.token_ids) - 1)
+            if kmax <= 0:
+                continue
+            if not probe and slot.spec_streak >= self.spec_patience > 0:
+                gated = True
+                continue
+            hist = np.concatenate([req.prompt_ids,
+                                   np.asarray(req.token_ids, np.int32)])
+            d = np.asarray(self._draft_fn(hist, kmax),
+                           np.int32).reshape(-1)[:kmax]
+            oob = np.nonzero((d < 0) | (d >= vocab))[0]
+            if oob.size:
+                d = d[:oob[0]]
+            if d.size:
+                drafts[s] = d
+        return drafts, gated
+
+    def _ensure_tail_pages(self, drafts=None) -> List[int]:
+        """Lazily allocate the pages the NEXT write positions need —
         this is where cache memory tracks live tokens. Prefilling slots
         are skipped: they are decode-invisible and their pages are
         already mapped.
+
+        With speculation, a slot drafting d tokens writes positions
+        ``[L, L + d]`` this step, so every page covering that WINDOW
+        must be mapped up front. The FIRST page (position L) keeps the
+        watchdog/stall semantics — without it the slot cannot advance
+        at all; failing to map a LATER window page merely TRUNCATES the
+        slot's drafts in ``drafts`` (speculation is best-effort: under
+        page pressure it degrades to fewer — or zero — drafts, never
+        to a stall the non-speculative engine would not have had).
 
         A slot whose tail page cannot be allocated (pool starved even
         after reclaiming prefix-index retention) is STALLED, not
@@ -942,62 +1249,106 @@ class InferenceEngine:
         length 0 with a NULL page row so its dead write cannot touch a
         real — possibly shared — page) and the watchdog evicts it
         FAILED_UNSERVABLE after ``watchdog_steps`` of zero progress."""
+        drafts = {} if drafts is None else drafts
+        ps = self.page_size
         stalled: List[int] = []
         for s in range(self.num_slots):
             slot = self._slots[s]
             if slot is None or slot.prefilling:
                 continue
-            pi = int(self._lengths[s]) // self.page_size
-            if self._page_table[s, pi] == NULL_PAGE:
-                if self._alloc.free_count == 0 and self._prefix is not None:
+            L = int(self._lengths[s])
+            d = drafts.get(s)
+            dlen = 0 if d is None else int(d.size)
+            first_pi = L // ps
+            mapped_through = first_pi - 1
+            starved = False
+            for pi in range(first_pi, (L + dlen) // ps + 1):
+                if self._page_table[s, pi] != NULL_PAGE:
+                    mapped_through = pi
+                    continue
+                if self._alloc.free_count == 0 and \
+                        self._prefix is not None:
                     self.prefix_reclaimed_pages += \
                         self._prefix.reclaim(1, self._alloc)
                 if self._alloc.free_count == 0:
-                    slot.stall_count += 1
-                    if slot.stall_count > self.watchdog_steps:
-                        self._evict(s, Outcome.FAILED_UNSERVABLE,
-                                    f"watchdog: tail page starved for "
-                                    f"{slot.stall_count} steps")
-                    else:
-                        stalled.append(s)
-                    continue
+                    if pi == first_pi:
+                        slot.stall_count += 1
+                        if slot.stall_count > self.watchdog_steps:
+                            self._evict(
+                                s, Outcome.FAILED_UNSERVABLE,
+                                f"watchdog: tail page starved for "
+                                f"{slot.stall_count} steps")
+                        else:
+                            stalled.append(s)
+                        starved = True
+                    break
                 page = self._alloc.alloc()
                 self._page_table[s, pi] = page
                 slot.row[pi] = page
                 slot.refs.append(page)
+                mapped_through = pi
+            if starved:
+                drafts.pop(s, None)
+                continue
             slot.stall_count = 0
+            if dlen:                         # clip drafts to the window
+                cap = (mapped_through + 1) * ps - 1 - L
+                if cap < dlen:
+                    if cap <= 0:
+                        drafts.pop(s, None)
+                    else:
+                        drafts[s] = d[:cap]
         return stalled
 
     def step(self) -> int:
         """Enforce deadlines, admit, advance chunked prefill under the
-        token budget, then run ONE decode step for all decode-ready
-        slots. Returns the number of live slots that advanced a decode
-        token."""
+        token budget, then run ONE decode/verify step for all
+        decode-ready slots: each live slot advances 1..spec_k+1 tokens
+        (exactly 1 when speculation is off, found no draft, or every
+        draft missed). Returns the number of live slots that advanced."""
         self._expire_queue()
         self._expire_slots()
         self._admit()
         if self.chunk_pages is not None:
             self._advance_prefill()
-        stalled = self._ensure_tail_pages()
+        drafts, gated = self._propose_drafts()
+        stalled = self._ensure_tail_pages(drafts)
         live = [s for s in range(self.num_slots)
                 if self._slots[s] is not None
                 and not self._slots[s].prefilling and s not in stalled]
         if not live:
             return 0
-        tokens = np.zeros((self.num_slots,), np.int32)
+        # adaptive width routing: a step where NO slot drafted runs the
+        # W=1 program — bitwise the non-speculative decode step — so
+        # gated/zero-draft workloads pay no verify width. Either width
+        # traces exactly once (shape-keyed jit cache).
+        W = self._spec_w if drafts else 1
+        if W > 1:
+            self.spec_steps += 1
+        elif gated:
+            self.spec_gated_steps += 1
+        tokens = np.zeros((self.num_slots, W), np.int32)
+        draft_len = np.zeros((self.num_slots,), np.int32)
         for s in live:
-            tokens[s] = self._slots[s].request.token_ids[-1]
+            tokens[s, 0] = self._slots[s].request.token_ids[-1]
+            d = drafts.get(s)
+            if d is not None and d.size:
+                tokens[s, 1:1 + d.size] = d
+                draft_len[s] = d.size
         lengths_dev = self._lengths.copy()
         table_dev = self._page_table.copy()
         for s in stalled:                    # decode-invisible this step
             lengths_dev[s] = 0
             table_dev[s, :] = NULL_PAGE
         t_start = time.perf_counter()
-        self._kpools, self._vpools, nxt, lengths = self._decode_step(
-            self._param_vals, self._kpools, self._vpools, tokens,
-            table_dev, lengths_dev, self._temps.copy(), self._next_key())
-        nxt = np.asarray(nxt)                # host sync point
-        bad = nxt < 0                        # sign-encoded guard flag
+        self._kpools, self._vpools, emitted, n_emit, lengths = \
+            self._decode_step(self._param_vals, self._kpools,
+                              self._vpools, tokens, draft_len,
+                              table_dev, lengths_dev,
+                              self._temps.copy(),
+                              self._slot_keys.copy())
+        emitted = np.asarray(emitted)        # host sync point
+        n_emit = np.asarray(n_emit)
         new_lengths = np.asarray(lengths).copy()
         for s in stalled:                    # their true length is kept
             new_lengths[s] = self._lengths[s]
@@ -1005,12 +1356,41 @@ class InferenceEngine:
         dt = time.perf_counter() - t_start
         self.decode_steps += 1
         for s in live:
-            if bad[s]:
+            if emitted[s, 0] < 0:            # sign-encoded guard flag
+                # poisoned verify: NOTHING from this step is recorded —
+                # accepted drafts included (they were scored by
+                # non-finite math)
                 self._quarantine(s, "non-finite logits in decode")
                 continue
-            done = self._finish_token(s, nxt[s], dt)
-            if done is not None:
-                self._evict(s, done)
+            slot = self._slots[s]
+            req = slot.request
+            d = int(draft_len[s])
+            n = int(n_emit[s])
+            if d:
+                self.drafted_tokens += d
+                req.drafted_tokens += d
+                # adaptive gating signal: a fully-rejected window grows
+                # the streak; ANY acceptance resets it
+                slot.spec_streak = 0 if n > 1 else slot.spec_streak + 1
+            per_tok = dt / max(n, 1)
+            recorded = 0
+            for i in range(n):
+                done = self._finish_token(s, int(emitted[s, i]), per_tok)
+                recorded += 1
+                if done is not None:
+                    # EOS inside the accepted window: later accepted
+                    # tokens are discarded — sequential decode would
+                    # never have generated them
+                    self._evict(s, done)
+                    break
+            if d:
+                # count only accepted drafts actually RECORDED —
+                # columns [0, n - 1) are drafts, n - 1 the
+                # bonus/correction, and an in-window EOS discards the
+                # tail, which must not inflate accept_rate
+                kept = min(recorded, n - 1)
+                self.accepted_tokens += kept
+                req.accepted_tokens += kept
         return len(live)
 
     # ------------------------------------------------------------- #

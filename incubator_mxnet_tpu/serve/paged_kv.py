@@ -47,7 +47,7 @@ from ..base import MXNetError
 NULL_PAGE = 0
 
 __all__ = ["NULL_PAGE", "PageAllocator", "PrefixIndex", "init_kv_pools",
-           "write_token_kv", "write_prompt_kv"]
+           "write_token_kv", "write_prompt_kv", "write_block_kv"]
 
 
 class PageAllocator:
@@ -352,6 +352,23 @@ def write_token_kv(pool, new, pages, offsets):
     H = pool.shape[1]
     return pool.at[pages[:, None], jnp.arange(H)[None, :],
                    offsets[:, None], :].set(new.astype(pool.dtype))
+
+
+def write_block_kv(pool, new, pages, offsets):
+    """Scatter a (S, W) BLOCK of K (or V) rows into the pool — the
+    speculative verify step's write: W consecutive positions per slot
+    (the last accepted token plus up to W-1 draft candidates).
+
+    pool: (P, H, ps, D); new: (S, W, H, D); pages/offsets: (S, W)
+    int32 — entry (s, w) writes ``new[s, w]`` to
+    ``pool[pages[s, w], :, offsets[s, w], :]``. Dead entries (inactive
+    slots, positions past a slot's real draft window) carry
+    ``pages == NULL_PAGE`` and land harmlessly in the null page, same
+    contract as ``write_token_kv`` (which this flattens into). Static
+    shapes; safe under jit."""
+    S, W, H, D = new.shape
+    return write_token_kv(pool, new.reshape(S * W, H, D),
+                          pages.reshape(S * W), offsets.reshape(S * W))
 
 
 def write_prompt_kv(pool, kv, pages):

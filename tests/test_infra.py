@@ -184,6 +184,9 @@ def test_mx_np_random_surface():
     assert (a1 == a2).all()
 
 
+@pytest.mark.slow   # 8s (round-11 tier-1 budget repair); optimizer
+                    # tier-1 coverage stays via test_fused_step;
+                    # ci stage_unit runs it
 def test_round5_optimizer_and_initializer_fills():
     """Adamax/Nadam/DCASGD/SGLD converge (SGLD stays finite — it's a
     sampler); Mixed/InitDesc/Load initializers behave per reference."""

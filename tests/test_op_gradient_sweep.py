@@ -721,7 +721,12 @@ def test_registry_fully_classified():
                 assert not dup, f"{sorted(dup)} in both {a} and {b}"
 
 
-@pytest.mark.parametrize("name", sorted(DIST_CHECK))
+@pytest.mark.parametrize("name", [
+    # random_gamma's moment check costs 9 s (round-11 tier-1 budget
+    # repair) — stage_unit still runs it
+    pytest.param(n, marks=pytest.mark.slow) if n == "random_gamma"
+    else n
+    for n in sorted(DIST_CHECK)])
 def test_sampler_distribution(name):
     """Moment check under a fixed seed: sample mean/variance within 5
     standard errors of the analytic moments (so the check is sharp but
@@ -751,7 +756,9 @@ _SLOW_GRAD = {"RNN", "DeformableConvolution",
               "ModulatedDeformableConvolution",
               # 12s on the tier-1 budget box (round-10 --durations
               # profile); ci stage_unit still runs it
-              "CTCLoss"}
+              "CTCLoss",
+              # 11s (round-11 profile); stage_unit still runs it
+              "ROIAlign"}
 
 
 @pytest.mark.parametrize("name", [

@@ -263,6 +263,8 @@ def test_sharded_embedding_vocab_split_matches_replicated():
                                atol=1e-6)
 
 
+@pytest.mark.slow   # 8s (round-11 tier-1 budget repair); ci stage_unit
+                    # runs it
 def test_pipeline_apply_matches_sequential():
     """GPipe over pp=4: pipelined forward equals sequential stage
     application, and gradients flow through the ppermute schedule."""
@@ -518,6 +520,9 @@ def test_ring_attention_training_composes_with_dp():
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow   # 13s (round-11 tier-1 budget repair); sp-ring
+                    # tier-1 coverage stays via
+                    # test_ring_attention_grad_flows; stage_unit runs it
 def test_gpt_seq_parallel_training_matches_dense():
     """Flagship long-context integration: a GPT trained through
     SPMDTrainer on a dp2 x sp4 mesh with seq_parallel=True (attention

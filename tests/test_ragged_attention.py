@@ -13,9 +13,10 @@ import jax
 import jax.numpy as jnp
 
 from incubator_mxnet_tpu.ops.ragged_attention import (
-    _ragged_pallas, _ragged_prefill_pallas, ragged_attention_reference,
-    ragged_paged_attention, ragged_prefill_attention,
-    ragged_prefill_reference)
+    _ragged_pallas, _ragged_prefill_pallas, _ragged_verify_pallas,
+    ragged_attention_reference, ragged_paged_attention,
+    ragged_prefill_attention, ragged_prefill_reference,
+    ragged_verify_attention, ragged_verify_reference)
 
 
 def _make_case(rng, S, H, D, page_size, max_pages, lengths,
@@ -287,6 +288,55 @@ def test_prefill_padded_rows_do_not_affect_real_rows():
                                rtol=2e-5, atol=2e-5)
 
 
+def test_partial_chunk_unwritten_tail_nan_does_not_poison_live_rows():
+    """Regression (chaos corrupt_page under speculation): a PARTIAL
+    final chunk (n_real < Cpad) attends a page whose offsets past the
+    chunk's written extent still hold a previous owner's NON-FINITE
+    K/V — a quarantined slot's pages are freed mid-poison and recycled
+    (speculation widens the poison: the verify step writes NaN K/V
+    into the whole draft window before quarantine). Masked 0-weight
+    terms must SELECT those positions out of V (0 * NaN = NaN
+    otherwise) bounded at q_start + n_real — NOT q_start + Cpad, which
+    left the unwritten gap [q_start + n_real, q_start + Cpad) leaking
+    NaN into every live row. Both implementations."""
+    rng = np.random.RandomState(21)
+    H, D, ps = 2, 8, 8
+    T, n_real, Cpad = 19, 3, 8               # chunk [16, 19) padded to 8
+    q_start = 16
+    pages = [4, 1, 8]
+    # the slot's row carries its WORST-CASE reservation: a 4th page is
+    # mapped but entirely unwritten (positions 24..31)
+    row = np.zeros((4,), np.int32)
+    row[:3] = pages
+    row[3] = 9
+    kp, vp, _, _ = _make_prefill_case(rng, H, D, ps, T, pages,
+                                      num_pages=12)
+    q = rng.randn(Cpad, H, D).astype(np.float32)
+    clean = np.asarray(ragged_prefill_reference(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(row), np.int32(q_start), n_real=np.int32(n_real)))
+    # poison the unwritten tail of the chunk's own page AND the whole
+    # reserved (recycled) next page — positions >= q_start + n_real = 19
+    kp2, vp2 = kp.copy(), vp.copy()
+    pg, off = pages[T // ps], T % ps
+    kp2[pg, :, off:], vp2[pg, :, off:] = np.nan, np.nan
+    kp2[9], vp2[9] = np.nan, np.nan
+    dirty = np.asarray(ragged_prefill_reference(
+        jnp.asarray(q), jnp.asarray(kp2), jnp.asarray(vp2),
+        jnp.asarray(row), np.int32(q_start), n_real=np.int32(n_real)))
+    assert np.isfinite(dirty[:n_real]).all(), \
+        "unwritten-tail NaN leaked into live chunk rows (reference)"
+    np.testing.assert_array_equal(dirty[:n_real], clean[:n_real])
+    pal = np.asarray(_ragged_prefill_pallas(
+        jnp.asarray(q), jnp.asarray(kp2), jnp.asarray(vp2),
+        jnp.asarray(row), jnp.asarray([q_start, n_real], jnp.int32),
+        D ** -0.5, True))
+    assert np.isfinite(pal[:n_real]).all(), \
+        "unwritten-tail NaN leaked into live chunk rows (kernel)"
+    np.testing.assert_allclose(pal[:n_real], clean[:n_real],
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_prefill_null_page_contents_never_leak():
     """Dead page-row entries (and padded-token scatter targets) point at
     page 0 — repoisoning it must not change any real output row."""
@@ -336,6 +386,279 @@ def test_prefill_dispatcher_and_dtype():
         jnp.asarray(vp, jnp.bfloat16), jnp.asarray(row), np.int32(0))
     assert b16.dtype == jnp.bfloat16
     np.testing.assert_allclose(np.asarray(b16, np.float32), ref,
+                               rtol=0.06, atol=0.06)
+
+
+# --------------------------------------------------------------------- #
+# multi-query verify over a paged prefix (the speculative-decoding
+# draft-then-verify variant)
+# --------------------------------------------------------------------- #
+
+def _make_verify_case(rng, H, D, ps, L, W, pages, num_pages=16,
+                      dtype=np.float32):
+    """One slot's paged K/V populated through the L + W - 1 positions a
+    verify window over ``lengths = L`` may read (row r sees keys
+    [0, L - 1 + r]); the null page is poisoned — its contents must
+    never matter. Returns the pool plus the dense per-position rows for
+    the numpy oracle."""
+    T = L + W - 1
+    kp = np.zeros((num_pages, H, ps, D), dtype)
+    vp = np.zeros((num_pages, H, ps, D), dtype)
+    tok_k = rng.randn(T, H, D).astype(dtype)
+    tok_v = rng.randn(T, H, D).astype(dtype)
+    for t in range(T):
+        kp[pages[t // ps], :, t % ps, :] = tok_k[t]
+        vp[pages[t // ps], :, t % ps, :] = tok_v[t]
+    kp[0] = 1e9
+    vp[0] = -1e9
+    return kp, vp, tok_k, tok_v
+
+
+def _verify_oracle(q, tok_k, tok_v, L):
+    """Dense causal oracle for ONE slot's verify window: row r softmaxes
+    over keys [0, L + r) — plain numpy, independent of every jnp code
+    path."""
+    W, H, D = q.shape
+    out = np.zeros((W, H, D), np.float32)
+    for r in range(W):
+        n = L + r
+        for h in range(H):
+            s = tok_k[:n, h].astype(np.float32) @ \
+                q[r, h].astype(np.float32) * (D ** -0.5)
+            p = np.exp(s - s.max())
+            p /= p.sum()
+            out[r, h] = p @ tok_v[:n, h].astype(np.float32)
+    return out
+
+
+@pytest.mark.parametrize("L,W", [
+    (1, 4),        # fresh slot: row 0 sees only the just-written token
+    (8, 3),        # row 0 at a page boundary, window spills into page 2
+    (13, 4),       # mid-page window crossing into the next page
+    (6, 1),        # W=1: plain decode
+])
+@pytest.mark.parametrize("impl", ["pallas_interpret", "jnp"])
+def test_verify_matches_dense_causal_oracle(L, W, impl):
+    """Each verify row r (absolute position L - 1 + r) must match the
+    dense causal softmax over its visible prefix — kernel (interpret
+    mode) and jnp reference alike, over a shuffled page table."""
+    rng = np.random.RandomState(20)
+    H, D, ps = 3, 16, 8
+    pages = [5, 2, 7][:-(-(L + W - 1) // ps)]
+    pt = np.zeros((1, 4), np.int32)
+    pt[0, :len(pages)] = pages
+    kp, vp, tok_k, tok_v = _make_verify_case(rng, H, D, ps, L, W, pages)
+    q = rng.randn(1, W, H, D).astype(np.float32)
+    if impl == "pallas_interpret":
+        got = _ragged_verify_pallas(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(pt), jnp.asarray([L], jnp.int32),
+            jnp.asarray([W - 1], jnp.int32), D ** -0.5, True)
+    else:
+        got = ragged_verify_reference(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(pt), jnp.asarray([L], jnp.int32))
+    ref = _verify_oracle(q[0], tok_k, tok_v, L)
+    np.testing.assert_allclose(np.asarray(got)[0], ref, rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_verify_w1_matches_decode_reference_bitwise():
+    """A 1-wide verify window IS the decode step: the reference path
+    must reproduce ``ragged_attention_reference`` BITWISE (the greedy
+    speculative-vs-sequential token parity rests on this), and the
+    kernel must agree numerically."""
+    rng = np.random.RandomState(21)
+    lengths = [0, 1, 8, 9, 24]
+    q, kp, vp, pt, ln = _make_case(rng, len(lengths), 2, 16, 8, 3,
+                                   lengths)
+    dec = np.asarray(ragged_attention_reference(q, kp, vp, pt, ln))
+    ver = np.asarray(ragged_verify_reference(q[:, None], kp, vp, pt, ln))
+    np.testing.assert_array_equal(ver[:, 0], dec)
+    pal = np.asarray(_ragged_verify_pallas(
+        q[:, None], kp, vp, pt, ln,
+        jnp.zeros((len(lengths),), jnp.int32), 16 ** -0.5, True))
+    for s, l in enumerate(lengths):      # dead rows: exactly zero
+        if l == 0:
+            np.testing.assert_array_equal(pal[s], 0.0)
+    np.testing.assert_allclose(pal[:, 0], dec, rtol=2e-5, atol=2e-5)
+
+
+def test_verify_pallas_matches_jnp_reference_mixed_slots():
+    """Kernel vs jnp reference over a mixed batch — dead slots, ragged
+    lengths, shuffled pages, window widths past page boundaries — agree
+    everywhere (both contracts zero dead rows)."""
+    rng = np.random.RandomState(22)
+    S, W, H, D, ps, max_pages = 5, 4, 2, 16, 8, 4
+    lengths = np.asarray([0, 1, 8, 13, 29], np.int32)
+    # populate FULL pools so every window position holds data
+    num_pages = 32
+    q = rng.randn(S, W, H, D).astype(np.float32)
+    kp = rng.randn(num_pages, H, ps, D).astype(np.float32)
+    vp = rng.randn(num_pages, H, ps, D).astype(np.float32)
+    perm = rng.permutation(np.arange(1, num_pages))
+    pt = np.zeros((S, max_pages), np.int32)
+    used = 0
+    for s in range(S):
+        n_live = -(-(int(lengths[s]) + W - 1) // ps) if lengths[s] else 0
+        pt[s, :n_live] = perm[used:used + n_live]
+        used += n_live
+    a = np.asarray(_ragged_verify_pallas(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(pt), jnp.asarray(lengths),
+        jnp.full((S,), W - 1, jnp.int32), 16 ** -0.5, True))
+    b = np.asarray(ragged_verify_reference(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(pt), jnp.asarray(lengths)))
+    np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-5)
+
+
+def test_verify_causal_window_masking():
+    """Row r must not see keys past position L - 1 + r: rewriting key
+    L + r0 changes nothing for rows <= r0 (and positions past the whole
+    window never matter to anyone)."""
+    rng = np.random.RandomState(23)
+    H, D, ps, L, W = 2, 8, 8, 5, 4
+    pages = [3, 6]
+    pt = np.zeros((1, 2), np.int32)
+    pt[0, :2] = pages
+    kp, vp, _, _ = _make_verify_case(rng, H, D, ps, L, W, pages)
+    q = rng.randn(1, W, H, D).astype(np.float32)
+
+    def run(kparr, vparr):
+        return np.asarray(_ragged_verify_pallas(
+            jnp.asarray(q), jnp.asarray(kparr), jnp.asarray(vparr),
+            jnp.asarray(pt), jnp.asarray([L], jnp.int32),
+            jnp.asarray([W - 1], jnp.int32), D ** -0.5, True))
+
+    base = run(kp, vp)
+    # poison position L + 1: row r sees keys [0, L - 1 + r], so rows
+    # 0..1 must be bit-unchanged and rows 2.. must move
+    r0 = 1
+    t = L + r0
+    kp2, vp2 = kp.copy(), vp.copy()
+    kp2[pages[t // ps], :, t % ps, :] = 77.0
+    vp2[pages[t // ps], :, t % ps, :] = -77.0
+    got = run(kp2, vp2)
+    np.testing.assert_array_equal(got[0, :r0 + 1], base[0, :r0 + 1])
+    assert not np.array_equal(got[0, r0 + 1:], base[0, r0 + 1:])
+    # positions past the window's last visible key never matter
+    kp3, vp3 = kp.copy(), vp.copy()
+    t = L + W - 1                         # first position nobody sees
+    kp3[pages[t // ps], :, t % ps, :] = 1e6
+    vp3[pages[t // ps], :, t % ps, :] = -1e6
+    np.testing.assert_array_equal(run(kp3, vp3), base)
+    # jnp reference: same two properties
+    refb = np.asarray(ragged_verify_reference(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(pt), jnp.asarray([L], jnp.int32)))
+    refg = np.asarray(ragged_verify_reference(
+        jnp.asarray(q), jnp.asarray(kp2), jnp.asarray(vp2),
+        jnp.asarray(pt), jnp.asarray([L], jnp.int32)))
+    np.testing.assert_array_equal(refg[0, :r0 + 1], refb[0, :r0 + 1])
+
+
+def test_verify_nan_propagates():
+    """A NaN K/V at a position the window can read must POISON the
+    output instead of being masked away (the non-finite guard's
+    detection path). The jnp reference — the CPU serving path the
+    engine's acceptance actually consumes — is per-ROW exact: only rows
+    whose causal window includes the position go NaN. The kernel's
+    granularity is the WINDOW (a 0-weight x NaN product in the shared
+    p @ v contraction can spill to earlier rows — same contract as the
+    chunked-prefill kernel): the rows that DO see the position must be
+    NaN; the engine's guard reduces per slot, so either granularity
+    quarantines exactly the poisoned slot."""
+    rng = np.random.RandomState(24)
+    H, D, ps, L, W = 2, 8, 8, 4, 3
+    pages = [2]
+    pt = np.zeros((1, 1), np.int32)
+    pt[0, 0] = 2
+    kp, vp, _, _ = _make_verify_case(rng, H, D, ps, L, W, pages)
+    q = rng.randn(1, W, H, D).astype(np.float32)
+    t = L                                 # visible to rows 1, 2 only
+    vp2 = vp.copy()
+    vp2[pages[0], :, t % ps, :] = np.nan
+    ref = np.asarray(ragged_verify_reference(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp2),
+        jnp.asarray(pt), jnp.asarray([L], jnp.int32)))
+    assert np.isfinite(ref[0, 0]).all()   # row 0 cannot see position L
+    assert np.isnan(ref[0, 1:]).all()
+    pal = np.asarray(_ragged_verify_pallas(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp2),
+        jnp.asarray(pt), jnp.asarray([L], jnp.int32),
+        jnp.asarray([W - 1], jnp.int32), D ** -0.5, True))
+    assert np.isnan(pal[0, 1:]).all()     # seeing rows must be poisoned
+
+
+def test_verify_unwritten_tail_nan_does_not_poison_consumed_rows():
+    """Regression: a slot drafting FEWER than window - 1 tokens leaves
+    positions [L + draft_len, L + window - 1) UNWRITTEN this step — a
+    recycled page can carry a quarantined slot's non-finite K/V there.
+    The kernel's V-select must bound at the slot's real written extent
+    L + draft_len (NOT L + window - 1, which let 0 * NaN poison every
+    consumed row and falsely quarantine a healthy slot — found by
+    review against the jnp reference, which is per-row exact and was
+    never affected)."""
+    rng = np.random.RandomState(26)
+    H, D, ps, L, W = 2, 8, 8, 4, 3
+    pages = [2]
+    pt = np.zeros((1, 1), np.int32)
+    pt[0, 0] = 2
+    kp, vp, _, _ = _make_verify_case(rng, H, D, ps, L, W, pages)
+    q = rng.randn(1, W, H, D).astype(np.float32)
+    dl = 0                                # no drafts: only row 0 consumed
+    ref = np.asarray(ragged_verify_reference(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(pt), jnp.asarray([L], jnp.int32)))
+    # poison every position past the written extent L - 1 + dl
+    kp2, vp2 = kp.copy(), vp.copy()
+    kp2[pages[0], :, L + dl:, :] = np.nan
+    vp2[pages[0], :, L + dl:, :] = np.nan
+    pal = np.asarray(_ragged_verify_pallas(
+        jnp.asarray(q), jnp.asarray(kp2), jnp.asarray(vp2),
+        jnp.asarray(pt), jnp.asarray([L], jnp.int32),
+        jnp.asarray([dl], jnp.int32), D ** -0.5, True))
+    assert np.isfinite(pal[0, :dl + 1]).all(), \
+        "unwritten-tail NaN leaked into consumed verify rows (kernel)"
+    np.testing.assert_allclose(pal[0, :dl + 1], ref[0, :dl + 1],
+                               rtol=2e-5, atol=2e-5)
+    # a partial draft (dl = 1 of W - 1 = 2) behaves the same
+    dl = 1
+    kp3, vp3 = kp.copy(), vp.copy()
+    kp3[pages[0], :, L + dl:, :] = np.nan
+    vp3[pages[0], :, L + dl:, :] = np.nan
+    pal = np.asarray(_ragged_verify_pallas(
+        jnp.asarray(q), jnp.asarray(kp3), jnp.asarray(vp3),
+        jnp.asarray(pt), jnp.asarray([L], jnp.int32),
+        jnp.asarray([dl], jnp.int32), D ** -0.5, True))
+    assert np.isfinite(pal[0, :dl + 1]).all()
+    np.testing.assert_allclose(pal[0, :dl + 1], ref[0, :dl + 1],
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_verify_dispatcher_and_dtype():
+    """The public dispatcher runs the jnp path on the CPU backend; bf16
+    inputs keep f32 accumulation and track the f32 result."""
+    rng = np.random.RandomState(25)
+    H, D, ps, L, W = 2, 8, 8, 9, 3
+    pages = [5, 3]
+    pt = np.zeros((1, 2), np.int32)
+    pt[0, :2] = pages
+    kp, vp, tok_k, tok_v = _make_verify_case(rng, H, D, ps, L, W, pages)
+    q = rng.randn(1, W, H, D).astype(np.float32)
+    out = ragged_verify_attention(jnp.asarray(q), jnp.asarray(kp),
+                                  jnp.asarray(vp), jnp.asarray(pt),
+                                  jnp.asarray([L], jnp.int32))
+    ref = _verify_oracle(q[0], tok_k, tok_v, L)
+    np.testing.assert_allclose(np.asarray(out)[0], ref, rtol=2e-5,
+                               atol=2e-5)
+    b16 = ragged_verify_attention(
+        jnp.asarray(q, jnp.bfloat16), jnp.asarray(kp, jnp.bfloat16),
+        jnp.asarray(vp, jnp.bfloat16), jnp.asarray(pt),
+        jnp.asarray([L], jnp.int32))
+    assert b16.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(b16, np.float32)[0], ref,
                                rtol=0.06, atol=0.06)
 
 

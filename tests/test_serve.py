@@ -113,6 +113,9 @@ def test_per_slot_sampling_isolation(model):
     assert all(0 <= t < 64 for t in r2.token_ids)
 
 
+@pytest.mark.slow   # 10s (round-11 tier-1 budget repair); admission /
+                    # reclaim tier-1 coverage stays via the churn-audit
+                    # and unservable tests; ci stage_unit runs it
 def test_admission_control_waits_for_pages(model):
     """A pool too small for two concurrent requests serializes them
     (second waits for eviction) instead of corrupting the cache; a pool

@@ -1,0 +1,407 @@
+"""Speculative-decoding engine tests (serve/ + ops ragged verify).
+
+The load-bearing claims: (1) GREEDY TOKEN PARITY — a speculative
+engine emits BIT-IDENTICAL tokens to the dense-cache
+``cached_generate`` oracle and to the non-speculative engine, at mixed
+occupancy, through chunked prefill, and across prefix-cache hits —
+acceptance only ever admits the exact argmax chain; (2) the decode
+family compiles EXACTLY TWO programs — the W=1 narrow step (bitwise
+the non-speculative decode, run when no slot drafted) and the
+K+1-wide verify — each traced at most once: drafts, acceptance
+lengths, and per-request RNG keys are pure data; (3) ``audit_pages()`` stays clean
+every step while the draft window lazily maps tail pages; (4) zero
+draft agreement degrades to exactly 1 token/step — speculation can
+slow nothing down semantically; (5) per-request seeds make temperature
+sampling reproducible across engines, occupancy, and speculation
+depth; (6) a non-finite verify step quarantines the slot WITHOUT
+recording any token of that step — drafted tokens included."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.base import MXNetError
+from incubator_mxnet_tpu.models import gpt as g
+from incubator_mxnet_tpu.serve import (InferenceEngine, Outcome, Request,
+                                       ngram_propose)
+
+
+@pytest.fixture(scope="module")
+def model():
+    mx.random.seed(0)
+    m = g.gpt_mini(vocab_size=64, max_length=64)
+    m.initialize()
+    return m
+
+
+def _solo_reference(model, prompt, max_new):
+    out = g.cached_generate(model, nd.array(prompt[None, :],
+                                            dtype="int32"),
+                            max_new_tokens=max_new).asnumpy()
+    return out[0, prompt.size:]
+
+
+def _assert_compile_once(eng):
+    """The speculative engine's compile contract: TWO decode-family
+    programs exist — the W=1 narrow step (bitwise the non-speculative
+    decode; runs whenever no slot drafted) and the K+1-wide verify —
+    and EACH traces at most once, with at least one having run.
+    Occupancy, drafts, acceptance, keys and weights are data."""
+    assert eng.decode_trace_count <= 1, \
+        f"narrow decode retraced ({eng.decode_trace_count})"
+    assert eng.verify_trace_count <= 1, \
+        f"wide verify retraced ({eng.verify_trace_count})"
+    assert eng.decode_trace_count + eng.verify_trace_count >= 1
+
+
+def _repetitive_prompts(rng, vocab=64):
+    """Prompts with recurring n-grams so prompt-lookup drafting fires,
+    mixed with plain random ones (zero-recurrence)."""
+    base = rng.randint(0, vocab, size=(6,)).astype(np.int32)
+    return [np.concatenate([base, base, base[:3]]),
+            rng.randint(0, vocab, size=(9,)).astype(np.int32),
+            np.concatenate([base, base]),
+            rng.randint(0, vocab, size=(17,)).astype(np.int32)]
+
+
+def _oracle_drafter(model, prompts, max_new, wrong=False, vocab=64):
+    """A drafter that knows each request's true greedy continuation
+    (precomputed): proposes exactly the right tokens — or, with
+    ``wrong=True``, tokens guaranteed to all be rejected (each draft is
+    the true token + 1 mod vocab). Requests are identified by their
+    prompt+emitted history matching a known (prompt, reference) pair."""
+    table = [(p, _solo_reference(model, p, mn))
+             for p, mn in zip(prompts, max_new)]
+
+    def draft(history, k):
+        h = np.asarray(history, np.int32)
+        for prompt, ref in table:
+            t0 = prompt.size
+            if h.size < t0 or not np.array_equal(h[:t0], prompt):
+                continue
+            e = h.size - t0
+            if not np.array_equal(h[t0:], ref[:e]):
+                continue
+            d = ref[e:e + k].astype(np.int32)
+            return (d + 1) % vocab if wrong else d
+        return np.zeros((0,), np.int32)
+
+    return draft
+
+
+# --------------------------------------------------------------------- #
+# the tentpole: greedy parity, compile-once, page audit, cache hits
+# --------------------------------------------------------------------- #
+
+def test_spec_greedy_parity_mixed_occupancy_cache_and_chunking(model):
+    """One speculative engine (K=3, chunked prefill, prefix cache, a
+    reclaim-forcing pool) serves ragged mixed-occupancy requests COLD
+    then WARM (cache-hit admissions): every request must emit exactly
+    its solo dense-cache tokens, the decode step compiles once across
+    both passes, ``audit_pages()`` passes after every scheduler step,
+    and speculation demonstrably compresses decode steps below 1
+    token/step/slot accounting."""
+    rng = np.random.RandomState(7)
+    prompts = _repetitive_prompts(rng)
+    news = (14, 10, 12, 8)
+    refs = [_solo_reference(model, p, k) for p, k in zip(prompts, news)]
+    eng = InferenceEngine(model, num_slots=3, page_size=8, max_len=64,
+                          num_pages=20, spec_k=3, chunk_pages=1,
+                          token_budget=16)
+    audit = lambda e, i: e.audit_pages()
+    for tag in ("cold", "warm"):
+        reqs = [Request(p, max_new_tokens=k)
+                for p, k in zip(prompts, news)]
+        eng.run(reqs, arrival_times=[0.0, 0.0, 0.01, 0.02],
+                after_step=audit)
+        for i, (req, ref) in enumerate(zip(reqs, refs)):
+            np.testing.assert_array_equal(
+                np.asarray(req.token_ids, np.int32), ref,
+                err_msg=f"{tag} request {i} diverged from the "
+                        f"non-speculative oracle")
+            assert req.outcome is not None and req.outcome.ok
+        _assert_compile_once(eng)
+        assert eng.verify_trace_count == 1, \
+            f"no wide verify step ran ({tag}) — drafting never fired"
+        eng.audit_pages()
+    assert eng.prefix_hits > 0               # warm pass hit the cache
+    # the accounting: drafting happened, some drafts were accepted, and
+    # engine counters equal the per-request sums
+    assert eng.drafted_tokens > 0
+    assert 0 < eng.accepted_tokens <= eng.drafted_tokens
+    assert 0.0 < eng.accept_rate <= 1.0
+    # every decode token is a step's base emission or an accepted
+    # draft: accepted > 0 means some step advanced a slot by more than
+    # one token — the compression speculation exists for
+    total_tokens = 2 * sum(len(r) for r in refs)
+    total_decode = total_tokens - 2 * len(refs)   # first tok: prefill
+    assert 0 < eng.accepted_tokens <= total_decode
+
+
+def test_spec_counters_match_per_request_sums(model):
+    """Engine-level drafted/accepted counters must equal the sums of
+    the per-request twins, and a non-speculative engine reports zeros
+    (the observability satellite's contract)."""
+    rng = np.random.RandomState(8)
+    prompts = _repetitive_prompts(rng)[:2]
+    news = (12, 10)
+    eng = InferenceEngine(model, num_slots=2, page_size=8, max_len=64,
+                          num_pages=16, spec_k=2)
+    reqs = [Request(p, max_new_tokens=k) for p, k in zip(prompts, news)]
+    eng.run(reqs)
+    assert eng.drafted_tokens == sum(r.drafted_tokens for r in reqs)
+    assert eng.accepted_tokens == sum(r.accepted_tokens for r in reqs)
+    for r in reqs:
+        assert 0 <= r.accepted_tokens <= r.drafted_tokens
+
+
+# --------------------------------------------------------------------- #
+# agreement extremes: oracle-right and oracle-wrong drafting
+# --------------------------------------------------------------------- #
+
+@pytest.mark.slow   # 11 s (oracle refs + 2 runs); ci stage_unit
+def test_full_agreement_compresses_steps_and_eos_truncates(model):
+    """With a drafter that proposes the TRUE continuation: every draft
+    is accepted (accept_rate 1.0) and N decode tokens take
+    ceil(N / (K+1)) steps. A second request whose reference contains
+    its EOS mid-window must stop exactly AT the EOS — accepted tokens
+    past it are discarded, as sequential decode would never have
+    emitted them."""
+    rng = np.random.RandomState(9)
+    prompts = [rng.randint(0, 64, size=(8,)).astype(np.int32),
+               rng.randint(0, 64, size=(11,)).astype(np.int32)]
+    news = (12, 12)
+    refs = [_solo_reference(model, p, k) for p, k in zip(prompts, news)]
+    drafter = _oracle_drafter(model, prompts, news)
+    K = 3
+    eng = InferenceEngine(model, num_slots=1, page_size=8, max_len=64,
+                          num_pages=16, spec_k=K, draft_fn=drafter,
+                          prefix_cache=False)
+    r0 = Request(prompts[0], max_new_tokens=news[0])
+    eng.run([r0])
+    np.testing.assert_array_equal(np.asarray(r0.token_ids, np.int32),
+                                  refs[0])
+    assert eng.accept_rate == 1.0
+    # 11 decode tokens (first came from prefill): capped drafting gives
+    # 4 + 4 + 3 = 11 in exactly 3 steps
+    n_decode = news[0] - 1
+    assert eng.decode_steps == -(-n_decode // (K + 1))
+    assert r0.accepted_tokens == r0.drafted_tokens > 0
+
+    # EOS inside the accepted window: pick the first reference token
+    # that did not occur earlier (so generation stops exactly there)
+    eos_pos = next(j for j in range(1, len(refs[1]))
+                   if refs[1][j] not in refs[1][:j])
+    eos_id = int(refs[1][eos_pos])
+    r1 = Request(prompts[1], max_new_tokens=news[1], eos_id=eos_id)
+    eng.run([r1])
+    np.testing.assert_array_equal(np.asarray(r1.token_ids, np.int32),
+                                  refs[1][:eos_pos + 1])
+    assert r1.outcome == Outcome.EOS
+    _assert_compile_once(eng)
+    assert eng.verify_trace_count == 1   # full agreement: all steps wide
+
+
+def test_zero_agreement_degrades_to_one_token_per_step(model):
+    """With a drafter whose every proposal is WRONG (true token + 1):
+    parity must hold bit-for-bit, zero drafts are accepted, and every
+    decode step advances exactly one token — the non-speculative
+    floor. After ``spec_patience`` fully-rejected windows, adaptive
+    gating stops drafting for the slot and the engine runs the W=1
+    narrow program (bitwise the non-speculative step) — the
+    zero-agreement floor pays no verify width."""
+    rng = np.random.RandomState(10)
+    prompts = [rng.randint(0, 64, size=(8,)).astype(np.int32)]
+    news = (12,)
+    refs = [_solo_reference(model, prompts[0], news[0])]
+    drafter = _oracle_drafter(model, prompts, news, wrong=True)
+    eng = InferenceEngine(model, num_slots=1, page_size=8, max_len=64,
+                          num_pages=16, spec_k=3, draft_fn=drafter,
+                          prefix_cache=False)
+    req = Request(prompts[0], max_new_tokens=news[0])
+    eng.run([req])
+    np.testing.assert_array_equal(np.asarray(req.token_ids, np.int32),
+                                  refs[0])
+    assert eng.accepted_tokens == 0
+    assert eng.drafted_tokens > 0
+    assert eng.decode_steps == news[0] - 1   # 1 token/step, no worse
+    # gating engaged: exactly spec_patience (default 2) fully-rejected
+    # windows ran wide, then every drafting-eligible step was gated
+    # narrow (the final step is narrow too — its token budget leaves
+    # no draft room), so BOTH programs traced exactly once
+    assert eng.spec_steps == eng.spec_patience
+    assert eng.spec_gated_steps == eng.decode_steps - eng.spec_steps - 1
+    assert eng.decode_trace_count == 1 and eng.verify_trace_count == 1
+
+
+# --------------------------------------------------------------------- #
+# per-request seeds (satellite): reproducible temperature sampling
+# --------------------------------------------------------------------- #
+
+@pytest.mark.slow   # 26 s: 3 engines × temperature runs; ci stage_unit
+def test_equal_seed_engines_emit_identical_temperature_tokens(model):
+    """Two speculative engines given requests with equal seeds must
+    emit identical temperature-path tokens; a different seed diverges.
+    The same request served SOLO must also match its batched tokens —
+    the per-request key is independent of occupancy."""
+    rng = np.random.RandomState(11)
+    base = rng.randint(0, 64, size=(5,)).astype(np.int32)
+    prompts = [np.concatenate([base, base]),
+               rng.randint(0, 64, size=(11,)).astype(np.int32)]
+
+    def serve(eng, seeds):
+        reqs = [Request(p, max_new_tokens=10, temperature=t, seed=sd)
+                for p, t, sd in zip(prompts, (0.8, 1.1), seeds)]
+        eng.run(reqs)
+        return [list(r.token_ids) for r in reqs]
+
+    eng_a = InferenceEngine(model, num_slots=2, page_size=8, max_len=64,
+                            num_pages=16, spec_k=2)
+    eng_b = InferenceEngine(model, num_slots=2, page_size=8, max_len=64,
+                            num_pages=16, spec_k=2)
+    toks_a = serve(eng_a, (123, 456))
+    toks_b = serve(eng_b, (123, 456))
+    assert toks_a == toks_b
+    assert serve(eng_a, (124, 456))[0] != toks_a[0]   # seed matters
+    solo = Request(prompts[0], max_new_tokens=10, temperature=0.8,
+                   seed=123)
+    eng_b.run([solo])                         # occupancy-independent
+    assert list(solo.token_ids) == toks_a[0]
+    _assert_compile_once(eng_a)
+    _assert_compile_once(eng_b)
+
+
+# --------------------------------------------------------------------- #
+# quarantine during a verify step (the PR 5 guard must see speculation)
+# --------------------------------------------------------------------- #
+
+@pytest.mark.slow   # 12 s: private model build + oracle refs; stage_unit
+def test_nonfinite_verify_step_records_no_drafted_token():
+    """Poison the weights mid-generation (warm_start, the chaos
+    NaNWeights fault): the very next verify step must quarantine the
+    slot with NOTHING recorded from that step — no base token, no
+    accepted draft — and the draft/accept counters must not move for
+    the poisoned step. Tokens recorded before the fault stay a clean
+    prefix of the fault-free reference.
+
+    Uses a PRIVATE model: warm_start swaps weights into the model's
+    Parameters in place (by design), so the poison must not leak into
+    the shared fixture."""
+    mx.random.seed(0)
+    model = g.gpt_mini(vocab_size=64, max_length=64)
+    model.initialize()
+    rng = np.random.RandomState(12)
+    prompt = rng.randint(0, 64, size=(8,)).astype(np.int32)
+    max_new = 16
+    ref = _solo_reference(model, prompt, max_new)
+    drafter = _oracle_drafter(model, [prompt], [max_new])
+    eng = InferenceEngine(model, num_slots=1, page_size=8, max_len=64,
+                          num_pages=16, spec_k=3, draft_fn=drafter,
+                          prefix_cache=False)
+    req = Request(prompt, max_new_tokens=max_new)
+    eng.submit(req)
+    while len(req.token_ids) < 4:            # prefill + >=1 verify step
+        eng.step()
+    tokens_before = list(req.token_ids)
+    drafted_before = eng.drafted_tokens
+    accepted_before = eng.accepted_tokens
+    # NaN a few embedding entries via warm_start (pure data, no retrace)
+    params = {str(i): np.asarray(p.data().asnumpy())
+              for i, p in enumerate(eng._eng_params)}
+    tab = params["0"].copy()
+    tab.reshape(-1)[:4] = np.nan
+    params["0"] = tab
+    eng.warm_start(params=params)
+    eng.step()                               # the poisoned verify step
+    assert req.outcome == Outcome.FAILED_NONFINITE
+    assert list(req.token_ids) == tokens_before
+    assert eng.drafted_tokens == drafted_before
+    assert eng.accepted_tokens == accepted_before
+    assert tokens_before == list(ref[:len(tokens_before)])
+    _assert_compile_once(eng)
+    assert eng.verify_trace_count == 1   # the poisoned step WAS a verify
+    eng.audit_pages()
+
+
+# --------------------------------------------------------------------- #
+# draft window vs page machinery
+# --------------------------------------------------------------------- #
+
+@pytest.mark.slow   # builds two engines; ci stage_unit runs it
+def test_draft_window_spans_page_boundary_and_survives_tiny_pool(model):
+    """page_size 4 with K=6: a verify window can span two freshly
+    allocated tail pages in one step — parity, audit, and compile-once
+    must hold. Then a pool sized to the bare admission minimum forces
+    the window allocation to fail sometimes: drafts are truncated (best
+    effort), never a stall — parity still exact."""
+    rng = np.random.RandomState(13)
+    prompts = _repetitive_prompts(rng)[:3]
+    news = (14, 10, 12)
+    refs = [_solo_reference(model, p, k) for p, k in zip(prompts, news)]
+    audit = lambda e, i: e.audit_pages()
+    eng = InferenceEngine(model, num_slots=2, page_size=4, max_len=64,
+                          num_pages=24, spec_k=6, prefix_cache=False)
+    reqs = [Request(p, max_new_tokens=k) for p, k in zip(prompts, news)]
+    eng.run(reqs, after_step=audit)
+    for req, ref in zip(reqs, refs):
+        np.testing.assert_array_equal(np.asarray(req.token_ids,
+                                                 np.int32), ref)
+    _assert_compile_once(eng)
+    assert eng.accepted_tokens > 0
+
+    # bare-minimum pool: slots fight for window pages
+    worst = max(-(-(p.size + k) // 4) for p, k in zip(prompts, news))
+    eng2 = InferenceEngine(model, num_slots=2, page_size=4, max_len=64,
+                           num_pages=2 * worst + 1, spec_k=6,
+                           prefix_cache=False)
+    reqs = [Request(p, max_new_tokens=k) for p, k in zip(prompts, news)]
+    eng2.run(reqs, after_step=audit)
+    for req, ref in zip(reqs, refs):
+        np.testing.assert_array_equal(np.asarray(req.token_ids,
+                                                 np.int32), ref)
+        assert req.outcome is not None and req.outcome.ok
+    _assert_compile_once(eng2)
+
+
+def test_spec_k_validation(model):
+    with pytest.raises(MXNetError):
+        InferenceEngine(model, num_slots=1, max_len=64, spec_k=-1)
+    with pytest.raises(MXNetError):
+        InferenceEngine(model, num_slots=1, max_len=64, spec_k=64)
+
+
+# --------------------------------------------------------------------- #
+# the n-gram drafter itself (pure host-side unit tests)
+# --------------------------------------------------------------------- #
+
+def test_ngram_propose_basics():
+    h = np.asarray([1, 2, 3, 9, 1, 2, 3], np.int32)
+    # suffix [1,2,3] recurs at 0; continuation is 9
+    np.testing.assert_array_equal(ngram_propose(h, 1), [9])
+    np.testing.assert_array_equal(ngram_propose(h, 3), [9, 1, 2])
+    # no recurrence anywhere: empty
+    assert ngram_propose(np.arange(8, dtype=np.int32), 4).size == 0
+    # k=0 or tiny history: empty
+    assert ngram_propose(h, 0).size == 0
+    assert ngram_propose(np.asarray([5], np.int32), 2).size == 0
+
+
+def test_ngram_propose_prefers_full_k_continuation():
+    """On periodic text the NEAREST occurrence abuts the suffix and
+    yields a short draft; the drafter must prefer the latest occurrence
+    with a full-k continuation."""
+    h = np.asarray([4, 5, 6, 7, 4, 5, 6, 7, 4, 5, 6], np.int32)
+    # suffix [4,5,6]: occurrences at 0 and 4; from 4, continuation
+    # [7, 4, 5] is full-k
+    np.testing.assert_array_equal(ngram_propose(h, 3), [7, 4, 5])
+    # falls back to shorter orders before giving up
+    h2 = np.asarray([9, 1, 9, 2, 9, 3, 9], np.int32)
+    d = ngram_propose(h2, 2, max_order=3)
+    assert d.size > 0                       # order-1 match on 9
+
+
+def test_ngram_drafter_cap_and_dtype():
+    h = np.tile(np.asarray([3, 1, 4], np.int32), 5)
+    d = ngram_propose(h, 2)
+    assert d.dtype == np.int32 and d.size <= 2

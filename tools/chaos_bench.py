@@ -90,12 +90,31 @@ def _make_requests(n, vocab, seed, deadline_s=None, max_len=128):
     return reqs
 
 
+_SPEC_K = 3     # scenarios run SPECULATIVE engines (greedy speculation
+                # is bit-identical to plain decode, so every parity
+                # invariant carries over — and every fault now lands on
+                # the draft-then-verify path too); --spec-k 0 reverts
+
+
 def _engine(model, **kw):
     from incubator_mxnet_tpu.serve import InferenceEngine
     cfg = dict(num_slots=4, page_size=8, max_len=128, chunk_pages=1,
-               prefix_cache=True)
+               prefix_cache=True, spec_k=_SPEC_K)
     cfg.update(kw)
     return InferenceEngine(model, **cfg)
+
+
+def _check_compile_once(tag, eng, errors):
+    """The decode-family compile contract: the W=1 narrow step and the
+    K+1-wide verify each trace AT MOST once (shape-keyed jit cache),
+    and at least one ran. A non-speculative engine (--spec-k 0) only
+    ever has the narrow program."""
+    if eng.decode_trace_count > 1 or eng.verify_trace_count > 1:
+        errors.append(f"{tag}: decode retraced (narrow "
+                      f"{eng.decode_trace_count}, wide "
+                      f"{eng.verify_trace_count}; each must be <= 1)")
+    if eng.decode_trace_count + eng.verify_trace_count < 1:
+        errors.append(f"{tag}: no decode program ever ran")
 
 
 # --------------------------------------------------------------------- #
@@ -119,9 +138,7 @@ def _check_invariants(tag, eng, reqs, baseline, affected, errors,
         eng.audit_pages()
     except MXNetError as e:
         errors.append(f"{tag}: final audit failed: {e}")
-    if eng.decode_trace_count != 1:
-        errors.append(f"{tag}: decode compiled "
-                      f"{eng.decode_trace_count} times (must be 1)")
+    _check_compile_once(tag, eng, errors)
     bad_buckets = {k: v for k, v in eng.prefill_trace_counts.items()
                    if v != 1}
     if bad_buckets:
@@ -140,10 +157,27 @@ def _check_invariants(tag, eng, reqs, baseline, affected, errors,
     if mismatches:
         errors.append(f"{tag}: {mismatches} unaffected requests diverged "
                       f"from the fault-free run (cross-contamination)")
+    # speculation observability: engine draft/accept counters must
+    # equal the per-request sums (these engines serve ONLY ``reqs``),
+    # and acceptance can never exceed drafting
+    d_sum = sum(r.drafted_tokens for r in reqs)
+    a_sum = sum(r.accepted_tokens for r in reqs)
+    if (eng.drafted_tokens, eng.accepted_tokens) != (d_sum, a_sum):
+        errors.append(
+            f"{tag}: engine spec counters "
+            f"({eng.drafted_tokens}, {eng.accepted_tokens}) != "
+            f"per-request sums ({d_sum}, {a_sum})")
+    if eng.accepted_tokens > eng.drafted_tokens:
+        errors.append(f"{tag}: accepted {eng.accepted_tokens} > "
+                      f"drafted {eng.drafted_tokens}")
     return {"outcomes": {o: n for o, n in eng.health.items() if n},
             "unaffected_ok": unaffected_ok,
             "affected": len(affected),
+            "drafted": eng.drafted_tokens,
+            "accepted": eng.accepted_tokens,
+            "accept_rate": round(eng.accept_rate, 4),
             "decode_trace_count": eng.decode_trace_count,
+            "verify_trace_count": eng.verify_trace_count,
             "prefill_buckets": len(eng.prefill_trace_counts)}
 
 
@@ -183,6 +217,10 @@ def run_scenarios(n_requests, errors):
                               errors, allow_non_ok=False)
     if not all(r.outcome is not None and r.outcome.ok for r in reqs):
         errors.append("baseline: not every request succeeded")
+    if _SPEC_K > 0 and eng.drafted_tokens == 0:
+        errors.append("baseline: speculation enabled but the n-gram "
+                      "drafter never proposed — scenarios are not "
+                      "exercising the verify path")
     stats["wall_s"] = wall
     results["baseline"] = stats
 
@@ -203,6 +241,15 @@ def run_scenarios(n_requests, errors):
         if r.outcome != Outcome.FAILED_NONFINITE:
             errors.append(f"nan_weights: poisoned request ended "
                           f"{r.outcome}, not FAILED_NONFINITE")
+    # a poisoned VERIFY step must record NOTHING — no base token, no
+    # accepted draft: every recorded token predates the fault, so it
+    # must be a clean prefix of the fault-free run's tokens
+    for r, base_tokens in zip(reqs, baseline):
+        if r.outcome == Outcome.FAILED_NONFINITE and \
+                list(r.token_ids) != base_tokens[:len(r.token_ids)]:
+            errors.append("nan_weights: a quarantined request recorded "
+                          "a token from the poisoned step (drafted "
+                          "tokens must never be published)")
     stats["log"] = inj.log
     results["nan_weights"] = stats
 
@@ -225,6 +272,11 @@ def run_scenarios(n_requests, errors):
         if r.outcome != Outcome.FAILED_NONFINITE:
             errors.append(f"corrupt_page: poisoned request ended "
                           f"{r.outcome}, not FAILED_NONFINITE")
+    for r, base_tokens in zip(reqs, baseline):
+        if r.outcome == Outcome.FAILED_NONFINITE and \
+                list(r.token_ids) != base_tokens[:len(r.token_ids)]:
+            errors.append("corrupt_page: a quarantined request recorded "
+                          "a token from the poisoned step")
     stats["log"] = inj.log
     results["corrupt_page"] = stats
 
@@ -319,8 +371,7 @@ def run_scenarios(n_requests, errors):
             errors.append(f"deadline_storm: request {i} non-terminal")
     if eng.expired == 0:
         errors.append("deadline_storm: stalls expired nothing")
-    if eng.decode_trace_count != 1:
-        errors.append("deadline_storm: decode retraced")
+    _check_compile_once("deadline_storm", eng, errors)
     try:
         eng.audit_pages()
     except Exception as e:
@@ -373,6 +424,7 @@ def _child_main(ckpt_dir):
         "all_terminal": all(r.outcome is not None for r in reqs),
         "outcomes": {o: n for o, n in eng.health.items() if n},
         "decode_trace_count": eng.decode_trace_count,
+        "verify_trace_count": eng.verify_trace_count,
         "committed_steps": mgr.all_steps(),
     }
     print("REPORT " + json.dumps(report), flush=True)
@@ -447,7 +499,8 @@ def run_sigterm_scenario(errors):
         if not report["all_terminal"]:
             errors.append("sigterm: requests left non-terminal after "
                           "the drain")
-        if report["decode_trace_count"] != 1:
+        if report["decode_trace_count"] > 1 or \
+                report.get("verify_trace_count", 0) > 1:
             errors.append("sigterm: decode retraced in the child")
         if not report["committed_steps"]:
             errors.append("sigterm: no weight snapshot committed")
@@ -460,6 +513,7 @@ def run_sigterm_scenario(errors):
 
 
 def main():
+    global _SPEC_K
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
                     help="CI guard: the same scenarios, small workload")
@@ -467,10 +521,15 @@ def main():
     ap.add_argument("--requests", type=int, default=None)
     ap.add_argument("--skip-sigterm", action="store_true",
                     help="in-process scenarios only")
+    ap.add_argument("--spec-k", type=int, default=_SPEC_K,
+                    help="draft depth for every scenario engine "
+                         "(0 = non-speculative)")
     ap.add_argument("--child", action="store_true",
                     help=argparse.SUPPRESS)
     ap.add_argument("--ckpt-dir", default=None, help=argparse.SUPPRESS)
     args = ap.parse_args()
+
+    _SPEC_K = args.spec_k
 
     if args.child:
         sys.exit(_child_main(args.ckpt_dir))

@@ -41,10 +41,28 @@ Round-10 workload (docs/RESILIENCE.md):
     tokens/s == slots / step-time) — banks what the always-on guard
     costs; the leave-it-on bar is <2%.
 
+Round-11 workloads (speculative decoding, docs/SERVING.md):
+
+  - ``spec_decoding.high_agreement``: templated/repetitive prompts
+    where the engine's own n-gram drafter reaches 80-97% acceptance
+    (greedy gpt_mini locks into the template loop — the honest
+    production mechanism, no oracle), swept over occupancy: the win is
+    largest on underfilled engines (spare per-step compute becomes
+    accepted tokens) and shrinks toward full occupancy;
+  - ``spec_decoding.zero_agreement``: an always-wrong drafter at full
+    occupancy — the adversarial floor. Adaptive gating must hold the
+    regression <=5%, and two timing-free contracts are asserted on
+    every run: greedy output BIT-IDENTICAL to the non-speculative
+    engine in exactly the same decode_steps, and the two-program
+    compile discipline (narrow W=1 + K+1-wide verify, each traced at
+    most once) — including through a mixed-agreement traffic run.
+    Both regimes use the round-10 strict-alternation methodology.
+
 ``--smoke`` is the CI guard (ci/run.sh servebench stage): fast runs
 that exit non-zero on any steady-state decode retrace, on a cache-hit
-admission compiling ANY new program, or on chunked prefill exceeding
-its per-step token budget. CPU-measurable by design.
+admission compiling ANY new program, on chunked prefill exceeding
+its per-step token budget, or on any speculative-decoding contract
+violation. CPU-measurable by design.
 
 Fairness notes for the baseline: every request uses the same
 (prompt_pad, total) shape so ``cached_generate`` compiles ONCE (warmed
@@ -499,6 +517,261 @@ def bench_guard_overhead(model, *, prompt_len, max_new, slots,
     return engines["guarded"], out
 
 
+# --------------------------------------------------------------------- #
+# round-11: speculative decoding (docs/SERVING.md)
+# --------------------------------------------------------------------- #
+
+def _templated_prompt(rng, vocab, i, length=20):
+    """Templated/repetitive text: a short random unit tiled to
+    ``length``. Greedy gpt_mini locks into the template's loop, so the
+    engine's own n-gram/prompt-lookup drafter reaches 80-97% acceptance
+    HONESTLY — no oracle drafter, the production mechanism itself."""
+    import numpy as np
+    unit = rng.randint(0, vocab, size=(5 + i % 4,)).astype(np.int32)
+    return np.tile(unit, 1 + (length - 1) // unit.size)[:length]
+
+
+def _wrong_drafter(vocab):
+    """TRUE zero agreement: always proposes k tokens, each the
+    history's tail token + 1 (mod vocab) — never the model's argmax
+    chain, so every window is fully rejected. Harsher than 'random
+    text' (where the model's own emitted loops give the real drafter
+    accidental hits): the engine pays drafting + patience wide steps
+    until gating engages, then probes. This is the floor the <=5%
+    regression bar is measured against."""
+    import numpy as np
+
+    def draft(history, k):
+        h = np.asarray(history, np.int32)
+        return (h[-k:] + 1) % vocab
+
+    return draft
+
+
+def _spec_alternation(model, *, slots, spec_k, prompt_fn, draft_fn=None,
+                      iters, max_new, page_size, vocab):
+    """Speculative vs non-speculative engines under the round-10
+    STRICT-ALTERNATION discipline: both held at full occupancy
+    (refilled untimed as requests finish), stepped alternately with the
+    order flipped every iteration, each step timed alone and credited
+    with the tokens it advanced. tokens/s = tokens/time over full-
+    occupancy steps; the drift window is one step, common-mode by
+    construction — window-level A/B on this host swings 2x either way
+    (round-9/10 notes), far above the effects measured here. The
+    speculative engine's per-step time INCLUDES host-side drafting and
+    acceptance bookkeeping — the ratio is end-to-end honest."""
+    import numpy as np
+    from incubator_mxnet_tpu.serve import InferenceEngine, Request
+    engines = {
+        "spec": InferenceEngine(model, num_slots=slots,
+                                page_size=page_size, max_len=max_new + 64,
+                                prefix_cache=False, spec_k=spec_k,
+                                draft_fn=draft_fn),
+        "base": InferenceEngine(model, num_slots=slots,
+                                page_size=page_size, max_len=max_new + 64,
+                                prefix_cache=False, spec_k=0),
+    }
+    fill_rng = {n: np.random.RandomState(29) for n in engines}
+
+    def refill(eng, name):
+        i = 0
+        while eng.active_count < slots:
+            eng.submit(Request(prompt_fn(fill_rng[name], i),
+                               max_new_tokens=max_new))
+            i += 1
+            eng.step()                       # admit + prefill, untimed
+
+    for name, eng in engines.items():
+        refill(eng, name)
+        for _ in range(6):                   # warm BOTH widths
+            eng.step()
+    acc = {n: [0.0, 0] for n in engines}
+
+    def _live_tokens(eng):
+        return sum(len(eng._slots[s].request.token_ids)
+                   for s in range(slots) if eng._slots[s] is not None)
+
+    for i in range(iters):
+        order = ("spec", "base") if i % 2 == 0 else ("base", "spec")
+        for name in order:
+            eng = engines[name]
+            n0 = _live_tokens(eng)
+            t0 = time.perf_counter()
+            eng.step()
+            dt = time.perf_counter() - t0
+            if eng.active_count == slots:    # a pure decode step
+                acc[name][0] += dt
+                acc[name][1] += _live_tokens(eng) - n0
+            else:                            # finishers: refill untimed
+                refill(eng, name)
+    tps = {n: k / t for n, (t, k) in acc.items()}
+    spec = engines["spec"]
+    out = {
+        "slots": slots, "spec_k": spec_k, "iters": iters,
+        "spec_tokens_per_s": tps["spec"],
+        "base_tokens_per_s": tps["base"],
+        "tokens_per_s_ratio": tps["spec"] / tps["base"],
+        "accept_rate": round(spec.accept_rate, 4),
+        "drafted_tokens": spec.drafted_tokens,
+        "accepted_tokens": spec.accepted_tokens,
+        "accepted_per_wide_step": (spec.accepted_tokens /
+                                   max(spec.spec_steps, 1)),
+        "tokens_per_decode_step": acc["spec"][1] /
+                                  max(spec.decode_steps, 1),
+        "wide_steps": spec.spec_steps,
+        "gated_steps": spec.spec_gated_steps,
+        "decode_steps": spec.decode_steps,
+        "trace_counts": {n: (e.decode_trace_count, e.verify_trace_count)
+                         for n, e in engines.items()},
+    }
+    return engines, out
+
+
+def _check_spec_compile(tag, eng, errors, spec=True):
+    """The two-program contract: narrow W=1 decode and K+1-wide verify
+    each trace AT MOST once; a non-speculative engine only ever has the
+    narrow program (exactly once)."""
+    if eng.decode_trace_count > 1 or eng.verify_trace_count > 1:
+        errors.append(f"{tag}: decode retraced (narrow "
+                      f"{eng.decode_trace_count}, wide "
+                      f"{eng.verify_trace_count}; each must be <= 1)")
+    if not spec and (eng.decode_trace_count, eng.verify_trace_count) \
+            != (1, 0):
+        errors.append(f"{tag}: non-speculative engine traced "
+                      f"({eng.decode_trace_count}, "
+                      f"{eng.verify_trace_count}), expected (1, 0)")
+
+
+def bench_spec_decoding(model, *, smoke, page_size, slots, spec_k,
+                        errors):
+    """Round-11 workloads + contracts.
+
+    ``high_agreement``: templated prompts + the engine's own n-gram
+    drafter, swept over occupancy — speculation converts spare
+    per-step compute into accepted tokens, so the win is largest on
+    underfilled engines (solo ~2.8x on this host) and shrinks toward
+    full occupancy where the verify width competes with batch
+    parallelism (the same tradeoff a TPU serving fleet tunes: below
+    the bandwidth roofline W is nearly free; at the compute roofline
+    it is not). The >=1.5x bar is read at half occupancy.
+
+    ``zero_agreement``: the _wrong_drafter floor at FULL occupancy —
+    the worst case for speculation. Adaptive gating must keep the
+    regression <=5%: after ``spec_patience`` rejected windows the
+    engine runs the narrow program (bitwise the non-speculative step),
+    paying only probe steps.
+
+    Deterministic contracts checked on every run (timing-free):
+    zero-agreement greedy output BIT-IDENTICAL to the non-speculative
+    engine with equal decode_steps (speculation can never change or
+    slow the floor semantically), and the two-program compile
+    discipline on every engine, including a mixed-agreement engine
+    that serves templated AND random traffic through one program
+    pair."""
+    import numpy as np
+    from incubator_mxnet_tpu.serve import InferenceEngine, Request
+    vocab = model.vocab_size
+    max_new = 120 if smoke else 400
+    iters = 60 if smoke else 220
+    sweep = sorted({1, max(slots // 2, 1), slots})
+    if smoke:
+        sweep = [max(slots // 2, 1)]
+    out = {"config": {"spec_k": spec_k, "page_size": page_size,
+                      "slots": slots, "max_new": max_new,
+                      "iters": iters, "smoke": smoke},
+           "high_agreement": {}}
+    for S in sweep:
+        engines, r = _spec_alternation(
+            model, slots=S, spec_k=spec_k,
+            prompt_fn=lambda rng, i: _templated_prompt(rng, vocab, i),
+            iters=iters, max_new=max_new, page_size=page_size,
+            vocab=vocab)
+        out["high_agreement"][f"slots_{S}"] = r
+        _check_spec_compile(f"spec.high_agreement.slots_{S}.spec",
+                            engines["spec"], errors)
+        _check_spec_compile(f"spec.high_agreement.slots_{S}.base",
+                            engines["base"], errors, spec=False)
+        if r["accept_rate"] < 0.5:
+            errors.append(f"spec.high_agreement.slots_{S}: accept rate "
+                          f"{r['accept_rate']} — drafter lost the "
+                          f"template (should be >0.8)")
+    engines, floor = _spec_alternation(
+        model, slots=slots, spec_k=spec_k,
+        prompt_fn=lambda rng, i: rng.randint(0, vocab, size=(20,))
+        .astype(np.int32),
+        draft_fn=_wrong_drafter(vocab), iters=max(iters, 80),
+        max_new=max_new, page_size=page_size, vocab=vocab)
+    out["zero_agreement"] = floor
+    _check_spec_compile("spec.zero_agreement.spec", engines["spec"],
+                        errors)
+    if floor["gated_steps"] == 0:
+        errors.append("spec.zero_agreement: gating never engaged — the "
+                      "floor is paying full verify width")
+    # the SEMANTIC floor contract, timing-free and deterministic:
+    # zero-agreement speculation emits bitwise the non-speculative
+    # tokens in exactly the same number of decode steps
+    rng = np.random.RandomState(31)
+    prompts = [rng.randint(0, vocab, size=(16,)).astype(np.int32)
+               for _ in range(slots + 2)]
+    runs = {}
+    for name, kw in (("spec", dict(spec_k=spec_k,
+                                   draft_fn=_wrong_drafter(vocab))),
+                     ("base", dict(spec_k=0))):
+        eng = InferenceEngine(model, num_slots=slots,
+                              page_size=page_size, max_len=256,
+                              prefix_cache=False, **kw)
+        reqs = [Request(p.copy(), max_new_tokens=24) for p in prompts]
+        eng.run(reqs)
+        runs[name] = ([list(r.token_ids) for r in reqs],
+                      eng.decode_steps)
+    if runs["spec"][0] != runs["base"][0]:
+        errors.append("spec.zero_agreement: tokens diverged from the "
+                      "non-speculative engine (parity broken)")
+    if runs["spec"][1] != runs["base"][1]:
+        errors.append(f"spec.zero_agreement: decode_steps "
+                      f"{runs['spec'][1]} != non-speculative "
+                      f"{runs['base'][1]} (1 token/step floor broken)")
+    out["zero_agreement_parity"] = {
+        "tokens_identical": runs["spec"][0] == runs["base"][0],
+        "decode_steps": runs["spec"][1],
+    }
+    # mixed-agreement traffic through ONE engine: templated + random
+    # requests, varying occupancy as they drain — still exactly one
+    # narrow + one wide program
+    eng = InferenceEngine(model, num_slots=slots, page_size=page_size,
+                          max_len=256, prefix_cache=False,
+                          spec_k=spec_k)
+    mixed = [Request(_templated_prompt(np.random.RandomState(40 + i),
+                                       vocab, i),
+                     max_new_tokens=20) for i in range(slots)]
+    mixed += [Request(np.random.RandomState(50 + i)
+                      .randint(0, vocab, size=(13,)).astype(np.int32),
+                      max_new_tokens=28) for i in range(slots)]
+    eng.run(mixed, arrival_times=[0.002 * i for i in range(len(mixed))])
+    _check_spec_compile("spec.mixed_traffic", eng, errors)
+    if eng.decode_trace_count != 1 or eng.verify_trace_count != 1:
+        errors.append(f"spec.mixed_traffic: expected BOTH programs to "
+                      f"trace exactly once, got "
+                      f"({eng.decode_trace_count}, "
+                      f"{eng.verify_trace_count})")
+    out["mixed_traffic"] = {
+        "decode_trace_count": eng.decode_trace_count,
+        "verify_trace_count": eng.verify_trace_count,
+        "accept_rate": round(eng.accept_rate, 4),
+        "drafted_tokens": eng.drafted_tokens,
+        "accepted_tokens": eng.accepted_tokens,
+        "gated_steps": eng.spec_gated_steps,
+    }
+    # timing bars: hard floor assert in smoke is deliberately loose
+    # (2-core CI hosts), the honest numbers are banked by full runs
+    floor_ratio = floor["tokens_per_s_ratio"]
+    if smoke and floor_ratio < 0.75:
+        errors.append(f"spec.zero_agreement: tokens/s ratio "
+                      f"{floor_ratio:.2f} — speculation slowed the "
+                      f"floor beyond noise")
+    return out
+
+
 def _check_compile_discipline(tag, stats, errors):
     if stats["decode_trace_count"] != 1:
         errors.append(f"{tag}: decode step compiled "
@@ -526,6 +799,9 @@ def main():
     ap.add_argument("--rate", type=float, default=30.0,
                     help="Poisson arrival rate (req/s) — default keeps "
                          "~all 8 slots busy on a CPU host")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="draft depth for the round-11 speculative "
+                         "workloads")
     args = ap.parse_args()
 
     errors = []
@@ -655,6 +931,13 @@ def main():
                           f"retraced: {bad}")
     result["guard_overhead"] = guard
 
+    # ---- round-11: speculative decoding ---------------------------- #
+    model_s = _build(max_length=512)
+    result["spec_decoding"] = bench_spec_decoding(
+        model_s, smoke=args.smoke, page_size=args.page_size,
+        slots=args.slots if not args.smoke else 4,
+        spec_k=args.spec_k, errors=errors)
+
     # ---- baseline comparison (full runs only) ---------------------- #
     if not args.smoke:
         reqs_b, arrivals_b = _make_requests(
@@ -687,6 +970,17 @@ def main():
             print(f"WARN: non-finite guard costs "
                   f"{guard['guard_overhead_pct']:.2f}% tokens/s — over "
                   f"the 2% leave-it-on bar", file=sys.stderr)
+        spec = result["spec_decoding"]
+        half = f"slots_{max(args.slots // 2, 1)}"
+        hi = spec["high_agreement"][half]["tokens_per_s_ratio"]
+        if hi < 1.5:
+            print(f"WARN: speculative high-agreement win {hi:.2f}x at "
+                  f"half occupancy — below the 1.5x bar",
+                  file=sys.stderr)
+        lo = spec["zero_agreement"]["tokens_per_s_ratio"]
+        if lo < 0.95:
+            print(f"WARN: speculative zero-agreement floor {lo:.2f}x — "
+                  f"regression beyond the 5% bar", file=sys.stderr)
 
     out = args.json
     if out is None and not args.smoke:
