@@ -62,6 +62,18 @@ stage_chaossmoke() {
   JAX_PLATFORMS=cpu python tools/chaos_bench.py --smoke
 }
 
+stage_fleetsmoke() {
+  echo "== fleetsmoke: fleet resilience guard (router over N replicas —"
+  echo "               replica kills mid-decode/mid-prefill become bounded"
+  echo "               structured re-queues with emitted tokens preserved,"
+  echo "               breaker opens/half-open-probes/closes under slow and"
+  echo "               flapping replicas, fleet-level shedding carries"
+  echo "               retry_after_s; fails on any lost/double-finished"
+  echo "               request, survivor divergence, page-audit violation"
+  echo "               on a surviving replica, or per-replica retrace)"
+  JAX_PLATFORMS=cpu python tools/chaos_bench.py --fleet --smoke
+}
+
 stage_ckptbench() {
   echo "== ckptbench: elastic-checkpoint regression guard (async commit +"
   echo "              keep-last-k GC + bit-exact capsule resume)"
@@ -81,7 +93,7 @@ ge.dryrun_multichip(8)"
 }
 
 stages=("$@")
-[ ${#stages[@]} -eq 0 ] && stages=(sanity native unit stepbench servebench chaossmoke ckptbench entry)
+[ ${#stages[@]} -eq 0 ] && stages=(sanity native unit stepbench servebench chaossmoke fleetsmoke ckptbench entry)
 for s in "${stages[@]}"; do
   "stage_$s"
 done

@@ -15,7 +15,14 @@ This package replaces it with the TPU-serving discipline (arxiv
                      decode step is ONE jitted program whose shapes
                      never depend on occupancy (prefill-insert and
                      EOS-eviction are host-side data edits, never
-                     retraces).
+                     retraces);
+  - ``router``     — a fleet front over N engine replicas:
+                     cache-affinity admission (read-only
+                     ``prefix_probe``), least-delay spill, heartbeat/
+                     circuit-breaker health states, and bounded
+                     structured failover — replica death becomes a
+                     re-queue with emitted tokens preserved, never a
+                     lost request (docs/RESILIENCE.md).
 
 The ragged decode-attention kernel itself lives in
 ``ops.ragged_attention`` next to its training-side siblings.
@@ -29,8 +36,11 @@ from .paged_kv import (NULL_PAGE, PageAllocator, PrefixIndex,
 from .outcomes import Outcome
 from .draft import make_ngram_drafter, ngram_propose
 from .engine import InferenceEngine, Request
+from .router import (Replica, ReplicaKilled, ReplicaState, Router,
+                     build_fleet)
 
 __all__ = ["InferenceEngine", "Request", "Outcome", "PageAllocator",
            "PrefixIndex", "NULL_PAGE", "init_kv_pools", "write_token_kv",
            "write_prompt_kv", "write_block_kv", "ngram_propose",
-           "make_ngram_drafter"]
+           "make_ngram_drafter", "Router", "Replica", "ReplicaState",
+           "ReplicaKilled", "build_fleet"]

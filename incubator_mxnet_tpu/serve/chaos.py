@@ -34,10 +34,14 @@ import numpy as np
 from ..base import MXNetError
 from .engine import InferenceEngine, Request
 from .outcomes import Outcome
+from .router import ReplicaState, Router
 
 __all__ = ["ChaosInjector", "NaNWeights", "CorruptPageWrite",
            "PagePressure", "DelayedSteps", "run_chaos",
-           "assert_all_terminal", "assert_health_consistent"]
+           "assert_all_terminal", "assert_health_consistent",
+           "FleetInjector", "KillReplica", "SlowReplica",
+           "FlappingReplica", "run_fleet_chaos",
+           "assert_fleet_health_consistent"]
 
 
 class ChaosInjector:
@@ -232,6 +236,192 @@ class DelayedSteps(ChaosInjector):
             self.fired = True
             self.stalled_steps += 1
             time.sleep(self.sleep_s)
+
+
+# --------------------------------------------------------------------- #
+# fleet-scope injectors (serve/router.py)
+# --------------------------------------------------------------------- #
+
+class FleetInjector(ChaosInjector):
+    """Base for ROUTER-level injectors: ``on_step(router, step_idx)``
+    fires through ``Router.run``'s ``before_step`` hook. Same seeding
+    and logging contract as the engine-level injectors."""
+
+    name = "fleet_chaos"
+
+    def on_step(self, router: Router, step_idx: int) -> None:
+        raise NotImplementedError
+
+
+class KillReplica(FleetInjector):
+    """Kill one replica — the 'host disappeared' fault. From the fire
+    point on, every step of that replica raises ``ReplicaKilled``; the
+    router must mark it DEAD and RE-QUEUE its in-flight requests with
+    their emitted tokens preserved (resume-from-suffix replay), so
+    with requeue budget left NO request is lost and — greedy decode
+    being deterministic under position-keyed sampling — every replayed
+    request still ends bit-identical to a fault-free run.
+
+    ``phase`` targets the kill: ``"decode"`` defers until the replica
+    has a decoding slot with at least one emitted token (a mid-stream
+    kill — the replay must preserve a non-empty prefix), ``"prefill"``
+    until it has a slot mid-prompt (chunked prefill spreads prompts
+    over steps), ``"verify"`` until a speculative verify step has run
+    with a decoding slot live (the kill lands inside the
+    draft-then-verify window), None fires at ``at_step``
+    unconditionally. ``inflight_at_kill`` snapshots (client request,
+    copy of its tokens so far) at the fire point — the
+    emitted-prefix-preservation oracle for tests."""
+
+    name = "kill_replica"
+
+    def __init__(self, replica: int, at_step: int, phase=None, seed=0):
+        super().__init__(seed)
+        if phase not in (None, "decode", "prefill", "verify"):
+            raise MXNetError(f"kill phase {phase!r} not in "
+                             f"decode|prefill|verify|None")
+        self.replica = replica
+        self.at_step = at_step
+        self.phase = phase
+        self.inflight_at_kill: List = []
+
+    def _phase_ready(self, router: Router) -> bool:
+        eng = router.replicas[self.replica].engine
+        if self.phase is None:
+            return True
+        slots = [s for s in eng._slots if s is not None]
+        if self.phase == "prefill":
+            return any(s.prefilling for s in slots)
+        decoding = [s for s in slots if not s.prefilling
+                    and s.request.token_ids]
+        if self.phase == "decode":
+            return bool(decoding)
+        return bool(decoding) and eng.spec_steps > 0   # "verify"
+
+    def on_step(self, router, step_idx):
+        if self.fired or step_idx < self.at_step:
+            return
+        rep = router.replicas[self.replica]
+        if rep.state is ReplicaState.DEAD or rep.killed is not None:
+            self.fired = True
+            return
+        if not self._phase_ready(router):
+            return                           # defer to a later step
+        self.fired = True
+        for t in router._inflight:
+            if t.replica == self.replica:
+                self.inflight_at_kill.append(
+                    (t.client, list(t.client.token_ids) +
+                     list(t.attempt.token_ids)))
+        rep.kill(f"chaos kill ({self.phase or 'any'} phase) at router "
+                 f"step {step_idx}")
+        self.log.append(
+            f"step {step_idx}: killed replica {self.replica} with "
+            f"{len(self.inflight_at_kill)} requests in flight")
+
+
+class SlowReplica(FleetInjector):
+    """Stall one replica's steps by ``sleep_s`` for router steps in
+    [``start``, ``end``) — the 'neighbour is thrashing / link is slow'
+    fault. With ``sleep_s`` over the router's ``heartbeat_timeout_s``,
+    ``breaker_failures`` stalled steps must OPEN the breaker
+    (DEGRADED: no new admissions, half-open probes on seeded-jitter
+    backoff); once the window passes, probes must close it back to
+    SERVING and its in-flight requests finish on-replica — slowness
+    alone must never lose, re-route, or corrupt a request."""
+
+    name = "slow_replica"
+
+    def __init__(self, replica: int, start: int, end: int,
+                 sleep_s: float, seed=0):
+        super().__init__(seed)
+        self.replica = replica
+        self.start = start
+        self.end = end
+        self.sleep_s = sleep_s
+
+    def on_step(self, router, step_idx):
+        rep = router.replicas[self.replica]
+        if self.start <= step_idx < self.end:
+            self.fired = True
+            rep.delay_s = self.sleep_s
+        else:
+            rep.delay_s = 0.0
+
+
+class FlappingReplica(FleetInjector):
+    """A replica that is alternately slow and healthy: ``cycles``
+    windows of ``slow_for`` stalled router steps every ``period``
+    steps, starting at ``start``. Exercises the full breaker loop
+    repeatedly — OPEN on misses, half-open probes, CLOSE on recovery,
+    OPEN again — asserting the backoff machinery is re-entrant and
+    that flapping, like slowness, never loses a request."""
+
+    name = "flapping_replica"
+
+    def __init__(self, replica: int, start: int, period: int,
+                 slow_for: int, sleep_s: float, cycles: int = 2,
+                 seed=0):
+        super().__init__(seed)
+        if slow_for >= period:
+            raise MXNetError("slow_for must be < period (the replica "
+                             "needs healthy steps to flap back up)")
+        self.replica = replica
+        self.start = start
+        self.period = period
+        self.slow_for = slow_for
+        self.sleep_s = sleep_s
+        self.cycles = cycles
+
+    def on_step(self, router, step_idx):
+        rep = router.replicas[self.replica]
+        rel = step_idx - self.start
+        slow = False
+        if rel >= 0 and rel // self.period < self.cycles:
+            slow = (rel % self.period) < self.slow_for
+        if slow:
+            self.fired = True
+        rep.delay_s = self.sleep_s if slow else 0.0
+
+
+def run_fleet_chaos(router: Router, requests, injectors,
+                    arrival_times=None, audit_every_step: bool = True,
+                    poll_sleep: float = 1e-3):
+    """Drive ``requests`` through the fleet with ``injectors`` firing
+    via the router's ``before_step`` hook, auditing EVERY surviving
+    replica's page invariant after every router step (a dead replica's
+    memory is off-limits by definition). Raises if any request fails
+    to reach a terminal outcome."""
+
+    def before(rt, i):
+        for inj in injectors:
+            inj.on_step(rt, i)
+
+    def after(rt, i):
+        if audit_every_step:
+            for rep in rt.replicas:
+                if rep.state is not ReplicaState.DEAD and \
+                        rep.killed is None:
+                    rep.engine.audit_pages()
+
+    router.run(requests, arrival_times=arrival_times,
+               poll_sleep=poll_sleep, before_step=before,
+               after_step=after)
+    assert_all_terminal(requests)
+    return requests
+
+
+def assert_fleet_health_consistent(router: Router, requests):
+    """The router's outcome tally must equal the per-request outcomes
+    — the fleet twin of ``assert_health_consistent`` (the engines'
+    own counters count ATTEMPTS, which legitimately exceed client
+    requests under requeue; the router's count client terminals)."""
+    tally = {o.value: 0 for o in Outcome}
+    for r in requests:
+        tally[r.outcome.value] += 1
+    if tally != router.health:
+        raise MXNetError(f"router health {router.health} != outcome "
+                         f"tally {tally}")
 
 
 def run_chaos(engine: InferenceEngine, requests, injectors,

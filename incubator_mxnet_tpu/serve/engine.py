@@ -805,15 +805,28 @@ class InferenceEngine:
     def unservable(self) -> int:
         return self.health[Outcome.FAILED_UNSERVABLE.value]
 
+    def _retry_hint(self) -> float:
+        """The machine-readable backoff hint attached to every
+        retryable terminal: the EWMA of observed slot-residence times
+        (how long until capacity realistically frees), or a small
+        default before a first completion calibrates it."""
+        return self._ewma_service_s if self._ewma_service_s else 0.05
+
     def _record_terminal(self, request: Request, outcome: Outcome,
                          detail: str = "",
                          retry_after: Optional[float] = None):
         """The single point where a request becomes terminal — exactly
-        once, with the health counter kept consistent."""
+        once, with the health counter kept consistent. Every
+        shed/deadline-class (``Outcome.retryable``) terminal carries a
+        ``retry_after_s`` hint — callers may pass a sharper estimate,
+        but no retryable outcome ever leaves without one (the single
+        backoff contract clients and the fleet router consume)."""
         if request.outcome is not None:
             raise MXNetError(
                 f"request already terminal ({request.outcome}) — "
                 f"double-finish is an engine bug")
+        if retry_after is None and outcome.retryable:
+            retry_after = self._retry_hint()
         request.outcome = outcome
         request.detail = detail
         request.retry_after_s = retry_after
@@ -844,6 +857,74 @@ class InferenceEngine:
         waves = (len(self._queue) - free) // self.num_slots + 1
         return waves * self._ewma_service_s
 
+    def health_snapshot(self) -> dict:
+        """A CONSISTENT, detached copy of the engine's health state.
+
+        ``engine.health`` is a live-mutated dict — a scraper (or the
+        fleet router's scheduling read) iterating it while the
+        scheduler records terminals can see torn state, and anything
+        that stores the reference sees values silently change under
+        it. This returns a snapshot taken in one pass — outcome
+        counters plus the scheduling signals the router routes on
+        (queue depth, free slots, EWMA service time, estimated
+        admission delay) — that never mutates after return. All
+        ``serve_bench``/``chaos_bench`` reporting and the router's
+        least-delay spill read through here, never through the live
+        dict."""
+        return {
+            "outcomes": dict(self.health),
+            "queue_depth": len(self._queue),
+            "active_slots": self.active_count,
+            "free_slots": self.num_slots - self.active_count,
+            "num_slots": self.num_slots,
+            "ewma_service_s": self._ewma_service_s,
+            "estimated_queue_delay_s": self._estimated_queue_delay(),
+            "free_pages": self._alloc.free_count,
+            "decode_steps": self.decode_steps,
+            "drafted_tokens": self.drafted_tokens,
+            "accepted_tokens": self.accepted_tokens,
+            "prefix_hits": self.prefix_hits,
+            "prefix_lookups": self.prefix_lookups,
+            "prefix_hit_tokens": self.prefix_hit_tokens,
+        }
+
+    def prefix_probe(self, prompt_ids) -> int:
+        """READ-ONLY cache-affinity query: how many leading tokens of
+        ``prompt_ids`` this engine's prefix index has cached right now.
+        No refcounts move, no LRU clock ticks, nothing compiles — a
+        router may probe every replica per admission for free. 0 when
+        the prefix cache is off (an affinity-blind replica)."""
+        if self._prefix is None:
+            return 0
+        return int(self._prefix.probe(prompt_ids))
+
+    def can_serve(self, total_positions: int) -> bool:
+        """Could a request spanning ``total_positions`` (prompt +
+        max_new_tokens) EVER be served by this engine? The single
+        definition of the servability bound — ``submit``'s fail-fast,
+        the fleet router's fleet-wide admission check, and its
+        per-replica routing filter all call this, so the bound can
+        never drift between the engine and the router."""
+        need = -(-total_positions // self.page_size)
+        return total_positions <= self.max_len and \
+            need <= self.num_pages - 1
+
+    def withdraw(self, request: Request) -> bool:
+        """Remove a still-QUEUED request from the admission queue
+        without recording a terminal (the caller owns the outcome) —
+        the fleet router's starved-attempt give-up. Returns False when
+        the request is not in the queue (already admitted or
+        terminal). Queued requests hold no pages, so nothing else
+        needs releasing. Removal is by IDENTITY: Request's generated
+        __eq__ compares ndarray fields, so deque.remove would raise
+        mid-scan on a same-shape neighbour instead of finding the
+        target."""
+        for i, q in enumerate(self._queue):
+            if q is request:
+                del self._queue[i]
+                return True
+        return False
+
     def submit(self, request: Request) -> bool:
         """Admission-queue entry with load shedding. Returns True when
         the request was queued; False when it was refused — already
@@ -856,7 +937,7 @@ class InferenceEngine:
             request._deadline_abs = request.submit_time + request.deadline_s
         total = int(request.prompt_ids.size) + request.max_new_tokens
         need = -(-total // self.page_size)
-        if total > self.max_len or need > self.num_pages - 1:
+        if not self.can_serve(total):
             self._record_terminal(
                 request, Outcome.FAILED_UNSERVABLE,
                 f"request needs {total} positions / {need} pages but the "

@@ -20,10 +20,18 @@ offer under overload and faults (docs/RESILIENCE.md). The taxonomy:
   FAILED_UNSERVABLE   the request can never (or did not, within the
                       watchdog/stall budget) get the pages it needs —
                       too large for the pool, or page-starved
+  FAILED_REPLICA      the fleet router re-queued the request across
+                      replica deaths ``max_requeues`` times (or had no
+                      serving replica left) and gave up — bounded
+                      recovery, never a silent loss (serve/router.py)
 
 ``EOS`` and ``MAX_TOKENS`` are the success outcomes (``.ok``); the
-other four are the failure surface the chaos harness (serve/chaos.py,
-tools/chaos_bench.py) drives and asserts.
+rest are the failure surface the chaos harness (serve/chaos.py,
+tools/chaos_bench.py) drives and asserts. ``.retryable`` marks the
+outcomes a client (or the fleet router) may legitimately retry —
+every terminal with a retryable outcome carries a machine-readable
+``retry_after_s`` backoff hint (one contract, engine- and
+router-level; asserted in tests/test_router.py).
 """
 
 from __future__ import annotations
@@ -40,12 +48,22 @@ class Outcome(enum.Enum):
     SHED = "SHED"
     FAILED_NONFINITE = "FAILED_NONFINITE"
     FAILED_UNSERVABLE = "FAILED_UNSERVABLE"
+    FAILED_REPLICA = "FAILED_REPLICA"
 
     @property
     def ok(self) -> bool:
         """True for the success outcomes (the request's own stopping
         condition, not an engine intervention)."""
         return self in (Outcome.EOS, Outcome.MAX_TOKENS)
+
+    @property
+    def retryable(self) -> bool:
+        """True for the shed/deadline-class outcomes a client may retry
+        (elsewhere, or later): the request itself was fine, the system
+        lacked capacity/time/replicas for it. These are exactly the
+        outcomes that must carry a ``retry_after_s`` hint."""
+        return self in (Outcome.SHED, Outcome.DEADLINE_EXPIRED,
+                        Outcome.FAILED_REPLICA)
 
     def __str__(self) -> str:  # readable in logs / JSON dumps
         return self.value

@@ -199,8 +199,8 @@ class PrefixIndex:
         self._clock += 1
         return self._clock
 
-    def match(self, prompt_ids) -> Tuple[List[int],
-                                         Optional[Tuple[int, int]], int]:
+    def match(self, prompt_ids, mutate: bool = True) \
+            -> Tuple[List[int], Optional[Tuple[int, int]], int]:
         """Longest cached page-aligned prefix of ``prompt_ids``.
 
         Returns ``(shared, partial, cached_len)``: ``shared`` is the
@@ -209,7 +209,10 @@ class PrefixIndex:
         page whose first ``n_tokens`` match (to copy into a private
         page), or None, and ``cached_len == page_size * len(shared) +
         n_tokens`` is the number of prompt tokens whose K/V is already
-        cached (always <= t0 - 1)."""
+        cached (always <= t0 - 1).
+
+        ``mutate=False`` skips the LRU ``last_use`` ticks — the
+        ``probe`` read, identical traversal, zero side effects."""
         ps = self.page_size
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         t0 = prompt.size
@@ -228,7 +231,8 @@ class PrefixIndex:
                         break
             if full is not None:
                 # whole page matches and the prompt continues past it
-                full.last_use = self._tick()
+                if mutate:
+                    full.last_use = self._tick()
                 shared.append(full.page)
                 m += 1
                 continue
@@ -244,10 +248,24 @@ class PrefixIndex:
                 if n > best_n:
                     best, best_n = ent, n
             if best is not None:
-                best.last_use = self._tick()
+                if mutate:
+                    best.last_use = self._tick()
                 return shared, (best.page, best_n), m * ps + best_n
             break
         return shared, None, m * ps
+
+    def probe(self, prompt_ids) -> int:
+        """READ-ONLY twin of ``match``: how many leading tokens of
+        ``prompt_ids`` are cached right now. Touches NOTHING — no
+        refcounts (it returns no pages to pin), no LRU clock ticks —
+        so a fleet router may probe every replica per admission
+        without perturbing any replica's eviction order
+        (serve/router.py's cache-affinity read; asserted
+        side-effect-free in tests/test_router.py). One traversal
+        serves both callers (``match(..., mutate=False)``), so the
+        affinity estimate can never drift from what admission will
+        actually reuse."""
+        return self.match(prompt_ids, mutate=False)[2]
 
     def insert(self, prompt_ids, pages, allocator: PageAllocator) -> int:
         """Publish the prompt's FULL pages (``pages[j]`` holds tokens

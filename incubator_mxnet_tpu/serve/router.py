@@ -1,0 +1,911 @@
+"""Fleet router: N serving replicas behind one admission surface.
+
+One excellent engine is not a serving tier — "heavy traffic from
+millions of users" (ROADMAP item 1) means N ``InferenceEngine``
+replicas, and the two things a fleet adds that no single engine can:
+
+  - **Cache-affinity routing.** The 2.7x warm-vs-cold tokens/s win of
+    prefix caching (BENCH_SERVE.json) only survives scale-out if a
+    request lands where its prefix lives. Admission probes every
+    SERVING replica's prefix index (``engine.prefix_probe`` — a
+    read-only query, no refcounts, no LRU ticks) and routes to the
+    longest match; when nobody has the prefix, it SPILLS to the
+    least-estimated-delay replica (the ``health_snapshot`` EWMA/queue
+    signals). Affinity is a preference among replicas WITH capacity —
+    a full replica is never chosen over an idle one just because it is
+    warm (the spill rule).
+  - **Structured failover.** A replica death mid-decode must become a
+    bounded re-queue, never a lost (or double-finished) request.
+    Per-replica health states:
+
+        SERVING   routable; heartbeats healthy
+        DEGRADED  circuit breaker open after ``breaker_failures``
+                  consecutive heartbeat misses (a step slower than
+                  ``heartbeat_timeout_s``): no new admissions; the
+                  replica is only stepped as a HALF-OPEN PROBE on a
+                  seeded-jitter exponential backoff schedule;
+                  ``probe_recovery`` consecutive healthy probes close
+                  the breaker back to SERVING
+        DEAD      the replica raised out of a step (``ReplicaKilled``
+                  or any engine exception — its state can no longer be
+                  trusted): terminal, never probed again
+
+    On death every in-flight request of that replica is RE-QUEUED with
+    its already-emitted tokens preserved: the replay attempt's prompt
+    is ``original prompt + emitted tokens`` submitted through NORMAL
+    admission on another replica — so the prefix cache absorbs the
+    redone work, and because sampling is keyed by absolute sequence
+    position under a router-pinned per-request seed, the continuation
+    is bit-identical to the tokens the dead replica would have
+    produced (greedy trivially; temperature by the per-request RNG
+    convention, docs/SERVING.md). Re-queues are BOUNDED:
+    ``max_requeues`` per request, after which the request terminates
+    ``FAILED_REPLICA`` — a structured give-up with a ``retry_after_s``
+    hint, never a silent loss. An in-flight attempt that a replica
+    sheds underneath the router (SIGTERM drain / ``shutdown()``) is
+    re-queued through the same bounded path.
+
+Fleet-wide backpressure: ``max_queue`` bounds the router's own queue
+and ``max_queue_delay_s`` sheds at ROUTER admission when every serving
+replica's estimated delay (plus the router backlog riding on top) is
+over the limit — the fleet refuses early instead of queuing blindly
+into replicas whose own shedding would only bounce the request around.
+Every shed/deadline-class terminal the router records carries the same
+machine-readable ``retry_after_s`` contract as the engine's
+(``Outcome.retryable`` — one backoff surface for clients at both
+levels).
+
+Everything here is host-side scheduling over the engines' existing
+data-plane contracts: no program compiles, no engine invariant bends —
+the fleet chaos harness (serve/chaos.py ``KillReplica`` /
+``SlowReplica`` / ``FlappingReplica``, tools/chaos_bench.py
+``--fleet``) asserts exactly-one-terminal-outcome, survivor token
+parity, per-step page audits on surviving replicas, and the jit-once
+compile discipline per replica, under every injected failure.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from collections import deque
+from typing import List, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+from .engine import InferenceEngine, Request
+from .outcomes import Outcome
+
+__all__ = ["Router", "Replica", "ReplicaState", "ReplicaKilled",
+           "build_fleet"]
+
+
+class ReplicaState(enum.Enum):
+    SERVING = "SERVING"
+    DEGRADED = "DEGRADED"
+    DEAD = "DEAD"
+
+    def __str__(self) -> str:
+        return self.value
+
+
+class ReplicaKilled(MXNetError):
+    """The process-death fault: a killed replica raises this from every
+    subsequent step — the in-process stand-in for 'the replica's host
+    stopped answering' (serve/chaos.py ``KillReplica``)."""
+
+
+class Replica:
+    """One engine plus the router's view of its health. The router
+    never reads a DEAD replica's engine again — its in-flight requests
+    are harvested from the ROUTER'S own bookkeeping (the token stream
+    it already received), not from the dead engine's memory."""
+
+    def __init__(self, idx: int, engine: InferenceEngine):
+        self.idx = idx
+        self.engine = engine
+        self.state = ReplicaState.SERVING
+        self.killed: Optional[str] = None    # chaos kill reason
+        self.delay_s = 0.0                   # chaos per-step stall
+        self.consecutive_misses = 0          # heartbeat misses in a row
+        self.probe_successes = 0
+        self.backoff_s: Optional[float] = None
+        self.next_probe_t = 0.0
+        self.breaker_opens = 0
+        self.probes = 0
+        self.steps = 0
+        self.death_detail = ""
+
+    def kill(self, reason: str = "killed"):
+        """Mark the replica process dead: every later ``step`` raises
+        ``ReplicaKilled`` (the chaos harness's kill switch)."""
+        self.killed = reason
+
+    def _traces(self) -> int:
+        e = self.engine
+        return (e.decode_trace_count + e.verify_trace_count +
+                e.prefill_trace_count + e.copy_trace_count)
+
+    def step(self):
+        """One engine scheduler step. Returns ``(advanced, wall_s,
+        compiled)``; raises when the replica is dead. ``compiled``
+        flags a step that traced a new program — expected-slow, so the
+        router exempts it from the heartbeat (a cold replica warming
+        its programs is not a sick replica)."""
+        if self.killed is not None:
+            raise ReplicaKilled(f"replica {self.idx} {self.killed}")
+        t0 = time.perf_counter()
+        tr0 = self._traces()
+        if self.delay_s:
+            time.sleep(self.delay_s)         # chaos SlowReplica stall
+        n = self.engine.step()
+        self.steps += 1
+        return n, time.perf_counter() - t0, self._traces() > tr0
+
+
+@dataclasses.dataclass(eq=False)        # identity semantics: tracked
+class _Tracked:                         # entries live in lists and the
+                                        # generated __eq__ would compare
+                                        # the client's ndarray fields
+    """The router's record of one CLIENT request: which replica is
+    serving its current attempt, and how many times it has been
+    re-queued. The client ``Request`` accumulates the token stream
+    across attempts; each attempt is a fresh engine-level ``Request``
+    (resume-from-suffix replay)."""
+
+    client: Request
+    attempt: Optional[Request] = None
+    replica: Optional[int] = None
+    requeues: int = 0
+
+
+class Router:
+    """Host-side fleet front: cache-affinity admission + bounded
+    replica failover over ``engines`` (see the module docstring).
+
+    ``affinity=False`` degrades routing to pure round-robin over
+    serving replicas with capacity — the control arm of
+    ``serve_bench --fleet``. ``replica_queue_depth`` caps how many
+    requests the router parks in any one replica's own admission queue
+    (shallow per-replica queues keep the blast radius of a death
+    small and the spill estimate honest); it defaults to the replica's
+    slot count."""
+
+    def __init__(self, engines: List[InferenceEngine], *,
+                 affinity: bool = True, max_requeues: int = 2,
+                 heartbeat_timeout_s: float = 0.75,
+                 breaker_failures: int = 3,
+                 probe_backoff_s: float = 0.05,
+                 probe_backoff_max_s: float = 2.0,
+                 probe_recovery: int = 2,
+                 replica_queue_depth: Optional[int] = None,
+                 max_queue: Optional[int] = None,
+                 max_queue_delay_s: Optional[float] = None,
+                 stall_steps: int = 2000, seed: int = 0):
+        if not engines:
+            raise MXNetError("a fleet needs at least one replica")
+        self.replicas = [Replica(i, e) for i, e in enumerate(engines)]
+        self.affinity = bool(affinity)
+        self.max_requeues = int(max_requeues)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.breaker_failures = int(breaker_failures)
+        self.probe_backoff_s = float(probe_backoff_s)
+        self.probe_backoff_max_s = float(probe_backoff_max_s)
+        self.probe_recovery = int(probe_recovery)
+        self.replica_queue_depth = replica_queue_depth
+        self.max_queue = None if max_queue is None else int(max_queue)
+        self.max_queue_delay_s = max_queue_delay_s
+        self.stall_steps = int(stall_steps)
+        self._rng = np.random.RandomState(seed)
+        # jitter draws MUST NOT share the seed stream: a breaker event
+        # interleaving rand() calls would shift every later request's
+        # pinned seed, silently breaking survivor parity between a
+        # faulted and a fault-free run of a temperature workload
+        self._jitter_rng = np.random.RandomState(
+            (seed + 0x9E3779B9) & 0xFFFFFFFF)   # stay in the u32 seed
+                                                # domain for any seed
+        self._queue: deque = deque()         # _Tracked awaiting dispatch
+        self._inflight: List[_Tracked] = []
+        self._rr = 0                         # round-robin cursor
+        self._stall = 0
+        self.steps = 0
+        self.health: dict = {o.value: 0 for o in Outcome}
+        self.requeues = 0
+        self.replica_deaths = 0
+        self.breaker_opens = 0
+        self.probes = 0
+        self.recoveries = 0
+        self.affinity_routed = 0
+        self.spill_routed = 0
+        self.log: List[str] = []
+
+    # ------------------------------------------------------------- #
+    # terminal accounting (the client-facing twin of the engine's)
+    # ------------------------------------------------------------- #
+
+    def _fleet_retry_hint(self) -> float:
+        """Backoff hint from the healthiest view available: the
+        smallest calibrated EWMA service time across live replicas
+        (read through ``health_snapshot`` like every other router
+        read of engine state)."""
+        ewmas = [r.engine.health_snapshot()["ewma_service_s"]
+                 for r in self.replicas
+                 if r.state is not ReplicaState.DEAD]
+        ewmas = [e for e in ewmas if e]
+        return min(ewmas) if ewmas else 0.05
+
+    def _record_terminal(self, request: Request, outcome: Outcome,
+                         detail: str = "",
+                         retry_after: Optional[float] = None):
+        """Exactly-once terminal recording for CLIENT requests — the
+        router-level twin of the engine's ``_record_terminal``, same
+        double-finish refusal, same retryable-outcomes-carry-a-hint
+        contract."""
+        if request.outcome is not None:
+            raise MXNetError(
+                f"request already terminal ({request.outcome}) — "
+                f"double-finish is a router bug")
+        if retry_after is None and outcome.retryable:
+            retry_after = self._fleet_retry_hint()
+        request.outcome = outcome
+        request.detail = detail
+        request.retry_after_s = retry_after
+        request.finish_time = time.perf_counter()
+        self.health[outcome.value] += 1
+
+    # ------------------------------------------------------------- #
+    # admission
+    # ------------------------------------------------------------- #
+
+    def _alive(self) -> List[Replica]:
+        return [r for r in self.replicas
+                if r.state is not ReplicaState.DEAD]
+
+    def _serving(self) -> List[Replica]:
+        return [r for r in self.replicas
+                if r.state is ReplicaState.SERVING]
+
+    def _fleet_delay_estimate(self) -> Optional[float]:
+        """Estimated admission delay for a NEWLY submitted request:
+        the best serving replica's own estimate, plus the router
+        backlog's waves riding on top of the fleet's total slots.
+        None until any replica has a calibrated EWMA."""
+        serving = self._serving()
+        if not serving:
+            return None
+        ests, ewmas, slots = [], [], 0
+        for r in serving:
+            snap = r.engine.health_snapshot()
+            est = snap["estimated_queue_delay_s"]
+            if est is None and snap["free_slots"] > 0:
+                # an uncalibrated replica with free slots can take the
+                # request NOW — it must pull the fleet estimate to 0,
+                # not silently drop out (shedding while a replica
+                # idles would refuse work the fleet can do)
+                est = 0.0
+            if est is not None:
+                ests.append(est)
+            if snap["ewma_service_s"]:
+                ewmas.append(snap["ewma_service_s"])
+            slots += snap["num_slots"]
+        if not ests and not ewmas:
+            return None
+        base = min(ests) if ests else 0.0
+        if self._queue and ewmas:
+            base += (len(self._queue) // max(slots, 1)) * min(ewmas)
+        return base
+
+    def submit(self, request: Request) -> bool:
+        """Fleet admission. Returns True when the request was accepted
+        for routing; False when it is already terminal — SHED (fleet
+        saturated / router queue bound, ``retry_after_s`` set),
+        FAILED_UNSERVABLE (no replica could EVER hold it), or
+        FAILED_REPLICA (no live replica at all)."""
+        request.submit_time = time.perf_counter()
+        if request.deadline_s is not None:
+            request._deadline_abs = request.submit_time + request.deadline_s
+        alive = self._alive()
+        if not alive:
+            self._record_terminal(
+                request, Outcome.FAILED_REPLICA,
+                "no live replica in the fleet")
+            return False
+        total = int(request.prompt_ids.size) + request.max_new_tokens
+        if not any(r.engine.can_serve(total) for r in alive):
+            self._record_terminal(
+                request, Outcome.FAILED_UNSERVABLE,
+                f"request needs {total} positions but no replica can "
+                f"ever hold it")
+            return False
+        if self.max_queue is not None and \
+                len(self._queue) >= self.max_queue:
+            self._record_terminal(
+                request, Outcome.SHED,
+                f"router queue at depth limit {self.max_queue}")
+            return False
+        if self.max_queue_delay_s is not None:
+            est = self._fleet_delay_estimate()
+            if est is not None and est > self.max_queue_delay_s:
+                self._record_terminal(
+                    request, Outcome.SHED,
+                    f"fleet-wide estimated delay {est:.3f}s exceeds "
+                    f"{self.max_queue_delay_s}s",
+                    retry_after=est)
+                return False
+        if request.seed is None:
+            # pin the sampling stream NOW: a replay attempt on another
+            # replica must reproduce the original's draws exactly
+            # (position-keyed RNG + same seed == same continuation)
+            request.seed = int(self._rng.randint(0, 2 ** 31 - 1))
+        self._queue.append(_Tracked(client=request))
+        return True
+
+    # ------------------------------------------------------------- #
+    # routing
+    # ------------------------------------------------------------- #
+
+    def _capacity(self, rep: Replica, snap: dict) -> bool:
+        """Will this replica take an admission right now? Respects the
+        router's shallow-queue policy AND the engine's own admission
+        bounds (``max_queue`` / ``max_queue_delay_s``, read from the
+        snapshot) — submitting into a replica that will predictably
+        shed would only churn attempt objects and engine SHED
+        terminals until capacity frees."""
+        eng = rep.engine
+        depth = self.replica_queue_depth
+        if depth is None:
+            depth = eng.num_slots
+        if eng.max_queue is not None:
+            depth = min(depth, eng.max_queue)
+        if not (snap["free_slots"] > 0 or snap["queue_depth"] < depth):
+            return False
+        if eng.max_queue_delay_s is not None:
+            est = snap["estimated_queue_delay_s"]
+            if est is not None and est > eng.max_queue_delay_s:
+                return False
+        return True
+
+    def _attempt_prompt(self, tracked: _Tracked) -> np.ndarray:
+        """The replay prompt: original prompt + every token already
+        delivered — resume-from-suffix through normal admission."""
+        c = tracked.client
+        if not c.token_ids:
+            return c.prompt_ids
+        return np.concatenate([c.prompt_ids,
+                               np.asarray(c.token_ids, np.int32)])
+
+    def _can_hold(self, rep: Replica, tracked: _Tracked) -> bool:
+        """Per-replica servability — the engine's own bound
+        (``can_serve``), so routing can never drift from what a
+        replica's admission will accept. In a heterogeneous fleet a
+        request must never be spilled onto a replica that would FAIL
+        it as unservable while a bigger sibling could serve it."""
+        c = tracked.client
+        return rep.engine.can_serve(int(c.prompt_ids.size) +
+                                    c.max_new_tokens)
+
+    def _route(self, tracked: _Tracked, snaps) -> Optional[Replica]:
+        """Pick a replica for this (re)admission: longest prefix match
+        among SERVING replicas with capacity (that can hold the
+        request at all); least-estimated-delay spill when nobody has
+        the prefix (or affinity is off: round-robin). None when no
+        serving replica has capacity. ``snaps`` is the dispatch
+        pass's one-snapshot-per-replica view (re-snapshotting every
+        replica for every queued request would churn dict builds in
+        the host hot loop for staleness the live read tolerated
+        anyway)."""
+        cands = [(r, s) for r, s in snaps
+                 if self._can_hold(r, tracked)
+                 and self._capacity(r, s)]
+        if not cands:
+            return None
+        if self.affinity:
+            prompt = self._attempt_prompt(tracked)
+            best, best_len = None, 0
+            for r, _ in cands:
+                n = r.engine.prefix_probe(prompt)
+                if n > best_len:
+                    best, best_len = r, n
+            if best is not None:
+                self.affinity_routed += 1
+                return best
+            # spill: least estimated delay, then shortest backlog —
+            # occupancy derived from the pass view's free_slots so
+            # this pass's own assignments count as load (active_slots
+            # is the stale pre-pass reading)
+            def load(rs):
+                r, s = rs
+                est = s["estimated_queue_delay_s"]
+                occupied = s["num_slots"] - s["free_slots"]
+                return (est if est is not None else 0.0,
+                        s["queue_depth"] +
+                        occupied / max(1, s["num_slots"]),
+                        r.idx)
+            rep = min(cands, key=load)[0]
+            self.spill_routed += 1
+            return rep
+        rep = cands[self._rr % len(cands)][0]
+        self._rr += 1
+        self.spill_routed += 1
+        return rep
+
+    def _remint_if_complete(self, tracked: _Tracked) -> bool:
+        """Torn-engine-death completion: a replica that died AFTER
+        emitting a request's final (or EOS) token but BEFORE recording
+        the terminal leaves a preserved stream that already satisfies
+        the request. Re-mint the success terminal the dead replica
+        owed — the stream is complete, not replayable (a replay would
+        feed EOS back through the prompt, or need max_new_tokens=0,
+        whose validation raise would escape run()). Returns True when
+        a terminal was minted. Checked on EVERY path that would
+        replay or give up (dispatch AND the requeue-budget bound —
+        FAILED_REPLICA on a complete stream would tell the client to
+        retry work it already has)."""
+        c = tracked.client
+        if c.eos_id >= 0 and int(c.eos_id) in c.token_ids:
+            stop = c.token_ids.index(int(c.eos_id)) + 1
+            del c.token_ids[stop:]
+            del c.token_times[stop:]
+            del c.token_stamps[stop:]
+            self._record_terminal(
+                c, Outcome.EOS,
+                "completed across a replica death (EOS preserved, "
+                "terminal re-minted by the router)")
+            return True
+        if c.max_new_tokens - len(c.token_ids) <= 0:
+            self._record_terminal(
+                c, Outcome.MAX_TOKENS,
+                "completed across a replica death (final token "
+                "preserved, terminal re-minted by the router)")
+            return True
+        return False
+
+    def _make_attempt(self, tracked: _Tracked) -> Optional[Request]:
+        c = tracked.client
+        if self._remint_if_complete(tracked):
+            return None
+        remaining = c.max_new_tokens - len(c.token_ids)
+        deadline = None
+        if c._deadline_abs is not None:
+            deadline = c._deadline_abs - time.perf_counter()
+            if deadline <= 0:
+                self._record_terminal(
+                    c, Outcome.DEADLINE_EXPIRED,
+                    "deadline passed before (re)dispatch")
+                return None
+        att = Request(self._attempt_prompt(tracked).copy(),
+                      max_new_tokens=remaining,
+                      temperature=c.temperature, eos_id=c.eos_id,
+                      deadline_s=deadline, seed=c.seed)
+        return att
+
+    def _absorb(self, tracked: _Tracked, att: Request):
+        """Fold an attempt's delivered stream into the client request
+        (the router already streamed these tokens — they are the part
+        of the request no failure may take back)."""
+        c = tracked.client
+        c.token_ids.extend(att.token_ids)
+        c.token_times.extend(att.token_times)
+        c.token_stamps.extend(att.token_stamps)
+        c.drafted_tokens += att.drafted_tokens
+        c.accepted_tokens += att.accepted_tokens
+
+    def _finish_from_attempt(self, tracked: _Tracked, att: Request):
+        self._absorb(tracked, att)
+        self._record_terminal(tracked.client, att.outcome, att.detail,
+                              att.retry_after_s)
+
+    def _requeue(self, tracked: _Tracked, detail: str):
+        """The structured-failover path: bounded, exactly-once-
+        terminal. Already-emitted tokens stay on the client; the next
+        dispatch replays from the suffix."""
+        if self._remint_if_complete(tracked):
+            return                           # nothing left to replay
+        if tracked.requeues >= self.max_requeues:
+            self._record_terminal(
+                tracked.client, Outcome.FAILED_REPLICA,
+                f"gave up after {tracked.requeues} re-queues "
+                f"(max_requeues={self.max_requeues}): {detail}")
+            return
+        tracked.requeues += 1
+        self.requeues += 1
+        self.log.append(f"requeue #{tracked.requeues}: {detail} "
+                        f"({len(tracked.client.token_ids)} tokens "
+                        f"preserved)")
+        self._queue.append(tracked)
+
+    def _dispatch(self) -> int:
+        """Route queued requests to replicas (FIFO). A queue that
+        nothing can take stays queued — unless every replica is DEAD,
+        in which case waiting is a lie and the queue drains to
+        FAILED_REPLICA."""
+        if not self._alive():
+            while self._queue:
+                t = self._queue.popleft()
+                self._record_terminal(
+                    t.client, Outcome.FAILED_REPLICA,
+                    "every replica is dead")
+            return 0
+        dispatched = 0
+        blocked: deque = deque()
+        # one snapshot per replica per pass; admissions bump the local
+        # view so later queue entries see the new depth
+        snaps = [(r, r.engine.health_snapshot())
+                 for r in self._serving()]
+        while self._queue:
+            t = self._queue.popleft()
+            c = t.client
+            if c._deadline_abs is not None and \
+                    time.perf_counter() > c._deadline_abs:
+                self._record_terminal(
+                    c, Outcome.DEADLINE_EXPIRED,
+                    f"deadline ({c.deadline_s}s) passed in the router "
+                    f"queue")
+                continue
+            rep = self._route(t, snaps)
+            if rep is None:
+                blocked.append(t)
+                if not any(self._capacity(r, s) for r, s in snaps):
+                    # fleet-wide out of capacity: nobody behind the
+                    # head can route either — stop scanning
+                    break
+                # the head is blocked PER-REQUEST (only a replica that
+                # cannot hold it, or is degraded, has room — the
+                # heterogeneous-fleet case): let smaller requests
+                # behind it through instead of head-of-line blocking
+                # the whole fleet; the head keeps FIFO priority and
+                # the stall give-up still watches it
+                continue
+            att = self._make_attempt(t)
+            if att is None:
+                continue                     # expired (or completed)
+            if not rep.engine.submit(att):
+                if att.outcome is Outcome.FAILED_UNSERVABLE:
+                    # nothing a retry fixes — propagate
+                    self._finish_from_attempt(t, att)
+                    continue
+                # engine-level shed: the replica's own admission bound
+                # is tighter than the router's capacity view. That is
+                # BACKPRESSURE, not a replica failure — it must not
+                # burn the requeue budget (an instant-retry loop would
+                # terminate healthy-fleet overload as FAILED_REPLICA).
+                # The request goes back to the queue HEAD and this
+                # dispatch pass stops; it waits for capacity like any
+                # queued request, bounded by run()'s stall give-up.
+                blocked.append(t)
+                break
+            t.attempt = att
+            t.replica = rep.idx
+            self._inflight.append(t)
+            dispatched += 1
+            for r, s in snaps:               # keep the pass view honest:
+                if r is rep:                 # the dispatch consumes a
+                    if s["free_slots"] > 0:  # free slot's allowance or
+                        s["free_slots"] -= 1 # a queue place — without
+                    else:                    # this a replica with one
+                        s["queue_depth"] += 1  # free slot would absorb
+                    break                    # a whole burst in one pass
+        blocked.extend(self._queue)
+        self._queue = blocked
+        return dispatched
+
+    # ------------------------------------------------------------- #
+    # health: heartbeat, breaker, half-open probes, death
+    # ------------------------------------------------------------- #
+
+    def _jittered(self, backoff: float) -> float:
+        """Seeded jitter (+0..25%) so a fleet of breakers does not
+        probe in lockstep — deterministic under the router's seed,
+        from a stream SEPARATE from request-seed pinning."""
+        return backoff * (1.0 + 0.25 * float(self._jitter_rng.rand()))
+
+    def _heartbeat_miss(self, rep: Replica, detail: str):
+        rep.consecutive_misses += 1
+        rep.probe_successes = 0
+        now = time.perf_counter()
+        if rep.state is ReplicaState.SERVING:
+            if rep.consecutive_misses >= self.breaker_failures:
+                rep.state = ReplicaState.DEGRADED
+                rep.backoff_s = self.probe_backoff_s
+                rep.next_probe_t = now + self._jittered(rep.backoff_s)
+                rep.breaker_opens += 1
+                self.breaker_opens += 1
+                self.log.append(f"replica {rep.idx}: breaker OPEN "
+                                f"after {rep.consecutive_misses} "
+                                f"misses ({detail})")
+        else:                                # failed half-open probe
+            rep.backoff_s = min(rep.backoff_s * 2.0,
+                                self.probe_backoff_max_s)
+            rep.next_probe_t = now + self._jittered(rep.backoff_s)
+            self.log.append(f"replica {rep.idx}: probe failed, backoff "
+                            f"-> {rep.backoff_s:.3f}s")
+
+    def _step_ok(self, rep: Replica, dt: float, compiled: bool):
+        if compiled:
+            # a step that traced a new program is NEUTRAL: exempt from
+            # the heartbeat (compiles are expected-slow — a cold
+            # replica warming up is not sick) but also NOT probe
+            # evidence (a still-stalled DEGRADED replica must not
+            # close its breaker on a slow-but-compiling step)
+            return
+        if dt > self.heartbeat_timeout_s:
+            self._heartbeat_miss(
+                rep, f"step took {dt:.3f}s > heartbeat "
+                     f"{self.heartbeat_timeout_s}s")
+            return
+        rep.consecutive_misses = 0
+        if rep.state is ReplicaState.DEGRADED:
+            rep.probe_successes += 1
+            if rep.probe_successes >= self.probe_recovery:
+                rep.state = ReplicaState.SERVING
+                rep.backoff_s = None
+                rep.probe_successes = 0
+                self.recoveries += 1
+                self.log.append(f"replica {rep.idx}: breaker CLOSED "
+                                f"(recovered)")
+
+    def _on_replica_death(self, rep: Replica, detail: str):
+        """A step raised: the replica's state can no longer be
+        trusted. Mark it DEAD and re-queue every in-flight request it
+        held — from the ROUTER'S bookkeeping (prompt + the tokens
+        already streamed), never from the dead engine's memory."""
+        rep.state = ReplicaState.DEAD
+        rep.death_detail = detail
+        self.replica_deaths += 1
+        self.log.append(f"replica {rep.idx}: DEAD ({detail})")
+        mine = [t for t in self._inflight if t.replica == rep.idx]
+        for t in mine:
+            self._inflight.remove(t)
+            att, t.attempt, t.replica = t.attempt, None, None
+            if att.outcome is not None and \
+                    att.outcome is not Outcome.SHED:
+                # finished on the replica's last good step, collected
+                # here instead of _collect — still exactly one terminal
+                self._finish_from_attempt(t, att)
+                continue
+            self._absorb(t, att)
+            self._requeue(t, f"replica {rep.idx} died mid-flight: "
+                             f"{detail}")
+
+    # ------------------------------------------------------------- #
+    # the scheduler
+    # ------------------------------------------------------------- #
+
+    def _collect(self):
+        """Harvest finished attempts. A SHED attempt (the replica
+        drained/shut down underneath us, or shed from its queue) is a
+        structured re-queue; everything else propagates to the client
+        as-is."""
+        for t in [t for t in self._inflight
+                  if t.attempt.outcome is not None]:
+            self._inflight.remove(t)
+            att, t.attempt, t.replica = t.attempt, None, None
+            if att.outcome is Outcome.SHED:
+                self._absorb(t, att)
+                self._requeue(t, f"replica shed in flight: "
+                                 f"{att.detail}")
+            else:
+                self._finish_from_attempt(t, att)
+
+    def step(self) -> int:
+        """One fleet scheduler pass: dispatch, step every steppable
+        replica (SERVING always; DEGRADED only when its half-open
+        backoff has elapsed — that step IS the probe), handle
+        heartbeat/breaker transitions and deaths, collect finished
+        attempts. Returns the number of slots that advanced fleet-wide
+        (0 = an idle/blocked pass)."""
+        self.steps += 1
+        self._dispatch()
+        advanced = 0
+        now = time.perf_counter()
+        for rep in self.replicas:
+            if rep.state is ReplicaState.DEAD:
+                continue
+            if rep.state is ReplicaState.DEGRADED:
+                if now < rep.next_probe_t:
+                    continue
+                rep.probes += 1
+                self.probes += 1
+            try:
+                n, dt, compiled = rep.step()
+            except Exception as e:           # ReplicaKilled or torn
+                self._on_replica_death(rep, f"{type(e).__name__}: {e}")
+                continue
+            advanced += n
+            self._step_ok(rep, dt, compiled)
+        self._collect()
+        if self._queue:
+            self._dispatch()                 # freed slots take work now
+        return advanced
+
+    def run(self, requests, arrival_times=None, poll_sleep=1e-3,
+            before_step=None, after_step=None):
+        """Drive ``requests`` until EVERY one is terminal — the fleet
+        twin of ``InferenceEngine.run``, with the same hook surface
+        (``before_step(router, i)`` / ``after_step(router, i)``: the
+        fleet chaos harness's injection and audit points).
+
+        A non-empty router queue that no live replica can absorb while
+        nothing else makes progress gives up on its head, bounded,
+        like the engine's own stall handling: FAILED_UNSERVABLE after
+        ``stall_steps`` idle passes when the fleet is healthy but
+        starved (capacity cause — matching the engine's starved-head
+        outcome), FAILED_REPLICA after ``8 * stall_steps`` when
+        survivors are wedged DEGRADED past recovery (replica-health
+        cause; the larger budget spans several probe-backoff
+        cycles)."""
+        if arrival_times is None:
+            for r in requests:
+                self.submit(r)
+            pending = []
+        else:
+            pending = sorted(zip(arrival_times, requests),
+                             key=lambda p: p[0])
+        t0 = time.perf_counter()
+        it = 0
+        self._stall = 0
+        while pending or self._queue or self._inflight:
+            now = time.perf_counter() - t0
+            while pending and pending[0][0] <= now:
+                self.submit(pending.pop(0)[1])
+            if before_step is not None:
+                before_step(self, it)
+            n = self.step()
+            if after_step is not None:
+                after_step(self, it)
+            it += 1
+            if n > 0:
+                self._stall = 0
+                continue
+            if self._queue or self._inflight:
+                self._stall += 1
+                degraded = any(r.state is ReplicaState.DEGRADED
+                               for r in self._alive())
+                # a DEGRADED replica's recovery is pending (half-open
+                # probes on backoff), so idle passes are expected —
+                # give the breaker loop several full backoff cycles
+                # before concluding it is a wedge, but DO keep
+                # counting: a permanently-degraded fleet must still
+                # give up, bounded, not hang forever
+                limit = self.stall_steps * (8 if degraded else 1)
+                if self._stall > limit:
+                    self._stall = 0
+                    if self._queue:
+                        head = self._queue.popleft()
+                        if degraded:
+                            # replica-health cause: survivors exist
+                            # but none recovered in time
+                            self._record_terminal(
+                                head.client, Outcome.FAILED_REPLICA,
+                                f"no replica recovered within {limit} "
+                                f"idle passes (fleet degraded)")
+                        else:
+                            # capacity/starvation cause on a healthy
+                            # fleet — same outcome as the engine's own
+                            # starved-head give-up (non-retryable:
+                            # 'retry later' is a lie here)
+                            self._record_terminal(
+                                head.client, Outcome.FAILED_UNSERVABLE,
+                                f"router queue head starved for "
+                                f"{limit} idle passes (no serving "
+                                f"replica could admit it)")
+                    else:
+                        # in-flight but frozen: an attempt stuck in a
+                        # replica's OWN admission queue never advances
+                        # and (unlike slotted work, which the engine's
+                        # watchdog evicts) no engine-side give-up
+                        # covers it — the engine's starved-head path
+                        # lives in engine.run(), which the router
+                        # does not use. Withdraw one, bounded, with
+                        # the same cause split as the queue-head
+                        # give-up above.
+                        self._withdraw_starved(degraded, limit)
+                else:
+                    time.sleep(poll_sleep)
+            elif pending:
+                self._stall = 0
+                time.sleep(min(poll_sleep,
+                               max(0.0, pending[0][0] - now)))
+        return requests
+
+    def _withdraw_starved(self, degraded: bool, limit: int) -> bool:
+        """Pull one attempt out of a live replica's admission queue
+        (it holds no pages there) and fail its client — the fleet
+        twin of the engine's own starved-queue-head give-up, with the
+        SAME cause split as the router-queue give-up: FAILED_REPLICA
+        (retryable, hinted) when survivors are wedged DEGRADED,
+        FAILED_UNSERVABLE when the fleet is healthy but starved.
+        Returns True when one was withdrawn; False means every
+        in-flight attempt is slotted (the engines' watchdogs own
+        those)."""
+        for t in list(self._inflight):
+            rep = self.replicas[t.replica]
+            if rep.state is ReplicaState.DEAD:
+                continue
+            if not rep.engine.withdraw(t.attempt):
+                continue                     # slotted, not queued
+            self._inflight.remove(t)
+            att, t.attempt, t.replica = t.attempt, None, None
+            self._absorb(t, att)
+            if degraded:
+                self._record_terminal(
+                    t.client, Outcome.FAILED_REPLICA,
+                    f"attempt parked in degraded replica {rep.idx}'s "
+                    f"admission queue; no recovery within {limit} "
+                    f"idle fleet passes")
+            else:
+                self._record_terminal(
+                    t.client, Outcome.FAILED_UNSERVABLE,
+                    f"attempt starved in replica {rep.idx}'s "
+                    f"admission queue for {limit} idle fleet passes")
+            return True
+        return False
+
+    def shutdown(self, detail: str = "fleet shutdown"):
+        """Drain the whole fleet: every live replica's engine drains
+        (its in-flight attempts go SHED), and every client request —
+        in flight or still queued — terminates SHED with the fleet
+        retry hint. Replica health states are left as they were."""
+        for rep in self._alive():
+            rep.engine.shutdown(detail)
+        for t in list(self._inflight):
+            self._inflight.remove(t)
+            att, t.attempt, t.replica = t.attempt, None, None
+            if att is not None and att.outcome is not None and \
+                    att.outcome is not Outcome.SHED:
+                # finished just before the drain — honor the real
+                # outcome, not the shutdown
+                self._finish_from_attempt(t, att)
+                continue
+            if att is not None:
+                self._absorb(t, att)
+            self._record_terminal(t.client, Outcome.SHED, detail)
+        while self._queue:
+            self._record_terminal(self._queue.popleft().client,
+                                  Outcome.SHED, detail)
+
+    # ------------------------------------------------------------- #
+    # observability
+    # ------------------------------------------------------------- #
+
+    def health_snapshot(self) -> dict:
+        """Consistent fleet-wide snapshot: router outcome tally +
+        routing/failover counters + per-replica state (with each LIVE
+        replica's own ``health_snapshot``; a DEAD replica reports only
+        its state — its engine is gone)."""
+        reps = []
+        for r in self.replicas:
+            entry = {"idx": r.idx, "state": r.state.value,
+                     "breaker_opens": r.breaker_opens,
+                     "probes": r.probes, "steps": r.steps}
+            if r.state is ReplicaState.DEAD:
+                entry["death_detail"] = r.death_detail
+            else:
+                entry["engine"] = r.engine.health_snapshot()
+            reps.append(entry)
+        return {
+            "outcomes": dict(self.health),
+            "queue_depth": len(self._queue),
+            "inflight": len(self._inflight),
+            "requeues": self.requeues,
+            "replica_deaths": self.replica_deaths,
+            "breaker_opens": self.breaker_opens,
+            "probes": self.probes,
+            "recoveries": self.recoveries,
+            "affinity_routed": self.affinity_routed,
+            "spill_routed": self.spill_routed,
+            "replicas": reps,
+        }
+
+
+def build_fleet(model, n_replicas: int, engine_kw: Optional[dict] = None,
+                **router_kw) -> Router:
+    """N homogeneous replicas over ONE model's weights (each engine
+    binds the same parameter arrays — host RAM holds one copy) behind
+    a Router. The common test/bench constructor."""
+    engine_kw = dict(engine_kw or {})
+    engines = [InferenceEngine(model, **engine_kw)
+               for _ in range(n_replicas)]
+    return Router(engines, **router_kw)

@@ -30,12 +30,30 @@ starvation_transient, starvation_full, overload_shed, deadline_storm,
 sigterm (subprocess: cooperative SIGTERM drain + final weight
 snapshot + every request terminal).
 
-``--smoke`` is the CI guard (ci/run.sh chaossmoke stage): the same
-scenarios at a size that runs in minutes on CPU; exits non-zero on any
-violated invariant.
+``--fleet`` switches to the FLEET scenarios (serve/router.py,
+ci/run.sh ``fleetsmoke`` stage): the same workload against a Router
+over N replicas with router-level faults — kill_mid_decode,
+kill_mid_prefill (replica death = structured bounded re-queue with
+emitted tokens preserved), kill_all (every replica dead → bounded
+FAILED_REPLICA give-up, nothing lost), requeue_exhaustion
+(max_requeues=0 → immediate FAILED_REPLICA with partial tokens kept),
+slow_replica (heartbeat misses must open the circuit breaker and
+half-open probes must close it), flapping_replica (the breaker loop
+is re-entrant), fleet_shed (router-level backpressure with
+retry_after_s). Fleet invariants asserted per scenario: 100% of
+requests reach EXACTLY ONE terminal outcome, survivors bit-identical
+to the fault-free fleet run, every SURVIVING replica's
+``audit_pages()`` clean after every router step, each replica's
+decode compiled exactly once, and every retryable outcome carries a
+``retry_after_s`` hint.
+
+``--smoke`` is the CI guard (ci/run.sh chaossmoke / fleetsmoke
+stages): the same scenarios at a size that runs in minutes on CPU;
+exits non-zero on any violated invariant.
 
 Usage:
   python tools/chaos_bench.py --smoke          # CI guard
+  python tools/chaos_bench.py --fleet --smoke  # fleet CI guard
   python tools/chaos_bench.py                  # larger sweep
   python tools/chaos_bench.py --json OUT.json
 """
@@ -170,7 +188,10 @@ def _check_invariants(tag, eng, reqs, baseline, affected, errors,
     if eng.accepted_tokens > eng.drafted_tokens:
         errors.append(f"{tag}: accepted {eng.accepted_tokens} > "
                       f"drafted {eng.drafted_tokens}")
-    return {"outcomes": {o: n for o, n in eng.health.items() if n},
+    # reporting reads the CONSISTENT snapshot, never the live dict
+    snap = eng.health_snapshot()
+    return {"outcomes": {o: n for o, n in snap["outcomes"].items()
+                         if n},
             "unaffected_ok": unaffected_ok,
             "affected": len(affected),
             "drafted": eng.drafted_tokens,
@@ -377,8 +398,310 @@ def run_scenarios(n_requests, errors):
     except Exception as e:
         errors.append(f"deadline_storm: audit failed: {e}")
     results["deadline_storm"] = {
-        "outcomes": {o: n for o, n in eng.health.items() if n},
+        "outcomes": {o: n for o, n in
+                     eng.health_snapshot()["outcomes"].items() if n},
         "stalled_steps": inj.stalled_steps}
+
+    return results
+
+
+# --------------------------------------------------------------------- #
+# fleet scenarios (serve/router.py — ci/run.sh fleetsmoke stage)
+# --------------------------------------------------------------------- #
+
+def _fleet(model, n=2, spec_k=None, router_kw=None, **eng_kw):
+    from incubator_mxnet_tpu.serve import build_fleet
+    cfg = dict(num_slots=4, page_size=8, max_len=128, chunk_pages=1,
+               prefix_cache=True,
+               spec_k=_SPEC_K if spec_k is None else spec_k)
+    cfg.update(eng_kw)
+    rkw = dict(seed=5)
+    rkw.update(router_kw or {})
+    return build_fleet(model, n, engine_kw=cfg, **rkw)
+
+
+def _check_fleet_invariants(tag, router, reqs, baseline, affected,
+                            errors):
+    """The PR 5 invariants lifted to fleet scope. ``affected`` is the
+    set of requests (by identity) whose OUTPUT the fault may change —
+    for pure replica kills it is EMPTY: a killed-and-requeued greedy
+    request must still end bit-identical to the fault-free run
+    (resume-from-suffix replay under position-keyed sampling)."""
+    from incubator_mxnet_tpu.base import MXNetError
+    from incubator_mxnet_tpu.serve import Outcome
+    from incubator_mxnet_tpu.serve.chaos import (
+        assert_fleet_health_consistent)
+    from incubator_mxnet_tpu.serve.router import ReplicaState
+    for i, r in enumerate(reqs):
+        if r.outcome is None:
+            errors.append(f"{tag}: request {i} non-terminal")
+    try:
+        assert_fleet_health_consistent(router, reqs)
+    except MXNetError as e:
+        errors.append(f"{tag}: {e}")
+    survivors = [rep for rep in router.replicas
+                 if rep.state is not ReplicaState.DEAD
+                 and rep.killed is None]
+    for rep in survivors:
+        try:
+            rep.engine.audit_pages()
+        except MXNetError as e:
+            errors.append(f"{tag}: replica {rep.idx} final audit "
+                          f"failed: {e}")
+        eng = rep.engine
+        if eng.decode_trace_count > 1 or eng.verify_trace_count > 1:
+            errors.append(f"{tag}: replica {rep.idx} decode retraced "
+                          f"(narrow {eng.decode_trace_count}, wide "
+                          f"{eng.verify_trace_count})")
+        bad = {k: v for k, v in eng.prefill_trace_counts.items()
+               if v != 1}
+        if bad:
+            errors.append(f"{tag}: replica {rep.idx} prefill buckets "
+                          f"retraced: {bad}")
+    aff_ids = {id(r) for r in affected}
+    mismatches = 0
+    for r, base_tokens in zip(reqs, baseline):
+        if id(r) in aff_ids:
+            continue
+        if r.outcome is not None and r.outcome.ok and \
+                list(r.token_ids) != base_tokens:
+            mismatches += 1
+        if r.outcome is not None and not r.outcome.ok and \
+                list(r.token_ids) != base_tokens[:len(r.token_ids)]:
+            errors.append(f"{tag}: a failed request's partial tokens "
+                          f"are not a prefix of its fault-free stream")
+    if mismatches:
+        errors.append(f"{tag}: {mismatches} completed requests "
+                      f"diverged from the fault-free fleet run")
+    # one backoff contract: every retryable terminal carries its hint
+    for i, r in enumerate(reqs):
+        if r.outcome is not None and r.outcome.retryable and \
+                (r.retry_after_s is None or r.retry_after_s <= 0):
+            errors.append(f"{tag}: request {i} ended {r.outcome} "
+                          f"without a retry_after_s hint")
+    snap = router.health_snapshot()
+    return {"outcomes": {o: n for o, n in snap["outcomes"].items()
+                         if n},
+            "requeues": snap["requeues"],
+            "replica_deaths": snap["replica_deaths"],
+            "breaker_opens": snap["breaker_opens"],
+            "probes": snap["probes"],
+            "recoveries": snap["recoveries"],
+            "affinity_routed": snap["affinity_routed"],
+            "spill_routed": snap["spill_routed"],
+            "replica_states": [e["state"] for e in snap["replicas"]]}
+
+
+def run_fleet_scenarios(n_requests, errors, n_replicas=2):
+    """Router-level chaos: every scenario replays the same workload
+    against a fresh fleet with one deterministic fault.
+
+    The kill_mid_decode fleet runs speculation (_SPEC_K) so the death
+    also lands on the draft-then-verify path; the other scenarios run
+    spec_k=0 to stay inside the fleetsmoke budget (every extra engine
+    pays a wide-verify compile). Token PARITY across the mix is sound
+    by the PR 6 contract: greedy speculation is bit-identical to plain
+    decode, so one fault-free baseline serves both engine configs."""
+    from incubator_mxnet_tpu.serve import Outcome
+    from incubator_mxnet_tpu.serve.chaos import (FlappingReplica,
+                                                 KillReplica,
+                                                 SlowReplica,
+                                                 run_fleet_chaos)
+    from incubator_mxnet_tpu.serve.router import ReplicaState
+    results = {}
+    vocab = 64
+
+    # ---- fault-free fleet baseline -------------------------------- #
+    model = _build_model()
+    rt = _fleet(model, n_replicas, spec_k=0)
+    reqs = _make_requests(n_requests, vocab, seed=42)
+    t0 = time.perf_counter()
+    run_fleet_chaos(rt, reqs, [])
+    wall = time.perf_counter() - t0
+    baseline = [list(r.token_ids) for r in reqs]
+    stats = _check_fleet_invariants("fleet_baseline", rt, reqs,
+                                    baseline, set(), errors)
+    if not all(r.outcome is not None and r.outcome.ok for r in reqs):
+        errors.append("fleet_baseline: not every request succeeded")
+    stats["wall_s"] = wall
+    results["fleet_baseline"] = stats
+
+    # ---- replica killed mid-decode -------------------------------- #
+    # the tentpole invariant: a death is a structured re-queue — zero
+    # lost requests, zero double-finishes, survivors AND replayed
+    # requests bit-identical to the fault-free run
+    model = _build_model()
+    rt = _fleet(model, n_replicas)          # speculative (_SPEC_K)
+    reqs = _make_requests(n_requests, vocab, seed=42)
+    inj = KillReplica(replica=0, at_step=6, phase="decode")
+    run_fleet_chaos(rt, reqs, [inj])
+    stats = _check_fleet_invariants("kill_mid_decode", rt, reqs,
+                                    baseline, set(), errors)
+    if not inj.fired:
+        errors.append("kill_mid_decode: injector never fired")
+    if rt.replica_deaths != 1:
+        errors.append(f"kill_mid_decode: {rt.replica_deaths} deaths "
+                      f"!= 1")
+    if not inj.inflight_at_kill:
+        errors.append("kill_mid_decode: nothing was in flight at the "
+                      "kill — scenario exercised nothing")
+    if rt.requeues == 0:
+        errors.append("kill_mid_decode: death re-queued nothing")
+    if not all(r.outcome is not None and r.outcome.ok for r in reqs):
+        errors.append("kill_mid_decode: a request was lost to the "
+                      "death (requeue budget was sufficient)")
+    for c, pre in inj.inflight_at_kill:
+        if list(c.token_ids[:len(pre)]) != pre:
+            errors.append("kill_mid_decode: a re-queued request's "
+                          "emitted prefix was not preserved")
+    stats["log"] = inj.log + rt.log[:6]
+    results["kill_mid_decode"] = stats
+
+    # ---- replica killed mid-prefill ------------------------------- #
+    # chunked prefill spreads prompts across steps, so the kill lands
+    # on a replica holding a half-built prompt: the replay must redo
+    # it from scratch on another replica (no tokens yet to preserve)
+    model = _build_model()
+    rt = _fleet(model, n_replicas, spec_k=0)
+    reqs = _make_requests(n_requests, vocab, seed=42)
+    inj = KillReplica(replica=0, at_step=2, phase="prefill")
+    run_fleet_chaos(rt, reqs, [inj])
+    stats = _check_fleet_invariants("kill_mid_prefill", rt, reqs,
+                                    baseline, set(), errors)
+    if not inj.fired:
+        errors.append("kill_mid_prefill: injector never fired")
+    if not all(r.outcome is not None and r.outcome.ok for r in reqs):
+        errors.append("kill_mid_prefill: a request was lost")
+    stats["log"] = inj.log
+    results["kill_mid_prefill"] = stats
+
+    # ---- every replica killed ------------------------------------- #
+    # bounded give-up: once the last replica dies, in-flight and
+    # queued requests terminate FAILED_REPLICA (with retry hints and
+    # their partial tokens) — nothing is lost, nothing wedges
+    model = _build_model()
+    rt = _fleet(model, n_replicas, spec_k=0)
+    reqs = _make_requests(n_requests, vocab, seed=42)
+    injs = [KillReplica(replica=i, at_step=5 + 3 * i, seed=i)
+            for i in range(n_replicas)]
+    run_fleet_chaos(rt, reqs, injs)
+    stats = _check_fleet_invariants("kill_all", rt, reqs, baseline,
+                                    reqs, errors)
+    if any(rep.state is not ReplicaState.DEAD for rep in rt.replicas):
+        errors.append("kill_all: a replica survived its kill")
+    failed = [r for r in reqs if r.outcome == Outcome.FAILED_REPLICA]
+    if not failed:
+        errors.append("kill_all: nothing ended FAILED_REPLICA — the "
+                      "give-up path never ran")
+    for r, base_tokens in zip(reqs, baseline):
+        if r.outcome is not None and r.outcome.ok and \
+                list(r.token_ids) != base_tokens:
+            errors.append("kill_all: a request completed before the "
+                          "deaths but diverged from fault-free")
+    stats["log"] = sum((i.log for i in injs), [])
+    results["kill_all"] = stats
+
+    # ---- requeue budget exhausted --------------------------------- #
+    # max_requeues=0: the first death immediately fails its in-flight
+    # requests FAILED_REPLICA — partial tokens kept, hints attached
+    model = _build_model()
+    rt = _fleet(model, n_replicas, spec_k=0,
+                router_kw=dict(max_requeues=0))
+    reqs = _make_requests(n_requests, vocab, seed=42)
+    inj = KillReplica(replica=0, at_step=6, phase="decode")
+    run_fleet_chaos(rt, reqs, [inj])
+    stats = _check_fleet_invariants("requeue_exhaustion", rt, reqs,
+                                    baseline,
+                                    [c for c, _ in inj.inflight_at_kill],
+                                    errors)
+    hit = {id(c) for c, _ in inj.inflight_at_kill}
+    for r in reqs:
+        want = Outcome.FAILED_REPLICA if id(r) in hit else None
+        if want is not None and r.outcome != want:
+            errors.append(f"requeue_exhaustion: an in-flight request "
+                          f"ended {r.outcome}, not FAILED_REPLICA at "
+                          f"max_requeues=0")
+    for c, pre in inj.inflight_at_kill:
+        if list(c.token_ids) != pre:
+            errors.append("requeue_exhaustion: partial tokens were "
+                          "not preserved on the FAILED_REPLICA path")
+    stats["log"] = inj.log
+    results["requeue_exhaustion"] = stats
+
+    # ---- slow replica: the circuit breaker ------------------------ #
+    # slowness must open the breaker (DEGRADED, no new admissions),
+    # half-open probes must close it, and NO request may be lost,
+    # re-routed into divergence, or corrupted by pure slowness
+    model = _build_model()
+    rt = _fleet(model, n_replicas, spec_k=0,
+                router_kw=dict(heartbeat_timeout_s=0.05,
+                               breaker_failures=2,
+                               probe_backoff_s=0.02,
+                               probe_recovery=2))
+    reqs = _make_requests(n_requests, vocab, seed=42)
+    inj = SlowReplica(replica=0, start=4, end=16, sleep_s=0.1)
+    run_fleet_chaos(rt, reqs, [inj],
+                    arrival_times=[0.01 * i for i in range(len(reqs))])
+    stats = _check_fleet_invariants("slow_replica", rt, reqs, baseline,
+                                    set(), errors)
+    if not inj.fired:
+        errors.append("slow_replica: injector never fired")
+    if rt.replicas[0].breaker_opens == 0:
+        errors.append("slow_replica: heartbeat misses never opened "
+                      "the breaker")
+    if rt.replica_deaths:
+        errors.append("slow_replica: slowness must degrade, never "
+                      "kill")
+    if not all(r.outcome is not None and r.outcome.ok for r in reqs):
+        errors.append("slow_replica: a request was lost to slowness")
+    stats["log"] = rt.log[:8]
+    results["slow_replica"] = stats
+
+    # ---- flapping replica: the breaker is re-entrant -------------- #
+    model = _build_model()
+    rt = _fleet(model, n_replicas, spec_k=0,
+                router_kw=dict(heartbeat_timeout_s=0.05,
+                               breaker_failures=2,
+                               probe_backoff_s=0.02,
+                               probe_recovery=1))
+    reqs = _make_requests(n_requests, vocab, seed=42)
+    inj = FlappingReplica(replica=0, start=4, period=12, slow_for=4,
+                          sleep_s=0.1, cycles=2)
+    run_fleet_chaos(rt, reqs, [inj],
+                    arrival_times=[0.015 * i for i in range(len(reqs))])
+    stats = _check_fleet_invariants("flapping_replica", rt, reqs,
+                                    baseline, set(), errors)
+    if not inj.fired:
+        errors.append("flapping_replica: injector never fired")
+    if rt.replicas[0].breaker_opens < 1 or rt.recoveries < 1:
+        errors.append(f"flapping_replica: breaker did not cycle "
+                      f"(opens {rt.replicas[0].breaker_opens}, "
+                      f"recoveries {rt.recoveries})")
+    if not all(r.outcome is not None and r.outcome.ok for r in reqs):
+        errors.append("flapping_replica: a request was lost to "
+                      "flapping")
+    stats["log"] = rt.log[:10]
+    results["flapping_replica"] = stats
+
+    # ---- fleet-level shedding ------------------------------------- #
+    # the router refuses at ITS admission when its queue bound is hit:
+    # bounded, hinted, nothing lost, nothing queued blindly
+    model = _build_model()
+    rt = _fleet(model, n_replicas, spec_k=0,
+                router_kw=dict(max_queue=2, replica_queue_depth=1))
+    reqs = _make_requests(n_requests, vocab, seed=42)
+    run_fleet_chaos(rt, reqs, [])
+    stats = _check_fleet_invariants(
+        "fleet_shed", rt, reqs, baseline,
+        [r for r in reqs if r.outcome is not None and not r.outcome.ok],
+        errors)
+    shed = [r for r in reqs if r.outcome == Outcome.SHED]
+    if not shed:
+        errors.append("fleet_shed: router queue bound never shed")
+    for r in shed:
+        if r.retry_after_s is None or r.retry_after_s <= 0:
+            errors.append("fleet_shed: shed without retry_after_s")
+    results["fleet_shed"] = stats
 
     return results
 
@@ -422,7 +745,8 @@ def _child_main(ckpt_dir):
     report = {
         "preempted": preempted,
         "all_terminal": all(r.outcome is not None for r in reqs),
-        "outcomes": {o: n for o, n in eng.health.items() if n},
+        "outcomes": {o: n for o, n in
+                     eng.health_snapshot()["outcomes"].items() if n},
         "decode_trace_count": eng.decode_trace_count,
         "verify_trace_count": eng.verify_trace_count,
         "committed_steps": mgr.all_steps(),
@@ -521,6 +845,11 @@ def main():
     ap.add_argument("--requests", type=int, default=None)
     ap.add_argument("--skip-sigterm", action="store_true",
                     help="in-process scenarios only")
+    ap.add_argument("--fleet", action="store_true",
+                    help="fleet (router) scenarios instead of the "
+                         "single-engine set (ci/run.sh fleetsmoke)")
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="fleet size for --fleet scenarios")
     ap.add_argument("--spec-k", type=int, default=_SPEC_K,
                     help="draft depth for every scenario engine "
                          "(0 = non-speculative)")
@@ -537,9 +866,13 @@ def main():
     n = args.requests or (10 if args.smoke else 24)
     errors = []
     t0 = time.perf_counter()
-    results = run_scenarios(n, errors)
-    if not args.skip_sigterm:
-        results["sigterm"] = run_sigterm_scenario(errors)
+    if args.fleet:
+        results = run_fleet_scenarios(n, errors,
+                                      n_replicas=args.replicas)
+    else:
+        results = run_scenarios(n, errors)
+        if not args.skip_sigterm:
+            results["sigterm"] = run_sigterm_scenario(errors)
     results["wall_s_total"] = time.perf_counter() - t0
     results["n_requests"] = n
 
@@ -552,8 +885,9 @@ def main():
             f.write("\n")
         print(f"banked {args.json}")
     if not errors:
-        print("chaos: all scenarios quiescent, isolated, audited, "
-              "compile-clean")
+        scope = "fleet" if args.fleet else "chaos"
+        print(f"{scope}: all scenarios quiescent, isolated, audited, "
+              f"compile-clean")
     sys.exit(0 if not errors else 1)
 
 
