@@ -74,6 +74,19 @@ stage_fleetsmoke() {
   JAX_PLATFORMS=cpu python tools/chaos_bench.py --fleet --smoke
 }
 
+stage_trainchaos() {
+  echo "== trainchaos: training resilience guard (seeded faults — NaN"
+  echo "               gradients, overflow storms, persistent poison, NaN"
+  echo "               batches on an fsdp mesh, kill -9 + supervisor resume,"
+  echo "               hung-step watchdog, transient data-iterator IO errors;"
+  echo "               fails on any step without exactly one recorded"
+  echo "               outcome, a skip that mutated params/optimizer state,"
+  echo "               a loss sequence that diverges across kill -9 resume,"
+  echo "               a steady-state retrace, or guard+scaler overhead"
+  echo "               over the smoke bar)"
+  JAX_PLATFORMS=cpu python tools/train_chaos_bench.py --smoke
+}
+
 stage_ckptbench() {
   echo "== ckptbench: elastic-checkpoint regression guard (async commit +"
   echo "              keep-last-k GC + bit-exact capsule resume)"
@@ -93,7 +106,7 @@ ge.dryrun_multichip(8)"
 }
 
 stages=("$@")
-[ ${#stages[@]} -eq 0 ] && stages=(sanity native unit stepbench servebench chaossmoke fleetsmoke ckptbench entry)
+[ ${#stages[@]} -eq 0 ] && stages=(sanity native unit stepbench servebench chaossmoke fleetsmoke trainchaos ckptbench entry)
 for s in "${stages[@]}"; do
   "stage_$s"
 done

@@ -48,7 +48,7 @@ from .ndarray.ndarray import NDArray
 _LAZY_SUBMODULES = (
     "gluon", "symbol", "sym", "optimizer", "kvstore", "metric", "io", "image",
     "initializer", "init", "lr_scheduler", "profiler", "amp", "parallel",
-    "models", "checkpoint",
+    "models", "checkpoint", "train", "serve",
     "runtime", "test_utils", "callback", "util", "engine", "recordio",
     "numpy", "np", "npx", "module", "mod", "model", "executor", "kv",
     "contrib", "operator", "rtc", "monitor", "mon",

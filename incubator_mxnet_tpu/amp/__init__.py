@@ -128,9 +128,25 @@ def _deinit_for_tests() -> None:
 
 def init_trainer(trainer) -> None:
     """Attach a dynamic loss scaler to a Gluon Trainer (parity:
-    ``amp.init_trainer``)."""
+    ``amp.init_trainer``).
+
+    Round 13: ``Trainer.step`` consumes the scaler itself — the fused
+    in-step guard detects overflow on device, the step is skipped as
+    pure traced data (``SKIPPED_NONFINITE``), and the scale
+    halves/grows automatically. Do NOT also call ``unscale`` in that
+    flow (it would double-update the scale); it remains for manual
+    eager loops with the guard off."""
     if not _initialized:
         raise MXNetError("call amp.init() before amp.init_trainer()")
+    if getattr(trainer, "_fused", None) is None or \
+            not trainer._fused.guard:
+        import warnings
+        warnings.warn(
+            "amp.init_trainer on a Trainer without the fused in-step "
+            "guard (fuse_step=False, a non-fusable optimizer, or "
+            "guard=False) — overflow detection never fires and the "
+            "dynamic loss scale will not adapt",
+            UserWarning, stacklevel=2)
     trainer._amp_loss_scaler = LossScaler(
         init_scale=2. ** 16 if _target_dtype == "float16" else 1.)
     trainer._amp_original_scale = trainer._scale

@@ -50,3 +50,22 @@ class LossScaler:
             if self._unskipped >= self._scale_window:
                 self.loss_scale *= self._scale_factor
                 self._unskipped = 0
+
+    # -- checkpoint capsule ride-along (docs/CHECKPOINTING.md) --------- #
+    def state_dict(self) -> dict:
+        """Scale + clean-step streak — rides in the capsule meta so a
+        resumed run re-enters the EXACT scaler trajectory (bit-exact
+        resume contract; without it a restart would re-warm the scale
+        and diverge the loss sequence)."""
+        return {"loss_scale": float(self.loss_scale),
+                "scale_factor": float(self._scale_factor),
+                "scale_window": int(self._scale_window),
+                "unskipped": int(self._unskipped)}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.loss_scale = float(state["loss_scale"])
+        self._scale_factor = float(
+            state.get("scale_factor", self._scale_factor))
+        self._scale_window = int(
+            state.get("scale_window", self._scale_window))
+        self._unskipped = int(state.get("unskipped", 0))

@@ -225,6 +225,52 @@ def _restore_iterator(iterator, meta: dict):
         iterator.set_position(pos)
 
 
+def _scaler_meta(scaler) -> Optional[dict]:
+    return None if scaler is None else scaler.state_dict()
+
+
+def _restore_scaler(owner, attr: str, meta: dict, inject: bool):
+    """Re-enter the dynamic loss-scaler trajectory (a resumed run must
+    not re-warm the scale from its init value — the bit-exact
+    loss-sequence contract, docs/RESILIENCE.md).
+
+    ``inject`` controls what happens when the capsule carries scaler
+    state but the trainer was constructed WITHOUT one: the SPMD trainer
+    applies the scale entirely inside its step program, so injecting a
+    scaler is self-consistent — but a gluon Trainer relies on the USER
+    scaling the loss (``trainer.backward``), and injecting into a loop
+    that calls plain ``loss.backward()`` would silently divide every
+    update by the saved scale. There we warn loudly and drop the
+    state (the run continues correctly, just unscaled)."""
+    state = meta.get("loss_scaler")
+    if state is None:
+        return
+    scaler = getattr(owner, attr, None)
+    if scaler is None:
+        if not inject:
+            if float(state.get("loss_scale", 1.0)) != 1.0:
+                import warnings
+                warnings.warn(
+                    f"capsule carries dynamic loss-scaler state (scale "
+                    f"{state.get('loss_scale')}) but this Trainer has no "
+                    f"loss_scaler — the state is DROPPED and training "
+                    f"resumes unscaled; construct the Trainer with "
+                    f"loss_scaler=LossScaler() to resume scaled training",
+                    RuntimeWarning, stacklevel=3)
+            return
+        from ..amp.loss_scaler import LossScaler
+        scaler = LossScaler()
+        setattr(owner, attr, scaler)
+    scaler.load_state_dict(state)
+
+
+def _restore_step_health(trainer, meta: dict):
+    rec = getattr(trainer, "_recorder", None)
+    state = meta.get("step_health")
+    if rec is not None and state is not None:
+        rec.load_state_dict(state)
+
+
 # ---------------------------------------------------------------------- #
 # gluon.Trainer capsule
 # ---------------------------------------------------------------------- #
@@ -260,6 +306,11 @@ def trainer_capsule(trainer, iterator=None,
         "opt_leaf_counts": leaf_counts,
         "param_names": [p.name for p in trainer._params],
         "iterator": _iterator_meta(iterator),
+        "loss_scaler": _scaler_meta(
+            getattr(trainer, "_amp_loss_scaler", None)),
+        "step_health": (
+            trainer._recorder.state_dict()
+            if getattr(trainer, "_recorder", None) is not None else None),
     }
     meta.update(extra_meta or {})
     return tree, meta
@@ -304,8 +355,11 @@ def restore_trainer(trainer, arrays: Dict[str, np.ndarray], meta: dict,
         # rebind: fresh jit cache keyed against the restored state
         # treedefs (mirrors Trainer.load_states' PR 1 fix)
         from .. import optimizer as opt_mod
-        trainer._fused = opt_mod.FusedApplier(opt) \
+        trainer._fused = opt_mod.FusedApplier(
+            opt, guard=getattr(trainer, "_guard", None)) \
             if getattr(opt, "fusable", True) and trainer._fuse_step else None
+    _restore_scaler(trainer, "_amp_loss_scaler", meta, inject=False)
+    _restore_step_health(trainer, meta)
     _restore_rng(arrays)
     _restore_iterator(iterator, meta)
 
@@ -379,6 +433,12 @@ def spmd_capsule(trainer, iterator=None,
     meta = {
         "kind": "spmd",
         "step": int(trainer.step_count),
+        # the trainer's OWN counter rides separately: meta["step"] may
+        # be overridden by save_checkpoint(step=) with the caller's
+        # loop position (which drifts ahead of step_count once the
+        # guard skips steps), and restore must not feed that into the
+        # Adam-t-driving step_count
+        "step_count": int(trainer.step_count),
         "num_update": int(opt.num_update),
         "index_update_count": {str(k): int(v) for k, v in
                                opt._index_update_count.items()},
@@ -387,6 +447,11 @@ def spmd_capsule(trainer, iterator=None,
         "param_names": [p.name for p in trainer._params],
         "sharding": trainer.sharding_mode,
         "iterator": _iterator_meta(iterator),
+        "loss_scaler": _scaler_meta(
+            getattr(trainer, "loss_scaler", None)),
+        "step_health": (
+            trainer._recorder.state_dict()
+            if getattr(trainer, "_recorder", None) is not None else None),
     }
     meta.update(extra_meta or {})
     return tree, meta
@@ -431,10 +496,12 @@ def restore_spmd(trainer, arrays: Dict[str, np.ndarray], meta: dict,
             template, arrays, f"opt/{slot}",
             expect=int(counts.get(str(slot), 0)) or None))
     trainer._opt_state = new_state
-    trainer.step_count = int(meta.get("step", 0))
+    trainer.step_count = int(meta.get("step_count", meta.get("step", 0)))
     opt.num_update = int(meta.get("num_update", 0))
     opt._index_update_count = {
         int(k): int(v)
         for k, v in (meta.get("index_update_count") or {}).items()}
+    _restore_scaler(trainer, "loss_scaler", meta, inject=True)
+    _restore_step_health(trainer, meta)
     _restore_rng(arrays)
     _restore_iterator(iterator, meta)

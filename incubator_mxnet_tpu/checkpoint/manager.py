@@ -30,6 +30,7 @@ import os
 import signal
 import threading
 import time
+import warnings
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -150,6 +151,7 @@ class CheckpointManager:
         self._sig_prev = None
         self.committed_steps = 0                # cumulative commits
         self.write_retries = 0                  # transient IO retries
+        self.restore_fallbacks = 0              # corrupt-latest fallbacks
         self._injected_failures = 0             # MXTPU_CKPT_FAIL_WRITES
 
     # -- background writer -------------------------------------------- #
@@ -292,15 +294,55 @@ class CheckpointManager:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
-    def restore(self, step: Optional[int] = None
+    def restore(self, step: Optional[int] = None, fallback: bool = True
                 ) -> Tuple[Dict[str, np.ndarray], dict]:
-        """Load a committed step (default: latest) → (arrays, meta)."""
-        if step is None:
-            step = self.latest_step()
-            if step is None:
-                raise MXNetError(
-                    f"no committed checkpoint under {self.directory}")
-        return _manifest.load_step(self.directory, step)
+        """Load a committed step (default: latest) → (arrays, meta).
+
+        When restoring the LATEST step and it turns out unreadable — a
+        corrupt shard (crc32 mismatch), a truncated file, a missing
+        piece — the restore falls back to the previous committed step,
+        walking back through everything keep-last-k retained, and
+        WARNS loudly naming the bad shard each time (this is what
+        keep-last-k is for: an auto-resume must prefer losing a few
+        steps over failing the whole run — docs/RESILIENCE.md).
+        ``fallback=False``, or an EXPLICIT ``step``, restores the old
+        fail-loud behavior (an operator asking for step N wants step N
+        or the error).
+        """
+        if step is not None:
+            return _manifest.load_step(self.directory, step)
+        steps = self.all_steps()
+        if not steps:
+            raise MXNetError(
+                f"no committed checkpoint under {self.directory}")
+        if not fallback:
+            return _manifest.load_step(self.directory, steps[-1])
+        last_err: Optional[BaseException] = None
+        for s in reversed(steps):
+            try:
+                out = _manifest.load_step(self.directory, s)
+            # MXNetError covers crc/truncation/coverage; a corrupt
+            # manifest.json raises ValueError (JSONDecodeError) or
+            # KeyError, and an unreadable file raises OSError — all are
+            # "this step is damaged", exactly what the walk-back is for
+            except (MXNetError, OSError, ValueError, KeyError) as e:
+                warnings.warn(
+                    f"checkpoint step {s} is unreadable ({e}); falling "
+                    f"back to the previous committed step",
+                    RuntimeWarning, stacklevel=2)
+                self.restore_fallbacks += 1
+                last_err = e
+                continue
+            if s != steps[-1]:
+                warnings.warn(
+                    f"restored checkpoint step {s} instead of latest "
+                    f"step {steps[-1]} — newer step(s) were corrupt",
+                    RuntimeWarning, stacklevel=2)
+            return out
+        raise MXNetError(
+            f"every committed checkpoint under {self.directory} "
+            f"({steps}) is unreadable; last error: {last_err}"
+        ) from last_err
 
     def close(self):
         """Drain and shut down. Raises a latched background-write error

@@ -15,6 +15,18 @@ dtype bucket instead of one per parameter. ``fuse_step=False`` (or
 optimizers with per-step host state) restores the eager per-param loop;
 for TPU throughput use ``parallel.SPMDTrainer`` which additionally fuses
 fwd+bwd+psum into the same program (SURVEY.md §3.2).
+
+Round 13 (docs/RESILIENCE.md "Training resilience"): every ``step()``
+ends in exactly one structured ``StepOutcome`` (``trainer.last_outcome``
+/ ``trainer.health`` / ``health_snapshot()``). The fused path carries an
+in-step non-finite guard — a non-finite gradient skips the whole update
+as a traced ``where``-select (params/optimizer state bit-identical,
+counters un-advanced, no retrace) — and an optional dynamic
+``LossScaler`` (``loss_scaler=`` or ``amp.init_trainer``) whose scale
+rides the already-traced ``rescale_grad`` input: overflow skips + halves,
+``scale_window`` clean steps double, never a recompile. K consecutive
+non-finite steps halt loudly (``HALTED_POISONED``) with a diagnostic
+naming the poisoned gradients.
 """
 
 from __future__ import annotations
@@ -27,6 +39,7 @@ import jax.numpy as jnp
 from .. import optimizer as opt_mod
 from ..base import MXNetError, getenv_bool, getenv_int
 from ..kvstore import create as kv_create
+from ..train.outcomes import StepOutcome, StepRecorder
 from .parameter import Parameter, ParameterDict
 
 __all__ = ["Trainer"]
@@ -35,7 +48,9 @@ __all__ = ["Trainer"]
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
-                 update_on_kvstore=None, fuse_step=None):
+                 update_on_kvstore=None, fuse_step=None,
+                 loss_scaler=None, guard=None,
+                 max_consecutive_nonfinite=None):
         if isinstance(params, (dict, ParameterDict)):
             param_list = [params[k] for k in sorted(params.keys())] \
                 if isinstance(params, dict) else list(params.values())
@@ -63,8 +78,30 @@ class Trainer:
             fuse_step = getenv_bool("MXTPU_FUSED_STEP", True)
         self._fuse_step = fuse_step and getattr(
             self._optimizer, "fusable", True)
-        self._fused = opt_mod.FusedApplier(self._optimizer) \
+        self._guard = guard
+        self._fused = opt_mod.FusedApplier(self._optimizer, guard=guard) \
             if self._fuse_step else None
+
+        # round-13 resilience state: one outcome per step, dynamic loss
+        # scaling riding the traced rescale_grad input
+        self._recorder = StepRecorder(max_consecutive_nonfinite)
+        self._amp_loss_scaler = loss_scaler
+        self._amp_original_scale = self._scale
+        self._headgrad_cache: Dict = {}
+        if loss_scaler is not None and (
+                self._fused is None or not self._fused.guard):
+            warnings.warn(
+                "loss_scaler attached but the fused in-step guard is "
+                "off (fuse_step=False, a non-fusable optimizer, or "
+                "guard=False) — overflow detection never fires and the "
+                "scale will not adapt", UserWarning, stacklevel=2)
+        if guard and self._fused is None:
+            warnings.warn(
+                "guard=True requested but the fused step is off "
+                "(fuse_step=False or a non-fusable optimizer) — the "
+                "eager per-param path has no non-finite guard, so "
+                "skip-step and HALTED_POISONED protection are INERT",
+                UserWarning, stacklevel=2)
 
         self._compression_params = compression_params
         self._kvstore = None
@@ -102,10 +139,90 @@ class Trainer:
     def set_learning_rate(self, lr: float):
         self._optimizer.set_learning_rate(lr)
 
+    # -- resilience surface (docs/RESILIENCE.md, round 13) --------------- #
+    @property
+    def health(self) -> dict:
+        """Live per-outcome step counters (use ``health_snapshot()`` for
+        a consistent detached read)."""
+        return self._recorder.health
+
+    @property
+    def last_outcome(self):
+        return self._recorder.last_outcome
+
+    @property
+    def loss_scaler(self):
+        return self._amp_loss_scaler
+
+    def health_snapshot(self) -> dict:
+        """Detached copy of the trainer's health state: outcome
+        counters, consecutive-non-finite streak, and the loss scaler's
+        current scale — the engine ``health_snapshot()`` twin."""
+        snap = self._recorder.snapshot()
+        snap["loss_scale"] = (
+            None if self._amp_loss_scaler is None
+            else float(self._amp_loss_scaler.loss_scale))
+        snap["guard"] = self._fused is not None and self._fused.guard
+        return snap
+
+    def scale_loss(self, loss):
+        """Multiply ``loss`` by the current dynamic loss scale before
+        ``backward()`` (identity without a scaler). ``step()`` divides
+        the gradients back through the traced rescale input. Prefer
+        ``trainer.backward(loss)``, which folds the scale into the
+        backward seed for free instead of adding ops to the graph."""
+        if self._amp_loss_scaler is None:
+            return loss
+        s = self._amp_loss_scaler.loss_scale
+        if isinstance(loss, (list, tuple)):
+            return type(loss)(l * s for l in loss)
+        return loss * s
+
+    def backward(self, loss):
+        """``loss.backward()`` with the dynamic loss scale folded into
+        the HEAD GRADIENT: seeding the cotangent with ``scale`` instead
+        of 1 is mathematically identical to scaling the loss, but adds
+        ZERO ops to the recorded graph — the scaler costs nothing on
+        the dispatch-bound eager path (PERF_NOTES round 13). Accepts a
+        single loss or a list/tuple of losses (like ``scale_loss``).
+        The seed arrays are cached per (scale, shape, dtype); scale
+        changes are halve/double events, so the cache stays tiny."""
+        heads = list(loss) if isinstance(loss, (list, tuple)) else [loss]
+        if self._amp_loss_scaler is None:
+            if len(heads) == 1:
+                heads[0].backward()
+            else:
+                from .. import autograd as _autograd
+                _autograd.backward(heads)
+            return
+        s = float(self._amp_loss_scaler.loss_scale)
+        hgs = [self._headgrad(s, h) for h in heads]
+        if len(heads) == 1:
+            heads[0].backward(out_grad=hgs[0])
+        else:
+            from .. import autograd as _autograd
+            _autograd.backward(heads, hgs)
+
+    def _headgrad(self, s, loss):
+        key = (s, tuple(loss.shape), str(loss.dtype))
+        hg = self._headgrad_cache.get(key)
+        if hg is None:
+            from ..ndarray import NDArray
+            if len(self._headgrad_cache) >= 16:
+                self._headgrad_cache.clear()
+            hg = NDArray(jnp.full(loss.shape, s, dtype=loss.dtype))
+            self._headgrad_cache[key] = hg
+        return hg
+
     # -- the step -------------------------------------------------------- #
     def step(self, batch_size, ignore_stale_grad=False):
         """allreduce grads then update (parity: Trainer.step)."""
         self._init_kvstore()
+        if self._amp_loss_scaler is not None:
+            # the dynamic scale rides the traced rescale_grad input —
+            # growth/decay never retraces (optimizer/fused.py)
+            self._scale = self._amp_original_scale / \
+                self._amp_loss_scaler.loss_scale
         self._optimizer.rescale_grad = self._scale / batch_size
         self._allreduce_grads()
         self._update(ignore_stale_grad)
@@ -184,9 +301,25 @@ class Trainer:
         self._allreduce_grads()
 
     def _update(self, ignore_stale_grad=False):
+        self._recorder.open_step()
+        try:
+            self._update_inner(ignore_stale_grad)
+        except BaseException:
+            # a step that died before reaching the recorder (dispatch
+            # error, interrupt) is a real error, not a step outcome —
+            # close the step so the NEXT one is not falsely accused of
+            # a missing record (recorder may already be closed if the
+            # raise came from the HALTED_POISONED path)
+            self._recorder.abort_step()
+            raise
+
+    def _update_inner(self, ignore_stale_grad=False):
         updater = self._updaters[0]
         fused_items = []
+        sparse_items = []
+        eager_items = []
         touched = []
+        saw_stale_skip = False
         for i, p in enumerate(self._params):
             if p.grad_req == "null":
                 continue
@@ -195,6 +328,7 @@ class Trainer:
                 # backward has not refilled this grad since the last step
                 # (reference Trainer's _fresh_grad contract)
                 if ignore_stale_grad:
+                    saw_stale_skip = True
                     continue
                 warnings.warn(
                     f"Gradient of Parameter `{p.name}` has not been "
@@ -205,24 +339,95 @@ class Trainer:
             touched.append(p)
             if getattr(p, "_grad_stype", "default") == "row_sparse":
                 # sparse-embedding contract (SURVEY.md §2.3 last row):
-                # convert to active rows so the optimizer touches only
-                # them — the index set changes shape per step, so this
-                # stays on the eager path even when fusing
-                from ..ndarray import sparse as _sparse
-                grad = _sparse.cast_storage(grad, "row_sparse")
-                updater(i, grad, p.data())
+                # the active-row index set changes shape per step, so
+                # this stays on the eager path even when fusing — but
+                # it must not run before the guard's verdict, so it is
+                # deferred below
+                sparse_items.append((i, p, grad))
             elif self._fused is not None:
                 fused_items.append((i, p, grad))
             else:
-                updater(i, grad, p.data())
+                eager_items.append((i, p, grad))
+        applied = True
+        guard_on = self._fused is not None and self._fused.guard
+        sparse_grad_vals = tuple(g for _, _, g in sparse_items)
         if fused_items:
-            self._fused.apply(fused_items, updater)
+            # guard verdict is traced data inside the fused programs —
+            # row_sparse grads join it so the skip is all-or-nothing
+            # across EVERY parameter; the host reads the flag after
+            # dispatch (optimizer/fused.py)
+            applied = self._fused.apply(
+                fused_items, updater,
+                extra_grads=sparse_grad_vals if guard_on else ())
+        elif sparse_items and guard_on:
+            # all-sparse step: no fused program carries the verdict, so
+            # run the reduction directly
+            ok = self._fused.grad_all_finite(
+                tuple(g._data for g in sparse_grad_vals))
+            applied = ok is None or bool(ok > 0)
+            if not applied:
+                self._fused.skipped_steps += 1
+        for i, p, grad in eager_items:
+            updater(i, grad, p.data())
+        if applied:
+            # sparse rows apply only on a non-vetoed step, so a skipped
+            # step leaves EVERY parameter bit-identical
+            from ..ndarray import sparse as _sparse
+            for i, p, grad in sparse_items:
+                grad = _sparse.cast_storage(grad, "row_sparse")
+                updater(i, grad, p.data())
         for p in touched:
             if p._grad is not None:
                 p._grad._fresh = False
+        self._finish_step(applied, bool(touched), saw_stale_skip,
+                          fused_items + sparse_items)
+
+    def _finish_step(self, applied, any_touched, saw_stale_skip,
+                     fused_items):
+        """Funnel the step into exactly one recorded StepOutcome, keep
+        the loss scaler honest, and halt loudly on a poisoned streak."""
+        scaler = self._amp_loss_scaler
+        guard_on = self._fused is not None and self._fused.guard
+        if not any_touched and saw_stale_skip:
+            self._recorder.record(StepOutcome.SKIPPED_STALE,
+                                  "all gradients stale; nothing applied")
+            return
+        if applied:
+            self._recorder.record(StepOutcome.APPLIED)
+            if scaler is not None and guard_on and any_touched:
+                scaler.update_scale(overflow=False)
+            return
+        if scaler is not None:
+            scaler.update_scale(overflow=True)
+        detail = self._nonfinite_diagnostic(fused_items)
+        outcome = self._recorder.record(StepOutcome.SKIPPED_NONFINITE,
+                                        detail)
+        if outcome is StepOutcome.HALTED_POISONED:
+            raise self._recorder.halt_error(
+                detail, loss_scale=None if scaler is None
+                else scaler.loss_scale)
+
+    @staticmethod
+    def _nonfinite_diagnostic(fused_items) -> str:
+        """Name the poisoned gradients (host-side sweep — only runs on
+        an already-skipped step, never on the hot path)."""
+        import numpy as _np
+        bad = []
+        for _, p, g in fused_items:
+            arr = _np.asarray(g._data)
+            if not _np.isfinite(arr).all():
+                n = int((~_np.isfinite(arr)).sum())
+                bad.append(f"{p.name}({n}/{arr.size} non-finite)")
+            if len(bad) >= 8:
+                bad.append("...")
+                break
+        return "non-finite grads: " + (", ".join(bad) or "<none found>")
 
     def update(self, batch_size, ignore_stale_grad=False):
         self._init_kvstore()
+        if self._amp_loss_scaler is not None:
+            self._scale = self._amp_original_scale / \
+                self._amp_loss_scaler.loss_scale
         self._optimizer.rescale_grad = self._scale / batch_size
         self._update(ignore_stale_grad)
 
@@ -259,7 +464,8 @@ class Trainer:
             # the discarded instance's lr/wd/rescale/update counts
             from .. import optimizer as opt_mod
             self._fuse_step = getattr(self._optimizer, "fusable", True)
-            self._fused = opt_mod.FusedApplier(self._optimizer) \
+            self._fused = opt_mod.FusedApplier(
+                self._optimizer, guard=self._guard) \
                 if self._fuse_step else None
 
     # -- elastic checkpointing (checkpoint/ subsystem) ------------------- #
@@ -273,6 +479,13 @@ class Trainer:
         tree, meta = _ckpt.trainer_capsule(self, iterator=iterator)
         if step is None:
             step = meta["step"]
+        else:
+            # an explicit step is the CALLER'S loop position — put it in
+            # the meta too, so restore_checkpoint hands it back exactly.
+            # num_update (the default) drifts below the loop index once
+            # the guard skips steps, and resuming from it would re-run
+            # already-applied batches (bit-exact-resume violation)
+            meta["step"] = int(step)
         manager.save(int(step), tree, meta=meta, block=block)
         return int(step)
 

@@ -584,7 +584,21 @@ class PrefetchingIter(DataIter):
     the wrapper counts batches actually DELIVERED to the consumer.
     ``set_position`` resets the inner iterator and replays that many
     batches before restarting the prefetch thread — O(position) on
-    resume, zero overhead on the hot path."""
+    resume, zero overhead on the hot path.
+
+    Failure surface (docs/RESILIENCE.md "Training resilience"): every
+    producer-side error — including ``BaseException`` and silent thread
+    death — PROPAGATES to the consumer instead of hanging it on an
+    empty queue forever; ``next()`` polls the producer's liveness with
+    a bounded timeout and raises loudly if it died without delivering
+    a batch, an error, or the end-of-stream sentinel. TRANSIENT read
+    errors (``OSError`` — an NFS blip, a flaky fuse mount) are retried
+    with bounded exponential backoff (``MXTPU_IO_RETRY_ATTEMPTS``,
+    default 3 attempts; ``MXTPU_IO_RETRY_BACKOFF`` base delay, default
+    0.05 s, doubling) before propagating. ``MXTPU_IO_FAIL_READS=n``
+    fault-injects n transient failures (one per read attempt) for the
+    chaos harness: n under the attempt bound still delivers every
+    batch; n at/over it fails exactly as a persistent outage would."""
 
     def __init__(self, iters, rename_data=None, rename_label=None):
         import queue
@@ -597,6 +611,8 @@ class PrefetchingIter(DataIter):
         self._cancel = None
         self._exhausted = False
         self._delivered = 0
+        self.read_retries = 0           # transient-IO retry count
+        self._injected_failures = 0     # MXTPU_IO_FAIL_READS bookkeeping
         self._epoch_start = self._try_tell()
         self._start()
 
@@ -609,26 +625,78 @@ class PrefetchingIter(DataIter):
         except MXNetError:
             return None
 
+    def _maybe_inject_read_failure(self):
+        """``MXTPU_IO_FAIL_READS=n``: the first n read ATTEMPTS raise a
+        transient OSError — the deterministic fault the retry loop is
+        tested against (the CheckpointManager writer's twin)."""
+        import os as _os
+        budget = int(_os.environ.get("MXTPU_IO_FAIL_READS", "0") or 0)
+        if self._injected_failures < budget:
+            self._injected_failures += 1
+            raise OSError(
+                f"injected transient data-iterator read failure "
+                f"({self._injected_failures}/{budget})")
+
+    def _next_inner(self):
+        """One inner read with bounded exponential-backoff retry on
+        TRANSIENT IO errors (OSError); StopIteration and structural
+        errors propagate untouched."""
+        import os as _os
+        import time as _time
+        attempts = max(1, int(_os.environ.get(
+            "MXTPU_IO_RETRY_ATTEMPTS", "3") or 3))
+        backoff = float(_os.environ.get(
+            "MXTPU_IO_RETRY_BACKOFF", "0.05") or 0.05)
+        for attempt in range(attempts):
+            try:
+                self._maybe_inject_read_failure()
+                return self._iter.next()
+            except StopIteration:
+                raise
+            except OSError:
+                if attempt + 1 >= attempts:
+                    raise
+                self.read_retries += 1
+                # cancel-aware backoff: a reset() mid-retry must abort
+                # the sleep promptly, not trip the bounded-join timeout
+                # on a healthy (merely recovering) producer
+                if self._cancel is not None and \
+                        self._cancel.wait(backoff * (2 ** attempt)):
+                    raise
+
+    def _safe_put(self, item, cancel) -> bool:
+        """Bounded put that aborts promptly when reset() cancels;
+        returns False if cancelled before delivery."""
+        import queue as _queue
+        while not cancel.is_set():
+            try:
+                self._queue.put(item, timeout=0.1)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
     def _start(self):
         import threading
 
         cancel = threading.Event()
 
         def run():
-            try:
-                for batch in self._iter:
-                    # bounded put that aborts promptly when reset() cancels
-                    while not cancel.is_set():
-                        try:
-                            self._queue.put(batch, timeout=0.1)
-                            break
-                        except Exception:
-                            continue
-                    if cancel.is_set():
-                        return
-            except Exception as e:
-                self._queue.put(e)
-            self._queue.put(self._stop)
+            while not cancel.is_set():
+                try:
+                    batch = self._next_inner()
+                except StopIteration:
+                    break
+                except BaseException as e:   # noqa: B036 — the consumer
+                    # must see EVERY producer death, KeyboardInterrupt/
+                    # SystemExit included; swallowing one would hang
+                    # next() forever
+                    self._safe_put(e, cancel)
+                    self._safe_put(self._stop, cancel)
+                    return
+                if not self._safe_put(batch, cancel):
+                    return
+            self._safe_put(self._stop, cancel)
 
         self._cancel = cancel
         self._exhausted = False
@@ -637,9 +705,24 @@ class PrefetchingIter(DataIter):
 
     def _stop_producer(self):
         # cancel the old producer FIRST, then drain so its pending put
-        # unblocks; only one thread ever touches self._iter at a time
+        # unblocks; only one thread ever touches self._iter at a time.
+        # The drain is BOUNDED (MXTPU_IO_JOIN_TIMEOUT, default 30 s —
+        # generous enough for a slow remote read to finish and notice
+        # the cancel, which is only polled between reads) so a producer
+        # wedged inside the inner iterator's C/IO cannot hang reset()
+        # forever; past the bound we refuse to reuse the iterator.
+        import os as _os
+        import time as _time
         self._cancel.set()
+        limit = float(_os.environ.get("MXTPU_IO_JOIN_TIMEOUT", "30")
+                      or 30)
+        deadline = _time.monotonic() + limit
         while self._thread.is_alive():
+            if _time.monotonic() > deadline:
+                raise MXNetError(
+                    f"PrefetchingIter producer thread did not stop "
+                    f"within {limit:g} s (MXTPU_IO_JOIN_TIMEOUT) — "
+                    f"inner iterator wedged; cannot safely reuse it")
             try:
                 self._queue.get(timeout=0.1)
             except Exception:
@@ -656,13 +739,30 @@ class PrefetchingIter(DataIter):
         self._start()
 
     def next(self):
+        import queue as _queue
         if self._exhausted:
             raise StopIteration
-        item = self._queue.get()
+        while True:
+            try:
+                item = self._queue.get(timeout=0.2)
+                break
+            except _queue.Empty:
+                if self._thread is not None and self._thread.is_alive():
+                    continue            # producer just slow — keep waiting
+                try:                    # died after a final put? drain it
+                    item = self._queue.get_nowait()
+                    break
+                except _queue.Empty:
+                    self._exhausted = True
+                    raise MXNetError(
+                        "PrefetchingIter producer thread died without "
+                        "delivering a batch, an error, or end-of-stream "
+                        "— propagating instead of hanging the consumer")
         if item is self._stop:
             self._exhausted = True
             raise StopIteration
-        if isinstance(item, Exception):
+        if isinstance(item, BaseException):
+            self._exhausted = True
             raise item
         self._delivered += 1
         return item

@@ -32,6 +32,18 @@ What does NOT fuse (falls back to the eager per-param path):
     baking those into a trace would freeze them at their step-1 values;
   - ``row_sparse``-gradient parameters — their active-row index sets
     change shape every step, which would retrace per step.
+
+Round 13 adds the IN-STEP NON-FINITE GUARD (docs/RESILIENCE.md): one
+jitted all-finite reduction over every fused gradient produces a device
+scalar ``ok`` that rides into each group's update program as PURE
+TRACED DATA, where a ``where``-select returns the OLD weights and
+optimizer state when the step must be skipped. The skip is therefore
+decided on device with zero extra host syncs on the dispatch path (the
+flag is read AFTER the updates are enqueued, only to keep host step
+counters and the loss scaler honest), and the group programs still
+compile exactly once — overflow/clean transitions and loss-scale
+growth/decay never retrace (``guard_trace_count`` /
+``trace_count`` asserted in tests and tools/train_chaos_bench.py).
 """
 
 from __future__ import annotations
@@ -46,11 +58,25 @@ import numpy as np
 from ..base import getenv_bool
 from ..ndarray import NDArray
 
-__all__ = ["apply_updates", "FusedApplier", "hyperparam_signature"]
+__all__ = ["apply_updates", "FusedApplier", "hyperparam_signature",
+           "all_finite"]
 
 
 def _is_nd(x):
     return isinstance(x, NDArray)
+
+
+def all_finite(grad_vals):
+    """Traceable all-finite reduction over a sequence of jax arrays →
+    an f32 scalar (1.0 = every float entry finite). THE guard
+    reduction — shared by the fused group programs, the external
+    multi-group guard, and the SPMD step (parallel/spmd.py), so the
+    guard semantics cannot drift between trainers."""
+    ok = jnp.asarray(True)
+    for g in grad_vals:
+        if jnp.issubdtype(g.dtype, jnp.floating):
+            ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(g)))
+    return ok.astype(jnp.float32)
 
 
 def apply_updates(optimizer, indices, weight_vals, grad_vals, states,
@@ -140,27 +166,69 @@ class FusedApplier:
     steady state re-dispatches the cached executable.
     """
 
-    def __init__(self, optimizer, donate: Optional[bool] = None):
+    def __init__(self, optimizer, donate: Optional[bool] = None,
+                 guard: Optional[bool] = None):
         self.optimizer = optimizer
         if donate is None:
             # donation is a no-op (plus a warning) on the CPU backend
             donate = jax.default_backend() != "cpu" or \
                 getenv_bool("MXTPU_FUSED_DONATE", False)
         self.donate = donate
+        if guard is None:
+            guard = getenv_bool("MXTPU_STEP_GUARD", True)
+        self.guard = bool(guard)
         self._jits: Dict = {}
+        self._guard_jits: Dict = {}
         self.trace_count = 0      # executions of a traced body (compiles)
         self.call_count = 0       # fused group dispatches
+        self.guard_trace_count = 0  # all-finite reduction compiles
+        self.skipped_steps = 0    # guard-vetoed apply() calls
 
     # ------------------------------------------------------------------ #
     def supported(self) -> bool:
         return getattr(self.optimizer, "fusable", True)
 
-    def apply(self, items: Sequence, updater) -> None:
+    def grad_all_finite(self, grad_vals):
+        """One jitted all-finite reduction over every fused gradient →
+        an f32 device scalar (1.0 = apply, 0.0 = skip). Compiled once
+        per (shape, dtype) signature; non-float grads are vacuously
+        finite and excluded."""
+        vals = tuple(g for g in grad_vals
+                     if jnp.issubdtype(g.dtype, jnp.floating))
+        if not vals:
+            return None
+        sig = tuple((v.shape, str(v.dtype)) for v in vals)
+        fn = self._guard_jits.get(sig)
+        if fn is None:
+            applier = self
+
+            def allfinite(grads):
+                applier.guard_trace_count += 1   # trace-time only
+                return all_finite(grads)
+
+            fn = jax.jit(allfinite)
+            self._guard_jits[sig] = fn
+        return fn(vals)
+
+    def apply(self, items: Sequence, updater,
+              extra_grads: Sequence = ()) -> bool:
         """Apply one fused update to ``items`` = [(index, param, grad)].
 
         ``updater`` is the Trainer's ``Updater`` — optimizer state is
         created into / read from ``updater.states`` so eager and fused
         paths share one serializable state store (save_states parity).
+
+        With the guard on, the skip decision is computed on device and
+        ``where``-selected inside each group's program; the flag is
+        read back only AFTER every group is dispatched, and a vetoed
+        step rolls the host update counters back so schedules and
+        Adam/LAMB bias correction do not advance on skipped steps.
+        ``extra_grads`` are gradients applied OUTSIDE the fused call
+        (the Trainer's row_sparse path) that must still join the
+        all-or-nothing verdict — any non-finite entry there vetoes the
+        fused groups too. Returns True when the update was applied,
+        False when the guard skipped it (params/state bit-identical to
+        before the call).
         """
         opt = self.optimizer
         groups: Dict = {}
@@ -171,12 +239,28 @@ class FusedApplier:
             gkey = (str(p.data().dtype),
                     getattr(p, "_grad_stype", "default"))
             groups.setdefault(gkey, []).append((i, p, g))
+        # guard plumbing: with ONE group (the common case) the
+        # all-finite reduction folds INTO the group's own program and
+        # the flag comes back as an extra output — zero added
+        # dispatches (the separate-program design measured ~12% on the
+        # CPU dispatch floor; inline is <2%, PERF_NOTES round 13).
+        # Multi-group sets — and steps carrying extra (row_sparse)
+        # grads — need the COMBINED flag before any group selects, so
+        # they pay one small external reduction program.
+        extra_vals = tuple(getattr(g, "_data", g) for g in extra_grads)
+        inline_guard = self.guard and len(groups) == 1 and not extra_vals
+        ok = None
+        if self.guard and not inline_guard:
+            ok = self.grad_all_finite(
+                tuple(g._data for _, _, g in items) + extra_vals)
         # commit the step's counters BEFORE dispatching: the eager path
         # bumps _update_count before reading the lr, so the scheduler must
         # see the post-bump num_update here too (scheduler(t), not t-1).
         # Trace-time bumps inside update() land on already-bumped counts
         # and are overwritten below, keeping the host counters exact.
         counts = opt._index_update_count
+        prev_counts = dict(counts)
+        prev_num_update = opt.num_update
         new_counts = {i: counts.get(i, 0) + 1 for i, _, _ in items}
         counts.update(new_counts)
         opt.num_update = max(counts.values(), default=opt.num_update)
@@ -188,12 +272,28 @@ class FusedApplier:
         lr = np.float32(float(opt.learning_rate))
         rescale = np.float32(float(opt.rescale_grad))
         for gkey, group in groups.items():
-            self._apply_group(gkey, group, updater, lr, rescale)
+            group_ok = self._apply_group(gkey, group, updater, lr,
+                                         rescale, ok,
+                                         inline_guard=inline_guard)
+            if inline_guard:
+                ok = group_ok
         counts.update(new_counts)
         opt.num_update = max(counts.values(), default=opt.num_update)
+        if ok is None or bool(np.asarray(ok) > 0):
+            return True
+        # guard veto: the programs already selected the old params and
+        # state; un-advance the host counters so the next applied step
+        # reuses this step's t (a skipped step never happened, contract
+        # of the reference's multi_all_finite skip)
+        counts.clear()
+        counts.update(prev_counts)
+        opt.num_update = prev_num_update
+        self.skipped_steps += 1
+        return False
 
     # ------------------------------------------------------------------ #
-    def _apply_group(self, gkey, group, updater, lr, rescale) -> None:
+    def _apply_group(self, gkey, group, updater, lr, rescale,
+                     ok=None, inline_guard=False):
         opt = self.optimizer
         indices = tuple(i for i, _, _ in group)
         states = [updater.states[i] for i in indices]
@@ -203,11 +303,13 @@ class FusedApplier:
         mults = tuple((float(getattr(p, "lr_mult", 1.0)),
                        float(getattr(p, "wd_mult", 1.0)))
                       for _, p, _ in group)
+        mode = ("inline" if inline_guard
+                else "external" if ok is not None else "off")
         sig = (gkey, indices, state_tree,
-               hyperparam_signature(opt), mults)
+               hyperparam_signature(opt), mults, mode)
         fn = self._jits.get(sig)
         if fn is None:
-            fn = self._build(indices, state_tree)
+            fn = self._build(indices, state_tree, mode)
             self._jits[sig] = fn
 
         weight_vals = tuple(p.data()._data for _, p, _ in group)
@@ -217,8 +319,19 @@ class FusedApplier:
             [opt._index_update_count.get(i, 1) for i in indices],
             np.float32)
 
-        new_ws, new_state_leaves = fn(
-            weight_vals, grad_vals, tuple(state_leaves), t_vec, lr, rescale)
+        group_ok = None
+        if mode == "external":
+            new_ws, new_state_leaves = fn(
+                weight_vals, grad_vals, tuple(state_leaves), t_vec, lr,
+                rescale, ok)
+        elif mode == "inline":
+            new_ws, new_state_leaves, group_ok = fn(
+                weight_vals, grad_vals, tuple(state_leaves), t_vec, lr,
+                rescale)
+        else:
+            new_ws, new_state_leaves = fn(
+                weight_vals, grad_vals, tuple(state_leaves), t_vec, lr,
+                rescale)
         self.call_count += 1
 
         for (_, p, _), new_w in zip(group, new_ws):
@@ -228,18 +341,56 @@ class FusedApplier:
             lambda old, new: setattr(old, "_data", new) if _is_nd(old)
             else None,
             tuple(states), new_states, is_leaf=_is_nd)
+        return group_ok
 
-    def _build(self, indices, state_tree):
+    def _build(self, indices, state_tree, mode="off"):
         opt = self.optimizer
         applier = self
 
-        def fused(weight_vals, grad_vals, state_leaves, t_vec, lr, rescale):
+        def core(weight_vals, grad_vals, state_leaves, t_vec, lr, rescale,
+                 ok):
             applier.trace_count += 1  # python body runs at trace time only
             states = jtu.tree_unflatten(state_tree, list(state_leaves))
             new_ws, new_states = apply_updates(
                 opt, indices, weight_vals, grad_vals, states, t_vec, lr,
                 rescale_grad=rescale)
-            return new_ws, tuple(jtu.tree_leaves(new_states))
+            new_leaves = tuple(jtu.tree_leaves(new_states))
+            if ok is not None:
+                # skip-step as pure data: the guard flag selects the OLD
+                # params/state, so a vetoed step is bit-identical to not
+                # stepping — and the program is the same either way (no
+                # retrace across overflow/clean transitions)
+                apply_p = ok > 0
+                new_ws = tuple(jnp.where(apply_p, nw, w)
+                               for nw, w in zip(new_ws, weight_vals))
+                new_leaves = tuple(
+                    jnp.where(apply_p, nl, ol)
+                    for nl, ol in zip(new_leaves, state_leaves))
+            return new_ws, new_leaves
 
         donate = (0, 2) if self.donate else ()
-        return jax.jit(fused, donate_argnums=donate)
+        if mode == "external":
+            def fused_ext(weight_vals, grad_vals, state_leaves, t_vec, lr,
+                          rescale, ok):
+                return core(weight_vals, grad_vals, state_leaves, t_vec,
+                            lr, rescale, ok)
+            return jax.jit(fused_ext, donate_argnums=donate)
+        if mode == "inline":
+            # single-group fast path: the all-finite reduction runs
+            # inside the SAME program and the flag rides out as a third
+            # output — no extra dispatch, no extra host sync point
+            def fused_inline(weight_vals, grad_vals, state_leaves, t_vec,
+                             lr, rescale):
+                applier.guard_trace_count += 1   # trace-time only
+                ok = all_finite(grad_vals)
+                new_ws, new_leaves = core(
+                    weight_vals, grad_vals, state_leaves, t_vec, lr,
+                    rescale, ok)
+                return new_ws, new_leaves, ok
+            return jax.jit(fused_inline, donate_argnums=donate)
+
+        def fused_off(weight_vals, grad_vals, state_leaves, t_vec, lr,
+                      rescale):
+            return core(weight_vals, grad_vals, state_leaves, t_vec, lr,
+                        rescale, None)
+        return jax.jit(fused_off, donate_argnums=donate)

@@ -30,9 +30,10 @@ import jax.tree_util as jtu
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from .. import autograd, random as _random
-from ..base import MXNetError
+from ..base import MXNetError, getenv_bool
 from ..ndarray import NDArray
 from ..optimizer import create as opt_create
+from ..train.outcomes import StepOutcome, StepRecorder
 from . import mesh as _mesh
 
 __all__ = ["SPMDTrainer", "shard_params", "replicate", "constrain",
@@ -191,7 +192,9 @@ class SPMDTrainer:
                  optimizer_params=None, mesh: Optional[Mesh] = None,
                  sharding: str = "replicated",
                  forward_loss: Optional[Callable] = None,
-                 donate: bool = True):
+                 donate: bool = True, loss_scaler=None,
+                 guard: Optional[bool] = None,
+                 max_consecutive_nonfinite: Optional[int] = None):
         if loss is None and forward_loss is None:
             raise MXNetError("provide loss or forward_loss")
         self.block = block
@@ -200,6 +203,25 @@ class SPMDTrainer:
         self.mesh = mesh if mesh is not None else _mesh.default_mesh()
         self.sharding_mode = sharding
         self.donate = donate
+        # round-13 resilience (docs/RESILIENCE.md "Training resilience"):
+        # the fused step carries an all-finite guard over the gradients
+        # as pure traced data (a where-select skip — no retrace, and the
+        # skip decision is GLOBAL because the reduction runs inside the
+        # SPMD program: every rank sees the same flag by construction);
+        # the dynamic loss scale rides as a traced scalar input.
+        if guard is None:
+            guard = getenv_bool("MXTPU_STEP_GUARD", True)
+        self.guard = bool(guard)
+        self.loss_scaler = loss_scaler
+        if loss_scaler is not None and not self.guard:
+            import warnings
+            warnings.warn(
+                "loss_scaler attached but the in-step guard is off — "
+                "overflow detection never fires, so the scale would "
+                "only ever grow; scale updates are disabled",
+                UserWarning, stacklevel=2)
+        self._recorder = StepRecorder(max_consecutive_nonfinite)
+        self.step_trace_count = 0    # fused-step compiles (jit-once)
 
         params = list(block.collect_params().values())
         not_ready = [p.name for p in params
@@ -233,6 +255,22 @@ class SPMDTrainer:
 
     def set_learning_rate(self, lr):
         self._optimizer.set_learning_rate(lr)
+
+    # -- resilience surface (docs/RESILIENCE.md, round 13) --------------- #
+    @property
+    def health(self) -> dict:
+        return self._recorder.health
+
+    @property
+    def last_outcome(self):
+        return self._recorder.last_outcome
+
+    def health_snapshot(self) -> dict:
+        snap = self._recorder.snapshot()
+        snap["loss_scale"] = (None if self.loss_scaler is None
+                              else float(self.loss_scaler.loss_scale))
+        snap["guard"] = self.guard
+        return snap
 
     # ------------------------------------------------------------------ #
     def _materialize(self, batch_nds):
@@ -310,11 +348,23 @@ class SPMDTrainer:
                     p._data = s
             return L._data, tuple(aux)
 
-        def step(train_vals, frozen_vals, opt_leaves, opt_tree, t, lr, key,
-                 *batch):
+        guard = self.guard
+        trainer = self
+        base_rescale = float(optimizer.rescale_grad)
+
+        def step(train_vals, frozen_vals, opt_leaves, opt_tree, t, lr,
+                 scale, key, *batch):
+            trainer.step_trace_count += 1   # python body = trace time only
             (loss_val, aux), grads = jax.value_and_grad(
-                pure_loss, argnums=0, has_aux=True)(
+                lambda tv, fv, k, *b: (
+                    # dynamic loss scaling as a traced scalar: scale the
+                    # loss INSIDE the program, divide back through the
+                    # (traced) rescale_grad below — growth/decay never
+                    # retraces
+                    (lambda L, a: (L * scale, a))(*pure_loss(tv, fv, k, *b))
+                ), argnums=0, has_aux=True)(
                     train_vals, frozen_vals, key, *batch)
+            loss_val = loss_val / scale
             opt_state = jtu.tree_unflatten(opt_tree, opt_leaves)
             # whole-tree fused apply (optimizer/fused.py — shared with the
             # eager Trainer's jitted group path); the step counter and lr
@@ -322,9 +372,31 @@ class SPMDTrainer:
             # correction advance without recompiling
             from ..optimizer.fused import apply_updates
             new_train, new_states = apply_updates(
-                optimizer, train_idx, train_vals, grads, opt_state, t, lr)
-            return tuple(new_train), tuple(aux), \
-                tuple(jtu.tree_leaves(tuple(new_states))), loss_val
+                optimizer, train_idx, train_vals, grads, opt_state, t, lr,
+                rescale_grad=jnp.float32(base_rescale) / scale)
+            new_train = tuple(new_train)
+            aux = tuple(aux)
+            new_leaves = tuple(jtu.tree_leaves(tuple(new_states)))
+            if guard:
+                # in-step non-finite guard, pure traced data: the
+                # all-finite reduction over the (scaled) gradients runs
+                # inside the SPMD program — XLA inserts the cross-device
+                # reduction itself, so every rank computes the SAME flag
+                # — and a skip-step is a where-select of the old params,
+                # optimizer state AND mutated frozen params (BN stats)
+                from ..optimizer.fused import all_finite
+                ok_flag = all_finite(grads)
+                apply_p = ok_flag > 0
+                new_train = tuple(jnp.where(apply_p, nw, w)
+                                  for nw, w in zip(new_train, train_vals))
+                aux = tuple(jnp.where(apply_p, na, fv)
+                            for na, fv in zip(aux, frozen_vals))
+                new_leaves = tuple(jnp.where(apply_p, nl, ol)
+                                   for nl, ol in zip(new_leaves,
+                                                     opt_leaves))
+            else:
+                ok_flag = jnp.float32(1.0)
+            return new_train, aux, new_leaves, loss_val, ok_flag
 
         mesh = self.mesh
         repl = NamedSharding(mesh, PartitionSpec())
@@ -350,11 +422,12 @@ class SPMDTrainer:
             step,
             static_argnums=(3,),
             in_shardings=(train_sh, frozen_sh, tuple(state_sh), repl, repl,
-                          repl) + (batch_sh,) * n_batch,
+                          repl, repl) + (batch_sh,) * n_batch,
             # pin outputs to the param/state shardings: otherwise the
             # partitioner may emit its preferred layout and step N+1's
             # donated inputs no longer match in_shardings
-            out_shardings=(train_sh, frozen_sh, tuple(state_sh), repl),
+            out_shardings=(train_sh, frozen_sh, tuple(state_sh), repl,
+                           repl),
             donate_argnums=donate)
 
     # ------------------------------------------------------------------ #
@@ -387,6 +460,9 @@ class SPMDTrainer:
         self._optimizer.num_update = self.step_count  # drive lr schedules
         t = _host_np.float32(self.step_count + 1)
         lr = _host_np.float32(float(self._optimizer.learning_rate))
+        scale = _host_np.float32(
+            1.0 if self.loss_scaler is None
+            else self.loss_scaler.loss_scale)
         batch_vals = [b._data for b in batch_nds]
         if jax.process_count() > 1:
             # multi-host: every process holds the SAME full batch (SPMD
@@ -406,9 +482,17 @@ class SPMDTrainer:
                     host.shape, batch_sh, lambda idx: host[idx])
             batch_vals = [_globalize(b) for b in batch_vals]
 
-        new_train, aux, new_state_leaves, loss_val = self._step_fn(
-            train_vals, frozen_vals, tuple(opt_leaves), opt_tree, t, lr, key,
-            *batch_vals)
+        self._recorder.open_step()
+        try:
+            new_train, aux, new_state_leaves, loss_val, ok_flag = \
+                self._step_fn(
+                    train_vals, frozen_vals, tuple(opt_leaves), opt_tree,
+                    t, lr, scale, key, *batch_vals)
+        except BaseException:
+            # dispatch died before any outcome existed — close the step
+            # so the next one is not falsely accused of a missing record
+            self._recorder.abort_step()
+            raise
 
         train_set = set(self._train_idx)
         it_t = iter(new_train)
@@ -418,7 +502,30 @@ class SPMDTrainer:
         new_states = jtu.tree_unflatten(opt_tree, list(new_state_leaves))
         self._opt_state = [
             jtu.tree_map(NDArray, st) for st in new_states]
-        self.step_count += 1
+        # the guard verdict is read AFTER the outputs are bound (the
+        # update was already selected on device); it only steers host
+        # counters, the scaler and the outcome record
+        applied = (not self.guard) or bool(_host_np.asarray(ok_flag) > 0)
+        if applied:
+            self.step_count += 1
+            self._recorder.record(StepOutcome.APPLIED)
+            if self.loss_scaler is not None and self.guard:
+                # without the guard overflow can never be observed, so
+                # growing the scale would be a one-way ratchet to inf
+                self.loss_scaler.update_scale(overflow=False)
+        else:
+            if self.loss_scaler is not None:
+                self.loss_scaler.update_scale(overflow=True)
+            detail = (f"non-finite gradient in fused SPMD step at "
+                      f"step_count={self.step_count} "
+                      f"(loss={float(_host_np.asarray(loss_val)):g})")
+            outcome = self._recorder.record(
+                StepOutcome.SKIPPED_NONFINITE, detail)
+            if outcome is StepOutcome.HALTED_POISONED:
+                raise self._recorder.halt_error(
+                    detail,
+                    loss_scale=None if self.loss_scaler is None
+                    else self.loss_scaler.loss_scale)
         return NDArray(loss_val)
 
     # ------------------------------------------------------------------ #
@@ -439,6 +546,11 @@ class SPMDTrainer:
         tree, meta = _ckpt.spmd_capsule(self, iterator=iterator)
         if step is None:
             step = meta["step"]
+        else:
+            # caller's loop position wins (see Trainer.save_checkpoint:
+            # step_count does not advance on guard-skipped steps, and a
+            # resume must not re-run already-applied batches)
+            meta["step"] = int(step)
         manager.save(int(step), tree, meta=meta, block=block)
         return int(step)
 

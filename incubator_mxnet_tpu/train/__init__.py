@@ -1,0 +1,24 @@
+"""Training resilience: structured step outcomes, the auto-resume
+supervisor, and the seeded training chaos harness
+(docs/RESILIENCE.md "Training resilience", round 13).
+
+The in-step non-finite guard and dynamic loss scaling themselves live
+where the steps live — ``optimizer/fused.py``, ``gluon/trainer.py``,
+``parallel/spmd.py`` — this package holds what they share: the outcome
+taxonomy + recorder, the crash/hang supervisor, and the fault
+injectors ``tools/train_chaos_bench.py`` (CI ``trainchaos`` stage)
+drives.
+"""
+
+from .outcomes import StepOutcome, StepRecorder
+from .supervisor import Attempt, Supervisor, SupervisorReport
+from . import chaos
+from .chaos import (KillSelf, NaNBatch, NaNGrad, OverflowStorm, SlowStep,
+                    TrainChaosInjector, run_train_chaos)
+
+__all__ = [
+    "StepOutcome", "StepRecorder",
+    "Supervisor", "SupervisorReport", "Attempt",
+    "chaos", "TrainChaosInjector", "NaNGrad", "OverflowStorm",
+    "NaNBatch", "SlowStep", "KillSelf", "run_train_chaos",
+]

@@ -561,3 +561,65 @@ def test_non_resumable_iterator_refuses_loudly():
     from incubator_mxnet_tpu.io import DataIter
     with pytest.raises(MXNetError, match="position export"):
         DataIter().tell()
+
+
+# --------------------------------------------------------------------- #
+# corrupt-latest fallback (round 13): keep-last-k earns its keep
+# --------------------------------------------------------------------- #
+
+def test_restore_falls_back_to_previous_step_on_corrupt_latest(tmp_path):
+    """A truncated shard in the newest step must not fail the run:
+    restore() walks back to the previous committed step, warning
+    loudly and naming the bad shard."""
+    import jax.numpy as jnp
+    root = str(tmp_path)
+    m = ckpt.CheckpointManager(root, keep=3)
+    for s in (1, 2, 3):
+        m.save(s, {"w": jnp.full((64,), float(s))}, block=True)
+    shard = os.path.join(ckpt.step_dir(root, 3), "shards_p0.bin")
+    with open(shard, "r+b") as f:           # truncate the latest shard
+        f.truncate(17)
+    with pytest.warns(RuntimeWarning, match="step 3 is unreadable"):
+        arrays, _ = m.restore()
+    np.testing.assert_array_equal(arrays["w"], np.full((64,), 2.0))
+    assert m.restore_fallbacks == 1
+    # an EXPLICIT step request still fails loudly, naming the shard
+    with pytest.raises(MXNetError, match="shards_p0.bin"):
+        m.restore(step=3)
+    # fallback=False restores the old latest-or-die behavior
+    with pytest.raises(MXNetError, match="shards_p0.bin"):
+        m.restore(fallback=False)
+    m.close()
+
+
+def test_restore_every_step_corrupt_raises(tmp_path):
+    import jax.numpy as jnp
+    root = str(tmp_path)
+    m = ckpt.CheckpointManager(root, keep=2)
+    for s in (1, 2):
+        m.save(s, {"w": jnp.ones((32,))}, block=True)
+    for s in (1, 2):
+        with open(os.path.join(ckpt.step_dir(root, s),
+                               "shards_p0.bin"), "r+b") as f:
+            f.truncate(3)
+    with pytest.warns(RuntimeWarning):
+        with pytest.raises(MXNetError, match="every committed"):
+            m.restore()
+    m.close()
+
+
+def test_restore_falls_back_on_corrupt_manifest(tmp_path):
+    """Manifest corruption (not just shard corruption) must also walk
+    back — json/structure errors are 'this step is damaged' too."""
+    import jax.numpy as jnp
+    root = str(tmp_path)
+    m = ckpt.CheckpointManager(root, keep=3)
+    for s in (1, 2):
+        m.save(s, {"w": jnp.full((16,), float(s))}, block=True)
+    mpath = os.path.join(ckpt.step_dir(root, 2), "manifest.json")
+    with open(mpath, "w") as f:
+        f.write('{"format_version": 1, "arrays": {TRUNCATED')
+    with pytest.warns(RuntimeWarning, match="step 2 is unreadable"):
+        arrays, _ = m.restore()
+    np.testing.assert_array_equal(arrays["w"], np.full((16,), 1.0))
+    m.close()

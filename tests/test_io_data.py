@@ -364,3 +364,95 @@ def test_libsvm_iter_and_io_aliases(tmp_path):
     assert TokenEmbedding is not None
     assert MXIndexedRecordIO is recordio.IndexedRecordIO
     assert ImageDetRecordIter is not None
+
+
+# --------------------------------------------------------------------- #
+# PrefetchingIter failure surface (round 13): producer death propagates,
+# transient IO errors retry bounded (docs/RESILIENCE.md)
+# --------------------------------------------------------------------- #
+
+class _FlakyIter(mx.io.DataIter):
+    """Inner iterator whose reads fail in configurable ways."""
+
+    def __init__(self, n=6, fail_at=None, exc=None):
+        super().__init__(batch_size=2)
+        self._n = n
+        self._cur = 0
+        self._fail_at = fail_at
+        self._exc = exc
+
+    def reset(self):
+        self._cur = 0
+
+    def next(self):
+        if self._fail_at is not None and self._cur == self._fail_at:
+            self._fail_at = None            # fire once
+            raise self._exc
+        if self._cur >= self._n:
+            raise StopIteration
+        i = self._cur
+        self._cur += 1
+        return DataBatch([nd.array(np.full((2, 1), i, np.float32))], [])
+
+
+def test_prefetch_producer_exception_propagates():
+    from incubator_mxnet_tpu.io import PrefetchingIter
+    pf = PrefetchingIter(_FlakyIter(fail_at=2,
+                                    exc=ValueError("reader exploded")))
+    assert pf.next() is not None
+    assert pf.next() is not None
+    with pytest.raises(ValueError, match="reader exploded"):
+        while True:
+            pf.next()
+
+
+def test_prefetch_producer_base_exception_propagates():
+    # SystemExit in a reader thread previously died silently, hanging
+    # the consumer on an empty queue forever
+    from incubator_mxnet_tpu.io import PrefetchingIter
+    pf = PrefetchingIter(_FlakyIter(fail_at=1, exc=SystemExit(3)))
+    pf.next()
+    with pytest.raises(SystemExit):
+        while True:
+            pf.next()
+
+
+def test_prefetch_producer_silent_death_raises_not_hangs():
+    from incubator_mxnet_tpu.base import MXNetError
+    from incubator_mxnet_tpu.io import PrefetchingIter
+    pf = PrefetchingIter(_FlakyIter(n=6))
+    pf.next()
+    # simulate abrupt producer death without a sentinel: cancel makes
+    # the thread return sentinel-free (the reset() protocol), then
+    # consume with the queue drained
+    pf._cancel.set()
+    pf._thread.join(timeout=5)
+    assert not pf._thread.is_alive()
+    while not pf._queue.empty():
+        pf._queue.get_nowait()
+    with pytest.raises(MXNetError, match="producer thread died"):
+        pf.next()
+    with pytest.raises(StopIteration):      # stays terminal, never wedges
+        pf.next()
+
+
+def test_prefetch_transient_io_error_retries_bounded(monkeypatch):
+    from incubator_mxnet_tpu.io import PrefetchingIter
+    monkeypatch.setenv("MXTPU_IO_FAIL_READS", "2")
+    monkeypatch.setenv("MXTPU_IO_RETRY_ATTEMPTS", "3")
+    monkeypatch.setenv("MXTPU_IO_RETRY_BACKOFF", "0.001")
+    pf = PrefetchingIter(_FlakyIter(n=6))
+    batches = list(pf)
+    assert len(batches) == 6                # nothing lost to the blips
+    assert pf.read_retries == 2
+
+
+def test_prefetch_persistent_io_error_fails_loudly(monkeypatch):
+    from incubator_mxnet_tpu.io import PrefetchingIter
+    monkeypatch.setenv("MXTPU_IO_FAIL_READS", "50")
+    monkeypatch.setenv("MXTPU_IO_RETRY_ATTEMPTS", "3")
+    monkeypatch.setenv("MXTPU_IO_RETRY_BACKOFF", "0.001")
+    pf = PrefetchingIter(_FlakyIter(n=6))
+    with pytest.raises(OSError, match="injected transient"):
+        pf.next()
+    assert pf.read_retries == 2             # attempts-1 retries, then loud

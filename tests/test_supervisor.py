@@ -1,0 +1,183 @@
+"""Auto-resume supervisor: bounded restarts, exponential backoff, the
+zero-progress hang watchdog, and the end-to-end kill -9 resume contract
+(docs/RESILIENCE.md "Training resilience").
+
+The cheap units drive trivial non-jax children (fast, tier-1); the
+full kill -9 training resume — loss sequence bit-identical to an
+uninterrupted run — is the slow end-to-end test, also exercised every
+CI run by the ``trainchaos`` stage (tools/train_chaos_bench.py).
+"""
+
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+from incubator_mxnet_tpu.base import MXNetError
+from incubator_mxnet_tpu.train import Supervisor
+
+
+def _script(tmp_path, body):
+    p = tmp_path / "child.py"
+    p.write_text(textwrap.dedent(body))
+    return [sys.executable, str(p)]
+
+
+def test_completes_without_restart(tmp_path):
+    sup = Supervisor(_script(tmp_path, "raise SystemExit(0)"),
+                     max_restarts=3, backoff_s=0.01)
+    report = sup.run()
+    assert report.completed and report.restarts == 0
+    assert [a.reason for a in report.attempts] == ["completed"]
+
+
+def test_restarts_across_crashes_then_completes(tmp_path):
+    # the child crashes until its scratch counter reaches 2
+    argv = _script(tmp_path, f"""
+        import os
+        c = {str(tmp_path / "count")!r}
+        n = int(open(c).read()) if os.path.exists(c) else 0
+        open(c, "w").write(str(n + 1))
+        raise SystemExit(0 if n >= 2 else 1)
+    """)
+    sup = Supervisor(argv, max_restarts=5, backoff_s=0.01)
+    report = sup.run()
+    assert report.completed and report.restarts == 2
+    assert [a.reason for a in report.attempts] == \
+        ["crash", "crash", "completed"]
+
+
+def test_backoff_doubles_without_progress(tmp_path):
+    prog = tmp_path / "progress"
+    prog.write_text("static\n")
+    sup = Supervisor(_script(tmp_path, "raise SystemExit(1)"),
+                     progress_file=str(prog), max_restarts=3,
+                     backoff_s=0.02, backoff_max_s=0.05)
+    report = sup.run(raise_on_failure=False)
+    assert not report.completed and report.restarts == 3
+    # no progress ever observed -> exponential, capped
+    assert report.backoffs == [0.02, 0.04, 0.05]
+
+
+def test_backoff_resets_on_progress(tmp_path):
+    # every attempt touches the progress file (real work happened)
+    # before crashing — an occasional preemption, not a crash-loop
+    prog = tmp_path / "progress"
+    argv = _script(tmp_path, f"""
+        import os
+        p = {str(prog)!r}
+        n = int(open(p).read()) if os.path.exists(p) else 0
+        open(p, "w").write(str(n + 1))
+        raise SystemExit(0 if n >= 2 else 1)
+    """)
+    sup = Supervisor(argv, progress_file=str(prog), max_restarts=5,
+                     backoff_s=0.02, hang_timeout_s=30.0)
+    report = sup.run()
+    assert report.completed and report.restarts == 2
+    assert report.backoffs == [0.02, 0.02]   # reset each time
+
+
+def test_hang_watchdog_converts_hang_into_restart(tmp_path):
+    prog = tmp_path / "progress"
+    argv = _script(tmp_path, f"""
+        import os, time
+        p = {str(prog)!r}
+        m = {str(tmp_path / "hung_once")!r}
+        if os.path.exists(m):
+            raise SystemExit(0)        # healthy after the restart
+        open(m, "w").write("x")
+        open(p, "a").write("alive\\n") # one heartbeat, then wedge
+        time.sleep(3600)
+    """)
+    sup = Supervisor(argv, progress_file=str(prog), max_restarts=2,
+                     backoff_s=0.01, hang_timeout_s=0.5, poll_s=0.02)
+    report = sup.run()
+    assert report.completed
+    assert report.hang_kills == 1 and report.restarts == 1
+    assert report.attempts[0].reason == "hang_kill"
+
+
+def test_gives_up_loudly_after_budget(tmp_path):
+    sup = Supervisor(_script(tmp_path, "raise SystemExit(3)"),
+                     max_restarts=2, backoff_s=0.01)
+    with pytest.raises(MXNetError, match="gave up after 2 restarts"):
+        sup.run()
+    report = sup.run(raise_on_failure=False)
+    assert not report.completed
+    assert len(report.attempts) == 3
+    assert all(a.exit_code == 3 for a in report.attempts)
+
+
+def test_watchdog_requires_progress_signal(tmp_path):
+    with pytest.raises(MXNetError, match="progress signal"):
+        Supervisor([sys.executable, "-c", "pass"], hang_timeout_s=1.0)
+
+
+def test_death_by_signal_is_a_crash(tmp_path):
+    argv = _script(tmp_path, f"""
+        import os, signal
+        m = {str(tmp_path / "killed")!r}
+        if os.path.exists(m):
+            raise SystemExit(0)
+        open(m, "w").write("x")
+        os.kill(os.getpid(), signal.SIGKILL)
+    """)
+    sup = Supervisor(argv, max_restarts=2, backoff_s=0.01)
+    report = sup.run()
+    assert report.completed and report.restarts == 1
+    import signal as _sig
+    assert report.attempts[0].term_signal == _sig.SIGKILL
+
+
+# --------------------------------------------------------------------- #
+# end-to-end: kill -9 a real training run twice; the resumed loss
+# sequence must be bit-identical to an uninterrupted run's
+# --------------------------------------------------------------------- #
+
+def _run_target(tmp_path, tag, steps, kill_at="", max_restarts=0,
+                hang_timeout_s=None):
+    ckpt = tmp_path / f"ckpt_{tag}"
+    results = tmp_path / f"results_{tag}.jsonl"
+    ckpt.mkdir()
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "MXTPU_TGT_CKPT_DIR": str(ckpt),
+        "MXTPU_TGT_RESULTS": str(results),
+        "MXTPU_TGT_STEPS": str(steps),
+        "MXTPU_TGT_SAVE_EVERY": "2",
+        "MXTPU_TGT_KILL_AT": kill_at,
+    }
+    sup = Supervisor(
+        [sys.executable, "-m", "incubator_mxnet_tpu.train.example_target"],
+        ckpt_dir=str(ckpt), progress_file=str(results),
+        max_restarts=max_restarts, backoff_s=0.05,
+        hang_timeout_s=hang_timeout_s, env=env)
+    report = sup.run()
+    by_step = {}
+    with open(results) as f:
+        for line in f:
+            rec = json.loads(line)
+            by_step[rec["step"]] = rec["loss"]
+    return report, by_step
+
+
+@pytest.mark.slow
+def test_kill9_twice_resumes_bit_exact(tmp_path):
+    steps = 14
+    _, clean = _run_target(tmp_path, "clean", steps)
+    report, survived = _run_target(tmp_path, "killed", steps,
+                                   kill_at="5,9", max_restarts=4)
+    assert report.completed
+    assert report.restarts == 2
+    assert sorted(a.reason for a in report.attempts) == \
+        ["completed", "crash", "crash"]
+    assert set(survived) == set(clean) == set(range(steps))
+    for s in range(steps):
+        assert survived[s] == clean[s], \
+            f"loss diverged at step {s}: {survived[s]} != {clean[s]}"
+    # backoff honored between restarts (scheduled, not timing-flaky)
+    assert len(report.backoffs) == 2
+    assert all(b >= 0.05 for b in report.backoffs)

@@ -1,0 +1,529 @@
+"""Fault-tolerant training: the in-step non-finite guard, dynamic loss
+scaling, structured step outcomes, and the halt-on-poison contract
+(docs/RESILIENCE.md "Training resilience").
+
+The invariants mirror the serving ones (round 10), translated to
+training: every step ends in exactly one recorded StepOutcome; a
+skipped step leaves params AND optimizer state bit-identical; the
+guard and scale ride as pure traced data so overflow/clean transitions
+and scale growth/decay never retrace; K consecutive non-finite steps
+halt loudly instead of skip-looping forever.
+"""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, nd, parallel
+from incubator_mxnet_tpu.amp.loss_scaler import LossScaler
+from incubator_mxnet_tpu.base import MXNetError
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.parallel import mesh as pmesh
+from incubator_mxnet_tpu.train import (NaNBatch, NaNGrad, OverflowStorm,
+                                       StepOutcome, StepRecorder,
+                                       run_train_chaos)
+
+
+def _build_net(seed=0):
+    mx.random.seed(seed)
+    net = nn.Sequential()
+    net.add(nn.Dense(16, in_units=8, activation="relu"),
+            nn.Dense(4, in_units=16))
+    net.initialize()
+    return net
+
+
+def _data(seed=1, n=8):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(n, 8).astype(np.float32),
+            rng.randn(n, 4).astype(np.float32))
+
+
+def _mse(out, label):
+    return (out - label) ** 2
+
+
+def _trainer(net, opt="adam", scaler=None, guard=None, max_nf=None,
+             **opt_params):
+    opt_params = opt_params or {"learning_rate": 0.01}
+    return gluon.Trainer(net.collect_params(), opt, opt_params,
+                         kvstore=None, loss_scaler=scaler, guard=guard,
+                         max_consecutive_nonfinite=max_nf)
+
+
+def _state_snapshot(tr):
+    """Params + every optimizer-state leaf, as host arrays."""
+    import jax.tree_util as jtu
+    snap = [p.data().asnumpy().copy() for p in tr._params]
+    for i, st in sorted(tr._updaters[0].states.items()):
+        for leaf in jtu.tree_leaves(
+                st, is_leaf=lambda x: hasattr(x, "asnumpy")):
+            snap.append(leaf.asnumpy().copy())
+    return snap
+
+
+# --------------------------------------------------------------------- #
+# recorder units (host-only)
+# --------------------------------------------------------------------- #
+
+def test_recorder_exactly_one_outcome_per_step():
+    rec = StepRecorder(max_consecutive_nonfinite=10)
+    rec.open_step()
+    rec.record(StepOutcome.APPLIED)
+    with pytest.raises(MXNetError, match="double-record"):
+        rec.record(StepOutcome.APPLIED)
+    rec.open_step()
+    with pytest.raises(MXNetError, match="never recorded"):
+        rec.open_step()
+    rec.record(StepOutcome.SKIPPED_STALE)
+    assert rec.step_count == 2 == sum(rec.health.values())
+
+
+def test_recorder_escalates_to_halt():
+    rec = StepRecorder(max_consecutive_nonfinite=3)
+    outs = []
+    for _ in range(3):
+        rec.open_step()
+        outs.append(rec.record(StepOutcome.SKIPPED_NONFINITE))
+    assert outs == [StepOutcome.SKIPPED_NONFINITE,
+                    StepOutcome.SKIPPED_NONFINITE,
+                    StepOutcome.HALTED_POISONED]
+    # an applied step resets the streak
+    rec.open_step()
+    rec.record(StepOutcome.APPLIED)
+    assert rec.consecutive_nonfinite == 0
+    snap = rec.snapshot()
+    assert snap["health"]["HALTED_POISONED"] == 1
+    snap["health"]["APPLIED"] = 99            # detached copy
+    assert rec.health["APPLIED"] == 1
+
+
+# --------------------------------------------------------------------- #
+# the guard on the fused Trainer step
+# --------------------------------------------------------------------- #
+
+def test_nan_grad_step_skipped_state_bit_identical():
+    net = _build_net()
+    tr = _trainer(net)
+    X, y = _data()
+    # two clean steps build optimizer state, then snapshot
+    run_train_chaos(net, tr, _mse, (X, y), 2)
+    before = _state_snapshot(tr)
+    losses, outcomes = run_train_chaos(net, tr, _mse, (X, y), 1,
+                                       [NaNGrad(at_step=0)])
+    assert outcomes == [StepOutcome.SKIPPED_NONFINITE]
+    after = _state_snapshot(tr)
+    for b, a in zip(before, after):
+        np.testing.assert_array_equal(a, b)
+    assert "non-finite grads" in tr._recorder.last_detail
+    # and training continues cleanly afterwards
+    _, outcomes = run_train_chaos(net, tr, _mse, (X, y), 2)
+    assert outcomes == [StepOutcome.APPLIED] * 2
+    assert tr.health == {"APPLIED": 4, "SKIPPED_NONFINITE": 1,
+                         "SKIPPED_STALE": 0, "HALTED_POISONED": 0}
+
+
+def test_guard_no_retrace_across_fault_transitions():
+    """Skip-step and scale decay/growth are pure data: one trace of the
+    fused group and one of the guard reduction across clean -> nan ->
+    clean -> nan transitions."""
+    net = _build_net()
+    tr = _trainer(net, scaler=LossScaler(init_scale=16.0, scale_window=2))
+    X, y = _data()
+    run_train_chaos(net, tr, _mse, (X, y), 8,
+                    [NaNGrad(at_step=2, seed=1), NaNGrad(at_step=5, seed=2)])
+    assert tr._fused.trace_count == 1
+    assert tr._fused.guard_trace_count == 1
+    assert len(tr._fused._jits) == 1
+    assert tr.health["SKIPPED_NONFINITE"] == 2
+    assert tr.health["APPLIED"] == 6
+
+
+def test_guarded_clean_run_matches_unguarded():
+    """The guard must be a no-op on healthy steps — same trajectory
+    with guard on and off."""
+    res = {}
+    for guard in (False, True):
+        net = _build_net(seed=3)
+        tr = _trainer(net, guard=guard)
+        X, y = _data(seed=4)
+        losses, _ = run_train_chaos(net, tr, _mse, (X, y), 4)
+        res[guard] = (losses, [p.data().asnumpy() for p in tr._params])
+    assert res[False][0] == res[True][0]
+    for a, b in zip(res[False][1], res[True][1]):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_skipped_step_does_not_advance_counters():
+    """Adam bias correction must see the same t sequence whether or not
+    skipped steps happened in between (skips never happened, as far as
+    schedules and bias correction are concerned)."""
+    net = _build_net(seed=5)
+    tr = _trainer(net)
+    X, y = _data(seed=6)
+    run_train_chaos(net, tr, _mse, (X, y), 2)
+    nu_before = tr.optimizer.num_update
+    counts_before = dict(tr.optimizer._index_update_count)
+    run_train_chaos(net, tr, _mse, (X, y), 1, [NaNGrad(at_step=0)])
+    assert tr.optimizer.num_update == nu_before
+    assert dict(tr.optimizer._index_update_count) == counts_before
+
+    # trajectory with an injected skip == trajectory without it
+    net_b = _build_net(seed=5)
+    tr_b = _trainer(net_b)
+    run_train_chaos(net_b, tr_b, _mse, (X, y), 2)
+    run_train_chaos(net, tr, _mse, (X, y), 2)      # faulted trainer
+    run_train_chaos(net_b, tr_b, _mse, (X, y), 2)  # clean trainer
+    for pa, pb in zip(tr._params, tr_b._params):
+        np.testing.assert_array_equal(pa.data().asnumpy(),
+                                      pb.data().asnumpy())
+
+
+def test_halt_poisoned_after_k_consecutive():
+    net = _build_net()
+    tr = _trainer(net, max_nf=3)
+    X, y = _data()
+    with pytest.raises(MXNetError, match="poisoned"):
+        run_train_chaos(net, tr, _mse, (X, y), 5,
+                        [_AlwaysNaN()])
+    assert tr.health["SKIPPED_NONFINITE"] == 2
+    assert tr.health["HALTED_POISONED"] == 1
+    assert tr.last_outcome is StepOutcome.HALTED_POISONED
+    assert sum(tr.health.values()) == 3
+
+
+class _AlwaysNaN(NaNGrad):
+    """NaN every step (divergence, not a transient)."""
+
+    def __init__(self):
+        super().__init__(at_step=0)
+
+    def on_grads(self, step_idx, trainer):
+        self.fired = False
+        super().on_grads(step_idx, trainer)
+
+
+def test_skipped_stale_outcome():
+    net = _build_net()
+    tr = _trainer(net)
+    X, y = _data()
+    run_train_chaos(net, tr, _mse, (X, y), 1)
+    tr.step(8, ignore_stale_grad=True)     # no backward since last step
+    assert tr.last_outcome is StepOutcome.SKIPPED_STALE
+    assert tr.health["SKIPPED_STALE"] == 1
+
+
+def test_loss_scaler_halves_on_overflow_and_regrows():
+    net = _build_net()
+    scaler = LossScaler(init_scale=64.0, scale_window=3)
+    tr = _trainer(net, scaler=scaler)
+    X, y = _data()
+    # persistent storm: any scale above 16 overflows. The scaler must
+    # halve its way down (64 -> 32 -> 16, one skip each), run clean,
+    # regrow after scale_window=3 clean steps (16 -> 32), hit the
+    # ceiling again (one skip back to 16), and keep training — the
+    # full decay/recover/probe cycle
+    _, outcomes = run_train_chaos(
+        net, tr, _mse, (X, y), 8, [OverflowStorm(at_step=0,
+                                                 overflow_above=16.0)])
+    S, A = StepOutcome.SKIPPED_NONFINITE, StepOutcome.APPLIED
+    assert outcomes == [S, S, A, A, A, S, A, A]
+    assert scaler.loss_scale == 16.0
+    assert tr.health_snapshot()["loss_scale"] == 16.0
+    # the traced-scalar path: scale changes never retraced
+    assert tr._fused.trace_count == 1
+    assert tr._fused.guard_trace_count == 1
+
+
+def test_scaler_without_guard_warns():
+    net = _build_net()
+    with pytest.warns(UserWarning, match="guard is off"):
+        gluon.Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1}, kvstore=None,
+                      fuse_step=False, loss_scaler=LossScaler())
+
+
+def test_amp_init_trainer_drives_guarded_scaling():
+    """The legacy amp surface rides the new machinery: init_trainer's
+    scaler adapts automatically through the guard."""
+    from incubator_mxnet_tpu import amp
+    net = _build_net()
+    tr = _trainer(net)
+    try:
+        amp.init(target_dtype="bfloat16")
+        amp.init_trainer(tr)
+        tr._amp_loss_scaler = LossScaler(init_scale=8.0, scale_window=100)
+        X, y = _data()
+        run_train_chaos(net, tr, _mse, (X, y), 1, [NaNGrad(at_step=0)])
+        assert tr._amp_loss_scaler.loss_scale == 4.0
+    finally:
+        amp._deinit_for_tests()
+
+
+def test_scaler_and_health_ride_the_capsule(tmp_path):
+    """Scaler trajectory + step-health counters resume from the capsule
+    (a restart must not re-warm the scale — bit-exact loss contract)."""
+    from incubator_mxnet_tpu.checkpoint import CheckpointManager
+    net = _build_net(seed=9)
+    tr = _trainer(net, scaler=LossScaler(init_scale=32.0, scale_window=4))
+    X, y = _data(seed=10)
+    run_train_chaos(net, tr, _mse, (X, y), 3, [NaNGrad(at_step=1)])
+    assert tr._amp_loss_scaler.loss_scale == 16.0
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=2)
+    tr.save_checkpoint(mgr, block=True)
+    mgr.wait()
+
+    net2 = _build_net(seed=9)
+    # a fresh scaler with DIFFERENT settings: the capsule must overwrite
+    tr2 = _trainer(net2, scaler=LossScaler(init_scale=2.0,
+                                           scale_window=4))
+    tr2.restore_checkpoint(mgr)
+    assert tr2._amp_loss_scaler.loss_scale == 16.0
+    assert tr2._amp_loss_scaler._unskipped == tr._amp_loss_scaler._unskipped
+    assert tr2.health == tr.health
+    assert tr2._recorder.consecutive_nonfinite == \
+        tr._recorder.consecutive_nonfinite
+
+    # the resumed trainer continues the EXACT trajectory
+    l_a, _ = run_train_chaos(net, tr, _mse, (X, y), 2)
+    l_b, _ = run_train_chaos(net2, tr2, _mse, (X, y), 2)
+    assert l_a == l_b
+
+    # restoring into a SCALERLESS trainer must not inject one (a plain
+    # loss.backward() loop would then silently divide every update by
+    # the saved scale) — it warns and resumes unscaled instead
+    net3 = _build_net(seed=9)
+    tr3 = _trainer(net3)
+    with pytest.warns(RuntimeWarning, match="DROPPED"):
+        tr3.restore_checkpoint(mgr)
+    assert tr3._amp_loss_scaler is None
+    mgr.close()
+
+
+def test_backward_multi_loss_with_scaler():
+    """trainer.backward accepts a list of losses, matching scale_loss's
+    contract (seeds each head with the scale)."""
+    from incubator_mxnet_tpu import autograd
+    net = _build_net(seed=31)
+    tr = _trainer(net, scaler=LossScaler(init_scale=4.0,
+                                         scale_window=100))
+    X, y = _data(seed=32)
+    with autograd.record():
+        out = net(nd.array(X))
+        l1 = ((out - nd.array(y)) ** 2).mean()
+        l2 = (out ** 2).mean()
+    tr.backward([l1, l2])
+    g = list(net.collect_params().values())[0].grad()
+    # reference: unscaled sum of both heads, times the scale
+    net_b = _build_net(seed=31)
+    tr_b = _trainer(net_b)
+    with autograd.record():
+        out = net_b(nd.array(X))
+        L = ((out - nd.array(y)) ** 2).mean() + (out ** 2).mean()
+    tr_b.backward(L)
+    g_b = list(net_b.collect_params().values())[0].grad()
+    np.testing.assert_allclose(g.asnumpy(), 4.0 * g_b.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+# --------------------------------------------------------------------- #
+# SPMD: the guard inside the one-compile fused step
+# --------------------------------------------------------------------- #
+
+def _spmd_setup(sharding="replicated", axis_sizes=None, scaler=None,
+                max_nf=None, seed=7):
+    mx.random.seed(seed)
+    net = nn.Sequential()
+    net.add(nn.Dense(16, in_units=8, activation="relu"),
+            nn.Dense(4, in_units=16))
+    net.initialize()
+    mesh = pmesh.build_mesh(axis_sizes=axis_sizes or {"dp": 8})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = parallel.SPMDTrainer(net, loss=loss_fn, optimizer="adam",
+                              optimizer_params={"learning_rate": 0.01},
+                              mesh=mesh, sharding=sharding,
+                              loss_scaler=scaler,
+                              max_consecutive_nonfinite=max_nf)
+    return net, tr
+
+
+@pytest.mark.parametrize("sharding,axes", [
+    ("replicated", {"dp": 8}),
+    ("fsdp", {"dp": 2, "fsdp": 4}),
+])
+def test_spmd_skip_step_parity(monkeypatch, sharding, axes):
+    """A non-finite batch skips the step with params + optimizer state
+    bit-identical, on dp AND fsdp meshes — and because the all-finite
+    reduction runs INSIDE the SPMD program, the skip decision is global
+    (every shard of every param stays untouched — the all-ranks-skip
+    contract)."""
+    monkeypatch.setenv("MXTPU_FSDP_MIN_SIZE", "0")
+    net, tr = _spmd_setup(sharding, axes)
+    rng = np.random.RandomState(2)
+    X = rng.randn(16, 8).astype(np.float32)
+    y = rng.randint(0, 4, size=(16,))
+    for _ in range(2):
+        tr.step(nd.array(X), nd.array(y))
+    w_before = [p.data().asnumpy().copy() for p in tr._params]
+    st_before = [np.asarray(leaf._data).copy()
+                 for st in tr._opt_state
+                 for leaf in _nd_leaves(st)]
+    sc_before = tr.step_count
+    inj = NaNBatch(at_step=0)
+    arrays = inj.on_batch(0, [X, y])
+    tr.step(nd.array(arrays[0]), nd.array(arrays[1]))
+    assert tr.last_outcome is StepOutcome.SKIPPED_NONFINITE
+    assert tr.step_count == sc_before        # t does not advance
+    for b, a in zip(w_before, [p.data().asnumpy() for p in tr._params]):
+        np.testing.assert_array_equal(a, b)
+    st_after = [np.asarray(leaf._data)
+                for st in tr._opt_state for leaf in _nd_leaves(st)]
+    for b, a in zip(st_before, st_after):
+        np.testing.assert_array_equal(a, b)
+    # clean step still applies, through the SAME program
+    tr.step(nd.array(X), nd.array(y))
+    assert tr.last_outcome is StepOutcome.APPLIED
+    assert tr.step_trace_count == 1
+    assert sum(tr.health.values()) == 4
+
+
+def _nd_leaves(st):
+    import jax.tree_util as jtu
+    return jtu.tree_leaves(st, is_leaf=lambda x: hasattr(x, "asnumpy"))
+
+
+def test_spmd_scaler_and_halt():
+    net, tr = _spmd_setup(scaler=LossScaler(init_scale=8.0,
+                                            scale_window=100),
+                          max_nf=2)
+    rng = np.random.RandomState(3)
+    X = rng.randn(16, 8).astype(np.float32)
+    y = rng.randint(0, 4, size=(16,))
+    Xbad = X.copy()
+    Xbad[0, 0] = np.nan
+    tr.step(nd.array(X), nd.array(y))
+    tr.step(nd.array(Xbad), nd.array(y))
+    assert tr.loss_scaler.loss_scale == 4.0
+    with pytest.raises(MXNetError, match="poisoned"):
+        tr.step(nd.array(Xbad), nd.array(y))
+    assert tr.health["HALTED_POISONED"] == 1
+    assert tr.step_trace_count == 1
+
+
+def test_spmd_guarded_clean_matches_unguarded():
+    res = {}
+    for guard in (False, True):
+        net, tr = _spmd_setup(seed=11)
+        tr.guard = guard
+        rng = np.random.RandomState(4)
+        X = rng.randn(16, 8).astype(np.float32)
+        y = rng.randint(0, 4, size=(16,))
+        losses = [float(tr.step(nd.array(X), nd.array(y)).asnumpy())
+                  for _ in range(3)]
+        res[guard] = (losses,
+                      [p.data().asnumpy() for p in tr._params])
+    assert res[False][0] == res[True][0]
+    for a, b in zip(res[False][1], res[True][1]):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_step_exception_does_not_wedge_recorder(monkeypatch):
+    """A step that dies before reaching the recorder (dispatch error)
+    must not leave it open — the NEXT step would be falsely accused of
+    a missing record."""
+    net = _build_net()
+    tr = _trainer(net)
+    X, y = _data()
+
+    def boom(*a, **k):
+        raise RuntimeError("dispatch exploded")
+
+    monkeypatch.setattr(tr._fused, "apply", boom)
+    with pytest.raises(RuntimeError, match="dispatch exploded"):
+        run_train_chaos(net, tr, _mse, (X, y), 1)
+    monkeypatch.undo()
+    _, outcomes = run_train_chaos(net, tr, _mse, (X, y), 1)
+    assert outcomes == [StepOutcome.APPLIED]
+
+
+def test_spmd_scaler_without_guard_warns_and_freezes_scale():
+    """Without the guard overflow can never be observed; the scale must
+    not ratchet up forever."""
+    mx.random.seed(7)
+    net2 = nn.Sequential()
+    net2.add(nn.Dense(16, in_units=8, activation="relu"),
+             nn.Dense(4, in_units=16))
+    net2.initialize()
+    with pytest.warns(UserWarning, match="guard is off"):
+        tr2 = parallel.SPMDTrainer(
+            net2, loss=gluon.loss.SoftmaxCrossEntropyLoss(),
+            optimizer="sgd", optimizer_params={"learning_rate": 0.1},
+            guard=False,
+            loss_scaler=LossScaler(init_scale=4.0, scale_window=1))
+    rng = np.random.RandomState(1)
+    X = rng.randn(16, 8).astype(np.float32)
+    y = rng.randint(0, 4, size=(16,))
+    for _ in range(3):
+        tr2.step(nd.array(X), nd.array(y))
+    assert tr2.loss_scaler.loss_scale == 4.0   # frozen, not ratcheting
+
+
+def test_row_sparse_grad_joins_guard_verdict():
+    """A NaN confined to a row_sparse embedding gradient must veto the
+    WHOLE step — sparse rows and fused dense groups alike (the
+    all-or-nothing contract; previously invisible to the guard)."""
+    mx.random.seed(13)
+    net = nn.Sequential()
+    net.add(nn.Embedding(20, 4, sparse_grad=True),
+            nn.Dense(4, in_units=4))
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.5}, kvstore=None)
+    from incubator_mxnet_tpu import autograd
+    idx = nd.array(np.array([3.0, 7.0]))
+    for _ in range(2):
+        with autograd.record():
+            L = (net(idx) ** 2).sum()
+        L.backward()
+        tr.step(1)
+    import jax.numpy as jnp
+    w_before = [p.data().asnumpy().copy()
+                for p in net.collect_params().values()]
+    with autograd.record():
+        L = (net(idx) ** 2).sum()
+    L.backward()
+    emb_grad = list(net.collect_params().values())[0].grad()
+    arr = np.asarray(emb_grad._data).copy()
+    arr[3, 0] = np.nan                       # poison only the embedding
+    emb_grad._data = jnp.asarray(arr)
+    tr.step(1)
+    assert tr.last_outcome is StepOutcome.SKIPPED_NONFINITE
+    for b, p in zip(w_before, net.collect_params().values()):
+        np.testing.assert_array_equal(b, p.data().asnumpy())
+    # clean step afterwards applies again
+    with autograd.record():
+        L = (net(idx) ** 2).sum()
+    L.backward()
+    tr.step(1)
+    assert tr.last_outcome is StepOutcome.APPLIED
+
+
+def test_explicit_save_step_survives_guard_skips(tmp_path):
+    """save_checkpoint(step=loop_index) must hand that exact index back
+    on restore even when guard skips made num_update drift below it —
+    resuming from num_update would re-run already-applied batches."""
+    from incubator_mxnet_tpu.checkpoint import CheckpointManager
+    net = _build_net(seed=21)
+    tr = _trainer(net, scaler=LossScaler(init_scale=8.0, scale_window=50))
+    X, y = _data(seed=22)
+    # 4 loop steps, one skipped -> num_update == 3, loop position == 4
+    run_train_chaos(net, tr, _mse, (X, y), 4, [NaNGrad(at_step=1)])
+    assert tr.optimizer.num_update == 3
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=2)
+    tr.save_checkpoint(mgr, step=4, block=True)
+
+    net2 = _build_net(seed=21)
+    tr2 = _trainer(net2)
+    assert tr2.restore_checkpoint(mgr) == 4   # the caller's loop index
+    assert tr2.optimizer.num_update == 3      # internal counter intact
+    mgr.close()

@@ -1,0 +1,470 @@
+"""Training chaos bench: drive the trainers through seeded fault
+scenarios and ASSERT the training resilience contract
+(docs/RESILIENCE.md "Training resilience"); bank the guard+scaler
+overhead and the supervisor recovery timeline (BENCH_TRAIN_RESIL.json).
+
+Scenarios (each asserts exactly-one-outcome-per-step and the jit-once
+contract on top of its own expectations):
+
+  nan_grad_skip    a NaN gradient at step k is SKIPPED with params and
+                   optimizer state BIT-IDENTICAL to pre-step, and every
+                   unfaulted step's loss bit-identical to a fault-free
+                   run's
+  overflow_storm   scale-dependent Inf gradients: the dynamic loss
+                   scale halves its way under the overflow threshold
+                   (one skip per halving), regrows after scale_window
+                   clean steps, and NEVER retraces the fused step
+  poison_halt      persistent NaN: after K consecutive non-finite
+                   steps the trainer halts loudly (HALTED_POISONED),
+                   never skip-loops forever
+  spmd_skip        the same skip contract inside the ONE-compile SPMD
+                   step on a dp2 x fsdp4 mesh (the all-finite reduction
+                   is global, so every rank skips the same step)
+  kill9_resume     a supervised training run kill -9'd twice mid-run:
+                   the supervisor restarts it from the latest committed
+                   checkpoint and the final per-step loss sequence is
+                   BIT-IDENTICAL to an uninterrupted run's; recovery
+                   timeline (steps re-run, restart wall) banked
+  hang_watchdog    a training child that wedges mid-run is SIGKILLed by
+                   the zero-progress watchdog and the restarted run
+                   completes
+  io_transient     MXTPU_IO_FAIL_READS blips under the retry bound
+                   lose no batch; at the bound the error surfaces
+                   loudly (never a hung consumer)
+
+Bench workloads (--json / full mode):
+
+  guard_overhead   guarded+scaled fused step vs unguarded step, strict
+                   alternation, per-step time quantiles (the round-10
+                   methodology — p50 is primary on a noisy host);
+                   <2% is the leave-on bar
+  recovery         kill9_resume's timeline: steps re-run, wall-clock
+                   from kill to resumed progress
+
+Usage:
+  python tools/train_chaos_bench.py --smoke        # CI guard (trainchaos)
+  python tools/train_chaos_bench.py --json OUT.json
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+FAILURES = []
+
+
+def check(cond, msg):
+    if cond:
+        print(f"    ok: {msg}")
+    else:
+        FAILURES.append(msg)
+        print(f"    FAIL: {msg}")
+
+
+# --------------------------------------------------------------------- #
+# shared workload
+# --------------------------------------------------------------------- #
+
+def _net(seed=0, width=16):
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.gluon import nn
+    mx.random.seed(seed)
+    net = nn.Sequential()
+    net.add(nn.Dense(width, in_units=8, activation="relu"),
+            nn.Dense(4, in_units=width))
+    net.initialize()
+    return net
+
+
+def _data(seed=1, n=8):
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    return (rng.randn(n, 8).astype(np.float32),
+            rng.randn(n, 4).astype(np.float32))
+
+
+def _mse(out, label):
+    return (out - label) ** 2
+
+
+def _trainer(net, scaler=None, guard=None, max_nf=None):
+    from incubator_mxnet_tpu import gluon
+    return gluon.Trainer(net.collect_params(), "adam",
+                         {"learning_rate": 0.01}, kvstore=None,
+                         loss_scaler=scaler, guard=guard,
+                         max_consecutive_nonfinite=max_nf)
+
+
+def _state(tr):
+    import numpy as np
+    import jax.tree_util as jtu
+    snap = [p.data().asnumpy().copy() for p in tr._params]
+    for _, st in sorted(tr._updaters[0].states.items()):
+        for leaf in jtu.tree_leaves(
+                st, is_leaf=lambda x: hasattr(x, "asnumpy")):
+            snap.append(np.asarray(leaf.asnumpy()).copy())
+    return snap
+
+
+# --------------------------------------------------------------------- #
+# scenarios
+# --------------------------------------------------------------------- #
+
+def scenario_nan_grad_skip(steps=10, fault_at=4):
+    from incubator_mxnet_tpu.train import (NaNGrad, StepOutcome,
+                                           run_train_chaos)
+    print("  [nan_grad_skip]")
+    X, y = _data()
+    ref_net = _net()
+    clean_losses, _ = run_train_chaos(ref_net, _trainer(ref_net), _mse,
+                                      (X, y), steps)
+
+    net = _net()
+    tr = _trainer(net)
+    run_train_chaos(net, tr, _mse, (X, y), fault_at)
+    losses, outcomes = run_train_chaos(
+        net, tr, _mse, (X, y), steps - fault_at,
+        [NaNGrad(at_step=0)])
+    check(outcomes[0] is StepOutcome.SKIPPED_NONFINITE,
+          "faulted step recorded SKIPPED_NONFINITE")
+    check(losses[0] == clean_losses[fault_at],
+          "loss at the faulted step computed on pre-fault params")
+    check(all(o is StepOutcome.APPLIED for o in outcomes[1:]),
+          "all later steps APPLIED")
+    check(tr._fused.trace_count == 1 and tr._fused.guard_trace_count == 1,
+          "fused step + guard compiled exactly once across the fault")
+    check(sum(tr.health.values()) == steps,
+          "exactly one outcome per step")
+    return {"outcomes": [str(o) for o in outcomes]}
+
+
+def scenario_nan_grad_state_identity(fault_at=3):
+    import numpy as np
+    from incubator_mxnet_tpu.train import NaNGrad, run_train_chaos
+    print("  [nan_grad_state_identity]")
+    X, y = _data()
+    net = _net()
+    tr = _trainer(net)
+    run_train_chaos(net, tr, _mse, (X, y), fault_at)
+    before = _state(tr)
+    run_train_chaos(net, tr, _mse, (X, y), 1, [NaNGrad(at_step=0)])
+    after = _state(tr)
+    check(all(np.array_equal(b, a) for b, a in zip(before, after)),
+          "skipped step left params + optimizer state bit-identical")
+    return {}
+
+
+def scenario_overflow_storm():
+    from incubator_mxnet_tpu.amp.loss_scaler import LossScaler
+    from incubator_mxnet_tpu.train import (OverflowStorm, StepOutcome,
+                                           run_train_chaos)
+    print("  [overflow_storm]")
+    X, y = _data()
+    net = _net()
+    scaler = LossScaler(init_scale=64.0, scale_window=3)
+    tr = _trainer(net, scaler=scaler)
+    _, outcomes = run_train_chaos(
+        net, tr, _mse, (X, y), 8,
+        [OverflowStorm(at_step=0, overflow_above=16.0)])
+    S, A = StepOutcome.SKIPPED_NONFINITE, StepOutcome.APPLIED
+    check(outcomes == [S, S, A, A, A, S, A, A],
+          "scale halved to the floor, regrew after scale_window, "
+          "re-probed the ceiling")
+    check(scaler.loss_scale == 16.0, "scale settled at the ceiling")
+    check(tr._fused.trace_count == 1,
+          "scale growth/decay never retraced the fused step")
+    return {"final_scale": scaler.loss_scale,
+            "outcomes": [str(o) for o in outcomes]}
+
+
+def scenario_poison_halt(k=4):
+    from incubator_mxnet_tpu.base import MXNetError
+    from incubator_mxnet_tpu.train import NaNGrad, run_train_chaos
+
+    class AlwaysNaN(NaNGrad):
+        def on_grads(self, step_idx, trainer):
+            self.fired = False
+            super().on_grads(step_idx, trainer)
+
+    print("  [poison_halt]")
+    X, y = _data()
+    net = _net()
+    tr = _trainer(net, max_nf=k)
+    halted = False
+    try:
+        run_train_chaos(net, tr, _mse, (X, y), k + 5,
+                        [AlwaysNaN(at_step=0)])
+    except MXNetError as e:
+        halted = True
+        check("poisoned" in str(e), "halt diagnostic names the poison")
+    check(halted, f"halted after {k} consecutive non-finite steps")
+    check(tr.health["HALTED_POISONED"] == 1 and
+          tr.health["SKIPPED_NONFINITE"] == k - 1,
+          "health: k-1 skips then one HALTED_POISONED")
+    check(sum(tr.health.values()) == k,
+          "exactly one outcome per attempted step")
+    return {"health": dict(tr.health)}
+
+
+def scenario_spmd_skip():
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, nd, parallel
+    from incubator_mxnet_tpu.parallel import mesh as pmesh
+    from incubator_mxnet_tpu.train import StepOutcome
+    print("  [spmd_skip]")
+    os.environ["MXTPU_FSDP_MIN_SIZE"] = "0"
+    net = _net(seed=7)
+    mesh = pmesh.build_mesh(axis_sizes={"dp": 2, "fsdp": 4})
+    tr = parallel.SPMDTrainer(
+        net, loss=gluon.loss.SoftmaxCrossEntropyLoss(),
+        optimizer="adam", optimizer_params={"learning_rate": 0.01},
+        mesh=mesh, sharding="fsdp")
+    rng = np.random.RandomState(2)
+    X = rng.randn(16, 8).astype(np.float32)
+    y = rng.randint(0, 4, size=(16,))
+    for _ in range(2):
+        tr.step(nd.array(X), nd.array(y))
+    w_before = [p.data().asnumpy().copy() for p in tr._params]
+    sc = tr.step_count
+    Xbad = X.copy()
+    Xbad[0, 0] = float("nan")
+    tr.step(nd.array(Xbad), nd.array(y))
+    check(tr.last_outcome is StepOutcome.SKIPPED_NONFINITE,
+          "NaN batch skipped inside the SPMD step")
+    check(tr.step_count == sc, "step counter did not advance on skip")
+    same = all(np.array_equal(b, p.data().asnumpy())
+               for b, p in zip(w_before, tr._params))
+    check(same, "params bit-identical across the skipped step "
+                "(global skip on an fsdp-sharded mesh)")
+    tr.step(nd.array(X), nd.array(y))
+    check(tr.last_outcome is StepOutcome.APPLIED and
+          tr.step_trace_count == 1,
+          "clean step applied through the SAME compiled program")
+    check(sum(tr.health.values()) == 4, "exactly one outcome per step")
+    os.environ.pop("MXTPU_FSDP_MIN_SIZE", None)
+    return {"health": dict(tr.health)}
+
+
+# --------------------------------------------------------------------- #
+# supervisor scenarios (subprocess)
+# --------------------------------------------------------------------- #
+
+def _run_target(workdir, tag, steps, kill_at="", hang_at=None,
+                max_restarts=0, hang_timeout_s=None, save_every=2):
+    from incubator_mxnet_tpu.train import Supervisor
+    ckpt = os.path.join(workdir, f"ckpt_{tag}")
+    results = os.path.join(workdir, f"results_{tag}.jsonl")
+    os.makedirs(ckpt, exist_ok=True)
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "MXTPU_TGT_CKPT_DIR": ckpt,
+        "MXTPU_TGT_RESULTS": results,
+        "MXTPU_TGT_STEPS": str(steps),
+        "MXTPU_TGT_SAVE_EVERY": str(save_every),
+        "MXTPU_TGT_KILL_AT": kill_at,
+    }
+    if hang_at is not None:
+        env["MXTPU_TGT_HANG_AT"] = str(hang_at)
+    sup = Supervisor(
+        [sys.executable, "-m",
+         "incubator_mxnet_tpu.train.example_target"],
+        ckpt_dir=ckpt, progress_file=results,
+        max_restarts=max_restarts, backoff_s=0.05,
+        hang_timeout_s=hang_timeout_s, env=env)
+    t0 = time.perf_counter()
+    report = sup.run(raise_on_failure=False)
+    wall = time.perf_counter() - t0
+    rows = []
+    if os.path.exists(results):
+        with open(results) as f:
+            rows = [json.loads(line) for line in f]
+    by_step = {}
+    for r in rows:
+        by_step[r["step"]] = r["loss"]
+    return report, by_step, rows, wall
+
+
+def scenario_kill9_resume(workdir, steps=16, kills=(6, 11)):
+    print("  [kill9_resume]")
+    _, clean, _, clean_wall = _run_target(workdir, "clean", steps)
+    kill_at = ",".join(str(k) for k in kills)
+    report, survived, rows, wall = _run_target(
+        workdir, "killed", steps, kill_at=kill_at,
+        max_restarts=len(kills) + 2)
+    check(report.completed, "supervised run completed")
+    check(report.restarts == len(kills),
+          f"exactly {len(kills)} restarts for {len(kills)} kills")
+    check(set(survived) == set(range(steps)),
+          "every step's loss recorded")
+    exact = all(survived.get(s) == clean.get(s) for s in range(steps))
+    check(exact, "resumed loss sequence BIT-IDENTICAL to uninterrupted "
+                 "run")
+    steps_rerun = len(rows) - steps
+    check(0 <= steps_rerun <= len(kills) * 2 + 2,
+          f"steps re-run bounded by save cadence (got {steps_rerun})")
+    return {"restarts": report.restarts,
+            "steps_rerun": steps_rerun,
+            "supervised_wall_s": round(wall, 3),
+            "clean_wall_s": round(clean_wall, 3),
+            "attempts": [a.reason for a in report.attempts]}
+
+
+def scenario_hang_watchdog(workdir, steps=8):
+    print("  [hang_watchdog]")
+    report, by_step, _, _ = _run_target(
+        workdir, "hang", steps, hang_at=4, max_restarts=2,
+        hang_timeout_s=3.0)
+    check(report.completed, "hung run completed after watchdog restart")
+    check(report.hang_kills == 1, "exactly one hang kill")
+    check(set(by_step) == set(range(steps)), "every step trained")
+    return {"hang_kills": report.hang_kills,
+            "attempts": [a.reason for a in report.attempts]}
+
+
+def scenario_io_transient():
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.io import NDArrayIter, PrefetchingIter
+    print("  [io_transient]")
+    data = np.arange(48, dtype=np.float32).reshape(48, 1)
+    os.environ["MXTPU_IO_FAIL_READS"] = "2"
+    os.environ["MXTPU_IO_RETRY_ATTEMPTS"] = "3"
+    os.environ["MXTPU_IO_RETRY_BACKOFF"] = "0.001"
+    try:
+        pf = PrefetchingIter(NDArrayIter(data, batch_size=4))
+        batches = list(pf)
+        check(len(batches) == 12,
+              "transient blips under the retry bound lost no batch")
+        check(pf.read_retries == 2, "retries counted")
+        os.environ["MXTPU_IO_FAIL_READS"] = "99"
+        pf2 = PrefetchingIter(NDArrayIter(data, batch_size=4))
+        loud = False
+        try:
+            pf2.next()
+        except OSError:
+            loud = True
+        check(loud, "persistent IO failure surfaced loudly, no hang")
+    finally:
+        for k in ("MXTPU_IO_FAIL_READS", "MXTPU_IO_RETRY_ATTEMPTS",
+                  "MXTPU_IO_RETRY_BACKOFF"):
+            os.environ.pop(k, None)
+    return {}
+
+
+# --------------------------------------------------------------------- #
+# bench: guard + scaler steady-state overhead (strict alternation)
+# --------------------------------------------------------------------- #
+
+def bench_guard_overhead(steps=400, width=64):
+    """Per-step wall time, guarded+scaled vs unguarded fused step, in
+    STRICT ALTERNATION (round-10 methodology: paired windows disagree
+    on the sign at this effect size on a noisy CPU host; per-step
+    quantiles of alternating steps are robust — p50 primary)."""
+    import numpy as np
+    from incubator_mxnet_tpu import autograd, nd
+    from incubator_mxnet_tpu.amp.loss_scaler import LossScaler
+    print("  [bench guard_overhead]")
+    X, y = _data(n=16)
+    nets = {}
+    trainers = {}
+    for arm, (guard, scaler) in {
+            "unguarded": (False, None),
+            "guarded": (True, LossScaler(init_scale=2.0,
+                                         scale_window=10 ** 9))}.items():
+        net = _net(seed=3, width=width)
+        nets[arm] = net
+        trainers[arm] = _trainer(net, scaler=scaler, guard=guard)
+    times = {"unguarded": [], "guarded": []}
+
+    def one_step(arm):
+        net, tr = nets[arm], trainers[arm]
+        t0 = time.perf_counter()
+        with autograd.record():
+            L = _mse(net(nd.array(X)), nd.array(y)).mean()
+        tr.backward(L)       # scale rides the backward seed (free)
+        tr.step(X.shape[0])
+        return time.perf_counter() - t0
+
+    for arm in ("unguarded", "guarded"):    # warmup: compiles
+        for _ in range(5):
+            one_step(arm)
+    for i in range(steps):                  # strict alternation
+        for arm in (("unguarded", "guarded") if i % 2 == 0
+                    else ("guarded", "unguarded")):
+            times[arm].append(one_step(arm))
+    out = {}
+    for arm, ts in times.items():
+        ts = np.sort(np.asarray(ts))
+        out[arm] = {"p50_ms": float(np.percentile(ts, 50) * 1e3),
+                    "p90_ms": float(np.percentile(ts, 90) * 1e3),
+                    "steps": len(ts)}
+    overhead = out["guarded"]["p50_ms"] / out["unguarded"]["p50_ms"] - 1.0
+    out["overhead_p50"] = round(overhead, 4)
+    tr = trainers["guarded"]
+    check(tr._fused.trace_count == 1 and tr._fused.guard_trace_count == 1,
+          "guarded arm compiled exactly once")
+    print(f"    guarded p50 {out['guarded']['p50_ms']:.3f} ms vs "
+          f"unguarded {out['unguarded']['p50_ms']:.3f} ms -> "
+          f"overhead {overhead * 100:+.2f}%")
+    return out
+
+
+# --------------------------------------------------------------------- #
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI guard: small sizes, exit non-zero on any "
+                         "violated invariant")
+    ap.add_argument("--json", default=None,
+                    help="write results (and bank-ready bench numbers)")
+    ap.add_argument("--overhead-steps", type=int, default=None)
+    args = ap.parse_args()
+
+    results = {"mode": "smoke" if args.smoke else "full"}
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory() as workdir:
+        print("== training chaos scenarios ==")
+        results["nan_grad_skip"] = scenario_nan_grad_skip()
+        results["nan_grad_state_identity"] = \
+            scenario_nan_grad_state_identity()
+        results["overflow_storm"] = scenario_overflow_storm()
+        results["poison_halt"] = scenario_poison_halt()
+        results["spmd_skip"] = scenario_spmd_skip()
+        results["io_transient"] = scenario_io_transient()
+        results["kill9_resume"] = scenario_kill9_resume(workdir)
+        results["hang_watchdog"] = scenario_hang_watchdog(workdir)
+        print("== bench ==")
+        steps = args.overhead_steps or (120 if args.smoke else 400)
+        results["guard_overhead"] = bench_guard_overhead(steps=steps)
+        if args.smoke:
+            check(results["guard_overhead"]["overhead_p50"] < 0.05,
+                  "guard+scaler overhead under the smoke bar (5%; the "
+                  "banked bar is 2% at full sample size)")
+    results["wall_s"] = round(time.perf_counter() - t0, 2)
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=2)
+        print(f"wrote {args.json}")
+
+    print(f"\n{len(FAILURES)} failures; wall {results['wall_s']}s")
+    if FAILURES:
+        for m in FAILURES:
+            print(f"  FAIL: {m}")
+        return 1
+    print("train chaos: all invariants held")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
