@@ -74,6 +74,20 @@ stage_fleetsmoke() {
   JAX_PLATFORMS=cpu python tools/chaos_bench.py --fleet --smoke
 }
 
+stage_tiersmoke() {
+  echo "== tiersmoke: SLO-tier resilience guard (priority scheduling under"
+  echo "              a mixed-tier overload storm — LATENCY preempts BATCH"
+  echo "              slots and resumes them bit-identically, shedding"
+  echo "              drains BATCH first; client cancel storms land as"
+  echo "              exactly-one CANCELLED terminal from any live state;"
+  echo "              preemption composes with NaN quarantine; brownout"
+  echo "              hysteresis steps degrade levels up and back down;"
+  echo "              fails on any non-terminal request, tier-ordering"
+  echo "              violation, parity break, page-audit violation, or"
+  echo "              steady-state retrace)"
+  JAX_PLATFORMS=cpu python tools/chaos_bench.py --tiers --smoke
+}
+
 stage_trainchaos() {
   echo "== trainchaos: training resilience guard (seeded faults — NaN"
   echo "               gradients, overflow storms, persistent poison, NaN"
@@ -106,7 +120,7 @@ ge.dryrun_multichip(8)"
 }
 
 stages=("$@")
-[ ${#stages[@]} -eq 0 ] && stages=(sanity native unit stepbench servebench chaossmoke fleetsmoke trainchaos ckptbench entry)
+[ ${#stages[@]} -eq 0 ] && stages=(sanity native unit stepbench servebench chaossmoke fleetsmoke tiersmoke trainchaos ckptbench entry)
 for s in "${stages[@]}"; do
   "stage_$s"
 done

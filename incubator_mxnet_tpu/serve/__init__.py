@@ -34,13 +34,18 @@ from .paged_kv import (NULL_PAGE, PageAllocator, PrefixIndex,
                        init_kv_pools, write_block_kv, write_prompt_kv,
                        write_token_kv)
 from .outcomes import Outcome
+from .slo import (BrownoutController, Tier, TierPolicy,
+                  default_tier_policies)
 from .draft import make_ngram_drafter, ngram_propose
 from .engine import InferenceEngine, Request
 from .router import (Replica, ReplicaKilled, ReplicaState, Router,
                      build_fleet)
+from .metrics import render_metrics
 
 __all__ = ["InferenceEngine", "Request", "Outcome", "PageAllocator",
            "PrefixIndex", "NULL_PAGE", "init_kv_pools", "write_token_kv",
            "write_prompt_kv", "write_block_kv", "ngram_propose",
            "make_ngram_drafter", "Router", "Replica", "ReplicaState",
-           "ReplicaKilled", "build_fleet"]
+           "ReplicaKilled", "build_fleet", "Tier", "TierPolicy",
+           "default_tier_policies", "BrownoutController",
+           "render_metrics"]

@@ -37,10 +37,10 @@ from .outcomes import Outcome
 from .router import ReplicaState, Router
 
 __all__ = ["ChaosInjector", "NaNWeights", "CorruptPageWrite",
-           "PagePressure", "DelayedSteps", "run_chaos",
+           "PagePressure", "DelayedSteps", "CancelStorm", "run_chaos",
            "assert_all_terminal", "assert_health_consistent",
            "FleetInjector", "KillReplica", "SlowReplica",
-           "FlappingReplica", "run_fleet_chaos",
+           "FlappingReplica", "FleetCancelStorm", "run_fleet_chaos",
            "assert_fleet_health_consistent"]
 
 
@@ -238,6 +238,57 @@ class DelayedSteps(ChaosInjector):
             time.sleep(self.sleep_s)
 
 
+class CancelStorm(ChaosInjector):
+    """The disconnect fault: clients walk away mid-stream. Every
+    ``every`` scheduler steps from ``start``, cancel up to ``n_per``
+    seeded-random LIVE requests (queued or slotted — so cancels land
+    while queued, mid-prefill, mid-decode and mid-spec-verify as the
+    workload moves through those states), up to ``max_cancels`` total
+    so part of the workload survives to assert isolation against.
+    Cancelled requests are ``affected`` (their streams truncate);
+    everything else must stay bit-identical, pages audited after every
+    step, and every cancel must land as EXACTLY ONE ``CANCELLED``
+    terminal — never a double-finish against a racing completion
+    (``engine.cancel`` refuses already-terminal targets)."""
+
+    name = "cancel_storm"
+
+    def __init__(self, start: int, every: int = 2, n_per: int = 1,
+                 max_cancels: int = 4, seed: int = 0):
+        super().__init__(seed)
+        self.start = start
+        self.every = max(1, int(every))
+        self.n_per = int(n_per)
+        self.max_cancels = int(max_cancels)
+        self.cancelled: List[Request] = []
+
+    def _live(self, engine) -> List[Request]:
+        live = [s.request for s in engine._slots if s is not None]
+        live.extend(engine._queue)
+        return [r for r in live if r.outcome is None]
+
+    def on_step(self, engine, step_idx):
+        if step_idx < self.start or \
+                (step_idx - self.start) % self.every or \
+                len(self.cancelled) >= self.max_cancels:
+            return
+        live = self._live(engine)
+        if not live:
+            return
+        n = min(self.n_per, self.max_cancels - len(self.cancelled),
+                len(live))
+        for i in self.rng.choice(len(live), size=n, replace=False):
+            req = live[int(i)]
+            if engine.cancel(req, detail=f"{self.name} at step "
+                                         f"{step_idx}"):
+                self.fired = True
+                self.cancelled.append(req)
+                self._mark(req)
+                self.log.append(f"step {step_idx}: cancelled request "
+                                f"{req.request_id} "
+                                f"({len(req.token_ids)} tokens in)")
+
+
 # --------------------------------------------------------------------- #
 # fleet-scope injectors (serve/router.py)
 # --------------------------------------------------------------------- #
@@ -384,6 +435,48 @@ class FlappingReplica(FleetInjector):
         rep.delay_s = self.sleep_s if slow else 0.0
 
 
+class FleetCancelStorm(FleetInjector):
+    """Router-level cancel storm: same cadence as ``CancelStorm`` but
+    through ``Router.cancel`` — cancels land on CLIENT requests
+    whether they sit in the router queue or are in flight on a
+    replica (where the router must also reclaim the engine-side
+    attempt)."""
+
+    name = "fleet_cancel_storm"
+
+    def __init__(self, start: int, every: int = 2, n_per: int = 1,
+                 max_cancels: int = 4, seed: int = 0):
+        super().__init__(seed)
+        self.start = start
+        self.every = max(1, int(every))
+        self.n_per = int(n_per)
+        self.max_cancels = int(max_cancels)
+        self.cancelled: List[Request] = []
+
+    def on_step(self, router, step_idx):
+        if step_idx < self.start or \
+                (step_idx - self.start) % self.every or \
+                len(self.cancelled) >= self.max_cancels:
+            return
+        live = [t.client for t in router._queue] + \
+               [t.client for t in router._inflight]
+        live = [r for r in live if r.outcome is None]
+        if not live:
+            return
+        n = min(self.n_per, self.max_cancels - len(self.cancelled),
+                len(live))
+        for i in self.rng.choice(len(live), size=n, replace=False):
+            req = live[int(i)]
+            if router.cancel(req, detail=f"{self.name} at step "
+                                         f"{step_idx}"):
+                self.fired = True
+                self.cancelled.append(req)
+                self._mark(req)
+                self.log.append(f"step {step_idx}: cancelled client "
+                                f"request {req.request_id} "
+                                f"({len(req.token_ids)} tokens in)")
+
+
 def run_fleet_chaos(router: Router, requests, injectors,
                     arrival_times=None, audit_every_step: bool = True,
                     poll_sleep: float = 1e-3):
@@ -422,6 +515,11 @@ def assert_fleet_health_consistent(router: Router, requests):
     if tally != router.health:
         raise MXNetError(f"router health {router.health} != outcome "
                          f"tally {tally}")
+    by_tier = _tier_tally(requests)
+    if by_tier != router.health_by_tier:
+        raise MXNetError(f"router per-tier health "
+                         f"{router.health_by_tier} != per-tier tally "
+                         f"{by_tier}")
 
 
 def run_chaos(engine: InferenceEngine, requests, injectors,
@@ -454,13 +552,26 @@ def assert_all_terminal(requests):
                          f"outcome — the engine failed quiescence")
 
 
+def _tier_tally(requests):
+    from .slo import Tier
+    by_tier = {t.value: {o.value: 0 for o in Outcome} for t in Tier}
+    for r in requests:
+        by_tier[r.tier.value][r.outcome.value] += 1
+    return by_tier
+
+
 def assert_health_consistent(engine: InferenceEngine, requests):
     """The engine's health counters must equal the per-request outcome
     tally — a counter drifting from the outcomes it summarizes would
-    lie to the operator exactly when it matters."""
+    lie to the operator exactly when it matters. The per-tier split
+    (the /metrics surface) must agree too."""
     tally = {o.value: 0 for o in Outcome}
     for r in requests:
         tally[r.outcome.value] += 1
     if tally != engine.health:
         raise MXNetError(f"health counters {engine.health} != outcome "
                          f"tally {tally}")
+    by_tier = _tier_tally(requests)
+    if by_tier != engine.health_by_tier:
+        raise MXNetError(f"per-tier health {engine.health_by_tier} != "
+                         f"per-tier tally {by_tier}")

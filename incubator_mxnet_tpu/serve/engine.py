@@ -90,10 +90,11 @@ multi-tenant decode.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import math
 import time
 from collections import deque
-from typing import List, Optional
+from typing import List, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -114,10 +115,17 @@ from .outcomes import Outcome
 from .paged_kv import (NULL_PAGE, PageAllocator, PrefixIndex,
                        init_kv_pools, write_block_kv, write_prompt_kv,
                        write_token_kv)
+from .slo import (BrownoutController, Tier, TierPolicy,
+                  resolve_tier_policies)
 
-__all__ = ["Request", "InferenceEngine", "Outcome"]
+__all__ = ["Request", "InferenceEngine", "Outcome", "Tier",
+           "TierPolicy"]
 
 _NEG_BIG = -1e30
+
+_REQUEST_IDS = itertools.count(1)    # process-wide: ids never collide
+                                     # across engines, so a router can
+                                     # address any request it has seen
 
 
 @dataclasses.dataclass
@@ -136,7 +144,17 @@ class Request:
     outcomes and ``retry_after_s`` the backpressure hint on SHED.
     ``drafted_tokens``/``accepted_tokens`` count this request's
     speculative drafting activity (accepted <= drafted; both 0 when
-    the engine does not speculate)."""
+    the engine does not speculate).
+
+    ``tier`` is the request's SLO priority class (serve/slo.py):
+    LATENCY outranks STANDARD outranks BATCH in admission order, shed
+    order (BATCH drains first) and slot preemption (a LATENCY
+    admission may reclaim a BATCH slot mid-decode — the preempted
+    request re-queues and resumes from its emitted suffix,
+    bit-identically). ``request_id`` is a process-unique handle for
+    client cancellation (``engine.cancel`` / ``router.cancel``);
+    auto-assigned unless pinned. ``preemptions`` counts how many times
+    a higher tier reclaimed this request's slot."""
 
     prompt_ids: np.ndarray
     max_new_tokens: int = 32
@@ -144,8 +162,11 @@ class Request:
     eos_id: int = -1
     deadline_s: Optional[float] = None
     seed: Optional[int] = None
+    tier: Tier = Tier.STANDARD
+    request_id: Optional[int] = None
 
     # filled in by the engine
+    preemptions: int = 0
     drafted_tokens: int = 0
     accepted_tokens: int = 0
     token_ids: List[int] = dataclasses.field(default_factory=list)
@@ -157,6 +178,12 @@ class Request:
     detail: str = ""
     retry_after_s: Optional[float] = None
     _deadline_abs: Optional[float] = None
+    _assigned_key: Optional[np.ndarray] = None   # engine-drawn RNG key,
+                                                 # pinned at first
+                                                 # admission so a
+                                                 # preemption resume
+                                                 # replays the SAME
+                                                 # sampling stream
 
     def __post_init__(self):
         self.prompt_ids = np.asarray(self.prompt_ids, np.int32).reshape(-1)
@@ -166,6 +193,13 @@ class Request:
             raise MXNetError("max_new_tokens must be >= 1")
         if self.deadline_s is not None and self.deadline_s <= 0:
             raise MXNetError("deadline_s must be > 0 (or None)")
+        if isinstance(self.tier, str):
+            self.tier = Tier(self.tier)
+        if not isinstance(self.tier, Tier):
+            raise MXNetError(f"tier must be a serve.Tier, got "
+                             f"{self.tier!r}")
+        if self.request_id is None:
+            self.request_id = next(_REQUEST_IDS)
 
 
 @dataclasses.dataclass
@@ -175,7 +209,12 @@ class _Slot:
     refs: List[int]              # pages this slot holds a refcount on
     row: np.ndarray              # (max_pages,) page row; installed into
                                  # the decode page table when prefill ends
-    t0: int                      # prompt length
+    t0: int                      # attempt prompt length (original
+                                 # prompt + tokens emitted before a
+                                 # preemption resume)
+    attempt_ids: np.ndarray      # the attempt prompt itself — what the
+                                 # prefill programs process and the
+                                 # prefix index is keyed by
     prefill_pos: int             # prompt tokens whose K/V is populated
     t_admit: float
     key: np.ndarray = None       # (2,) uint32 per-request RNG key
@@ -240,6 +279,32 @@ class InferenceEngine:
       decoding, queue head unadmittable) before the head request is
       failed FAILED_UNSERVABLE instead of waiting forever.
 
+    SLO-tier knobs (serve/slo.py, docs/RESILIENCE.md):
+
+    - ``tier_policies``: {Tier: TierPolicy} overrides merged over
+      ``default_tier_policies()`` — per-tier ``max_queue`` /
+      ``max_queue_delay_s`` / ``default_deadline_s`` scoping of the
+      global knobs, plus the preemption contract. Admission is
+      priority-ordered (LATENCY > STANDARD > BATCH, FIFO within a
+      tier), overload shedding drains the lowest queued tier first,
+      and a tier that ``can_preempt`` may reclaim a ``preemptible``
+      lower-tier slot mid-decode: the victim keeps its partial tokens
+      and re-queues through normal admission as a resume-from-suffix
+      replay (continuation bit-identical — same pinned sampling key,
+      position-keyed draws), bounded by ``max_preemptions`` before a
+      retryable PREEMPTED terminal;
+    - ``brownout``: True (default controller) or a
+      ``BrownoutController`` — deterministic hysteresis over pressure
+      signals stepping through degrade levels (1: speculation off,
+      2: chunked-prefill budget clamped to one chunk, 3: BATCH
+      admissions clamped to zero) and back out as pressure clears;
+    - ``cancel(request_or_id)``: client cancellation from any live
+      state to a CANCELLED terminal, pages reclaimed, audit clean.
+
+    All tier/preemption/brownout state is host-side data — none of it
+    enters a compiled program, so the jit-once decode contract holds
+    (asserted in tests/test_tiers.py, tools/chaos_bench.py --tiers).
+
     Speculative decoding knobs (docs/SERVING.md):
 
     - ``spec_k`` (default 0 = off): draft up to K candidate tokens per
@@ -271,7 +336,9 @@ class InferenceEngine:
                  guard_nonfinite=True, watchdog_steps=1024,
                  max_slot_wall_s=None, stall_steps=500,
                  spec_k=0, draft_fn=None, draft_ngram=3,
-                 spec_patience=2, spec_probe_every=64):
+                 spec_patience=2, spec_probe_every=64,
+                 tier_policies=None, max_preemptions=4,
+                 brownout=None):
         self.model = model
         self.num_slots = int(num_slots)
         self.page_size = int(page_size)
@@ -376,7 +443,20 @@ class InferenceEngine:
         self.max_slot_wall_s = max_slot_wall_s
         self.stall_steps = int(stall_steps)
         self.health: dict = {o.value: 0 for o in Outcome}
+        self.health_by_tier: dict = {
+            t.value: {o.value: 0 for o in Outcome} for t in Tier}
         self._ewma_service_s: Optional[float] = None
+
+        # SLO tiers (serve/slo.py): per-tier admission policy, slot
+        # preemption and brownout degradation — all host-side DATA
+        self._tier_policies = resolve_tier_policies(tier_policies)
+        self.max_preemptions = int(max_preemptions)
+        self.preemptions = 0                 # slots reclaimed by a
+                                             # higher-tier admission
+        if brownout is True:
+            brownout = BrownoutController(
+                delay_ref=max_queue_delay_s or 1.0)
+        self._brownout = brownout            # None | BrownoutController
 
         # speculative-decoding observability (docs/SERVING.md): drafted
         # vs accepted counts feed accept_rate; per-request twins live on
@@ -832,6 +912,14 @@ class InferenceEngine:
         request.retry_after_s = retry_after
         request.finish_time = time.perf_counter()
         self.health[outcome.value] += 1
+        self.health_by_tier[request.tier.value][outcome.value] += 1
+
+    def _tier_policy(self, tier: Tier) -> TierPolicy:
+        return self._tier_policies[tier]
+
+    @property
+    def brownout_level(self) -> int:
+        return self._brownout.level if self._brownout is not None else 0
 
     def _observe_service(self, t_admit: float):
         """EWMA of SLOT-RESIDENCE time (admit -> finish) for completed
@@ -842,19 +930,31 @@ class InferenceEngine:
         self._ewma_service_s = served if self._ewma_service_s is None \
             else 0.2 * served + 0.8 * self._ewma_service_s
 
-    def _estimated_queue_delay(self) -> Optional[float]:
+    def _estimated_queue_delay(self, tier: Optional[Tier] = None) \
+            -> Optional[float]:
         """Rough admission-delay estimate for a NEWLY submitted
         request: how many service generations must complete before it
         gets a slot, scaled by the EWMA of observed slot-residence
         times. Zero when the queue fits today's free slots — an idle
         engine must never shed on its own steady-state latency. None
-        until a first completion calibrates the EWMA."""
+        until a first completion calibrates the EWMA.
+
+        ``tier`` scopes the backlog to the requests that will actually
+        be admitted ahead of (or with) that tier — priority admission
+        means a queue full of BATCH work does not delay a LATENCY
+        arrival, so it must not shed one either. None counts
+        everything (the tierless view health_snapshot exports)."""
         if self._ewma_service_s is None:
             return None
+        if tier is None:
+            ahead = len(self._queue)
+        else:
+            ahead = sum(1 for q in self._queue
+                        if q.tier.order <= tier.order)
         free = self.num_slots - self.active_count
-        if len(self._queue) < free:
+        if ahead < free:
             return 0.0
-        waves = (len(self._queue) - free) // self.num_slots + 1
+        waves = (ahead - free) // self.num_slots + 1
         return waves * self._ewma_service_s
 
     def health_snapshot(self) -> dict:
@@ -871,21 +971,40 @@ class InferenceEngine:
         ``serve_bench``/``chaos_bench`` reporting and the router's
         least-delay spill read through here, never through the live
         dict."""
+        bo = self._brownout
         return {
             "outcomes": dict(self.health),
+            "outcomes_by_tier": {t: dict(d) for t, d in
+                                 self.health_by_tier.items()},
             "queue_depth": len(self._queue),
+            "queue_depth_by_tier": {
+                t.value: sum(1 for q in self._queue if q.tier is t)
+                for t in Tier},
             "active_slots": self.active_count,
             "free_slots": self.num_slots - self.active_count,
             "num_slots": self.num_slots,
             "ewma_service_s": self._ewma_service_s,
             "estimated_queue_delay_s": self._estimated_queue_delay(),
+            # the PRIORITY tiers' delay (LATENCY+STANDARD backlog
+            # only): the brownout controller's delay signal — BATCH
+            # queue depth must not drive it, or the level-3 clamp
+            # would sustain the very signal that raised it (the
+            # clamped queue never drains → the estimate never falls
+            # → the clamp never lifts; deadlock found end-to-end)
+            "estimated_queue_delay_priority_s":
+                self._estimated_queue_delay(Tier.STANDARD),
             "free_pages": self._alloc.free_count,
             "decode_steps": self.decode_steps,
             "drafted_tokens": self.drafted_tokens,
             "accepted_tokens": self.accepted_tokens,
+            "accept_rate": self.accept_rate,
             "prefix_hits": self.prefix_hits,
             "prefix_lookups": self.prefix_lookups,
             "prefix_hit_tokens": self.prefix_hit_tokens,
+            "preemptions": self.preemptions,
+            "brownout_level": self.brownout_level,
+            "brownout_escalations": bo.escalations if bo else 0,
+            "brownout_deescalations": bo.deescalations if bo else 0,
         }
 
     def prefix_probe(self, prompt_ids) -> int:
@@ -925,14 +1044,74 @@ class InferenceEngine:
                 return True
         return False
 
+    def _shed_one_below(self, tier: Tier) -> bool:
+        """Overload drains the LOWEST tier first: shed the most
+        recently queued request of the lowest-priority tier strictly
+        below ``tier`` (it waited least — FIFO fairness within its
+        tier is preserved for the rest). Returns True when a queued
+        request was shed to make room."""
+        victim = None
+        for q in self._queue:
+            if q.tier.order <= tier.order:
+                continue
+            if victim is None or q.tier.order >= victim.tier.order:
+                victim = q               # rightmost of the worst tier
+        if victim is None:
+            return False
+        self.withdraw(victim)
+        self._record_terminal(
+            victim, Outcome.SHED,
+            f"displaced from the admission queue by a {tier.value} "
+            f"submission under overload")
+        return True
+
+    def cancel(self, request: Union[Request, int],
+               detail: str = "cancelled by client") -> bool:
+        """Client cancellation — a first-class transition from ANY
+        live state to the CANCELLED terminal: a QUEUED request leaves
+        the queue, a slotted one (prefilling, mid-decode, or
+        mid-spec-verify — all host-visible as a live slot between
+        steps) is evicted with its pages reclaimed; partial tokens are
+        kept either way. Accepts the ``Request`` itself or its
+        ``request_id``. Returns False — the refusal the double-finish
+        guard implies — when the request is already terminal (or not
+        known to this engine): exactly one terminal, ever, even when
+        a cancel races a completion."""
+        if isinstance(request, Request) and request.outcome is not None:
+            return False                     # already terminal: refuse
+        for i, q in enumerate(self._queue):
+            if q is request or q.request_id == request:
+                del self._queue[i]
+                self._record_terminal(q, Outcome.CANCELLED, detail)
+                return True
+        for s in range(self.num_slots):
+            slot = self._slots[s]
+            if slot is not None and (slot.request is request or
+                                     slot.request.request_id == request):
+                self._evict(s, Outcome.CANCELLED, detail)
+                return True
+        return False
+
     def submit(self, request: Request) -> bool:
         """Admission-queue entry with load shedding. Returns True when
         the request was queued; False when it was refused — already
         terminal with SHED (queue bounds exceeded, ``retry_after_s``
         set) or FAILED_UNSERVABLE (it could NEVER be served: more
         positions than ``max_len`` or more worst-case pages than the
-        whole pool — failing fast beats wedging the queue head)."""
+        whole pool — failing fast beats wedging the queue head).
+
+        Tier scoping (serve/slo.py): the request's ``TierPolicy`` may
+        supply a default deadline, a per-tier queue depth bound, and a
+        per-tier estimated-delay limit (each falling back to the
+        engine-global knob). When the GLOBAL queue bound is hit by a
+        higher-tier submission, shedding drains the lowest queued tier
+        first (``_shed_one_below``) — BATCH absorbs overload before
+        STANDARD before LATENCY."""
         request.submit_time = time.perf_counter()
+        pol = self._tier_policy(request.tier)
+        if request.deadline_s is None and \
+                pol.default_deadline_s is not None:
+            request.deadline_s = float(pol.default_deadline_s)
         if request.deadline_s is not None:
             request._deadline_abs = request.submit_time + request.deadline_s
         total = int(request.prompt_ids.size) + request.max_new_tokens
@@ -944,20 +1123,36 @@ class InferenceEngine:
                 f"engine caps at max_len {self.max_len} / "
                 f"{self.num_pages - 1} usable pages")
             return False
-        est = self._estimated_queue_delay()
-        if self.max_queue is not None and len(self._queue) >= self.max_queue:
+        est = self._estimated_queue_delay(request.tier)
+        # the newcomer's OWN refusals come first: a request its tier
+        # bound or delay limit is about to refuse anyway must not
+        # displace an innocent lower-tier victim on the way out
+        if pol.max_queue is not None and \
+                sum(1 for q in self._queue
+                    if q.tier is request.tier) >= pol.max_queue:
+            self._record_terminal(
+                request, Outcome.SHED,
+                f"{request.tier.value} queue at its tier depth limit "
+                f"{pol.max_queue}",
+                retry_after=est if est else 0.05)
+            return False
+        delay_limit = pol.max_queue_delay_s \
+            if pol.max_queue_delay_s is not None else self.max_queue_delay_s
+        if delay_limit is not None and est is not None \
+                and est > delay_limit:
+            self._record_terminal(
+                request, Outcome.SHED,
+                f"estimated queue delay {est:.3f}s exceeds "
+                f"{delay_limit}s for tier {request.tier.value}",
+                retry_after=est)
+            return False
+        if self.max_queue is not None and \
+                len(self._queue) >= self.max_queue and \
+                not self._shed_one_below(request.tier):
             self._record_terminal(
                 request, Outcome.SHED,
                 f"admission queue at depth limit {self.max_queue}",
                 retry_after=est if est else 0.05)
-            return False
-        if self.max_queue_delay_s is not None and est is not None \
-                and est > self.max_queue_delay_s:
-            self._record_terminal(
-                request, Outcome.SHED,
-                f"estimated queue delay {est:.3f}s exceeds "
-                f"{self.max_queue_delay_s}s",
-                retry_after=est)
             return False
         self._queue.append(request)
         return True
@@ -991,12 +1186,7 @@ class InferenceEngine:
 
     def _evict(self, slot_idx: int, outcome: Outcome, detail: str = ""):
         slot = self._slots[slot_idx]
-        self._alloc.free(slot.refs)          # refcounted: shared pages
-        self._page_table[slot_idx, :] = NULL_PAGE  # survive via sharers
-        self._lengths[slot_idx] = 0
-        self._temps[slot_idx] = 0.0
-        self._slot_keys[slot_idx] = 0
-        self._slots[slot_idx] = None
+        self._free_slot_state(slot_idx)
         if outcome.ok:
             self._observe_service(slot.t_admit)
         self._record_terminal(slot.request, outcome, detail)
@@ -1054,98 +1244,239 @@ class InferenceEngine:
                             f"per-slot wall cap {self.max_slot_wall_s}s "
                             f"exceeded")
 
-    def _admit(self):
-        """FIFO admission into free slots, gated on worst-case pages.
+    def _attempt_ids(self, req: Request) -> np.ndarray:
+        """The sequence a (re)admission actually prefills: the
+        original prompt plus every token already emitted — the
+        resume-from-suffix replay (PR 7's router pattern, here used by
+        slot preemption). Fresh requests return the prompt itself."""
+        if not req.token_ids:
+            return req.prompt_ids
+        return np.concatenate([req.prompt_ids,
+                               np.asarray(req.token_ids, np.int32)])
 
-        With the prefix cache on, admission first matches the prompt's
-        longest cached page-aligned prefix: matched full pages are
-        mapped copy-on-write (incref'd, read-only), the boundary
-        partial page is copied, and only the remaining suffix pays
-        prefill compute. Pages held only by the index count as
-        reclaimable budget — they are evicted (LRU) when the free list
-        alone cannot cover a request."""
-        for slot_idx in range(self.num_slots):
-            if not self._queue or self._slots[slot_idx] is not None:
+    def _queue_head(self, clamped_ok: bool = True) -> Optional[Request]:
+        """The queue's PRIORITY head: the earliest-submitted request of
+        the highest-priority tier present (FIFO within a tier —
+        deque order is submit order). ``clamped_ok=False`` skips tiers
+        the brownout controller has clamped (level 3: BATCH admissions
+        held at zero — they stay queued, they do not block others)."""
+        best = None
+        for q in self._queue:
+            if not clamped_ok and self.brownout_level >= 3 and \
+                    q.tier is Tier.BATCH:
                 continue
-            req = self._queue[0]
-            t0 = int(req.prompt_ids.size)
-            # submit() fail-fasts requests that can never fit, so here
-            # ``need`` is always <= the usable pool
-            total = t0 + req.max_new_tokens
-            need = -(-total // self.page_size)
-            prompt_pages = -(-t0 // self.page_size)
+            if best is None or q.tier.order < best.tier.order:
+                best = q
+        return best
 
-            shared: List[int] = []
-            partial = None
-            cached_len = 0
-            if self._prefix is not None:
-                self.prefix_lookups += 1
-                shared, partial, cached_len = \
-                    self._prefix.match(req.prompt_ids)
-                # pin matches NOW so reclaim below can't free them
-                for p in shared:
-                    self._alloc.incref(p)
-                if partial is not None:
-                    self._alloc.incref(partial[0])
-            n_new = need - len(shared)       # pages the free list owes
+    def _preempt_candidate(self, tier: Tier) -> Optional[int]:
+        """The slot a ``tier`` admission may reclaim: a live slot of a
+        PREEMPTIBLE, strictly lower-priority tier — the lowest tier
+        first, the fewest emitted tokens within it (cheapest replay),
+        smallest index as the deterministic tie-break. None when
+        ``tier`` cannot preempt or no victim qualifies."""
+        if not self._tier_policy(tier).can_preempt:
+            return None
+        best, best_key = None, None
+        for s, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            vt = slot.request.tier
+            if vt.order <= tier.order or \
+                    not self._tier_policy(vt).preemptible:
+                continue
+            key = (-vt.order, len(slot.request.token_ids), s)
+            if best_key is None or key < best_key:
+                best, best_key = s, key
+        return best
+
+    def _free_slot_state(self, slot_idx: int):
+        """Release a slot's pages and scrub its device-facing arrays —
+        shared by eviction (terminal) and preemption (re-queue)."""
+        slot = self._slots[slot_idx]
+        self._alloc.free(slot.refs)          # refcounted: shared pages
+        self._page_table[slot_idx, :] = NULL_PAGE  # survive via sharers
+        self._lengths[slot_idx] = 0
+        self._temps[slot_idx] = 0.0
+        self._slot_keys[slot_idx] = 0
+        self._slots[slot_idx] = None
+
+    def _preempt(self, slot_idx: int, detail: str = ""):
+        """Reclaim a slot for a higher-tier admission: pages released,
+        partial tokens KEPT, and — within ``max_preemptions`` — the
+        request re-queued through normal admission (original
+        ``submit_time`` / ``_deadline_abs`` untouched: deadlines stay
+        anchored to the original admission). The resume replays
+        prompt + emitted as the next attempt's prompt under the SAME
+        pinned sampling key, so the continuation is bit-identical to
+        an unpreempted run. Past the budget the request terminates
+        PREEMPTED — bounded, retryable, hinted."""
+        slot = self._slots[slot_idx]
+        req = slot.request
+        req.preemptions += 1
+        self.preemptions += 1
+        self._free_slot_state(slot_idx)
+        if req.preemptions > self.max_preemptions:
+            self._record_terminal(
+                req, Outcome.PREEMPTED,
+                f"preempted {req.preemptions} times "
+                f"(max_preemptions={self.max_preemptions}): {detail}")
+        else:
+            self._queue.append(req)
+
+    def _admit(self):
+        """Priority admission into free slots, gated on worst-case
+        pages: the highest-priority queued request first (FIFO within
+        a tier), with slot PREEMPTION — when no slot (or not enough
+        pages) is free for a tier that ``can_preempt``, a preemptible
+        lower-tier slot is reclaimed (``_preempt``: partial tokens
+        kept, bounded re-queue). The blocked priority head blocks the
+        tiers at and below it (no priority inversion: BATCH never
+        slips past a page-starved LATENCY head).
+
+        With the prefix cache on, admission first matches the attempt
+        prompt's longest cached page-aligned prefix: matched full
+        pages are mapped copy-on-write (incref'd, read-only), the
+        boundary partial page is copied, and only the remaining suffix
+        pays prefill compute — a preempted request's resume typically
+        re-lands on its own published prompt pages. Pages held only by
+        the index count as reclaimable budget — they are evicted (LRU)
+        when the free list alone cannot cover a request."""
+        while self._queue:
+            req = self._queue_head(clamped_ok=False)
+            if req is None:
+                return
+            slot_idx = next((i for i in range(self.num_slots)
+                             if self._slots[i] is None), None)
+            if slot_idx is None:
+                slot_idx = self._preempt_candidate(req.tier)
+                if slot_idx is None:
+                    return
+                self._preempt(slot_idx,
+                              f"slot reclaimed for a {req.tier.value} "
+                              f"admission")
+            if not self._try_admit(slot_idx, req):
+                return
+
+    def _try_admit(self, slot_idx: int, req: Request) -> bool:
+        """Admit ``req`` into the free ``slot_idx`` if its worst-case
+        pages fit (preempting lower-tier slots for pages when the
+        request's tier may); returns False — request left queued,
+        nothing pinned — when the pool cannot cover it yet."""
+        ids = self._attempt_ids(req)
+        t0 = int(ids.size)
+        # submit() fail-fasts requests that can never fit, so here
+        # ``need`` is always <= the usable pool (resume attempts span
+        # the same total positions: prompt + max_new_tokens)
+        total = t0 + (req.max_new_tokens - len(req.token_ids))
+        need = -(-total // self.page_size)
+        prompt_pages = -(-t0 // self.page_size)
+
+        shared: List[int] = []
+        partial = None
+        cached_len = 0
+        if self._prefix is not None:
+            self.prefix_lookups += 1
+            shared, partial, cached_len = self._prefix.match(ids)
+            # pin matches NOW so reclaim below can't free them
+            for p in shared:
+                self._alloc.incref(p)
+            if partial is not None:
+                self._alloc.incref(partial[0])
+
+        def _budget():
+            n_new = need - len(shared)   # pages the free list owes
             avail = self._alloc.free_count - self._lazy_debt
             recl = self._prefix.reclaimable(self._alloc) \
                 if self._prefix is not None else 0
-            if avail + recl < n_new:
-                # no cache budget yet — unpin and wait for evictions
-                for p in shared:
-                    self._alloc.decref(p)
-                if partial is not None:
-                    self._alloc.decref(partial[0])
-                break
-            if avail < n_new:
-                self.prefix_reclaimed_pages += \
-                    self._prefix.reclaim(n_new - avail, self._alloc)
-            if cached_len:
-                self.prefix_hits += 1
-                self.prefix_hit_tokens += cached_len
+            return n_new, avail, recl
 
-            self._queue.popleft()
-            priv = [self._alloc.alloc()
-                    for _ in range(prompt_pages - len(shared))]
-            row = np.zeros((self.max_pages,), np.int32)
-            row[:len(shared)] = shared
-            row[len(shared):prompt_pages] = priv
-            # per-request RNG key: pinned by Request.seed (reproducible
-            # across engines/occupancy), engine-split otherwise
-            skey = np.asarray(jax.random.PRNGKey(int(req.seed))
-                              if req.seed is not None
-                              else self._next_key(), np.uint32)
-            slot = _Slot(req, reserved_pages=need,
-                         refs=list(shared) + priv, row=row, t0=t0,
-                         prefill_pos=cached_len,
-                         t_admit=time.perf_counter(), key=skey)
-            self._slots[slot_idx] = slot
-            self._slot_keys[slot_idx] = skey
-            # decode-invisible until prefill completes: the decode step
-            # must neither attend a half-built prompt nor scatter its
-            # (dead-slot) write into a mapped — possibly SHARED — page
-            self._page_table[slot_idx, :] = NULL_PAGE
-            self._lengths[slot_idx] = 0
-            self._temps[slot_idx] = 0.0
+        n_new, avail, recl = _budget()
+        if avail + recl < n_new:
+            # not enough pages even reclaiming cache retention: a tier
+            # that can preempt reclaims lower-tier slots' pages — but
+            # only when the OPTIMISTIC bound (every preemptible
+            # victim's refs freed in full) can actually cover the
+            # deficit. Bouncing every BATCH slot (each bounce burning
+            # its preemption budget and redoing its prefill) only to
+            # fail the admission anyway would be pure loss.
+            victim_pages = sum(
+                len(s.refs) for s in self._slots
+                if s is not None
+                and s.request.tier.order > req.tier.order
+                and self._tier_policy(s.request.tier).preemptible)
+            if self._tier_policy(req.tier).can_preempt and \
+                    avail + recl + victim_pages >= n_new:
+                while avail + recl < n_new:
+                    victim = self._preempt_candidate(req.tier)
+                    if victim is None:
+                        break
+                    self._preempt(victim, f"pages reclaimed for a "
+                                          f"{req.tier.value} admission")
+                    n_new, avail, recl = _budget()
+        if avail + recl < n_new:
+            # no cache budget yet — unpin and wait for evictions
+            for p in shared:
+                self._alloc.decref(p)
             if partial is not None:
-                # COW: the boundary page becomes a private copy; drop
-                # the temporary pin on the cached source
-                self._copy_page(partial[0], int(row[len(shared)]))
                 self._alloc.decref(partial[0])
+            return False
+        if avail < n_new:
+            self.prefix_reclaimed_pages += \
+                self._prefix.reclaim(n_new - avail, self._alloc)
+        if cached_len:
+            self.prefix_hits += 1
+            self.prefix_hit_tokens += cached_len
 
-            if self.chunk_pages is None:
-                # monolithic mode: prefill to completion inside _admit.
-                # A cache hit still runs the (chunk-program) suffix path
-                # — the dense program cannot start mid-prompt.
-                if cached_len == 0:
-                    self._dense_prefill(slot_idx)
-                else:
-                    while (self._slots[slot_idx] is slot and
-                           slot.prefilling):
-                        self._run_chunk(slot_idx)
-            # chunked mode: the slot prefills across subsequent step()
-            # calls under the token budget
+        self.withdraw(req)
+        priv = [self._alloc.alloc()
+                for _ in range(prompt_pages - len(shared))]
+        row = np.zeros((self.max_pages,), np.int32)
+        row[:len(shared)] = shared
+        row[len(shared):prompt_pages] = priv
+        # per-request RNG key: pinned by Request.seed (reproducible
+        # across engines/occupancy), engine-split otherwise — and
+        # REMEMBERED on the request, so a preemption resume keeps the
+        # same stream and the continuation stays bit-identical
+        if req.seed is not None:
+            skey = np.asarray(jax.random.PRNGKey(int(req.seed)),
+                              np.uint32)
+        elif req._assigned_key is not None:
+            skey = req._assigned_key
+        else:
+            skey = np.asarray(self._next_key(), np.uint32)
+            req._assigned_key = skey
+        slot = _Slot(req, reserved_pages=need,
+                     refs=list(shared) + priv, row=row, t0=t0,
+                     attempt_ids=ids, prefill_pos=cached_len,
+                     t_admit=time.perf_counter(), key=skey)
+        self._slots[slot_idx] = slot
+        self._slot_keys[slot_idx] = skey
+        # decode-invisible until prefill completes: the decode step
+        # must neither attend a half-built prompt nor scatter its
+        # (dead-slot) write into a mapped — possibly SHARED — page
+        self._page_table[slot_idx, :] = NULL_PAGE
+        self._lengths[slot_idx] = 0
+        self._temps[slot_idx] = 0.0
+        if partial is not None:
+            # COW: the boundary page becomes a private copy; drop
+            # the temporary pin on the cached source
+            self._copy_page(partial[0], int(row[len(shared)]))
+            self._alloc.decref(partial[0])
+
+        if self.chunk_pages is None:
+            # monolithic mode: prefill to completion inside _admit.
+            # A cache hit still runs the (chunk-program) suffix path
+            # — the dense program cannot start mid-prompt.
+            if cached_len == 0:
+                self._dense_prefill(slot_idx)
+            else:
+                while (self._slots[slot_idx] is slot and
+                       slot.prefilling):
+                    self._run_chunk(slot_idx)
+        # chunked mode: the slot prefills across subsequent step()
+        # calls under the token budget
+        return True
 
     def _dense_prefill(self, slot_idx: int):
         """The PR 2 monolithic prompt program (one pow2-page bucket)."""
@@ -1156,7 +1487,7 @@ class InferenceEngine:
         bucket = min(_next_pow2(prompt_pages), self.max_pages)
         Tpad = bucket * self.page_size
         ids = np.zeros((1, Tpad), np.int32)
-        ids[0, :t0] = req.prompt_ids
+        ids[0, :t0] = slot.attempt_ids
         pages_arr = np.zeros((bucket,), np.int32)
         pages_arr[:prompt_pages] = slot.row[:prompt_pages]
         fn = self._prefill_jits.get(bucket)
@@ -1190,7 +1521,7 @@ class InferenceEngine:
         bucket = min(_next_pow2(-(-n // self.page_size)), self.max_pages)
         Cpad = bucket * self.page_size
         ids = np.zeros((1, Cpad), np.int32)
-        ids[0, :n] = req.prompt_ids[start:start + n]
+        ids[0, :n] = slot.attempt_ids[start:start + n]
         fn = self._chunk_jits.get(bucket)
         if fn is None:
             fn = jax.jit(self._chunk_prefill_fn, donate_argnums=(1, 2))
@@ -1221,7 +1552,7 @@ class InferenceEngine:
         self._lengths[slot_idx] = slot.t0
         self._temps[slot_idx] = slot.request.temperature
         if self._prefix is not None:
-            self._prefix.insert(slot.request.prompt_ids, slot.row,
+            self._prefix.insert(slot.attempt_ids, slot.row,
                                 self._alloc)
         done = self._finish_token(slot_idx, tok,
                                   time.perf_counter() - slot.t_admit)
@@ -1231,8 +1562,12 @@ class InferenceEngine:
     def _advance_prefill(self) -> int:
         """Chunked-prefill scheduler: round-robin one chunk at a time
         over prefilling slots, never exceeding ``token_budget`` real
-        prompt tokens per engine step. Returns tokens processed."""
+        prompt tokens per engine step (brownout level 2+ clamps the
+        budget to ONE chunk — same bucket shapes, so nothing
+        retraces). Returns tokens processed."""
         budget = self.token_budget
+        if self.brownout_level >= 2 and self.chunk_pages is not None:
+            budget = min(budget, self.chunk_pages * self.page_size)
         spent = 0
         progressed = True
         while budget > 0 and progressed:
@@ -1281,7 +1616,10 @@ class InferenceEngine:
         zero-agreement workload pays the plain decode price."""
         drafts: dict = {}
         gated = False
-        if self.spec_k == 0:
+        if self.spec_k == 0 or self.brownout_level >= 1:
+            # brownout level 1+ disables speculation: the engine
+            # narrow-steps (W=1 — already compiled) until pressure
+            # clears, trading peak tokens/s for per-step latency
             return drafts, gated
         vocab = self.model.vocab_size
         probe = self.spec_patience == 0 or \
@@ -1389,6 +1727,11 @@ class InferenceEngine:
         draft missed). Returns the number of live slots that advanced."""
         self._expire_queue()
         self._expire_slots()
+        if self._brownout is not None:
+            # one deterministic evaluation per scheduler step, BEFORE
+            # admission so a clamp decision applies to this step's
+            # admissions; level effects are pure host policy
+            self._brownout.update(self)
         self._admit()
         if self.chunk_pages is not None:
             self._advance_prefill()
@@ -1661,11 +2004,30 @@ class InferenceEngine:
                 # nothing decoding, nothing prefilling, head unadmitted
                 stall += 1
                 if stall > self.stall_steps:
-                    head = self._queue.popleft()
-                    self._record_terminal(
-                        head, Outcome.FAILED_UNSERVABLE,
-                        f"page-starved: head of an idle engine for "
-                        f"{stall} polls (free={self._alloc.free_count})")
+                    # the PRIORITY head is what admission is blocked
+                    # on — failing a lower tier behind it would not
+                    # unwedge anything. A head that is only queued
+                    # because the brownout clamp holds its tier is
+                    # NOT page-starved: it gets a retryable SHED (the
+                    # honest 'come back when pressure clears'), not a
+                    # FAILED_UNSERVABLE — still bounded, the engine
+                    # never wedges on a pinned controller
+                    head = self._queue_head(clamped_ok=False)
+                    if head is not None:
+                        self.withdraw(head)
+                        self._record_terminal(
+                            head, Outcome.FAILED_UNSERVABLE,
+                            f"page-starved: head of an idle engine "
+                            f"for {stall} polls "
+                            f"(free={self._alloc.free_count})")
+                    else:
+                        head = self._queue_head()
+                        self.withdraw(head)
+                        self._record_terminal(
+                            head, Outcome.SHED,
+                            f"brownout level {self.brownout_level} "
+                            f"held {head.tier.value} admissions "
+                            f"clamped for {stall} idle polls")
                     stall = 0
                 else:
                     time.sleep(poll_sleep)   # let deadlines/holds move

@@ -1,0 +1,168 @@
+"""Prometheus-style text rendering of serving health snapshots.
+
+``render_metrics`` turns ``InferenceEngine.health_snapshot()`` or
+``Router.health_snapshot()`` into the Prometheus text exposition
+format (``# TYPE``-annotated lines) — the scrape surface an operator's
+monitoring stack expects from a serving tier. It is a PURE renderer
+over the detached snapshot dicts (never the live-mutated ``health``
+state), so a scrape can never observe torn counters; serving it over
+HTTP is one handler around one string.
+
+Conventions:
+
+  - counters end in ``_total``; everything instantaneous is a gauge;
+  - per-tier outcome counters carry ``{tier=...,outcome=...}`` labels
+    (only non-zero series are emitted — the label space is bounded by
+    |Tier| x |Outcome| but sparse in practice);
+  - a fleet snapshot nests per-replica engine gauges under a
+    ``replica="<idx>"`` label plus a ``..._replica_up`` health gauge
+    (1 SERVING, 0.5 DEGRADED, 0 DEAD);
+  - ``None`` values (e.g. an uncalibrated EWMA) are skipped rather
+    than rendered as NaN — absence is the honest representation.
+
+Output is golden-parsed in tests/test_tiers.py: every sample line must
+follow a matching ``# TYPE`` declaration and parse back to the
+snapshot's numbers.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+__all__ = ["render_metrics"]
+
+_NS = "mxtpu_serve"
+
+# snapshot key -> (metric suffix, prometheus type)
+_ENGINE_GAUGES = [
+    ("queue_depth", "queue_depth", "gauge"),
+    ("active_slots", "active_slots", "gauge"),
+    ("free_slots", "free_slots", "gauge"),
+    ("num_slots", "num_slots", "gauge"),
+    ("free_pages", "free_pages", "gauge"),
+    ("ewma_service_s", "ewma_service_seconds", "gauge"),
+    ("estimated_queue_delay_s", "estimated_queue_delay_seconds",
+     "gauge"),
+    ("estimated_queue_delay_priority_s",
+     "estimated_queue_delay_priority_seconds", "gauge"),
+    ("accept_rate", "accept_rate", "gauge"),
+    ("brownout_level", "brownout_level", "gauge"),
+]
+_ENGINE_COUNTERS = [
+    ("decode_steps", "decode_steps_total"),
+    ("drafted_tokens", "drafted_tokens_total"),
+    ("accepted_tokens", "accepted_tokens_total"),
+    ("prefix_hits", "prefix_hits_total"),
+    ("prefix_lookups", "prefix_lookups_total"),
+    ("preemptions", "preemptions_total"),
+    ("brownout_escalations", "brownout_escalations_total"),
+    ("brownout_deescalations", "brownout_deescalations_total"),
+]
+_ROUTER_COUNTERS = [
+    ("requeues", "requeues_total"),
+    ("replica_deaths", "replica_deaths_total"),
+    ("breaker_opens", "breaker_opens_total"),
+    ("probes", "probes_total"),
+    ("recoveries", "recoveries_total"),
+    ("affinity_routed", "affinity_routed_total"),
+    ("spill_routed", "spill_routed_total"),
+]
+
+_REPLICA_UP = {"SERVING": 1.0, "DEGRADED": 0.5, "DEAD": 0.0}
+
+
+class _Writer:
+    """Accumulates samples grouped under one ``# TYPE`` line per
+    metric name (the format requires the declaration to precede every
+    sample of that name, once)."""
+
+    def __init__(self):
+        self._types: dict = {}           # name -> type
+        self._samples: dict = {}         # name -> [(labels, value)]
+
+    def add(self, name: str, mtype: str, value, labels: str = ""):
+        if value is None:
+            return
+        self._types.setdefault(name, mtype)
+        self._samples.setdefault(name, []).append((labels,
+                                                   float(value)))
+
+    def render(self) -> str:
+        out: List[str] = []
+        for name in self._samples:
+            out.append(f"# TYPE {name} {self._types[name]}")
+            for labels, value in self._samples[name]:
+                if value == int(value):
+                    sval = str(int(value))
+                else:
+                    sval = repr(value)
+                out.append(f"{name}{labels} {sval}")
+        return "\n".join(out) + "\n"
+
+
+def _labels(**kv) -> str:
+    if not kv:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in kv.items())
+    return "{" + inner + "}"
+
+
+def _emit_outcomes(w: _Writer, snap: dict, ns: str = _NS,
+                   extra: Optional[dict] = None):
+    extra = extra or {}
+    name = f"{ns}_requests_total"
+    for outcome, n in snap.get("outcomes", {}).items():
+        if n:
+            w.add(name, "counter", n,
+                  _labels(outcome=outcome, **extra))
+    tname = f"{ns}_tier_requests_total"
+    for tier, d in snap.get("outcomes_by_tier", {}).items():
+        for outcome, n in d.items():
+            if n:
+                w.add(tname, "counter", n,
+                      _labels(tier=tier, outcome=outcome, **extra))
+    qname = f"{ns}_tier_queue_depth"
+    for tier, n in snap.get("queue_depth_by_tier", {}).items():
+        w.add(qname, "gauge", n, _labels(tier=tier, **extra))
+
+
+def _emit_engine(w: _Writer, snap: dict, ns: str = _NS,
+                 extra: Optional[dict] = None):
+    extra = extra or {}
+    _emit_outcomes(w, snap, ns, extra)
+    for key, suffix, mtype in _ENGINE_GAUGES:
+        if key in snap:
+            w.add(f"{ns}_{suffix}", mtype, snap[key],
+                  _labels(**extra))
+    for key, suffix in _ENGINE_COUNTERS:
+        if key in snap:
+            w.add(f"{ns}_{suffix}", "counter", snap[key],
+                  _labels(**extra))
+
+
+def render_metrics(snapshot: dict) -> str:
+    """Render an engine or router ``health_snapshot()`` dict as
+    Prometheus text. Router snapshots (detected by their ``replicas``
+    entry) emit the fleet-level outcome/routing counters (CLIENT
+    requests) plus each live replica's engine metrics under the
+    ``{ns}_replica_*`` namespace with a ``replica="<idx>"`` label —
+    engine counters count ATTEMPTS (which legitimately exceed client
+    requests under requeue), so they must not share a series name
+    with the fleet-level counters a dashboard would sum."""
+    w = _Writer()
+    if "replicas" not in snapshot:
+        _emit_engine(w, snapshot)
+        return w.render()
+    _emit_outcomes(w, snapshot)
+    w.add(f"{_NS}_queue_depth", "gauge", snapshot["queue_depth"])
+    w.add(f"{_NS}_inflight", "gauge", snapshot["inflight"])
+    for key, suffix in _ROUTER_COUNTERS:
+        w.add(f"{_NS}_{suffix}", "counter", snapshot[key])
+    rns = f"{_NS}_replica"
+    for rep in snapshot["replicas"]:
+        extra = {"replica": rep["idx"]}
+        w.add(f"{rns}_up", "gauge",
+              _REPLICA_UP.get(rep["state"], 0.0), _labels(**extra))
+        if "engine" in rep:
+            _emit_engine(w, rep["engine"], rns, extra)
+    return w.render()

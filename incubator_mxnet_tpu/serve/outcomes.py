@@ -24,6 +24,20 @@ offer under overload and faults (docs/RESILIENCE.md). The taxonomy:
                       replica deaths ``max_requeues`` times (or had no
                       serving replica left) and gave up — bounded
                       recovery, never a silent loss (serve/router.py)
+  PREEMPTED           a higher-tier admission reclaimed the request's
+                      slot ``max_preemptions`` times and the engine
+                      gave up re-queuing it — bounded, retryable,
+                      partial tokens kept (an in-budget preemption is
+                      NOT terminal: the request re-queues through
+                      normal admission as a resume-from-suffix replay,
+                      continuation bit-identical — serve/slo.py)
+  CANCELLED           the client withdrew the request
+                      (``engine.cancel`` / ``router.cancel``) — a
+                      first-class transition from ANY live state
+                      (queued, prefilling, mid-decode,
+                      mid-spec-verify) with pages reclaimed and
+                      partial tokens kept; not retryable (the client
+                      asked for it)
 
 ``EOS`` and ``MAX_TOKENS`` are the success outcomes (``.ok``); the
 rest are the failure surface the chaos harness (serve/chaos.py,
@@ -49,6 +63,8 @@ class Outcome(enum.Enum):
     FAILED_NONFINITE = "FAILED_NONFINITE"
     FAILED_UNSERVABLE = "FAILED_UNSERVABLE"
     FAILED_REPLICA = "FAILED_REPLICA"
+    PREEMPTED = "PREEMPTED"
+    CANCELLED = "CANCELLED"
 
     @property
     def ok(self) -> bool:
@@ -61,9 +77,11 @@ class Outcome(enum.Enum):
         """True for the shed/deadline-class outcomes a client may retry
         (elsewhere, or later): the request itself was fine, the system
         lacked capacity/time/replicas for it. These are exactly the
-        outcomes that must carry a ``retry_after_s`` hint."""
+        outcomes that must carry a ``retry_after_s`` hint. CANCELLED
+        is deliberately absent: the client withdrew the request, so
+        'retry later' is not advice it asked for."""
         return self in (Outcome.SHED, Outcome.DEADLINE_EXPIRED,
-                        Outcome.FAILED_REPLICA)
+                        Outcome.FAILED_REPLICA, Outcome.PREEMPTED)
 
     def __str__(self) -> str:  # readable in logs / JSON dumps
         return self.value

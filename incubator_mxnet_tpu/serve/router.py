@@ -77,6 +77,7 @@ import numpy as np
 from ..base import MXNetError
 from .engine import InferenceEngine, Request
 from .outcomes import Outcome
+from .slo import Tier, resolve_tier_policies
 
 __all__ = ["Router", "Replica", "ReplicaState", "ReplicaKilled",
            "build_fleet"]
@@ -183,7 +184,8 @@ class Router:
                  replica_queue_depth: Optional[int] = None,
                  max_queue: Optional[int] = None,
                  max_queue_delay_s: Optional[float] = None,
-                 stall_steps: int = 2000, seed: int = 0):
+                 stall_steps: int = 2000, seed: int = 0,
+                 tier_policies: Optional[dict] = None):
         if not engines:
             raise MXNetError("a fleet needs at least one replica")
         self.replicas = [Replica(i, e) for i, e in enumerate(engines)]
@@ -212,6 +214,12 @@ class Router:
         self._stall = 0
         self.steps = 0
         self.health: dict = {o.value: 0 for o in Outcome}
+        self.health_by_tier: dict = {
+            t.value: {o.value: 0 for o in Outcome} for t in Tier}
+        # router-level tier scoping (serve/slo.py): per-tier queue
+        # bound / delay limit / default deadline on the ROUTER'S
+        # admission surface (each engine still applies its own)
+        self._tier_policies = resolve_tier_policies(tier_policies)
         self.requeues = 0
         self.replica_deaths = 0
         self.breaker_opens = 0
@@ -254,6 +262,7 @@ class Router:
         request.retry_after_s = retry_after
         request.finish_time = time.perf_counter()
         self.health[outcome.value] += 1
+        self.health_by_tier[request.tier.value][outcome.value] += 1
 
     # ------------------------------------------------------------- #
     # admission
@@ -297,13 +306,41 @@ class Router:
             base += (len(self._queue) // max(slots, 1)) * min(ewmas)
         return base
 
+    def _shed_one_below(self, tier: Tier) -> bool:
+        """Router-queue twin of the engine's drain-lowest-tier-first
+        shed: remove the most recently queued _Tracked of the lowest
+        tier strictly below ``tier`` and SHED its client. Returns True
+        when room was made."""
+        victim = None
+        for t in self._queue:
+            if t.client.tier.order <= tier.order:
+                continue
+            if victim is None or \
+                    t.client.tier.order >= victim.client.tier.order:
+                victim = t
+        if victim is None:
+            return False
+        self._queue.remove(victim)
+        self._record_terminal(
+            victim.client, Outcome.SHED,
+            f"displaced from the router queue by a {tier.value} "
+            f"submission under overload")
+        return True
+
     def submit(self, request: Request) -> bool:
         """Fleet admission. Returns True when the request was accepted
         for routing; False when it is already terminal — SHED (fleet
         saturated / router queue bound, ``retry_after_s`` set),
         FAILED_UNSERVABLE (no replica could EVER hold it), or
-        FAILED_REPLICA (no live replica at all)."""
+        FAILED_REPLICA (no live replica at all). Tier scoping matches
+        the engine's: per-tier default deadline, per-tier queue bound
+        and delay limit (falling back to the router globals), and the
+        global queue bound drains the lowest queued tier first."""
         request.submit_time = time.perf_counter()
+        pol = self._tier_policies[request.tier]
+        if request.deadline_s is None and \
+                pol.default_deadline_s is not None:
+            request.deadline_s = float(pol.default_deadline_s)
         if request.deadline_s is not None:
             request._deadline_abs = request.submit_time + request.deadline_s
         alive = self._alive()
@@ -319,21 +356,35 @@ class Router:
                 f"request needs {total} positions but no replica can "
                 f"ever hold it")
             return False
+        # the newcomer's OWN refusals come first (tier bound, delay
+        # limit): a request about to be refused anyway must not
+        # displace an innocent lower-tier victim on the way out
+        if pol.max_queue is not None and \
+                sum(1 for t in self._queue
+                    if t.client.tier is request.tier) >= pol.max_queue:
+            self._record_terminal(
+                request, Outcome.SHED,
+                f"{request.tier.value} router queue at its tier depth "
+                f"limit {pol.max_queue}")
+            return False
+        delay_limit = pol.max_queue_delay_s \
+            if pol.max_queue_delay_s is not None else self.max_queue_delay_s
+        if delay_limit is not None:
+            est = self._fleet_delay_estimate()
+            if est is not None and est > delay_limit:
+                self._record_terminal(
+                    request, Outcome.SHED,
+                    f"fleet-wide estimated delay {est:.3f}s exceeds "
+                    f"{delay_limit}s for tier {request.tier.value}",
+                    retry_after=est)
+                return False
         if self.max_queue is not None and \
-                len(self._queue) >= self.max_queue:
+                len(self._queue) >= self.max_queue and \
+                not self._shed_one_below(request.tier):
             self._record_terminal(
                 request, Outcome.SHED,
                 f"router queue at depth limit {self.max_queue}")
             return False
-        if self.max_queue_delay_s is not None:
-            est = self._fleet_delay_estimate()
-            if est is not None and est > self.max_queue_delay_s:
-                self._record_terminal(
-                    request, Outcome.SHED,
-                    f"fleet-wide estimated delay {est:.3f}s exceeds "
-                    f"{self.max_queue_delay_s}s",
-                    retry_after=est)
-                return False
         if request.seed is None:
             # pin the sampling stream NOW: a replay attempt on another
             # replica must reproduce the original's draws exactly
@@ -478,7 +529,7 @@ class Router:
         att = Request(self._attempt_prompt(tracked).copy(),
                       max_new_tokens=remaining,
                       temperature=c.temperature, eos_id=c.eos_id,
-                      deadline_s=deadline, seed=c.seed)
+                      deadline_s=deadline, seed=c.seed, tier=c.tier)
         return att
 
     def _absorb(self, tracked: _Tracked, att: Request):
@@ -530,6 +581,12 @@ class Router:
             return 0
         dispatched = 0
         blocked: deque = deque()
+        # tier-priority dispatch: LATENCY routes before STANDARD
+        # before BATCH; the sort is stable, so FIFO order within a
+        # tier (and every replay's queue position) is preserved
+        if any(t.client.tier is not Tier.STANDARD for t in self._queue):
+            self._queue = deque(sorted(
+                self._queue, key=lambda t: t.client.tier.order))
         # one snapshot per replica per pass; admissions bump the local
         # view so later queue entries see the new depth
         snaps = [(r, r.engine.health_snapshot())
@@ -660,7 +717,8 @@ class Router:
             self._inflight.remove(t)
             att, t.attempt, t.replica = t.attempt, None, None
             if att.outcome is not None and \
-                    att.outcome is not Outcome.SHED:
+                    att.outcome not in (Outcome.SHED,
+                                        Outcome.PREEMPTED):
                 # finished on the replica's last good step, collected
                 # here instead of _collect — still exactly one terminal
                 self._finish_from_attempt(t, att)
@@ -675,16 +733,19 @@ class Router:
 
     def _collect(self):
         """Harvest finished attempts. A SHED attempt (the replica
-        drained/shut down underneath us, or shed from its queue) is a
-        structured re-queue; everything else propagates to the client
-        as-is."""
+        drained/shut down underneath us, or shed from its queue) and a
+        PREEMPTED attempt (the replica's own preemption budget gave
+        the slot away for good) are structured re-queues — both
+        retryable capacity signals, both resume from the emitted
+        suffix on the next dispatch; everything else propagates to the
+        client as-is."""
         for t in [t for t in self._inflight
                   if t.attempt.outcome is not None]:
             self._inflight.remove(t)
             att, t.attempt, t.replica = t.attempt, None, None
-            if att.outcome is Outcome.SHED:
+            if att.outcome in (Outcome.SHED, Outcome.PREEMPTED):
                 self._absorb(t, att)
-                self._requeue(t, f"replica shed in flight: "
+                self._requeue(t, f"replica {att.outcome} in flight: "
                                  f"{att.detail}")
             else:
                 self._finish_from_attempt(t, att)
@@ -843,6 +904,55 @@ class Router:
             return True
         return False
 
+    def cancel(self, request, detail: str = "cancelled by client") \
+            -> bool:
+        """Fleet-level client cancellation: accepts the client
+        ``Request`` or its ``request_id``. A QUEUED request terminates
+        CANCELLED immediately; an IN-FLIGHT one is cancelled on its
+        replica (engine pages reclaimed) and its client terminal is
+        recorded here with the partial tokens absorbed. Returns False
+        — refused — when the request is already terminal or the
+        attempt finished before the cancel could land (the
+        double-finish guard's contract: exactly one terminal,
+        whichever transition wins)."""
+        tracked = None
+        for t in self._queue:
+            if t.client is request or t.client.request_id == request:
+                tracked = t
+                break
+        if tracked is not None:
+            self._queue.remove(tracked)
+            self._record_terminal(tracked.client, Outcome.CANCELLED,
+                                  detail)
+            return True
+        for t in self._inflight:
+            if t.client is request or t.client.request_id == request:
+                tracked = t
+                break
+        if tracked is None:
+            return False
+        rep = self.replicas[tracked.replica]
+        if rep.state is not ReplicaState.DEAD and rep.killed is None \
+                and not rep.engine.cancel(tracked.attempt, detail):
+            # the attempt is already terminal on the engine. A REAL
+            # finish (EOS, failure, ...) owns the client outcome —
+            # _collect will propagate it, the cancel lost the race.
+            # But SHED/PREEMPTED would only be RE-QUEUED: the request
+            # is still live from the client's view, so the cancel
+            # must win — otherwise a disconnected client's request
+            # keeps bouncing through the fleet.
+            if tracked.attempt.outcome not in (Outcome.SHED,
+                                               Outcome.PREEMPTED):
+                return False
+        # a dead/killed replica cannot execute the cancel RPC — the
+        # router's own bookkeeping is authoritative, as on death
+        self._inflight.remove(tracked)
+        att, tracked.attempt, tracked.replica = \
+            tracked.attempt, None, None
+        self._absorb(tracked, att)
+        self._record_terminal(tracked.client, Outcome.CANCELLED, detail)
+        return True
+
     def shutdown(self, detail: str = "fleet shutdown"):
         """Drain the whole fleet: every live replica's engine drains
         (its in-flight attempts go SHED), and every client request —
@@ -854,7 +964,8 @@ class Router:
             self._inflight.remove(t)
             att, t.attempt, t.replica = t.attempt, None, None
             if att is not None and att.outcome is not None and \
-                    att.outcome is not Outcome.SHED:
+                    att.outcome not in (Outcome.SHED,
+                                        Outcome.PREEMPTED):
                 # finished just before the drain — honor the real
                 # outcome, not the shutdown
                 self._finish_from_attempt(t, att)
@@ -887,7 +998,12 @@ class Router:
             reps.append(entry)
         return {
             "outcomes": dict(self.health),
+            "outcomes_by_tier": {t: dict(d) for t, d in
+                                 self.health_by_tier.items()},
             "queue_depth": len(self._queue),
+            "queue_depth_by_tier": {
+                t.value: sum(1 for q in self._queue
+                             if q.client.tier is t) for t in Tier},
             "inflight": len(self._inflight),
             "requeues": self.requeues,
             "replica_deaths": self.replica_deaths,

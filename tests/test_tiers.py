@@ -1,0 +1,795 @@
+"""SLO-tier serving tests (serve/slo.py + the engine/router tier
+machinery — docs/RESILIENCE.md, docs/SERVING.md).
+
+The load-bearing claims: (1) admission, shedding and preemption are
+PRIORITY-ordered — LATENCY > STANDARD > BATCH, BATCH drains first
+under overload; (2) a preempted request resumes from its emitted
+suffix BIT-IDENTICALLY, deadlines stay anchored to the original
+admission, and the preemption budget bounds the bouncing with a
+retryable PREEMPTED terminal; (3) client cancellation reaches a
+CANCELLED terminal from every live state, exactly once, pages
+reclaimed; (4) the brownout controller steps degrade levels
+deterministically with hysteresis and its effects never retrace a
+program; (5) the /metrics rendering round-trips the health
+snapshots."""
+
+import re
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.base import MXNetError
+from incubator_mxnet_tpu.models import gpt as g
+from incubator_mxnet_tpu.serve import (InferenceEngine, Outcome,
+                                       Request, Tier, TierPolicy,
+                                       build_fleet, render_metrics)
+from incubator_mxnet_tpu.serve.chaos import assert_health_consistent
+from incubator_mxnet_tpu.serve.slo import (BrownoutController,
+                                           default_tier_policies)
+
+VOCAB = 64
+
+
+@pytest.fixture(scope="module")
+def model():
+    mx.random.seed(0)
+    m = g.gpt_mini(vocab_size=VOCAB, max_length=64)
+    m.initialize()
+    return m
+
+
+def _prompt(rng, n):
+    return rng.randint(0, VOCAB, size=(n,)).astype(np.int32)
+
+
+def _drain(eng, reqs, max_steps=3000, audit=True):
+    steps = 0
+    while any(r.outcome is None for r in reqs):
+        eng.step()
+        if audit:
+            eng.audit_pages()
+        steps += 1
+        assert steps < max_steps, "engine failed to reach quiescence"
+    return steps
+
+
+# ------------------------------------------------------------------- #
+# taxonomy / policy units (no engine)
+# ------------------------------------------------------------------- #
+
+def test_tier_order_and_policy_defaults():
+    assert Tier.LATENCY.order < Tier.STANDARD.order < Tier.BATCH.order
+    pols = default_tier_policies()
+    assert pols[Tier.LATENCY].can_preempt
+    assert not pols[Tier.LATENCY].preemptible
+    assert pols[Tier.BATCH].preemptible
+    assert not pols[Tier.BATCH].can_preempt
+    assert not pols[Tier.STANDARD].preemptible
+    # requests coerce string tiers and auto-assign unique ids
+    a = Request(np.ones(3, np.int32), tier="BATCH")
+    b = Request(np.ones(3, np.int32))
+    assert a.tier is Tier.BATCH and b.tier is Tier.STANDARD
+    assert a.request_id != b.request_id
+    with pytest.raises(MXNetError):
+        Request(np.ones(3, np.int32), tier=7)
+
+
+def test_new_outcomes_taxonomy():
+    assert Outcome.PREEMPTED.retryable and not Outcome.PREEMPTED.ok
+    assert not Outcome.CANCELLED.retryable and not Outcome.CANCELLED.ok
+
+
+def test_brownout_controller_hysteresis_unit():
+    """Pure-signal unit: the controller steps one level at a time,
+    rises only after up_steps consecutive over-threshold updates,
+    falls only after down_steps under the exit threshold, and logs
+    every transition."""
+    bo = BrownoutController(enter=(0.5, 0.7, 0.9), exit_margin=0.2,
+                            up_steps=2, down_steps=3)
+    snaps = {"num_slots": 4, "queue_depth": 0, "free_pages": 10,
+             "active_slots": 0, "estimated_queue_delay_s": None}
+    eng = SimpleNamespace(num_pages=11, decode_steps=0,
+                          health_snapshot=lambda: dict(snaps))
+
+    def drive(pressure, n):
+        # backlog-gated occupancy signal: full queue + occupancy p
+        snaps.update(queue_depth=4 * 10, free_pages=10,
+                     active_slots=int(4 * pressure))
+        levels = []
+        for _ in range(n):
+            levels.append(bo.update(eng))
+            eng.decode_steps += 1
+        return levels
+
+    assert drive(1.0, 1) == [0]          # one over-threshold: no move
+    assert drive(1.0, 1) == [1]          # second consecutive: L1
+    assert drive(1.0, 4) == [1, 2, 2, 3]  # one step per transition
+    assert drive(1.0, 3) == [3, 3, 3]    # saturated
+    assert drive(0.0, 2) == [3, 3]       # falling needs down_steps
+    assert drive(0.0, 1) == [2]
+    assert drive(0.0, 3) == [2, 2, 1]
+    # a mid-cooldown pressure spike resets the descent counter: the
+    # two pre-spike under-threshold updates do not count afterwards
+    assert drive(0.0, 2) == [1, 1]
+    assert drive(1.0, 1) == [1]
+    assert drive(0.0, 3) == [1, 1, 0]
+    assert bo.escalations >= 3 and bo.deescalations >= 2
+    assert len(bo.timeline) == bo.escalations + bo.deescalations
+    for e in bo.timeline:
+        assert abs(e["to"] - e["from"]) == 1
+
+
+def test_brownout_rejects_bad_thresholds():
+    with pytest.raises(ValueError):
+        BrownoutController(enter=(0.9, 0.7, 0.5))
+
+
+# ------------------------------------------------------------------- #
+# priority admission / shed ordering
+# ------------------------------------------------------------------- #
+
+def test_priority_admission_order(model):
+    """With one slot held, queued LATENCY is admitted before STANDARD
+    before BATCH regardless of submit order (FIFO within a tier)."""
+    eng = InferenceEngine(model, num_slots=1, page_size=8, max_len=64)
+    rng = np.random.RandomState(0)
+    hold = Request(_prompt(rng, 5), max_new_tokens=6)
+    eng.submit(hold)
+    eng.step()                           # hold occupies the slot
+    reqs = []
+    for tier in (Tier.BATCH, Tier.STANDARD, Tier.LATENCY,
+                 Tier.STANDARD):
+        r = Request(_prompt(rng, 5), max_new_tokens=2, tier=tier)
+        reqs.append(r)
+        eng.submit(r)
+    _drain(eng, [hold] + reqs)
+    assert all(r.outcome is not None and r.outcome.ok for r in reqs)
+    # one slot serves them strictly one at a time, so completion order
+    # IS admission order: LATENCY first, BATCH last, FIFO within
+    # STANDARD (the first-submitted STANDARD before the second)
+    order = [r.tier for r in sorted(reqs, key=lambda r: r.finish_time)]
+    assert order == [Tier.LATENCY, Tier.STANDARD, Tier.STANDARD,
+                     Tier.BATCH]
+    assert reqs[1].finish_time < reqs[3].finish_time
+
+
+def test_overload_shed_drains_batch_first(model):
+    """A full global queue sheds the lowest queued tier to admit a
+    higher one — the displaced BATCH terminal carries a retry hint."""
+    eng = InferenceEngine(model, num_slots=1, page_size=8, max_len=64,
+                          max_queue=2)
+    rng = np.random.RandomState(1)
+    hold = Request(_prompt(rng, 5), max_new_tokens=24)
+    eng.submit(hold)
+    eng.step()
+    b1 = Request(_prompt(rng, 5), max_new_tokens=2, tier=Tier.BATCH)
+    b2 = Request(_prompt(rng, 5), max_new_tokens=2, tier=Tier.BATCH)
+    assert eng.submit(b1) and eng.submit(b2)
+    lat = Request(_prompt(rng, 5), max_new_tokens=2, tier=Tier.LATENCY)
+    assert eng.submit(lat)               # displaces the NEWEST batch
+    assert b2.outcome is Outcome.SHED
+    assert b2.retry_after_s is not None and b2.retry_after_s > 0
+    assert "displaced" in b2.detail
+    assert b1.outcome is None and lat.outcome is None
+    # a BATCH newcomer on the still-full queue sheds ITSELF
+    b3 = Request(_prompt(rng, 5), max_new_tokens=2, tier=Tier.BATCH)
+    assert not eng.submit(b3)
+    assert b3.outcome is Outcome.SHED
+    _drain(eng, [hold, b1, lat])
+    assert_health_consistent(eng, [hold, b1, b2, lat, b3])
+
+
+def test_per_tier_queue_bound_and_default_deadline(model):
+    """TierPolicy.max_queue bounds that tier's own share; a tier
+    default deadline is applied to deadline-less submissions."""
+    eng = InferenceEngine(
+        model, num_slots=1, page_size=8, max_len=64,
+        tier_policies={Tier.BATCH: TierPolicy(max_queue=1,
+                                              preemptible=True),
+                       Tier.LATENCY: TierPolicy(
+                           can_preempt=True, default_deadline_s=5.0)})
+    rng = np.random.RandomState(2)
+    hold = Request(_prompt(rng, 5), max_new_tokens=30)
+    eng.submit(hold)
+    eng.step()
+    b1 = Request(_prompt(rng, 5), max_new_tokens=2, tier=Tier.BATCH)
+    b2 = Request(_prompt(rng, 5), max_new_tokens=2, tier=Tier.BATCH)
+    assert eng.submit(b1)
+    assert not eng.submit(b2)            # tier bound, global unbounded
+    assert b2.outcome is Outcome.SHED and "tier depth" in b2.detail
+    lat = Request(_prompt(rng, 5), max_new_tokens=2, tier=Tier.LATENCY)
+    eng.submit(lat)
+    assert lat.deadline_s == 5.0 and lat._deadline_abs is not None
+    explicit = Request(_prompt(rng, 5), max_new_tokens=2,
+                       tier=Tier.LATENCY, deadline_s=9.0)
+    eng.submit(explicit)
+    assert explicit.deadline_s == 9.0    # explicit beats the default
+    eng.shutdown()
+
+
+# ------------------------------------------------------------------- #
+# preemption
+# ------------------------------------------------------------------- #
+
+def test_refused_newcomer_does_not_displace_victim(model):
+    """A submission the newcomer's OWN tier bound (or delay limit) is
+    about to refuse must not shed a lower-tier victim on the way out
+    — two terminals where one refusal sufficed."""
+    eng = InferenceEngine(
+        model, num_slots=1, page_size=8, max_len=64, max_queue=2,
+        tier_policies={Tier.LATENCY: TierPolicy(can_preempt=True,
+                                                max_queue=1)})
+    rng = np.random.RandomState(21)
+    hold = Request(_prompt(rng, 5), max_new_tokens=24)
+    eng.submit(hold)
+    eng.step()
+    rb = Request(_prompt(rng, 5), max_new_tokens=2, tier=Tier.BATCH)
+    l1 = Request(_prompt(rng, 5), max_new_tokens=2, tier=Tier.LATENCY)
+    assert eng.submit(rb) and eng.submit(l1)   # queue full at 2
+    l2 = Request(_prompt(rng, 5), max_new_tokens=2, tier=Tier.LATENCY)
+    assert not eng.submit(l2)            # LATENCY tier bound refuses it
+    assert l2.outcome is Outcome.SHED and "tier depth" in l2.detail
+    assert rb.outcome is None            # the BATCH victim survived
+    _drain(eng, [hold, rb, l1])
+
+
+def test_router_cancel_wins_over_requeueable_attempt(model):
+    """A cancel racing an attempt terminal that _collect would only
+    RE-QUEUE (SHED/PREEMPTED) must win — the request is still live
+    from the client's view, and losing the cancel would keep a
+    disconnected client's request bouncing through the fleet."""
+    rt = build_fleet(model, 1, engine_kw=dict(num_slots=1, page_size=8,
+                                              max_len=64))
+    rng = np.random.RandomState(22)
+    c = Request(_prompt(rng, 5), max_new_tokens=30)
+    rt.submit(c)
+    for _ in range(30):
+        rt.step()
+        tr = next((t for t in rt._inflight if t.client is c), None)
+        if tr is not None and tr.attempt.token_ids:
+            break
+    assert tr is not None and tr.attempt.token_ids
+    # the replica sheds the attempt underneath the router (drain);
+    # before the router collects it, the client cancels
+    rt.replicas[0].engine.shutdown("drain")
+    att = tr.attempt                     # cancel unwinds tr.attempt
+    assert att.outcome is Outcome.SHED
+    assert rt.cancel(c)
+    assert c.outcome is Outcome.CANCELLED
+    assert c.token_ids == att.token_ids  # stream absorbed
+    rt.step()                            # _collect must not double-act
+    assert c.outcome is Outcome.CANCELLED
+    from incubator_mxnet_tpu.serve.chaos import (
+        assert_fleet_health_consistent)
+    assert_fleet_health_consistent(rt, [c])
+
+
+def _run_solo(model, req_proto):
+    eng = InferenceEngine(model, num_slots=1, page_size=8, max_len=64)
+    r = Request(req_proto.prompt_ids.copy(),
+                max_new_tokens=req_proto.max_new_tokens,
+                tier=req_proto.tier)
+    eng.run([r])
+    return r
+
+
+def test_latency_preempts_batch_and_resumes_bit_identically(model):
+    rng = np.random.RandomState(3)
+    proto = Request(_prompt(rng, 6), max_new_tokens=12,
+                    tier=Tier.BATCH)
+    base = _run_solo(model, proto)
+    assert base.outcome is not None and base.outcome.ok
+
+    eng = InferenceEngine(model, num_slots=1, page_size=8, max_len=64)
+    rb = Request(proto.prompt_ids.copy(), max_new_tokens=12,
+                 tier=Tier.BATCH)
+    rl = Request(_prompt(rng, 5), max_new_tokens=3, tier=Tier.LATENCY)
+    eng.submit(rb)
+    for _ in range(4):
+        eng.step()
+        eng.audit_pages()
+    emitted_before = len(rb.token_ids)
+    assert 0 < emitted_before < 12
+    eng.submit(rl)
+    _drain(eng, [rl, rb])
+    assert rl.outcome.ok and rb.outcome.ok
+    assert rb.preemptions == 1 and eng.preemptions == 1
+    # the resumed continuation is bit-identical to the unpreempted run
+    assert rb.token_ids == base.token_ids
+    # LATENCY finished before the preempted BATCH resumed to the end
+    assert rl.finish_time < rb.finish_time
+    # preemption state never entered a program
+    assert eng.decode_trace_count == 1
+    bad = {k: v for k, v in eng.prefill_trace_counts.items() if v != 1}
+    assert not bad, f"prefill buckets retraced: {bad}"
+    assert_health_consistent(eng, [rb, rl])
+
+
+def test_standard_neither_preempts_nor_is_preempted(model):
+    rng = np.random.RandomState(4)
+    eng = InferenceEngine(model, num_slots=1, page_size=8, max_len=64)
+    rs = Request(_prompt(rng, 5), max_new_tokens=10)
+    eng.submit(rs)
+    eng.step()
+    # LATENCY cannot preempt STANDARD (not preemptible by default)
+    rl = Request(_prompt(rng, 5), max_new_tokens=2, tier=Tier.LATENCY)
+    eng.submit(rl)
+    eng.step()
+    assert rs.preemptions == 0 and eng.preemptions == 0
+    _drain(eng, [rs, rl])
+    # STANDARD finished first: it kept its slot
+    assert rs.finish_time < rl.finish_time
+
+    eng2 = InferenceEngine(model, num_slots=1, page_size=8, max_len=64)
+    rb = Request(_prompt(rng, 5), max_new_tokens=10, tier=Tier.BATCH)
+    eng2.submit(rb)
+    eng2.step()
+    rs2 = Request(_prompt(rng, 5), max_new_tokens=2)
+    eng2.submit(rs2)                     # STANDARD cannot preempt
+    eng2.step()
+    assert rb.preemptions == 0
+    _drain(eng2, [rb, rs2])
+    assert rb.finish_time < rs2.finish_time
+
+
+def test_preemption_budget_bounds_to_preempted_terminal(model):
+    """max_preemptions=0: the first preemption is terminal — a
+    retryable PREEMPTED with the partial tokens kept and a hint."""
+    rng = np.random.RandomState(5)
+    proto = Request(_prompt(rng, 6), max_new_tokens=12,
+                    tier=Tier.BATCH)
+    base = _run_solo(model, proto)
+    eng = InferenceEngine(model, num_slots=1, page_size=8, max_len=64,
+                          max_preemptions=0)
+    rb = Request(proto.prompt_ids.copy(), max_new_tokens=12,
+                 tier=Tier.BATCH)
+    eng.submit(rb)
+    for _ in range(4):
+        eng.step()
+        eng.audit_pages()
+    kept = list(rb.token_ids)
+    rl = Request(_prompt(rng, 5), max_new_tokens=2, tier=Tier.LATENCY)
+    eng.submit(rl)
+    _drain(eng, [rl, rb])
+    assert rb.outcome is Outcome.PREEMPTED
+    assert rb.retry_after_s is not None and rb.retry_after_s > 0
+    assert rb.token_ids == kept
+    assert rb.token_ids == base.token_ids[:len(rb.token_ids)]
+    assert rl.outcome.ok
+    eng.audit_pages()
+    assert_health_consistent(eng, [rb, rl])
+
+
+def test_preemption_deadline_anchored_to_original_admission(model):
+    """Failover-deadline audit (engine half): a preempted request's
+    ``_deadline_abs`` must NOT reset when it re-queues — the clock
+    keeps running from the ORIGINAL submit."""
+    rng = np.random.RandomState(6)
+    eng = InferenceEngine(model, num_slots=1, page_size=8, max_len=64)
+    rb = Request(_prompt(rng, 6), max_new_tokens=12, tier=Tier.BATCH,
+                 deadline_s=30.0)
+    eng.submit(rb)
+    original_abs = rb._deadline_abs
+    assert original_abs is not None
+    for _ in range(3):
+        eng.step()
+    rl = Request(_prompt(rng, 5), max_new_tokens=2, tier=Tier.LATENCY)
+    eng.submit(rl)
+    eng.step()                           # the preemption fires here
+    assert rb.preemptions == 1
+    assert rb._deadline_abs == original_abs
+    _drain(eng, [rl, rb])
+    assert rb._deadline_abs == original_abs
+
+
+def test_router_requeue_deadline_anchored_to_original(model):
+    """Failover-deadline audit (router half): a replica-death replay
+    attempt's deadline is derived from the CLIENT's original
+    ``_deadline_abs`` — re-admission must not grant fresh time."""
+    rt = build_fleet(model, 2, engine_kw=dict(num_slots=2, page_size=8,
+                                              max_len=64))
+    rng = np.random.RandomState(7)
+    c = Request(_prompt(rng, 6), max_new_tokens=24, deadline_s=60.0)
+    rt.submit(c)
+    original_abs = c._deadline_abs
+    for _ in range(40):
+        rt.step()
+        if any(t.client is c and t.attempt.token_ids
+               for t in rt._inflight):
+            break
+    tr = next(t for t in rt._inflight if t.client is c)
+    rt.replicas[tr.replica].kill("test kill")
+    for _ in range(40):
+        rt.step()
+        live = next((t for t in rt._inflight if t.client is c), None)
+        if live is not None and live.attempt is not None:
+            break
+    assert c.outcome is None and live is not None
+    att = live.attempt
+    # the attempt's absolute deadline is the client's original one
+    # (modulo the microseconds between derivation and submit)
+    assert att._deadline_abs is not None
+    assert abs(att._deadline_abs - original_abs) < 0.25
+    rt.shutdown()
+
+
+# ------------------------------------------------------------------- #
+# cancellation race matrix
+# ------------------------------------------------------------------- #
+
+def test_cancel_matrix_engine(model):
+    """Cancel while {queued, mid-prefill, mid-decode, mid-spec-verify,
+    already-terminal} on the engine: every live state reaches exactly
+    one CANCELLED terminal with pages reclaimed; already-terminal is
+    refused."""
+    rng = np.random.RandomState(8)
+
+    # queued
+    eng = InferenceEngine(model, num_slots=1, page_size=8, max_len=64)
+    hold = Request(_prompt(rng, 5), max_new_tokens=8)
+    eng.submit(hold)
+    eng.step()
+    q = Request(_prompt(rng, 5), max_new_tokens=4)
+    eng.submit(q)
+    assert eng.cancel(q)
+    assert q.outcome is Outcome.CANCELLED and not q.token_ids
+    eng.audit_pages()
+
+    # mid-prefill (chunked: the prompt spans several steps)
+    eng2 = InferenceEngine(model, num_slots=1, page_size=8, max_len=64,
+                           chunk_pages=1, token_budget=8)
+    pf = Request(_prompt(rng, 30), max_new_tokens=4)
+    eng2.submit(pf)
+    eng2.step()
+    slot = eng2._slots[0]
+    assert slot is not None and slot.prefilling
+    assert eng2.cancel(pf.request_id)    # by id
+    assert pf.outcome is Outcome.CANCELLED
+    eng2.audit_pages()
+    assert eng2._slots[0] is None
+
+    # mid-decode (partial tokens kept)
+    d = Request(_prompt(rng, 5), max_new_tokens=20)
+    eng2.submit(d)
+    for _ in range(4):
+        eng2.step()
+    assert len(d.token_ids) > 0 and d.outcome is None
+    assert eng2.cancel(d)
+    assert d.outcome is Outcome.CANCELLED and d.token_ids
+    assert d.retry_after_s is None       # the client asked to stop
+    eng2.audit_pages()
+
+    # mid-spec-verify (a live speculative slot between verify steps)
+    eng3 = InferenceEngine(model, num_slots=1, page_size=8, max_len=64,
+                           spec_k=2, spec_patience=0)
+    sv = Request(_prompt(rng, 6), max_new_tokens=24)
+    eng3.submit(sv)
+    for _ in range(6):
+        eng3.step()
+        if eng3.spec_steps > 0 and eng3._slots[0] is not None:
+            break
+    assert eng3.spec_steps > 0 and eng3._slots[0] is not None
+    assert eng3.cancel(sv)
+    assert sv.outcome is Outcome.CANCELLED
+    eng3.audit_pages()
+
+    # already-terminal: refused (the double-finish guard's contract)
+    assert not eng2.cancel(d)
+    assert not eng2.cancel(d.request_id)
+    assert d.outcome is Outcome.CANCELLED
+    # unknown id: refused
+    assert not eng2.cancel(10 ** 9)
+    _drain(eng, [hold], audit=True)
+    assert_health_consistent(eng2, [pf, d])
+
+
+def test_cancel_matrix_router(model):
+    """Cancel while {queued, in-flight} through the router; an
+    already-terminal client is refused; partial tokens kept."""
+    rt = build_fleet(model, 2, engine_kw=dict(num_slots=1, page_size=8,
+                                              max_len=64),
+                     replica_queue_depth=0)
+    rng = np.random.RandomState(9)
+    a = Request(_prompt(rng, 5), max_new_tokens=30)
+    b = Request(_prompt(rng, 5), max_new_tokens=30)
+    c = Request(_prompt(rng, 5), max_new_tokens=30)
+    for r in (a, b, c):
+        rt.submit(r)
+    # c is queued behind the two slots' worth of work
+    while not any(t.client is c for t in rt._queue):
+        rt.step()
+    assert rt.cancel(c)
+    assert c.outcome is Outcome.CANCELLED and not c.token_ids
+    # a is in flight: cancel reclaims the engine attempt too (tokens
+    # live on the ATTEMPT until absorbed — watch those, not a's)
+    for _ in range(30):
+        rt.step()
+        tr = next((t for t in rt._inflight if t.client is a), None)
+        if tr is not None and tr.attempt.token_ids:
+            break
+    assert a.outcome is None and tr.attempt.token_ids
+    assert rt.cancel(a.request_id)
+    assert a.outcome is Outcome.CANCELLED and a.token_ids
+    for rep in rt.replicas:
+        rep.engine.audit_pages()
+    # refused on the already-terminal client
+    assert not rt.cancel(a) and not rt.cancel(c)
+    rt.run([])                           # drain b
+    assert b.outcome is not None and b.outcome.ok
+    from incubator_mxnet_tpu.serve.chaos import (
+        assert_fleet_health_consistent)
+    assert_fleet_health_consistent(rt, [a, b, c])
+
+
+# ------------------------------------------------------------------- #
+# brownout effects on the engine (forced levels — no retrace, ever)
+# ------------------------------------------------------------------- #
+
+class _FixedBrownout:
+    """A controller stub pinned at one level: isolates the engine's
+    level EFFECTS from the controller's signal dynamics."""
+
+    def __init__(self, level):
+        self.level = level
+        self.escalations = 0
+        self.deescalations = 0
+        self.timeline = []
+
+    def update(self, engine):
+        return self.level
+
+
+def test_brownout_level1_disables_speculation(model):
+    rng = np.random.RandomState(10)
+    eng = InferenceEngine(model, num_slots=2, page_size=8, max_len=64,
+                          spec_k=3, spec_patience=0,
+                          brownout=_FixedBrownout(1))
+    reqs = [Request(_prompt(rng, 6), max_new_tokens=8)
+            for _ in range(3)]
+    for r in reqs:
+        eng.submit(r)
+    _drain(eng, reqs)
+    assert eng.drafted_tokens == 0 and eng.spec_steps == 0
+    assert eng.verify_trace_count == 0   # the wide program never ran
+    assert eng.decode_trace_count == 1
+
+
+def test_brownout_level2_clamps_prefill_budget(model):
+    rng = np.random.RandomState(11)
+    eng = InferenceEngine(model, num_slots=2, page_size=8, max_len=64,
+                          chunk_pages=1, token_budget=32,
+                          brownout=_FixedBrownout(2))
+    reqs = [Request(_prompt(rng, 30), max_new_tokens=2)
+            for _ in range(2)]
+    for r in reqs:
+        eng.submit(r)
+    _drain(eng, reqs)
+    assert eng.max_step_prefill_tokens <= 8   # one chunk, not 32
+    bad = {k: v for k, v in eng.prefill_trace_counts.items() if v != 1}
+    assert not bad                        # same buckets, no retrace
+
+
+def test_brownout_level3_clamps_batch_admissions(model):
+    rng = np.random.RandomState(12)
+    bo = _FixedBrownout(3)
+    eng = InferenceEngine(model, num_slots=2, page_size=8, max_len=64,
+                          brownout=bo)
+    rb = Request(_prompt(rng, 5), max_new_tokens=2, tier=Tier.BATCH)
+    rs = Request(_prompt(rng, 5), max_new_tokens=2)
+    eng.submit(rb)
+    eng.submit(rs)
+    for _ in range(60):
+        eng.step()
+    # STANDARD ran to completion; BATCH never left the queue
+    assert rs.outcome is not None and rs.outcome.ok
+    assert rb.outcome is None and len(eng._queue) == 1
+    bo.level = 0                         # pressure clears
+    _drain(eng, [rb])
+    assert rb.outcome.ok
+
+
+def test_brownout_closed_loop_escalates_and_recovers(model):
+    """End-to-end: a backlog storm drives the real controller up the
+    ladder; draining brings it back to level 0; transitions are
+    logged; nothing retraced."""
+    rng = np.random.RandomState(13)
+    bo = BrownoutController(up_steps=1, down_steps=2, delay_ref=0.05)
+    eng = InferenceEngine(model, num_slots=2, page_size=8, max_len=64,
+                          num_pages=1 + 2 * 8, chunk_pages=1,
+                          brownout=bo, spec_k=2)
+    reqs = [Request(_prompt(rng, 12), max_new_tokens=8,
+                    tier=[Tier.LATENCY, Tier.STANDARD,
+                          Tier.BATCH][i % 3]) for i in range(9)]
+    eng.run(reqs)
+    assert all(r.outcome is not None for r in reqs)
+    assert bo.escalations >= 1 and bo.deescalations >= 1
+    assert bo.level == 0
+    assert len(bo.timeline) == bo.escalations + bo.deescalations
+    assert eng.decode_trace_count <= 1 and eng.verify_trace_count <= 1
+    snap = eng.health_snapshot()
+    assert snap["brownout_level"] == 0
+    assert snap["brownout_escalations"] == bo.escalations
+    eng.audit_pages()
+
+
+def test_brownout_clamp_cannot_sustain_itself(model):
+    """Deadlock regression: a BATCH-only backlog on an otherwise idle
+    engine must NOT hold the controller at level 3 — the delay signal
+    is scoped to the priority tiers, so the clamped BATCH queue
+    cannot sustain the clamp that parked it. (Found end-to-end: the
+    first requests' compile-dominated EWMA pushed the estimate over
+    every threshold, level 3 clamped BATCH, and the queued BATCH kept
+    the BATCH-inclusive estimate high forever — the stall watchdog,
+    not the controller, had to break the wedge.)"""
+    rng = np.random.RandomState(20)
+    bo = BrownoutController(up_steps=1, down_steps=2, delay_ref=0.01)
+    eng = InferenceEngine(model, num_slots=2, page_size=8, max_len=64,
+                          brownout=bo)
+    # calibrate a HUGE ewma (the compile-step effect, distilled)
+    eng._ewma_service_s = 50.0
+    bo.level = 3
+    rb = [Request(_prompt(rng, 5), max_new_tokens=2, tier=Tier.BATCH)
+          for _ in range(4)]
+    for r in rb:
+        eng.submit(r)
+    for _ in range(200):
+        eng.step()
+        if all(r.outcome is not None for r in rb):
+            break
+    assert all(r.outcome is not None and r.outcome.ok for r in rb), \
+        [str(r.outcome) for r in rb]
+    for _ in range(3 * bo.down_steps):   # idle evaluations: step down
+        eng.step()
+    assert bo.level == 0
+    eng.audit_pages()
+
+
+# ------------------------------------------------------------------- #
+# /metrics rendering (serve/metrics.py)
+# ------------------------------------------------------------------- #
+
+_SAMPLE = re.compile(r"^([A-Za-z_:][A-Za-z0-9_:]*)"
+                     r"(\{[^}]*\})?\s([-+0-9.eE]+)$")
+
+
+def _golden_parse(text):
+    """Prometheus text-format validation: every sample line parses and
+    its metric name was declared by a preceding # TYPE line."""
+    typed = {}
+    samples = []
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, mtype = line.split(" ")
+            assert name not in typed, f"duplicate TYPE for {name}"
+            typed[name] = mtype
+            continue
+        m = _SAMPLE.match(line)
+        assert m, f"unparseable metrics line: {line!r}"
+        name, labels, value = m.groups()
+        assert name in typed, f"sample before TYPE: {line!r}"
+        samples.append((name, labels or "", float(value)))
+    return typed, samples
+
+
+def test_metrics_engine_golden(model):
+    rng = np.random.RandomState(14)
+    eng = InferenceEngine(model, num_slots=2, page_size=8, max_len=64,
+                          max_queue=2, brownout=True)
+    reqs = [Request(_prompt(rng, 5), max_new_tokens=3,
+                    tier=[Tier.LATENCY, Tier.BATCH][i % 2])
+            for i in range(5)]
+    for r in reqs:
+        eng.submit(r)
+    _drain(eng, reqs)
+    snap = eng.health_snapshot()
+    typed, samples = _golden_parse(render_metrics(snap))
+    by = {}
+    for name, labels, v in samples:
+        by.setdefault(name, {})[labels] = v
+    total = sum(v for v in by["mxtpu_serve_requests_total"].values())
+    assert total == sum(snap["outcomes"].values()) == len(reqs)
+    tier_total = sum(
+        v for v in by["mxtpu_serve_tier_requests_total"].values())
+    assert tier_total == total
+    assert typed["mxtpu_serve_requests_total"] == "counter"
+    assert typed["mxtpu_serve_queue_depth"] == "gauge"
+    assert by["mxtpu_serve_queue_depth"][""] == snap["queue_depth"]
+    assert by["mxtpu_serve_free_pages"][""] == snap["free_pages"]
+    assert by["mxtpu_serve_brownout_level"][""] == \
+        snap["brownout_level"]
+    assert by["mxtpu_serve_decode_steps_total"][""] == \
+        snap["decode_steps"]
+    # per-tier series carry both labels
+    for labels in by["mxtpu_serve_tier_requests_total"]:
+        assert "tier=" in labels and "outcome=" in labels
+
+
+def test_metrics_router_golden(model):
+    rt = build_fleet(model, 2, engine_kw=dict(num_slots=1, page_size=8,
+                                              max_len=64))
+    rng = np.random.RandomState(15)
+    reqs = [Request(_prompt(rng, 5), max_new_tokens=3)
+            for _ in range(3)]
+    rt.run(reqs)
+    snap = rt.health_snapshot()
+    typed, samples = _golden_parse(render_metrics(snap))
+    by = {}
+    for name, labels, v in samples:
+        by.setdefault(name, {})[labels] = v
+    # fleet-level counters count CLIENT requests only — per-replica
+    # attempt counters live in their own _replica_* namespace
+    assert sum(by["mxtpu_serve_requests_total"].values()) == len(reqs)
+    assert sum(by["mxtpu_serve_replica_requests_total"].values()) >= \
+        len(reqs)
+    ups = by["mxtpu_serve_replica_up"]
+    assert set(ups) == {'{replica="0"}', '{replica="1"}'}
+    assert all(v == 1.0 for v in ups.values())
+    # per-replica engine gauges are labelled
+    assert '{replica="0"}' in by["mxtpu_serve_replica_free_pages"]
+    # None-valued gauges are skipped, not rendered as NaN
+    assert "NaN" not in render_metrics(snap)
+
+
+# ------------------------------------------------------------------- #
+# fleet-level tier flow
+# ------------------------------------------------------------------- #
+
+def test_router_preempted_attempt_requeues_and_resumes(model):
+    """An engine that exhausts its preemption budget hands the router
+    a retryable PREEMPTED attempt — the router must re-queue it like a
+    shed (resume-from-suffix), not propagate the failure."""
+    rt = build_fleet(
+        model, 1,
+        engine_kw=dict(num_slots=1, page_size=8, max_len=64,
+                       max_preemptions=0),
+        max_requeues=3)
+    rng = np.random.RandomState(16)
+    base = _run_solo(model, Request(_prompt(rng, 6), max_new_tokens=10,
+                                    tier=Tier.BATCH))
+    rb = Request(base.prompt_ids.copy(), max_new_tokens=10,
+                 tier=Tier.BATCH, seed=0)
+    rt.submit(rb)
+    for _ in range(60):
+        rt.step()
+        tr = next((t for t in rt._inflight if t.client is rb), None)
+        if tr is not None and tr.attempt.token_ids:
+            break
+    assert tr is not None and tr.attempt.token_ids
+    lat = Request(_prompt(rng, 5), max_new_tokens=2,
+                  tier=Tier.LATENCY)
+    rt.submit(lat)
+    rt.run([])
+    assert lat.outcome is not None and lat.outcome.ok
+    assert rb.outcome is not None and rb.outcome.ok
+    assert rb.token_ids == base.token_ids  # resumed bit-identically
+    assert rt.requeues >= 1
+    for rep in rt.replicas:
+        rep.engine.audit_pages()
+
+
+def test_router_tier_priority_dispatch_and_by_tier_health(model):
+    rt = build_fleet(model, 1, engine_kw=dict(num_slots=1, page_size=8,
+                                              max_len=64),
+                     replica_queue_depth=0)
+    rng = np.random.RandomState(17)
+    hold = Request(_prompt(rng, 5), max_new_tokens=10)
+    rt.submit(hold)
+    for _ in range(20):
+        rt.step()
+        if hold.token_ids:
+            break
+    rb = Request(_prompt(rng, 5), max_new_tokens=2, tier=Tier.BATCH)
+    rl = Request(_prompt(rng, 5), max_new_tokens=2, tier=Tier.LATENCY)
+    rt.submit(rb)                        # BATCH queued first...
+    rt.submit(rl)
+    rt.run([])
+    assert rl.finish_time < rb.finish_time  # ...LATENCY served first
+    snap = rt.health_snapshot()
+    assert snap["outcomes_by_tier"]["LATENCY"]["MAX_TOKENS"] == 1
+    assert snap["outcomes_by_tier"]["BATCH"]["MAX_TOKENS"] == 1
+    from incubator_mxnet_tpu.serve.chaos import (
+        assert_fleet_health_consistent)
+    assert_fleet_health_consistent(rt, [hold, rb, rl])

@@ -406,6 +406,302 @@ def run_scenarios(n_requests, errors):
 
 
 # --------------------------------------------------------------------- #
+# SLO-tier scenarios (serve/slo.py — ci/run.sh tiersmoke stage)
+# --------------------------------------------------------------------- #
+
+def _make_tiered_requests(n, vocab, seed, max_len=128):
+    """Mixed-tier greedy workload: round-robin LATENCY (short, tight
+    budgets) / STANDARD / BATCH (long budgets — the preemption and
+    shed fodder), ragged prompt lengths, deterministic."""
+    import numpy as np
+    from incubator_mxnet_tpu.serve import Request, Tier
+    rng = np.random.RandomState(seed)
+    tiers = [Tier.LATENCY, Tier.STANDARD, Tier.BATCH]
+    reqs = []
+    for i in range(n):
+        tier = tiers[i % 3]
+        plen = 4 + 3 * (i % 4)
+        max_new = {Tier.LATENCY: 4 + (i % 3),
+                   Tier.STANDARD: 6 + 2 * (i % 3),
+                   Tier.BATCH: 16 + 4 * (i % 3)}[tier]
+        prompt = rng.randint(0, vocab, size=(plen,)).astype(np.int32)
+        assert plen + max_new <= max_len
+        reqs.append(Request(prompt, max_new_tokens=max_new, tier=tier))
+    return reqs
+
+
+def _drive(eng, errors, tag, max_steps=4000, poll_sleep=1e-4,
+           injectors=()):
+    """Step an engine whose requests were ALREADY submitted (possibly
+    in phases — engine.run() would re-submit) to quiescence, auditing
+    pages after every step and firing ``injectors`` before each."""
+    from incubator_mxnet_tpu.base import MXNetError
+    it = 0
+    while eng._queue or eng.active_count:
+        for inj in injectors:
+            inj.on_step(eng, it)
+        eng.step()
+        try:
+            eng.audit_pages()
+        except MXNetError as e:
+            errors.append(f"{tag}: audit failed at step {it}: {e}")
+            raise
+        it += 1
+        if it >= max_steps:
+            errors.append(f"{tag}: engine failed to reach quiescence "
+                          f"within {max_steps} steps")
+            break
+        if not eng.active_count:
+            time.sleep(poll_sleep)       # let brownout/deadlines move
+    return it
+
+
+def run_tier_scenarios(n_requests, errors):
+    """SLO-tier chaos: priority scheduling, preemption, cancellation
+    and brownout under deterministic seeded faults. Invariants per
+    scenario: 100% exactly-one-terminal, per-tier health counters
+    consistent, pages audited after EVERY step, decode/verify trace
+    counts still exactly 1 per program, completed requests
+    bit-identical to an unconstrained fault-free run (preemption
+    resume included), failed/cancelled requests' partial tokens a
+    prefix of that run's stream."""
+    import numpy as np
+    from incubator_mxnet_tpu.serve import Outcome, Tier, TierPolicy
+    from incubator_mxnet_tpu.serve.slo import BrownoutController
+    from incubator_mxnet_tpu.serve.chaos import (CancelStorm,
+                                                 NaNWeights,
+                                                 PagePressure,
+                                                 run_chaos)
+    results = {}
+    vocab = 64
+    n = max(n_requests, 12)              # the tier mix needs all three
+
+    # ---- unconstrained baseline (the parity oracle) ---------------- #
+    model = _build_model()
+    eng = _engine(model, num_slots=4)
+    reqs = _make_tiered_requests(n, vocab, seed=17)
+    t0 = time.perf_counter()
+    run_chaos(eng, reqs, [], audit_every_step=True)
+    wall = time.perf_counter() - t0
+    baseline = [list(r.token_ids) for r in reqs]
+    stats = _check_invariants("tier_baseline", eng, reqs, baseline,
+                              set(), errors, allow_non_ok=False)
+    if not all(r.outcome is not None and r.outcome.ok for r in reqs):
+        errors.append("tier_baseline: not every request succeeded")
+    stats["wall_s"] = wall
+    results["tier_baseline"] = stats
+
+    def _prefix_ok(tag, reqs):
+        for r, base in zip(reqs, baseline):
+            if r.outcome is not None and r.outcome.ok and \
+                    list(r.token_ids) != base:
+                errors.append(f"{tag}: a completed request diverged "
+                              f"from the unconstrained run")
+            if r.outcome is not None and not r.outcome.ok and \
+                    list(r.token_ids) != base[:len(r.token_ids)]:
+                errors.append(f"{tag}: partial tokens are not a prefix "
+                              f"of the unconstrained stream")
+
+    # ---- tiered overload storm ------------------------------------- #
+    # a BATCH-heavy flood saturates the engine first; a
+    # LATENCY+STANDARD storm lands on it: LATENCY must preempt its way
+    # into slots, shedding must drain BATCH (never LATENCY or
+    # STANDARD while BATCH is queued), and every preempted BATCH
+    # continuation must stay bit-identical
+
+    def _overload_requests():
+        """BATCH-heavy mix (half BATCH): the shed/preempt fodder must
+        outnumber the storm so it can absorb ALL of it."""
+        import numpy as np
+        from incubator_mxnet_tpu.serve import Request
+        rng = np.random.RandomState(23)
+        reqs = []
+        for i in range(n):
+            if i % 2 == 0:
+                tier, max_new = Tier.BATCH, 16 + 4 * (i % 3)
+            elif i % 4 == 1:
+                tier, max_new = Tier.LATENCY, 4 + (i % 3)
+            else:
+                tier, max_new = Tier.STANDARD, 6 + 2 * (i % 3)
+            prompt = rng.randint(0, vocab,
+                                 size=(4 + 3 * (i % 4),)).astype(np.int32)
+            reqs.append(Request(prompt, max_new_tokens=max_new,
+                                tier=tier))
+        return reqs
+
+    model = _build_model()
+    eng = _engine(model, num_slots=4)    # unconstrained oracle arm
+    oreqs = _overload_requests()
+    run_chaos(eng, oreqs, [], audit_every_step=True)
+    obase = [list(r.token_ids) for r in oreqs]
+    if not all(r.outcome is not None and r.outcome.ok for r in oreqs):
+        errors.append("tiered_overload: oracle arm did not complete")
+
+    model = _build_model()
+    # max_queue = n//2 (= the BATCH count): the L+S storm's overflow
+    # (n/2 - free capacity) never exceeds the queued BATCH supply
+    # (n/2 - slotted), so displacement can always drain BATCH and
+    # never has to touch a higher tier — at any n
+    eng = _engine(model, num_slots=2, max_queue=n // 2)
+    reqs = _overload_requests()
+    batch = [r for r in reqs if r.tier is Tier.BATCH]
+    other = [r for r in reqs if r.tier is not Tier.BATCH]
+    for r in batch:
+        eng.submit(r)
+    steps = 0
+    while not all(s is not None for s in eng._slots) and steps < 2000:
+        eng.step()
+        eng.audit_pages()
+        steps += 1
+    for r in other:                      # the storm
+        eng.submit(r)
+    _drive(eng, errors, "tiered_overload")
+    stats = _check_invariants(
+        "tiered_overload", eng, reqs, obase,
+        [r for r in reqs if r.outcome is not None and not r.outcome.ok],
+        errors)
+    for r, base in zip(reqs, obase):
+        if r.outcome is not None and r.outcome.ok and \
+                list(r.token_ids) != base:
+            errors.append("tiered_overload: a completed request "
+                          "diverged from the unconstrained run "
+                          "(preemption resume broke parity)")
+        if r.outcome is not None and not r.outcome.ok and \
+                list(r.token_ids) != base[:len(r.token_ids)]:
+            errors.append("tiered_overload: partial tokens are not a "
+                          "prefix of the unconstrained stream")
+    lat = [r for r in reqs if r.tier is Tier.LATENCY]
+    if not all(r.outcome is not None and r.outcome.ok for r in lat):
+        errors.append("tiered_overload: a LATENCY request did not "
+                      "complete")
+    for r in reqs:
+        if r.outcome is Outcome.SHED and r.tier is not Tier.BATCH:
+            errors.append(f"tiered_overload: a {r.tier} request was "
+                          f"shed while BATCH should absorb overload")
+    if eng.preemptions == 0:
+        errors.append("tiered_overload: LATENCY never preempted a "
+                      "BATCH slot on a saturated engine")
+    if sum(1 for r in reqs if r.outcome is Outcome.SHED) == 0:
+        errors.append("tiered_overload: overload shed nothing — the "
+                      "storm exercised no shedding")
+    stats["preemptions"] = eng.preemptions
+    stats["outcomes_by_tier"] = {
+        t: {o: c for o, c in d.items() if c}
+        for t, d in eng.health_snapshot()["outcomes_by_tier"].items()}
+    results["tiered_overload"] = stats
+
+    # ---- cancel storm ---------------------------------------------- #
+    # clients walk away while queued / mid-prefill / mid-decode /
+    # mid-spec-verify: every cancel is exactly one CANCELLED terminal
+    # with a prefix stream, everyone else is untouched
+    model = _build_model()
+    eng = _engine(model, num_slots=4)
+    reqs = _make_tiered_requests(n, vocab, seed=17)
+    inj = CancelStorm(start=2, every=2, n_per=1,
+                      max_cancels=max(3, n // 4), seed=11)
+    run_chaos(eng, reqs, [inj], audit_every_step=True)
+    stats = _check_invariants("cancel_storm", eng, reqs, baseline,
+                              inj.affected, errors, allow_non_ok=False)
+    _prefix_ok("cancel_storm", reqs)
+    if not inj.fired or not inj.cancelled:
+        errors.append("cancel_storm: injector never cancelled anything")
+    for r in inj.cancelled:
+        if r.outcome is not Outcome.CANCELLED:
+            errors.append(f"cancel_storm: a cancelled request ended "
+                          f"{r.outcome}, not CANCELLED")
+        if r.retry_after_s is not None:
+            errors.append("cancel_storm: CANCELLED carried a "
+                          "retry_after_s hint (client asked to stop)")
+    stats["cancelled"] = len(inj.cancelled)
+    stats["log"] = inj.log
+    results["cancel_storm"] = stats
+
+    # ---- preemption vs quarantine ---------------------------------- #
+    # a saturated tiered engine is preempting when the weights go NaN:
+    # quarantine and the preemption re-queue must compose — exactly
+    # one terminal each, pages exact, nothing wedged
+    model = _build_model()
+    eng = _engine(model, num_slots=2)
+    reqs = _make_tiered_requests(n, vocab, seed=17)
+    batch = [r for r in reqs if r.tier is Tier.BATCH]
+    other = [r for r in reqs if r.tier is not Tier.BATCH]
+    for r in batch:
+        eng.submit(r)
+    steps = 0
+    while not all(s is not None for s in eng._slots) and steps < 2000:
+        eng.step()
+        eng.audit_pages()
+        steps += 1
+    for r in other:
+        eng.submit(r)
+    inj = NaNWeights(at_step=4, seed=7)
+    it = _drive(eng, errors, "preempt_vs_quarantine", injectors=[inj])
+    for i, r in enumerate(reqs):
+        if r.outcome is None:
+            errors.append(f"preempt_vs_quarantine: request {i} "
+                          f"non-terminal")
+    from incubator_mxnet_tpu.serve.chaos import assert_health_consistent
+    from incubator_mxnet_tpu.base import MXNetError
+    try:
+        assert_health_consistent(eng, reqs)
+    except MXNetError as e:
+        errors.append(f"preempt_vs_quarantine: {e}")
+    _check_compile_once("preempt_vs_quarantine", eng, errors)
+    if not inj.fired:
+        errors.append("preempt_vs_quarantine: injector never fired")
+    if eng.quarantined == 0:
+        errors.append("preempt_vs_quarantine: poison quarantined "
+                      "nothing")
+    if eng.preemptions == 0:
+        errors.append("preempt_vs_quarantine: nothing was preempted — "
+                      "the interaction was not exercised")
+    results["preempt_vs_quarantine"] = {
+        "outcomes": {o: c for o, c in
+                     eng.health_snapshot()["outcomes"].items() if c},
+        "preemptions": eng.preemptions,
+        "steps": it, "log": inj.log}
+
+    # ---- brownout flap --------------------------------------------- #
+    # page-pressure waves drive the hysteresis controller up the
+    # degrade ladder and back down; levels must step deterministically,
+    # transitions must all be logged, and NOTHING may retrace
+    model = _build_model()
+    bo = BrownoutController(up_steps=1, down_steps=2, delay_ref=0.05)
+    eng = _engine(model, num_slots=2, brownout=bo, watchdog_steps=3000)
+    reqs = _make_tiered_requests(n, vocab, seed=17)
+    injs = [PagePressure(hold_at=3, release_after=12, seed=1),
+            PagePressure(hold_at=30, release_after=12, seed=2)]
+    run_chaos(eng, reqs, injs, audit_every_step=True,
+              poll_sleep=1e-4)
+    # the run ends the step the last request terminates — give the
+    # controller its down_steps-per-level of idle evaluations to walk
+    # back to 0 (a real engine keeps stepping; run() returns)
+    for _ in range(4 * bo.down_steps):
+        eng.step()
+        eng.audit_pages()
+    stats = _check_invariants("brownout_flap", eng, reqs, baseline,
+                              reqs, errors)
+    _prefix_ok("brownout_flap", reqs)
+    if bo.escalations == 0 or bo.deescalations == 0:
+        errors.append(f"brownout_flap: controller never cycled "
+                      f"(up {bo.escalations}, down {bo.deescalations})")
+    if len(bo.timeline) != bo.escalations + bo.deescalations:
+        errors.append("brownout_flap: a transition went unlogged")
+    for a, b in zip(bo.timeline, bo.timeline[1:]):
+        if abs(b["to"] - b["from"]) != 1:
+            errors.append("brownout_flap: a transition skipped a level")
+    if bo.level != 0:
+        errors.append(f"brownout_flap: level stuck at {bo.level} after "
+                      f"pressure cleared")
+    stats["brownout_timeline"] = bo.timeline
+    stats["escalations"] = bo.escalations
+    stats["deescalations"] = bo.deescalations
+    results["brownout_flap"] = stats
+
+    return results
+
+
+# --------------------------------------------------------------------- #
 # fleet scenarios (serve/router.py — ci/run.sh fleetsmoke stage)
 # --------------------------------------------------------------------- #
 
@@ -848,6 +1144,10 @@ def main():
     ap.add_argument("--fleet", action="store_true",
                     help="fleet (router) scenarios instead of the "
                          "single-engine set (ci/run.sh fleetsmoke)")
+    ap.add_argument("--tiers", action="store_true",
+                    help="SLO-tier scenarios — tiered overload storm, "
+                         "cancel storm, preempt-vs-quarantine, "
+                         "brownout flap (ci/run.sh tiersmoke)")
     ap.add_argument("--replicas", type=int, default=2,
                     help="fleet size for --fleet scenarios")
     ap.add_argument("--spec-k", type=int, default=_SPEC_K,
@@ -866,7 +1166,9 @@ def main():
     n = args.requests or (10 if args.smoke else 24)
     errors = []
     t0 = time.perf_counter()
-    if args.fleet:
+    if args.tiers:
+        results = run_tier_scenarios(n, errors)
+    elif args.fleet:
         results = run_fleet_scenarios(n, errors,
                                       n_replicas=args.replicas)
     else:
@@ -885,7 +1187,8 @@ def main():
             f.write("\n")
         print(f"banked {args.json}")
     if not errors:
-        scope = "fleet" if args.fleet else "chaos"
+        scope = "tiers" if args.tiers else \
+            ("fleet" if args.fleet else "chaos")
         print(f"{scope}: all scenarios quiescent, isolated, audited, "
               f"compile-clean")
     sys.exit(0 if not errors else 1)

@@ -1042,6 +1042,158 @@ def bench_fleet_kill(model, *, slots, page_size, prefix_len,
     return out
 
 
+# --------------------------------------------------------------------- #
+# round-13: SLO-tiered overload (serve/slo.py) — banks BENCH_TIER.json
+# --------------------------------------------------------------------- #
+
+def _tiered_workload(n, vocab, rate_hz, seed):
+    """Mixed-tier overload workload: per-index class assignment
+    (i%3 → LATENCY / STANDARD / BATCH), ragged prompts, Poisson
+    arrivals. Returns (class names, request-factory, arrivals) so both
+    arms build IDENTICAL requests except for the tier field the
+    tierless arm erases."""
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_hz, size=n))
+    arrivals[0] = 0.0
+    classes = ["LATENCY", "STANDARD", "BATCH"]
+    spec = []
+    for i in range(n):
+        cls = classes[i % 3]
+        plen = 6 + 3 * (i % 5)
+        max_new = {"LATENCY": 4 + (i % 3),
+                   "STANDARD": 8 + 2 * (i % 3),
+                   "BATCH": 20 + 4 * (i % 3)}[cls]
+        prompt = rng.randint(0, vocab, size=(plen,)).astype(np.int32)
+        spec.append((cls, prompt, max_new))
+
+    def build(tiered):
+        from incubator_mxnet_tpu.serve import Request, Tier
+        return [Request(prompt.copy(), max_new_tokens=max_new,
+                        tier=Tier(cls) if tiered else Tier.STANDARD)
+                for cls, prompt, max_new in spec]
+
+    return [s[0] for s in spec], build, arrivals.tolist()
+
+
+def _class_latencies(classes, reqs):
+    """Per-class completion latency (submit → finish) of the OK
+    requests, plus per-class outcome tallies."""
+    lat: dict = {}
+    outcomes: dict = {}
+    for cls, r in zip(classes, reqs):
+        outcomes.setdefault(cls, {}).setdefault(str(r.outcome), 0)
+        outcomes[cls][str(r.outcome)] += 1
+        if r.outcome is not None and r.outcome.ok:
+            lat.setdefault(cls, []).append(r.finish_time -
+                                           r.submit_time)
+    return lat, outcomes
+
+
+def bench_tiered_overload(model, *, n_requests, slots, page_size,
+                          rate_hz, errors, smoke=False):
+    """The acceptance run for SLO tiers: the SAME mixed-class offered
+    load against (a) a TIERLESS engine (every request STANDARD — the
+    PR 5 FIFO baseline) and (b) the TIERED engine (priority admission,
+    BATCH-drains-first shedding, LATENCY-preempts-BATCH, brownout
+    controller on). Banks per-class completion p50/p99, per-tier
+    outcomes and the brownout timeline; asserts
+
+      - every request ends in exactly one terminal outcome (both arms);
+      - the tiered arm sheds ONLY BATCH (BATCH absorbs all overload);
+      - every LATENCY request completes in the tiered arm;
+      - LATENCY completion p99 is STRICTLY better tiered than
+        tierless under the identical offered load;
+      - pages audited clean after every step, decode compiled once.
+    """
+    from incubator_mxnet_tpu.serve import (InferenceEngine, Outcome,
+                                           Tier, TierPolicy)
+    from incubator_mxnet_tpu.serve.slo import BrownoutController
+    from incubator_mxnet_tpu.serve.chaos import (
+        assert_health_consistent, run_chaos)
+    vocab = model.vocab_size
+    classes, build, arrivals = _tiered_workload(n_requests, vocab,
+                                                rate_hz, seed=3)
+    # neither arm bounds the GLOBAL queue: the tierless baseline must
+    # express overload as FIFO head-of-line latency (shedding most of
+    # the load would let its survivors see an idle engine — a baseline
+    # that wins by refusing work). The tiered arm bounds only BATCH's
+    # OWN queue — so by construction every shed lands on BATCH, which
+    # is exactly the policy under test.
+    batch_queue = slots
+
+    def _arm(tiered):
+        kw = dict(num_slots=slots, page_size=page_size,
+                  chunk_pages=1, prefix_cache=True)
+        bo = None
+        if tiered:
+            bo = BrownoutController(up_steps=2, down_steps=6,
+                                    delay_ref=0.25)
+            kw["brownout"] = bo
+            kw["tier_policies"] = {
+                Tier.BATCH: TierPolicy(max_queue=batch_queue,
+                                       preemptible=True)}
+        eng = InferenceEngine(model, **kw)
+        # untimed warmup: compile the programs OUTSIDE the measured
+        # window so the first arrivals' latency is scheduling, not XLA
+        import numpy as np
+        warm_rng = np.random.RandomState(99)
+        from incubator_mxnet_tpu.serve import Request
+        warm = [Request(warm_rng.randint(0, vocab, size=(21,)),
+                        max_new_tokens=4) for _ in range(2)]
+        eng.run(warm)
+        reqs = build(tiered)
+        t0 = time.perf_counter()
+        run_chaos(eng, reqs, [], arrival_times=arrivals,
+                  audit_every_step=True)
+        wall = time.perf_counter() - t0
+        tag = "tiered" if tiered else "tierless"
+        assert_health_consistent(eng, warm + reqs)
+        if eng.decode_trace_count != 1:
+            errors.append(f"tiers/{tag}: decode traced "
+                          f"{eng.decode_trace_count} times")
+        lat, by_class = _class_latencies(classes, reqs)
+        out = {"wall_s": round(wall, 3),
+               "outcomes_by_class": by_class,
+               "latency_s": {
+                   cls: {"n_ok": len(xs),
+                         "p50": round(_percentile(xs, 50), 4),
+                         "p99": round(_percentile(xs, 99), 4)}
+                   for cls, xs in sorted(lat.items())}}
+        if tiered:
+            out["preemptions"] = eng.preemptions
+            out["brownout_timeline"] = bo.timeline
+            out["brownout_escalations"] = bo.escalations
+            out["brownout_deescalations"] = bo.deescalations
+            for cls, r in zip(classes, reqs):
+                if r.outcome is Outcome.SHED and cls != "BATCH":
+                    errors.append(f"tiers/tiered: a {cls} request was "
+                                  f"shed — BATCH must absorb all "
+                                  f"shedding")
+            lat_ok = [r for cls, r in zip(classes, reqs)
+                      if cls == "LATENCY" and r.outcome is not None
+                      and r.outcome.ok]
+            if len(lat_ok) != classes.count("LATENCY"):
+                errors.append("tiers/tiered: a LATENCY request did "
+                              "not complete")
+        return out, lat
+
+    tierless, lat_a = _arm(tiered=False)
+    tiered, lat_b = _arm(tiered=True)
+    result = {"config": {"n_requests": n_requests, "slots": slots,
+                         "page_size": page_size, "rate_hz": rate_hz,
+                         "batch_queue": batch_queue, "smoke": smoke},
+              "tierless": tierless, "tiered": tiered}
+    p99_a = tierless["latency_s"].get("LATENCY", {}).get("p99", 0.0)
+    p99_b = tiered["latency_s"].get("LATENCY", {}).get("p99", 1e9)
+    result["latency_p99_ratio"] = round(p99_a / max(p99_b, 1e-9), 3)
+    if not (p99_b < p99_a):
+        errors.append(f"tiers: LATENCY p99 not strictly better tiered "
+                      f"({p99_b:.4f}s) than tierless ({p99_a:.4f}s) "
+                      f"under the same offered load")
+    return result
+
+
 def _check_compile_discipline(tag, stats, errors):
     if stats["decode_trace_count"] != 1:
         errors.append(f"{tag}: decode step compiled "
@@ -1076,9 +1228,39 @@ def main():
                     help="round-12 fleet workloads ONLY (affinity vs "
                          "round-robin at N replicas + KillReplica "
                          "recovery timeline) — banks BENCH_FLEET.json")
+    ap.add_argument("--tiers", action="store_true",
+                    help="round-13 SLO-tier workload ONLY (tiered vs "
+                         "tierless under the same mixed-class "
+                         "overload) — banks BENCH_TIER.json")
     args = ap.parse_args()
 
     errors = []
+
+    if args.tiers:
+        model = _build(max_length=128)
+        if args.smoke:
+            cfg = dict(n_requests=18, slots=2, page_size=8,
+                       rate_hz=60.0)
+        else:
+            cfg = dict(n_requests=60, slots=4, page_size=8,
+                       rate_hz=120.0)
+        result = bench_tiered_overload(model, errors=errors,
+                                       smoke=args.smoke, **cfg)
+        result["config"]["backend"] = os.environ.get("JAX_PLATFORMS",
+                                                     "cpu")
+        print(json.dumps(result, indent=2))
+        for e in errors:
+            print(f"FAIL: {e}", file=sys.stderr)
+        out = args.json
+        if out is None and not args.smoke:
+            out = os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "BENCH_TIER.json")
+        if out:
+            with open(out, "w") as f:
+                json.dump(result, f, indent=2)
+                f.write("\n")
+            print(f"banked {out}")
+        sys.exit(0 if not errors else 1)
 
     if args.fleet:
         model9 = _build_round9(args.smoke)
