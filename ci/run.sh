@@ -53,6 +53,17 @@ stage_servebench() {
   JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke
 }
 
+stage_quantbench() {
+  echo "== quantbench: quantized-KV regression guard (int8 pages vs the"
+  echo "               f32 jnp oracle: greedy top-1 token match >= 99%,"
+  echo "               p99 logit error under the accuracy gate, decode/"
+  echo "               verify/prefill each compiled exactly once in the"
+  echo "               quantized arm, slots-at-fixed-pool-bytes >= 1.8x"
+  echo "               the f32 layout; plus the int8-allreduce seam:"
+  echo "               loss-curve divergence vs f32 bounded at 5%)"
+  JAX_PLATFORMS=cpu python tools/serve_bench.py --quant --smoke
+}
+
 stage_chaossmoke() {
   echo "== chaossmoke: resilience guard (seeded faults — NaN weights,"
   echo "               corrupt/dropped page writes, allocator starvation,"
@@ -120,7 +131,7 @@ ge.dryrun_multichip(8)"
 }
 
 stages=("$@")
-[ ${#stages[@]} -eq 0 ] && stages=(sanity native unit stepbench servebench chaossmoke fleetsmoke tiersmoke trainchaos ckptbench entry)
+[ ${#stages[@]} -eq 0 ] && stages=(sanity native unit stepbench servebench quantbench chaossmoke fleetsmoke tiersmoke trainchaos ckptbench entry)
 for s in "${stages[@]}"; do
   "stage_$s"
 done

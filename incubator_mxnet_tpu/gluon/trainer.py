@@ -50,7 +50,8 @@ class Trainer:
                  kvstore="device", compression_params=None,
                  update_on_kvstore=None, fuse_step=None,
                  loss_scaler=None, guard=None,
-                 max_consecutive_nonfinite=None):
+                 max_consecutive_nonfinite=None,
+                 int8_allreduce=False):
         if isinstance(params, (dict, ParameterDict)):
             param_list = [params[k] for k in sorted(params.keys())] \
                 if isinstance(params, dict) else list(params.values())
@@ -101,6 +102,29 @@ class Trainer:
                 "(fuse_step=False or a non-fusable optimizer) — the "
                 "eager per-param path has no non-finite guard, so "
                 "skip-step and HALTED_POISONED protection are INERT",
+                UserWarning, stacklevel=2)
+
+        # EQuARX-style compressed-collective seam (PAPERS.md): opt-in
+        # int8 quantization AT THE BUCKET — the one place every
+        # gradient byte crosses the wire. Per-bucket symmetric scale,
+        # quantize → allreduce → dequantize; the fused step's
+        # non-finite guard then judges the DEQUANTIZED gradients, so
+        # its verdict (apply vs skip) is unaffected by compression: a
+        # non-finite gradient poisons the bucket's scale, the scale
+        # poisons every dequantized element, and the skip fires exactly
+        # as it would have uncompressed. Banked for overhead and
+        # convergence-delta (BENCH_QUANT.json int8_allreduce) — a
+        # numerics seam on CPU, a 4x wire-bytes lever where a real
+        # int8 collective backs the pushpull.
+        self._int8_allreduce = bool(int8_allreduce)
+        self.int8_buckets = 0            # buckets shipped quantized
+        self.int8_bytes_saved = 0        # f32 bytes - int8 bytes
+        if self._int8_allreduce and not self._fuse_step:
+            warnings.warn(
+                "int8_allreduce=True but the fused step is off "
+                "(fuse_step=False or a non-fusable optimizer) — "
+                "gradient bucketing never runs, so the compressed "
+                "allreduce is INERT and gradients ship uncompressed",
                 UserWarning, stacklevel=2)
 
         self._compression_params = compression_params
@@ -163,6 +187,9 @@ class Trainer:
             None if self._amp_loss_scaler is None
             else float(self._amp_loss_scaler.loss_scale))
         snap["guard"] = self._fused is not None and self._fused.guard
+        snap["int8_allreduce"] = self._int8_allreduce
+        snap["int8_buckets"] = self.int8_buckets
+        snap["int8_bytes_saved"] = self.int8_bytes_saved
         return snap
 
     def scale_loss(self, loss):
@@ -235,7 +262,16 @@ class Trainer:
             if p.grad_req == "null":
                 continue
             grads = p.list_grad()
-            if self._kvstore.num_workers > 1 or len(grads) > 1:
+            # int8_allreduce includes single-replica grads too: the
+            # quantize→dequantize roundtrip IS the effect under test
+            # (the allreduce is identity there), so a one-process run
+            # measures the convergence delta the compressed collective
+            # would impose at scale. Gated on the fused step — without
+            # bucketing the compressed path cannot engage (warned in
+            # the constructor), so adding work would only buy identity
+            # pushpulls
+            if self._kvstore.num_workers > 1 or len(grads) > 1 or \
+                    (self._int8_allreduce and self._fuse_step):
                 work.append((i, grads))
         if not work:
             return
@@ -245,7 +281,8 @@ class Trainer:
                       not isinstance(g[0], RowSparseNDArray)]
         rest = [(i, g) for i, g in work
                 if len(g) != 1 or isinstance(g[0], RowSparseNDArray)]
-        if self._fuse_step and len(bucketable) > 1:
+        if self._fuse_step and (len(bucketable) > 1 or
+                                (self._int8_allreduce and bucketable)):
             self._bucketed_pushpull(bucketable)
         else:
             rest = work
@@ -283,11 +320,15 @@ class Trainer:
                 chunk = members[start:end]
                 flat = jnp.concatenate(
                     [g._data.ravel() for _, g in chunk])
-                bucket = NDArray(flat)
                 comp = zlib.crc32(",".join(
                     f"{i}:{g.size}" for i, g in chunk).encode())
                 key = f"__grad_bucket_{dt}_{bucket_id}_{comp:08x}"
-                self._kvstore.pushpull(key, bucket, out=bucket)
+                if self._int8_allreduce:
+                    flat = self._int8_pushpull(key, flat)
+                    bucket = NDArray(flat)
+                else:
+                    bucket = NDArray(flat)
+                    self._kvstore.pushpull(key, bucket, out=bucket)
                 off = 0
                 for _, g in chunk:
                     n = g.size
@@ -295,6 +336,39 @@ class Trainer:
                     off += n
                 start = end
                 bucket_id += 1
+
+    def _int8_pushpull(self, key, flat):
+        """Quantize one gradient bucket to int8 codes with a single
+        per-bucket symmetric scale, allreduce the CODES, dequantize the
+        sum — the EQuARX seam on the PR-1 dtype bucket. Across workers
+        the scale must be shared or the code sum is meaningless: the
+        bucket amaxes are summed first (a one-scalar pushpull; the sum
+        bounds every worker's max, so the shared scale is merely
+        conservative — at most log2(W) bits of the mantissa), and the
+        codes ride the wire as int32 so a W-way sum cannot wrap
+        (where a real compressed collective backs the kvstore, this is
+        the hop that ships 4x fewer bytes). A non-finite gradient
+        makes amax — and therefore every dequantized element —
+        non-finite: the fused guard's verdict on the dequantized
+        result is the uncompressed verdict."""
+        from ..ndarray import NDArray
+        from ..ops.quantization import (dequantize_symmetric,
+                                        quantize_symmetric,
+                                        symmetric_scale)
+        amax = jnp.max(jnp.abs(flat.astype(jnp.float32)))
+        if self._kvstore.num_workers > 1:
+            am = NDArray(amax.reshape(1))
+            self._kvstore.pushpull(key + "_int8amax", am, out=am)
+            amax = am._data.reshape(())
+        scale = symmetric_scale(amax)
+        q = quantize_symmetric(flat, scale)          # int8 codes
+        codes = NDArray(q.astype(jnp.int32))
+        self._kvstore.pushpull(key + "_int8q", codes, out=codes)
+        self.int8_buckets += 1
+        self.int8_bytes_saved += int(flat.size) * \
+            (flat.dtype.itemsize - 1)
+        return dequantize_symmetric(codes._data, scale) \
+            .astype(flat.dtype)
 
     def allreduce_grads(self):
         self._init_kvstore()

@@ -19,10 +19,63 @@ from ..base import MXNetError
 from .registry import register
 
 
+# --------------------------------------------------------------------- #
+# the ONE audited symmetric-quantization codepath, shared between these
+# legacy MXNet-compat operators and the serving tier's quantized KV
+# pages (serve/paged_kv.py) — the scale convention, the zero-range
+# fallback, and the saturation behaviour live HERE and nowhere else.
+# --------------------------------------------------------------------- #
+
+def symmetric_scale(amax, qmax=127.0):
+    """Symmetric scale from an absolute-max statistic: ``amax / qmax``,
+    with the ZERO-RANGE convention ``scale = 1.0`` where ``amax <= 0``
+    (an all-zero page/tensor roundtrips to exact zeros and a freshly
+    reset page dequantizes its codes verbatim — never a divide-by-zero
+    or a NaN). ``amax`` may be any shape (per-tensor scalar, per-page
+    vector); non-finite amax propagates into the scale BY DESIGN — a
+    poisoned range statistic must stay visible downstream, not be
+    silently clamped (the serving guard depends on it). The zero test
+    is ``amax != 0``, not ``amax > 0``: ``NaN > 0`` is False, so the
+    greater-than form would quietly map a poisoned amax onto the
+    benign zero-range fallback — exactly the corruption the serving
+    guard exists to catch (found by the corrupt_page_scale chaos
+    scenario)."""
+    amax = jnp.asarray(amax, jnp.float32)
+    return jnp.where(amax != 0, amax / qmax, 1.0)
+
+
+def quantize_symmetric(x, scale, dtype=jnp.int8, qmax=127.0):
+    """``x / scale`` rounded (integer targets) or cast (fp8 targets),
+    saturated to ±qmax. ``scale`` broadcasts against ``x`` (per-tensor
+    scalar or per-page column). Accepts any float input (f32/bf16 —
+    math runs in f32)."""
+    y = x.astype(jnp.float32) / scale
+    if jnp.issubdtype(jnp.dtype(dtype), jnp.integer):
+        y = jnp.round(y)
+    return jnp.clip(y, -qmax, qmax).astype(dtype)
+
+
+def dequantize_symmetric(q, scale):
+    """Codes → f32: ``q * scale`` (scale broadcasts)."""
+    return q.astype(jnp.float32) * scale
+
+
+def requantize_symmetric(q, ratio, dtype=jnp.int8, qmax=127.0):
+    """Rescale existing codes in place of a dequantize→quantize round
+    trip: ``round(q * ratio)`` saturated — the page-scale-growth path of
+    the quantized KV pool (a page's symmetric scale only ever GROWS, so
+    ``ratio = old_scale / new_scale <= 1`` and the rescale never
+    saturates live payload)."""
+    y = q.astype(jnp.float32) * ratio
+    if jnp.issubdtype(jnp.dtype(dtype), jnp.integer):
+        y = jnp.round(y)
+    return jnp.clip(y, -qmax, qmax).astype(dtype)
+
+
 def _symmetric_scale(min_r, max_r, bits=8):
-    amax = jnp.maximum(jnp.abs(min_r), jnp.abs(max_r))
     qmax = float(2 ** (bits - 1) - 1)  # 127
-    return jnp.where(amax > 0, amax / qmax, 1.0)
+    return symmetric_scale(jnp.maximum(jnp.abs(min_r), jnp.abs(max_r)),
+                           qmax)
 
 
 @register("quantize", aliases=("_contrib_quantize",), num_outputs=3)
@@ -37,7 +90,7 @@ def quantize(data, min_range, max_range, out_type="uint8"):
     max_r = jnp.asarray(max_range, jnp.float32).reshape(())
     if out_type == "int8":
         scale = _symmetric_scale(min_r, max_r)
-        q = jnp.clip(jnp.round(data / scale), -127, 127).astype(jnp.int8)
+        q = quantize_symmetric(data, scale)
     elif out_type == "uint8":
         scale = (max_r - min_r) / 255.0
         zero = jnp.round(-min_r / scale)
@@ -63,7 +116,7 @@ def quantize_v2(data, min_calib_range=None, max_calib_range=None,
         min_r = jnp.asarray(min_calib_range, jnp.float32)
         max_r = jnp.asarray(max_calib_range, jnp.float32)
     scale = _symmetric_scale(min_r, max_r)
-    q = jnp.clip(jnp.round(data / scale), -127, 127).astype(jnp.int8)
+    q = quantize_symmetric(data, scale)
     return q, min_r, max_r
 
 
@@ -71,7 +124,7 @@ def quantize_v2(data, min_calib_range=None, max_calib_range=None,
 def dequantize(data, min_range, max_range, out_type="float32"):
     """int8 → float (reference: dequantize.cc)."""
     scale = _symmetric_scale(min_range, max_range)
-    return data.astype(jnp.float32) * scale
+    return dequantize_symmetric(data, scale)
 
 
 @register("requantize", aliases=("_contrib_requantize",), num_outputs=3)
@@ -87,8 +140,7 @@ def requantize(data, min_range, max_range, min_calib_range=None,
         min_out = jnp.asarray(min_calib_range, jnp.float32)
         max_out = jnp.asarray(max_calib_range, jnp.float32)
     out_scale = _symmetric_scale(min_out, max_out)
-    q = jnp.clip(jnp.round(data.astype(jnp.float32) * in_scale / out_scale),
-                 -127, 127).astype(jnp.int8)
+    q = quantize_symmetric(data.astype(jnp.float32) * in_scale, out_scale)
     return q, min_out, max_out
 
 

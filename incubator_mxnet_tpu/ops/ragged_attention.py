@@ -74,7 +74,13 @@ __all__ = ["ragged_paged_attention", "ragged_attention_reference",
 
 def _ragged_kernel(pt_ref, ln_ref, q_ref, k_ref, v_ref, o_ref,
                    m_ref, l_ref, acc_ref, *, scale, page_size, n_pages,
-                   heads):
+                   heads, ks_ref=None, vs_ref=None):
+    """``ks_ref``/``vs_ref`` (None = unquantized pools, bit-identical
+    to the pre-quantization kernel) are (P,) f32 per-page scale arrays
+    riding the SAME scalar-prefetch path as the page table: the grid
+    step that DMAs page ``pt[s, j]`` reads that page's scale from SMEM
+    and dequantizes the int8/fp8 block inline at the DMA boundary —
+    the pool never materializes in float anywhere."""
     from jax.experimental import pallas as pl
 
     s = pl.program_id(0)
@@ -91,15 +97,25 @@ def _ragged_kernel(pt_ref, ln_ref, q_ref, k_ref, v_ref, o_ref,
     def _accumulate():
         valid = (j * page_size + lax.broadcasted_iota(
             jnp.int32, (page_size, 1), 0)) < length
+        if ks_ref is not None:                  # this page's scales
+            sk = ks_ref[pt_ref[s, j]]
+            sv = vs_ref[pt_ref[s, j]]
         for h in range(heads):                  # unrolled head loop
             q = q_ref[0, h]                     # (1, D), input dtype
             k = k_ref[0, h]                     # (page_size, D)
+            if ks_ref is not None:              # inline dequant
+                q = q.astype(jnp.float32)
+                k = k.astype(jnp.float32) * sk
             # SELECT masked rows out of V (not just zero-weight them):
             # a freed page can be reused carrying non-finite garbage in
             # positions past the new owner's length, and 0 * NaN = NaN
             # would leak it through the weighted sum — masked reads
-            # must never matter, even poisoned ones
-            v = jnp.where(valid, v_ref[0, h], 0.0)
+            # must never matter, even poisoned ones (a quantized pool's
+            # NaN channel is the page SCALE — the select covers it the
+            # same way)
+            vv = v_ref[0, h] if vs_ref is None \
+                else v_ref[0, h].astype(jnp.float32) * sv
+            v = jnp.where(valid, vv, 0.0)
             sc = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
                          precision=lax.Precision.DEFAULT) * scale
             pos = j * page_size + lax.broadcasted_iota(
@@ -179,12 +195,70 @@ def _ragged_pallas(q, k_pool, v_pool, page_table, lengths, scale,
     return out[:, :, 0, :]
 
 
-def _gather_window(pool, page_table):
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def _ragged_pallas_q(q, k_pool, v_pool, page_table, lengths, k_scale,
+                     v_scale, scale, interpret):
+    """Quantized-pool decode kernel: ``k_scale``/``v_scale`` (P,) f32
+    per-page scales join the page table and lengths in the
+    scalar-prefetch set; the kernel dequantizes each page inline at
+    the DMA boundary (see ``_ragged_kernel``)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    S, H, D = q.shape
+    page_size = k_pool.shape[2]
+    n_pages = page_table.shape[1]
+    q4 = q[:, :, None, :]                       # (S, H, 1, D)
+
+    def kernel(pt_ref, ln_ref, ks_ref, vs_ref, *rest):
+        _ragged_kernel(pt_ref, ln_ref, *rest, scale=scale,
+                       page_size=page_size, n_pages=n_pages, heads=H,
+                       ks_ref=ks_ref, vs_ref=vs_ref)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,      # page_table, lengths, k/v scales
+        grid=(S, n_pages),
+        in_specs=[
+            pl.BlockSpec((1, H, 1, D),
+                         lambda s, j, pt, ln, ks, vs: (s, 0, 0, 0)),
+            pl.BlockSpec((1, H, page_size, D),
+                         lambda s, j, pt, ln, ks, vs:
+                         (pt[s, j], 0, 0, 0)),
+            pl.BlockSpec((1, H, page_size, D),
+                         lambda s, j, pt, ln, ks, vs:
+                         (pt[s, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, 1, D),
+                               lambda s, j, pt, ln, ks, vs:
+                               (s, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, 1), jnp.float32),        # m
+            pltpu.VMEM((H, 1), jnp.float32),        # l
+            pltpu.VMEM((H, 1, D), jnp.float32),     # acc
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, H, 1, D), q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      k_scale.astype(jnp.float32), v_scale.astype(jnp.float32),
+      q4, k_pool, v_pool)
+    return out[:, :, 0, :]
+
+
+def _gather_window(pool, page_table, scale=None):
     """(S, H, K, D) dense window of a slot's pages — the expensive
-    gather over the pool's page axis, shared by the reference paths."""
+    gather over the pool's page axis, shared by the reference paths.
+    ``scale`` (P,) dequantizes a quantized pool inline with the gather
+    (per-page broadcast) — the f32 oracle's quantized arm."""
     S, n_pages = page_table.shape
     _, H, page_size, D = pool.shape
     g = pool[page_table]                        # (S, n_pages, H, ps, D)
+    if scale is not None:
+        g = g.astype(jnp.float32) * \
+            scale[page_table][:, :, None, None, None]
     g = jnp.moveaxis(g, 2, 1)                   # (S, H, n_pages, ps, D)
     return g.reshape(S, H, n_pages * page_size, D)
 
@@ -218,25 +292,36 @@ def _reference_core(q, k, v, lengths, sc):
 
 
 def ragged_attention_reference(q, k_pool, v_pool, page_table, lengths,
-                               scale=None):
+                               scale=None, k_scale=None, v_scale=None):
     """Pure-jnp oracle and CPU serving path: gather each slot's pages to
     a dense (S, H, K, D) window, mask positions >= length, softmax with
     f32 accumulation. Jit-friendly (static shapes; the gather is an XLA
-    gather over the pool's page axis)."""
+    gather over the pool's page axis). ``k_scale``/``v_scale`` (P,)
+    dequantize quantized pools at the gather (per-page broadcast) —
+    past that point the math is BITWISE the unquantized reference, which
+    is what makes this the quantization accuracy oracle's denominator."""
     D = q.shape[-1]
     sc = D ** -0.5 if scale is None else scale
-    k = _gather_window(k_pool, page_table)
-    v = _gather_window(v_pool, page_table)
+    k = _gather_window(k_pool, page_table, k_scale)
+    v = _gather_window(v_pool, page_table, v_scale)
     return _reference_core(q, k, v, lengths, sc)
 
 
 def ragged_paged_attention(q, k_pool, v_pool, page_table, lengths,
-                           scale=None, interpret=None):
+                           scale=None, interpret=None, k_scale=None,
+                           v_scale=None):
     """Decode attention for one new token per slot against the paged KV
     pool. q: (S, H, D); k_pool/v_pool: (num_pages, H, page_size, D);
     page_table: (S, max_pages) int32 (dead entries 0 = null page);
     lengths: (S,) int32 — number of live KV tokens INCLUDING the one
     just written for this step. Returns (S, H, D).
+
+    ``k_scale``/``v_scale`` (P,) f32 mark the pools QUANTIZED (int8 /
+    fp8 codes with per-page symmetric scales — serve/paged_kv.py): the
+    Pallas path prefetches them next to the page table and dequantizes
+    inline at the DMA boundary; the jnp path dequantizes at the gather.
+    None (the default) is the unquantized path, bit-identical to
+    before.
 
     Dispatch is static (mirrors ``ops.pallas_attention``): the Pallas
     kernel on TPU, or anywhere under ``MXTPU_FLASH_INTERPRET=1`` /
@@ -246,10 +331,14 @@ def ragged_paged_attention(q, k_pool, v_pool, page_table, lengths,
         interpret = os.environ.get("MXTPU_FLASH_INTERPRET") == "1"
     sc = q.shape[-1] ** -0.5 if scale is None else scale
     if _pallas_available() and _pallas_runnable(interpret):
+        if k_scale is not None:
+            return _ragged_pallas_q(q, k_pool, v_pool, page_table,
+                                    lengths, k_scale, v_scale, sc,
+                                    interpret)
         return _ragged_pallas(q, k_pool, v_pool, page_table, lengths,
                               sc, interpret)
     return ragged_attention_reference(q, k_pool, v_pool, page_table,
-                                      lengths, sc)
+                                      lengths, sc, k_scale, v_scale)
 
 
 # --------------------------------------------------------------------- #
@@ -258,7 +347,8 @@ def ragged_paged_attention(q, k_pool, v_pool, page_table, lengths,
 
 def _ragged_prefill_kernel(pr_ref, qi_ref, q_ref, k_ref, v_ref, o_ref,
                            m_ref, l_ref, acc_ref, *, scale, page_size,
-                           n_pages, heads, chunk):
+                           n_pages, heads, chunk, ks_ref=None,
+                           vs_ref=None):
     from jax.experimental import pallas as pl
 
     j = pl.program_id(0)
@@ -281,10 +371,18 @@ def _ragged_prefill_kernel(pr_ref, qi_ref, q_ref, k_ref, v_ref, o_ref,
         # (possibly non-finite) cannot leak through 0-weight terms
         valid = (j * page_size + lax.broadcasted_iota(
             jnp.int32, (page_size, 1), 0)) < start + n_real
+        if ks_ref is not None:                  # this page's scales
+            sk = ks_ref[pr_ref[j]]
+            sv = vs_ref[pr_ref[j]]
         for h in range(heads):                  # unrolled head loop
             q = q_ref[0, h]                     # (chunk, D), input dtype
             k = k_ref[0, h]                     # (page_size, D)
-            v = jnp.where(valid, v_ref[0, h], 0.0)
+            if ks_ref is not None:              # inline dequant
+                q = q.astype(jnp.float32)
+                k = k.astype(jnp.float32) * sk
+            vv = v_ref[0, h] if vs_ref is None \
+                else v_ref[0, h].astype(jnp.float32) * sv
+            v = jnp.where(valid, vv, 0.0)
             sc = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
                          precision=lax.Precision.DEFAULT) * scale
             pos_k = j * page_size + lax.broadcasted_iota(
@@ -369,8 +467,59 @@ def _ragged_prefill_pallas(q, k_pool, v_pool, page_row, qinfo, scale,
     return out[0].transpose(1, 0, 2)
 
 
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def _ragged_prefill_pallas_q(q, k_pool, v_pool, page_row, qinfo,
+                             k_scale, v_scale, scale, interpret):
+    """Quantized-pool chunked-prefill kernel: per-page scales in the
+    scalar-prefetch set, dequant at the DMA boundary (see
+    ``_ragged_prefill_kernel``)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    C, H, D = q.shape
+    page_size = k_pool.shape[2]
+    n_pages = page_row.shape[0]
+    q4 = q.transpose(1, 0, 2)[None]             # (1, H, C, D)
+
+    def kernel(pr_ref, qi_ref, ks_ref, vs_ref, *rest):
+        _ragged_prefill_kernel(pr_ref, qi_ref, *rest, scale=scale,
+                               page_size=page_size, n_pages=n_pages,
+                               heads=H, chunk=C, ks_ref=ks_ref,
+                               vs_ref=vs_ref)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,      # page_row, qinfo, k/v scales
+        grid=(n_pages,),
+        in_specs=[
+            pl.BlockSpec((1, H, C, D),
+                         lambda j, pr, qi, ks, vs: (0, 0, 0, 0)),
+            pl.BlockSpec((1, H, page_size, D),
+                         lambda j, pr, qi, ks, vs: (pr[j], 0, 0, 0)),
+            pl.BlockSpec((1, H, page_size, D),
+                         lambda j, pr, qi, ks, vs: (pr[j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, C, D),
+                               lambda j, pr, qi, ks, vs: (0, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, C), jnp.float32),        # m
+            pltpu.VMEM((H, C), jnp.float32),        # l
+            pltpu.VMEM((H, C, D), jnp.float32),     # acc
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((1, H, C, D), q.dtype),
+        interpret=interpret,
+    )(page_row.astype(jnp.int32), qinfo.astype(jnp.int32),
+      k_scale.astype(jnp.float32), v_scale.astype(jnp.float32),
+      q4, k_pool, v_pool)
+    return out[0].transpose(1, 0, 2)
+
+
 def ragged_prefill_reference(q, k_pool, v_pool, page_row, q_start,
-                             scale=None, n_real=None):
+                             scale=None, n_real=None, k_scale=None,
+                             v_scale=None):
     """Pure-jnp oracle and CPU serving path for chunked prefill: gather
     the slot's whole page window dense, apply the per-query prefix mask
     ``pos_k <= q_start + i``, softmax with f32 accumulation. Same
@@ -385,13 +534,16 @@ def ragged_prefill_reference(q, k_pool, v_pool, page_row, q_start,
     if n_real is None:
         n_real = C
 
-    def window(pool):
+    def window(pool, pscale):
         g = pool[page_row]                      # (n_pages, H, ps, D)
+        if pscale is not None:                  # per-page dequant
+            g = g.astype(jnp.float32) * \
+                pscale[page_row][:, None, None, None]
         g = jnp.moveaxis(g, 1, 0)               # (H, n_pages, ps, D)
         return g.reshape(H, K, D)
 
-    k = window(k_pool)
-    v = window(v_pool)
+    k = window(k_pool, k_scale)
+    v = window(v_pool, v_scale)
     s = jnp.einsum("chd,hkd->chk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * sc
     pos_k = lax.broadcasted_iota(jnp.int32, (C, K), 1)
@@ -433,7 +585,8 @@ def ragged_prefill_reference(q, k_pool, v_pool, page_row, q_start,
 
 def _ragged_verify_kernel(pt_ref, ln_ref, dl_ref, q_ref, k_ref, v_ref,
                           o_ref, m_ref, l_ref, acc_ref, *, scale,
-                          page_size, n_pages, heads, window):
+                          page_size, n_pages, heads, window,
+                          ks_ref=None, vs_ref=None):
     """Decode kernel generalized to ``window`` queries per slot: query
     row r of slot s sits at absolute position ``lengths[s] - 1 + r``
     (row 0 IS the ordinary decode query) and attends keys
@@ -479,10 +632,18 @@ def _ragged_verify_kernel(pt_ref, ln_ref, dl_ref, q_ref, k_ref, v_ref,
         # documented PRECONDITION).
         valid = (j * page_size + lax.broadcasted_iota(
             jnp.int32, (page_size, 1), 0)) < length + dl
+        if ks_ref is not None:                  # this page's scales
+            sk = ks_ref[pt_ref[s, j]]
+            sv = vs_ref[pt_ref[s, j]]
         for h in range(heads):                  # unrolled head loop
             q = q_ref[0, h]                     # (window, D), input dtype
             k = k_ref[0, h]                     # (page_size, D)
-            v = jnp.where(valid, v_ref[0, h], 0.0)
+            if ks_ref is not None:              # inline dequant
+                q = q.astype(jnp.float32)
+                k = k.astype(jnp.float32) * sk
+            vv = v_ref[0, h] if vs_ref is None \
+                else v_ref[0, h].astype(jnp.float32) * sv
+            v = jnp.where(valid, vv, 0.0)
             sc = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
                          precision=lax.Precision.DEFAULT) * scale
             pos_k = j * page_size + lax.broadcasted_iota(
@@ -569,8 +730,63 @@ def _ragged_verify_pallas(q, k_pool, v_pool, page_table, lengths,
     return out.transpose(0, 2, 1, 3)
 
 
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def _ragged_verify_pallas_q(q, k_pool, v_pool, page_table, lengths,
+                            draft_len, k_scale, v_scale, scale,
+                            interpret):
+    """Quantized-pool verify kernel: per-page scales in the
+    scalar-prefetch set, dequant at the DMA boundary (see
+    ``_ragged_verify_kernel``)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    S, W, H, D = q.shape
+    page_size = k_pool.shape[2]
+    n_pages = page_table.shape[1]
+    q4 = q.transpose(0, 2, 1, 3)                # (S, H, W, D)
+
+    def kernel(pt_ref, ln_ref, dl_ref, ks_ref, vs_ref, *rest):
+        _ragged_verify_kernel(pt_ref, ln_ref, dl_ref, *rest,
+                              scale=scale, page_size=page_size,
+                              n_pages=n_pages, heads=H, window=W,
+                              ks_ref=ks_ref, vs_ref=vs_ref)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,  # page_table, lengths, draft_len, scales
+        grid=(S, n_pages),
+        in_specs=[
+            pl.BlockSpec((1, H, W, D),
+                         lambda s, j, pt, ln, dl, ks, vs:
+                         (s, 0, 0, 0)),
+            pl.BlockSpec((1, H, page_size, D),
+                         lambda s, j, pt, ln, dl, ks, vs:
+                         (pt[s, j], 0, 0, 0)),
+            pl.BlockSpec((1, H, page_size, D),
+                         lambda s, j, pt, ln, dl, ks, vs:
+                         (pt[s, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, W, D),
+                               lambda s, j, pt, ln, dl, ks, vs:
+                               (s, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, W), jnp.float32),        # m
+            pltpu.VMEM((H, W), jnp.float32),        # l
+            pltpu.VMEM((H, W, D), jnp.float32),     # acc
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, H, W, D), q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      draft_len.astype(jnp.int32), k_scale.astype(jnp.float32),
+      v_scale.astype(jnp.float32), q4, k_pool, v_pool)
+    return out.transpose(0, 2, 1, 3)
+
+
 def ragged_verify_reference(q, k_pool, v_pool, page_table, lengths,
-                            scale=None):
+                            scale=None, k_scale=None, v_scale=None):
     """Pure-jnp verify path: one ``ragged_attention_reference`` call
     per query offset — query row r of slot s attends
     ``lengths[s] + r`` keys (0 for dead slots). DELIBERATELY a loop of
@@ -589,8 +805,8 @@ def ragged_verify_reference(q, k_pool, v_pool, page_table, lengths,
     D = q.shape[-1]
     sc = D ** -0.5 if scale is None else scale
     lengths = lengths.astype(jnp.int32)
-    k = _gather_window(k_pool, page_table)
-    v = _gather_window(v_pool, page_table)
+    k = _gather_window(k_pool, page_table, k_scale)
+    v = _gather_window(v_pool, page_table, v_scale)
     outs = []
     for r in range(W):
         lr = jnp.where(lengths > 0, lengths + r, 0)
@@ -599,7 +815,8 @@ def ragged_verify_reference(q, k_pool, v_pool, page_table, lengths,
 
 
 def ragged_verify_attention(q, k_pool, v_pool, page_table, lengths,
-                            draft_len=None, scale=None, interpret=None):
+                            draft_len=None, scale=None, interpret=None,
+                            k_scale=None, v_scale=None):
     """Multi-query decode (speculative verify) attention: W queries per
     slot — row 0 is the ordinary decode query at position
     ``lengths[s] - 1``, row r sits at position ``lengths[s] - 1 + r``
@@ -638,15 +855,21 @@ def ragged_verify_attention(q, k_pool, v_pool, page_table, lengths,
     if draft_len is None:
         draft_len = jnp.full((q.shape[0],), q.shape[1] - 1, jnp.int32)
     if _pallas_available() and _pallas_runnable(interpret):
+        if k_scale is not None:
+            return _ragged_verify_pallas_q(
+                q, k_pool, v_pool, page_table, lengths,
+                jnp.asarray(draft_len), k_scale, v_scale, sc,
+                interpret)
         return _ragged_verify_pallas(q, k_pool, v_pool, page_table,
                                      lengths, jnp.asarray(draft_len),
                                      sc, interpret)
     return ragged_verify_reference(q, k_pool, v_pool, page_table,
-                                   lengths, sc)
+                                   lengths, sc, k_scale, v_scale)
 
 
 def ragged_prefill_attention(q, k_pool, v_pool, page_row, q_start,
-                             n_real=None, scale=None, interpret=None):
+                             n_real=None, scale=None, interpret=None,
+                             k_scale=None, v_scale=None):
     """Chunked-prefill attention for ONE slot: C chunk queries at
     absolute positions ``q_start + i`` attend the slot's paged prefix
     plus the causal intra-chunk part. q: (C, H, D); k_pool/v_pool:
@@ -669,7 +892,12 @@ def ragged_prefill_attention(q, k_pool, v_pool, page_row, q_start,
     if _pallas_available() and _pallas_runnable(interpret):
         qinfo = jnp.stack([jnp.asarray(q_start, jnp.int32),
                            jnp.asarray(n_real, jnp.int32)])
+        if k_scale is not None:
+            return _ragged_prefill_pallas_q(q, k_pool, v_pool,
+                                            page_row, qinfo, k_scale,
+                                            v_scale, sc, interpret)
         return _ragged_prefill_pallas(q, k_pool, v_pool, page_row,
                                       qinfo, sc, interpret)
     return ragged_prefill_reference(q, k_pool, v_pool, page_row,
-                                    q_start, sc, n_real=n_real)
+                                    q_start, sc, n_real=n_real,
+                                    k_scale=k_scale, v_scale=v_scale)

@@ -37,6 +37,7 @@ from .outcomes import Outcome
 from .router import ReplicaState, Router
 
 __all__ = ["ChaosInjector", "NaNWeights", "CorruptPageWrite",
+           "CorruptPageScale",
            "PagePressure", "DelayedSteps", "CancelStorm", "run_chaos",
            "assert_all_terminal", "assert_health_consistent",
            "FleetInjector", "KillReplica", "SlowReplica",
@@ -148,6 +149,13 @@ class CorruptPageWrite(ChaosInjector):
     def on_step(self, engine, step_idx):
         if self.fired or step_idx < self.at_step:
             return
+        if getattr(engine, "_kv_spec", None) is not None and \
+                self.mode == "nan":
+            raise MXNetError(
+                "CorruptPageWrite(mode='nan') cannot express NaN in an "
+                "int8/fp8 page payload — on a quantized engine the "
+                "non-finite channel is the per-page SCALE: use "
+                "CorruptPageScale")
         ps = engine.page_size
         cands = []
         for s in range(engine.num_slots):
@@ -178,6 +186,116 @@ class CorruptPageWrite(ChaosInjector):
         self._mark(engine._slots[s].request)
         self.log.append(f"step {step_idx}: {self.mode}-corrupted page "
                         f"{page} (slot {s}, refcount 1) in all layers")
+
+
+class CorruptPageScale(ChaosInjector):
+    """Corrupt the per-page SCALE metadata of a live quantized KV page
+    — the quantized pool's own corruption channel: int8/fp8 payloads
+    cannot carry NaN, so a torn scale (bit-flipped SMEM word, stale
+    metadata after a botched migration) is how a quantized cache
+    poisons reads. Requires a quantized engine (``kv_quant`` set);
+    refuses otherwise.
+
+    By default the target is a live SHARED page (refcount >= 2 — a
+    prefix page mapped by a slot AND retained by the index or a
+    sibling slot): the sharpest case, because the scale is shared
+    exactly like the page, so one torn word poisons every reader, and
+    quarantine must both fail the readers AND flush the index so no
+    FUTURE admission maps the poisoned page (the freed page's scale is
+    reset on reallocation). ``shared=False`` targets a private
+    (refcount-1) page — the blast radius is provably one slot.
+
+    ``mode='nan'`` / ``'inf'``: the dequantized K/V go non-finite and
+    the next decode step's sign-encoded guard must quarantine exactly
+    the slots mapping the page (FAILED_NONFINITE, nothing from the
+    poisoned step recorded). ``mode='zero'`` zeroes the page's amax —
+    the scale collapses to the zero-range convention (1.0) and the
+    page dequantizes its raw codes at the wrong magnitude: finite
+    garbage the guard CANNOT see, the metadata twin of a dropped
+    write; affected slots may emit anything, everyone else must stay
+    bit-identical. Defers to a later step when no candidate page is
+    live."""
+
+    name = "corrupt_page_scale"
+
+    _VALS = {"nan": np.nan, "inf": np.inf, "zero": 0.0}
+
+    def __init__(self, at_step: int, mode: str = "nan",
+                 shared: bool = True, seed: int = 0):
+        super().__init__(seed)
+        if mode not in self._VALS:
+            raise MXNetError(f"scale-corrupt mode {mode!r} not in "
+                             f"nan|inf|zero")
+        self.at_step = at_step
+        self.mode = mode
+        self.shared = shared
+        self.page: Optional[int] = None
+
+    def on_step(self, engine, step_idx):
+        if self.fired or step_idx < self.at_step:
+            return
+        if engine._kv_spec is None:
+            raise MXNetError("CorruptPageScale needs a quantized "
+                             "engine (kv_quant='int8'/'fp8_e4m3') — "
+                             "unquantized pools have no scale metadata")
+        ps = engine.page_size
+        want_shared = self.shared
+        cands = []
+        for s in range(engine.num_slots):
+            slot = engine._slots[s]
+            if slot is None or slot.prefilling:
+                continue
+            n_read = -(-int(engine._lengths[s]) // ps)
+            for p in slot.row[:n_read]:
+                p = int(p)
+                if not p:
+                    continue
+                rc = engine._alloc.refcount(p)
+                if (rc >= 2) == want_shared:
+                    cands.append(p)
+        if not cands:
+            return                       # defer until a candidate lives
+        self.fired = True
+        page = cands[self.rng.randint(len(cands))]
+        val = self._VALS[self.mode]
+        for a in engine._kamax:          # host-owned page metadata —
+            a[page] = val                # every layer's K and V scale
+        for a in engine._vamax:
+            a[page] = val
+        self.page = page
+        hit = []
+        for s in range(engine.num_slots):
+            slot = engine._slots[s]
+            if slot is not None and any(int(p) == page
+                                        for p in slot.row):
+                hit.append(s)
+                self._mark(slot.request)
+        if self.mode == "zero":
+            # finite corruption survives quarantine-free: a poisoned
+            # SHARED page stays in the prefix index, so any later
+            # admission may map it — every not-yet-finished request is
+            # in the blast radius (the nan/inf modes need no such
+            # blanket: quarantine flushes the index the same step)
+            for slot in engine._slots:
+                if slot is not None:
+                    self._mark(slot.request)
+            self._mark(*engine._queue)
+        self.log.append(
+            f"step {step_idx}: {self.mode}-corrupted the scale of "
+            f"page {page} (refcount "
+            f"{engine._alloc.refcount(page)}, slots {hit}) in all "
+            f"layers, K and V")
+
+    def mark_submitted_after(self, request: Request):
+        """Zero-mode only: requests submitted after the fault may map
+        the still-cached poisoned page (no quarantine ever flushes
+        it). ``run_chaos`` submits everything up front — the fire-time
+        blanket mark covers batch scenarios — so only a harness that
+        feeds ``arrival_times`` (late submissions) needs to route its
+        submits through this (same contract as
+        ``NaNWeights.mark_submitted_after``)."""
+        if self.fired and self.mode == "zero":
+            self._mark(request)
 
 
 class PagePressure(ChaosInjector):

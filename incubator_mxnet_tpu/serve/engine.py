@@ -113,8 +113,10 @@ from ..ops.ragged_attention import (ragged_attention_reference,
 from .draft import make_ngram_drafter
 from .outcomes import Outcome
 from .paged_kv import (NULL_PAGE, PageAllocator, PrefixIndex,
-                       init_kv_pools, write_block_kv, write_prompt_kv,
-                       write_token_kv)
+                       init_kv_pools, kv_quant_spec, page_scales,
+                       write_block_kv, write_block_kv_q,
+                       write_prompt_kv, write_prompt_kv_q,
+                       write_token_kv, write_token_kv_q)
 from .slo import (BrownoutController, Tier, TierPolicy,
                   resolve_tier_policies)
 
@@ -327,7 +329,27 @@ class InferenceEngine:
       engine step (shared clock — one wide step per probe, however
       many slots probe); newly admitted requests always draft
       immediately (fresh slot state), so churny traffic re-tests
-      agreement without waiting for the clock."""
+      agreement without waiting for the clock.
+
+    Quantized KV cache (docs/SERVING.md "Quantized KV cache"):
+
+    - ``kv_quant`` (default None = f32/bf16 pools): ``'int8'`` (or
+      ``'fp8_e4m3'`` on a float8-capable jax) stores every KV page as
+      narrow codes with ONE symmetric scale per page per pool —
+      roughly 4x (f32) / 2x (bf16) more slots-at-context on the same
+      pool bytes, and the same factor more prefix-cache working set.
+      K/V quantize AT WRITE TIME inside the existing programs (pure
+      traced data — decode/verify/prefill trace counts stay 1), all
+      three ragged kernels dequantize inline at the DMA boundary with
+      the scales riding the scalar-prefetch path next to the page
+      table, and the host owns the per-page amax metadata (reset on
+      page allocation, copied on COW, shared when the page is
+      shared). Accuracy is a measured-tolerance gate against the f32
+      jnp oracle (BENCH_QUANT.json), not bit parity; int8 payloads
+      cannot carry NaN, so the non-finite channel becomes the page
+      SCALE — a poisoned scale makes the attention output non-finite
+      and the existing sign-encoded guard quarantines the slot
+      (serve/chaos.py ``CorruptPageScale``)."""
 
     def __init__(self, model, num_slots=8, page_size=16, max_len=None,
                  num_pages=None, dtype=None, mesh=None, interpret=None,
@@ -338,7 +360,7 @@ class InferenceEngine:
                  spec_k=0, draft_fn=None, draft_ngram=3,
                  spec_patience=2, spec_probe_every=64,
                  tier_policies=None, max_preemptions=4,
-                 brownout=None):
+                 brownout=None, kv_quant=None):
         self.model = model
         self.num_slots = int(num_slots)
         self.page_size = int(page_size)
@@ -371,10 +393,31 @@ class InferenceEngine:
         H = model.block0.attn._heads
         D = model._units // H
         self._H, self._D = H, D
+        # quantized KV pools (docs/SERVING.md "Quantized KV cache"):
+        # int8/fp8 page payload + per-page symmetric scales. The amax
+        # arrays are HOST-OWNED page metadata (np, one (P,) f32 per
+        # layer per pool): every program that writes pages takes them
+        # as traced data and returns them updated — the host pulls the
+        # tiny arrays back on its existing per-step sync — and the
+        # host resets a page's amax when the allocator hands it out
+        # (a recycled page must not inherit its previous owner's
+        # range, and a quarantined slot's poisoned scale dies with
+        # the page). Everything below is gated on self._kv_spec, so
+        # kv_quant=None is byte-for-byte the unquantized engine.
+        self._kv_spec = kv_quant_spec(kv_quant)
+        self.kv_quant = self._kv_spec.name if self._kv_spec else None
         pools = init_kv_pools(model.num_layers, self.num_pages, H,
-                              self.page_size, D, self._dtype)
+                              self.page_size, D, self._dtype,
+                              quant=self._kv_spec)
         self._kpools = tuple(k for k, _ in pools)
         self._vpools = tuple(v for _, v in pools)
+        if self._kv_spec is not None:
+            self._kamax = tuple(np.zeros((self.num_pages,), np.float32)
+                                for _ in range(model.num_layers))
+            self._vamax = tuple(np.zeros((self.num_pages,), np.float32)
+                                for _ in range(model.num_layers))
+        else:
+            self._kamax = self._vamax = ()
 
         # model params are TRACED INPUTS of the decode/prefill programs
         # (not closure constants): warm-restarting new weights into a
@@ -524,14 +567,18 @@ class InferenceEngine:
 
         return scope()
 
-    def _ragged_attn(self, q, kp, vp, page_table, lengths):
+    def _ragged_attn(self, q, kp, vp, page_table, lengths, ks=None,
+                     vs=None):
         if self._mesh is not None:
             return ragged_attention_reference(q, kp, vp, page_table,
-                                              lengths)
+                                              lengths, k_scale=ks,
+                                              v_scale=vs)
         return ragged_paged_attention(q, kp, vp, page_table, lengths,
-                                      interpret=self._interpret)
+                                      interpret=self._interpret,
+                                      k_scale=ks, v_scale=vs)
 
-    def _verify_attn(self, q, kp, vp, page_table, lengths, draft_len):
+    def _verify_attn(self, q, kp, vp, page_table, lengths, draft_len,
+                     ks=None, vs=None):
         """Multi-query (speculative verify) decode attention: q is
         (S, W, H, D), ``lengths`` counts keys visible to query row 0
         (0 = dead slot), ``draft_len`` the slot's real draft count
@@ -545,22 +592,27 @@ class InferenceEngine:
         path."""
         if q.shape[1] == 1:
             out = self._ragged_attn(q[:, 0], kp, vp, page_table,
-                                    lengths)
+                                    lengths, ks, vs)
             return out[:, None]
         if self._mesh is not None:
             return ragged_verify_reference(q, kp, vp, page_table,
-                                           lengths)
+                                           lengths, k_scale=ks,
+                                           v_scale=vs)
         return ragged_verify_attention(q, kp, vp, page_table, lengths,
                                        draft_len=draft_len,
-                                       interpret=self._interpret)
+                                       interpret=self._interpret,
+                                       k_scale=ks, v_scale=vs)
 
-    def _prefill_attn(self, q, kp, vp, page_row, start, n_real):
+    def _prefill_attn(self, q, kp, vp, page_row, start, n_real,
+                      ks=None, vs=None):
         if self._mesh is not None:
             return ragged_prefill_reference(q, kp, vp, page_row, start,
-                                            n_real=n_real)
+                                            n_real=n_real, k_scale=ks,
+                                            v_scale=vs)
         return ragged_prefill_attention(q, kp, vp, page_row, start,
                                         n_real=n_real,
-                                        interpret=self._interpret)
+                                        interpret=self._interpret,
+                                        k_scale=ks, v_scale=vs)
 
     def _accept_emit(self, logits, tokens, draft_len, temps, slot_keys,
                      pos, act):
@@ -630,8 +682,8 @@ class InferenceEngine:
         n_emit = jnp.where(act, n_acc + 1, 0).astype(jnp.int32)
         return emitted, n_emit
 
-    def _decode_step_fn(self, param_vals, kpools, vpools, tokens,
-                        draft_len, page_table, lengths, temps,
+    def _decode_step_fn(self, param_vals, kpools, vpools, kamax, vamax,
+                        tokens, draft_len, page_table, lengths, temps,
                         slot_keys):
         """ONE decode/verify step for every slot: W token positions per
         slot — the last accepted token plus up to W - 1 draft
@@ -682,15 +734,39 @@ class InferenceEngine:
             if model._dtype != "float32":
                 x = x.astype(model._dtype)
             new_k, new_v = [], []
+            new_ka, new_va = [], []
+            spec = self._kv_spec
             for i in range(model.num_layers):
                 blk = getattr(model, f"block{i}")
                 q, k, v = _qkv_heads(blk.attn, blk.ln1(x))  # (S,W,H,D)
-                kp = write_block_kv(kpools[i], k, write_page, write_off)
-                vp = write_block_kv(vpools[i], v, write_page, write_off)
+                if spec is None:
+                    kp = write_block_kv(kpools[i], k, write_page,
+                                        write_off)
+                    vp = write_block_kv(vpools[i], v, write_page,
+                                        write_off)
+                    ks = vs = None
+                    adt = kp.dtype
+                else:
+                    # quantize-at-write: the page's scale grows with
+                    # the window's amax and existing codes requantize
+                    # in the same scatter — pure traced data, no new
+                    # programs (trace counts stay asserted at 1)
+                    kp, ka = write_block_kv_q(kpools[i], kamax[i], k,
+                                              write_page, write_off,
+                                              spec)
+                    vp, va = write_block_kv_q(vpools[i], vamax[i], v,
+                                              write_page, write_off,
+                                              spec)
+                    new_ka.append(ka)
+                    new_va.append(va)
+                    ks = page_scales(ka, spec)
+                    vs = page_scales(va, spec)
+                    adt = self._dtype
                 new_k.append(kp)
                 new_v.append(vp)
-                out = self._verify_attn(q.astype(kp.dtype), kp, vp,
-                                        page_table, eff_len, draft_len)
+                out = self._verify_attn(q.astype(adt), kp, vp,
+                                        page_table, eff_len, draft_len,
+                                        ks, vs)
                 out = NDArray(out.astype(q.dtype).reshape(
                     S, W, model._units))
                 x = x + blk.attn.proj(out)
@@ -715,10 +791,11 @@ class InferenceEngine:
             bad = jnp.any(jnp.any(~jnp.isfinite(logits), axis=-1) &
                           used, axis=-1) & act
             emitted = jnp.where(bad[:, None], -emitted - 1, emitted)
-        return tuple(new_k), tuple(new_v), emitted, n_emit, new_lengths
+        return (tuple(new_k), tuple(new_v), tuple(new_ka),
+                tuple(new_va), emitted, n_emit, new_lengths)
 
-    def _prefill_fn(self, param_vals, kpools, vpools, ids, t0, pages,
-                    temp, key):
+    def _prefill_fn(self, param_vals, kpools, vpools, kamax, vamax,
+                    ids, t0, pages, temp, key):
         """Prompt forward for ONE request (ids (1, Tpad) padded): dense
         causal attention inside the prompt (the prompt attends only
         itself), K/V scattered into the slot's pages, and the FIRST
@@ -746,11 +823,23 @@ class InferenceEngine:
             pos_k = lax.broadcasted_iota(jnp.int32, (Tpad, Tpad), 1)
             mask = ((pos_k <= pos_q) & (pos_k < t0))[None, None]
             new_k, new_v = list(kpools), list(vpools)
+            new_ka, new_va = list(kamax), list(vamax)
+            spec = self._kv_spec
             for i in range(model.num_layers):
                 blk = getattr(model, f"block{i}")
                 q, k, v = _qkv_heads(blk.attn, blk.ln1(x))  # (1,Tpad,H,D)
-                new_k[i] = write_prompt_kv(new_k[i], k[0], pages)
-                new_v[i] = write_prompt_kv(new_v[i], v[0], pages)
+                if spec is None:
+                    new_k[i] = write_prompt_kv(new_k[i], k[0], pages)
+                    new_v[i] = write_prompt_kv(new_v[i], v[0], pages)
+                else:
+                    # quantize the prompt's pages at a FRESH per-page
+                    # scale; the prompt's own attention below runs on
+                    # the exact pre-quantization K/V (only future
+                    # paged reads pay the quantization error)
+                    new_k[i], new_ka[i] = write_prompt_kv_q(
+                        new_k[i], new_ka[i], k[0], pages, spec)
+                    new_v[i], new_va[i] = write_prompt_kv_q(
+                        new_v[i], new_va[i], v[0], pages, spec)
                 out = _sdpa(q, k, v, mask=mask)
                 x = x + blk.attn.proj(NDArray(out.reshape(
                     1, Tpad, model._units)))
@@ -766,10 +855,12 @@ class InferenceEngine:
         if self.guard_nonfinite:             # sign-encoded, see decode
             tok = jnp.where(jnp.any(~jnp.isfinite(logits)),
                             -tok - 1, tok)
-        return tuple(new_k), tuple(new_v), tok
+        return tuple(new_k), tuple(new_v), tuple(new_ka), \
+            tuple(new_va), tok
 
-    def _chunk_prefill_fn(self, param_vals, kpools, vpools, ids, start,
-                          n_real, page_row, temp, key):
+    def _chunk_prefill_fn(self, param_vals, kpools, vpools, kamax,
+                          vamax, ids, start, n_real, page_row, temp,
+                          key):
         """ONE prefill chunk of ONE slot's prompt: ids (1, Cpad) holds
         ``n_real`` prompt tokens at absolute positions ``start + i``.
         Their K/V is scattered into the slot's pages (padded tokens land
@@ -804,16 +895,31 @@ class InferenceEngine:
             tok_pages = jnp.where(live, page_row[page_idx], NULL_PAGE)
             tok_off = pos[0] % ps
             new_k, new_v = list(kpools), list(vpools)
+            new_ka, new_va = list(kamax), list(vamax)
+            spec = self._kv_spec
             for i in range(model.num_layers):
                 blk = getattr(model, f"block{i}")
                 q, k, v = _qkv_heads(blk.attn, blk.ln1(x))  # (1,Cpad,H,D)
-                new_k[i] = write_token_kv(new_k[i], k[0], tok_pages,
-                                          tok_off)
-                new_v[i] = write_token_kv(new_v[i], v[0], tok_pages,
-                                          tok_off)
-                out = self._prefill_attn(q[0].astype(new_k[i].dtype),
+                if spec is None:
+                    new_k[i] = write_token_kv(new_k[i], k[0], tok_pages,
+                                              tok_off)
+                    new_v[i] = write_token_kv(new_v[i], v[0], tok_pages,
+                                              tok_off)
+                    ks = vs = None
+                    adt = new_k[i].dtype
+                else:
+                    new_k[i], new_ka[i] = write_token_kv_q(
+                        new_k[i], new_ka[i], k[0], tok_pages, tok_off,
+                        spec)
+                    new_v[i], new_va[i] = write_token_kv_q(
+                        new_v[i], new_va[i], v[0], tok_pages, tok_off,
+                        spec)
+                    ks = page_scales(new_ka[i], spec)
+                    vs = page_scales(new_va[i], spec)
+                    adt = self._dtype
+                out = self._prefill_attn(q[0].astype(adt),
                                          new_k[i], new_v[i], page_row,
-                                         start, n_real)
+                                         start, n_real, ks, vs)
                 x = x + blk.attn.proj(NDArray(out.astype(q.dtype).reshape(
                     1, Cpad, model._units)))
                 x = x + _mlp(blk, x)
@@ -829,7 +935,8 @@ class InferenceEngine:
         if self.guard_nonfinite:             # sign-encoded, see decode
             tok = jnp.where(jnp.any(~jnp.isfinite(logits)),
                             -tok - 1, tok)
-        return tuple(new_k), tuple(new_v), tok
+        return tuple(new_k), tuple(new_v), tuple(new_ka), \
+            tuple(new_va), tok
 
     def _copy_page_fn(self, kpools, vpools, src, dst):
         """COW boundary copy: duplicate one page's K/V across every
@@ -847,6 +954,38 @@ class InferenceEngine:
                                      donate_argnums=(0, 1))
         self._kpools, self._vpools = self._copy_jit(
             self._kpools, self._vpools, np.int32(src), np.int32(dst))
+        if self._kv_spec is not None:
+            # the scale is page metadata: a COW copy carries its
+            # source's scale (the codes were copied verbatim), and the
+            # suffix writes grow it from there
+            for a in self._kamax:
+                a[dst] = a[src]
+            for a in self._vamax:
+                a[dst] = a[src]
+
+    def _reset_page_amax(self, pages):
+        """Zero the scale metadata of freshly-allocated pages (host-
+        side np — the arrays are host-owned between program calls).
+        Pages are identity-free and never cleared on reuse; their
+        SCALE must be, or a recycled page would quantize its new
+        owner's rows against the previous owner's range (including a
+        quarantined slot's poisoned scale)."""
+        if self._kv_spec is None or not pages:
+            return
+        idx = np.asarray(list(pages), np.int64)
+        for a in self._kamax:
+            a[idx] = 0.0
+        for a in self._vamax:
+            a[idx] = 0.0
+
+    def _pull_amax(self, ka, va):
+        """Re-take host ownership of the scale metadata a program just
+        updated (``np.array`` — a mutable COPY, never a read-only view
+        of the device buffer; the host resets entries in place)."""
+        if self._kv_spec is None:
+            return
+        self._kamax = tuple(np.array(a, np.float32) for a in ka)
+        self._vamax = tuple(np.array(a, np.float32) for a in va)
 
     # ------------------------------------------------------------- #
     # host-side scheduler
@@ -994,6 +1133,22 @@ class InferenceEngine:
             "estimated_queue_delay_priority_s":
                 self._estimated_queue_delay(Tier.STANDARD),
             "free_pages": self._alloc.free_count,
+            # KV-pool capacity surface (docs/SERVING.md "Quantized KV
+            # cache"): the bytes the cache actually pins — scale
+            # metadata included — and the payload dtype, so a capacity
+            # dashboard can see the quantized working set. At a fixed
+            # HBM budget slots × context ≤ pool bytes, so kv_pool_bytes
+            # IS the serving-capacity denominator.
+            "kv_dtype": str(self._kpools[0].dtype),
+            "kv_quant": self.kv_quant or "off",
+            "kv_pool_bytes": int(
+                sum(k.nbytes + v.nbytes
+                    for k, v in zip(self._kpools, self._vpools)) +
+                sum(a.nbytes for a in self._kamax) +
+                sum(a.nbytes for a in self._vamax)),
+            "kv_quantized_pages": (
+                self.num_pages - 1 - self._alloc.free_count
+                if self._kv_spec is not None else 0),
             "decode_steps": self.decode_steps,
             "drafted_tokens": self.drafted_tokens,
             "accepted_tokens": self.accepted_tokens,
@@ -1431,6 +1586,7 @@ class InferenceEngine:
         self.withdraw(req)
         priv = [self._alloc.alloc()
                 for _ in range(prompt_pages - len(shared))]
+        self._reset_page_amax(priv)          # fresh pages, fresh scales
         row = np.zeros((self.max_pages,), np.int32)
         row[:len(shared)] = shared
         row[len(shared):prompt_pages] = priv
@@ -1494,10 +1650,11 @@ class InferenceEngine:
         if fn is None:
             fn = jax.jit(self._prefill_fn, donate_argnums=(1, 2))
             self._prefill_jits[bucket] = fn
-        self._kpools, self._vpools, tok = fn(
-            self._param_vals, self._kpools, self._vpools, ids,
-            np.int32(t0), pages_arr,
+        self._kpools, self._vpools, ka, va, tok = fn(
+            self._param_vals, self._kpools, self._vpools, self._kamax,
+            self._vamax, ids, np.int32(t0), pages_arr,
             np.float32(req.temperature), slot.key)
+        self._pull_amax(ka, va)
         slot.prefill_pos = t0
         tok = int(np.asarray(tok))
         if tok < 0:                          # sign-encoded guard flag
@@ -1526,10 +1683,11 @@ class InferenceEngine:
         if fn is None:
             fn = jax.jit(self._chunk_prefill_fn, donate_argnums=(1, 2))
             self._chunk_jits[bucket] = fn
-        self._kpools, self._vpools, tok = fn(
-            self._param_vals, self._kpools, self._vpools, ids,
-            np.int32(start), np.int32(n), slot.row.copy(),
-            np.float32(req.temperature), slot.key)
+        self._kpools, self._vpools, ka, va, tok = fn(
+            self._param_vals, self._kpools, self._vpools, self._kamax,
+            self._vamax, ids, np.int32(start), np.int32(n),
+            slot.row.copy(), np.float32(req.temperature), slot.key)
+        self._pull_amax(ka, va)
         slot.prefill_pos = start + n
         tok = int(np.asarray(tok))
         if tok < 0:                          # sign-encoded guard flag
@@ -1702,6 +1860,7 @@ class InferenceEngine:
                         starved = True
                     break
                 page = self._alloc.alloc()
+                self._reset_page_amax((page,))   # fresh page, fresh scale
                 self._page_table[s, pi] = page
                 slot.row[pi] = page
                 slot.refs.append(page)
@@ -1765,12 +1924,14 @@ class InferenceEngine:
             lengths_dev[s] = 0
             table_dev[s, :] = NULL_PAGE
         t_start = time.perf_counter()
-        self._kpools, self._vpools, emitted, n_emit, lengths = \
+        self._kpools, self._vpools, ka, va, emitted, n_emit, lengths = \
             self._decode_step(self._param_vals, self._kpools,
-                              self._vpools, tokens, draft_len,
+                              self._vpools, self._kamax, self._vamax,
+                              tokens, draft_len,
                               table_dev, lengths_dev,
                               self._temps.copy(),
                               self._slot_keys.copy())
+        self._pull_amax(ka, va)
         emitted = np.asarray(emitted)        # host sync point
         n_emit = np.asarray(n_emit)
         new_lengths = np.asarray(lengths).copy()

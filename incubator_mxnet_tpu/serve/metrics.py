@@ -47,6 +47,11 @@ _ENGINE_GAUGES = [
      "estimated_queue_delay_priority_seconds", "gauge"),
     ("accept_rate", "accept_rate", "gauge"),
     ("brownout_level", "brownout_level", "gauge"),
+    # KV-pool capacity (quantized serving, docs/SERVING.md): the bytes
+    # the cache pins (scale metadata included) and how many live pages
+    # hold quantized payload — the doubled-working-set dashboard
+    ("kv_pool_bytes", "kv_pool_bytes", "gauge"),
+    ("kv_quantized_pages", "kv_quantized_pages", "gauge"),
 ]
 _ENGINE_COUNTERS = [
     ("decode_steps", "decode_steps_total"),
@@ -130,6 +135,12 @@ def _emit_engine(w: _Writer, snap: dict, ns: str = _NS,
                  extra: Optional[dict] = None):
     extra = extra or {}
     _emit_outcomes(w, snap, ns, extra)
+    if "kv_dtype" in snap:
+        # info-style gauge: the payload dtype and quant mode ride as
+        # labels (strings cannot be sample values), value constant 1
+        w.add(f"{ns}_kv_pool_info", "gauge", 1,
+              _labels(dtype=snap["kv_dtype"],
+                      quant=snap.get("kv_quant", "off"), **extra))
     for key, suffix, mtype in _ENGINE_GAUGES:
         if key in snap:
             w.add(f"{ns}_{suffix}", mtype, snap[key],

@@ -43,11 +43,144 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..base import MXNetError
+from ..ops.quantization import (quantize_symmetric, requantize_symmetric,
+                                symmetric_scale)
 
 NULL_PAGE = 0
 
 __all__ = ["NULL_PAGE", "PageAllocator", "PrefixIndex", "init_kv_pools",
-           "write_token_kv", "write_prompt_kv", "write_block_kv"]
+           "write_token_kv", "write_prompt_kv", "write_block_kv",
+           "KVQuantSpec", "kv_quant_spec", "page_scales",
+           "write_token_kv_q", "write_prompt_kv_q", "write_block_kv_q"]
+
+
+# --------------------------------------------------------------------- #
+# quantized pool layout (int8 / fp8 payload + per-page symmetric scale)
+#
+# A quantized pool keeps the SAME (num_pages, H, page_size, D) page
+# layout with a narrow payload dtype, plus ONE float32 absolute-max
+# statistic per page per pool (``amax``, shape (num_pages,)) from which
+# the page's symmetric dequantization scale derives
+# (ops.quantization.symmetric_scale: amax / qmax, 1.0 on an untouched
+# page). The amax array is PAGE METADATA: it rides next to the page
+# table as data into every program that reads or writes pages (and on
+# TPU down the same scalar-prefetch path — ops/ragged_attention.py), a
+# shared prefix page's scale is shared exactly like the page itself,
+# and the host resets a page's amax when the allocator hands it out
+# (pages are identity-free; a recycled page must not inherit its
+# previous owner's range).
+#
+# Incremental writes and the monotone-scale contract: decode and
+# chunked prefill fill a page a few rows at a time, so a page's scale
+# can only GROW (amax is scatter-max'd). When a write raises a page's
+# amax, the page's existing codes are REQUANTIZED in place by
+# ``old_scale / new_scale <= 1`` (ops.quantization.requantize_symmetric
+# — a pure code rescale, never a dequant round trip), then the new rows
+# are quantized at the new scale. Each rescale adds at most half a
+# quantum of error to already-written rows; scales stabilize after the
+# first few writes in practice (measured in BENCH_QUANT.json).
+# --------------------------------------------------------------------- #
+
+@dataclasses.dataclass(frozen=True)
+class KVQuantSpec:
+    """One quantized-KV flavour: the pool payload dtype and its
+    saturation bound (int8: ±127; fp8_e4m3: ±448)."""
+    name: str
+    dtype: object
+    qmax: float
+
+
+def kv_quant_spec(kv_quant) -> Optional[KVQuantSpec]:
+    """Resolve an engine's ``kv_quant`` knob: None/'none' → None
+    (unquantized f32/bf16 pools), 'int8' → int8 payload (the portable
+    default — the MXU int8 path on TPU, exact small-int arithmetic on
+    CPU), 'fp8_e4m3' → float8 payload (TPU-targeted; needs a jax with
+    float8 dtypes)."""
+    if kv_quant is None or kv_quant == "none":
+        return None
+    if isinstance(kv_quant, KVQuantSpec):
+        return kv_quant
+    if kv_quant == "int8":
+        return KVQuantSpec("int8", jnp.int8, 127.0)
+    if kv_quant == "fp8_e4m3":
+        if not hasattr(jnp, "float8_e4m3fn"):
+            raise MXNetError("kv_quant='fp8_e4m3' needs a jax build "
+                             "with float8 dtypes")
+        return KVQuantSpec("fp8_e4m3", jnp.float8_e4m3fn, 448.0)
+    raise MXNetError(f"kv_quant must be None|'int8'|'fp8_e4m3', got "
+                     f"{kv_quant!r}")
+
+
+def page_scales(amax, spec: KVQuantSpec):
+    """(P,) per-page dequantization scales from the amax metadata."""
+    return symmetric_scale(amax, spec.qmax)
+
+
+def write_token_kv_q(pool, amax, new, pages, offsets, spec: KVQuantSpec):
+    """Quantized twin of ``write_token_kv``: scatter one K (or V) row
+    per entry into an int8/fp8 pool, growing the per-page scales.
+
+    pool: (P, H, ps, D) codes; amax: (P,) f32; new: (N, H, D) float;
+    pages/offsets: (N,) int32. Returns ``(pool, amax)`` updated.
+
+    Three phases, all safe under duplicate page indices (several rows
+    of one call landing in the same page — the verify window's block
+    write flattens into this):
+      1. scatter-max the new rows' |max| into ``amax`` (duplicates
+         combine correctly by construction);
+      2. requantize every TOUCHED page's existing codes by
+         ``old_scale / new_scale`` — duplicate entries compute
+         IDENTICAL page contents (same gathered codes, same final
+         scale), so the unspecified scatter order cannot diverge;
+      3. quantize the new rows at the final scale and scatter them at
+         their (page, offset) cells — distinct cells except dead
+         entries, which all land in the null page (garbage by design,
+         same contract as the unquantized write)."""
+    H = pool.shape[1]
+    a_n = jnp.max(jnp.abs(new.astype(jnp.float32)), axis=(1, 2))  # (N,)
+    new_amax = amax.at[pages].max(a_n)
+    old_s = symmetric_scale(amax, spec.qmax)
+    new_s = symmetric_scale(new_amax, spec.qmax)
+    ratio = (old_s / new_s)[pages]                       # (N,) <= 1
+    touched = requantize_symmetric(
+        pool[pages], ratio[:, None, None, None], spec.dtype, spec.qmax)
+    pool = pool.at[pages].set(touched)
+    q = quantize_symmetric(new, new_s[pages][:, None, None],
+                           spec.dtype, spec.qmax)        # (N, H, D)
+    pool = pool.at[pages[:, None], jnp.arange(H)[None, :],
+                   offsets[:, None], :].set(q)
+    return pool, new_amax
+
+
+def write_block_kv_q(pool, amax, new, pages, offsets, spec: KVQuantSpec):
+    """Quantized twin of ``write_block_kv``: a (S, W) block of rows
+    (the speculative verify window) flattened into the per-row
+    quantized scatter — duplicate pages inside one slot's window are
+    exactly the case ``write_token_kv_q``'s phases are built for."""
+    S, W, H, D = new.shape
+    return write_token_kv_q(pool, amax, new.reshape(S * W, H, D),
+                            pages.reshape(S * W),
+                            offsets.reshape(S * W), spec)
+
+
+def write_prompt_kv_q(pool, amax, kv, pages, spec: KVQuantSpec):
+    """Quantized twin of ``write_prompt_kv``: scatter a whole prompt's
+    K (or V) into its pages with a FRESH per-page scale (each page's
+    amax is overwritten, not grown — prefill is the page's first write,
+    so a recycled page's stale range dies here). Dead entries all index
+    the null page; whichever dead page's amax wins the duplicate
+    scatter is garbage by design, like the payload."""
+    n_pages = pages.shape[0]
+    ps = pool.shape[2]
+    paged = kv.astype(jnp.float32).reshape(n_pages, ps, kv.shape[1],
+                                           kv.shape[2])
+    a_p = jnp.max(jnp.abs(paged), axis=(1, 2, 3))        # (n_pages,)
+    amax = amax.at[pages].set(a_p)
+    s = symmetric_scale(a_p, spec.qmax)
+    q = quantize_symmetric(paged, s[:, None, None, None],
+                           spec.dtype, spec.qmax)
+    q = q.transpose(0, 2, 1, 3)                 # (n_pages, H, ps, D)
+    return pool.at[pages].set(q), amax
 
 
 class PageAllocator:
@@ -349,9 +482,12 @@ class PrefixIndex:
 
 
 def init_kv_pools(num_layers, num_pages, num_heads, page_size, head_dim,
-                  dtype="float32"):
-    """Fresh zeroed (k_pool, v_pool) pairs, one per layer."""
-    dt = jnp.dtype(dtype)
+                  dtype="float32", quant: Optional[KVQuantSpec] = None):
+    """Fresh zeroed (k_pool, v_pool) pairs, one per layer. With a
+    ``quant`` spec the payload dtype is the spec's narrow dtype (the
+    per-page amax metadata is the ENGINE's to own — host-resettable
+    page metadata, not pool state)."""
+    dt = jnp.dtype(quant.dtype) if quant is not None else jnp.dtype(dtype)
     mk = lambda: jnp.zeros((num_pages, num_heads, page_size, head_dim), dt)
     return [(mk(), mk()) for _ in range(num_layers)]
 

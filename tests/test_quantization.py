@@ -148,3 +148,104 @@ def test_quantized_export_gated():
     import incubator_mxnet_tpu as mx2
     with pytest.raises(mx2.base.MXNetError):
         qnet(mx2.sym.Variable("data"))
+
+
+# ------------------------------------------------------------------- #
+# the shared symmetric-quantizer codepath (ops/quantization.py) — the
+# ONE audited quantize/dequantize the legacy ops above and the serving
+# tier's quantized KV pages (serve/paged_kv.py) both ride
+# ------------------------------------------------------------------- #
+
+def test_symmetric_roundtrip_error_bound():
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.ops.quantization import (
+        dequantize_symmetric, quantize_symmetric, symmetric_scale)
+    rng = np.random.RandomState(7)
+    x = rng.randn(16, 4, 8).astype(np.float32) * 5
+    scale = symmetric_scale(jnp.max(jnp.abs(jnp.asarray(x))))
+    q = quantize_symmetric(jnp.asarray(x), scale)
+    assert str(q.dtype) == "int8"
+    back = np.asarray(dequantize_symmetric(q, scale))
+    # round-to-nearest: error <= half a quantum
+    assert np.abs(back - x).max() <= float(scale) / 2 + 1e-7
+
+
+def test_symmetric_zero_range_page():
+    """An all-zero page (fresh/reset amax) must roundtrip to exact
+    zeros through the zero-range scale convention (scale = 1), never
+    divide by zero or emit NaN."""
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.ops.quantization import (
+        dequantize_symmetric, quantize_symmetric, symmetric_scale)
+    scale = symmetric_scale(jnp.zeros((3,)))
+    np.testing.assert_array_equal(np.asarray(scale), np.ones(3))
+    q = quantize_symmetric(jnp.zeros((3, 8)), scale[:, None])
+    back = np.asarray(dequantize_symmetric(q, scale[:, None]))
+    np.testing.assert_array_equal(back, np.zeros((3, 8)))
+
+
+def test_symmetric_bf16_input():
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.ops.quantization import (
+        dequantize_symmetric, quantize_symmetric, symmetric_scale)
+    rng = np.random.RandomState(8)
+    x32 = rng.randn(64).astype(np.float32)
+    x = jnp.asarray(x32).astype(jnp.bfloat16)
+    scale = symmetric_scale(jnp.max(jnp.abs(x)))
+    q = quantize_symmetric(x, scale)
+    back = np.asarray(dequantize_symmetric(q, scale))
+    # quantum/2 plus the bf16 representation error of the input itself
+    bound = float(scale) / 2 + np.abs(
+        np.asarray(x, np.float32) - x32).max() + 1e-6
+    assert np.abs(back - np.asarray(x, np.float32)).max() <= bound
+
+
+def test_symmetric_scale_propagates_nonfinite():
+    """A poisoned amax must poison the scale (the serving guard's
+    corruption channel), NOT fall into the benign zero-range branch —
+    the `amax > 0` form silently mapped NaN to scale 1.0."""
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.ops.quantization import symmetric_scale
+    s = np.asarray(symmetric_scale(
+        jnp.asarray([np.nan, np.inf, 0.0, 2.54])))
+    assert np.isnan(s[0])
+    assert np.isposinf(s[1])
+    assert s[2] == 1.0
+    np.testing.assert_allclose(s[3], 2.54 / 127.0, rtol=1e-6)
+
+
+def test_requantize_symmetric_monotone_scale_growth():
+    """The KV page write path's in-place code rescale: growing the
+    scale by ratio <= 1 keeps previously-written rows within one NEW
+    quantum of their values (no dequant round trip needed)."""
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.ops.quantization import (
+        dequantize_symmetric, quantize_symmetric, requantize_symmetric,
+        symmetric_scale)
+    rng = np.random.RandomState(9)
+    x = rng.randn(32).astype(np.float32)
+    s_old = symmetric_scale(jnp.max(jnp.abs(jnp.asarray(x))))
+    q = quantize_symmetric(jnp.asarray(x), s_old)
+    s_new = s_old * 4.0                  # a 4x larger row arrived
+    q2 = requantize_symmetric(q, s_old / s_new)
+    back = np.asarray(dequantize_symmetric(q2, s_new))
+    assert np.abs(back - x).max() <= float(s_new) / 2 + float(s_old) / 2
+
+
+def test_symmetric_fp8_roundtrip_if_available():
+    """The fp8_e4m3 KV flavour rides the same codepath (cast instead
+    of round, ±448 saturation) — covered where the jax build has
+    float8 dtypes, skipped otherwise."""
+    import jax.numpy as jnp
+    if not hasattr(jnp, "float8_e4m3fn"):
+        pytest.skip("no float8 dtypes in this jax")
+    from incubator_mxnet_tpu.ops.quantization import (
+        dequantize_symmetric, quantize_symmetric, symmetric_scale)
+    rng = np.random.RandomState(10)
+    x = rng.randn(128).astype(np.float32)
+    scale = symmetric_scale(jnp.max(jnp.abs(jnp.asarray(x))), qmax=448.0)
+    q = quantize_symmetric(jnp.asarray(x), scale,
+                           dtype=jnp.float8_e4m3fn, qmax=448.0)
+    back = np.asarray(dequantize_symmetric(q, scale))
+    # fp8 e4m3: ~3 mantissa bits → relative error ~2^-4 of each value
+    assert np.abs(back - x).max() <= np.abs(x).max() * 0.0725 + 1e-6

@@ -683,3 +683,140 @@ def test_kernel_page_table_permutation_invariance():
             q, jnp.asarray(kp), jnp.asarray(vp), pt,
             jnp.asarray([12], np.int32), D ** -0.5, True)))
     np.testing.assert_array_equal(outs[0], outs[1])
+
+
+# ------------------------------------------------------------------- #
+# quantized pools: int8 pages + per-page scales, dequant at the DMA
+# boundary (serve/paged_kv.py quantized layout; the f32 jnp reference
+# is the accuracy ORACLE — bit-parity is replaced by a measured
+# tolerance bounded by the pages' quantization quanta)
+# ------------------------------------------------------------------- #
+
+def _quantize_pools(k_pool, v_pool):
+    """Quantize whole f32 pools page-by-page through the serving write
+    path (fresh per-page scales), returning int8 pools + scale arrays."""
+    from incubator_mxnet_tpu.serve.paged_kv import (kv_quant_spec,
+                                                    page_scales,
+                                                    write_prompt_kv_q)
+    spec = kv_quant_spec("int8")
+    P, H, ps, D = k_pool.shape
+    pages = jnp.arange(P, dtype=jnp.int32)
+    rows_k = jnp.moveaxis(jnp.asarray(k_pool), 1, 2).reshape(P * ps, H, D)
+    rows_v = jnp.moveaxis(jnp.asarray(v_pool), 1, 2).reshape(P * ps, H, D)
+    kq = jnp.zeros((P, H, ps, D), spec.dtype)
+    vq = jnp.zeros((P, H, ps, D), spec.dtype)
+    kq, kam = write_prompt_kv_q(kq, jnp.zeros((P,)), rows_k, pages, spec)
+    vq, vam = write_prompt_kv_q(vq, jnp.zeros((P,)), rows_v, pages, spec)
+    return kq, vq, page_scales(kam, spec), page_scales(vam, spec), spec
+
+
+def _quant_tol(k_pool, v_pool):
+    """A loose end-to-end bound: attention output error is dominated by
+    the V quantum (output is a convex combination of V rows) plus a
+    softmax-reweighting term from the K quantum."""
+    qk = np.abs(np.asarray(k_pool)).max() / 127.0
+    qv = np.abs(np.asarray(v_pool)).max() / 127.0
+    return 4.0 * (qk + qv)
+
+
+@pytest.mark.parametrize("lengths", [[0, 1, 8, 9, 32], [7, 8, 9, 15, 16]])
+def test_quantized_decode_matches_f32_oracle(lengths):
+    rng = np.random.RandomState(31)
+    q, k_pool, v_pool, pt, ln = _make_case(rng, 5, 2, 8, 8, 4, lengths)
+    kq, vq, ks, vs, _ = _quantize_pools(k_pool, v_pool)
+    oracle = np.asarray(ragged_attention_reference(q, k_pool, v_pool,
+                                                   pt, ln))
+    got = np.asarray(ragged_attention_reference(q, kq, vq, pt, ln,
+                                                k_scale=ks, v_scale=vs))
+    assert np.abs(got - oracle).max() <= _quant_tol(k_pool, v_pool)
+    # the masked-row contract survives quantization: length-0 slots
+    # emit exactly zero
+    for s, l in enumerate(lengths):
+        if l == 0:
+            np.testing.assert_array_equal(got[s], 0.0)
+
+
+def test_quantized_decode_pallas_interpret_matches_reference():
+    """The kernel's inline scalar-prefetch dequant must agree with the
+    jnp gather-dequant reference to float rounding — the same
+    cross-backend contract as the unquantized kernel, at the quantized
+    operand dtypes."""
+    from incubator_mxnet_tpu.ops.ragged_attention import _ragged_pallas_q
+    rng = np.random.RandomState(32)
+    q, k_pool, v_pool, pt, ln = _make_case(rng, 4, 2, 8, 8, 4,
+                                           [0, 5, 16, 27])
+    kq, vq, ks, vs, _ = _quantize_pools(k_pool, v_pool)
+    ref = np.asarray(ragged_attention_reference(q, kq, vq, pt, ln,
+                                                k_scale=ks, v_scale=vs))
+    got = np.asarray(_ragged_pallas_q(q, kq, vq, pt, ln, ks, vs,
+                                      8 ** -0.5, True))
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_quantized_prefill_matches_f32_oracle_and_kernel():
+    from incubator_mxnet_tpu.ops.ragged_attention import \
+        _ragged_prefill_pallas_q
+    rng = np.random.RandomState(33)
+    _, k_pool, v_pool, pt, _ = _make_case(rng, 1, 2, 8, 8, 4, [32])
+    kq, vq, ks, vs, _ = _quantize_pools(k_pool, v_pool)
+    C = 8
+    qc = jnp.asarray(rng.randn(C, 2, 8).astype(np.float32))
+    row = pt[0]
+    oracle = np.asarray(ragged_prefill_reference(
+        qc, k_pool, v_pool, row, jnp.int32(16), n_real=6))
+    got = np.asarray(ragged_prefill_reference(
+        qc, kq, vq, row, jnp.int32(16), n_real=6, k_scale=ks,
+        v_scale=vs))
+    assert np.abs(got[:6] - oracle[:6]).max() <= \
+        _quant_tol(k_pool, v_pool)
+    kern = np.asarray(_ragged_prefill_pallas_q(
+        qc, kq, vq, row, jnp.asarray([16, 6], dtype=jnp.int32), ks, vs,
+        8 ** -0.5, True))
+    np.testing.assert_allclose(kern[:6], got[:6], rtol=2e-5, atol=2e-5)
+
+
+def test_quantized_verify_matches_f32_oracle_and_kernel():
+    from incubator_mxnet_tpu.ops.ragged_attention import \
+        _ragged_verify_pallas_q
+    rng = np.random.RandomState(34)
+    _, k_pool, v_pool, pt, _ = _make_case(rng, 3, 2, 8, 8, 4,
+                                          [5, 17, 0])
+    kq, vq, ks, vs, _ = _quantize_pools(k_pool, v_pool)
+    W = 3
+    qv = jnp.asarray(rng.randn(3, W, 2, 8).astype(np.float32))
+    ln = jnp.asarray(np.array([3, 9, 0], np.int32))
+    dl = jnp.asarray(np.array([2, 2, 0], np.int32))
+    oracle = np.asarray(ragged_verify_reference(qv, k_pool, v_pool,
+                                                pt, ln))
+    got = np.asarray(ragged_verify_reference(qv, kq, vq, pt, ln,
+                                             k_scale=ks, v_scale=vs))
+    assert np.abs(got - oracle).max() <= _quant_tol(k_pool, v_pool)
+    np.testing.assert_array_equal(got[2], 0.0)    # dead slot stays zero
+    kern = np.asarray(_ragged_verify_pallas_q(qv, kq, vq, pt, ln, dl,
+                                              ks, vs, 8 ** -0.5, True))
+    # consumed rows (<= dl) must match; later rows are contractually
+    # discarded by the engine
+    for s in range(3):
+        d = int(np.asarray(dl)[s])
+        np.testing.assert_allclose(kern[s, :d + 1], got[s, :d + 1],
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_poisoned_page_scale_propagates_and_isolates():
+    """int8 payloads cannot carry NaN — the page SCALE is the
+    corruption channel: a NaN scale on one live page must make exactly
+    the slots reading that page non-finite (so the serving guard can
+    quarantine them) while every other slot stays bit-identical."""
+    rng = np.random.RandomState(35)
+    q, k_pool, v_pool, pt, ln = _make_case(rng, 3, 2, 8, 8, 4,
+                                           [16, 16, 8])
+    kq, vq, ks, vs, _ = _quantize_pools(k_pool, v_pool)
+    clean = np.asarray(ragged_attention_reference(
+        q, kq, vq, pt, ln, k_scale=ks, v_scale=vs))
+    page = int(np.asarray(pt)[0, 0])              # slot 0's first page
+    ks_bad = ks.at[page].set(jnp.nan)
+    got = np.asarray(ragged_attention_reference(
+        q, kq, vq, pt, ln, k_scale=ks_bad, v_scale=vs))
+    assert np.isnan(got[0]).all()                 # poisoned slot visible
+    np.testing.assert_array_equal(got[1], clean[1])
+    np.testing.assert_array_equal(got[2], clean[2])

@@ -705,6 +705,50 @@ def test_metrics_engine_golden(model):
     # per-tier series carry both labels
     for labels in by["mxtpu_serve_tier_requests_total"]:
         assert "tier=" in labels and "outcome=" in labels
+    # KV-pool capacity surface (quantized serving): bytes + page
+    # gauges parse back to the snapshot, and the info gauge carries
+    # the payload dtype/quant mode as labels
+    assert by["mxtpu_serve_kv_pool_bytes"][""] == snap["kv_pool_bytes"]
+    assert snap["kv_pool_bytes"] > 0
+    assert by["mxtpu_serve_kv_quantized_pages"][""] == \
+        snap["kv_quantized_pages"] == 0          # unquantized engine
+    (info_labels, info_v), = by["mxtpu_serve_kv_pool_info"].items()
+    assert info_v == 1.0
+    assert 'dtype="float32"' in info_labels
+    assert 'quant="off"' in info_labels
+
+
+def test_metrics_engine_golden_quantized(model):
+    """The int8 arm of the capacity surface: the info gauge flips its
+    labels, live pages count as quantized pages, and the pool-bytes
+    gauge shrinks ~4x against the f32 twin at identical geometry."""
+    rng = np.random.RandomState(16)
+    engines = {q: InferenceEngine(model, num_slots=2, page_size=8,
+                                  max_len=64, kv_quant=q)
+               for q in (None, "int8")}
+    snaps = {}
+    for q, eng in engines.items():
+        reqs = [Request(_prompt(rng, 5), max_new_tokens=3)
+                for _ in range(2)]
+        for r in reqs:
+            eng.submit(r)
+        _drain(eng, reqs)
+        snaps[q] = eng.health_snapshot()
+    snap = snaps["int8"]
+    typed, samples = _golden_parse(render_metrics(snap))
+    by = {}
+    for name, labels, v in samples:
+        by.setdefault(name, {})[labels] = v
+    assert by["mxtpu_serve_kv_pool_bytes"][""] == snap["kv_pool_bytes"]
+    assert by["mxtpu_serve_kv_quantized_pages"][""] == \
+        snap["kv_quantized_pages"]
+    # the prefix index retains the prompts' full pages → live pages
+    assert snap["kv_quantized_pages"] == \
+        engines["int8"].num_pages - 1 - snap["free_pages"]
+    (info_labels, info_v), = by["mxtpu_serve_kv_pool_info"].items()
+    assert 'dtype="int8"' in info_labels and 'quant="int8"' in info_labels
+    ratio = snaps[None]["kv_pool_bytes"] / snap["kv_pool_bytes"]
+    assert ratio > 3.0                   # f32 → int8 + scale metadata
 
 
 def test_metrics_router_golden(model):

@@ -26,9 +26,14 @@ fresh engine with one deterministic fault injected
 
 Scenarios: nan_weights, corrupt_page (NaN), dropped_write (zeroed
 page — undetectable by the guard, isolation still asserted),
-starvation_transient, starvation_full, overload_shed, deadline_storm,
-sigterm (subprocess: cooperative SIGTERM drain + final weight
-snapshot + every request terminal).
+corrupt_page_scale / corrupt_page_scale_zero (quantized int8 engine:
+a live SHARED page's per-page scale torn to NaN — quarantine must
+fire, nothing from the poisoned step recorded, the prefix index
+flushed — or zeroed: finite metadata garbage, isolation asserted
+against a fault-free QUANTIZED baseline), starvation_transient,
+starvation_full, overload_shed, deadline_storm, sigterm (subprocess:
+cooperative SIGTERM drain + final weight snapshot + every request
+terminal).
 
 ``--fleet`` switches to the FLEET scenarios (serve/router.py,
 ci/run.sh ``fleetsmoke`` stage): the same workload against a Router
@@ -316,6 +321,79 @@ def run_scenarios(n_requests, errors):
         errors.append("dropped_write: injector never fired")
     stats["log"] = inj.log
     results["dropped_write"] = stats
+
+    # ---- corrupt SCALE on a live shared quantized page ------------- #
+    # the quantized pool's own corruption channel: int8 payloads can't
+    # carry NaN, so the poisoned SCALE is what quarantine must catch.
+    # The parity oracle is a fault-free QUANTIZED run (quantization is
+    # a numerics change, so the f32 baseline is the wrong oracle).
+    from incubator_mxnet_tpu.serve.chaos import CorruptPageScale
+    model = _build_model()
+    eng = _engine(model, kv_quant="int8")
+    reqs = _make_requests(n_requests, vocab, seed=42)
+    run_chaos(eng, reqs, [], audit_every_step=True)
+    qbaseline = [list(r.token_ids) for r in reqs]
+    qstats = _check_invariants("quant_baseline", eng, reqs, qbaseline,
+                               set(), errors, allow_non_ok=False)
+    if not all(r.outcome is not None and r.outcome.ok for r in reqs):
+        errors.append("quant_baseline: not every request succeeded on "
+                      "the fault-free int8 engine")
+    results["quant_baseline"] = qstats
+
+    model = _build_model()
+    eng = _engine(model, kv_quant="int8")
+    reqs = _make_requests(n_requests, vocab, seed=42)
+    inj = CorruptPageScale(at_step=6, mode="nan", shared=True, seed=3)
+    run_chaos(eng, reqs, [inj], audit_every_step=True)
+    # allow_non_ok: a request ADMITTED onto the still-cached poisoned
+    # page before quarantine flushes the index legitimately fails its
+    # prefill guard without having been markable at fire time — it
+    # must still quarantine cleanly, never emit garbage
+    stats = _check_invariants("corrupt_page_scale", eng, reqs,
+                              qbaseline, inj.affected, errors)
+    if not inj.fired:
+        errors.append("corrupt_page_scale: injector never fired")
+    if eng.quarantined == 0:
+        errors.append("corrupt_page_scale: poisoned scale was never "
+                      "quarantined — the guard missed the new "
+                      "corruption channel")
+    for r in inj.affected:
+        if r.outcome != Outcome.FAILED_NONFINITE:
+            errors.append(f"corrupt_page_scale: a request mapping the "
+                          f"poisoned page ended {r.outcome}, not "
+                          f"FAILED_NONFINITE")
+    # no garbage token: everything any quarantined request recorded
+    # predates the fault, so it must be a clean prefix of the
+    # fault-free quantized run
+    for r, base_tokens in zip(reqs, qbaseline):
+        if r.outcome == Outcome.FAILED_NONFINITE and \
+                list(r.token_ids) != base_tokens[:len(r.token_ids)]:
+            errors.append("corrupt_page_scale: a quarantined request "
+                          "recorded a token scored by the poisoned "
+                          "scale")
+    if eng.prefix_flushes == 0:
+        errors.append("corrupt_page_scale: quarantine never flushed "
+                      "the prefix index — the poisoned shared page "
+                      "would keep serving cache hits")
+    stats["log"] = inj.log
+    results["corrupt_page_scale"] = stats
+
+    # ---- zeroed scale (finite metadata corruption) ----------------- #
+    # the scale collapses to the zero-range convention: raw codes at
+    # the wrong magnitude — finite garbage the guard cannot see; the
+    # invariant is pure isolation + exact accounting
+    model = _build_model()
+    eng = _engine(model, kv_quant="int8")
+    reqs = _make_requests(n_requests, vocab, seed=42)
+    inj = CorruptPageScale(at_step=6, mode="zero", shared=True, seed=3)
+    run_chaos(eng, reqs, [inj], audit_every_step=True)
+    stats = _check_invariants("corrupt_page_scale_zero", eng, reqs,
+                              qbaseline, inj.affected, errors,
+                              allow_non_ok=False)
+    if not inj.fired:
+        errors.append("corrupt_page_scale_zero: injector never fired")
+    stats["log"] = inj.log
+    results["corrupt_page_scale_zero"] = stats
 
     # ---- transient allocator pressure ------------------------------ #
     model = _build_model()

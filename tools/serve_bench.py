@@ -1194,6 +1194,290 @@ def bench_tiered_overload(model, *, n_requests, slots, page_size,
     return result
 
 
+# --------------------------------------------------------------------- #
+# round-14: quantized KV-cache serving (--quant, banks BENCH_QUANT.json)
+# --------------------------------------------------------------------- #
+
+def _make_tap_engine_cls():
+    """An ``InferenceEngine`` whose decode/verify and prefill programs
+    stream their logits (plus the used-column operands the host needs
+    to mask dead entries) back via ``jax.debug.callback`` — pure
+    instrumentation INSIDE the existing programs: no new outputs, no
+    extra programs, trace counts still asserted at 1. Two tap engines
+    (f32 oracle vs int8) stepped over the same greedy workload stay
+    call-for-call aligned as long as their emitted tokens agree, which
+    is exactly the window where a logit-to-logit comparison is
+    meaningful."""
+    import jax
+    import numpy as np
+    from incubator_mxnet_tpu.serve import InferenceEngine
+
+    class _LogitTapEngine(InferenceEngine):
+        def __init__(self, *a, **kw):
+            self.tap_decode = []     # (logits (S,W,V), draft_len, act)
+            self.tap_prefill = []    # (V,) per prefill/chunk program
+            super().__init__(*a, **kw)
+
+        def _accept_emit(self, logits, tokens, draft_len, temps,
+                         slot_keys, pos, act):
+            jax.debug.callback(
+                lambda lg, dl, a: self.tap_decode.append(
+                    (np.array(lg), np.array(dl), np.array(a))),
+                logits, draft_len, act)
+            return super()._accept_emit(logits, tokens, draft_len,
+                                        temps, slot_keys, pos, act)
+
+        def _sample_one(self, logits, temp, pos_key):
+            if logits.ndim == 1:     # prefill/chunk head (V,)
+                jax.debug.callback(
+                    lambda lg: self.tap_prefill.append(np.array(lg)),
+                    logits)
+            return super()._sample_one(logits, temp, pos_key)
+
+    return _LogitTapEngine
+
+
+def _err_stats(diffs):
+    import numpy as np
+    if not diffs:
+        return {"n": 0, "max": 0.0, "p99": 0.0, "mean": 0.0}
+    d = np.concatenate([x.ravel() for x in diffs])
+    return {"n": int(d.size), "max": float(d.max()),
+            "p99": float(np.percentile(d, 99)),
+            "mean": float(d.mean())}
+
+
+def bench_quant_serving(model, *, smoke, slots, page_size, spec_k,
+                        personas, per_persona, prefix_len, suffix_len,
+                        max_new, errors):
+    """The quantized-KV accuracy + capacity bench: the SAME greedy
+    shared-prefix workload through an f32 engine (the oracle — its jnp
+    gather reference IS the accuracy denominator) and an int8 engine,
+    both logit-tapped. Banks:
+
+      - per-program logit error (max/p99/mean |Δ| over the used
+        columns) split decode / verify / prefill, compared only over
+        the aligned window (steps before any emitted-token
+        divergence — past one, contexts legitimately differ);
+      - greedy top-1 token match rate (the ≥99% gate);
+      - slots-at-fixed-pool-bytes ratio from the engines' own
+        kv_pool_bytes (scale metadata included; the ≥1.8x gate);
+      - tokens/s and speculative accept-rate deltas (informational on
+        a CPU host — the capacity claim is the bytes ratio, not CPU
+        wall-clock);
+      - compile discipline: decode, verify and every prefill bucket
+        exactly once in BOTH arms."""
+    import copy
+    import numpy as np
+    Tap = _make_tap_engine_cls()
+    vocab = model.vocab_size
+    reqs0, arrivals = _persona_requests(personas, per_persona,
+                                        prefix_len, suffix_len,
+                                        max_new, 200.0, vocab)
+    for i, r in enumerate(reqs0):
+        r.seed = 1000 + i            # pinned keys: greedy anyway, but
+                                     # keeps the arms bit-comparable
+    # narrow-program coverage: a request with max_new_tokens=2 has a
+    # zero draft budget after its prefill token (kmax = 0), so its
+    # decode step runs the W=1 program — both decode-family programs
+    # then compile exactly once per arm even on a workload where every
+    # main-phase step drafted
+    from incubator_mxnet_tpu.serve import Request
+    rng_n = np.random.RandomState(77)
+    narrow0 = [Request(rng_n.randint(0, vocab, size=(5,))
+                       .astype(np.int32), max_new_tokens=2,
+                       seed=9000 + i) for i in range(2)]
+    arms = {}
+    for name, kvq in (("f32", None), ("int8", "int8")):
+        eng = Tap(model, num_slots=slots, page_size=page_size,
+                  prefix_cache=True, chunk_pages=1, spec_k=spec_k,
+                  kv_quant=kvq)
+        reqs = copy.deepcopy(reqs0)
+        t0 = time.perf_counter()
+        eng.run(reqs)
+        wall = time.perf_counter() - t0
+        stats = _engine_stats(eng, reqs, wall)
+        narrow = copy.deepcopy(narrow0)
+        eng.run(narrow)              # untimed: narrow-program coverage
+        reqs = reqs + narrow
+        eng.audit_pages()
+        stats["verify_trace_count"] = eng.verify_trace_count
+        stats["accept_rate"] = eng.accept_rate
+        stats["kv_pool_bytes"] = eng.health_snapshot()["kv_pool_bytes"]
+        stats["kv_dtype"] = eng.health_snapshot()["kv_dtype"]
+        arms[name] = (eng, reqs, stats)
+        tag = f"quant_serving.{name}"
+        if eng.decode_trace_count != 1:
+            errors.append(f"{tag}: narrow decode compiled "
+                          f"{eng.decode_trace_count} times (must be 1)")
+        if spec_k > 0 and eng.verify_trace_count != 1:
+            errors.append(f"{tag}: wide verify compiled "
+                          f"{eng.verify_trace_count} times (must be 1)")
+        bad = {k: v for k, v in eng.prefill_trace_counts.items()
+               if v != 1}
+        if bad:
+            errors.append(f"{tag}: prefill buckets retraced: {bad}")
+
+    eng_f, reqs_f, stats_f = arms["f32"]
+    eng_q, reqs_q, stats_q = arms["int8"]
+
+    # greedy top-1 token match rate (EOS off → equal lengths)
+    total = match = 0
+    for rf, rq in zip(reqs_f, reqs_q):
+        for a, b in zip(rf.token_ids, rq.token_ids):
+            total += 1
+            match += int(a == b)
+    match_rate = match / max(total, 1)
+
+    # per-program logit error over the aligned step window
+    dec_d, ver_d = [], []
+    aligned = 0
+    for (lf, dlf, af), (lq, dlq, aq) in zip(eng_f.tap_decode,
+                                            eng_q.tap_decode):
+        if lf.shape != lq.shape or not (np.array_equal(dlf, dlq)
+                                        and np.array_equal(af, aq)):
+            break
+        S, W, V = lf.shape
+        used = af[:, None] & (np.arange(W)[None, :] <= dlf[:, None])
+        d = np.abs(lf.astype(np.float64) - lq.astype(np.float64))[used]
+        (dec_d if W == 1 else ver_d).append(d)
+        aligned += 1
+    pre_d = [np.abs(a.astype(np.float64) - b.astype(np.float64))
+             for a, b in zip(eng_f.tap_prefill, eng_q.tap_prefill)
+             if a.shape == b.shape]
+    logit_scale = float(np.std(np.concatenate(
+        [x[0].ravel() for x in eng_f.tap_decode[:8]]))) \
+        if eng_f.tap_decode else 0.0     # aligned==0 reports below
+
+    out = {
+        "config": {"slots": slots, "page_size": page_size,
+                   "spec_k": spec_k, "personas": personas,
+                   "per_persona": per_persona,
+                   "prefix_len": prefix_len, "suffix_len": suffix_len,
+                   "max_new": max_new, "smoke": smoke},
+        "f32": stats_f,
+        "int8": stats_q,
+        "token_match_rate": match_rate,
+        "token_positions_compared": total,
+        "aligned_decode_steps": aligned,
+        "logit_err_decode": _err_stats(dec_d),
+        "logit_err_verify": _err_stats(ver_d),
+        "logit_err_prefill": _err_stats(pre_d),
+        "f32_logit_std": logit_scale,
+        "tokens_per_s_ratio": (stats_q["tokens_per_s"] /
+                               stats_f["tokens_per_s"]),
+        "accept_rate_delta": (stats_q["accept_rate"] -
+                              stats_f["accept_rate"]),
+        "kv_pool_bytes_f32": stats_f["kv_pool_bytes"],
+        "kv_pool_bytes_int8": stats_q["kv_pool_bytes"],
+        # slots × context ≤ pool bytes: at a fixed byte budget the
+        # admissible slot count scales inversely with bytes/page, so
+        # the pool-bytes ratio IS the slots-at-fixed-pool-bytes ratio
+        # (identical geometry: same num_pages, page_size, layers)
+        "slots_at_fixed_pool_bytes_ratio": (
+            stats_f["kv_pool_bytes"] / stats_q["kv_pool_bytes"]),
+    }
+    if match_rate < 0.99:
+        errors.append(f"quant_serving: greedy top-1 match rate "
+                      f"{match_rate:.4f} below the 0.99 gate")
+    if out["slots_at_fixed_pool_bytes_ratio"] < 1.8:
+        errors.append(f"quant_serving: slots-at-fixed-pool-bytes "
+                      f"{out['slots_at_fixed_pool_bytes_ratio']:.2f}x "
+                      f"below the 1.8x gate")
+    if aligned == 0:
+        errors.append("quant_serving: zero aligned decode steps — "
+                      "the logit comparison never ran")
+    for tag, st in (("decode", out["logit_err_decode"]),
+                    ("verify", out["logit_err_verify"]),
+                    ("prefill", out["logit_err_prefill"])):
+        if st["n"] and st["p99"] > 0.5:
+            errors.append(f"quant_serving: {tag} p99 logit error "
+                          f"{st['p99']:.3f} over the 0.5 accuracy "
+                          f"gate (f32 logit std "
+                          f"{logit_scale:.3f})")
+    return out
+
+
+def bench_int8_allreduce(*, smoke, errors):
+    """The EQuARX-seam convergence bench: the example target's
+    pretraining loop (gpt_mini on the synthetic next-token stream of
+    examples/gpt_pretrain.py) run twice through the gluon Trainer's
+    bucketed pushpull — f32 vs the opt-in int8-compressed mode — and
+    the loss curves banked side by side. The claim is NOT a speedup
+    (on one CPU process the allreduce is identity; the win arrives
+    where a real compressed collective backs the wire): it is that
+    the quantize→allreduce→dequantize roundtrip leaves convergence
+    intact, with the divergence REPORTED, not hidden."""
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, nd
+    from incubator_mxnet_tpu.gluon import Trainer
+    from incubator_mxnet_tpu.models import gpt as gpt_mod
+
+    steps = 25 if smoke else 120
+    B, T = 8, 32
+
+    def run(int8):
+        mx.random.seed(0)
+        model = gpt_mod.gpt_mini(vocab_size=512, max_length=96,
+                                 dropout=0.0)
+        model.initialize()
+        rng = np.random.RandomState(0)
+        base = rng.randint(0, 512, (B, 1))
+        ids = (base + np.arange(T + 1)[None, :]) % 512
+        inputs = nd.array(ids[:, :-1], dtype="int32")
+        labels = nd.array(ids[:, 1:], dtype="int32")
+        tr = Trainer(model.collect_params(), "adam",
+                     {"learning_rate": 1e-3}, kvstore="device",
+                     int8_allreduce=int8)
+        losses = []
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            with autograd.record():
+                loss = gpt_mod.lm_loss(model, inputs, labels)
+            loss.backward()
+            tr.step(B)
+            losses.append(float(loss.asnumpy()))
+        wall = time.perf_counter() - t0
+        return losses, wall, tr
+
+    lf, wall_f, _ = run(False)
+    lq, wall_q, tr_q = run(True)
+    deltas = [abs(a - b) for a, b in zip(lf, lq)]
+    rel = [d / max(abs(a), 1e-9) for d, a in zip(deltas, lf)]
+    # the bounded-divergence metric: the worst gap between the two
+    # curves as a fraction of the f32 arm's TOTAL loss improvement —
+    # per-step relative deltas compound as any two slightly-different
+    # trajectories descend, so they are reported but not gated
+    span = max(lf[0] - min(lf), 1e-9)
+    div = max(deltas) / span
+    out = {
+        "config": {"steps": steps, "batch": B, "seq_len": T,
+                   "optimizer": "adam", "smoke": smoke},
+        "f32_loss_first": lf[0], "f32_loss_last": lf[-1],
+        "int8_loss_first": lq[0], "int8_loss_last": lq[-1],
+        "loss_curve_f32": lf[:: max(1, steps // 20)],
+        "loss_curve_int8": lq[:: max(1, steps // 20)],
+        "max_abs_loss_delta": max(deltas),
+        "max_rel_loss_delta": max(rel),
+        "final_rel_loss_delta": rel[-1],
+        "divergence_vs_f32_improvement": div,
+        "int8_buckets": tr_q.int8_buckets,
+        "int8_bytes_saved": tr_q.int8_bytes_saved,
+        "overhead_pct": (wall_q / wall_f - 1.0) * 100.0,
+    }
+    if tr_q.int8_buckets == 0:
+        errors.append("int8_allreduce: the quantized path never ran")
+    if div > 0.05:
+        errors.append(f"int8_allreduce: loss curves diverged by "
+                      f"{div * 100:.2f}% of the f32 improvement span "
+                      f"— over the 5% bound")
+    if lq[-1] >= lf[0]:
+        errors.append("int8_allreduce: the int8 arm failed to learn "
+                      "(final loss above the f32 arm's first loss)")
+    return out
+
+
 def _check_compile_discipline(tag, stats, errors):
     if stats["decode_trace_count"] != 1:
         errors.append(f"{tag}: decode step compiled "
@@ -1232,9 +1516,48 @@ def main():
                     help="round-13 SLO-tier workload ONLY (tiered vs "
                          "tierless under the same mixed-class "
                          "overload) — banks BENCH_TIER.json")
+    ap.add_argument("--quant", action="store_true",
+                    help="round-14 quantized-KV workload ONLY (int8 "
+                         "pages vs the f32 oracle: logit error, token "
+                         "match rate, slots-at-fixed-pool-bytes, plus "
+                         "the int8-allreduce convergence seam) — "
+                         "banks BENCH_QUANT.json")
     args = ap.parse_args()
 
     errors = []
+
+    if args.quant:
+        model = _build(max_length=256)
+        if args.smoke:
+            q_cfg = dict(slots=4, page_size=args.page_size,
+                         spec_k=args.spec_k, personas=2,
+                         per_persona=3, prefix_len=40, suffix_len=6,
+                         max_new=10)
+        else:
+            q_cfg = dict(slots=args.slots, page_size=args.page_size,
+                         spec_k=args.spec_k, personas=4,
+                         per_persona=6, prefix_len=96, suffix_len=8,
+                         max_new=24)
+        result = {"config": {"smoke": args.smoke,
+                             "backend": os.environ.get("JAX_PLATFORMS",
+                                                       "cpu")}}
+        result["quant_serving"] = bench_quant_serving(
+            model, smoke=args.smoke, errors=errors, **q_cfg)
+        result["int8_allreduce"] = bench_int8_allreduce(
+            smoke=args.smoke, errors=errors)
+        print(json.dumps(result, indent=2))
+        for e in errors:
+            print(f"FAIL: {e}", file=sys.stderr)
+        out = args.json
+        if out is None and not args.smoke:
+            out = os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "BENCH_QUANT.json")
+        if out:
+            with open(out, "w") as f:
+                json.dump(result, f, indent=2)
+                f.write("\n")
+            print(f"banked {out}")
+        sys.exit(0 if not errors else 1)
 
     if args.tiers:
         model = _build(max_length=128)
