@@ -21,6 +21,18 @@ import incubator_mxnet_tpu as mx
 print('import ok:', mx.__version__)"
 }
 
+stage_lintcore() {
+  echo "== lintcore: mxlint AST invariant analyzer (trace purity,"
+  echo "             terminal outcomes, page refcounts, hot-loop host"
+  echo "             syncs, lock discipline — docs/STATIC_ANALYSIS.md.)"
+  echo "             Fails on any unbaselined, unwaived finding; the"
+  echo "             summary line reports the baseline size so debt"
+  echo "             growth is visible per PR. To acknowledge NEW debt:"
+  echo "             python -m tools.mxlint --baseline ci/mxlint_baseline.json --update-baseline"
+  echo "             then replace every UNREVIEWED reason with a real one."
+  python -m tools.mxlint --baseline ci/mxlint_baseline.json
+}
+
 stage_native() {
   echo "== native: build the C++ runtime components (make)"
   make -C incubator_mxnet_tpu/src
@@ -131,7 +143,7 @@ ge.dryrun_multichip(8)"
 }
 
 stages=("$@")
-[ ${#stages[@]} -eq 0 ] && stages=(sanity native unit stepbench servebench quantbench chaossmoke fleetsmoke tiersmoke trainchaos ckptbench entry)
+[ ${#stages[@]} -eq 0 ] && stages=(sanity lintcore native unit stepbench servebench quantbench chaossmoke fleetsmoke tiersmoke trainchaos ckptbench entry)
 for s in "${stages[@]}"; do
   "stage_$s"
 done

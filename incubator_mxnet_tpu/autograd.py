@@ -305,7 +305,7 @@ def _compose_pure(heads, variables):
     seeded_order = [id(v) for v in variables]
 
     def composite(*var_vals):
-        _pins = pins  # noqa: F841 — pin NDArray identities for env keys
+        _pins = pins  # mxlint: allow-pinned-name(pin NDArray identities for env keys)
         # leaf variables seed the env; variables that are themselves
         # INTERMEDIATES (grad of a non-leaf) are instead INJECTED at
         # their production site as `replayed + (v - stop_grad(v))`:

@@ -199,11 +199,14 @@ class CheckpointManager:
         snapshots) raise a transient OSError — the deterministic fault
         the retry loop is tested against."""
         budget = int(os.environ.get("MXTPU_CKPT_FAIL_WRITES", "0") or 0)
-        if self._injected_failures < budget:
+        with self._lock:
+            if self._injected_failures >= budget:
+                return
             self._injected_failures += 1
-            raise OSError(
-                f"injected transient checkpoint write failure "
-                f"({self._injected_failures}/{budget})")
+            count = self._injected_failures
+        raise OSError(
+            f"injected transient checkpoint write failure "
+            f"({count}/{budget})")
 
     def _write(self, step, entries, meta):
         """One snapshot write with bounded exponential-backoff retry on
@@ -225,7 +228,11 @@ class CheckpointManager:
             except OSError:
                 if attempt + 1 >= attempts:
                     raise
-                self.write_retries += 1
+                # counter shared with main-thread scrapers (ckpt_bench,
+                # chaos assertions): RLock'd so a torn read-modify-write
+                # on the writer thread cannot drop a retry
+                with self._lock:
+                    self.write_retries += 1
                 time.sleep(backoff * (2 ** attempt))
 
     def _write_once(self, step, entries, meta):
@@ -234,7 +241,11 @@ class CheckpointManager:
             process_index=jax.process_index(),
             process_count=jax.process_count(),
             sync_fn=self._process_barrier)
-        self.committed_steps += 1
+        # commit count is read by the step loop / benches while the
+        # writer thread bumps it — same RLock as the rest of the
+        # shared state (mxlint lock-discipline)
+        with self._lock:
+            self.committed_steps += 1
         if self.keep:
             _manifest.gc_steps(self.directory, self.keep)
 

@@ -611,6 +611,11 @@ class PrefetchingIter(DataIter):
         self._cancel = None
         self._exhausted = False
         self._delivered = 0
+        # stats shared between the producer thread and consumer-side
+        # scrapers: guarded so a retry bump on the producer cannot be
+        # lost to a torn read-modify-write (mxlint lock-discipline)
+        import threading as _threading
+        self._lock = _threading.Lock()
         self.read_retries = 0           # transient-IO retry count
         self._injected_failures = 0     # MXTPU_IO_FAIL_READS bookkeeping
         self._epoch_start = self._try_tell()
@@ -631,11 +636,14 @@ class PrefetchingIter(DataIter):
         tested against (the CheckpointManager writer's twin)."""
         import os as _os
         budget = int(_os.environ.get("MXTPU_IO_FAIL_READS", "0") or 0)
-        if self._injected_failures < budget:
+        with self._lock:
+            if self._injected_failures >= budget:
+                return
             self._injected_failures += 1
-            raise OSError(
-                f"injected transient data-iterator read failure "
-                f"({self._injected_failures}/{budget})")
+            count = self._injected_failures
+        raise OSError(
+            f"injected transient data-iterator read failure "
+            f"({count}/{budget})")
 
     def _next_inner(self):
         """One inner read with bounded exponential-backoff retry on
@@ -656,7 +664,8 @@ class PrefetchingIter(DataIter):
             except OSError:
                 if attempt + 1 >= attempts:
                     raise
-                self.read_retries += 1
+                with self._lock:
+                    self.read_retries += 1
                 # cancel-aware backoff: a reset() mid-retry must abort
                 # the sleep promptly, not trip the bounded-join timeout
                 # on a healthy (merely recovering) producer
@@ -698,6 +707,9 @@ class PrefetchingIter(DataIter):
                     return
             self._safe_put(self._stop, cancel)
 
+        # the producer only reads _cancel, and the write lands before
+        # Thread.start publishes it to the new thread
+        # mxlint: allow-lock-discipline(set before Thread.start, happens-before)
         self._cancel = cancel
         self._exhausted = False
         self._thread = threading.Thread(target=run, daemon=True)

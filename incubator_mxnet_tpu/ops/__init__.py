@@ -6,15 +6,15 @@ serving both front ends, mirroring the reference's single NNVM registry).
 """
 
 from . import registry
-from . import tensor  # noqa: F401  (registers ops)
-from . import nn  # noqa: F401
-from . import random_ops  # noqa: F401
-from . import optimizer_ops  # noqa: F401
-from . import attention  # noqa: F401
-from . import rnn  # noqa: F401
-from . import contrib  # noqa: F401
-from . import vision  # noqa: F401
-from . import misc  # noqa: F401
-from . import linalg  # noqa: F401
-from . import quantization  # noqa: F401
+from . import tensor  # mxlint: allow-import-effect(registers ops)
+from . import nn  # mxlint: allow-import-effect(registers ops)
+from . import random_ops  # mxlint: allow-import-effect(registers ops)
+from . import optimizer_ops  # mxlint: allow-import-effect(registers ops)
+from . import attention  # mxlint: allow-import-effect(registers ops)
+from . import rnn  # mxlint: allow-import-effect(registers ops)
+from . import contrib  # mxlint: allow-import-effect(registers ops)
+from . import vision  # mxlint: allow-import-effect(registers ops)
+from . import misc  # mxlint: allow-import-effect(registers ops)
+from . import linalg  # mxlint: allow-import-effect(registers ops)
+from . import quantization  # mxlint: allow-import-effect(registers ops)
 from .registry import get, list_all_ops, describe_op, register

@@ -48,6 +48,7 @@ def _env_block(name, default):
     silently reusing the old tile size."""
     import os
     try:
+        # mxlint: allow-trace-host-leak(args are host ints: every jitted caller passes the block sizes via static_argnames)
         return int(os.environ.get(name, default))
     except ValueError:
         return default
@@ -63,7 +64,7 @@ def _resolve_blocks(block_q, block_k):
 
 def _pallas_available():
     try:
-        from jax.experimental import pallas  # noqa: F401
+        from jax.experimental import pallas  # mxlint: allow-import-effect(availability probe)
         return True
     except Exception:  # pragma: no cover
         return False

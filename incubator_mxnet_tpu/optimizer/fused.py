@@ -279,6 +279,7 @@ class FusedApplier:
                 ok = group_ok
         counts.update(new_counts)
         opt.num_update = max(counts.values(), default=opt.num_update)
+        # mxlint: allow-host-sync(flag read AFTER every group dispatched; off the dispatch critical path by design)
         if ok is None or bool(np.asarray(ok) > 0):
             return True
         # guard veto: the programs already selected the old params and

@@ -1595,11 +1595,13 @@ class InferenceEngine:
         # REMEMBERED on the request, so a preemption resume keeps the
         # same stream and the continuation stays bit-identical
         if req.seed is not None:
+            # mxlint: allow-host-sync(once per request at admission, not per decode step)
             skey = np.asarray(jax.random.PRNGKey(int(req.seed)),
                               np.uint32)
         elif req._assigned_key is not None:
             skey = req._assigned_key
         else:
+            # mxlint: allow-host-sync(once per request at admission, not per decode step)
             skey = np.asarray(self._next_key(), np.uint32)
             req._assigned_key = skey
         slot = _Slot(req, reserved_pages=need,
@@ -1656,6 +1658,7 @@ class InferenceEngine:
             np.float32(req.temperature), slot.key)
         self._pull_amax(ka, va)
         slot.prefill_pos = t0
+        # mxlint: allow-host-sync(prefill-boundary readback, once per prompt: the sampled first token must reach token_ids)
         tok = int(np.asarray(tok))
         if tok < 0:                          # sign-encoded guard flag
             self._quarantine(slot_idx, "non-finite logits in prefill")
@@ -1689,6 +1692,7 @@ class InferenceEngine:
             slot.row.copy(), np.float32(req.temperature), slot.key)
         self._pull_amax(ka, va)
         slot.prefill_pos = start + n
+        # mxlint: allow-host-sync(chunk-boundary readback, once per chunk: the guard flag and tail token gate the next chunk)
         tok = int(np.asarray(tok))
         if tok < 0:                          # sign-encoded guard flag
             # poisoned mid-prompt: fail NOW — later chunks would only
@@ -1932,8 +1936,14 @@ class InferenceEngine:
                               self._temps.copy(),
                               self._slot_keys.copy())
         self._pull_amax(ka, va)
-        emitted = np.asarray(emitted)        # host sync point
+        # THE designed per-step host sync: the scheduler needs the
+        # emitted tokens/acceptance counts to advance slots; everything
+        # above this line is enqueued without blocking
+        # mxlint: allow-host-sync(THE one designed readback per decode step)
+        emitted = np.asarray(emitted)
+        # mxlint: allow-host-sync(same readback: device already synced by the emitted pull)
         n_emit = np.asarray(n_emit)
+        # mxlint: allow-host-sync(same readback: device already synced by the emitted pull)
         new_lengths = np.asarray(lengths).copy()
         for s in stalled:                    # their true length is kept
             new_lengths[s] = self._lengths[s]

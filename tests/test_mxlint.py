@@ -1,0 +1,683 @@
+"""mxlint (tools/mxlint): the AST invariant analyzer.
+
+Per pass: at least one TRUE-POSITIVE fixture (a distilled version of a
+bug class this repo actually shipped — the PR-9 double-finish race,
+retrace storms, page leaks, hidden host syncs, stat-counter races) and
+one CLEAN fixture the pass must stay silent on. Plus waiver and
+baseline round-trips, and the lintcore CI contract: the real tree is
+clean, and injecting any single fixture bug (one per pass) makes the
+gate exit non-zero.
+
+Everything here is pure-AST host work — no jax arrays are built, so
+the whole module stays well inside the tier-1 budget.
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from tools.mxlint import analyze_project, build_project
+from tools.mxlint.cli import main as mxlint_main
+from tools.mxlint.core import load_baseline, save_baseline
+from tools.mxlint.passes import default_passes
+from tools.mxlint.passes.host_sync import HostSyncPass
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --------------------------------------------------------------------- #
+# harness
+# --------------------------------------------------------------------- #
+
+def _tree(tmp_path, files):
+    """Materialize {relpath: source} under tmp_path; returns root."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return str(tmp_path)
+
+
+def _findings(tmp_path, files, rule=None, passes=None, baseline=None):
+    root = _tree(tmp_path, files)
+    project = build_project(sorted(files), root)
+    out = analyze_project(project, passes or default_passes(),
+                          baseline or {})
+    if rule is not None:
+        out = [f for f in out if f.rule == rule]
+    return out
+
+
+def _active(findings):
+    return [f for f in findings
+            if f.status == "active" and f.severity == "error"]
+
+
+# --------------------------------------------------------------------- #
+# pass 1: trace-host-leak
+# --------------------------------------------------------------------- #
+
+BAD_TRACED = {
+    "incubator_mxnet_tpu/ops/badtrace.py": """
+        import time
+        import numpy as np
+        import jax
+
+
+        def traced(x, y):
+            t = time.time()
+            f = float(x)
+            r = np.random.rand()
+            m = np.asarray(y)
+            return x * t + f + r + m.sum()
+
+
+        fast = jax.jit(traced)
+    """,
+}
+
+CLEAN_TRACED = {
+    "incubator_mxnet_tpu/ops/goodtrace.py": """
+        import time
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+
+
+        def traced(x, key):
+            noise = jax.random.normal(key, x.shape)
+            return jnp.tanh(x) + noise
+
+
+        fast = jax.jit(traced)
+
+
+        def host_helper(v):
+            # NOT reachable from any jit site: host casts are fine here
+            return float(v) + time.time() + np.random.rand()
+    """,
+}
+
+
+def test_trace_pass_flags_host_leaks(tmp_path):
+    active = _active(_findings(tmp_path, BAD_TRACED,
+                               rule="trace-host-leak"))
+    msgs = "\n".join(f.message for f in active)
+    assert len(active) >= 4
+    assert "host clock" in msgs
+    assert "float()" in msgs
+    assert "host RNG" in msgs
+    assert "np.asarray" in msgs
+
+
+def test_trace_pass_clean_fixture(tmp_path):
+    assert _active(_findings(tmp_path, CLEAN_TRACED,
+                             rule="trace-host-leak")) == []
+
+
+def test_trace_pass_follows_call_graph(tmp_path):
+    files = {
+        "incubator_mxnet_tpu/ops/chained.py": """
+            import jax
+
+
+            def helper(v):
+                return int(v) + 1
+
+
+            def traced(x):
+                return helper(x)
+
+
+            fast = jax.jit(traced)
+        """,
+    }
+    active = _active(_findings(tmp_path, files, rule="trace-host-leak"))
+    assert len(active) == 1 and active[0].symbol == "helper"
+
+
+def test_trace_pass_decorated_and_method_roots(tmp_path):
+    files = {
+        "incubator_mxnet_tpu/ops/decorated.py": """
+            import functools
+            import time
+            import jax
+
+
+            @functools.partial(jax.jit, static_argnames=("k",))
+            def decorated(x, k):
+                return x * time.monotonic()
+
+
+            class Engine:
+                def __init__(self):
+                    self._step = jax.jit(self._step_fn)
+
+                def _step_fn(self, x):
+                    return bool(x)
+        """,
+    }
+    active = _active(_findings(tmp_path, files, rule="trace-host-leak"))
+    symbols = {f.symbol for f in active}
+    assert "decorated" in symbols
+    assert "Engine._step_fn" in symbols
+
+
+# --------------------------------------------------------------------- #
+# pass 2: terminal-outcome (the PR-9 double-finish race, distilled)
+# --------------------------------------------------------------------- #
+
+BAD_OUTCOME = {
+    "incubator_mxnet_tpu/serve/badoutcome.py": """
+        class Scheduler:
+            def _record_terminal(self, request, outcome):
+                request.outcome = outcome
+                self.health[outcome.value] += 1
+
+            def evict_expired(self, request, outcome):
+                # the double-finish race: a second writer that does not
+                # go through the recorder
+                request.outcome = outcome
+
+            def fixup_counts(self, outcome):
+                self.health[outcome.value] += 1
+    """,
+}
+
+CLEAN_OUTCOME = {
+    "incubator_mxnet_tpu/serve/goodoutcome.py": """
+        class Scheduler:
+            def __init__(self):
+                self.health = {}
+
+            def _record_terminal(self, request, outcome):
+                request.outcome = outcome
+                self.health[outcome.value] += 1
+
+            def reset_for_requeue(self, request):
+                request.outcome = None      # reset, not a terminal
+
+            def evict(self, request, outcome):
+                self._record_terminal(request, outcome)
+    """,
+}
+
+
+def test_outcome_pass_flags_second_writer(tmp_path):
+    active = _active(_findings(tmp_path, BAD_OUTCOME,
+                               rule="terminal-outcome"))
+    assert {f.symbol for f in active} == \
+        {"Scheduler.evict_expired", "Scheduler.fixup_counts"}
+
+
+def test_outcome_pass_clean_fixture(tmp_path):
+    assert _active(_findings(tmp_path, CLEAN_OUTCOME,
+                             rule="terminal-outcome")) == []
+
+
+def test_outcome_pass_scoped_to_serve_and_train(tmp_path):
+    files = {"incubator_mxnet_tpu/gluon/other.py": """
+        class T:
+            def set(self, r, o):
+                r.outcome = o
+    """}
+    assert _active(_findings(tmp_path, files,
+                             rule="terminal-outcome")) == []
+
+
+# --------------------------------------------------------------------- #
+# pass 3: page-refcount
+# --------------------------------------------------------------------- #
+
+BAD_PAGES = {
+    "incubator_mxnet_tpu/serve/badpages.py": """
+        class LeakyIndex:
+            def retain(self, pages):
+                for p in pages:
+                    self._alloc.incref(p)
+
+            def grab_one(self):
+                return self._alloc.alloc()
+    """,
+}
+
+CLEAN_PAGES = {
+    "incubator_mxnet_tpu/serve/goodpages.py": """
+        class PairedIndex:
+            def retain(self, pages):
+                for p in pages:
+                    self._alloc.incref(p)
+
+            def drop(self, pages):
+                for p in pages:
+                    self._alloc.decref(p)
+    """,
+}
+
+
+def test_page_pass_flags_unpaired_acquire(tmp_path):
+    active = _active(_findings(tmp_path, BAD_PAGES,
+                               rule="page-refcount"))
+    assert len(active) == 2
+    assert all("silent pool leak" in f.message for f in active)
+
+
+def test_page_pass_clean_fixture(tmp_path):
+    assert _active(_findings(tmp_path, CLEAN_PAGES,
+                             rule="page-refcount")) == []
+
+
+def test_page_pass_null_page_and_rc_internals(tmp_path):
+    files = {"incubator_mxnet_tpu/serve/nullpage.py": """
+        NULL_PAGE = 0
+
+
+        class Evil:
+            def release(self):
+                self._alloc.decref(0)
+                self._alloc.free(NULL_PAGE)
+
+            def poke(self):
+                self._rc[3] += 1
+    """}
+    active = _active(_findings(tmp_path, files, rule="page-refcount"))
+    msgs = "\n".join(f.message for f in active)
+    assert msgs.count("null page") == 2
+    assert "outside PageAllocator" in msgs
+
+
+# --------------------------------------------------------------------- #
+# pass 4: host-sync
+# --------------------------------------------------------------------- #
+
+BAD_HOTLOOP = {
+    "incubator_mxnet_tpu/serve/hotloop.py": """
+        import jax
+        import numpy as np
+
+
+        class MiniEngine:
+            def __init__(self):
+                self._decode = jax.jit(lambda x: x + 1)
+
+            def step(self):
+                out = self._decode(self.state)
+                tok = int(np.asarray(out))       # designed sync
+                extra = out.item()               # hidden second sync
+                if out > 0:                      # hidden implicit bool
+                    tok += 1
+                return tok + extra
+    """,
+}
+
+_HOT = {"incubator_mxnet_tpu/serve/hotloop.py": {"step"}}
+
+
+def _hot_passes():
+    return [HostSyncPass(hot_seeds=_HOT)]
+
+
+def test_host_sync_flags_hidden_syncs(tmp_path):
+    active = _active(_findings(tmp_path, BAD_HOTLOOP, rule="host-sync",
+                               passes=_hot_passes()))
+    msgs = "\n".join(f.message for f in active)
+    assert "np.asarray" in msgs
+    assert ".item()" in msgs
+    assert "implicit `bool()`" in msgs
+
+
+def test_host_sync_untaints_after_cast_and_ignores_is_none(tmp_path):
+    files = {"incubator_mxnet_tpu/serve/hotloop.py": """
+        import jax
+        import numpy as np
+
+
+        class MiniEngine:
+            def __init__(self):
+                self._decode = jax.jit(lambda x: x + 1)
+
+            def step(self):
+                out = self._decode(self.state)
+                if out is None:                # identity: NOT a sync
+                    return 0
+                # mxlint: allow-host-sync(the one designed readback)
+                out = np.asarray(out)
+                if out > 0:                    # host np array now: free
+                    return 1
+                return int(out)                # host int now: free
+    """}
+    findings = _findings(tmp_path, files, rule="host-sync",
+                         passes=_hot_passes())
+    assert _active(findings) == []
+    assert [f.status for f in findings] == ["waived"]
+
+
+def test_host_sync_taints_through_jit_dicts_and_returns(tmp_path):
+    files = {"incubator_mxnet_tpu/serve/hotloop.py": """
+        import jax
+        import numpy as np
+
+
+        class MiniEngine:
+            def __init__(self):
+                self._jits = {}
+
+            def _get_fn(self, sig):
+                fn = self._jits.get(sig)
+                if fn is None:
+                    fn = jax.jit(lambda x: x)
+                    self._jits[sig] = fn
+                return fn(sig)
+
+            def step(self):
+                flag = self._get_fn(8)
+                return bool(np.asarray(flag) > 0)
+    """}
+    active = _active(_findings(tmp_path, files, rule="host-sync",
+                               passes=_hot_passes()))
+    assert len(active) == 1
+    assert "np.asarray" in active[0].message
+
+
+# --------------------------------------------------------------------- #
+# pass 5: lock-discipline
+# --------------------------------------------------------------------- #
+
+BAD_LOCKS = {
+    "incubator_mxnet_tpu/checkpoint/badlocks.py": """
+        import threading
+
+
+        class RacyWriter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.commits = 0
+                self._thread = threading.Thread(target=self._loop)
+
+            def _loop(self):
+                while True:
+                    self.commits += 1     # writer thread, no lock
+
+            def reset(self):
+                self.commits = 0          # main path, no lock
+    """,
+}
+
+CLEAN_LOCKS = {
+    "incubator_mxnet_tpu/checkpoint/goodlocks.py": """
+        import threading
+
+
+        class GuardedWriter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.commits = 0
+                self._thread = threading.Thread(target=self._loop)
+
+            def _loop(self):
+                while True:
+                    with self._lock:
+                        self.commits += 1
+
+            def reset(self):
+                with self._lock:
+                    self.commits = 0
+    """,
+}
+
+
+def test_lock_pass_flags_unguarded_shared_writes(tmp_path):
+    active = _active(_findings(tmp_path, BAD_LOCKS,
+                               rule="lock-discipline"))
+    assert {f.symbol for f in active} == \
+        {"RacyWriter._loop", "RacyWriter.reset"}
+
+
+def test_lock_pass_clean_fixture(tmp_path):
+    assert _active(_findings(tmp_path, CLEAN_LOCKS,
+                             rule="lock-discipline")) == []
+
+
+def test_lock_pass_flags_lockless_thread_class(tmp_path):
+    files = {"incubator_mxnet_tpu/io/lockless.py": """
+        import threading
+
+
+        class NoLock:
+            def __init__(self):
+                self._thread = threading.Thread(target=self._loop)
+
+            def _loop(self):
+                self.n = 1
+    """}
+    active = _active(_findings(tmp_path, files, rule="lock-discipline"))
+    assert len(active) == 1
+    assert "designates no lock" in active[0].message
+
+
+# --------------------------------------------------------------------- #
+# waivers
+# --------------------------------------------------------------------- #
+
+def test_waiver_suppresses_and_records_reason(tmp_path):
+    files = {"incubator_mxnet_tpu/serve/waived.py": """
+        class Scheduler:
+            def evict(self, request, outcome):
+                # mxlint: allow-terminal-outcome(distilled fixture, not a real writer)
+                request.outcome = outcome
+    """}
+    findings = _findings(tmp_path, files, rule="terminal-outcome")
+    assert len(findings) == 1
+    assert findings[0].status == "waived"
+    assert "distilled fixture" in findings[0].reason
+
+
+def test_scope_level_waiver_on_def_line(tmp_path):
+    files = {"incubator_mxnet_tpu/serve/scoped.py": """
+        class Scheduler:
+            # mxlint: allow-terminal-outcome(whole-method waiver: legacy shim)
+            def evict(self, request, outcome):
+                request.outcome = outcome
+    """}
+    findings = _findings(tmp_path, files, rule="terminal-outcome")
+    assert [f.status for f in findings] == ["waived"]
+
+
+def test_waiver_without_reason_is_a_finding(tmp_path):
+    files = {"incubator_mxnet_tpu/serve/noreason.py": """
+        X = 1  # mxlint: allow-terminal-outcome()
+    """}
+    findings = _findings(tmp_path, files, rule="waiver-syntax")
+    assert len(findings) == 1
+    assert "no reason" in findings[0].message
+
+
+def test_waiver_unknown_rule_is_a_finding(tmp_path):
+    files = {"incubator_mxnet_tpu/serve/unknown.py": """
+        X = 1  # mxlint: allow-made-up-rule(sounds legit)
+    """}
+    findings = _findings(tmp_path, files, rule="waiver-syntax")
+    assert len(findings) == 1
+    assert "unknown rule" in findings[0].message
+
+
+def test_first_body_line_waiver_is_not_scope_wide(tmp_path):
+    """Review regression: a LINE waiver on (or above) a function's
+    first statement must not silently become a whole-function waiver —
+    the later unwaived violation stays active (fail-closed)."""
+    files = {"incubator_mxnet_tpu/serve/firstline.py": """
+        class Scheduler:
+            def evict(self, request, other):
+                # mxlint: allow-terminal-outcome(this one write only)
+                request.outcome = 1
+                other.outcome = 2
+    """}
+    findings = _findings(tmp_path, files, rule="terminal-outcome")
+    assert sorted(f.status for f in findings) == ["active", "waived"]
+    active = _active(findings)[0]
+    assert "other" in tmp_path.joinpath(
+        "incubator_mxnet_tpu/serve/firstline.py").read_text() \
+        .splitlines()[active.line - 1]
+
+
+def test_host_sync_item_on_host_value_not_flagged(tmp_path):
+    """Review regression: `.item()` on a pure-host numpy value is not
+    a device sync and must not demand a waiver."""
+    files = {"incubator_mxnet_tpu/serve/hotloop.py": """
+        import numpy as np
+
+
+        class MiniEngine:
+            def step(self):
+                host = np.zeros(3)
+                return host.max().item()
+    """}
+    assert _active(_findings(tmp_path, files, rule="host-sync",
+                             passes=_hot_passes())) == []
+
+
+def test_aliased_baseline_group_carries_attribution_note(tmp_path):
+    """Review regression: when identical findings split between
+    baselined and active, the active one's report admits the line
+    attribution is order-based instead of silently pointing at an
+    arbitrary line."""
+    first = _findings(tmp_path, BAD_OUTCOME, rule="terminal-outcome")
+    dup = [f for f in first if f.symbol == "Scheduler.evict_expired"]
+    baseline = {dup[0].key: "acknowledged debt"}
+    src = textwrap.dedent(
+        BAD_OUTCOME["incubator_mxnet_tpu/serve/badoutcome.py"])
+    marker = "recorder\n        request.outcome = outcome"
+    assert marker in src
+    doubled = {
+        "incubator_mxnet_tpu/serve/badoutcome.py": src.replace(
+            marker, marker + "\n        request.outcome = outcome")}
+    findings = [
+        f for f in _findings(tmp_path / "d", doubled,
+                             rule="terminal-outcome", baseline=baseline)
+        if f.symbol == "Scheduler.evict_expired"]
+    assert sorted(f.status for f in findings) == ["active", "baselined"]
+    active = [f for f in findings if f.status == "active"][0]
+    assert "re-triage the whole group" in active.note
+    assert "re-triage" in active.render()
+
+
+def test_docstring_mention_is_not_a_waiver(tmp_path):
+    files = {"incubator_mxnet_tpu/serve/docmention.py": '''
+        """Docs may say # mxlint: allow-terminal-outcome(reason) freely."""
+        X = 1
+    '''}
+    assert _findings(tmp_path, files, rule="waiver-syntax") == []
+
+
+# --------------------------------------------------------------------- #
+# baseline
+# --------------------------------------------------------------------- #
+
+def test_baseline_roundtrip(tmp_path):
+    findings = _findings(tmp_path, BAD_OUTCOME, rule="terminal-outcome")
+    keys = {f.key: "pre-existing: tracked as debt" for f in findings}
+    bl_path = str(tmp_path / "bl.json")
+    save_baseline(bl_path, keys)
+    loaded = load_baseline(bl_path)
+    assert loaded == keys
+
+    again = _findings(tmp_path, BAD_OUTCOME, rule="terminal-outcome",
+                      baseline=loaded)
+    assert _active(again) == []
+    assert all(f.status == "baselined" and "debt" in f.reason
+               for f in again)
+
+
+def test_baseline_key_survives_line_shift(tmp_path):
+    first = _findings(tmp_path, BAD_OUTCOME, rule="terminal-outcome")
+    shifted = {
+        "incubator_mxnet_tpu/serve/badoutcome.py":
+            "# a new comment line at the top\n# another\n" +
+            textwrap.dedent(
+                BAD_OUTCOME["incubator_mxnet_tpu/serve/badoutcome.py"])}
+    second = _findings(tmp_path / "b", shifted, rule="terminal-outcome")
+    assert {f.key for f in first} == {f.key for f in second}
+    assert [f.line for f in first] != [f.line for f in second]
+
+
+def test_cli_update_baseline_then_clean(tmp_path):
+    root = _tree(tmp_path, BAD_OUTCOME)
+    bl = "bl.json"
+    rc = mxlint_main(["--root", root, "--baseline", bl,
+                      "incubator_mxnet_tpu"])
+    assert rc == 1
+    rc = mxlint_main(["--root", root, "--baseline", bl,
+                      "--update-baseline", "incubator_mxnet_tpu"])
+    assert rc == 0
+    data = json.loads((tmp_path / bl).read_text())
+    assert data["entries"] and all(e["reason"] for e in data["entries"])
+    rc = mxlint_main(["--root", root, "--baseline", bl,
+                      "incubator_mxnet_tpu"])
+    assert rc == 0
+
+
+# --------------------------------------------------------------------- #
+# the lintcore CI contract
+# --------------------------------------------------------------------- #
+
+def test_lintcore_real_tree_is_clean():
+    """`ci/run.sh lintcore` equivalence: the checked-in tree plus the
+    checked-in baseline must have zero unbaselined findings."""
+    rc = mxlint_main(["--root", REPO_ROOT,
+                      "--baseline", "ci/mxlint_baseline.json"])
+    assert rc == 0
+
+
+_INJECTIONS = {
+    # one representative bug per pass, injected as a fresh file at a
+    # path inside the pass's scope (host-sync: a step() on the real
+    # hot-module path so the default HOT_SEEDS pick it up)
+    "trace-host-leak": (
+        "incubator_mxnet_tpu/ops/injected_trace.py",
+        BAD_TRACED["incubator_mxnet_tpu/ops/badtrace.py"]),
+    "terminal-outcome": (
+        "incubator_mxnet_tpu/serve/injected_outcome.py",
+        BAD_OUTCOME["incubator_mxnet_tpu/serve/badoutcome.py"]),
+    "page-refcount": (
+        "incubator_mxnet_tpu/serve/injected_pages.py",
+        BAD_PAGES["incubator_mxnet_tpu/serve/badpages.py"]),
+    "host-sync": (
+        "incubator_mxnet_tpu/serve/router.py",
+        """
+        import jax
+        import numpy as np
+
+
+        class Router:
+            def __init__(self):
+                self._probe = jax.jit(lambda x: x)
+
+            def _dispatch(self):
+                score = self._probe(3)
+                return float(np.asarray(score))
+        """),
+    "lock-discipline": (
+        "incubator_mxnet_tpu/checkpoint/injected_locks.py",
+        BAD_LOCKS["incubator_mxnet_tpu/checkpoint/badlocks.py"]),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(_INJECTIONS))
+def test_lintcore_fails_on_injected_bug(tmp_path, rule):
+    """Injecting any SINGLE fixture bug (one per pass) into an
+    otherwise-clean tree must flip the lintcore gate non-zero."""
+    rel, src = _INJECTIONS[rule]
+    root = _tree(tmp_path, {rel: src})
+    rc = mxlint_main(["--root", root, "incubator_mxnet_tpu"])
+    assert rc == 1, f"{rule}: injected bug not caught"
+    # and the finding is attributed to the right rule
+    findings = _findings(tmp_path / "chk", {rel: src}, rule=rule)
+    assert _active(findings), f"{rule}: no active finding for its rule"
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    files = {"incubator_mxnet_tpu/serve/broken.py": "def oops(:\n"}
+    findings = _findings(tmp_path, files, rule="parse-error")
+    assert len(findings) == 1

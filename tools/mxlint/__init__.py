@@ -1,0 +1,20 @@
+"""mxlint: AST-based invariant analyzer for this repo's load-bearing
+disciplines (docs/STATIC_ANALYSIS.md).
+
+Every invariant the runtime asserts — one compile per program,
+exactly-one-terminal per request/step, refcounted page discipline, no
+hidden host syncs in hot loops, lock-guarded cross-thread state — is
+enforced here at parse time, over every file, before any test drives
+the path. Pure stdlib (``ast`` + ``tokenize``-free line scans), no
+third-party deps, runs anywhere ``compileall`` does.
+
+Entry points:
+  python -m tools.mxlint --baseline ci/mxlint_baseline.json   # CI gate
+  from tools.mxlint import run_paths, analyze_project          # library
+"""
+
+from .core import (Finding, LintPass, Project, SourceUnit,  # noqa: F401
+                   analyze_project, build_project, load_baseline,
+                   run_paths)
+
+__version__ = "1.0"

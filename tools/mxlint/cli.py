@@ -1,0 +1,154 @@
+"""mxlint command line — the `ci/run.sh lintcore` entry point.
+
+  python -m tools.mxlint --baseline ci/mxlint_baseline.json
+  python -m tools.mxlint incubator_mxnet_tpu/serve --verbose
+  python -m tools.mxlint --update-baseline --baseline ci/mxlint_baseline.json
+  python -m tools.mxlint --list-rules
+
+Exit status: 0 = no unbaselined, unwaived error-severity findings;
+1 = at least one; 2 = usage/internal error. The summary line always
+reports the baseline size so CI can gate on it not growing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .core import (ANNOTATION_RULES, UNREVIEWED, analyze_project,
+                   build_project, load_baseline, save_baseline)
+from .passes import ALL_PASSES, default_passes
+
+DEFAULT_PATHS = ["incubator_mxnet_tpu", "tools", "examples",
+                 "bench.py", "__graft_entry__.py"]
+
+
+def _find_root(start: str) -> str:
+    cur = os.path.abspath(start)
+    while True:
+        if os.path.isdir(os.path.join(cur, "incubator_mxnet_tpu")):
+            return cur
+        nxt = os.path.dirname(cur)
+        if nxt == cur:
+            return os.path.abspath(start)
+        cur = nxt
+
+
+def list_rules() -> str:
+    lines = ["mxlint rules (docs/STATIC_ANALYSIS.md has the catalog):"]
+    for cls in ALL_PASSES:
+        lines.append(f"  pass {cls.name}: " + ", ".join(cls.rules))
+    lines.append("  framework: parse-error, waiver-syntax")
+    lines.append("  annotation-only (waiver vocabulary, no pass):")
+    for rule, desc in sorted(ANNOTATION_RULES.items()):
+        lines.append(f"    {rule}: {desc}")
+    lines.append("waiver syntax: # mxlint: allow-<rule>(reason) — on the"
+                 " flagged line, the line above, or a def/class line for"
+                 " a scope-wide waiver. The reason is mandatory.")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mxlint",
+        description="AST-based invariant analyzer for this repo "
+                    "(trace purity, terminal outcomes, page refcounts, "
+                    "host syncs, lock discipline)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help=f"files/dirs to analyze (default: "
+                         f"{' '.join(DEFAULT_PATHS)})")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: auto-detected)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON of acknowledged findings")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite --baseline: keep matched entries "
+                         "(and their reasons), add current active "
+                         "findings as UNREVIEWED, drop stale entries")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--verbose", action="store_true",
+                    help="also print waived/baselined findings")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        print(list_rules())
+        return 0
+
+    root = args.root or _find_root(os.getcwd())
+    paths = args.paths or [p for p in DEFAULT_PATHS
+                           if os.path.exists(os.path.join(root, p))]
+    baseline_path = args.baseline
+    baseline = load_baseline(
+        baseline_path if baseline_path is None or
+        os.path.isabs(baseline_path)
+        else os.path.join(root, baseline_path))
+
+    project = build_project(paths, root)
+    findings = analyze_project(project, default_passes(), baseline)
+
+    active = [f for f in findings
+              if f.status == "active" and f.severity == "error"]
+    advisory = [f for f in findings
+                if f.status == "active" and f.severity != "error"]
+    waived = [f for f in findings if f.status == "waived"]
+    baselined = [f for f in findings if f.status == "baselined"]
+    matched_keys = {f.key for f in baselined}
+    stale = [k for k in baseline if k not in matched_keys]
+
+    if args.update_baseline:
+        if not baseline_path:
+            print("--update-baseline needs --baseline", file=sys.stderr)
+            return 2
+        entries = {f.key: baseline.get(f.key, UNREVIEWED)
+                   for f in baselined}
+        entries.update({f.key: baseline.get(f.key, UNREVIEWED)
+                        for f in active})
+        out_path = baseline_path if os.path.isabs(baseline_path) \
+            else os.path.join(root, baseline_path)
+        save_baseline(out_path, entries)
+        print(f"mxlint: baseline rewritten: {len(entries)} entries "
+              f"({len(active)} new, {len(stale)} stale dropped) -> "
+              f"{baseline_path}")
+        return 0
+
+    if args.as_json:
+        print(json.dumps([dataclass_dict(f) for f in findings],
+                         indent=1))
+    else:
+        shown = findings if args.verbose else active + advisory
+        for f in sorted(shown, key=lambda f: (f.path, f.line)):
+            print(f.render())
+
+    empty_reasons = sum(
+        1 for k in baseline if not baseline[k].strip()
+        or baseline[k].strip().startswith("UNREVIEWED"))
+    # with --json, stdout carries ONLY the findings document
+    summary_out = sys.stderr if args.as_json else sys.stdout
+    print(f"mxlint: {len(project.units)} files | "
+          f"{len(active)} active, {len(advisory)} advisory, "
+          f"{len(waived)} waived, {len(baselined)} baselined | "
+          f"baseline size: {len(baseline)} entries "
+          f"({len(stale)} stale, {empty_reasons} unreviewed)",
+          file=summary_out)
+    if active:
+        print("mxlint: FAIL — fix the finding, add an inline "
+              "'# mxlint: allow-<rule>(reason)' waiver, or (for "
+              "pre-existing debt) --update-baseline and justify the "
+              "entry.", file=summary_out)
+        return 1
+    return 0
+
+
+def dataclass_dict(f):
+    return {"rule": f.rule, "path": f.path, "line": f.line,
+            "severity": f.severity, "symbol": f.symbol,
+            "message": f.message, "status": f.status,
+            "reason": f.reason, "key": f.key}
+
+
+if __name__ == "__main__":
+    sys.exit(main())

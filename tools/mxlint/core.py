@@ -1,0 +1,430 @@
+"""mxlint framework: source model, findings, waivers, baseline, runner.
+
+The moving parts (docs/STATIC_ANALYSIS.md):
+
+  - ``SourceUnit``   one parsed file: AST with parent links, the
+                     module's dotted name, and its inline waivers.
+  - ``Project``      every unit plus cross-file lookup (module name →
+                     unit, function tables) so passes can walk call
+                     graphs project-wide.
+  - ``Finding``      one violation. Its ``key`` deliberately excludes
+                     the line number — baselines must survive unrelated
+                     edits above the finding — and disambiguates
+                     repeats within one (path, symbol, rule, message)
+                     cell with a ``#n`` suffix ordered by line.
+  - waivers          ``# mxlint: allow-<rule>(reason)`` on the flagged
+                     line, the line above, or the ``def``/``class``
+                     line of an enclosing scope (scope-wide waiver).
+                     A waiver is a CONTRACT: the reason is mandatory
+                     and an empty or unknown-rule waiver is itself a
+                     finding (rule ``waiver-syntax``).
+  - baseline         checked-in JSON debt ledger: pre-existing findings
+                     that are acknowledged but not yet fixed. Every
+                     entry carries a human-readable reason; stale
+                     entries are dropped on ``--update-baseline`` and
+                     reported otherwise.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# rules that exist only as annotation vocabulary (no detection pass):
+# waivers under these names document an invariant at the site that an
+# external tool (or a human reader) would otherwise question.
+ANNOTATION_RULES = {
+    "import-effect":   "import kept for its side effect (op registration,"
+                       " availability probe)",
+    "pinned-name":     "name bound only to pin an object's lifetime or"
+                       " identity",
+}
+
+_BUILTIN_NAMES = frozenset(dir(builtins))
+
+_WAIVER_ITEM_RE = re.compile(r"allow-([A-Za-z0-9_-]+)\(([^()]*)\)")
+_WAIVER_MARK_RE = re.compile(r"#\s*mxlint:")
+
+
+# --------------------------------------------------------------------- #
+# findings
+# --------------------------------------------------------------------- #
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str                   # repo-relative, '/' separated
+    line: int
+    message: str                # stable text: never embeds line numbers
+    symbol: str = "<module>"    # enclosing def/class qualname
+    severity: str = "error"     # "error" gates CI; "warn" is advisory
+    # filled by the runner:
+    status: str = "active"      # active | waived | baselined
+    reason: str = ""            # waiver/baseline justification
+    occurrence: int = 1         # disambiguates identical keys
+    note: str = ""              # attribution caveats (aliased groups)
+
+    @property
+    def key(self) -> str:
+        base = f"{self.path}::{self.symbol}::{self.rule}::{self.message}"
+        return base if self.occurrence == 1 else \
+            f"{base}#{self.occurrence}"
+
+    def render(self) -> str:
+        sev = self.severity.upper()
+        tag = "" if self.status == "active" else f" [{self.status}]"
+        note = f" [{self.note}]" if self.note else ""
+        return (f"{self.path}:{self.line}: {sev} {self.rule}{tag} "
+                f"({self.symbol}): {self.message}{note}")
+
+
+# --------------------------------------------------------------------- #
+# source model
+# --------------------------------------------------------------------- #
+
+def _attach_parents(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._mxparent = node          # type: ignore[attr-defined]
+
+
+def parent(node: ast.AST) -> Optional[ast.AST]:
+    return getattr(node, "_mxparent", None)
+
+
+def enclosing_scopes(node: ast.AST) -> List[ast.AST]:
+    """Innermost-first chain of enclosing FunctionDef/ClassDef nodes."""
+    out = []
+    cur = parent(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            out.append(cur)
+        cur = parent(cur)
+    return out
+
+
+def qualname_of(node: ast.AST) -> str:
+    names = [s.name for s in reversed(enclosing_scopes(node))]
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        names.append(node.name)
+    return ".".join(names) if names else "<module>"
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class SourceUnit:
+    def __init__(self, path: str, text: str, module: str):
+        self.path = path
+        self.module = module
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree: Optional[ast.Module] = None
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(text, filename=path)
+            _attach_parents(self.tree)
+        except SyntaxError as e:
+            self.parse_error = e
+        # line -> [(rule, reason)] waiver table from inline comments
+        self.waivers: Dict[int, List[Tuple[str, str]]] = {}
+        self.bad_waivers: List[Tuple[int, str]] = []
+        self._scan_waivers()
+        # import table: local alias -> dotted module / imported symbol
+        self.import_modules: Dict[str, str] = {}
+        self.import_symbols: Dict[str, Tuple[str, str]] = {}
+        if self.tree is not None:
+            self._scan_imports()
+
+    # -- waivers -------------------------------------------------------- #
+    def _comment_lines(self) -> Dict[int, str]:
+        """line -> comment text, via tokenize so a docstring MENTIONING
+        the waiver syntax is not a waiver."""
+        import io
+        import tokenize
+        out: Dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(self.text).readline):
+                if tok.type == tokenize.COMMENT:
+                    out[tok.start[0]] = tok.string
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            pass
+        return out
+
+    def _scan_waivers(self) -> None:
+        for i, line in sorted(self._comment_lines().items()):
+            m = _WAIVER_MARK_RE.search(line)
+            if not m:
+                continue
+            tail = line[m.end():]
+            items = _WAIVER_ITEM_RE.findall(tail)
+            if not items:
+                self.bad_waivers.append(
+                    (i, "mxlint marker without a parseable "
+                        "'allow-<rule>(reason)' clause"))
+                continue
+            for rule, reason in items:
+                reason = reason.strip()
+                if not reason:
+                    self.bad_waivers.append(
+                        (i, f"waiver allow-{rule} carries no reason — "
+                            f"a waiver is a contract, state why"))
+                    continue
+                self.waivers.setdefault(i, []).append((rule, reason))
+
+    def waiver_reason(self, rule: str, line: int) -> Optional[str]:
+        """Waiver lookup for a finding at ``line``: same line, the line
+        above, or the def/class line of any enclosing scope."""
+        for cand in (line, line - 1):
+            for r, reason in self.waivers.get(cand, ()):
+                if r == rule:
+                    return reason
+        if self.tree is not None:
+            for node in ast.walk(self.tree):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef,
+                                         ast.ClassDef)):
+                    continue
+                if not (node.lineno <= line <= (node.end_lineno or 0)):
+                    continue
+                # scope-wide waivers live ON the def/class line or the
+                # line directly above it — NEVER on body lines: a
+                # line-level waiver on the first statement must not be
+                # silently promoted to cover the whole function
+                # (fail-closed; found by review)
+                for cand in (node.lineno, node.lineno - 1):
+                    for r, reason in self.waivers.get(cand, ()):
+                        if r == rule:
+                            return reason
+        return None
+
+    # -- imports -------------------------------------------------------- #
+    def _resolve_relative(self, level: int, name: str) -> str:
+        base = self.module.split(".")
+        if level:
+            base = base[:-level] if level <= len(base) else []
+        return ".".join(base + ([name] if name else [])).strip(".")
+
+    def _scan_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.import_modules[a.asname or
+                                        a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                mod = self._resolve_relative(node.level, node.module or "")
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.import_symbols[a.asname or a.name] = (mod, a.name)
+
+
+class Project:
+    def __init__(self, root: str, units: Sequence[SourceUnit]):
+        self.root = root
+        self.units = list(units)
+        self.by_module: Dict[str, SourceUnit] = {
+            u.module: u for u in units}
+        self.by_path: Dict[str, SourceUnit] = {u.path: u for u in units}
+
+    def functions(self, unit: SourceUnit) \
+            -> Dict[str, List[ast.FunctionDef]]:
+        out: Dict[str, List[ast.FunctionDef]] = {}
+        if unit.tree is None:
+            return out
+        for node in ast.walk(unit.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.setdefault(node.name, []).append(node)
+        return out
+
+
+# --------------------------------------------------------------------- #
+# pass interface
+# --------------------------------------------------------------------- #
+
+class LintPass:
+    """One invariant. ``rules`` names every rule the pass may emit (the
+    waiver vocabulary is validated against the union of these)."""
+
+    name = "base"
+    rules: Tuple[str, ...] = ()
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+# --------------------------------------------------------------------- #
+# baseline
+# --------------------------------------------------------------------- #
+
+UNREVIEWED = ("UNREVIEWED: added by --update-baseline — replace with a "
+              "real justification")
+
+
+def load_baseline(path: Optional[str]) -> Dict[str, str]:
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return {e["key"]: e.get("reason", "") for e in data.get("entries", [])}
+
+
+def save_baseline(path: str, entries: Dict[str, str]) -> None:
+    data = {
+        "_comment": ("mxlint baseline: acknowledged pre-existing findings."
+                     " An entry here is DEBT (a waiver in the source is a"
+                     " contract) — every entry needs a reason, and the"
+                     " lintcore CI stage reports the total so growth is"
+                     " visible. Regenerate with --update-baseline."),
+        "version": 1,
+        "entries": [{"key": k, "reason": entries[k]}
+                    for k in sorted(entries)],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1)
+        f.write("\n")
+
+
+# --------------------------------------------------------------------- #
+# runner
+# --------------------------------------------------------------------- #
+
+_SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "node_modules"}
+
+
+def iter_py_files(paths: Sequence[str], root: str) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(full) and full.endswith(".py"):
+            out.append(full)
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in _SKIP_DIRS)
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def module_name_for(relpath: str) -> str:
+    mod = relpath[:-3] if relpath.endswith(".py") else relpath
+    mod = mod.replace("/", ".").replace("\\", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[:-len(".__init__")]
+    return mod
+
+
+def build_project(paths: Sequence[str], root: str) -> Project:
+    units = []
+    for full in iter_py_files(paths, root):
+        rel = os.path.relpath(full, root).replace(os.sep, "/")
+        try:
+            with open(full, "r", encoding="utf-8") as f:
+                text = f.read()
+        except OSError:
+            continue
+        units.append(SourceUnit(rel, text, module_name_for(rel)))
+    return Project(root, units)
+
+
+def _known_rules(passes: Sequence[LintPass]) -> set:
+    known = set(ANNOTATION_RULES)
+    for p in passes:
+        known.update(p.rules)
+    return known
+
+
+def analyze_project(project: Project, passes: Sequence[LintPass],
+                    baseline: Optional[Dict[str, str]] = None
+                    ) -> List[Finding]:
+    """Run every pass, then classify findings against waivers and the
+    baseline. Returns ALL findings (status marks the triage)."""
+    baseline = dict(baseline or {})
+    known = _known_rules(passes)
+    findings: List[Finding] = []
+
+    for unit in project.units:
+        if unit.parse_error is not None:
+            findings.append(Finding(
+                "parse-error", unit.path,
+                unit.parse_error.lineno or 1,
+                f"file does not parse: {unit.parse_error.msg}"))
+        for line, msg in unit.bad_waivers:
+            findings.append(Finding("waiver-syntax", unit.path, line, msg))
+        for line, items in unit.waivers.items():
+            for rule, _ in items:
+                if rule not in known:
+                    findings.append(Finding(
+                        "waiver-syntax", unit.path, line,
+                        f"waiver names unknown rule '{rule}' — see "
+                        f"--list-rules for the vocabulary"))
+
+    for p in passes:
+        for f in p.run(project):
+            findings.append(f)
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+
+    # occurrence disambiguation for identical (path,symbol,rule,message)
+    seen: Dict[str, int] = {}
+    for f in findings:
+        base = f"{f.path}::{f.symbol}::{f.rule}::{f.message}"
+        seen[base] = seen.get(base, 0) + 1
+        f.occurrence = seen[base]
+
+    for f in findings:
+        unit = project.by_path.get(f.path)
+        reason = unit.waiver_reason(f.rule, f.line) if unit else None
+        if reason is not None:
+            f.status, f.reason = "waived", reason
+        elif f.key in baseline:
+            f.status, f.reason = "baselined", baseline[f.key]
+
+    # Aliased groups: identical findings are keyed by ORDER (#n), so
+    # when a group holds both baselined and active members, which line
+    # inherited the baseline entry is arbitrary — a NEW identical
+    # violation above an acknowledged one swaps identities with it.
+    # The count stays fail-closed (one new finding => one active), but
+    # the line attribution must say it is approximate (found by review).
+    groups: Dict[str, List[Finding]] = {}
+    for f in findings:
+        base = f"{f.path}::{f.symbol}::{f.rule}::{f.message}"
+        groups.setdefault(base, []).append(f)
+    for members in groups.values():
+        statuses = {m.status for m in members}
+        if len(members) > 1 and "active" in statuses \
+                and "baselined" in statuses:
+            n_base = sum(1 for m in members if m.status == "baselined")
+            for m in members:
+                if m.status == "active":
+                    m.note = (f"{n_base} identical sibling(s) "
+                              f"baselined — line attribution within "
+                              f"this group is by order; re-triage the "
+                              f"whole group")
+    return findings
+
+
+def run_paths(paths: Sequence[str], root: str, passes: Sequence[LintPass],
+              baseline_path: Optional[str] = None) -> List[Finding]:
+    project = build_project(paths, root)
+    return analyze_project(project, passes,
+                           load_baseline(baseline_path))

@@ -1,0 +1,19 @@
+"""mxlint pass registry — one pass per load-bearing invariant."""
+
+from .trace_purity import TracePurityPass
+from .outcome_discipline import OutcomeDisciplinePass
+from .page_refcount import PageRefcountPass
+from .host_sync import HostSyncPass
+from .lock_discipline import LockDisciplinePass
+
+ALL_PASSES = (
+    TracePurityPass,
+    OutcomeDisciplinePass,
+    PageRefcountPass,
+    HostSyncPass,
+    LockDisciplinePass,
+)
+
+
+def default_passes():
+    return [cls() for cls in ALL_PASSES]

@@ -1,0 +1,395 @@
+"""Pass 4 — hidden device→host syncs in the hot-loop modules.
+
+The serving/training hot loops are built around ONE designed host sync
+per step (the decode readback); every additional forcing op —
+``.item()``, ``jax.device_get``, ``np.asarray``/``np.array`` over a
+jax value, an implicit ``bool()`` in a host branch — serializes the
+host against the device pipeline and silently costs a dispatch bubble
+on every step. The chaos harnesses cannot see these (they are
+correctness-neutral); only a static pass can.
+
+Scope: the hot functions of serve/engine.py (step/run and the
+admission/prefill/draft path), serve/router.py dispatch, and the fused
+optimizer apply — plus everything they call in the same module. Device
+values are tracked by a small forward taint: results of calling
+jit-compiled attributes (``self.X`` where ``X`` was assigned
+``jax.jit(…)``), jit-dict lookups, ``jax.*``/``jnp.*`` calls, and
+same-module functions that return such values. EVERY finding here
+requires a waiver naming why the sync is off the critical path — that
+is the point: the designed syncs become documented contracts.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..core import Finding, Project, SourceUnit, dotted, qualname_of
+from ._callgraph import walk_own
+
+RULE = "host-sync"
+
+# module path -> seed hot functions (method names); the pass closes
+# over same-module self/local calls from these.
+HOT_SEEDS: Dict[str, Set[str]] = {
+    "incubator_mxnet_tpu/serve/engine.py": {
+        "step", "run", "_advance_prefill", "_run_chunk",
+        "_dense_prefill", "_finish_prefill", "_propose_drafts",
+        "_ensure_tail_pages", "_admit", "_try_admit", "_finish_token",
+        "_evict", "_quarantine", "_expire_slots", "_expire_queue",
+        "_preempt",
+    },
+    "incubator_mxnet_tpu/serve/router.py": {
+        "_dispatch", "step", "run", "_route", "_collect",
+    },
+    "incubator_mxnet_tpu/optimizer/fused.py": {
+        "apply", "_apply_group", "grad_all_finite",
+    },
+}
+
+_FORCING_CASTS = {"float", "int", "bool"}
+_NP_CAST = {"asarray", "array"}
+
+
+def _head(d: Optional[str]) -> str:
+    return d.split(".")[0] if d else ""
+
+
+class _ModuleModel:
+    """Per-module facts: jit-valued attributes/dicts and the
+    returns-device fixpoint over its functions."""
+
+    def __init__(self, unit: SourceUnit):
+        self.unit = unit
+        self.jit_attrs: Set[str] = set()
+        self.jit_dict_attrs: Set[str] = set()
+        # name -> EVERY def of that name (router.py has Replica.step
+        # AND Router.step — last-wins would silently drop one hot
+        # path's coverage; the pass errs toward analyzing all of them)
+        self.functions: Dict[str, List[ast.AST]] = {}
+        self.returns_device: Set[str] = set()     # function/method names
+        if unit.tree is None:
+            return
+        for node in ast.walk(unit.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions.setdefault(node.name, []).append(node)
+        self._collect_jit_attrs()
+        self._fixpoint()
+
+    def _is_jit_call(self, node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        d = dotted(node.func) or ""
+        return d in ("jax.jit", "jax.pjit") or d.endswith(".pallas_call")
+
+    def _collect_jit_attrs(self) -> None:
+        jit_locals: Set[Tuple[int, str]] = set()  # (scope id, name)
+        for node in ast.walk(self.unit.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            is_jit = self._is_jit_call(node.value)
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and is_jit:
+                    self.jit_attrs.add(t.attr)        # self.X = jax.jit
+                elif isinstance(t, ast.Name) and is_jit:
+                    jit_locals.add(t.id)
+                elif isinstance(t, ast.Subscript):
+                    base = t.value
+                    if isinstance(base, ast.Attribute):
+                        v = node.value
+                        if is_jit or (isinstance(v, ast.Name)
+                                      and v.id in jit_locals):
+                            self.jit_dict_attrs.add(base.attr)
+
+    def _fixpoint(self) -> None:
+        changed = True
+        while changed:
+            changed = False
+            for name, fns in self.functions.items():
+                if name in self.returns_device:
+                    continue
+                for fn in fns:
+                    taint = _TaintWalker(self, fn)
+                    taint.walk()
+                    if taint.returns_tainted:
+                        self.returns_device.add(name)
+                        changed = True
+                        break
+
+
+class _TaintWalker:
+    """One forward pass over a function body tracking which local names
+    hold device values."""
+
+    def __init__(self, model: _ModuleModel, func: ast.AST):
+        self.model = model
+        self.func = func
+        self.tainted: Set[str] = set()
+        self.returns_tainted = False
+        self.sinks: List[Tuple[ast.AST, str]] = []
+
+    # -- expression taint ---------------------------------------------- #
+    def is_tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Call):
+            return self.call_returns_device(node) or any(
+                self.is_tainted(a) for a in node.args)
+        if isinstance(node, (ast.Attribute, ast.Subscript, ast.Starred)):
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.BinOp):
+            return self.is_tainted(node.left) or \
+                self.is_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_tainted(node.operand)
+        if isinstance(node, ast.Compare):
+            # `x is None` / `x is not None` are host identity checks —
+            # they never touch the device value
+            if all(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in node.ops):
+                return False
+            return self.is_tainted(node.left) or any(
+                self.is_tainted(c) for c in node.comparators)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.is_tainted(e) for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return self.is_tainted(node.body) or \
+                self.is_tainted(node.orelse)
+        return False
+
+    def call_returns_device(self, call: ast.Call) -> bool:
+        func = call.func
+        d = dotted(func) or ""
+        h = _head(d)
+        mods = self.model.unit.import_modules
+        # jax.* / jnp.* values are device values
+        if h and mods.get(h, "").startswith("jax") and "." in d:
+            return True
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            # self.<jit attr>(…)
+            if isinstance(base, ast.Name) and base.id == "self":
+                if func.attr in self.model.jit_attrs:
+                    return True
+                if func.attr in self.model.returns_device:
+                    return True
+                return False
+            # self.<jit dict attr>[k](…) handled via Name assignment;
+            # direct form self._jits[sig](…):
+            if isinstance(base, ast.Subscript) and \
+                    isinstance(base.value, ast.Attribute) and \
+                    base.value.attr in self.model.jit_dict_attrs:
+                return True
+            # <jit dict attr>.get(sig)(…) — rare, covered by locals
+            return False
+        if isinstance(func, ast.Name):
+            if func.id in self.tainted:     # fn = self._jits[sig]; fn()
+                return True
+            if func.id in self.model.returns_device:
+                return True
+        if isinstance(func, ast.Subscript):
+            base = func.value
+            if isinstance(base, ast.Attribute) and \
+                    base.attr in self.model.jit_dict_attrs:
+                return True
+        return False
+
+    def _jit_lookup(self, value: ast.AST) -> bool:
+        """name = self._jits[sig] / self._jits.get(sig) / jax.jit(f)."""
+        if isinstance(value, ast.Subscript):
+            base = value.value
+            return isinstance(base, ast.Attribute) and \
+                base.attr in self.model.jit_dict_attrs
+        if isinstance(value, ast.Call):
+            f = value.func
+            if isinstance(f, ast.Attribute) and f.attr == "get" and \
+                    isinstance(f.value, ast.Attribute) and \
+                    f.value.attr in self.model.jit_dict_attrs:
+                return True
+            d = dotted(f) or ""
+            if d in ("jax.jit", "jax.pjit") or d.endswith(".pallas_call"):
+                return True
+        return False
+
+    # -- statement walk ------------------------------------------------ #
+    def _is_forcing_cast(self, node: ast.AST) -> bool:
+        """float()/int()/bool()/np.asarray()/np.array() RESULTS are host
+        values — the sync already happened at the cast (which is where
+        the sink fires); downstream uses are free."""
+        if not isinstance(node, ast.Call):
+            return False
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in _FORCING_CASTS:
+            return True
+        d = dotted(f) or ""
+        return bool(d) and \
+            self.model.unit.import_modules.get(_head(d)) == "numpy" \
+            and d.split(".")[-1] in _NP_CAST
+
+    def walk(self, collect_sinks: bool = False) -> None:
+        """One forward pass in statement order: each statement's own
+        expressions are checked for sinks against the taint state AT
+        THAT POINT, then its bindings are applied (so
+        ``emitted = np.asarray(emitted)`` flags the sync AND untaints
+        the rebound name for everything after)."""
+        self._call_sinks: List[Tuple[ast.AST, str]] = []
+        self._branch_sinks: List[Tuple[ast.AST, str]] = []
+        for stmt in self._ordered_stmts(self.func):
+            for expr in self._own_exprs(stmt):
+                if collect_sinks:
+                    self._scan_expr_sinks(expr)
+            if isinstance(stmt, ast.Assign):
+                src_tainted = (self.is_tainted(stmt.value) or
+                               self._jit_lookup(stmt.value)) and \
+                    not self._is_forcing_cast(stmt.value)
+                for t in stmt.targets:
+                    for name_node in ast.walk(t):
+                        if isinstance(name_node, ast.Name):
+                            if src_tainted:
+                                self.tainted.add(name_node.id)
+                            else:
+                                self.tainted.discard(name_node.id)
+            elif isinstance(stmt, ast.AugAssign):
+                if isinstance(stmt.target, ast.Name) and \
+                        self.is_tainted(stmt.value):
+                    self.tainted.add(stmt.target.id)
+            elif isinstance(stmt, ast.Return) and stmt.value is not None:
+                if self.is_tainted(stmt.value):
+                    self.returns_tainted = True
+            elif isinstance(stmt, (ast.If, ast.While)) and collect_sinks:
+                if self.is_tainted(stmt.test):
+                    self._branch_sinks.append(
+                        (stmt, "implicit `bool()` on a device value in "
+                               "a host branch — hidden device→host "
+                               "sync"))
+
+    @staticmethod
+    def _ordered_stmts(func: ast.AST):
+        stmts = [n for n in walk_own(func) if isinstance(n, ast.stmt)]
+        stmts.sort(key=lambda n: (n.lineno, n.col_offset))
+        return stmts
+
+    @staticmethod
+    def _own_exprs(stmt: ast.stmt):
+        """The expression children of a statement (not nested stmts)."""
+        if isinstance(stmt, ast.Assign):
+            return [stmt.value]
+        if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            return [stmt.value] if stmt.value is not None else []
+        if isinstance(stmt, (ast.Return, ast.Expr)):
+            return [stmt.value] if stmt.value is not None else []
+        if isinstance(stmt, (ast.If, ast.While)):
+            return [stmt.test]
+        if isinstance(stmt, ast.For):
+            return [stmt.iter]
+        if isinstance(stmt, ast.With):
+            return [i.context_expr for i in stmt.items]
+        if isinstance(stmt, ast.Assert):
+            return [stmt.test]
+        if isinstance(stmt, ast.Raise):
+            return [e for e in (stmt.exc, stmt.cause) if e is not None]
+        return []
+
+    def _scan_expr_sinks(self, expr: ast.AST) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                msg = self._sink_message(node)
+                if msg:
+                    self._call_sinks.append((node, msg))
+
+    def find_sinks(self) -> List[Tuple[ast.AST, str]]:
+        self.tainted = set()
+        self.walk(collect_sinks=True)
+        # keep only the INNERMOST sink of a nested chain like
+        # int(np.asarray(tok)) — the inner call is the actual sync
+        ids = {id(n) for n, _ in self._call_sinks}
+        out = list(self._branch_sinks)
+        for node, msg in self._call_sinks:
+            nested = any(id(sub) in ids for sub in ast.walk(node)
+                         if sub is not node)
+            if not nested:
+                out.append((node, msg))
+        return out
+
+    def _sink_message(self, call: ast.Call) -> Optional[str]:
+        func = call.func
+        d = dotted(func) or ""
+        if isinstance(func, ast.Attribute) and func.attr == "item" \
+                and not call.args:
+            # taint-guarded like the other sinks: `.item()` on a host
+            # numpy scalar is not a sync and must not demand a waiver
+            # asserting a falsehood (trace-purity separately flags
+            # .item() inside traced code regardless of taint)
+            if self.is_tainted(func.value):
+                return ("`.item()` — device→host sync; the host "
+                        "stalls on the device pipeline")
+            return None
+        if d == "jax.device_get":
+            return "`jax.device_get` — explicit device→host sync"
+        mods = self.model.unit.import_modules
+        h = _head(d)
+        tail = d.split(".")[-1] if d else ""
+        if h and mods.get(h) == "numpy" and tail in _NP_CAST:
+            if call.args and self.is_tainted(call.args[0]):
+                return (f"`{d}()` over a device value — forces a "
+                        f"device→host sync")
+            return None
+        if isinstance(func, ast.Name) and func.id in _FORCING_CASTS:
+            if call.args and self.is_tainted(call.args[0]):
+                return (f"host `{func.id}()` of a device value — "
+                        f"forces a device→host sync")
+        return None
+
+
+class HostSyncPass:
+    name = "host-sync"
+    rules = (RULE,)
+
+    def __init__(self, hot_seeds: Optional[Dict[str, Set[str]]] = None):
+        self.hot_seeds = HOT_SEEDS if hot_seeds is None else hot_seeds
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for path, seeds in self.hot_seeds.items():
+            unit = project.by_path.get(path)
+            if unit is None or unit.tree is None:
+                continue
+            model = _ModuleModel(unit)
+            hot = self._close_over_calls(model, seeds)
+            for name in sorted(hot):
+                for fn in model.functions.get(name, ()):
+                    taint = _TaintWalker(model, fn)
+                    for node, msg in taint.find_sinks():
+                        out.append(Finding(
+                            RULE, unit.path, node.lineno,
+                            f"{msg} (hot path: "
+                            f"{path.rsplit('/', 1)[-1]}:{name}) — "
+                            f"requires a waiver naming why this is "
+                            f"off the critical path",
+                            symbol=qualname_of(node)))
+        return out
+
+    @staticmethod
+    def _close_over_calls(model: _ModuleModel,
+                          seeds: Set[str]) -> Set[str]:
+        hot = set(n for n in seeds if n in model.functions)
+        work = list(hot)
+        while work:
+            name = work.pop()
+            for fn in model.functions.get(name, ()):
+                for node in walk_own(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    callee = None
+                    f = node.func
+                    if isinstance(f, ast.Attribute) and \
+                            isinstance(f.value, ast.Name) and \
+                            f.value.id == "self":
+                        callee = f.attr
+                    elif isinstance(f, ast.Name):
+                        callee = f.id
+                    if callee and callee in model.functions \
+                            and callee not in hot:
+                        hot.add(callee)
+                        work.append(callee)
+        return hot

@@ -1,0 +1,257 @@
+"""Pass 5 — lock discipline for classes that own background threads.
+
+A class that starts a ``threading.Thread`` has two execution contexts
+touching its attributes: the thread target (and everything it calls)
+and the ordinary methods. The repo's contract (CheckpointManager is
+the template): such a class designates lock attributes
+(``self._lock = threading.Lock()/RLock()`` / a ``Condition``), and
+every WRITE to instance state from the thread context — and every
+main-side write to state the thread context touches — happens inside
+``with self.<lock>:`` or carries a waiver saying why it is safe
+(happens-before via start/join, a monotonic stat read torn at worst,
+…). ``__init__`` writes are exempt (construction happens-before the
+thread starts), as are the lock/thread attributes themselves.
+
+A class with a thread and NO lock gets every thread-context attribute
+write flagged: that is the PR-8/PR-9 class of bug (stat counters and
+completion flags racing between a writer thread and the step loop).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from ..core import Finding, Project, SourceUnit, dotted, parent, \
+    qualname_of
+
+RULE = "lock-discipline"
+
+_SCOPE = "incubator_mxnet_tpu/"
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+_EXEMPT_ATTRS = {"_thread", "_threads"}
+
+
+def _lock_factory_name(call: ast.AST,
+                       unit: SourceUnit) -> Optional[str]:
+    if not isinstance(call, ast.Call):
+        return None
+    d = dotted(call.func) or ""
+    parts = d.split(".")
+    tail = parts[-1]
+    if tail not in _LOCK_FACTORIES:
+        return None
+    if len(parts) == 1:
+        # bare Lock()/Condition(): honest if imported from threading,
+        # or (function-local `import threading as X` aliases make the
+        # import table incomplete) accepted as-is — a false lock attr
+        # only ever SUPPRESSES findings on guarded writes
+        sym = unit.import_symbols.get(tail)
+        return tail if sym is None or sym[0] in ("threading",
+                                                 "multiprocessing") \
+            else None
+    head = parts[0]
+    mod = unit.import_modules.get(head, head)
+    if mod in ("threading", "multiprocessing") or \
+            mod.startswith("threading."):
+        return tail
+    return None
+
+
+def _thread_target(call: ast.Call, unit: SourceUnit) -> Optional[ast.AST]:
+    """For ``threading.Thread(target=X)`` return the target expr."""
+    d = dotted(call.func) or ""
+    if not (d == "threading.Thread" or
+            (d == "Thread" and
+             unit.import_symbols.get("Thread", ("",))[0] == "threading")):
+        return None
+    for kw in call.keywords:
+        if kw.arg == "target":
+            return kw.value
+    return call.args[0] if call.args else None
+
+
+class _ClassModel:
+    def __init__(self, cls: ast.ClassDef, unit: SourceUnit):
+        self.cls = cls
+        self.unit = unit
+        self.methods: Dict[str, ast.AST] = {
+            n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        self.lock_attrs: Set[str] = set()
+        self.thread_targets: List[ast.AST] = []   # FunctionDef nodes
+        self._scan()
+
+    def _scan(self) -> None:
+        for m in self.methods.values():
+            local_defs = {n.name: n for n in ast.walk(m)
+                          if isinstance(n, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef))
+                          and n is not m}
+            for node in ast.walk(m):
+                if isinstance(node, ast.Assign):
+                    lock = _lock_factory_name(node.value, self.unit)
+                    if lock:
+                        for t in node.targets:
+                            if isinstance(t, ast.Attribute) and \
+                                    isinstance(t.value, ast.Name) and \
+                                    t.value.id == "self":
+                                self.lock_attrs.add(t.attr)
+                if isinstance(node, ast.Call):
+                    tgt = _thread_target(node, self.unit)
+                    if tgt is None:
+                        continue
+                    if isinstance(tgt, ast.Attribute) and \
+                            isinstance(tgt.value, ast.Name) and \
+                            tgt.value.id == "self" and \
+                            tgt.attr in self.methods:
+                        self.thread_targets.append(
+                            self.methods[tgt.attr])
+                    elif isinstance(tgt, ast.Name) and \
+                            tgt.id in local_defs:
+                        self.thread_targets.append(local_defs[tgt.id])
+
+    # -- thread-context closure over self-method calls ----------------- #
+    def thread_context(self) -> List[ast.AST]:
+        seen: Set[int] = set()
+        out: List[ast.AST] = []
+        work = list(self.thread_targets)
+        while work:
+            fn = work.pop()
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            out.append(fn)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id == "self" and \
+                        node.func.attr in self.methods:
+                    work.append(self.methods[node.func.attr])
+        return out
+
+
+def _under_lock(node: ast.AST, lock_attrs: Set[str]) -> bool:
+    cur = parent(node)
+    while cur is not None:
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                expr = item.context_expr
+                # with self._lock: / with self._cv: /
+                # with self._lock.acquire_timeout(...):
+                base = expr
+                if isinstance(base, ast.Call):
+                    base = base.func
+                d = dotted(base) or ""
+                parts = d.split(".")
+                if len(parts) >= 2 and parts[0] == "self" and \
+                        parts[1] in lock_attrs:
+                    return True
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # stay within the function being analyzed: a `with` in a
+            # CALLER does not protect the callee textually — but our
+            # walk is per-function, so stop at the boundary.
+            return False
+        cur = parent(cur)
+    return False
+
+
+def _self_attr_writes(fn: ast.AST):
+    for node in ast.walk(fn):
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            # self.x = / self.x += / self.x[k] =
+            base = t
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            if isinstance(base, ast.Attribute) and \
+                    isinstance(base.value, ast.Name) and \
+                    base.value.id == "self":
+                yield node, base.attr
+
+
+def _self_attr_reads(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self" and \
+                isinstance(node.ctx, ast.Load):
+            out.add(node.attr)
+    return out
+
+
+class LockDisciplinePass:
+    name = "lock-discipline"
+    rules = (RULE,)
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for unit in project.units:
+            if unit.tree is None or not unit.path.startswith(_SCOPE):
+                continue
+            for node in ast.walk(unit.tree):
+                if isinstance(node, ast.ClassDef):
+                    out.extend(self._check_class(node, unit))
+        return out
+
+    def _check_class(self, cls: ast.ClassDef,
+                     unit: SourceUnit) -> List[Finding]:
+        model = _ClassModel(cls, unit)
+        if not model.thread_targets:
+            return []
+        out: List[Finding] = []
+        thread_fns = model.thread_context()
+        thread_ids = {id(f) for f in thread_fns}
+        locks = model.lock_attrs
+
+        # attributes the thread context touches at all
+        thread_attrs: Set[str] = set()
+        for fn in thread_fns:
+            thread_attrs |= _self_attr_reads(fn)
+            thread_attrs |= {a for _, a in _self_attr_writes(fn)}
+        thread_attrs -= locks | _EXEMPT_ATTRS
+
+        # 1) writes from the thread context
+        for fn in thread_fns:
+            for node, attr in _self_attr_writes(fn):
+                if attr in locks or attr in _EXEMPT_ATTRS:
+                    continue
+                if locks and _under_lock(node, locks):
+                    continue
+                why = ("class starts a thread but designates no lock"
+                       if not locks else
+                       f"not inside `with self.<{'|'.join(sorted(locks))}>`")
+                out.append(Finding(
+                    RULE, unit.path, node.lineno,
+                    f"`self.{attr}` written from thread context "
+                    f"({cls.name}) without holding the class lock — "
+                    f"{why}; racing the main path",
+                    symbol=qualname_of(node)))
+
+        # 2) main-side writes to attributes the thread context touches
+        in_thread_subtree = {id(n) for f in thread_fns
+                             for n in ast.walk(f)}
+        for name, fn in model.methods.items():
+            if id(fn) in thread_ids or name == "__init__":
+                continue
+            for node, attr in _self_attr_writes(fn):
+                if id(node) in in_thread_subtree:
+                    continue        # nested thread target, handled above
+                if attr not in thread_attrs:
+                    continue
+                if locks and _under_lock(node, locks):
+                    continue
+                out.append(Finding(
+                    RULE, unit.path, node.lineno,
+                    f"`self.{attr}` is shared with {cls.name}'s thread "
+                    f"context but written on the main path without the "
+                    f"class lock",
+                    symbol=qualname_of(node)))
+        return out

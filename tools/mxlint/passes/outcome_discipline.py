@@ -1,0 +1,106 @@
+"""Pass 2 — terminal-outcome discipline in serve/ and train/.
+
+Every serving request ends in EXACTLY ONE terminal outcome recorded by
+``_record_terminal`` (serve/engine.py, serve/router.py); every training
+step ends in exactly one ``StepOutcome`` recorded by ``StepRecorder``
+(train/outcomes.py). A write of ``<x>.outcome``, ``last_outcome`` or a
+health counter anywhere else is how the PR-9 double-finish race got in:
+two code paths each "helpfully" finishing a request, each keeping its
+own count, disagreeing under faults.
+
+Allowed writers: any function literally named ``_record_terminal``,
+anything inside the ``StepRecorder`` class, checkpoint/state
+restoration (``load_state_dict``), and counter/None initialization in
+``__init__`` (construction, not a terminal transition). Everything
+else needs a waiver.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..core import Finding, Project, enclosing_scopes, qualname_of
+
+RULE = "terminal-outcome"
+
+_SCOPES = ("incubator_mxnet_tpu/serve/", "incubator_mxnet_tpu/train/")
+_ALLOWED_FUNCS = {"_record_terminal", "load_state_dict", "__init__"}
+_ALLOWED_CLASSES = {"StepRecorder"}
+_OUTCOME_ATTRS = {"outcome", "last_outcome"}
+_HEALTH_ATTRS = {"health", "health_by_tier"}
+
+
+def _allowed_site(node: ast.AST) -> bool:
+    for scope in enclosing_scopes(node):
+        if isinstance(scope, ast.ClassDef) \
+                and scope.name in _ALLOWED_CLASSES:
+            return True
+        if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and scope.name in _ALLOWED_FUNCS:
+            return True
+    return False
+
+
+def _is_none(value: ast.AST) -> bool:
+    return isinstance(value, ast.Constant) and value.value is None
+
+
+class OutcomeDisciplinePass:
+    name = "outcome-discipline"
+    rules = (RULE,)
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for unit in project.units:
+            if unit.tree is None or \
+                    not unit.path.startswith(_SCOPES):
+                continue
+            for node in ast.walk(unit.tree):
+                targets: List[ast.AST] = []
+                value = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AugAssign):
+                    targets, value = [node.target], None
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    targets, value = [node.target], node.value
+                else:
+                    continue
+                for t in targets:
+                    f = self._check_target(t, value, node, unit)
+                    if f is not None:
+                        out.append(f)
+        return out
+
+    def _check_target(self, target, value, node, unit):
+        # <x>.outcome = ... / <x>.last_outcome = ...
+        if isinstance(target, ast.Attribute) \
+                and target.attr in _OUTCOME_ATTRS:
+            if _allowed_site(node):
+                return None
+            if value is not None and _is_none(value):
+                return None      # reset/initialization, not a terminal
+            return Finding(
+                RULE, unit.path, node.lineno,
+                f"`.{target.attr}` written outside "
+                f"_record_terminal/StepRecorder — a second writer is a "
+                f"double-finish / lost-terminal race",
+                symbol=qualname_of(node))
+        # health[...] = / += outside the recorder
+        if isinstance(target, ast.Subscript):
+            base = target.value
+            # health[k] or health_by_tier[t][o]
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            if isinstance(base, ast.Attribute) \
+                    and base.attr in _HEALTH_ATTRS:
+                if _allowed_site(node):
+                    return None
+                return Finding(
+                    RULE, unit.path, node.lineno,
+                    f"health counter `{base.attr}[…]` mutated outside "
+                    f"_record_terminal/StepRecorder — counters drift "
+                    f"from per-request outcomes",
+                    symbol=qualname_of(node))
+        return None

@@ -1,0 +1,118 @@
+"""Pass 3 — KV page refcount pairing in serve/.
+
+``PageAllocator`` invariants (serve/paged_kv.py): every ``alloc``/
+``incref`` is someone's RESPONSIBILITY to ``decref``/``free``; page 0
+is the null page and is never allocated, shared, or freed; the
+refcount array is the allocator's alone. A leaked reference never
+crashes — it silently shrinks the pool until admission starves, which
+is exactly why it needs a static pass (the runtime page audit only
+sees leaks on paths a test drives).
+
+Checks:
+  - an ``alloc``/``incref`` call whose enclosing scope (function, then
+    class, then module) contains no reachable ``decref``/``free``/
+    ``release_held`` — an acquire with no paired release anywhere in
+    the owning component;
+  - literal page 0 (or ``NULL_PAGE``) passed to ``alloc``-family calls;
+  - refcount internals (``._rc`` / ``._free``) touched outside
+    ``PageAllocator``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from ..core import (Finding, Project, dotted, enclosing_scopes,
+                    qualname_of)
+
+RULE = "page-refcount"
+
+_SCOPE = "incubator_mxnet_tpu/serve/"
+_ACQUIRE = {"alloc", "incref"}
+_RELEASE = {"decref", "free", "release_held"}
+_INTERNAL = {"_rc", "_free"}
+
+
+def _calls_in(node: ast.AST):
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) \
+                and isinstance(sub.func, ast.Attribute):
+            yield sub
+
+
+def _has_release(scope: ast.AST) -> bool:
+    return any(c.func.attr in _RELEASE for c in _calls_in(scope))
+
+
+def _null_page_arg(call: ast.Call) -> bool:
+    if not call.args:
+        return False
+    a = call.args[0]
+    if isinstance(a, ast.Constant) and a.value == 0:
+        return True
+    return isinstance(a, ast.Name) and a.id == "NULL_PAGE"
+
+
+class PageRefcountPass:
+    name = "page-refcount"
+    rules = (RULE,)
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for unit in project.units:
+            if unit.tree is None or not unit.path.startswith(_SCOPE):
+                continue
+            for node in ast.walk(unit.tree):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute):
+                    attr = node.func.attr
+                    if attr in _ACQUIRE | {"decref"} | {"free"} \
+                            and _null_page_arg(node):
+                        out.append(Finding(
+                            RULE, unit.path, node.lineno,
+                            f"literal null page passed to "
+                            f"`.{attr}()` — page 0 is never "
+                            f"allocated, shared, or freed",
+                            symbol=qualname_of(node)))
+                    if attr in _ACQUIRE:
+                        f = self._check_pairing(node, unit)
+                        if f is not None:
+                            out.append(f)
+                elif isinstance(node, ast.Attribute) \
+                        and node.attr in _INTERNAL \
+                        and isinstance(node.value, ast.Name) \
+                        and node.value.id == "self":
+                    if not self._inside_allocator(node):
+                        out.append(Finding(
+                            RULE, unit.path, node.lineno,
+                            f"refcount internals `self.{node.attr}` "
+                            f"touched outside PageAllocator — refcount "
+                            f"arithmetic belongs to the allocator",
+                            symbol=qualname_of(node)))
+        return out
+
+    @staticmethod
+    def _inside_allocator(node: ast.AST) -> bool:
+        return any(isinstance(s, ast.ClassDef)
+                   and s.name == "PageAllocator"
+                   for s in enclosing_scopes(node))
+
+    def _check_pairing(self, call: ast.Call,
+                       unit) -> Optional[Finding]:
+        # skip calls ON the allocator itself (its own bookkeeping)
+        if self._inside_allocator(call):
+            return None
+        scopes = enclosing_scopes(call)
+        for scope in scopes:                # function(s), then class
+            if _has_release(scope):
+                return None
+        if unit.tree is not None and _has_release(unit.tree):
+            return None                     # module-level pairing
+        d = dotted(call.func) or call.func.attr
+        return Finding(
+            RULE, unit.path, call.lineno,
+            f"`{d}()` acquires a page reference but no "
+            f"decref/free/release_held is reachable in the enclosing "
+            f"function, class, or module — a silent pool leak",
+            symbol=qualname_of(call))
