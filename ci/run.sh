@@ -53,6 +53,21 @@ stage_stepbench() {
   JAX_PLATFORMS=cpu python tools/step_bench.py --smoke
 }
 
+stage_mfubench() {
+  echo "== mfubench: training-throughput regression guard (round 16 —"
+  echo "             the microbatch-accumulation program must compile"
+  echo "             exactly once across accumulation counts {1,4},"
+  echo "             a non-finite microbatch must veto the WHOLE"
+  echo "             accumulated apply as one outcome with params"
+  echo "             bit-identical, the guarded accumulated trajectory"
+  echo "             must match the unguarded one bitwise on clean"
+  echo "             streams, the overlapped bucket issue order must be"
+  echo "             deterministic and equal to the plan order, and"
+  echo "             every banked arm must carry tokens/s AND an MFU"
+  echo "             field computed from the same run)"
+  JAX_PLATFORMS=cpu python tools/step_bench.py --mfu --smoke
+}
+
 stage_servebench() {
   echo "== servebench: continuous-batching regression guard (the decode"
   echo "               family must compile exactly once per program — W=1"
@@ -143,7 +158,7 @@ ge.dryrun_multichip(8)"
 }
 
 stages=("$@")
-[ ${#stages[@]} -eq 0 ] && stages=(sanity lintcore native unit stepbench servebench quantbench chaossmoke fleetsmoke tiersmoke trainchaos ckptbench entry)
+[ ${#stages[@]} -eq 0 ] && stages=(sanity lintcore native unit stepbench mfubench servebench quantbench chaossmoke fleetsmoke tiersmoke trainchaos ckptbench entry)
 for s in "${stages[@]}"; do
   "stage_$s"
 done

@@ -30,9 +30,39 @@ from .base import MXNetError
 
 __all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
            "is_training", "mark_variables", "backward", "grad", "Function",
-           "set_recording", "set_training"]
+           "set_recording", "set_training", "register_grad_ready_hook",
+           "remove_grad_ready_hook"]
 
 _STATE = threading.local()
+
+# ----------------------------------------------------------------------- #
+# grad-ready hooks (round 16, docs/TRAINING_PERF.md): backward() flushes
+# each marked leaf's gradient as soon as its LAST contributing tape node
+# has run — not at the end of the whole backward — and fires these hooks
+# at that moment. This is the seam the Trainer's overlapped bucket
+# allreduce hangs off: a dtype bucket's collective is issued while the
+# rest of the backward is still dispatching, hiding the reduction behind
+# remaining compute (the reference's P3 priority propagation, eager
+# analogue). Hooks run with recording OFF and must not raise on foreign
+# leaves (a hook is global; it sees every backward in the process).
+# ----------------------------------------------------------------------- #
+_GRAD_READY_HOOKS: Dict[int, object] = {}
+_GRAD_HOOK_SEQ = [0]
+
+
+def register_grad_ready_hook(fn) -> int:
+    """Register ``fn(leaf, grad_buffer)`` to fire the moment a marked
+    variable's gradient is final inside ``backward()`` (all tape
+    contributions accumulated and flushed into the buffer). Returns a
+    handle for ``remove_grad_ready_hook``."""
+    _GRAD_HOOK_SEQ[0] += 1
+    handle = _GRAD_HOOK_SEQ[0]
+    _GRAD_READY_HOOKS[handle] = fn
+    return handle
+
+
+def remove_grad_ready_hook(handle) -> None:
+    _GRAD_READY_HOOKS.pop(handle, None)
 
 
 def _st():
@@ -212,8 +242,48 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
                 "did you forget autograd.record() or attach_grad()?")
 
     order = _topo(heads)
+    rev = list(reversed(order))
+
+    # early-finalization schedule: the LAST node (in execution order) that
+    # can contribute a cotangent to each marked leaf. Once that node has
+    # been processed the leaf's accumulator is final, so it can be flushed
+    # into the grad buffer and the grad-ready hooks fired while the rest
+    # of the backward is still running (docs/TRAINING_PERF.md overlap).
+    last_contrib: Dict[int, int] = {}
+    for k, node in enumerate(rev):
+        for owner in node.owners:
+            if owner is not None and \
+                    getattr(owner, "_ag_grad", None) is not None:
+                last_contrib[id(owner)] = k
+                leaves.setdefault(id(owner), owner)
+    flush_at: List[List[object]] = [[] for _ in rev]
+    for lid, k in last_contrib.items():
+        flush_at[k].append(leaves[lid])
+
+    def _flush_leaf(leaf):
+        total = leaf_acc.pop(id(leaf), None)
+        if total is None:
+            return
+        req = getattr(leaf, "_ag_grad_req", "write")
+        if req == "null":
+            return
+        gbuf = leaf._ag_grad
+        if req == "add":
+            gbuf._data = gbuf._data + total.astype(gbuf.dtype)
+        else:  # write
+            gbuf._data = total.astype(gbuf.dtype)
+        # Trainer's stale-grad contract: a grad buffer backward has
+        # refilled is FRESH; Trainer.step marks it stale after applying
+        gbuf._fresh = True
+        for fn in tuple(_GRAD_READY_HOOKS.values()):
+            fn(leaf, gbuf)
+
     with _ModeScope(recording=False, training=train_mode):
-        for node in reversed(order):
+        # marked heads no tape node can reach again (seed-only leaves)
+        # are final before any node runs
+        for lid in [k for k in leaf_acc if k not in last_contrib]:
+            _flush_leaf(leaves[lid])
+        for k, node in enumerate(rev):
             out_cots = []
             any_cot = False
             for o in node.outputs:
@@ -223,45 +293,40 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
                 else:
                     any_cot = True
                 out_cots.append(c)
-            if not any_cot:
-                continue
-            if node.custom_vjp is not None:
-                in_cots = node.custom_vjp(out_cots)
-            else:
-                _, vjp_fn = jax.vjp(node.pure_fn, *node.primals)
-                seed = tuple(out_cots) if node.tuple_out or len(out_cots) > 1 \
-                    else out_cots[0]
-                in_cots = vjp_fn(seed)
-            for owner, ic in zip(node.owners, in_cots):
-                if owner is None or ic is None:
-                    continue
-                if ic.dtype == jax.dtypes.float0:
-                    continue  # non-differentiable input (e.g. PRNG key)
-                # an array can be BOTH an intermediate (has a tape node to
-                # propagate through) and a marked variable (grad() /
-                # attach_grad on a non-leaf): feed both paths
-                child = getattr(owner, "_ag_node", None)
-                if child is not None:
-                    _add(cots, owner, ic)
-                    keep[id(owner)] = owner
-                if getattr(owner, "_ag_grad", None) is not None:
-                    _add(leaf_acc, owner, ic)
-                    leaves[id(owner)] = owner
+            if any_cot:
+                if node.custom_vjp is not None:
+                    in_cots = node.custom_vjp(out_cots)
+                else:
+                    _, vjp_fn = jax.vjp(node.pure_fn, *node.primals)
+                    seed = tuple(out_cots) \
+                        if node.tuple_out or len(out_cots) > 1 \
+                        else out_cots[0]
+                    in_cots = vjp_fn(seed)
+                for owner, ic in zip(node.owners, in_cots):
+                    if owner is None or ic is None:
+                        continue
+                    if ic.dtype == jax.dtypes.float0:
+                        continue  # non-differentiable input (e.g. PRNG key)
+                    # an array can be BOTH an intermediate (has a tape
+                    # node to propagate through) and a marked variable
+                    # (grad() / attach_grad on a non-leaf): feed both
+                    child = getattr(owner, "_ag_node", None)
+                    if child is not None:
+                        _add(cots, owner, ic)
+                        keep[id(owner)] = owner
+                    if getattr(owner, "_ag_grad", None) is not None:
+                        _add(leaf_acc, owner, ic)
+                        leaves[id(owner)] = owner
+            # flush every leaf whose final contribution this node was —
+            # even a node SKIPPED for lack of cotangents finalizes its
+            # leaves (nothing later can touch them)
+            for leaf in flush_at[k]:
+                _flush_leaf(leaf)
 
-    # flush leaf accumulators honoring grad_req
-    for key, total in leaf_acc.items():
-        leaf = leaves[key]
-        req = getattr(leaf, "_ag_grad_req", "write")
-        if req == "null":
-            continue
-        gbuf = leaf._ag_grad
-        if req == "add":
-            gbuf._data = gbuf._data + total.astype(gbuf.dtype)
-        else:  # write
-            gbuf._data = total.astype(gbuf.dtype)
-        # Trainer's stale-grad contract: a grad buffer backward has
-        # refilled is FRESH; Trainer.step marks it stale after applying
-        gbuf._fresh = True
+        # fallback: anything not finalized by the schedule (defensive —
+        # the schedule covers every owner relationship)
+        for lid in list(leaf_acc):
+            _flush_leaf(leaves[lid])
 
     if not retain_graph:
         for node in order:

@@ -27,6 +27,16 @@ rides the already-traced ``rescale_grad`` input: overflow skips + halves,
 ``scale_window`` clean steps double, never a recompile. K consecutive
 non-finite steps halt loudly (``HALTED_POISONED``) with a diagnostic
 naming the poisoned gradients.
+
+Round 16 (docs/TRAINING_PERF.md): ``overlap_allreduce=True`` issues
+each dtype bucket's pushpull DURING backward, the moment the bucket's
+last member gradient is final (autograd grad-ready hooks), in a
+deterministic plan order identical on every process — the serial
+post-backward communication tail becomes compute-overlapped.
+``accumulate_grads()`` + ``step(k)`` runs microbatch gradient
+accumulation in f32 with ONE combined guard verdict and ONE scaler
+update per accumulated round; the int8-allreduce seam ships the
+accumulated bucket once per round, unchanged.
 """
 
 from __future__ import annotations
@@ -51,7 +61,7 @@ class Trainer:
                  update_on_kvstore=None, fuse_step=None,
                  loss_scaler=None, guard=None,
                  max_consecutive_nonfinite=None,
-                 int8_allreduce=False):
+                 int8_allreduce=False, overlap_allreduce=None):
         if isinstance(params, (dict, ParameterDict)):
             param_list = [params[k] for k in sorted(params.keys())] \
                 if isinstance(params, dict) else list(params.values())
@@ -135,6 +145,50 @@ class Trainer:
         self._distributed = isinstance(kvstore, str) and \
             kvstore.startswith("dist")
 
+        # round 16 (docs/TRAINING_PERF.md): overlapped bucket-ready
+        # allreduce — each dtype bucket's pushpull is issued the moment
+        # backward finalizes its last member gradient (autograd
+        # grad-ready hooks), instead of serially after the full
+        # backward. Buckets issue strictly in a deterministic plan order
+        # (parallel.collectives.plan_grad_buckets) gated on readiness,
+        # so every process posts collectives in the same order — a
+        # reordered collective is a silent cross-replica deadlock on
+        # real hardware.
+        if overlap_allreduce is None:
+            overlap_allreduce = getenv_bool("MXTPU_OVERLAP_ALLREDUCE",
+                                            False)
+        if overlap_allreduce and not self._fuse_step:
+            warnings.warn(
+                "overlap_allreduce=True but the fused step is off — "
+                "gradient bucketing never runs, so the overlapped "
+                "collective is INERT", UserWarning, stacklevel=2)
+        self._overlap = bool(overlap_allreduce) and self._fuse_step
+        self._overlap_sched = None     # BucketSchedule | False = disabled
+        self.grad_issue_schedule = []  # last round's issued bucket keys
+        self._hook_handle = None
+        if self._overlap:
+            import weakref
+            from .. import autograd as _ag
+            ref = weakref.ref(self)
+            handle_box = []
+
+            def _hook(leaf, gbuf, _ref=ref, _box=handle_box):
+                tr = _ref()
+                if tr is None:           # trainer collected: self-remove
+                    _ag.remove_grad_ready_hook(_box[0])
+                    return
+                tr._on_grad_ready(leaf, gbuf)
+
+            handle_box.append(_ag.register_grad_ready_hook(_hook))
+            self._hook_handle = handle_box[0]
+
+        # round 16: eager microbatch gradient accumulation — f32
+        # accumulators folded per microbatch (accumulate_grads), ONE
+        # combined guard verdict + ONE scaler update at step()
+        self._accum = None             # param index -> f32 jax array
+        self._accum_count = 0          # microbatches folded this round
+        self._accum_mode = False       # latched by accumulate_grads()
+
     # -- kvstore bootstrap ---------------------------------------------- #
     def _init_kvstore(self):
         if self._kv_initialized:
@@ -190,6 +244,9 @@ class Trainer:
         snap["int8_allreduce"] = self._int8_allreduce
         snap["int8_buckets"] = self.int8_buckets
         snap["int8_bytes_saved"] = self.int8_bytes_saved
+        snap["overlap_allreduce"] = self._overlap
+        snap["grad_issue_schedule"] = list(self.grad_issue_schedule)
+        snap["accumulated_microbatches"] = self._accum_count
         return snap
 
     def scale_loss(self, loss):
@@ -243,7 +300,13 @@ class Trainer:
 
     # -- the step -------------------------------------------------------- #
     def step(self, batch_size, ignore_stale_grad=False):
-        """allreduce grads then update (parity: Trainer.step)."""
+        """allreduce grads then update (parity: Trainer.step).
+
+        With microbatch accumulation active (``accumulate_grads``), the
+        update applies from the f32 accumulators: pass the batch size
+        the SUMMED gradients correspond to (number of microbatches when
+        each microbatch loss is already a mean), and the round ends in
+        ONE StepOutcome with ONE loss-scaler update."""
         self._init_kvstore()
         if self._amp_loss_scaler is not None:
             # the dynamic scale rides the traced rescale_grad input —
@@ -251,17 +314,58 @@ class Trainer:
             self._scale = self._amp_original_scale / \
                 self._amp_loss_scaler.loss_scale
         self._optimizer.rescale_grad = self._scale / batch_size
-        self._allreduce_grads()
-        self._update(ignore_stale_grad)
+        overrides = self._accum_overrides()
+        try:
+            self._allreduce_grads(overrides)
+            self._update(ignore_stale_grad, overrides)
+        finally:
+            self._finish_round(overrides)
 
-    def _allreduce_grads(self):
+    def _accum_overrides(self):
+        """NDArray views over the f32 accumulators when a microbatch
+        round is pending (they replace ``p.grad()`` for reduction and
+        apply), else None."""
+        if not self._accum_count:
+            return None
+        from ..ndarray import NDArray
+        return {i: NDArray(a) for i, a in self._accum.items()}
+
+    def _finish_round(self, overrides):
+        """Close the step's overlap/accumulation round state (runs even
+        when the update raised): bank the issue-order ledger, reset the
+        schedule for the next backward, drop spent accumulators."""
+        sched = self._overlap_sched
+        if sched is not None and sched is not False:
+            if sched.issued:
+                self.grad_issue_schedule = list(sched.issued)
+            sched.reset_round()
+        if overrides is not None:
+            self._accum = None
+            self._accum_count = 0
+
+    def _allreduce_grads(self, overrides=None):
         if self._kvstore is None:
             return
+        if self._overlap and self._overlap_sched is None:
+            # build (or rebuild) the deterministic plan here — at step
+            # time, never inside the global autograd hook — so the NEXT
+            # backward's grad-ready hooks can start issuing; this step's
+            # reduction below runs the serial path (nothing issued yet)
+            self._build_overlap_plan()
         work = []
         for i, p in enumerate(self._params):
             if p.grad_req == "null":
                 continue
-            grads = p.list_grad()
+            if overrides is not None:
+                if i not in overrides:
+                    # no microbatch produced a fresh gradient for this
+                    # parameter this round — it is skipped at apply
+                    # (_update_inner warns), so don't reduce its stale
+                    # raw grad either
+                    continue
+                grads = [overrides[i]]
+            else:
+                grads = p.list_grad()
             # int8_allreduce includes single-replica grads too: the
             # quantize→dequantize roundtrip IS the effect under test
             # (the allreduce is identity there), so a one-process run
@@ -281,8 +385,16 @@ class Trainer:
                       not isinstance(g[0], RowSparseNDArray)]
         rest = [(i, g) for i, g in work
                 if len(g) != 1 or isinstance(g[0], RowSparseNDArray)]
-        if self._fuse_step and (len(bucketable) > 1 or
-                                (self._int8_allreduce and bucketable)):
+        sched = self._overlap_sched
+        if overrides is None and sched not in (None, False) and \
+                sched.issued:
+            # overlap already issued part of the plan during backward:
+            # flush the tail through the SAME plan — re-packing (or the
+            # per-param rest path) would reduce issued members a second
+            # time, inflating them by num_workers
+            self._overlap_flush({i: g[0] for i, g in bucketable})
+        elif self._fuse_step and (len(bucketable) > 1 or
+                                  (self._int8_allreduce and bucketable)):
             self._bucketed_pushpull(bucketable)
         else:
             rest = work
@@ -292,50 +404,42 @@ class Trainer:
     def _bucketed_pushpull(self, work):
         """One pushpull per (dtype, <=MXTPU_GRAD_BUCKET_MB) bucket instead
         of one per parameter — the eager analogue of the reference's
-        gradient bulking (kvstore comm buckets). Bucket keys encode the
+        gradient bulking (kvstore comm buckets). Packing and keys come
+        from the same audited planner the overlap path uses
+        (``plan_grad_buckets``, forward order here): keys encode the
         member composition, so dist-mode compression residuals stay
         coherent per bucket while the trainable set is stable, and start
         a FRESH residual stream if it changes (e.g. a layer is frozen
         mid-training) instead of applying a stale residual to a
         differently-shaped bucket."""
-        import zlib
+        from ..parallel.collectives import plan_grad_buckets
+        limit = getenv_int("MXTPU_GRAD_BUCKET_BYTES", 0) or \
+            getenv_int("MXTPU_GRAD_BUCKET_MB", 32) * (1 << 20)
+        gmap = {i: grads[0] for i, grads in work}
+        members = [(i, g.size, g._data.dtype.itemsize, str(g.dtype))
+                   for i, g in gmap.items()]
+        for bucket in plan_grad_buckets(members, limit, reverse=False):
+            self._pushpull_chunk(bucket.key,
+                                 [(i, gmap[i]) for i in bucket.indices])
+
+    def _pushpull_chunk(self, key, chunk):
+        """Ship one bucket: concat members, pushpull (int8-quantized
+        when enabled — the EQuARX seam), split the reduction back into
+        the member gradient buffers. Shared by the serial bucketed path
+        and the overlapped per-bucket issue."""
         from ..ndarray import NDArray
-        limit = getenv_int("MXTPU_GRAD_BUCKET_MB", 32) * (1 << 20)
-        by_dtype: Dict = {}
-        for i, grads in work:
-            by_dtype.setdefault(str(grads[0].dtype), []).append(
-                (i, grads[0]))
-        for dt, members in by_dtype.items():
-            start = 0
-            bucket_id = 0
-            while start < len(members):
-                end, nbytes = start, 0
-                while end < len(members):
-                    sz = members[end][1].size * \
-                        members[end][1]._data.dtype.itemsize
-                    if end > start and nbytes + sz > limit:
-                        break
-                    nbytes += sz
-                    end += 1
-                chunk = members[start:end]
-                flat = jnp.concatenate(
-                    [g._data.ravel() for _, g in chunk])
-                comp = zlib.crc32(",".join(
-                    f"{i}:{g.size}" for i, g in chunk).encode())
-                key = f"__grad_bucket_{dt}_{bucket_id}_{comp:08x}"
-                if self._int8_allreduce:
-                    flat = self._int8_pushpull(key, flat)
-                    bucket = NDArray(flat)
-                else:
-                    bucket = NDArray(flat)
-                    self._kvstore.pushpull(key, bucket, out=bucket)
-                off = 0
-                for _, g in chunk:
-                    n = g.size
-                    g._data = bucket._data[off:off + n].reshape(g.shape)
-                    off += n
-                start = end
-                bucket_id += 1
+        flat = jnp.concatenate([g._data.ravel() for _, g in chunk])
+        if self._int8_allreduce:
+            flat = self._int8_pushpull(key, flat)
+            bucket = NDArray(flat)
+        else:
+            bucket = NDArray(flat)
+            self._kvstore.pushpull(key, bucket, out=bucket)
+        off = 0
+        for _, g in chunk:
+            n = g.size
+            g._data = bucket._data[off:off + n].reshape(g.shape)
+            off += n
 
     def _int8_pushpull(self, key, flat):
         """Quantize one gradient bucket to int8 codes with a single
@@ -374,10 +478,191 @@ class Trainer:
         self._init_kvstore()
         self._allreduce_grads()
 
-    def _update(self, ignore_stale_grad=False):
+    # -- overlapped bucket-ready allreduce (round 16) -------------------- #
+    def _on_grad_ready(self, leaf, gbuf):
+        """autograd grad-ready hook: fires mid-backward the moment a
+        leaf's gradient is final. Marks the owning bucket ready and
+        issues every bucket the plan-order gate clears — the collective
+        dispatch is async, so it rides behind the remaining backward
+        compute. Foreign leaves (other models/trainers in the process)
+        and accumulation rounds fall through untouched."""
+        if self._accum_mode or self._accum_count:
+            # microbatch accumulation: only the ACCUMULATED gradients
+            # cross the wire, at apply time (see accumulate_grads)
+            return
+        sched = self._overlap_sched
+        if sched is None or sched is False:
+            # plan not built yet (it builds at the first step() so hooks
+            # never pay an O(params) scan for foreign backwards) or
+            # overlap cannot engage
+            return
+        tag = getattr(gbuf, "_ov_member", None)
+        if tag is None or tag[0]() is not self:
+            return                       # another model/trainer's leaf
+        for bucket in sched.mark_ready(tag[1]):
+            self._issue_bucket(bucket)
+
+    def _build_overlap_plan(self):
+        """Deterministic bucket plan over the current trainable set
+        (parallel.collectives.plan_grad_buckets): a pure function of
+        (member indices, sizes, dtypes, byte limit), identical on every
+        process. Disabled (schedule = False) when nothing can engage —
+        no kvstore, no reduction needed, or a grad_req='add' parameter
+        (its gradient is only final after an unknowable number of
+        backwards, so mid-backward issue would ship partial sums)."""
+        from ..ndarray.sparse import RowSparseNDArray
+        from ..parallel.collectives import (BucketSchedule,
+                                            plan_grad_buckets)
+        self._init_kvstore()
+        if self._kvstore is None:
+            self._overlap_sched = False
+            return
+        engages = self._kvstore.num_workers > 1 or self._int8_allreduce
+        members, tagged = [], []
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null" or p._grad is None:
+                continue
+            grads = p.list_grad()
+            if len(grads) != 1 or isinstance(grads[0], RowSparseNDArray):
+                continue                 # the step-time `rest` path
+            if p.grad_req == "add":
+                warnings.warn(
+                    f"overlap_allreduce disabled: parameter `{p.name}` "
+                    f"has grad_req='add' — its gradient is not final "
+                    f"until the last of an unknowable number of "
+                    f"backwards, so a mid-backward collective would "
+                    f"ship a partial sum", UserWarning, stacklevel=3)
+                self._overlap_sched = False
+                return
+            g = grads[0]
+            members.append((i, g.size, g._data.dtype.itemsize,
+                            str(g.dtype)))
+            tagged.append((i, g))
+        # mirror the step-time bucketed gate exactly: a single
+        # non-int8 member never buckets there, so overlapping it here
+        # would hand the SAME gradient to the step's per-param rest
+        # path — a second reduction (num_workers× inflation)
+        if not engages or not (len(members) > 1 or
+                               (self._int8_allreduce and members)):
+            self._overlap_sched = False
+            return
+        # tag member grad buffers so the global hook rejects foreign
+        # leaves in O(1) (the buffer object is stable: backward and the
+        # bucket split both swap its _data in place)
+        import weakref
+        ref = weakref.ref(self)
+        for i, g in tagged:
+            g._ov_member = (ref, i)
+        limit = getenv_int("MXTPU_GRAD_BUCKET_BYTES", 0) or \
+            getenv_int("MXTPU_GRAD_BUCKET_MB", 32) * (1 << 20)
+        self._overlap_sched = BucketSchedule(
+            plan_grad_buckets(members, limit))
+
+    def _issue_bucket(self, bucket):
+        chunk = [(i, self._params[i].grad()) for i in bucket.indices]
+        self._pushpull_chunk(bucket.key, chunk)
+
+    def _overlap_flush(self, work_by_idx):
+        """End-of-backward flush: issue the plan's unissued tail (grads
+        are certainly final at step time). A trainable-set change since
+        the plan was built falls back to per-parameter pushpulls for
+        the never-issued members (re-bucketing them under the legacy
+        packing would re-reduce already-issued members) and rebuilds
+        the plan for the next backward."""
+        sched = self._overlap_sched
+        plan_idx = {i for b in sched.buckets for i in b.indices}
+        if plan_idx != set(work_by_idx):
+            issued_idx = set()
+            issued_keys = set(sched.issued)
+            for b in sched.buckets:
+                if b.key in issued_keys:
+                    issued_idx |= set(b.indices)
+            for i, g in sorted(work_by_idx.items()):
+                if i in issued_idx:
+                    continue
+                if self._int8_allreduce:
+                    # keep the compressed seam even on the transition
+                    # step: a plain pushpull here would silently skip
+                    # quantization and skew the banked convergence delta
+                    self._pushpull_chunk(
+                        f"__grad_bucket_{g.dtype}_fb{i}", [(i, g)])
+                else:
+                    self._kvstore.pushpull(i, g, out=g)
+            self._overlap_sched = None   # rebuilt at the next step()
+            return
+        for bucket in sched.drain():
+            self._issue_bucket(bucket)
+
+    # -- eager microbatch gradient accumulation (round 16) --------------- #
+    def set_grad_accumulation(self, active: bool):
+        """Declare that the NEXT backwards belong to microbatch
+        accumulation rounds, so the overlapped allreduce defers to
+        apply time from the very first microbatch (without the
+        declaration, the first microbatch's backward cannot be told
+        apart from a plain step's and an overlap-enabled trainer would
+        issue its collective on partial gradients — refused loudly by
+        ``accumulate_grads``). ``accumulate_grads()`` latches this
+        automatically for every later round; set False to return to
+        per-step overlapped reduction."""
+        self._accum_mode = bool(active)
+
+    def accumulate_grads(self):
+        """Fold the current (fresh) gradients into persistent f32
+        accumulators and mark them consumed — the eager half of
+        in-step gradient accumulation (docs/TRAINING_PERF.md). Call
+        once per microbatch after ``backward``; ``step(batch_size)``
+        then applies from the accumulators with ONE combined guard
+        verdict (non-finite values propagate through the f32 sum, so a
+        NaN in any microbatch skips the whole apply bit-identically)
+        and ONE loss-scaler update per accumulated step. Returns the
+        number of microbatches folded so far this round."""
+        sched = self._overlap_sched
+        if sched not in (None, False) and sched.issued:
+            raise MXNetError(
+                "accumulate_grads() cannot compose with an overlapped "
+                "allreduce that already issued this round — the issued "
+                "bucket reduced a single microbatch's gradients. Build "
+                "the Trainer with overlap_allreduce=False for "
+                "microbatch accumulation (the apply-time reduction "
+                "already ships each gradient byte once per accumulated "
+                "step).")
+        items = []
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null" or p._grad is None:
+                continue
+            g = p.grad()
+            if not getattr(g, "_fresh", True):
+                continue          # backward touched nothing new here
+            items.append((i, g))
+        if not items:
+            raise MXNetError("accumulate_grads() found no fresh "
+                             "gradients; run backward() first")
+        if self._accum is None:
+            self._accum = {}
+        acc_vals, grad_vals = [], []
+        for i, g in items:
+            a = self._accum.get(i)
+            if a is None:
+                a = jnp.zeros(g.shape, jnp.float32)
+            acc_vals.append(a)
+            grad_vals.append(g._data)
+        if self._fused is not None:
+            new_accs = self._fused.accumulate(tuple(acc_vals),
+                                              tuple(grad_vals))
+        else:
+            new_accs = tuple(a + v.astype(jnp.float32)
+                             for a, v in zip(acc_vals, grad_vals))
+        for (i, g), na in zip(items, new_accs):
+            self._accum[i] = na
+            g._fresh = False
+        self._accum_count += 1
+        self._accum_mode = True    # later rounds defer overlap upfront
+        return self._accum_count
+
+    def _update(self, ignore_stale_grad=False, overrides=None):
         self._recorder.open_step()
         try:
-            self._update_inner(ignore_stale_grad)
+            self._update_inner(ignore_stale_grad, overrides)
         except BaseException:
             # a step that died before reaching the recorder (dispatch
             # error, interrupt) is a real error, not a step outcome —
@@ -387,7 +672,7 @@ class Trainer:
             self._recorder.abort_step()
             raise
 
-    def _update_inner(self, ignore_stale_grad=False):
+    def _update_inner(self, ignore_stale_grad=False, overrides=None):
         updater = self._updaters[0]
         fused_items = []
         sparse_items = []
@@ -397,8 +682,25 @@ class Trainer:
         for i, p in enumerate(self._params):
             if p.grad_req == "null":
                 continue
-            grad = p.grad()
-            if not getattr(grad, "_fresh", True):
+            if overrides is not None:
+                if i not in overrides:
+                    # the accumulated round never saw a fresh gradient
+                    # for this parameter: applying its stale raw grad at
+                    # the round's rescale would silently corrupt it, so
+                    # it is ALWAYS skipped (warned unless the caller
+                    # opted into stale-skips already)
+                    if not ignore_stale_grad:
+                        warnings.warn(
+                            f"Parameter `{p.name}` received no gradient "
+                            f"in any microbatch of the accumulated "
+                            f"round; it is skipped this step.",
+                            UserWarning, stacklevel=3)
+                    saw_stale_skip = True
+                    continue
+                grad = overrides[i]       # fresh by construction
+            else:
+                grad = p.grad()
+            if overrides is None and not getattr(grad, "_fresh", True):
                 # backward has not refilled this grad since the last step
                 # (reference Trainer's _fresh_grad contract)
                 if ignore_stale_grad:
@@ -503,7 +805,11 @@ class Trainer:
             self._scale = self._amp_original_scale / \
                 self._amp_loss_scaler.loss_scale
         self._optimizer.rescale_grad = self._scale / batch_size
-        self._update(ignore_stale_grad)
+        overrides = self._accum_overrides()
+        try:
+            self._update(ignore_stale_grad, overrides)
+        finally:
+            self._finish_round(overrides)
 
     def zero_grad(self):
         for p in self._params:

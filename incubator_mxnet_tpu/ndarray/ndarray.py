@@ -89,7 +89,7 @@ class NDArray:
     """
 
     __slots__ = ("_data", "_ag_node", "_ag_idx", "_ag_grad", "_ag_grad_req",
-                 "_fresh", "__weakref__")
+                 "_fresh", "_ov_member", "__weakref__")
 
     def __init__(self, data):
         if isinstance(data, NDArray):

@@ -179,9 +179,11 @@ class FusedApplier:
         self.guard = bool(guard)
         self._jits: Dict = {}
         self._guard_jits: Dict = {}
+        self._accum_jits: Dict = {}
         self.trace_count = 0      # executions of a traced body (compiles)
         self.call_count = 0       # fused group dispatches
         self.guard_trace_count = 0  # all-finite reduction compiles
+        self.accum_trace_count = 0  # f32 accumulate-program compiles
         self.skipped_steps = 0    # guard-vetoed apply() calls
 
     # ------------------------------------------------------------------ #
@@ -209,6 +211,34 @@ class FusedApplier:
             fn = jax.jit(allfinite)
             self._guard_jits[sig] = fn
         return fn(vals)
+
+    def accumulate(self, acc_vals, grad_vals):
+        """One jitted f32 microbatch-gradient accumulation:
+        ``acc + grad.astype(f32)`` over the whole fused set (round 16,
+        docs/TRAINING_PERF.md). f32 accumulators keep low-precision
+        microbatch gradients from losing mass to rounding, and
+        non-finite values PROPAGATE through the sum — so the apply-time
+        all-finite verdict over the accumulators is the COMBINED
+        verdict for the accumulated step (a NaN in any microbatch skips
+        the whole apply). Compiled once per (shape, dtype) signature,
+        accumulators donated; the program's shape never depends on the
+        accumulation count, so changing counts never retraces
+        (``accum_trace_count`` asserted in tests and
+        tools/step_bench.py --mfu --smoke)."""
+        sig = tuple((v.shape, str(v.dtype)) for v in grad_vals)
+        fn = self._accum_jits.get(sig)
+        if fn is None:
+            applier = self
+
+            def accum(accs, grads):
+                applier.accum_trace_count += 1   # trace-time only
+                return tuple(a + g.astype(jnp.float32)
+                             for a, g in zip(accs, grads))
+
+            fn = jax.jit(accum,
+                         donate_argnums=(0,) if self.donate else ())
+            self._accum_jits[sig] = fn
+        return fn(tuple(acc_vals), tuple(grad_vals))
 
     def apply(self, items: Sequence, updater,
               extra_grads: Sequence = ()) -> bool:

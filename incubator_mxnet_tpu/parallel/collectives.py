@@ -155,6 +155,146 @@ def host_allreduce_2bit(x: jax.Array, residual: Optional[jax.Array],
     return total.astype(x.dtype), new_res
 
 
+# ----------------------------------------------------------------------- #
+# deterministic gradient-bucket schedule (round 16, docs/TRAINING_PERF.md)
+#
+# The overlapped allreduce fires a bucket's collective DURING backward,
+# which makes the issue order a correctness surface: on real hardware a
+# collective is a rendezvous, so two processes issuing buckets in
+# different orders deadlock (each waits on a collective the other has
+# not posted). The plan below is a pure function of (member indices,
+# sizes, dtypes, byte limit) — identical on every process — and buckets
+# are ISSUED strictly in plan order, gated on readiness: a ready bucket
+# behind an unready one waits (issue order == plan order, every run,
+# every process; asserted in tests/test_train_perf.py). Members are
+# packed in REVERSE parameter order because backward finalizes the last
+# layers' gradients first — the plan order approximates readiness order
+# so the gate rarely stalls.
+# ----------------------------------------------------------------------- #
+
+class GradBucket:
+    """One dtype bucket of the overlap plan: a deterministic key plus the
+    member parameter indices (in packing order)."""
+
+    __slots__ = ("key", "dtype", "indices", "nbytes")
+
+    def __init__(self, key: str, dtype: str, indices, nbytes: int):
+        self.key = key
+        self.dtype = dtype
+        self.indices = tuple(indices)
+        self.nbytes = int(nbytes)
+
+    def __repr__(self):
+        return (f"GradBucket({self.key!r}, n={len(self.indices)}, "
+                f"{self.nbytes}B)")
+
+
+def plan_grad_buckets(members, limit_bytes: int,
+                      key_prefix: str = "__grad_bucket_",
+                      reverse: bool = True):
+    """Deterministic bucket plan over ``members`` =
+    ``[(param_idx, size_elems, itemsize, dtype_str)]`` — THE one
+    audited packing, shared by the serial bucketed pushpull
+    (``reverse=False``: forward param order, the PR-1 key format) and
+    the overlapped issue plan (``reverse=True``).
+
+    Groups by dtype (sorted), packs each dtype's members in param-index
+    order — REVERSE for the overlap plan, because backward finalizes
+    the deepest layers first — into <= ``limit_bytes`` buckets, and
+    orders the buckets deepest-parameter-first (reverse) or
+    shallowest-first (forward). Keys follow the PR-1 bucket-key format
+    (dtype + running id + crc of the member composition; the overlap
+    plan's ids carry an ``ov`` tag since its compositions differ) so
+    dist-mode compression residuals stay coherent per composition."""
+    import zlib
+    by_dtype = {}
+    for idx, size, itemsize, dt in members:
+        by_dtype.setdefault(str(dt), []).append((int(idx), int(size),
+                                                 int(itemsize)))
+    tag = "ov" if reverse else ""
+    buckets = []
+    for dt in sorted(by_dtype):
+        entries = sorted(by_dtype[dt],
+                         key=(lambda e: -e[0]) if reverse
+                         else (lambda e: e[0]))
+        start, bucket_id = 0, 0
+        while start < len(entries):
+            end, nbytes = start, 0
+            while end < len(entries):
+                sz = entries[end][1] * entries[end][2]
+                if end > start and nbytes + sz > limit_bytes:
+                    break
+                nbytes += sz
+                end += 1
+            chunk = entries[start:end]
+            comp = zlib.crc32(",".join(
+                f"{i}:{n}" for i, n, _ in chunk).encode())
+            buckets.append(GradBucket(
+                f"{key_prefix}{dt}_{tag}{bucket_id}_{comp:08x}", dt,
+                [i for i, _, _ in chunk], nbytes))
+            start = end
+            bucket_id += 1
+    if reverse:
+        buckets.sort(key=lambda b: (-max(b.indices), b.dtype))
+    else:
+        buckets.sort(key=lambda b: (min(b.indices), b.dtype))
+    return buckets
+
+
+class BucketSchedule:
+    """Readiness-gated, plan-ordered issue schedule over a bucket plan.
+
+    ``mark_ready(param_idx)`` records one member gradient as final and
+    returns the list of buckets now clear to issue: the next bucket in
+    plan order issues only when every member is ready AND every earlier
+    bucket has issued — so the observed issue order is the plan order by
+    construction (the cross-process deadlock-freedom contract above).
+    ``drain()`` returns the still-unissued tail (the end-of-backward
+    flush). ``issued`` is the per-round ledger of issued bucket keys."""
+
+    def __init__(self, buckets):
+        self.buckets = list(buckets)
+        self._member_of = {}
+        for b in self.buckets:
+            for i in b.indices:
+                self._member_of[i] = b
+        self._pending = {b.key: len(b.indices) for b in self.buckets}
+        self._cursor = 0
+        self.issued = []
+
+    @property
+    def order(self):
+        return [b.key for b in self.buckets]
+
+    def reset_round(self):
+        self._pending = {b.key: len(b.indices) for b in self.buckets}
+        self._cursor = 0
+        self.issued = []
+
+    def mark_ready(self, param_idx: int):
+        b = self._member_of.get(param_idx)
+        if b is None:
+            return []
+        n = self._pending.get(b.key, 0)
+        if n > 0:
+            self._pending[b.key] = n - 1
+        ready = []
+        while self._cursor < len(self.buckets) and \
+                self._pending[self.buckets[self._cursor].key] == 0:
+            nxt = self.buckets[self._cursor]
+            self._cursor += 1
+            self.issued.append(nxt.key)
+            ready.append(nxt)
+        return ready
+
+    def drain(self):
+        tail = self.buckets[self._cursor:]
+        self._cursor = len(self.buckets)
+        for b in tail:
+            self.issued.append(b.key)
+        return tail
+
+
 def host_broadcast(x: jax.Array, root: int = 0) -> jax.Array:
     """Broadcast ``x`` from the root process to all processes (the
     reference's init-time weight broadcast via kvstore init/pull)."""

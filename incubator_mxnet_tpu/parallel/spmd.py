@@ -222,6 +222,15 @@ class SPMDTrainer:
                 UserWarning, stacklevel=2)
         self._recorder = StepRecorder(max_consecutive_nonfinite)
         self.step_trace_count = 0    # fused-step compiles (jit-once)
+        # round 16 (docs/TRAINING_PERF.md): in-step traced gradient
+        # accumulation — ONE once-compiled microbatch program whose
+        # accumulation count is pure host data (see step_microbatches)
+        self.accum_step_trace_count = 0
+        self._accum_step_fn = None
+        self._accum_bufs = None      # f32 grad accumulators (jax arrays)
+        self._accum_ok = None        # carried combined-verdict scalar
+        self._accum_loss = None      # carried loss-sum scalar
+        self.last_accum_count = 0    # k of the last accumulated round
 
         params = list(block.collect_params().values())
         not_ready = [p.name for p in params
@@ -270,6 +279,9 @@ class SPMDTrainer:
         snap["loss_scale"] = (None if self.loss_scaler is None
                               else float(self.loss_scaler.loss_scale))
         snap["guard"] = self.guard
+        snap["step_trace_count"] = self.step_trace_count
+        snap["accum_step_trace_count"] = self.accum_step_trace_count
+        snap["last_accum_count"] = self.last_accum_count
         return snap
 
     # ------------------------------------------------------------------ #
@@ -398,24 +410,8 @@ class SPMDTrainer:
                 ok_flag = jnp.float32(1.0)
             return new_train, aux, new_leaves, loss_val, ok_flag
 
-        mesh = self.mesh
-        repl = NamedSharding(mesh, PartitionSpec())
-        batch_sh = NamedSharding(mesh, PartitionSpec(("fsdp", "dp")))
-        train_sh = tuple(
-            _param_sharding(params[i], mesh, self.sharding_mode)
-            for i in train_idx)
-        frozen_sh = tuple(
-            _param_sharding(params[i], mesh, self.sharding_mode)
-            for i in range(len(params)) if i not in train_set)
-        # optimizer-state leaves share their parameter's sharding
-        state_sh = []
-        for slot, i in enumerate(train_idx):
-            n_leaves = len(jtu.tree_leaves(
-                jtu.tree_map(lambda s: 0, self._opt_state[slot],
-                             is_leaf=lambda s: isinstance(s, NDArray))))
-            state_sh.extend(
-                [_param_sharding(params[i], mesh, self.sharding_mode)]
-                * n_leaves)
+        repl, batch_sh, train_sh, frozen_sh, state_sh = \
+            self._step_shardings()
 
         donate = (0, 2) if self.donate else ()
         return jax.jit(
@@ -429,6 +425,307 @@ class SPMDTrainer:
             out_shardings=(train_sh, frozen_sh, tuple(state_sh), repl,
                            repl),
             donate_argnums=donate)
+
+    def _step_shardings(self):
+        mesh = self.mesh
+        params = self._params
+        train_set = set(self._train_idx)
+        repl = NamedSharding(mesh, PartitionSpec())
+        batch_sh = NamedSharding(mesh, PartitionSpec(("fsdp", "dp")))
+        train_sh = tuple(
+            _param_sharding(params[i], mesh, self.sharding_mode)
+            for i in self._train_idx)
+        frozen_sh = tuple(
+            _param_sharding(params[i], mesh, self.sharding_mode)
+            for i in range(len(params)) if i not in train_set)
+        # optimizer-state leaves share their parameter's sharding
+        state_sh = []
+        for slot, i in enumerate(self._train_idx):
+            n_leaves = len(jtu.tree_leaves(
+                jtu.tree_map(lambda s: 0, self._opt_state[slot],
+                             is_leaf=lambda s: isinstance(s, NDArray))))
+            state_sh.extend(
+                [_param_sharding(params[i], mesh, self.sharding_mode)]
+                * n_leaves)
+        return repl, batch_sh, train_sh, frozen_sh, state_sh
+
+    # ------------------------------------------------------------------ #
+    # in-step traced gradient accumulation (round 16,
+    # docs/TRAINING_PERF.md). ONE once-compiled program processes one
+    # microbatch per call and carries (f32 grad accumulators, combined
+    # all-finite verdict, loss sum) as donated state; ``is_last`` and
+    # ``inv_k`` ride as traced scalars, so the accumulation count k is
+    # PURE HOST DATA — changing k between rounds never retraces
+    # (``accum_step_trace_count`` asserted; the scan-over-k alternative
+    # recompiles per count because the reshaped batch changes shape).
+    # The apply is a where-select on ``is_last AND all-micros-finite``:
+    # a NaN in microbatch 2 of 8 poisons the carried verdict and the
+    # whole apply skips, params/optimizer state bit-identical — ONE
+    # combined verdict, ONE StepOutcome, ONE loss-scaler update per
+    # accumulated step (the PR-8 guard/scaler contract, composed).
+    # ------------------------------------------------------------------ #
+    def _build_accum_step(self, n_batch):
+        params = self._params
+        train_idx = self._train_idx
+        train_set = set(train_idx)
+        optimizer = self._optimizer
+        block = self.block
+        loss = self.loss
+        forward_loss = self.forward_loss
+        self_mesh = self.mesh
+        from ..gluon.block import _hybrid_trace_scope
+
+        def pure_loss(train_vals, frozen_vals, key, *batch):
+            saved = [p._data for p in params]
+            it_t, it_f = iter(train_vals), iter(frozen_vals)
+            for i, p in enumerate(params):
+                p._data = NDArray(next(it_t) if i in train_set
+                                  else next(it_f))
+            try:
+                with _hybrid_trace_scope(), _random.key_provider(key), \
+                        autograd._ModeScope(recording=False,
+                                            training=True), \
+                        activation_sharding_scope(self_mesh):
+                    batch_nd = [NDArray(b) for b in batch]
+                    if forward_loss is not None:
+                        L = forward_loss(block, *batch_nd)
+                    else:
+                        out = block(batch_nd[0])
+                        L = loss(out, *batch_nd[1:])
+                    if L.ndim > 0:
+                        L = L.mean()
+                    aux = []
+                    for i, p in enumerate(params):
+                        if i not in train_set:
+                            aux.append(p._data._data)
+            finally:
+                for p, s in zip(params, saved):
+                    p._data = s
+            return L._data, tuple(aux)
+
+        guard = self.guard
+        trainer = self
+        base_rescale = float(optimizer.rescale_grad)
+
+        def astep(train_vals, frozen_vals, opt_leaves, opt_tree,
+                  acc_vals, acc_ok, acc_loss, t, lr, scale, inv_k,
+                  is_last, key, *batch):
+            trainer.accum_step_trace_count += 1   # trace time only
+            (loss_val, aux), grads = jax.value_and_grad(
+                lambda tv, fv, k, *b: (
+                    (lambda L, a: (L * scale, a))(*pure_loss(tv, fv, k,
+                                                             *b))
+                ), argnums=0, has_aux=True)(
+                    train_vals, frozen_vals, key, *batch)
+            loss_val = loss_val / scale
+            # fold this microbatch into the f32 accumulators; non-finite
+            # values propagate through the sum AND the explicit verdict
+            # product below, so the round's apply decision is combined
+            new_acc = tuple(a + g.astype(jnp.float32)
+                            for a, g in zip(acc_vals, grads))
+            from ..optimizer.fused import all_finite, apply_updates
+            if guard:
+                ok_round = acc_ok * all_finite(grads)
+            else:
+                ok_round = jnp.float32(1.0)
+            loss_round = acc_loss + loss_val
+            # the apply (mean of the accumulated f32 gradients), always
+            # traced, selected only on the last microbatch of a clean
+            # round — the where-select skip idiom of the PR-8 guard,
+            # extended with the is_last gate
+            opt_state = jtu.tree_unflatten(opt_tree, opt_leaves)
+            apply_grads = tuple(a * inv_k for a in new_acc)
+            new_train, new_states = apply_updates(
+                optimizer, train_idx, train_vals, apply_grads, opt_state,
+                t, lr, rescale_grad=jnp.float32(base_rescale) / scale)
+            new_leaves = tuple(jtu.tree_leaves(tuple(new_states)))
+            last_p = is_last > 0
+            apply_p = jnp.logical_and(last_p, ok_round > 0)
+            new_train = tuple(jnp.where(apply_p, nw, w)
+                              for nw, w in zip(new_train, train_vals))
+            new_leaves = tuple(jnp.where(apply_p, nl, ol)
+                               for nl, ol in zip(new_leaves, opt_leaves))
+            # accumulators reset at round end regardless of verdict (a
+            # vetoed round's batch is discarded, PR-8 skip semantics)
+            acc_out = tuple(jnp.where(last_p, jnp.zeros_like(na), na)
+                            for na in new_acc)
+            acc_ok_out = jnp.where(last_p, jnp.float32(1.0), ok_round)
+            acc_loss_out = jnp.where(last_p, jnp.float32(0.0),
+                                     loss_round)
+            return (new_train, tuple(aux), new_leaves, acc_out,
+                    acc_ok_out, acc_loss_out, loss_round * inv_k,
+                    ok_round)
+
+        repl, batch_sh, train_sh, frozen_sh, state_sh = \
+            self._step_shardings()
+        acc_sh = train_sh                 # accumulators shard like params
+        donate = (0, 2, 4) if self.donate else ()
+        return jax.jit(
+            astep,
+            static_argnums=(3,),
+            in_shardings=(train_sh, frozen_sh, tuple(state_sh), acc_sh,
+                          repl, repl, repl, repl, repl, repl, repl,
+                          repl) + (batch_sh,) * n_batch,
+            out_shardings=(train_sh, frozen_sh, tuple(state_sh), acc_sh,
+                           repl, repl, repl, repl),
+            donate_argnums=donate)
+
+    def step_microbatches(self, microbatches):
+        """Run ONE optimizer step over ``microbatches`` (a sequence of
+        batch tuples of identical shapes), accumulating gradients in
+        f32 inside the once-compiled microbatch program and applying
+        the mean once at the end. The accumulation count is pure host
+        data — rounds of 1, 4 and 8 microbatches all run the same
+        compiled program (``accum_step_trace_count`` stays 1; changing
+        the MICROBATCH SHAPE retraces, changing the count never does).
+        The PR-8 guard/scaler contract composes as one round-level
+        verdict: a non-finite gradient in ANY microbatch skips the
+        whole apply (params, optimizer state and BN aux bit-identical
+        to the round start), records ONE ``SKIPPED_NONFINITE`` and
+        halves the loss scale ONCE. Returns the round's mean loss."""
+        batches = [b if isinstance(b, (tuple, list)) else (b,)
+                   for b in microbatches]
+        if not batches:
+            raise MXNetError("step_microbatches needs >= 1 microbatch")
+        k = len(batches)
+        dp = self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
+        rounds = []
+        for batch in batches:
+            nds = [b if isinstance(b, NDArray)
+                   else NDArray(jnp.asarray(b)) for b in batch]
+            for b in nds:
+                if b.ndim and b.shape[0] % dp != 0:
+                    raise MXNetError(
+                        f"microbatch dim {b.shape[0]} not divisible by "
+                        f"the mesh's dp×fsdp size {dp}")
+            rounds.append(nds)
+        if self._opt_state is None:
+            self._materialize(rounds[0])
+        if self._accum_step_fn is None:
+            self._accum_step_fn = self._build_accum_step(len(rounds[0]))
+        if self._accum_bufs is None:
+            # f32 accumulators placed with their parameter's sharding
+            repl, _, train_sh, _, _ = self._step_shardings()
+            self._accum_bufs = [
+                jax.device_put(
+                    jnp.zeros(self._params[i].shape, jnp.float32), sh)
+                for i, sh in zip(self._train_idx, train_sh)]
+            self._accum_ok = jnp.float32(1.0)
+            self._accum_loss = jnp.float32(0.0)
+
+        import numpy as _host_np
+        train_set = set(self._train_idx)
+        self._optimizer.num_update = self.step_count
+        t = _host_np.float32(self.step_count + 1)
+        lr = _host_np.float32(float(self._optimizer.learning_rate))
+        scale = _host_np.float32(
+            1.0 if self.loss_scaler is None
+            else self.loss_scaler.loss_scale)
+        inv_k = _host_np.float32(1.0 / k)
+        # round-start frozen-param snapshot (array refs, not copies):
+        # BN running stats advance per microbatch, and a vetoed round
+        # must roll NOTHING forward — restored below on veto
+        frozen_saved = [p._data._data
+                        for i, p in enumerate(self._params)
+                        if i not in train_set]
+
+        self._recorder.open_step()
+        loss_report = ok_report = None
+        try:
+            for m, batch_nds in enumerate(rounds):
+                is_last = _host_np.float32(1.0 if m == k - 1 else 0.0)
+                key = _random.new_key()
+                train_vals = tuple(self._params[i]._data._data
+                                   for i in self._train_idx)
+                frozen_vals = tuple(
+                    p._data._data for i, p in enumerate(self._params)
+                    if i not in train_set)
+                opt_leaves, opt_tree = jtu.tree_flatten(
+                    jtu.tree_map(
+                        lambda s: s._data if isinstance(s, NDArray)
+                        else s,
+                        tuple(self._opt_state),
+                        is_leaf=lambda s: isinstance(s, NDArray)))
+                batch_vals = self._global_batch_vals(
+                    [b._data for b in batch_nds])
+                if jax.process_count() > 1:
+                    key = _host_np.asarray(key)
+                (new_train, aux, new_leaves, acc_out, acc_ok_out,
+                 acc_loss_out, loss_report, ok_report) = \
+                    self._accum_step_fn(
+                        train_vals, frozen_vals, tuple(opt_leaves),
+                        opt_tree, tuple(self._accum_bufs),
+                        self._accum_ok, self._accum_loss, t, lr, scale,
+                        inv_k, is_last, key, *batch_vals)
+                it_t, it_a = iter(new_train), iter(aux)
+                for i, p in enumerate(self._params):
+                    p._data._data = next(it_t) if i in train_set \
+                        else next(it_a)
+                self._opt_state = [
+                    jtu.tree_map(NDArray, st)
+                    for st in jtu.tree_unflatten(opt_tree,
+                                                 list(new_leaves))]
+                self._accum_bufs = list(acc_out)
+                self._accum_ok = acc_ok_out
+                self._accum_loss = acc_loss_out
+        except BaseException:
+            # dispatch died mid-round: close the step and drop the
+            # half-accumulated state (re-zeroed on the next round)
+            self._recorder.abort_step()
+            self._accum_bufs = None
+            raise
+
+        self.last_accum_count = k
+        # the ONE designed readback per accumulated round: the combined
+        # verdict steers host counters, the scaler and the outcome —
+        # read after every microbatch is dispatched
+        applied = (not self.guard) or \
+            bool(_host_np.asarray(ok_report) > 0)
+        if applied:
+            self.step_count += 1
+            self._recorder.record(StepOutcome.APPLIED)
+            if self.loss_scaler is not None and self.guard:
+                self.loss_scaler.update_scale(overflow=False)
+        else:
+            # roll the per-microbatch BN/aux mutations back to the
+            # round start — a vetoed round rolls NOTHING forward
+            it_f = iter(frozen_saved)
+            for i, p in enumerate(self._params):
+                if i not in train_set:
+                    p._data._data = next(it_f)
+            if self.loss_scaler is not None:
+                self.loss_scaler.update_scale(overflow=True)
+            detail = (f"non-finite gradient in accumulated SPMD round "
+                      f"(k={k}) at step_count={self.step_count}")
+            outcome = self._recorder.record(
+                StepOutcome.SKIPPED_NONFINITE, detail)
+            if outcome is StepOutcome.HALTED_POISONED:
+                raise self._recorder.halt_error(
+                    detail,
+                    loss_scale=None if self.loss_scaler is None
+                    else self.loss_scaler.loss_scale)
+        return NDArray(loss_report)
+
+    def _global_batch_vals(self, batch_vals):
+        """Multi-host batch placement (every process holds the SAME full
+        batch; build global dp-sharded arrays from the host copies) —
+        identity in single-process runs."""
+        if jax.process_count() <= 1:
+            return batch_vals
+        import numpy as _host_np
+        batch_sh = NamedSharding(self.mesh,
+                                 PartitionSpec(("fsdp", "dp")))
+
+        def _globalize(b):
+            if len(b.devices()) > 1:
+                return b
+            host = _host_np.asarray(b)
+            if host.ndim == 0:
+                return host
+            return jax.make_array_from_callback(
+                host.shape, batch_sh, lambda idx: host[idx])
+
+        return [_globalize(b) for b in batch_vals]
 
     # ------------------------------------------------------------------ #
     def step(self, *batch):
@@ -463,24 +760,9 @@ class SPMDTrainer:
         scale = _host_np.float32(
             1.0 if self.loss_scaler is None
             else self.loss_scaler.loss_scale)
-        batch_vals = [b._data for b in batch_nds]
+        batch_vals = self._global_batch_vals([b._data for b in batch_nds])
         if jax.process_count() > 1:
-            # multi-host: every process holds the SAME full batch (SPMD
-            # input contract); build global dp-sharded arrays from the
-            # host copies — committed process-local device arrays cannot
-            # be resharded cross-process
             key = _host_np.asarray(key)
-            batch_sh = NamedSharding(self.mesh,
-                                     PartitionSpec(("fsdp", "dp")))
-            def _globalize(b):
-                if len(b.devices()) > 1:
-                    return b
-                host = _host_np.asarray(b)
-                if host.ndim == 0:
-                    return host
-                return jax.make_array_from_callback(
-                    host.shape, batch_sh, lambda idx: host[idx])
-            batch_vals = [_globalize(b) for b in batch_vals]
 
         self._recorder.open_step()
         try:

@@ -1,3 +1,4 @@
-"""Utility subsystems: serialization, docs, misc helpers."""
+"""Utility subsystems: serialization, FLOPs/MFU accounting, misc."""
 
 from . import serialization  # noqa: F401
+from . import flops  # noqa: F401

@@ -1,0 +1,683 @@
+"""Round 16 (docs/TRAINING_PERF.md): overlapped bucket-ready allreduce,
+in-step gradient accumulation, and MFU accounting.
+
+The training-perf invariants, in the compile-count discipline of
+PR 2/6: the overlapped bucket issue order is a DETERMINISTIC pure
+function of the trainable set (a reordered collective is a silent
+cross-replica deadlock on real hardware); an accumulation-count change
+never retraces the microbatch program; the PR-8 guard/scaler compose
+with accumulation as ONE combined verdict per accumulated step (a NaN
+in microbatch 2 of 8 skips the whole apply bit-identically, the loss
+scale halves once); and the int8-allreduce seam (PR 11) reads its
+verdict from dequantized gradients unchanged.
+"""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd, parallel
+from incubator_mxnet_tpu import kvstore as kv_mod
+from incubator_mxnet_tpu.amp.loss_scaler import LossScaler
+from incubator_mxnet_tpu.base import MXNetError
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.parallel import mesh as pmesh
+from incubator_mxnet_tpu.parallel.collectives import (BucketSchedule,
+                                                      plan_grad_buckets)
+from incubator_mxnet_tpu.train import StepOutcome
+
+
+def _build_net(seed=0, bn=False):
+    mx.random.seed(seed)
+    net = nn.Sequential()
+    if bn:
+        net.add(nn.Dense(16, in_units=8, activation="relu"),
+                nn.BatchNorm(in_channels=16),
+                nn.Dense(4, in_units=16))
+    else:
+        net.add(nn.Dense(16, in_units=8, activation="relu"),
+                nn.Dense(4, in_units=16))
+    net.initialize()
+    return net
+
+
+def _data(seed=1, n=8):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(n, 8).astype(np.float32),
+            rng.randn(n, 4).astype(np.float32))
+
+
+def _spy_kv(num_workers=2):
+    """A 'device' kvstore forced onto the reduction path, with every
+    pushpull key recorded (the test_fused_step idiom)."""
+    kv = kv_mod.create("device")
+    kv._num_workers = num_workers
+    calls = []
+    orig = kv.pushpull
+
+    def spy(key, value, out=None, priority=0):
+        calls.append(key)
+        return orig(key, value, out=out, priority=priority)
+
+    kv.pushpull = spy
+    return kv, calls
+
+
+def _params_snapshot(net):
+    return [p.data().asnumpy().copy()
+            for p in net.collect_params().values()]
+
+
+# --------------------------------------------------------------------- #
+# bucket plan + schedule units (host-only, no compiles)
+# --------------------------------------------------------------------- #
+
+def test_plan_grad_buckets_deterministic_pure_function():
+    members = [(i, 1000 + i, 4, "float32") for i in range(10)] + \
+              [(i, 500, 2, "bfloat16") for i in range(10, 14)]
+    a = plan_grad_buckets(members, 8 * 1024)
+    b = plan_grad_buckets(list(reversed(members)), 8 * 1024)
+    assert [x.key for x in a] == [x.key for x in b]  # input-order free
+    assert [x.indices for x in a] == [x.indices for x in b]
+    # packing is reverse-param-index within dtype; plan order leads
+    # with the bucket holding the deepest parameter
+    assert a[0].indices[0] == max(i for b_ in a for i in b_.indices
+                                  if b_.dtype == a[0].dtype)
+    # byte limit respected (single members may exceed it)
+    for bk in a:
+        if len(bk.indices) > 1:
+            assert bk.nbytes <= 8 * 1024
+
+
+def test_bucket_schedule_issues_in_plan_order_gated_on_readiness():
+    buckets = plan_grad_buckets(
+        [(i, 10, 4, "float32") for i in range(6)], 2 * 40)
+    sched = BucketSchedule(buckets)
+    # bucket 0 holds the HIGHEST indices; readying a later-plan bucket
+    # first must not issue it out of order
+    later = buckets[1].indices
+    issued = []
+    for i in later:
+        issued += sched.mark_ready(i)
+    assert issued == []                    # gated behind plan bucket 0
+    for i in buckets[0].indices:
+        issued += sched.mark_ready(i)
+    # bucket 0 ready -> releases itself AND the already-ready bucket 1
+    assert [b.key for b in issued] == [buckets[0].key, buckets[1].key]
+    tail = sched.drain()
+    assert [b.key for b in tail] == [b.key for b in buckets[2:]]
+    assert sched.issued == [b.key for b in buckets]
+    sched.reset_round()
+    assert sched.issued == []
+    assert sched.mark_ready(999) == []     # foreign index: no-op
+
+
+# --------------------------------------------------------------------- #
+# overlapped allreduce on the eager Trainer
+# --------------------------------------------------------------------- #
+
+def _overlap_trainer(net, kv, **kw):
+    return gluon.Trainer(net.collect_params(), "sgd",
+                         {"learning_rate": 0.1}, kvstore=kv,
+                         fuse_step=True, overlap_allreduce=True, **kw)
+
+
+def _one_step(net, tr, x, batch=4):
+    with autograd.record():
+        loss = (net(x) ** 2).mean()
+    loss.backward()
+    tr.step(batch)
+
+
+def test_overlap_issues_during_backward_and_schedule_is_stable(
+        monkeypatch):
+    monkeypatch.setenv("MXTPU_GRAD_BUCKET_BYTES", "600")  # several buckets
+    net = _build_net()
+    kv, calls = _spy_kv()
+    tr = _overlap_trainer(net, kv)
+    x = nd.array(np.random.RandomState(0).randn(4, 8).astype(np.float32))
+    _one_step(net, tr, x)                  # plan builds at step 1
+    scheds = []
+    for _ in range(3):
+        with autograd.record():
+            loss = (net(x) ** 2).mean()
+        before = len(calls)
+        loss.backward()
+        in_backward = calls[before:len(calls)]
+        assert len(in_backward) >= 1       # issued DURING backward
+        tr.step(4)
+        scheds.append(list(tr.grad_issue_schedule))
+        assert in_backward == scheds[-1][:len(in_backward)]
+    # stable across runs and equal to the deterministic plan order
+    assert scheds[0] == scheds[1] == scheds[2]
+    assert scheds[0] == tr._overlap_sched.order
+    assert len(scheds[0]) > 1
+    snap = tr.health_snapshot()
+    assert snap["overlap_allreduce"] is True
+    assert snap["grad_issue_schedule"] == scheds[0]
+
+
+def test_overlap_matches_serial_reduction_bitwise():
+    results = []
+    for overlap in (False, True):
+        net = _build_net()
+        kv, _ = _spy_kv()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1}, kvstore=kv,
+                           fuse_step=True, overlap_allreduce=overlap)
+        x = nd.array(np.random.RandomState(0)
+                     .randn(4, 8).astype(np.float32))
+        for _ in range(4):
+            _one_step(net, tr, x)
+        results.append(_params_snapshot(net))
+    for a, b in zip(*results):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_overlap_partial_backward_flushes_at_step(monkeypatch):
+    """A backward reaching only the DEEP layer readies (and issues) the
+    plan's first bucket mid-backward; the shallow layers' buckets never
+    ready, and step() drains that tail itself — the gate can stall, the
+    step cannot."""
+    monkeypatch.setenv("MXTPU_GRAD_BUCKET_BYTES", "300")
+    mx.random.seed(0)
+    d1 = nn.Dense(16, in_units=8, activation="relu")
+    d2 = nn.Dense(4, in_units=16)
+    d1.initialize()
+    d2.initialize()
+    params = list(d1.collect_params().values()) + \
+        list(d2.collect_params().values())
+    kv, calls = _spy_kv()
+    tr = gluon.Trainer(params, "sgd", {"learning_rate": 0.1},
+                       kvstore=kv, fuse_step=True,
+                       overlap_allreduce=True)
+    x = nd.array(np.random.RandomState(0).randn(4, 8).astype(np.float32))
+    with autograd.record():
+        loss = (d2(d1(x)) ** 2).mean()
+    loss.backward()
+    tr.step(4)                             # plan builds here
+    h = d1(x)                              # outside the tape
+    with autograd.record():
+        loss = (d2(h) ** 2).mean()
+    before = len(calls)
+    loss.backward()                        # only d2's grads refresh
+    assert len(calls) > before             # deep bucket issued anyway
+    tr.step(4, ignore_stale_grad=True)     # drains the unready tail
+    assert list(tr.grad_issue_schedule) == tr._overlap_sched.order
+    assert sum(tr.health.values()) == 2
+
+
+def test_overlap_refuses_mid_round_accumulation(monkeypatch):
+    monkeypatch.setenv("MXTPU_GRAD_BUCKET_BYTES", "600")
+    net = _build_net()
+    kv, calls = _spy_kv()
+    tr = _overlap_trainer(net, kv)
+    x = nd.array(np.random.RandomState(0).randn(4, 8).astype(np.float32))
+    _one_step(net, tr, x)                  # plan armed
+    with autograd.record():
+        loss = (net(x) ** 2).mean()
+    loss.backward()                        # hooks issued buckets
+    with pytest.raises(MXNetError, match="overlapped allreduce"):
+        tr.accumulate_grads()
+    tr.step(4)                             # round still closes cleanly
+    # declared accumulation defers overlap from the FIRST microbatch
+    tr.set_grad_accumulation(True)
+    with autograd.record():
+        loss = (net(x) ** 2).mean()
+    before = len(calls)
+    loss.backward()
+    assert calls[before:] == []            # nothing issued mid-backward
+    tr.accumulate_grads()
+    tr.step(1)
+    assert tr.last_outcome is StepOutcome.APPLIED
+
+
+def test_overlap_single_member_never_double_reduces():
+    """Review regression: one bucketable dense param, num_workers>1,
+    int8 off — the step-time bucketed gate routes it per-param, so the
+    overlap plan must DISABLE rather than issue the same gradient into
+    both paths (a second reduction inflates it by num_workers)."""
+    mx.random.seed(0)
+    d = nn.Dense(4, in_units=8, use_bias=False)    # exactly one param
+    d.initialize()
+    kv, calls = _spy_kv()
+    tr = gluon.Trainer(d.collect_params(), "sgd", {"learning_rate": 0.1},
+                       kvstore=kv, fuse_step=True, overlap_allreduce=True)
+    x = nd.array(np.random.RandomState(0).randn(4, 8).astype(np.float32))
+    for _ in range(3):
+        with autograd.record():
+            loss = (d(x) ** 2).mean()
+        loss.backward()
+        tr.step(4)
+    assert tr._overlap_sched is False              # overlap disabled
+    # exactly ONE pushpull per step (the per-param rest path), never two
+    assert len(calls) == 3
+
+
+def test_accum_round_missing_param_grad_is_skipped():
+    """Review regression: a parameter that gets no fresh gradient in
+    any microbatch of an accumulated round must be SKIPPED (warned),
+    never have its stale raw grad applied at the round's rescale."""
+    mx.random.seed(0)
+    d1 = nn.Dense(16, in_units=8, activation="relu")
+    d2 = nn.Dense(4, in_units=16)
+    d1.initialize()
+    d2.initialize()
+    params = list(d1.collect_params().values()) + \
+        list(d2.collect_params().values())
+    tr = gluon.Trainer(params, "adam", {"learning_rate": 0.05},
+                       kvstore=None)
+    x = nd.array(np.random.RandomState(0).randn(4, 8).astype(np.float32))
+    # round 1 touches BOTH layers (leaves a stale d1 grad behind)
+    with autograd.record():
+        loss = (d2(d1(x)) ** 2).mean()
+    tr.backward(loss)
+    tr.accumulate_grads()
+    tr.step(1)
+    d1_before = [p.data().asnumpy().copy()
+                 for p in d1.collect_params().values()]
+    d2_before = [p.data().asnumpy().copy()
+                 for p in d2.collect_params().values()]
+    # round 2's microbatches only reach d2
+    h = d1(x)                                      # outside the tape
+    for _ in range(2):
+        with autograd.record():
+            loss = (d2(h) ** 2).mean()
+        tr.backward(loss)
+        tr.accumulate_grads()
+    with pytest.warns(UserWarning, match="no gradient in any microbatch"):
+        tr.step(2)
+    assert tr.last_outcome is StepOutcome.APPLIED  # d2 still applied
+    for p, w in zip(d1.collect_params().values(), d1_before):
+        np.testing.assert_array_equal(p.data().asnumpy(), w)
+    assert any(np.abs(p.data().asnumpy() - w).max() > 0
+               for p, w in zip(d2.collect_params().values(), d2_before))
+
+
+# --------------------------------------------------------------------- #
+# eager microbatch accumulation: equivalence + guard/scaler composition
+# --------------------------------------------------------------------- #
+
+def test_eager_accumulation_matches_big_batch():
+    X, y = _data(n=8)
+    net_a = _build_net()
+    tr_a = gluon.Trainer(net_a.collect_params(), "adam",
+                         {"learning_rate": 0.01}, kvstore=None)
+    for m in range(4):
+        xb, yb = X[m * 2:(m + 1) * 2], y[m * 2:(m + 1) * 2]
+        with autograd.record():
+            loss = ((net_a(nd.array(xb)) - nd.array(yb)) ** 2).mean()
+        tr_a.backward(loss)
+        tr_a.accumulate_grads()
+    tr_a.step(4)          # 4 microbatches, each loss already a mean
+    assert tr_a.last_outcome is StepOutcome.APPLIED
+    assert tr_a._fused.accum_trace_count == 1
+
+    net_b = _build_net()
+    tr_b = gluon.Trainer(net_b.collect_params(), "adam",
+                         {"learning_rate": 0.01}, kvstore=None)
+    with autograd.record():
+        loss = ((net_b(nd.array(X)) - nd.array(y)) ** 2).mean()
+    loss.backward()
+    tr_b.step(1)
+    for a, b in zip(_params_snapshot(net_a), _params_snapshot(net_b)):
+        np.testing.assert_allclose(a, b, rtol=2e-6, atol=2e-7)
+
+
+def test_eager_accum_count_change_never_retraces():
+    X, y = _data(n=8)
+    net = _build_net()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 0.01}, kvstore=None)
+    for k in (1, 4, 2):
+        for m in range(k):
+            with autograd.record():
+                loss = ((net(nd.array(X[:2])) - nd.array(y[:2]))
+                        ** 2).mean()
+            tr.backward(loss)
+            tr.accumulate_grads()
+        tr.step(k)
+    assert tr._fused.accum_trace_count == 1
+    assert tr._fused.trace_count <= len(tr._fused._jits)
+
+
+def test_eager_nonfinite_microbatch_skips_whole_apply_once():
+    """A NaN in microbatch 2 of 4: the whole apply skips bit-identically
+    (params AND optimizer state), ONE SKIPPED_NONFINITE outcome, the
+    loss scale halves ONCE — not once per microbatch."""
+    X, y = _data(n=8)
+    net = _build_net()
+    sc = LossScaler(init_scale=8.0, scale_window=100)
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 0.01}, kvstore=None,
+                       loss_scaler=sc)
+    # one clean accumulated round builds optimizer state
+    for m in range(2):
+        with autograd.record():
+            loss = ((net(nd.array(X[:2])) - nd.array(y[:2])) ** 2).mean()
+        tr.backward(loss)
+        tr.accumulate_grads()
+    tr.step(2)
+    import jax.tree_util as jtu
+    w_before = _params_snapshot(net)
+    st_before = [leaf.asnumpy().copy()
+                 for _, st in sorted(tr._updaters[0].states.items())
+                 for leaf in jtu.tree_leaves(
+                     st, is_leaf=lambda x: hasattr(x, "asnumpy"))]
+    outcomes_before = sum(tr.health.values())
+    for m in range(4):
+        xb = X[m * 2:(m + 1) * 2].copy()
+        if m == 1:
+            xb[0, 0] = np.nan
+        with autograd.record():
+            loss = ((net(nd.array(xb)) -
+                     nd.array(y[m * 2:(m + 1) * 2])) ** 2).mean()
+        tr.backward(loss)
+        tr.accumulate_grads()
+    tr.step(4)
+    assert tr.last_outcome is StepOutcome.SKIPPED_NONFINITE
+    assert sum(tr.health.values()) == outcomes_before + 1
+    assert sc.loss_scale == 4.0            # halved exactly once
+    for a, b in zip(_params_snapshot(net), w_before):
+        np.testing.assert_array_equal(a, b)
+    st_after = [leaf.asnumpy()
+                for _, st in sorted(tr._updaters[0].states.items())
+                for leaf in jtu.tree_leaves(
+                    st, is_leaf=lambda x: hasattr(x, "asnumpy"))]
+    for a, b in zip(st_after, st_before):
+        np.testing.assert_array_equal(a, b)
+    # clean round afterwards applies through the SAME programs
+    for m in range(2):
+        with autograd.record():
+            loss = ((net(nd.array(X[:2])) - nd.array(y[:2])) ** 2).mean()
+        tr.backward(loss)
+        tr.accumulate_grads()
+    tr.step(2)
+    assert tr.last_outcome is StepOutcome.APPLIED
+    assert tr._fused.accum_trace_count == 1
+
+
+def test_eager_accum_int8_allreduce_verdict_on_dequantized():
+    """Accumulation + the PR-11 int8 seam: the accumulated bucket ships
+    quantized at apply time and the guard still reads the DEQUANTIZED
+    gradients — a poisoned microbatch poisons the bucket scale, every
+    dequantized element, and the verdict."""
+    X, y = _data(n=4)
+    net = _build_net()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 0.01}, kvstore="device",
+                       int8_allreduce=True)
+    tr.set_grad_accumulation(True)
+
+    def round_(poison):
+        for m in range(2):
+            xb = X[m * 2:(m + 1) * 2].copy()
+            if poison and m == 1:
+                xb[0, 0] = np.nan
+            with autograd.record():
+                loss = ((net(nd.array(xb)) -
+                         nd.array(y[m * 2:(m + 1) * 2])) ** 2).mean()
+            tr.backward(loss)
+            tr.accumulate_grads()
+        tr.step(2)
+
+    round_(False)
+    assert tr.int8_buckets > 0             # seam engaged
+    w_before = _params_snapshot(net)
+    round_(True)
+    assert tr.last_outcome is StepOutcome.SKIPPED_NONFINITE
+    for a, b in zip(_params_snapshot(net), w_before):
+        np.testing.assert_array_equal(a, b)
+    round_(False)
+    assert tr.last_outcome is StepOutcome.APPLIED
+
+
+# --------------------------------------------------------------------- #
+# SPMD in-step accumulation
+# --------------------------------------------------------------------- #
+
+def _flagged_mse(block, x, y, flag):
+    """MSE with a per-microbatch poison channel: flag==1 is identity,
+    a NaN flag entry poisons the loss (and every gradient) as pure
+    traced data — no retrace across clean/poisoned rounds."""
+    out = block(x)
+    return ((out - y) ** 2).mean() * flag.mean()
+
+
+def _spmd_setup(sharding="replicated", axes=None, scaler=None, seed=7,
+                guard=None, bn=False, two_dev=True):
+    import jax
+    net = _build_net(seed=seed, bn=bn)
+    if two_dev:
+        mesh = pmesh.build_mesh(devices=jax.devices()[:2],
+                                axis_sizes=axes or {"dp": 2})
+    else:
+        mesh = pmesh.build_mesh(axis_sizes=axes or {"dp": 8})
+    tr = parallel.SPMDTrainer(net, forward_loss=_flagged_mse,
+                              optimizer="adam",
+                              optimizer_params={"learning_rate": 0.01},
+                              mesh=mesh, sharding=sharding,
+                              loss_scaler=scaler, guard=guard)
+    return net, tr
+
+
+def _micros(X, y, k, nan_at=None, seed=None):
+    n = X.shape[0] // k
+    out = []
+    for m in range(k):
+        flag = np.ones((n,), np.float32)
+        if m == nan_at:
+            flag[0] = np.nan
+        out.append((nd.array(X[m * n:(m + 1) * n]),
+                    nd.array(y[m * n:(m + 1) * n]), nd.array(flag)))
+    return out
+
+
+def test_spmd_accum_count_change_never_retraces():
+    X, y = _data(n=16)
+    net, tr = _spmd_setup()
+    for k in (1, 4, 8):
+        # fixed MICROBATCH shape (2 rows), varying COUNT k — the count
+        # is pure host data, so one compiled program covers every k
+        micros = [(nd.array(X[m * 2:(m + 1) * 2]),
+                   nd.array(y[m * 2:(m + 1) * 2]),
+                   nd.array(np.ones(2, np.float32)))
+                  for m in range(k)]
+        L = tr.step_microbatches(micros)
+        assert np.isfinite(float(L.asnumpy()))
+    assert tr.accum_step_trace_count == 1
+    assert tr.step_count == 3
+    snap = tr.health_snapshot()
+    assert snap["accum_step_trace_count"] == 1
+    assert snap["last_accum_count"] == 8
+
+
+def test_spmd_accum_matches_plain_step():
+    X, y = _data(n=16)
+    net_a, tr_a = _spmd_setup(seed=9)
+    for _ in range(3):
+        La = tr_a.step_microbatches(_micros(X, y, 4))
+    net_b, tr_b = _spmd_setup(seed=9)
+    for _ in range(3):
+        Lb = tr_b.step(nd.array(X), nd.array(y),
+                       nd.array(np.ones(16, np.float32)))
+    np.testing.assert_allclose(float(La.asnumpy()), float(Lb.asnumpy()),
+                               rtol=1e-5)
+    for pa, pb in zip(tr_a._params, tr_b._params):
+        np.testing.assert_allclose(pa.data().asnumpy(),
+                                   pb.data().asnumpy(),
+                                   rtol=3e-6, atol=3e-7)
+
+
+@pytest.mark.parametrize("sharding,axes", [
+    ("replicated", {"dp": 2}),
+    ("fsdp", {"dp": 1, "fsdp": 2}),
+])
+def test_spmd_nonfinite_microbatch_skips_round(monkeypatch, sharding,
+                                               axes):
+    """One combined verdict per accumulated round on dp AND fsdp: a NaN
+    in microbatch 2 of 4 skips the whole apply with params + optimizer
+    state bit-identical, exactly one outcome, one scaler halve; the
+    clean round after applies through the SAME program."""
+    monkeypatch.setenv("MXTPU_FSDP_MIN_SIZE", "0")
+    X, y = _data(n=16)
+    sc = LossScaler(init_scale=8.0, scale_window=100)
+    net, tr = _spmd_setup(sharding=sharding, axes=axes, scaler=sc)
+    tr.step_microbatches(_micros(X, y, 4))
+    import jax.tree_util as jtu
+
+    def leaves():
+        return [np.asarray(leaf._data).copy()
+                for st in tr._opt_state
+                for leaf in jtu.tree_leaves(
+                    st, is_leaf=lambda s: hasattr(s, "asnumpy"))]
+
+    w_before = [p.data().asnumpy().copy() for p in tr._params]
+    st_before = leaves()
+    sc_steps = tr.step_count
+    outcomes_before = sum(tr.health.values())
+    tr.step_microbatches(_micros(X, y, 4, nan_at=1))
+    assert tr.last_outcome is StepOutcome.SKIPPED_NONFINITE
+    assert sum(tr.health.values()) == outcomes_before + 1
+    assert tr.step_count == sc_steps       # t did not advance
+    assert sc.loss_scale == 4.0            # halved exactly once
+    for a, b in zip([p.data().asnumpy() for p in tr._params], w_before):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(leaves(), st_before):
+        np.testing.assert_array_equal(a, b)
+    tr.step_microbatches(_micros(X, y, 4))
+    assert tr.last_outcome is StepOutcome.APPLIED
+    assert tr.accum_step_trace_count == 1
+
+
+def test_spmd_accum_guarded_clean_bitwise_matches_unguarded():
+    X, y = _data(n=16)
+    finals = []
+    for guard in (True, False):
+        net, tr = _spmd_setup(seed=11, guard=guard)
+        for _ in range(3):
+            tr.step_microbatches(_micros(X, y, 4))
+        finals.append([p.data().asnumpy() for p in tr._params])
+    for a, b in zip(*finals):
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.slow
+def test_spmd_vetoed_round_rolls_back_bn_stats():
+    """BN running stats advance per microbatch forward; a vetoed round
+    must roll them back to the round start (the rolls-NOTHING-forward
+    contract of the PR-8 skip)."""
+    X, y = _data(n=16)
+    net, tr = _spmd_setup(seed=15, bn=True)
+    tr.step_microbatches(_micros(X, y, 4))
+    frozen_before = [p.data().asnumpy().copy()
+                     for i, p in enumerate(tr._params)
+                     if i not in set(tr._train_idx)]
+    tr.step_microbatches(_micros(X, y, 4, nan_at=2))
+    assert tr.last_outcome is StepOutcome.SKIPPED_NONFINITE
+    frozen_after = [p.data().asnumpy()
+                    for i, p in enumerate(tr._params)
+                    if i not in set(tr._train_idx)]
+    assert frozen_before  # BatchNorm contributes frozen aux state
+    for a, b in zip(frozen_after, frozen_before):
+        np.testing.assert_array_equal(a, b)
+    # and an APPLIED round does advance them
+    tr.step_microbatches(_micros(X, y, 4))
+    changed = any(
+        np.abs(a - b).max() > 0
+        for a, b in zip([p.data().asnumpy()
+                         for i, p in enumerate(tr._params)
+                         if i not in set(tr._train_idx)],
+                        frozen_before))
+    assert changed
+
+
+def test_spmd_halt_escalation_through_accumulated_rounds():
+    X, y = _data(n=16)
+    net, tr = _spmd_setup(seed=17)
+    tr._recorder.max_consecutive_nonfinite = 2
+    tr.step_microbatches(_micros(X, y, 2))
+    tr.step_microbatches(_micros(X, y, 2, nan_at=0))
+    with pytest.raises(MXNetError, match="poisoned"):
+        tr.step_microbatches(_micros(X, y, 2, nan_at=1))
+    assert tr.health["HALTED_POISONED"] == 1
+
+
+# --------------------------------------------------------------------- #
+# FLOPs / MFU accounting units
+# --------------------------------------------------------------------- #
+
+def test_flops_formulas_and_mfu_fields():
+    from incubator_mxnet_tpu.models.gpt import GPTModel
+    from incubator_mxnet_tpu.utils.flops import (count_params,
+                                                 gpt_train_flops,
+                                                 mfu, model_train_flops,
+                                                 transformer_train_flops)
+    mx.random.seed(0)
+    model = GPTModel(vocab_size=64, units=32, hidden_size=64,
+                     num_layers=2, num_heads=4, max_length=32,
+                     dropout=0.0)
+    model.initialize()
+    n = count_params(model)
+    assert n == sum(int(np.prod(p.shape))
+                    for p in model.collect_params().values())
+    f1 = gpt_train_flops(model, batch=2, seq_len=16)
+    f2 = gpt_train_flops(model, batch=4, seq_len=16)
+    assert f2 == pytest.approx(2 * f1)     # linear in tokens
+    assert model_train_flops(model, 2, 16) == f1
+    # 6P lower bound: matmul params exclude embeddings but re-add the
+    # tied LM head, attention adds on top
+    assert f1 > 6 * (n - 32 * 32) * 2 * 16 * 0.5
+    out = mfu(f1, 0.01, 2, peak={"flops": 1e12, "source": "env",
+                                 "device_kind": "x"})
+    assert out["mfu"] == pytest.approx(f1 / 0.01 / 2 / 1e12)
+    for field in ("model_flops_per_step", "achieved_flops_per_device",
+                  "peak_flops_per_device", "peak_source", "mfu"):
+        assert field in out
+    with pytest.raises(ValueError, match="analytic FLOPs"):
+        model_train_flops(object(), 1, 1)
+
+
+def test_bert_flops_counts_mlm_head():
+    from incubator_mxnet_tpu.models.bert import BERTModel
+    from incubator_mxnet_tpu.utils.flops import bert_train_flops
+    mx.random.seed(0)
+    m = BERTModel(vocab_size=128, units=32, hidden_size=64,
+                  num_layers=2, num_heads=4, max_length=32)
+    m.initialize()
+    with_head = bert_train_flops(m, 2, 16, mlm_head=True)
+    without = bert_train_flops(m, 2, 16, mlm_head=False)
+    assert with_head - without == pytest.approx(
+        6 * 128 * 32 * 2 * 16)             # 6 · V·d · tokens
+
+
+def test_peak_flops_env_override(monkeypatch):
+    from incubator_mxnet_tpu.utils import flops as flops_mod
+    monkeypatch.setenv("MXTPU_PEAK_FLOPS", "123e9")
+    peak = flops_mod.peak_flops_per_device()
+    assert peak["flops"] == pytest.approx(123e9)
+    assert peak["source"] == "env"
+
+
+@pytest.mark.slow
+def test_trace_summary_overlap_stats(tmp_path):
+    """overlap_stats parses a real profiler capture of an SPMD step and
+    returns the per-lane split fields step_bench banks (slow: the
+    profiler capture costs ~9 s; the full bench exercises the same
+    path when banking BENCH_MFU.json)."""
+    import jax
+    import sys, os
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    from trace_summary import overlap_stats
+    X, y = _data(n=16)
+    net, tr = _spmd_setup(seed=19)
+    tr.step_microbatches(_micros(X, y, 2))     # compile outside capture
+    with jax.profiler.trace(str(tmp_path)):
+        L = tr.step_microbatches(_micros(X, y, 2))
+        jax.block_until_ready(L._data)
+    st = overlap_stats(str(tmp_path))
+    for field in ("compute_us", "collective_us", "overlapped_us",
+                  "exposed_us", "overlap_ratio", "n_device_lanes"):
+        assert field in st
+    assert st["compute_us"] > 0

@@ -43,7 +43,15 @@ HOT_SEEDS: Dict[str, Set[str]] = {
         "_dispatch", "step", "run", "_route", "_collect",
     },
     "incubator_mxnet_tpu/optimizer/fused.py": {
-        "apply", "_apply_group", "grad_all_finite",
+        "apply", "_apply_group", "grad_all_finite", "accumulate",
+    },
+    # round 16: the overlapped allreduce runs INSIDE backward — a hidden
+    # sync in a grad-ready hook stalls the remaining backward dispatch,
+    # which is exactly the overlap the feature exists to create
+    "incubator_mxnet_tpu/gluon/trainer.py": {
+        "_on_grad_ready", "_issue_bucket", "_pushpull_chunk",
+        "_overlap_flush", "_allreduce_grads", "_bucketed_pushpull",
+        "_int8_pushpull", "accumulate_grads",
     },
 }
 

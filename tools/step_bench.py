@@ -1,22 +1,36 @@
-"""Host-dispatch benchmark for the optimizer step: eager vs fused vs SPMD.
+"""Host-dispatch + training-throughput benchmark for the optimizer step.
 
-Measures what the fused whole-tree optimizer step (optimizer/fused.py)
-buys on the host side: the eager path dispatches one un-jitted update op
-per parameter per step (the overhead MXNet 1.x's op-bulking engine
-existed to kill), the fused path dispatches ONE jitted call per
-(dtype, stype, hyperparam) group. Parameters are tiny so device compute
-is negligible and wall time ≈ host dispatch. CPU-measurable by design —
-no TPU needed to validate the host-side win.
+Two modes:
 
-Also reports steady-state jit trace counts for the fused path: after
-warmup, re-stepping with fixed shapes must not retrace (one trace per
-(shape, dtype) signature, ever). ``--smoke`` runs a fast version of that
-check and exits non-zero on violation — wired into ci/run.sh as the
-tier-1 regression guard for the fused step.
+DISPATCH (default): measures what the fused whole-tree optimizer step
+(optimizer/fused.py) buys on the host side: the eager path dispatches
+one un-jitted update op per parameter per step (the overhead MXNet
+1.x's op-bulking engine existed to kill), the fused path dispatches ONE
+jitted call per (dtype, stype, hyperparam) group. Parameters are tiny
+so device compute is negligible and wall time ≈ host dispatch.
+``--smoke`` asserts the steady-state no-retrace contract — wired into
+ci/run.sh (stepbench) as the tier-1 regression guard for the fused step.
+
+MFU (``--mfu``, round 16 — docs/TRAINING_PERF.md): trains a small GPT
+through the REAL trainers and banks tokens/s next to an honest MFU
+number computed from the same run (analytic fwd+bwd FLOPs per
+utils/flops.py over measured wall time vs per-device peak), across the
+round-16 levers: overlapped bucket-ready allreduce {off,on} ×
+gradient accumulation {1,4,8} on the eager Trainer (paired alternating
+windows, the ckpt_bench jitter methodology), and accumulation {1,4,8}
+on SPMDTrainer over dp and fsdp meshes, plus a per-device-lane overlap
+ratio from a profiler capture (tools/trace_summary.overlap_stats).
+``--mfu --smoke`` is the ci/run.sh mfubench gate: an accumulation-count
+change that RETRACES the step, a non-finite microbatch that does NOT
+veto the whole accumulated apply, a guarded accumulated trajectory that
+diverges from the unguarded one on a clean stream, or a
+non-deterministic overlap issue schedule all fail the stage.
 
 Usage:
-  python tools/step_bench.py                 # full bench, banks JSON
+  python tools/step_bench.py                 # dispatch bench, banks JSON
   python tools/step_bench.py --smoke         # CI guard (fast, asserts)
+  python tools/step_bench.py --mfu           # training bench, BENCH_MFU.json
+  python tools/step_bench.py --mfu --smoke   # mfubench CI gates
   python tools/step_bench.py --json OUT.json
 """
 
@@ -29,6 +43,12 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--mfu" in sys.argv:
+    # the SPMD arms need a multi-device mesh; must land before jax import
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
 
 
 def _build_params(n_params, shape, seed=0):
@@ -128,18 +148,421 @@ def bench_spmd(n_layers, units, steps):
             "n_params": 2 * n_layers}
 
 
+# ----------------------------------------------------------------------- #
+# --mfu: training throughput with honest MFU accounting (round 16)
+# ----------------------------------------------------------------------- #
+
+def _tiny_gpt(seed=0, vocab=256, units=64, hidden=256, layers=2,
+              heads=4, max_len=64):
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.models.gpt import GPTModel
+    mx.random.seed(seed)
+    model = GPTModel(vocab_size=vocab, units=units, hidden_size=hidden,
+                     num_layers=layers, num_heads=heads,
+                     max_length=max_len, dropout=0.0)
+    model.initialize()
+    return model
+
+
+def _token_micros(B, T, vocab, k, seed=0):
+    """k deterministic (inputs, labels) microbatches of the synthetic
+    next-token stream (the serve_bench int8-allreduce workload)."""
+    import numpy as np
+    from incubator_mxnet_tpu import nd
+    rng = np.random.RandomState(seed)
+    micros = []
+    for m in range(k):
+        base = rng.randint(0, vocab, (B, 1))
+        ids = (base + np.arange(T + 1)[None, :]) % vocab
+        micros.append((nd.array(ids[:, :-1], dtype="int32"),
+                       nd.array(ids[:, 1:], dtype="int32")))
+    return micros
+
+
+def _block_params(params):
+    import jax
+    for p in params:
+        jax.block_until_ready(p.data()._data)
+
+
+def _eager_opt_steps(model, tr, micros, n_steps):
+    """Run ``n_steps`` optimizer steps of len(micros) microbatches each
+    through the eager Trainer; returns wall seconds."""
+    from incubator_mxnet_tpu import autograd
+    from incubator_mxnet_tpu.models.gpt import lm_loss
+    k = len(micros)
+    params = list(model.collect_params().values())
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        if k == 1:
+            with autograd.record():
+                loss = lm_loss(model, *micros[0])
+            tr.backward(loss)
+            tr.step(1)
+        else:
+            for m in range(k):
+                with autograd.record():
+                    loss = lm_loss(model, *micros[m])
+                tr.backward(loss)
+                tr.accumulate_grads()
+            tr.step(k)
+    _block_params(params)
+    return time.perf_counter() - t0
+
+
+def bench_eager_overlap(accum_counts, steps, B, T, vocab, errors,
+                        smoke):
+    """Overlap {off,on} × accumulation arms on the eager Trainer with
+    the int8-allreduce bucketed pushpull engaged (the seam whose
+    dispatch overlap can hide). STRICT per-step alternation with ABBA
+    ordering (arm order flips every step) and medians of per-step
+    times — the round-10 guard-overhead methodology: this box's speed
+    swings mid-session, and paired windows disagreed on the SIGN of
+    effects this small (PERF_NOTES rounds 10/16). Each arm owns its
+    model+trainer so state never crosses arms."""
+    from incubator_mxnet_tpu.gluon import Trainer
+    from incubator_mxnet_tpu.utils.flops import (gpt_train_flops, mfu,
+                                                 peak_flops_per_device)
+
+    peak = peak_flops_per_device()
+    out = {}
+    for k in accum_counts:
+        arms = {}
+        for overlap in (False, True):
+            model = _tiny_gpt(seed=5)
+            tr = Trainer(
+                model.collect_params(), "adam",
+                {"learning_rate": 1e-3}, kvstore="device",
+                int8_allreduce=True, overlap_allreduce=overlap)
+            if k > 1:
+                # declare the rounds upfront: overlap defers to apply
+                # time (each gradient byte still crosses once per
+                # accumulated step — banked as the parity it is)
+                tr.set_grad_accumulation(True)
+            arms[overlap] = (model, tr)
+        micros = _token_micros(B, T, vocab, k, seed=3)
+        # warmup: compiles + overlap plan build (plan lands at step 1,
+        # hooks issue from step 2 on)
+        for model, tr in arms.values():
+            _eager_opt_steps(model, tr, micros, 2)
+        times = {False: [], True: []}
+        for s in range(steps):
+            order = (False, True) if s % 2 == 0 else (True, False)
+            for overlap in order:
+                model, tr = arms[overlap]
+                times[overlap].append(
+                    _eager_opt_steps(model, tr, micros, 1))
+        med = {ov: sorted(ts)[len(ts) // 2]
+               for ov, ts in times.items()}
+        ratio = med[False] / med[True]
+        tokens_per_step = B * T * k
+        flops_per_step = gpt_train_flops(arms[False][0], B, T) * k
+        arm_out = {}
+        for overlap in (False, True):
+            arm_out["overlap_on" if overlap else "overlap_off"] = {
+                "per_step_ms": med[overlap] * 1e3,
+                "tokens_per_s": tokens_per_step / med[overlap],
+                **mfu(flops_per_step, med[overlap], 1, peak),
+            }
+        sched = arms[True][1].grad_issue_schedule
+        arm_out["overlap_speedup_median_ratio"] = ratio
+        arm_out["buckets_issued_overlapped"] = len(sched)
+        arm_out["methodology"] = ("strict per-step ABBA alternation, "
+                                  "median per-step times; at accum>1 "
+                                  "both arms run the identical "
+                                  "deferred-overlap path (parity arm)")
+        out[f"accum_{k}"] = arm_out
+        if k == 1 and not sched:
+            errors.append("mfu/eager: overlap arm never issued a "
+                          "bucket during backward")
+        floor = 0.80 if smoke else 0.90
+        if ratio < floor:
+            errors.append(
+                f"mfu/eager accum_{k}: overlap-on tokens/s "
+                f"{ratio:.2f}x of overlap-off — under the {floor}x "
+                f"no-worse floor")
+    return out
+
+
+def bench_spmd_accum(accum_counts, steps, B, T, vocab, errors,
+                     trace_dir=None):
+    """Accumulation arms on SPMDTrainer over dp2 and fsdp2 meshes: ONE
+    once-compiled microbatch program per trainer across every
+    accumulation count (the no-retrace gate), tokens/s + MFU per arm;
+    optionally captures a profiler trace of the dp2 k=max arm for the
+    per-device-lane overlap ratio (trace_summary.overlap_stats)."""
+    import jax
+    import numpy as np
+    from incubator_mxnet_tpu import parallel
+    from incubator_mxnet_tpu.models.gpt import lm_loss
+    from incubator_mxnet_tpu.parallel import mesh as pmesh
+    from incubator_mxnet_tpu.utils.flops import (gpt_train_flops, mfu,
+                                                 peak_flops_per_device)
+
+    peak = peak_flops_per_device()
+    out = {}
+    for tag, axes, sharding in (
+            ("dp2", {"dp": 2}, "replicated"),
+            ("fsdp2", {"dp": 1, "fsdp": 2}, "fsdp")):
+        model = _tiny_gpt(seed=7)
+        mesh = pmesh.build_mesh(devices=jax.devices()[:2],
+                                axis_sizes=axes)
+        tr = parallel.SPMDTrainer(
+            model, optimizer="adam",
+            optimizer_params={"learning_rate": 1e-3},
+            forward_loss=lm_loss, mesh=mesh, sharding=sharding)
+        arm_out = {}
+        for k in accum_counts:
+            micros = _token_micros(B, T, vocab, k, seed=3)
+            tr.step_microbatches(micros)         # warm (compile once)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                L = tr.step_microbatches(micros)
+            jax.block_until_ready(L._data)
+            dt = (time.perf_counter() - t0) / steps
+            flops_per_step = gpt_train_flops(model, B, T) * k
+            arm_out[f"accum_{k}"] = {
+                "per_step_ms": dt * 1e3,
+                "tokens_per_s": B * T * k / dt,
+                **mfu(flops_per_step, dt, 2, peak),
+            }
+        arm_out["accum_step_trace_count"] = tr.accum_step_trace_count
+        if tr.accum_step_trace_count != 1:
+            errors.append(
+                f"mfu/spmd {tag}: microbatch program compiled "
+                f"{tr.accum_step_trace_count}x across accumulation "
+                f"counts {list(accum_counts)} — an accumulation-count "
+                f"change retraced the step")
+        if tag == "dp2" and trace_dir is not None:
+            try:
+                micros = _token_micros(B, T, vocab, max(accum_counts),
+                                       seed=3)
+                with jax.profiler.trace(trace_dir):
+                    for _ in range(3):
+                        L = tr.step_microbatches(micros)
+                    jax.block_until_ready(L._data)
+                from trace_summary import overlap_stats
+                st = overlap_stats(trace_dir)
+                arm_out["overlap_trace"] = {
+                    "overlap_ratio": st["overlap_ratio"],
+                    "collective_ms": st["collective_us"] / 1e3,
+                    "exposed_ms": st["exposed_us"] / 1e3,
+                    "n_device_lanes": st["n_device_lanes"],
+                }
+            except Exception as e:                # profiler optional
+                arm_out["overlap_trace"] = {"error": str(e)[:200]}
+        out[tag] = arm_out
+    return out
+
+
+def mfu_invariant_gates(B, T, vocab, errors):
+    """The mfubench correctness gates (cheap, always run): combined
+    verdict per accumulated round, guarded==unguarded bit-identity on
+    clean streams, deterministic overlap issue schedule."""
+    import jax
+    import numpy as np
+    from incubator_mxnet_tpu import nd, parallel
+    from incubator_mxnet_tpu.gluon import Trainer
+    from incubator_mxnet_tpu.models.gpt import lm_loss
+    from incubator_mxnet_tpu.parallel import mesh as pmesh
+    from incubator_mxnet_tpu.train import StepOutcome
+
+    def flagged_loss(m, inputs, labels, flag):
+        # the poison channel: flag==1 is the identity, a NaN flag
+        # poisons this microbatch's loss (and so every gradient) as
+        # PURE TRACED DATA — no retrace across clean/poisoned rounds
+        return lm_loss(m, inputs, labels) * flag.mean()
+
+    def spmd_trainer(guard=True, seed=11, loss_fn=None):
+        model = _tiny_gpt(seed=seed, vocab=vocab)
+        mesh = pmesh.build_mesh(devices=jax.devices()[:2],
+                                axis_sizes={"dp": 2})
+        tr = parallel.SPMDTrainer(
+            model, optimizer="adam",
+            optimizer_params={"learning_rate": 1e-3},
+            forward_loss=loss_fn or lm_loss, mesh=mesh, guard=guard)
+        return model, tr
+
+    def with_flag(micros, nan_at=None):
+        out = []
+        for m, (i, l) in enumerate(micros):
+            f = np.ones((B,), np.float32)
+            if m == nan_at:
+                f[0] = np.nan
+            out.append((i, l, nd.array(f)))
+        return out
+
+    # 1. combined verdict: a NaN in microbatch 2 of 4 vetoes the WHOLE
+    #    accumulated apply bit-identically, as exactly one outcome
+    model, tr = spmd_trainer(loss_fn=flagged_loss)
+    micros = _token_micros(B, T, vocab, 4, seed=3)
+    tr.step_microbatches(with_flag(micros))
+    before = [p.data().asnumpy().copy() for p in tr._params]
+    h_before = sum(tr.health.values())
+    tr.step_microbatches(with_flag(micros, nan_at=1))
+    if tr.last_outcome is not StepOutcome.SKIPPED_NONFINITE:
+        errors.append("mfu/gates: non-finite microbatch 2/4 did not "
+                      "record SKIPPED_NONFINITE for the round")
+    if sum(tr.health.values()) != h_before + 1:
+        errors.append("mfu/gates: accumulated round did not record "
+                      "exactly one outcome")
+    for b, a in zip(before, [p.data().asnumpy() for p in tr._params]):
+        if not np.array_equal(b, a):
+            errors.append("mfu/gates: vetoed accumulated round mutated "
+                          "parameters")
+            break
+    tr.step_microbatches(with_flag(micros))
+    if tr.last_outcome is not StepOutcome.APPLIED:
+        errors.append("mfu/gates: clean round after a veto failed to "
+                      "apply")
+    if tr.accum_step_trace_count != 1:
+        errors.append("mfu/gates: poisoned/clean transition retraced "
+                      "the microbatch program")
+
+    # 2. guarded accumulated trajectory bit-identical to unguarded on a
+    #    clean stream
+    finals = []
+    for guard in (True, False):
+        model_g, tr_g = spmd_trainer(guard=guard, seed=13)
+        micros = _token_micros(B, T, vocab, 4, seed=5)
+        for _ in range(3):
+            tr_g.step_microbatches(micros)
+        finals.append([p.data().asnumpy() for p in tr_g._params])
+    for a, b in zip(*finals):
+        if not np.array_equal(a, b):
+            errors.append("mfu/gates: guarded accumulated trajectory "
+                          "diverged from unguarded on a clean stream")
+            break
+
+    # 3. overlap issue schedule: stable across backwards and equal to
+    #    the deterministic plan order
+    model = _tiny_gpt(seed=5)
+    tr = Trainer(model.collect_params(), "adam",
+                 {"learning_rate": 1e-3}, kvstore="device",
+                 int8_allreduce=True, overlap_allreduce=True)
+    micros = _token_micros(B, T, vocab, 1, seed=3)
+    scheds = []
+    for _ in range(3):
+        _eager_opt_steps(model, tr, micros, 1)
+        scheds.append(list(tr.grad_issue_schedule))
+    if scheds[1] != scheds[2] or not scheds[1]:
+        errors.append("mfu/gates: overlapped bucket issue order not "
+                      "deterministic across runs")
+    if tr._overlap_sched not in (None, False) and \
+            scheds[2] != tr._overlap_sched.order:
+        errors.append("mfu/gates: issue order diverged from the "
+                      "deterministic plan order")
+
+
+def run_mfu(args):
+    # split the tiny model into several buckets so bucket-READY issue
+    # has something to overlap (one bucket degenerates to the serial
+    # path: its last member gradient is the end of backward) — and so
+    # the determinism gate asserts a real multi-bucket schedule
+    saved_limit = os.environ.get("MXTPU_GRAD_BUCKET_BYTES")
+    os.environ["MXTPU_GRAD_BUCKET_BYTES"] = str(64 * 1024)
+    try:
+        _run_mfu(args)
+    finally:
+        if saved_limit is None:
+            os.environ.pop("MXTPU_GRAD_BUCKET_BYTES", None)
+        else:
+            os.environ["MXTPU_GRAD_BUCKET_BYTES"] = saved_limit
+
+
+def _run_mfu(args):
+    errors = []
+    B, T, vocab = (4, 32, 256) if args.smoke else (8, 32, 256)
+    accum_counts = (1, 4) if args.smoke else (1, 4, 8)
+    eager_steps = 4 if args.smoke else 20
+    spmd_steps = 2 if args.smoke else 6
+
+    model_meta = _tiny_gpt(seed=5)
+    from incubator_mxnet_tpu.utils.flops import (count_params,
+                                                 gpt_train_flops,
+                                                 peak_flops_per_device)
+    peak = peak_flops_per_device()
+    result = {
+        "config": {
+            "model": "gpt(tiny)",
+            "vocab": vocab, "units": model_meta._units,
+            "layers": model_meta.num_layers,
+            "hidden": model_meta.hidden_size,
+            "microbatch": B, "seq_len": T,
+            "n_params": count_params(model_meta),
+            "model_flops_per_microbatch":
+                gpt_train_flops(model_meta, B, T),
+            "peak_flops_per_device": peak["flops"],
+            "peak_source": peak["source"],
+            "device_kind": peak["device_kind"],
+            "accum_counts": list(accum_counts),
+            "backend": os.environ.get("JAX_PLATFORMS", "cpu"),
+            "smoke": bool(args.smoke),
+            "methodology": "strict per-step ABBA alternation between "
+                           "overlap arms, median per-step times (the "
+                           "round-10 small-effect methodology); MFU = "
+                           "analytic fwd+bwd FLOPs (utils/flops.py) / "
+                           "wall time / per-device peak",
+        },
+    }
+    del model_meta
+
+    mfu_invariant_gates(B, T, vocab, errors)
+    result["eager_overlap_int8"] = bench_eager_overlap(
+        accum_counts, eager_steps, B, T, vocab, errors, args.smoke)
+    import tempfile
+    trace_dir = None if args.smoke else tempfile.mkdtemp(
+        prefix="mxtpu_mfu_trace_")
+    result["spmd"] = bench_spmd_accum(accum_counts, spmd_steps, B, T,
+                                      vocab, errors,
+                                      trace_dir=trace_dir)
+
+    # field-presence gate: every arm banks an MFU number
+    for section in ("eager_overlap_int8", "spmd"):
+        for arm_key, arm in result[section].items():
+            if not isinstance(arm, dict):
+                continue
+            for sub_key, sub in arm.items():
+                if isinstance(sub, dict) and "per_step_ms" in sub and \
+                        "mfu" not in sub:
+                    errors.append(f"mfu: arm {section}.{arm_key}."
+                                  f"{sub_key} lacks an mfu field")
+
+    print(json.dumps(result, indent=2))
+    out = args.json
+    if out is None and not args.smoke:
+        out = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "BENCH_MFU.json")
+    if out:
+        with open(out, "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+        print(f"banked {out}")
+    for e in errors:
+        print(f"FAIL: {e}", file=sys.stderr)
+    sys.exit(1 if errors else 0)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
                     help="fast CI guard: assert no steady-state retraces")
+    ap.add_argument("--mfu", action="store_true",
+                    help="training-throughput mode: overlap/accumulation "
+                         "arms with MFU accounting (BENCH_MFU.json)")
     ap.add_argument("--json", default=None,
-                    help="bank results here (default BENCH_STEP.json at "
-                         "the repo root for a full run; none for --smoke)")
+                    help="bank results here (default BENCH_STEP.json / "
+                         "BENCH_MFU.json at the repo root for a full "
+                         "run; none for --smoke)")
     ap.add_argument("--params", type=int, default=50)
     ap.add_argument("--dim", type=int, default=32)
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--optimizer", default="adam")
     args = ap.parse_args()
+
+    if args.mfu:
+        run_mfu(args)
+        return
 
     if args.smoke:
         args.params, args.steps = 12, 3

@@ -82,7 +82,9 @@ def _device_op_lanes(events):
         if (e.get("ph") == "M" and e.get("name") == "thread_name"
                 and e.get("pid") in cpu_pids):
             lane = (e.get("args") or {}).get("name", "")
-            if lane.startswith("tf_XLAPjRtCpuClient"):
+            # XLA:CPU client threadpool lane names vary by jax/xla
+            # version: tf_XLAPjRtCpuClient/…, tf_XLATfrtCpuClient/…
+            if lane.startswith("tf_XLA") and "CpuClient" in lane:
                 lanes.add((e.get("pid"), e.get("tid")))
     return lanes, True
 
@@ -132,6 +134,125 @@ def _scope_family(args_dict, hlo_name):
         return fns[-1] + direction
     base = re.sub(r"\.\d+$", "", hlo_name)
     return base + direction
+
+
+def overlap_stats(trace_dir):
+    """Machine-readable per-device-lane overlap split (the same lane
+    attribution as ``summarize``): total compute/collective busy time,
+    the collective time overlapped with the SAME lane's compute, and
+    the wall-clock window. This is the hook tools/step_bench.py --mfu
+    uses to bank an ``overlap_ratio`` next to each arm's MFU, and what
+    the MFU section below feeds on (round 16, docs/TRAINING_PERF.md)."""
+    path = _find_trace_file(trace_dir)
+    with gzip.open(path, "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", [])
+    lanes, cpu_mode = _device_op_lanes(events)
+    coll_by_dev, compute_by_dev = {}, {}
+    t_min, t_max = float("inf"), float("-inf")
+    for e in events:
+        if e.get("ph") != "X" or (e.get("pid"), e.get("tid")) not in lanes:
+            continue
+        name, ts, dur = e.get("name", "?"), e.get("ts"), e.get("dur")
+        if ts is None or dur is None:
+            continue
+        if cpu_mode and not _HLO_NAME_RE.match(name):
+            continue
+        t_min, t_max = min(t_min, ts), max(t_max, ts + dur)
+        span = (ts, ts + dur)
+        if cpu_mode:
+            dev = (e.get("args") or {}).get("device_ordinal")
+            pid = ("vdev", dev)
+        else:
+            pid = e.get("pid")
+        if any(m in name.lower() for m in COLLECTIVE_MARKERS):
+            coll_by_dev.setdefault(pid, []).append(span)
+        else:
+            compute_by_dev.setdefault(pid, []).append(span)
+    # events with no device attribution cannot join a per-lane split —
+    # unless NOTHING is attributed (older XLA:CPU emits no
+    # device_ordinal), where the whole pool degrades to one lane and
+    # the split is a pool-level UPPER BOUND on overlap (flagged)
+    unattr_coll = coll_by_dev.pop(("vdev", None), None)
+    unattr_comp = compute_by_dev.pop(("vdev", None), None)
+    attribution = "per-lane"
+    if not coll_by_dev and not compute_by_dev and (unattr_coll or
+                                                   unattr_comp):
+        attribution = "pool-upper-bound"
+        if unattr_coll:
+            coll_by_dev[("pool", 0)] = unattr_coll
+        if unattr_comp:
+            compute_by_dev[("pool", 0)] = unattr_comp
+    busy_compute = busy_coll = overlapped = 0.0
+    for pid, spans in compute_by_dev.items():
+        _, b = _merge_intervals(spans)
+        busy_compute += b
+    for pid, spans in coll_by_dev.items():
+        merged_c, b = _merge_intervals(spans)
+        busy_coll += b
+        merged_compute, _ = _merge_intervals(compute_by_dev.get(pid, []))
+        overlapped += _overlap_len(merged_c, merged_compute)
+    n_dev = len(set(coll_by_dev) | set(compute_by_dev))
+    window = (t_max - t_min) if t_max > t_min else 0.0
+    return {
+        "cpu_mode": cpu_mode,
+        "attribution": attribution,
+        "n_device_lanes": n_dev,
+        "window_us": window,
+        "compute_us": busy_compute,
+        "collective_us": busy_coll,
+        "overlapped_us": overlapped,
+        "exposed_us": busy_coll - overlapped,
+        "overlap_ratio": (overlapped / busy_coll) if busy_coll else None,
+    }
+
+
+def mfu_section(trace_dir, step_flops, n_steps=1, peak_flops=None):
+    """Markdown MFU block from a capture of ``n_steps`` training steps
+    whose analytic cost is ``step_flops`` each (utils/flops.py
+    formulas). Two MFU readings are reported: against device-BUSY time
+    (kernel efficiency) and against the WALL window (the honest number
+    — dispatch gaps and exposed collectives count against it)."""
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from incubator_mxnet_tpu.utils.flops import peak_flops_per_device
+
+    st = overlap_stats(trace_dir)
+    peak = ({"flops": float(peak_flops), "source": "arg",
+             "device_kind": "?"} if peak_flops
+            else peak_flops_per_device())
+    n_dev = max(st["n_device_lanes"], 1)
+    total_flops = step_flops * n_steps
+    lines = ["", "## MFU (analytic model FLOPs / hardware peak)", ""]
+    # both denominators are AGGREGATE lane-time (device-seconds summed
+    # over lanes): busy time is per-lane sums, and the wall window is
+    # multiplied out to window × n_dev — so the per-device rate is
+    # total_flops / aggregate_seconds, with NO further /n_dev (that
+    # would understate MFU by another factor of n_dev)
+    for label, us in (("device-busy",
+                       st["compute_us"] + st["collective_us"]),
+                      ("wall-window", st["window_us"] * n_dev)):
+        if us <= 0:
+            continue
+        achieved = total_flops / (us * 1e-6)
+        lines.append(
+            f"- {label}: {achieved / 1e9:.2f} GFLOP/s/device over "
+            f"{n_dev} lane(s) = **{100 * achieved / peak['flops']:.1f}%"
+            f" MFU** (peak {peak['flops'] / 1e9:.0f} GFLOP/s,"
+            f" {peak['source']})")
+    if st["overlap_ratio"] is not None:
+        lines.append(
+            f"- collectives: {st['collective_us'] / 1e3:.2f} ms, "
+            f"{100 * st['overlap_ratio']:.0f}% overlapped with the "
+            f"owning lane's compute, "
+            f"{st['exposed_us'] / 1e3:.2f} ms exposed")
+    if st["cpu_mode"]:
+        lines.append(
+            "- CPU-backend caveat: peak is a measured large-matmul "
+            "proxy, so MFU here is a RELATIVE regression number, not "
+            "a hardware-utilization claim (docs/TRAINING_PERF.md)")
+    return "\n".join(lines) + "\n"
 
 
 def summarize(trace_dir, top=12):
@@ -366,8 +487,19 @@ def main():
     ap.add_argument("-o", "--out", default=None,
                     help="write the summary markdown here (default: stdout)")
     ap.add_argument("--top", type=int, default=12)
+    ap.add_argument("--step-flops", type=float, default=None,
+                    help="analytic model FLOPs per training step "
+                         "(utils/flops.py) — appends an MFU section")
+    ap.add_argument("--steps", type=int, default=1,
+                    help="training steps inside the capture window")
+    ap.add_argument("--peak-flops", type=float, default=None,
+                    help="per-device peak FLOPs override (default: TPU "
+                         "datasheet by device_kind, CPU measured proxy)")
     args = ap.parse_args()
     md = summarize(args.trace_dir, top=args.top)
+    if args.step_flops:
+        md += mfu_section(args.trace_dir, args.step_flops,
+                          n_steps=args.steps, peak_flops=args.peak_flops)
     if args.out:
         with open(args.out, "w") as f:
             f.write(md)
