@@ -126,6 +126,18 @@ stage_tiersmoke() {
   JAX_PLATFORMS=cpu python tools/chaos_bench.py --tiers --smoke
 }
 
+stage_obssmoke() {
+  echo "== obssmoke: observability guard (flight recorder + tracing —"
+  echo "             a seeded replica kill with the recorder on must dump"
+  echo "             a postmortem JSON that validates against the event"
+  echo "             schema and names the injected fault, the dead"
+  echo "             replica, and every re-queued request; the Perfetto"
+  echo "             export of a mixed prefill/decode/preemption run must"
+  echo "             validate and show per-slot lanes; recorder overhead"
+  echo "             is gated by the servebench stage's smoke run)"
+  JAX_PLATFORMS=cpu python tools/trace_export.py --smoke
+}
+
 stage_trainchaos() {
   echo "== trainchaos: training resilience guard (seeded faults — NaN"
   echo "               gradients, overflow storms, persistent poison, NaN"
@@ -145,6 +157,12 @@ stage_ckptbench() {
   JAX_PLATFORMS=cpu python tools/ckpt_bench.py --smoke
 }
 
+stage_report() {
+  echo "== report: bench trajectory (aggregates every banked BENCH_*.json"
+  echo "           into BENCH_TRAJECTORY.md — informational, never fails)"
+  python tools/bench_report.py || true
+}
+
 stage_entry() {
   echo "== entry: driver entry points (single-chip compile is driver-side;"
   echo "          here the 8-device multichip dryrun must pass)"
@@ -158,7 +176,7 @@ ge.dryrun_multichip(8)"
 }
 
 stages=("$@")
-[ ${#stages[@]} -eq 0 ] && stages=(sanity lintcore native unit stepbench mfubench servebench quantbench chaossmoke fleetsmoke tiersmoke trainchaos ckptbench entry)
+[ ${#stages[@]} -eq 0 ] && stages=(sanity lintcore native unit stepbench mfubench servebench quantbench chaossmoke fleetsmoke tiersmoke obssmoke trainchaos ckptbench entry report)
 for s in "${stages[@]}"; do
   "stage_$s"
 done

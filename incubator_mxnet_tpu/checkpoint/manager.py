@@ -132,11 +132,18 @@ class CheckpointManager:
     """
 
     def __init__(self, directory: str, keep: Optional[int] = 3,
-                 async_save: bool = True):
+                 async_save: bool = True, recorder=None):
         self.directory = os.fspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.keep = keep or 0
         self.async_save = async_save
+        # flight recorder (events.py): every COMMIT is one
+        # event — pass the owning engine/trainer's recorder to land
+        # commits on the same timeline as the steps they snapshot.
+        # The emit may run on the writer thread; a deque append is
+        # atomic under the GIL, same contract as the counters below.
+        from ..events import resolve_recorder
+        self.flight = resolve_recorder(recorder, histograms=False)
         # RLock: the SIGTERM preemption handler runs ON the main thread
         # and may interrupt save() INSIDE its critical section; the
         # handler's drain (wait()) must be able to re-enter. Condition
@@ -246,6 +253,10 @@ class CheckpointManager:
         # shared state (mxlint lock-discipline)
         with self._lock:
             self.committed_steps += 1
+        from ..events import EventType
+        self.flight.emit("checkpoint", EventType.CHECKPOINT_COMMIT,
+                         entity=self.directory, step=int(step),
+                         preempted=bool(meta.get("preempted", False)))
         if self.keep:
             _manifest.gc_steps(self.directory, self.keep)
 

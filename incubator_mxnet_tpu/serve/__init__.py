@@ -33,6 +33,7 @@ See docs/SERVING.md for the architecture and invariants.
 from .paged_kv import (NULL_PAGE, PageAllocator, PrefixIndex,
                        init_kv_pools, write_block_kv, write_prompt_kv,
                        write_token_kv)
+from .events import Event, EventType, FlightRecorder
 from .outcomes import Outcome
 from .slo import (BrownoutController, Tier, TierPolicy,
                   default_tier_policies)
@@ -48,4 +49,4 @@ __all__ = ["InferenceEngine", "Request", "Outcome", "PageAllocator",
            "make_ngram_drafter", "Router", "Replica", "ReplicaState",
            "ReplicaKilled", "build_fleet", "Tier", "TierPolicy",
            "default_tier_policies", "BrownoutController",
-           "render_metrics"]
+           "render_metrics", "Event", "EventType", "FlightRecorder"]

@@ -33,6 +33,7 @@ import numpy as np
 
 from ..base import MXNetError
 from .engine import InferenceEngine, Request
+from .events import EventType
 from .outcomes import Outcome
 from .router import ReplicaState, Router
 
@@ -595,6 +596,20 @@ class FleetCancelStorm(FleetInjector):
                                 f"({len(req.token_ids)} tokens in)")
 
 
+def _mirror_injector_events(flight, component, injectors, seen):
+    """Land every injector firing on the flight-recorder timeline —
+    one CHAOS event per new injector-log line, so a postmortem dump
+    always NAMES the injected fault next to its consequences (the
+    obssmoke CI contract). ``seen`` maps injector → log length already
+    mirrored; injectors stay recorder-agnostic."""
+    for inj in injectors:
+        n = seen.get(id(inj), 0)
+        for line in inj.log[n:]:
+            flight.emit(component, EventType.CHAOS, entity=inj.name,
+                        detail=line[:300])
+        seen[id(inj)] = len(inj.log)
+
+
 def run_fleet_chaos(router: Router, requests, injectors,
                     arrival_times=None, audit_every_step: bool = True,
                     poll_sleep: float = 1e-3):
@@ -602,11 +617,15 @@ def run_fleet_chaos(router: Router, requests, injectors,
     via the router's ``before_step`` hook, auditing EVERY surviving
     replica's page invariant after every router step (a dead replica's
     memory is off-limits by definition). Raises if any request fails
-    to reach a terminal outcome."""
+    to reach a terminal outcome — after dumping a postmortem of the
+    fleet timeline (the chaos-invariant-breach black box,
+    docs/OBSERVABILITY.md)."""
+    seen: dict = {}
 
     def before(rt, i):
         for inj in injectors:
             inj.on_step(rt, i)
+        _mirror_injector_events(rt.flight, "router", injectors, seen)
 
     def after(rt, i):
         if audit_every_step:
@@ -615,10 +634,16 @@ def run_fleet_chaos(router: Router, requests, injectors,
                         rep.killed is None:
                     rep.engine.audit_pages()
 
-    router.run(requests, arrival_times=arrival_times,
-               poll_sleep=poll_sleep, before_step=before,
-               after_step=after)
-    assert_all_terminal(requests)
+    try:
+        router.run(requests, arrival_times=arrival_times,
+                   poll_sleep=poll_sleep, before_step=before,
+                   after_step=after)
+        assert_all_terminal(requests)
+    except MXNetError as e:
+        router.flight.postmortem(
+            "chaos invariant breach", f"{type(e).__name__}",
+            context={"error": str(e)[:400]})
+        raise
     return requests
 
 
@@ -646,20 +671,31 @@ def run_chaos(engine: InferenceEngine, requests, injectors,
     """Drive ``requests`` through ``engine`` with ``injectors`` firing
     via the scheduler's ``before_step`` hook, auditing the page
     invariant after EVERY step (faults included). Returns the requests;
-    raises if any request failed to reach a terminal outcome."""
+    raises if any request failed to reach a terminal outcome — after
+    dumping a postmortem of the engine timeline (the
+    chaos-invariant-breach black box, docs/OBSERVABILITY.md)."""
+    seen: dict = {}
 
     def before(eng, i):
         for inj in injectors:
             inj.on_step(eng, i)
+        _mirror_injector_events(eng.flight, eng._component, injectors,
+                                seen)
 
     def after(eng, i):
         if audit_every_step:
             eng.audit_pages()
 
-    engine.run(requests, arrival_times=arrival_times,
-               poll_sleep=poll_sleep, before_step=before,
-               after_step=after)
-    assert_all_terminal(requests)
+    try:
+        engine.run(requests, arrival_times=arrival_times,
+                   poll_sleep=poll_sleep, before_step=before,
+                   after_step=after)
+        assert_all_terminal(requests)
+    except MXNetError as e:
+        engine.flight.postmortem(
+            "chaos invariant breach", f"{type(e).__name__}",
+            context={"error": str(e)[:400]})
+        raise
     return requests
 
 

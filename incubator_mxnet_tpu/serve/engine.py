@@ -111,6 +111,7 @@ from ..ops.ragged_attention import (ragged_attention_reference,
                                     ragged_verify_attention,
                                     ragged_verify_reference)
 from .draft import make_ngram_drafter
+from .events import EventType, resolve_recorder, terminal_fields
 from .outcomes import Outcome
 from .paged_kv import (NULL_PAGE, PageAllocator, PrefixIndex,
                        init_kv_pools, kv_quant_spec, page_scales,
@@ -360,7 +361,8 @@ class InferenceEngine:
                  spec_k=0, draft_fn=None, draft_ngram=3,
                  spec_patience=2, spec_probe_every=64,
                  tier_policies=None, max_preemptions=4,
-                 brownout=None, kv_quant=None):
+                 brownout=None, kv_quant=None, recorder=None,
+                 component="engine"):
         self.model = model
         self.num_slots = int(num_slots)
         self.page_size = int(page_size)
@@ -500,6 +502,19 @@ class InferenceEngine:
             brownout = BrownoutController(
                 delay_ref=max_queue_delay_s or 1.0)
         self._brownout = brownout            # None | BrownoutController
+
+        # flight recorder (serve/events.py, docs/OBSERVABILITY.md):
+        # ON by default (overhead banked <2%, BENCH_SERVE.json
+        # recorder_overhead); ``recorder=False`` disables, passing an
+        # existing FlightRecorder shares a timeline. ``component``
+        # names this engine's lane (a Router renames its replicas'
+        # default lanes to replica<i> at adoption).
+        self.flight = resolve_recorder(recorder)
+        self._component = str(component)
+        if self._brownout is not None:
+            # brownout transitions land on THIS engine's lane (the
+            # controller itself is engine-agnostic — serve/slo.py)
+            self._brownout.flight = self.flight
 
         # speculative-decoding observability (docs/SERVING.md): drafted
         # vs accepted counts feed accept_rate; per-request twins live on
@@ -1052,6 +1067,15 @@ class InferenceEngine:
         request.finish_time = time.perf_counter()
         self.health[outcome.value] += 1
         self.health_by_tier[request.tier.value][outcome.value] += 1
+        # the TERMINAL event (and the latency histograms it feeds) are
+        # emitted HERE and only here — exactly-once by the same
+        # construction as the outcome itself (serve/events.py). The
+        # enabled gate keeps the O(tokens) gap derivation off the
+        # recorder=False path entirely.
+        if self.flight.enabled:
+            self.flight.emit(self._component, EventType.TERMINAL,
+                             request_id=request.request_id,
+                             **terminal_fields(request))
 
     def _tier_policy(self, tier: Tier) -> TierPolicy:
         return self._tier_policies[tier]
@@ -1160,6 +1184,11 @@ class InferenceEngine:
             "brownout_level": self.brownout_level,
             "brownout_escalations": bo.escalations if bo else 0,
             "brownout_deescalations": bo.deescalations if bo else 0,
+            # tier-labeled TTFT/TPOT/queue-delay/e2e histograms,
+            # ingested from the SAME event stream as every counter
+            # above (serve/events.py) — rendered by serve/metrics.py;
+            # None when the recorder is disabled
+            "latency_hists": self.flight.hist_snapshot(),
         }
 
     def prefix_probe(self, prompt_ids) -> int:
@@ -1263,6 +1292,10 @@ class InferenceEngine:
         first (``_shed_one_below``) — BATCH absorbs overload before
         STANDARD before LATENCY."""
         request.submit_time = time.perf_counter()
+        self.flight.emit(self._component, EventType.SUBMIT,
+                         request_id=request.request_id,
+                         tier=request.tier.value,
+                         queue_depth=len(self._queue))
         pol = self._tier_policy(request.tier)
         if request.deadline_s is None and \
                 pol.default_deadline_s is not None:
@@ -1471,12 +1504,20 @@ class InferenceEngine:
         req.preemptions += 1
         self.preemptions += 1
         self._free_slot_state(slot_idx)
+        self.flight.emit(self._component, EventType.PREEMPT,
+                         request_id=req.request_id,
+                         tier=req.tier.value, slot=slot_idx,
+                         preemptions=req.preemptions, detail=detail)
         if req.preemptions > self.max_preemptions:
             self._record_terminal(
                 req, Outcome.PREEMPTED,
                 f"preempted {req.preemptions} times "
                 f"(max_preemptions={self.max_preemptions}): {detail}")
         else:
+            self.flight.emit(self._component, EventType.REQUEUE,
+                             request_id=req.request_id,
+                             cause="preemption",
+                             preemptions=req.preemptions)
             self._queue.append(req)
 
     def _admit(self):
@@ -1621,6 +1662,12 @@ class InferenceEngine:
             # the temporary pin on the cached source
             self._copy_page(partial[0], int(row[len(shared)]))
             self._alloc.decref(partial[0])
+        self.flight.emit(
+            self._component, EventType.ADMIT,
+            request_id=req.request_id, tier=req.tier.value,
+            slot=slot_idx, t0=t0, cached_len=cached_len,
+            queue_delay_s=(slot.t_admit - req.submit_time
+                           if req.submit_time is not None else None))
 
         if self.chunk_pages is None:
             # monolithic mode: prefill to completion inside _admit.
@@ -1640,6 +1687,7 @@ class InferenceEngine:
         """The PR 2 monolithic prompt program (one pow2-page bucket)."""
         slot = self._slots[slot_idx]
         req = slot.request
+        t_start = time.perf_counter()
         t0 = slot.t0
         prompt_pages = -(-t0 // self.page_size)
         bucket = min(_next_pow2(prompt_pages), self.max_pages)
@@ -1660,6 +1708,10 @@ class InferenceEngine:
         slot.prefill_pos = t0
         # mxlint: allow-host-sync(prefill-boundary readback, once per prompt: the sampled first token must reach token_ids)
         tok = int(np.asarray(tok))
+        self.flight.emit(self._component, EventType.PREFILL_CHUNK,
+                         request_id=req.request_id, ts=t_start,
+                         slot=slot_idx, start=0, n=t0,
+                         dur_s=time.perf_counter() - t_start)
         if tok < 0:                          # sign-encoded guard flag
             self._quarantine(slot_idx, "non-finite logits in prefill")
             return
@@ -1672,6 +1724,7 @@ class InferenceEngine:
         cache-hit suffix, bucket to the same pow2-page family)."""
         slot = self._slots[slot_idx]
         req = slot.request
+        t_start = time.perf_counter()
         start = slot.prefill_pos
         remaining = slot.t0 - start
         if self.chunk_pages is not None:
@@ -1694,6 +1747,10 @@ class InferenceEngine:
         slot.prefill_pos = start + n
         # mxlint: allow-host-sync(chunk-boundary readback, once per chunk: the guard flag and tail token gate the next chunk)
         tok = int(np.asarray(tok))
+        self.flight.emit(self._component, EventType.PREFILL_CHUNK,
+                         request_id=req.request_id, ts=t_start,
+                         slot=slot_idx, start=start, n=n,
+                         dur_s=time.perf_counter() - t_start)
         if tok < 0:                          # sign-encoded guard flag
             # poisoned mid-prompt: fail NOW — later chunks would only
             # propagate the contamination (and the prompt's pages must
@@ -1950,6 +2007,9 @@ class InferenceEngine:
         self._lengths = new_lengths
         dt = time.perf_counter() - t_start
         self.decode_steps += 1
+        self.flight.emit(self._component, EventType.DECODE_STEP,
+                         ts=t_start, step=self.decode_steps, width=W,
+                         live=len(live), dur_s=dt)
         for s in live:
             if emitted[s, 0] < 0:            # sign-encoded guard flag
                 # poisoned verify: NOTHING from this step is recorded —

@@ -75,33 +75,63 @@ _ROUTER_COUNTERS = [
 
 _REPLICA_UP = {"SERVING": 1.0, "DEGRADED": 0.5, "DEAD": 0.0}
 
+# flight-recorder latency metrics (serve/events.py) -> prometheus name
+_HIST_METRICS = [
+    ("ttft", "ttft_seconds"),
+    ("tpot", "tpot_seconds"),
+    ("queue_delay", "queue_delay_seconds"),
+    ("e2e", "e2e_latency_seconds"),
+]
+
 
 class _Writer:
     """Accumulates samples grouped under one ``# TYPE`` line per
     metric name (the format requires the declaration to precede every
-    sample of that name, once)."""
+    sample of that name, once). Histogram samples carry the
+    Prometheus suffix convention: the ``# TYPE x histogram`` line
+    declares ``x``; the samples are ``x_bucket{le=...}`` /
+    ``x_sum`` / ``x_count``."""
 
     def __init__(self):
         self._types: dict = {}           # name -> type
-        self._samples: dict = {}         # name -> [(labels, value)]
+        self._samples: dict = {}         # name -> [(suffix, labels, v)]
 
     def add(self, name: str, mtype: str, value, labels: str = ""):
         if value is None:
             return
         self._types.setdefault(name, mtype)
-        self._samples.setdefault(name, []).append((labels,
+        self._samples.setdefault(name, []).append(("", labels,
                                                    float(value)))
+
+    def add_histogram(self, name: str, bounds, counts, hsum, hcount,
+                      labels: Optional[dict] = None):
+        """One histogram series: ``counts`` is per-bucket (NOT
+        cumulative) with the overflow bucket last — rendered as the
+        cumulative ``_bucket`` samples the format requires, closed by
+        ``le="+Inf"`` == ``_count``."""
+        labels = dict(labels or {})
+        self._types.setdefault(name, "histogram")
+        rows = self._samples.setdefault(name, [])
+        cum = 0
+        for b, c in zip(bounds, counts):
+            cum += c
+            rows.append(("_bucket", _labels(**labels, le=repr(float(b))),
+                         float(cum)))
+        rows.append(("_bucket", _labels(**labels, le="+Inf"),
+                     float(hcount)))
+        rows.append(("_sum", _labels(**labels), float(hsum)))
+        rows.append(("_count", _labels(**labels), float(hcount)))
 
     def render(self) -> str:
         out: List[str] = []
         for name in self._samples:
             out.append(f"# TYPE {name} {self._types[name]}")
-            for labels, value in self._samples[name]:
+            for suffix, labels, value in self._samples[name]:
                 if value == int(value):
                     sval = str(int(value))
                 else:
                     sval = repr(value)
-                out.append(f"{name}{labels} {sval}")
+                out.append(f"{name}{suffix}{labels} {sval}")
         return "\n".join(out) + "\n"
 
 
@@ -110,6 +140,28 @@ def _labels(**kv) -> str:
         return ""
     inner = ",".join(f'{k}="{v}"' for k, v in kv.items())
     return "{" + inner + "}"
+
+
+def _emit_hists(w: _Writer, snap: dict, ns: str = _NS,
+                extra: Optional[dict] = None):
+    """Tier-labeled TTFT/TPOT/queue-delay/e2e histograms from the
+    flight recorder's snapshot (``latency_hists``) — derived from the
+    SAME event stream as the outcome counters, so the percentiles a
+    dashboard computes from these can never disagree with the
+    counters next to them (docs/OBSERVABILITY.md)."""
+    hists = snap.get("latency_hists")
+    if not hists:
+        return
+    extra = extra or {}
+    bounds = hists["bounds"]
+    for metric, suffix in _HIST_METRICS:
+        for tier, cell in sorted(hists["metrics"].get(metric,
+                                                      {}).items()):
+            labels = dict(extra)
+            if tier:
+                labels["tier"] = tier
+            w.add_histogram(f"{ns}_{suffix}", bounds, cell["counts"],
+                            cell["sum"], cell["count"], labels)
 
 
 def _emit_outcomes(w: _Writer, snap: dict, ns: str = _NS,
@@ -149,6 +201,7 @@ def _emit_engine(w: _Writer, snap: dict, ns: str = _NS,
         if key in snap:
             w.add(f"{ns}_{suffix}", "counter", snap[key],
                   _labels(**extra))
+    _emit_hists(w, snap, ns, extra)
 
 
 def render_metrics(snapshot: dict) -> str:
@@ -165,6 +218,7 @@ def render_metrics(snapshot: dict) -> str:
         _emit_engine(w, snapshot)
         return w.render()
     _emit_outcomes(w, snapshot)
+    _emit_hists(w, snapshot)             # client-level SLO histograms
     w.add(f"{_NS}_queue_depth", "gauge", snapshot["queue_depth"])
     w.add(f"{_NS}_inflight", "gauge", snapshot["inflight"])
     for key, suffix in _ROUTER_COUNTERS:

@@ -76,6 +76,7 @@ import numpy as np
 
 from ..base import MXNetError
 from .engine import InferenceEngine, Request
+from .events import EventType, resolve_recorder, terminal_fields
 from .outcomes import Outcome
 from .slo import Tier, resolve_tier_policies
 
@@ -185,10 +186,22 @@ class Router:
                  max_queue: Optional[int] = None,
                  max_queue_delay_s: Optional[float] = None,
                  stall_steps: int = 2000, seed: int = 0,
-                 tier_policies: Optional[dict] = None):
+                 tier_policies: Optional[dict] = None,
+                 recorder=None):
         if not engines:
             raise MXNetError("a fleet needs at least one replica")
         self.replicas = [Replica(i, e) for i, e in enumerate(engines)]
+        # the router's own flight recorder (serve/events.py): CLIENT
+        # lifecycle + routing/failover/replica-health events. Each
+        # replica keeps its OWN recorder (attempt-level events and
+        # histograms must not merge into the client view); a replica
+        # still carrying the default lane name is renamed replica<i>
+        # so a merged export (``flight_events``) reads as a fleet.
+        self.flight = resolve_recorder(recorder)
+        self._component = "router"
+        for rep in self.replicas:
+            if getattr(rep.engine, "_component", None) == "engine":
+                rep.engine._component = f"replica{rep.idx}"
         self.affinity = bool(affinity)
         self.max_requeues = int(max_requeues)
         self.heartbeat_timeout_s = float(heartbeat_timeout_s)
@@ -263,6 +276,14 @@ class Router:
         request.finish_time = time.perf_counter()
         self.health[outcome.value] += 1
         self.health_by_tier[request.tier.value][outcome.value] += 1
+        # the client-level TERMINAL event + latency histograms — the
+        # engine-level twin lives in InferenceEngine._record_terminal
+        # (attempts), this one counts CLIENT terminals exactly once;
+        # enabled gate: the O(tokens) derivation is recorder-only work
+        if self.flight.enabled:
+            self.flight.emit(self._component, EventType.TERMINAL,
+                             request_id=request.request_id,
+                             **terminal_fields(request))
 
     # ------------------------------------------------------------- #
     # admission
@@ -337,6 +358,10 @@ class Router:
         and delay limit (falling back to the router globals), and the
         global queue bound drains the lowest queued tier first."""
         request.submit_time = time.perf_counter()
+        self.flight.emit(self._component, EventType.SUBMIT,
+                         request_id=request.request_id,
+                         tier=request.tier.value,
+                         queue_depth=len(self._queue))
         pol = self._tier_policies[request.tier]
         if request.deadline_s is None and \
                 pol.default_deadline_s is not None:
@@ -559,9 +584,25 @@ class Router:
                 tracked.client, Outcome.FAILED_REPLICA,
                 f"gave up after {tracked.requeues} re-queues "
                 f"(max_requeues={self.max_requeues}): {detail}")
+            # FAILED_REPLICA at the requeue bound is a structured
+            # give-up — dump the trailing fleet timeline naming the
+            # request (the REQUEUE/REPLICA_HEALTH events name the
+            # replicas that failed it) — docs/OBSERVABILITY.md
+            self.flight.postmortem(
+                "FAILED_REPLICA at requeue bound",
+                f"request {tracked.client.request_id}",
+                context={"requeues": tracked.requeues,
+                         "max_requeues": self.max_requeues,
+                         "detail": detail})
             return
         tracked.requeues += 1
         self.requeues += 1
+        self.flight.emit(self._component, EventType.REQUEUE,
+                         request_id=tracked.client.request_id,
+                         cause="failover", requeues=tracked.requeues,
+                         detail=detail[:200],
+                         tokens_preserved=len(
+                             tracked.client.token_ids))
         self.log.append(f"requeue #{tracked.requeues}: {detail} "
                         f"({len(tracked.client.token_ids)} tokens "
                         f"preserved)")
@@ -637,6 +678,15 @@ class Router:
             t.replica = rep.idx
             self._inflight.append(t)
             dispatched += 1
+            self.flight.emit(self._component, EventType.DISPATCH,
+                             request_id=c.request_id,
+                             entity=f"replica{rep.idx}",
+                             attempt_id=att.request_id,
+                             replica=rep.idx, tier=c.tier.value,
+                             queue_delay_s=(
+                                 time.perf_counter() - c.submit_time
+                                 if c.submit_time is not None
+                                 else None))
             for r, s in snaps:               # keep the pass view honest:
                 if r is rep:                 # the dispatch consumes a
                     if s["free_slots"] > 0:  # free slot's allowance or
@@ -669,6 +719,12 @@ class Router:
                 rep.next_probe_t = now + self._jittered(rep.backoff_s)
                 rep.breaker_opens += 1
                 self.breaker_opens += 1
+                self.flight.emit(
+                    self._component, EventType.REPLICA_HEALTH,
+                    entity=f"replica{rep.idx}", replica=rep.idx,
+                    from_state=ReplicaState.SERVING.value,
+                    to_state=ReplicaState.DEGRADED.value,
+                    detail=detail[:200])
                 self.log.append(f"replica {rep.idx}: breaker OPEN "
                                 f"after {rep.consecutive_misses} "
                                 f"misses ({detail})")
@@ -700,6 +756,12 @@ class Router:
                 rep.backoff_s = None
                 rep.probe_successes = 0
                 self.recoveries += 1
+                self.flight.emit(
+                    self._component, EventType.REPLICA_HEALTH,
+                    entity=f"replica{rep.idx}", replica=rep.idx,
+                    from_state=ReplicaState.DEGRADED.value,
+                    to_state=ReplicaState.SERVING.value,
+                    detail="breaker closed (recovered)")
                 self.log.append(f"replica {rep.idx}: breaker CLOSED "
                                 f"(recovered)")
 
@@ -708,9 +770,15 @@ class Router:
         trusted. Mark it DEAD and re-queue every in-flight request it
         held — from the ROUTER'S bookkeeping (prompt + the tokens
         already streamed), never from the dead engine's memory."""
+        prev_state = rep.state
         rep.state = ReplicaState.DEAD
         rep.death_detail = detail
         self.replica_deaths += 1
+        self.flight.emit(self._component, EventType.REPLICA_HEALTH,
+                         entity=f"replica{rep.idx}", replica=rep.idx,
+                         from_state=prev_state.value,
+                         to_state=ReplicaState.DEAD.value,
+                         detail=detail[:200])
         self.log.append(f"replica {rep.idx}: DEAD ({detail})")
         mine = [t for t in self._inflight if t.replica == rep.idx]
         for t in mine:
@@ -981,6 +1049,25 @@ class Router:
     # observability
     # ------------------------------------------------------------- #
 
+    def flight_events(self):
+        """The merged fleet timeline: the router's own events plus
+        every replica's — DEAD replicas INCLUDED. The "never read a
+        dead engine again" rule protects request/page bookkeeping the
+        death left untrustworthy; the flight recorder is the router
+        process's own host-side log of what that replica did BEFORE it
+        died, which is exactly the evidence a death postmortem exists
+        to keep (its lane simply ends at the kill; the router-side
+        REPLICA_HEALTH event records the death itself). Ordered by
+        timestamp (then seq) — seq is only per-recorder, so the clock
+        is the cross-recorder order. This is what
+        ``tools/trace_export.py`` turns into one fleet-wide Perfetto
+        timeline."""
+        evs = list(self.flight.events())
+        for rep in self.replicas:
+            evs.extend(rep.engine.flight.events())
+        evs.sort(key=lambda e: (e.ts, e.component, e.seq))
+        return evs
+
     def health_snapshot(self) -> dict:
         """Consistent fleet-wide snapshot: router outcome tally +
         routing/failover counters + per-replica state (with each LIVE
@@ -1012,6 +1099,10 @@ class Router:
             "recoveries": self.recoveries,
             "affinity_routed": self.affinity_routed,
             "spill_routed": self.spill_routed,
+            # CLIENT-level latency histograms (the SLO percentiles a
+            # dashboard should alert on — per-replica attempt
+            # histograms ride each replica's own engine snapshot)
+            "latency_hists": self.flight.hist_snapshot(),
             "replicas": reps,
         }
 

@@ -159,6 +159,10 @@ class BrownoutController:
         self.escalations = 0
         self.deescalations = 0
         self.timeline: List[dict] = []       # one entry per transition
+        self.flight = None                   # FlightRecorder the owning
+                                             # engine attaches — every
+                                             # transition then lands on
+                                             # its event timeline too
         self._over = 0
         self._under = 0
 
@@ -218,5 +222,12 @@ class BrownoutController:
             self.escalations += 1
         else:
             self.deescalations += 1
+        if self.flight is not None:
+            from .events import EventType
+            self.flight.emit(
+                getattr(engine, "_component", "engine"),
+                EventType.BROWNOUT, entity="brownout",
+                from_level=self.level, to_level=new_level,
+                pressure=entry["pressure"], step=entry["step"])
         self.level = new_level
         self.timeline.append(entry)

@@ -85,7 +85,8 @@ class StepRecorder:
     gradient is poison (NaN weights, divergence), not overflow.
     """
 
-    def __init__(self, max_consecutive_nonfinite: Optional[int] = None):
+    def __init__(self, max_consecutive_nonfinite: Optional[int] = None,
+                 flight=None, component: str = "trainer"):
         if max_consecutive_nonfinite is None:
             max_consecutive_nonfinite = getenv_int(
                 "MXTPU_MAX_NONFINITE_STEPS", 25)
@@ -96,6 +97,15 @@ class StepRecorder:
         self.last_outcome: Optional[StepOutcome] = None
         self.last_detail: str = ""
         self._open = False
+        # flight recorder (events.py, docs/OBSERVABILITY.md):
+        # every recorded StepOutcome also lands as ONE TRAIN_STEP
+        # event — the same exactly-once construction as the outcome —
+        # and a HALTED_POISONED escalation dumps a postmortem naming
+        # the trainer. ``flight=False`` disables; default is a private
+        # bounded ring (no request latencies → no histograms).
+        from ..events import resolve_recorder
+        self.flight = resolve_recorder(flight, histograms=False)
+        self.component = str(component)
 
     # ------------------------------------------------------------------ #
     def open_step(self) -> None:
@@ -126,6 +136,16 @@ class StepRecorder:
         self.last_outcome = outcome
         self.last_detail = detail
         self._open = False
+        from ..events import EventType
+        self.flight.emit(self.component, EventType.TRAIN_STEP,
+                         step=self.step_count, outcome=outcome.value,
+                         detail=detail[:200])
+        if outcome is StepOutcome.HALTED_POISONED:
+            self.flight.postmortem(
+                "HALTED_POISONED", self.component,
+                context={"consecutive_nonfinite":
+                         self.consecutive_nonfinite,
+                         "detail": detail[:400]})
         return outcome
 
     def abort_step(self) -> None:

@@ -109,7 +109,8 @@ class Supervisor:
                  hang_timeout_s: Optional[float] = None,
                  startup_grace_s: Optional[float] = None,
                  poll_s: float = 0.05, env: Optional[dict] = None,
-                 stdout=None, stderr=None):
+                 stdout=None, stderr=None, recorder=None,
+                 postmortem_dir: Optional[str] = None):
         if hang_timeout_s is not None and \
                 ckpt_dir is None and progress_file is None:
             raise MXNetError(
@@ -129,6 +130,14 @@ class Supervisor:
         self.env = dict(env or {})
         self.stdout = stdout
         self.stderr = stderr
+        # flight recorder (events.py): every restart is an
+        # event, and an exhausted budget dumps a postmortem naming the
+        # supervised command — written next to the checkpoints by
+        # default so the evidence survives the dead run
+        from ..events import resolve_recorder
+        self.flight = resolve_recorder(
+            recorder, histograms=False,
+            postmortem_dir=postmortem_dir or ckpt_dir)
 
     # ------------------------------------------------------------------ #
     def _progress_token(self):
@@ -228,6 +237,17 @@ class Supervisor:
                 report = SupervisorReport(
                     False, restarts, hang_kills, attempts, backoffs,
                     time.monotonic() - t_start)
+                from ..events import EventType
+                self.flight.emit("supervisor",
+                                 EventType.SUPERVISOR_GIVEUP,
+                                 entity=self.argv[0],
+                                 restarts=restarts,
+                                 hang_kills=hang_kills)
+                self.flight.postmortem(
+                    "supervisor give-up", " ".join(self.argv)[:200],
+                    context={"restarts": restarts,
+                             "hang_kills": hang_kills,
+                             "summary": report.summary()})
                 if raise_on_failure:
                     raise MXNetError(
                         f"supervisor gave up after {restarts} restarts "
@@ -235,5 +255,15 @@ class Supervisor:
                 return report
             restarts += 1
             backoffs.append(backoff)
+            from ..events import EventType
+            last = attempts[-1]
+            self.flight.emit("supervisor",
+                             EventType.SUPERVISOR_RESTART,
+                             entity=self.argv[0], restart=restarts,
+                             reason=last.reason,
+                             exit_code=last.exit_code,
+                             term_signal=last.term_signal,
+                             backoff_s=backoff,
+                             progressed=last.progressed)
             time.sleep(backoff)
             backoff = min(backoff * 2.0, self.backoff_max_s)

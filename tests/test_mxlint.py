@@ -227,6 +227,72 @@ def test_outcome_pass_scoped_to_serve_and_train(tmp_path):
                              rule="terminal-outcome")) == []
 
 
+BAD_EVENT_BUFFER = {
+    "incubator_mxnet_tpu/serve/badevents.py": """
+        class Engine:
+            def sneak_event(self, ev):
+                # bypasses FlightRecorder.emit: no seq, no histogram
+                # ingestion, no capacity bound — the round-17 event
+                # discipline violation, distilled
+                self.flight._rings["engine"].append(ev)
+
+            def peek(self):
+                return list(self.flight._rings.values())
+    """,
+}
+
+CLEAN_EVENT_BUFFER = {
+    "incubator_mxnet_tpu/serve/goodevents.py": """
+        from collections import deque
+
+
+        class FlightRecorder:
+            def __init__(self):
+                self._rings = {}
+
+            def emit(self, component, ev):
+                ring = self._rings.setdefault(component, deque())
+                ring.append(ev)
+
+            def events(self):
+                return [e for r in self._rings.values() for e in r]
+
+
+        class Engine:
+            def record(self, ev):
+                self.flight.emit("engine", ev)   # the one API
+    """,
+}
+
+
+def test_outcome_pass_flags_event_buffer_bypass(tmp_path):
+    active = _active(_findings(tmp_path, BAD_EVENT_BUFFER,
+                               rule="terminal-outcome"))
+    assert {f.symbol for f in active} == \
+        {"Engine.sneak_event", "Engine.peek"}
+    assert all("FlightRecorder API" in f.message for f in active)
+
+
+def test_outcome_pass_event_buffer_clean_inside_recorder(tmp_path):
+    assert _active(_findings(tmp_path, CLEAN_EVENT_BUFFER,
+                             rule="terminal-outcome")) == []
+
+
+def test_outcome_pass_event_buffer_covers_whole_package(tmp_path):
+    """The ring-discipline sub-rule is scoped to the whole package —
+    checkpoint/manager.py holds a recorder too, so a bypass there must
+    be caught even though the outcome/health checks stay scoped to
+    serve/+train/."""
+    files = {"incubator_mxnet_tpu/checkpoint/badckpt.py": """
+        class Manager:
+            def sneak(self, ev):
+                self.flight._rings["checkpoint"].append(ev)
+    """}
+    active = _active(_findings(tmp_path, files,
+                               rule="terminal-outcome"))
+    assert {f.symbol for f in active} == {"Manager.sneak"}
+
+
 # --------------------------------------------------------------------- #
 # pass 3: page-refcount
 # --------------------------------------------------------------------- #
@@ -640,6 +706,11 @@ _INJECTIONS = {
     "terminal-outcome": (
         "incubator_mxnet_tpu/serve/injected_outcome.py",
         BAD_OUTCOME["incubator_mxnet_tpu/serve/badoutcome.py"]),
+    # second terminal-outcome injection: the round-17 event-buffer
+    # rule ("#" suffix = parametrize id only; the rule is the prefix)
+    "terminal-outcome#events": (
+        "incubator_mxnet_tpu/serve/injected_events.py",
+        BAD_EVENT_BUFFER["incubator_mxnet_tpu/serve/badevents.py"]),
     "page-refcount": (
         "incubator_mxnet_tpu/serve/injected_pages.py",
         BAD_PAGES["incubator_mxnet_tpu/serve/badpages.py"]),
@@ -669,6 +740,7 @@ def test_lintcore_fails_on_injected_bug(tmp_path, rule):
     """Injecting any SINGLE fixture bug (one per pass) into an
     otherwise-clean tree must flip the lintcore gate non-zero."""
     rel, src = _INJECTIONS[rule]
+    rule = rule.split("#")[0]            # "#suffix" = parametrize id
     root = _tree(tmp_path, {rel: src})
     rc = mxlint_main(["--root", root, "incubator_mxnet_tpu"])
     assert rc == 1, f"{rule}: injected bug not caught"

@@ -657,7 +657,10 @@ _SAMPLE = re.compile(r"^([A-Za-z_:][A-Za-z0-9_:]*)"
 
 def _golden_parse(text):
     """Prometheus text-format validation: every sample line parses and
-    its metric name was declared by a preceding # TYPE line."""
+    its metric name was declared by a preceding # TYPE line. A
+    histogram declaration for ``x`` covers the convention-suffixed
+    samples ``x_bucket`` / ``x_sum`` / ``x_count`` (the round-17
+    latency histograms, serve/metrics.py)."""
     typed = {}
     samples = []
     for line in text.strip().splitlines():
@@ -669,7 +672,12 @@ def _golden_parse(text):
         m = _SAMPLE.match(line)
         assert m, f"unparseable metrics line: {line!r}"
         name, labels, value = m.groups()
-        assert name in typed, f"sample before TYPE: {line!r}"
+        if name not in typed:
+            base = name.rsplit("_", 1)[0]
+            assert name.rsplit("_", 1)[-1] in ("bucket", "sum",
+                                               "count") and \
+                typed.get(base) == "histogram", \
+                f"sample before TYPE: {line!r}"
         samples.append((name, labels or "", float(value)))
     return typed, samples
 

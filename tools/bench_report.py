@@ -1,0 +1,144 @@
+"""Aggregate every banked BENCH_*.json into one readable trajectory.
+
+Each PR banks its performance evidence as a ``BENCH_*.json`` in the
+repo root (step throughput, serving tokens/s, checkpoint overhead,
+fleet recovery, tier latencies, quantization accuracy, MFU, recorder
+overhead, ...). Individually they are machine-checkable; together they
+are unreadable. This tool flattens the headline numbers of every
+banked file into ONE ``BENCH_TRAJECTORY.md`` table — metric, value,
+and the commit of record (the last commit that touched the file) — so
+the perf trajectory of the whole repo is visible at a glance.
+
+Selection is heuristic by design: leaves whose key names a rate,
+ratio, percentile, percentage or speedup are headline numbers; raw
+configs and counts are not. Per-file rows are capped (shallowest
+paths win) — the full detail stays in the JSON.
+
+Run as the ``report`` CI step (ci/run.sh): NEVER fails — a bench file
+that does not parse is reported as such and skipped. Writes
+``BENCH_TRAJECTORY.md`` next to the bench files and prints the table.
+"""
+
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# headline-metric key filter (matched against the LAST path segment)
+_KEY_RE = re.compile(
+    r"(tokens_per_s|speedup|ratio|_pct$|^pct$|p50|p99|hit_rate|"
+    r"overhead|accept_rate|mfu|match|divergence|recover|restarts|"
+    r"slots_at|retraces)", re.IGNORECASE)
+_SKIP_RE = re.compile(r"(^|\.)(config|args)(\.|$)")
+
+MAX_ROWS_PER_FILE = 10
+
+
+def _flatten(obj, path=""):
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            yield from _flatten(v, f"{path}.{k}" if path else str(k))
+    elif isinstance(obj, (int, float)) and not isinstance(obj, bool):
+        yield path, obj
+
+
+def _fmt(v):
+    import math
+    if not math.isfinite(v):             # json allows NaN/Infinity
+        return str(v)
+    if isinstance(v, int) or v == int(v):
+        return str(int(v))
+    if abs(v) >= 100:
+        return f"{v:.1f}"
+    if abs(v) >= 0.01:
+        return f"{v:.4g}"
+    return f"{v:.3g}"
+
+
+def _commit_of_record(path):
+    """The last commit that touched the banked file — the PR of
+    record for the number. Best-effort: no git, no problem."""
+    try:
+        out = subprocess.run(
+            ["git", "log", "-1", "--format=%h %s", "--", path],
+            cwd=REPO, capture_output=True, text=True, timeout=10)
+        line = out.stdout.strip()
+        if line:
+            return line[:72] + ("…" if len(line) > 72 else "")
+    except Exception:
+        pass
+    return "(uncommitted)"
+
+
+def collect():
+    rows = []
+    for path in sorted(glob.glob(os.path.join(REPO, "BENCH_*.json"))):
+        fname = os.path.basename(path)
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except Exception as e:
+            rows.append((fname, f"(unparseable: {e})", "", ""))
+            continue
+        record = _commit_of_record(fname)
+        picked = []
+        for key, val in _flatten(data):
+            if _SKIP_RE.search(key):
+                continue
+            leaf = key.rsplit(".", 1)[-1]
+            if not _KEY_RE.search(leaf):
+                continue
+            # headline summaries (overheads, speedups, ratios, rates)
+            # outrank raw percentiles when the per-file cap bites
+            summary = 0 if leaf.endswith(("_pct", "speedup", "ratio",
+                                          "rate")) else 1
+            picked.append(((summary, key.count("."), key), key, val))
+        picked.sort(key=lambda t: t[0])
+        dropped = max(0, len(picked) - MAX_ROWS_PER_FILE)
+        for _, key, val in picked[:MAX_ROWS_PER_FILE]:
+            rows.append((fname, key, _fmt(val), record))
+        if dropped:
+            rows.append((fname, f"(+{dropped} more metrics in the "
+                                f"JSON)", "", record))
+    return rows
+
+
+def render(rows):
+    out = ["# Bench trajectory",
+           "",
+           "Headline numbers from every banked `BENCH_*.json`, with "
+           "the commit of record",
+           "(regenerate: `python tools/bench_report.py` — the "
+           "`report` CI step).",
+           "",
+           "| file | metric | value | commit of record |",
+           "|---|---|---|---|"]
+    last = None
+    for fname, metric, value, record in rows:
+        shown = fname if fname != last else ""
+        shown_rec = record if fname != last else ""
+        last = fname
+        out.append(f"| {shown} | {metric} | {value} | {shown_rec} |")
+    return "\n".join(out) + "\n"
+
+
+def main():
+    try:
+        rows = collect()
+        text = render(rows)
+        out_path = os.path.join(REPO, "BENCH_TRAJECTORY.md")
+        with open(out_path, "w") as f:
+            f.write(text)
+        print(text)
+        print(f"wrote {out_path} ({len(rows)} rows)")
+    except Exception as e:                   # the report step never fails
+        print(f"bench_report: skipped ({e})", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

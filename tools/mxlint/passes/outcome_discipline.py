@@ -13,6 +13,13 @@ anything inside the ``StepRecorder`` class, checkpoint/state
 restoration (``load_state_dict``), and counter/None initialization in
 ``__init__`` (construction, not a terminal transition). Everything
 else needs a waiver.
+
+The same discipline covers the flight recorder's event buffers
+(serve/events.py): ``FlightRecorder.emit`` is the ONLY writer of the
+per-component rings — a direct touch of ``_rings`` outside the
+``FlightRecorder`` class bypasses the sequencing, histogram ingestion
+and capacity bounds that make the event stream trustworthy, exactly
+the way a second ``.outcome`` writer breaks exactly-once terminals.
 """
 
 from __future__ import annotations
@@ -29,6 +36,15 @@ _ALLOWED_FUNCS = {"_record_terminal", "load_state_dict", "__init__"}
 _ALLOWED_CLASSES = {"StepRecorder"}
 _OUTCOME_ATTRS = {"outcome", "last_outcome"}
 _HEALTH_ATTRS = {"health", "health_by_tier"}
+# flight-recorder internals (events.py): only FlightRecorder itself
+# may touch the event rings — everything else goes through ``emit()``
+# (even reads have ``events()``/``snapshot`` APIs). Scoped to the
+# WHOLE package, not just serve/+train/: checkpoint/manager.py (and
+# any future emitter) holds a recorder too, and the invariant is the
+# recorder's, not the serving tier's.
+_EVENT_BUFFER_SCOPE = "incubator_mxnet_tpu/"
+_EVENT_BUFFER_ATTRS = {"_rings"}
+_EVENT_BUFFER_CLASSES = {"FlightRecorder"}
 
 
 def _allowed_site(node: ast.AST) -> bool:
@@ -54,9 +70,17 @@ class OutcomeDisciplinePass:
         out: List[Finding] = []
         for unit in project.units:
             if unit.tree is None or \
-                    not unit.path.startswith(_SCOPES):
+                    not unit.path.startswith(_EVENT_BUFFER_SCOPE):
                 continue
+            in_outcome_scope = unit.path.startswith(_SCOPES)
             for node in ast.walk(unit.tree):
+                if isinstance(node, ast.Attribute) and \
+                        node.attr in _EVENT_BUFFER_ATTRS:
+                    f = self._check_event_buffer(node, unit)
+                    if f is not None:
+                        out.append(f)
+                if not in_outcome_scope:
+                    continue
                 targets: List[ast.AST] = []
                 value = None
                 if isinstance(node, ast.Assign):
@@ -72,6 +96,21 @@ class OutcomeDisciplinePass:
                     if f is not None:
                         out.append(f)
         return out
+
+    def _check_event_buffer(self, node, unit):
+        """Any touch of a flight-recorder ring outside FlightRecorder
+        itself — append, clear, subscript, even a read: the recorder
+        API (``emit``/``events``/``snapshot``) is the contract."""
+        for scope in enclosing_scopes(node):
+            if isinstance(scope, ast.ClassDef) and \
+                    scope.name in _EVENT_BUFFER_CLASSES:
+                return None
+        return Finding(
+            RULE, unit.path, node.lineno,
+            f"flight-recorder buffer `.{node.attr}` touched outside "
+            f"the FlightRecorder API — direct event-buffer writes "
+            f"break exactly-once emission (use emit()/events())",
+            symbol=qualname_of(node))
 
     def _check_target(self, target, value, node, unit):
         # <x>.outcome = ... / <x>.last_outcome = ...

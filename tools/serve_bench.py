@@ -141,14 +141,19 @@ def _percentile(xs, q):
 
 
 def _itl_gaps(reqs):
-    """Inter-token latencies from absolute token timestamps: the gap a
-    USER sees between consecutive tokens of one request — including
-    stalls caused by other requests' prefills, which per-decode-step
-    timing cannot see."""
+    """Inter-token latencies from absolute token timestamps
+    (``Request.token_stamps`` — stamped by the engine for EVERY
+    request, bench or not): the gap a USER sees between consecutive
+    tokens of one request, including stalls caused by other requests'
+    prefills, which per-decode-step timing cannot see. The gap math
+    itself is ``serve.events.token_gaps`` — the same implementation
+    the recorder's TPOT histograms ingest, so the bench and /metrics
+    can never disagree (the round-17 dedup: the bench-local gap
+    computation was deleted)."""
+    from incubator_mxnet_tpu.serve.events import token_gaps
     gaps = []
     for r in reqs:
-        st = r.token_stamps
-        gaps.extend(b - a for a, b in zip(st, st[1:]))
+        gaps.extend(token_gaps(r.token_stamps))
     return gaps
 
 
@@ -424,6 +429,57 @@ def bench_long_prompt_mixed(model, *, n_short, short_len, short_new,
     return eng_c, out
 
 
+def _strict_alternation_times(engines, names, make_req, slots,
+                              n_steps):
+    """The round-10 strict-alternation core, shared by every
+    overhead workload (guard, recorder): both persistent engines are
+    warmed to full occupancy, then stepped in strict alternation with
+    the order flipped per iteration; each engine's ``step()`` is timed
+    alone, and steps that ran an admission/prefill (the refill) are
+    excluded — only pure decode steps compare. Returns sorted
+    per-engine step-time lists."""
+    for eng in engines.values():             # compile + reach occupancy
+        for _ in range(slots):
+            eng.submit(make_req())
+        for _ in range(4):
+            eng.step()
+    times = {name: [] for name in engines}
+    contaminated = {name: True for name in engines}  # first step: warm
+    for i in range(n_steps):
+        order = names if i % 2 == 0 else tuple(reversed(names))
+        for name in order:
+            eng = engines[name]
+            t0 = time.perf_counter()
+            eng.step()
+            dt = time.perf_counter() - t0
+            if not contaminated[name]:
+                times[name].append(dt)
+            contaminated[name] = False
+            if eng.active_count < slots:     # refill: next step admits
+                for _ in range(slots - eng.active_count):
+                    eng.submit(make_req())   # and prefills — untimed
+                contaminated[name] = True
+    for name in times:
+        times[name].sort()
+    return times
+
+
+def _overhead_quantiles(times, test_name, base_name):
+    """Quantile-ratio table for a strict-alternation run: p50 is the
+    primary banked number, min/p10/p25 corroborate (load spikes only
+    ever ADD time, so low quantiles are the least contaminated)."""
+    def _q(xs, q):
+        return xs[min(len(xs) - 1, int(q / 100.0 * len(xs)))]
+
+    quantiles = {}
+    for q in (0, 10, 25, 50):
+        t, b = _q(times[test_name], q), _q(times[base_name], q)
+        quantiles[f"p{q}"] = {f"{test_name}_ms": t * 1e3,
+                              f"{base_name}_ms": b * 1e3,
+                              "overhead_pct": (t / b - 1.0) * 100.0}
+    return quantiles
+
+
 def bench_guard_overhead(model, *, prompt_len, max_new, slots,
                          page_size, n_steps=600):
     """Round-10: what the per-slot non-finite guard COSTS on the steady
@@ -466,40 +522,10 @@ def bench_guard_overhead(model, *, prompt_len, max_new, slots,
                                      prefix_cache=False,
                                      guard_nonfinite=False),
     }
-    for eng in engines.values():             # compile + reach occupancy
-        for _ in range(slots):
-            eng.submit(_req())
-        for _ in range(4):
-            eng.step()
-    times = {name: [] for name in engines}
-    contaminated = {name: True for name in engines}  # first step: warm
-    for i in range(n_steps):
-        names = ("guarded", "unguarded") if i % 2 == 0 else \
-            ("unguarded", "guarded")
-        for name in names:
-            eng = engines[name]
-            t0 = time.perf_counter()
-            eng.step()
-            dt = time.perf_counter() - t0
-            if not contaminated[name]:
-                times[name].append(dt)
-            contaminated[name] = False
-            if eng.active_count < slots:     # refill: next step admits
-                for _ in range(slots - eng.active_count):
-                    eng.submit(_req())       # and prefills — untimed
-                contaminated[name] = True
-    for name in times:
-        times[name].sort()
-
-    def _q(xs, q):
-        return xs[min(len(xs) - 1, int(q / 100.0 * len(xs)))]
-
-    quantiles = {}
-    for q in (0, 10, 25, 50):
-        g, u = _q(times["guarded"], q), _q(times["unguarded"], q)
-        quantiles[f"p{q}"] = {"guarded_ms": g * 1e3,
-                              "unguarded_ms": u * 1e3,
-                              "overhead_pct": (g / u - 1.0) * 100.0}
+    times = _strict_alternation_times(engines, ("guarded",
+                                                "unguarded"),
+                                      _req, slots, n_steps)
+    quantiles = _overhead_quantiles(times, "guarded", "unguarded")
     out = {
         "config": {"prompt_len": prompt_len, "max_new": max_new,
                    "slots": slots, "page_size": page_size,
@@ -515,6 +541,56 @@ def bench_guard_overhead(model, *, prompt_len, max_new, slots,
         "guard_overhead_pct": quantiles["p50"]["overhead_pct"],
     }
     return engines["guarded"], out
+
+
+def bench_recorder_overhead(model, *, prompt_len, max_new, slots,
+                            page_size, n_steps=600):
+    """Round-17: what the flight recorder COSTS on the steady serving
+    path (serve/events.py, docs/OBSERVABILITY.md). The recorder ships
+    ON by default — one DECODE_STEP event per step plus lifecycle
+    events at admission/terminal boundaries, all host-side deque
+    appends — and this measures that the residual host work is under
+    the <=2% tokens/s leave-on bar, the same bar (and the same
+    strict-alternation methodology, PERF_NOTES round 10) as the
+    non-finite guard: two persistent engines (recorder on / off) at
+    full occupancy, stepped in strict alternation with the order
+    flipped per iteration, pure decode steps timed, overhead = the
+    ratio of per-step-time quantiles (p50 banked)."""
+    from incubator_mxnet_tpu.serve import InferenceEngine, Request
+    import numpy as np
+    vocab = model.vocab_size
+    rng = np.random.RandomState(23)
+
+    def _req():
+        return Request(rng.randint(0, vocab, size=(prompt_len,))
+                       .astype(np.int32), max_new_tokens=max_new)
+
+    engines = {
+        "recorded": InferenceEngine(model, num_slots=slots,
+                                    page_size=page_size,
+                                    prefix_cache=False),
+        "unrecorded": InferenceEngine(model, num_slots=slots,
+                                      page_size=page_size,
+                                      prefix_cache=False,
+                                      recorder=False),
+    }
+    times = _strict_alternation_times(engines, ("recorded",
+                                                "unrecorded"),
+                                      _req, slots, n_steps)
+    quantiles = _overhead_quantiles(times, "recorded", "unrecorded")
+    rec = engines["recorded"].flight
+    out = {
+        "config": {"prompt_len": prompt_len, "max_new": max_new,
+                   "slots": slots, "page_size": page_size,
+                   "n_steps": n_steps},
+        "pure_decode_steps_timed": {n: len(t) for n, t in times.items()},
+        "step_time_quantiles": quantiles,
+        "events_emitted": rec.emitted,
+        "decode_trace_counts": {n: e.decode_trace_count
+                                for n, e in engines.items()},
+        "recorder_overhead_pct": quantiles["p50"]["overhead_pct"],
+    }
+    return engines["recorded"], out
 
 
 # --------------------------------------------------------------------- #
@@ -1778,6 +1854,33 @@ def main():
                           f"retraced: {bad}")
     result["guard_overhead"] = guard
 
+    # ---- round-17: flight-recorder overhead ------------------------ #
+    # (docs/OBSERVABILITY.md) the recorder ships ON by default — this
+    # banks what the always-on event stream costs, and the smoke run
+    # gates catastrophic regressions (the honest <=2% number needs the
+    # full 600-step run; the 60-step smoke is noise-bounded at 15%)
+    if args.smoke:
+        ro_cfg = dict(prompt_len=args.prompt_len, max_new=10, slots=4,
+                      page_size=args.page_size, n_steps=60)
+    else:
+        ro_cfg = dict(prompt_len=args.prompt_len, max_new=args.max_new,
+                      slots=args.slots, page_size=args.page_size,
+                      n_steps=600)
+    eng_r, rec_over = bench_recorder_overhead(model, **ro_cfg)
+    for name, n in rec_over["decode_trace_counts"].items():
+        if n != 1:
+            errors.append(f"recorder_overhead.{name}: decode step "
+                          f"compiled {n} times (must be 1)")
+    if rec_over["events_emitted"] == 0:
+        errors.append("recorder_overhead: the recorded engine emitted "
+                      "no events — the recorder is not actually on")
+    if args.smoke and rec_over["recorder_overhead_pct"] >= 15.0:
+        errors.append(f"recorder_overhead: "
+                      f"{rec_over['recorder_overhead_pct']:.2f}% p50 "
+                      f"step-time overhead in smoke — far over the 2% "
+                      f"leave-on bar even allowing smoke noise")
+    result["recorder_overhead"] = rec_over
+
     # ---- round-11: speculative decoding ---------------------------- #
     model_s = _build(max_length=512)
     result["spec_decoding"] = bench_spec_decoding(
@@ -1817,6 +1920,10 @@ def main():
             print(f"WARN: non-finite guard costs "
                   f"{guard['guard_overhead_pct']:.2f}% tokens/s — over "
                   f"the 2% leave-it-on bar", file=sys.stderr)
+        if rec_over["recorder_overhead_pct"] >= 2.0:
+            print(f"WARN: flight recorder costs "
+                  f"{rec_over['recorder_overhead_pct']:.2f}% tokens/s "
+                  f"— over the 2% leave-it-on bar", file=sys.stderr)
         spec = result["spec_decoding"]
         half = f"slots_{max(args.slots // 2, 1)}"
         hi = spec["high_agreement"][half]["tokens_per_s_ratio"]
