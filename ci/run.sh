@@ -126,6 +126,27 @@ stage_tiersmoke() {
   JAX_PLATFORMS=cpu python tools/chaos_bench.py --tiers --smoke
 }
 
+stage_frontsmoke() {
+  echo "== frontsmoke: client-protocol guard (HTTP/SSE front end over"
+  echo "               localhost — an end-to-end SSE stream must deliver"
+  echo "               tokens incrementally, a mid-stream disconnect must"
+  echo "               land as exactly-one CANCELLED terminal with pages"
+  echo "               reclaimed, stop-sequence truncation must be correct"
+  echo "               over the wire, decode must compile exactly once"
+  echo "               through the HTTP path, and the constrained"
+  echo "               tool-call arm must stay 100% in-language with the"
+  echo "               decode family untraced by grammar masks)"
+  JAX_PLATFORMS=cpu python tools/serve_bench.py --frontend --smoke
+}
+
+stage_frontchaos() {
+  echo "== frontchaos: client-edge resilience guard (real-socket chaos —"
+  echo "               disconnect storms and slow-reader backpressure must"
+  echo "               each end in exactly one terminal per request with"
+  echo "               clean page audits, survivor parity, and no retrace)"
+  JAX_PLATFORMS=cpu python tools/chaos_bench.py --frontend --smoke
+}
+
 stage_obssmoke() {
   echo "== obssmoke: observability guard (flight recorder + tracing —"
   echo "             a seeded replica kill with the recorder on must dump"
@@ -176,7 +197,7 @@ ge.dryrun_multichip(8)"
 }
 
 stages=("$@")
-[ ${#stages[@]} -eq 0 ] && stages=(sanity lintcore native unit stepbench mfubench servebench quantbench chaossmoke fleetsmoke tiersmoke obssmoke trainchaos ckptbench entry report)
+[ ${#stages[@]} -eq 0 ] && stages=(sanity lintcore native unit stepbench mfubench servebench quantbench chaossmoke fleetsmoke tiersmoke frontsmoke frontchaos obssmoke trainchaos ckptbench entry report)
 for s in "${stages[@]}"; do
   "stage_$s"
 done

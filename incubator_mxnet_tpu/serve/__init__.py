@@ -38,10 +38,14 @@ from .outcomes import Outcome
 from .slo import (BrownoutController, Tier, TierPolicy,
                   default_tier_policies)
 from .draft import make_ngram_drafter, ngram_propose
+from .sampling import (SamplingParams, TokenFsm, TokenGrammar,
+                       choice_grammar)
 from .engine import InferenceEngine, Request
 from .router import (Replica, ReplicaKilled, ReplicaState, Router,
                      build_fleet)
 from .metrics import render_metrics
+from .frontend import (OUTCOME_HTTP_STATUS, ServeFrontend,
+                       stream_completion)
 
 __all__ = ["InferenceEngine", "Request", "Outcome", "PageAllocator",
            "PrefixIndex", "NULL_PAGE", "init_kv_pools", "write_token_kv",
@@ -49,4 +53,7 @@ __all__ = ["InferenceEngine", "Request", "Outcome", "PageAllocator",
            "make_ngram_drafter", "Router", "Replica", "ReplicaState",
            "ReplicaKilled", "build_fleet", "Tier", "TierPolicy",
            "default_tier_policies", "BrownoutController",
-           "render_metrics", "Event", "EventType", "FlightRecorder"]
+           "render_metrics", "Event", "EventType", "FlightRecorder",
+           "SamplingParams", "TokenGrammar", "TokenFsm",
+           "choice_grammar", "ServeFrontend", "OUTCOME_HTTP_STATUS",
+           "stream_completion"]

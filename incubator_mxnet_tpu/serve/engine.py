@@ -118,11 +118,13 @@ from .paged_kv import (NULL_PAGE, PageAllocator, PrefixIndex,
                        write_block_kv, write_block_kv_q,
                        write_prompt_kv, write_prompt_kv_q,
                        write_token_kv, write_token_kv_q)
+from .sampling import (SamplingParams, constrain_logits, grammar_mask,
+                       match_stop)
 from .slo import (BrownoutController, Tier, TierPolicy,
                   resolve_tier_policies)
 
 __all__ = ["Request", "InferenceEngine", "Outcome", "Tier",
-           "TierPolicy"]
+           "TierPolicy", "SamplingParams"]
 
 _NEG_BIG = -1e30
 
@@ -167,8 +169,25 @@ class Request:
     seed: Optional[int] = None
     tier: Tier = Tier.STANDARD
     request_id: Optional[int] = None
+    # the full sampling menu (serve/sampling.py): top-k/top-p,
+    # repetition/presence penalties, logit bias, stop sequences,
+    # grammar-constrained decoding — all pure per-slot data through
+    # the same compiled programs temperature rides today (None = the
+    # plain greedy/temperature path, bit-identical to pre-round-18)
+    sampling: Optional[SamplingParams] = None
+    # resume split hint: the first ``prompt_len`` prompt ids are the
+    # TRUE prompt, the rest previously-emitted tokens folded back in
+    # by a failover/preemption replay (serve/router.py). The grammar
+    # state and stop-sequence window are derived from the generated
+    # part only, so a resumed request samples exactly as the unbroken
+    # run would. None = the whole prompt is prompt.
+    prompt_len: Optional[int] = None
 
     # filled in by the engine
+    _stop_trim: int = 0          # stop-seq tokens the recording attempt
+                                 # could not truncate locally (they were
+                                 # emitted by an EARLIER attempt) — the
+                                 # router trims them from the client
     preemptions: int = 0
     drafted_tokens: int = 0
     accepted_tokens: int = 0
@@ -201,6 +220,20 @@ class Request:
         if not isinstance(self.tier, Tier):
             raise MXNetError(f"tier must be a serve.Tier, got "
                              f"{self.tier!r}")
+        if self.sampling is not None:
+            if not isinstance(self.sampling, SamplingParams):
+                raise MXNetError(f"sampling must be a SamplingParams, "
+                                 f"got {type(self.sampling).__name__}")
+            if self.sampling.grammar is not None and self.eos_id < 0:
+                raise MXNetError(
+                    "grammar-constrained decoding requires eos_id >= 0 "
+                    "(grammar completion is expressed through EOS)")
+        if self.prompt_len is not None:
+            self.prompt_len = int(self.prompt_len)
+            if not (0 < self.prompt_len <= self.prompt_ids.size):
+                raise MXNetError(
+                    f"prompt_len {self.prompt_len} outside "
+                    f"(0, {self.prompt_ids.size}]")
         if self.request_id is None:
             self.request_id = next(_REQUEST_IDS)
 
@@ -226,6 +259,20 @@ class _Slot:
     spec_streak: int = 0         # consecutive FULLY-REJECTED draft
                                  # windows (adaptive gating's evidence;
                                  # reset on any acceptance)
+    grammar_state: object = None  # current DFA state (host data; None
+                                  # when the request has no grammar)
+    menu_active: bool = False    # request carries LOGIT-touching
+                                 # sampling params (stop-only requests
+                                 # stay False: stops are host-side) —
+                                 # steps serving only neutral slots
+                                 # ship the cached device-resident
+                                 # neutral operands instead of copying
+                                 # the (S, V) tables every step
+    stop_tail: list = dataclasses.field(default_factory=list)
+                                 # trailing window of the GENERATED
+                                 # stream (max_stop_len tokens) — the
+                                 # stop-sequence matcher's evidence,
+                                 # seeded across resume boundaries
 
     @property
     def prefilling(self) -> bool:
@@ -472,6 +519,21 @@ class InferenceEngine:
         self._lengths = np.zeros((S,), np.int32)
         self._temps = np.zeros((S,), np.float32)
         self._slot_keys = np.zeros((S, 2), np.uint32)
+        # the sampling menu's per-slot state (serve/sampling.py): knob
+        # vectors, the logit-bias table, and the token-count table the
+        # penalties read — all pure data into the SAME programs
+        # temperature rides, reset to exact-identity neutrals on slot
+        # free so an unconfigured request costs one where-select
+        V = model.vocab_size
+        self._vocab = V
+        self._top_k = np.zeros((S,), np.int32)
+        self._top_p = np.ones((S,), np.float32)
+        self._rep_pen = np.ones((S,), np.float32)
+        self._pres_pen = np.zeros((S,), np.float32)
+        self._logit_bias = np.zeros((S, V), np.float32)
+        self._tok_counts = np.zeros((S, V), np.int32)
+        self._mask_true: dict = {}   # W -> cached all-True (S, W, V)
+        self._neutral_ops: dict = {}  # W -> committed neutral operands
         self._alloc = PageAllocator(self.num_pages)
         self._prefix = PrefixIndex(self.page_size) if prefix_cache \
             else None
@@ -525,6 +587,8 @@ class InferenceEngine:
         self.spec_gated_steps = 0            # steps adaptive gating
                                              # suppressed all drafting
 
+        self.stop_hits = 0                   # stop-sequence terminals
+        self.constrained_requests = 0        # admissions with a grammar
         self.decode_trace_count = 0          # W=1 decode program traces
         self.verify_trace_count = 0          # K+1-wide verify traces
         self.prefill_trace_count = 0         # dense + chunk, total
@@ -548,13 +612,23 @@ class InferenceEngine:
     # traced programs
     # ------------------------------------------------------------- #
 
-    def _sample_one(self, logits, temp, pos_key):
+    def _sample_one(self, logits, temp, pos_key, top_k=None, top_p=None,
+                    rep_pen=None, pres_pen=None, counts=None, bias=None,
+                    mask=None):
         """Greedy/temperature sample of ONE token from (V,) logits.
         ``pos_key`` is the request's RNG key folded with the sampled
         token's SEQUENCE POSITION (the engine-wide convention: the draw
         for position p uses ``fold_in(fold_in(request_key, p), 0)``),
         so whichever program computes it — dense prefill, chunk tail,
-        or a verify emission point — produces the identical draw."""
+        or a verify emission point — produces the identical draw.
+
+        The sampling-menu knobs (serve/sampling.py) are traced scalars
+        / (V,) rows; None (a trace-time constant) means the caller has
+        no menu state, which compiles the plain path — the prefill
+        programs always pass real values."""
+        if top_k is not None:
+            logits = constrain_logits(logits, temp, counts, bias, mask,
+                                      top_k, top_p, rep_pen, pres_pen)
         cat_key = jax.random.fold_in(pos_key, 0)
         greedy = jnp.argmax(logits, axis=-1)
         samp = jax.random.categorical(
@@ -630,7 +704,8 @@ class InferenceEngine:
                                         k_scale=ks, v_scale=vs)
 
     def _accept_emit(self, logits, tokens, draft_len, temps, slot_keys,
-                     pos, act):
+                     pos, act, top_k=None, top_p=None, rep_pen=None,
+                     pres_pen=None, counts=None, bias=None, mask=None):
         """On-device draft acceptance — the speculative-decoding core.
 
         ``logits`` (S, W, V) scores token positions ``pos + 1``;
@@ -651,7 +726,20 @@ class InferenceEngine:
 
         Returns ``(emitted (S, W) int32, n_emit (S,) int32)``: columns
         ``[0, n_emit)`` of ``emitted`` are real tokens (accepted drafts
-        then the correction/bonus sample), later columns are dead."""
+        then the correction/bonus sample), later columns are dead.
+
+        Round 18: the acceptance tests and the residual both run over
+        the CONSTRAINED target distribution (serve/sampling.py — bias,
+        penalties with in-window count updates, top-k/top-p
+        truncation, grammar mask), so speculation stays
+        distribution-correct under truncated/masked proposals: a
+        drafted token the constraint forbids has p̃(d) = 0 and is
+        rejected; the correction resamples from the masked residual.
+        Column j's penalty counts include the drafts at columns <= j —
+        exactly the history a sequential decode would have seen —
+        computed in-program from the (known) draft block. The
+        degenerate single-allowed-token case (empty residual) force-
+        accepts: p̃ is that point mass."""
         S, W = tokens.shape
         V = logits.shape[-1]
         jj = lax.broadcasted_iota(jnp.int32, (S, W), 1)
@@ -666,6 +754,18 @@ class InferenceEngine:
             lambda k: jax.random.fold_in(k, 1)))(pos_keys)
         u = jax.vmap(jax.vmap(jax.random.uniform))(acc_keys)   # (S, W)
 
+        if top_k is not None:
+            # in-window history: column j scores the token AFTER
+            # tokens[:, 0..j], so its penalty counts are the base
+            # (prompt + emitted, incl. tokens[:, 0]) plus the one-hot
+            # sum of draft columns 1..j
+            oh = jax.nn.one_hot(tokens, V, dtype=jnp.int32)
+            win_counts = counts[:, None, :] + \
+                jnp.cumsum(oh, axis=1) - oh[:, :1]
+            logits = constrain_logits(
+                logits, temps[:, None], win_counts, bias[:, None, :],
+                mask, top_k[:, None], top_p[:, None],
+                rep_pen[:, None], pres_pen[:, None])
         greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         scaled = logits.astype(jnp.float32) / \
             jnp.maximum(temps, 1e-6)[:, None, None]
@@ -676,20 +776,28 @@ class InferenceEngine:
         d_next = jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)
         p_next = jnp.take_along_axis(logp, d_next[..., None],
                                      axis=-1)[..., 0]    # log p_j(d)
-        accept = jnp.where((temps > 0)[:, None],
-                           jnp.log(u) < p_next,          # P[accept]=p(d)
-                           d_next == greedy_tok)
-        valid = jj < draft_len[:, None]
-        chain = jnp.cumprod((accept & valid).astype(jnp.int32), axis=1)
-        n_acc = jnp.sum(chain, axis=1).astype(jnp.int32)
         # residual for a REJECTED draft at column j: q was a point mass
         # at d, so max(p - q, 0) is p with d's mass removed — mask d's
         # logit out and renormalize via the categorical itself. Columns
         # with no draft (j >= draft_len) sample plain p — the bonus
         # token when every draft was accepted.
+        valid = jj < draft_len[:, None]
         res_logits = scaled + jax.nn.one_hot(
             d_next, V, dtype=jnp.float32) * \
             jnp.where(valid, _NEG_BIG, 0.0)[..., None]
+        # an empty residual (every unit of mass sits on the draft —
+        # e.g. a grammar state with ONE legal token) means p̃(d) = 1:
+        # force acceptance instead of resampling from nothing. Tested
+        # on the UNSCALED constrained logits: a temperature divide
+        # could float a masked -1e30 back over the threshold
+        res_empty = ~jnp.any(
+            (logits + jax.nn.one_hot(d_next, V, dtype=jnp.float32) *
+             _NEG_BIG) > _NEG_BIG / 2, axis=-1)
+        accept = jnp.where((temps > 0)[:, None],
+                           (jnp.log(u) < p_next) | res_empty,
+                           d_next == greedy_tok)
+        chain = jnp.cumprod((accept & valid).astype(jnp.int32), axis=1)
+        n_acc = jnp.sum(chain, axis=1).astype(jnp.int32)
         samp = jax.vmap(jax.vmap(jax.random.categorical))(
             cat_keys, res_logits).astype(jnp.int32)
         final = jnp.where((temps > 0)[:, None], samp, greedy_tok)
@@ -699,7 +807,8 @@ class InferenceEngine:
 
     def _decode_step_fn(self, param_vals, kpools, vpools, kamax, vamax,
                         tokens, draft_len, page_table, lengths, temps,
-                        slot_keys):
+                        slot_keys, top_k, top_p, rep_pen, pres_pen,
+                        counts, bias, mask):
         """ONE decode/verify step for every slot: W token positions per
         slot — the last accepted token plus up to W - 1 draft
         candidates — embedded, written into the tail pages, and scored
@@ -790,8 +899,10 @@ class InferenceEngine:
             # (models/gpt.py::_lm_head — token parity with
             # decode_forward / the training path)
             logits = _lm_head(model, x)._data        # (S, W, V)
-        emitted, n_emit = self._accept_emit(logits, tokens, draft_len,
-                                            temps, slot_keys, pos, act)
+        emitted, n_emit = self._accept_emit(
+            logits, tokens, draft_len, temps, slot_keys, pos, act,
+            top_k=top_k, top_p=top_p, rep_pen=rep_pen,
+            pres_pen=pres_pen, counts=counts, bias=bias, mask=mask)
         new_lengths = jnp.where(act, lengths + n_emit, 0)
         # per-slot non-finite guard: one logits reduction over the USED
         # verify columns (later columns may legitimately read stale
@@ -810,7 +921,8 @@ class InferenceEngine:
                 tuple(new_va), emitted, n_emit, new_lengths)
 
     def _prefill_fn(self, param_vals, kpools, vpools, kamax, vamax,
-                    ids, t0, pages, temp, key):
+                    ids, t0, pages, temp, key, top_k, top_p, rep_pen,
+                    pres_pen, counts, bias, vocab_mask):
         """Prompt forward for ONE request (ids (1, Tpad) padded): dense
         causal attention inside the prompt (the prompt attends only
         itself), K/V scattered into the slot's pages, and the FIRST
@@ -866,7 +978,9 @@ class InferenceEngine:
         # the first generated token occupies position t0: its draw is
         # keyed by fold_in(request_key, t0), the engine-wide convention
         tok = self._sample_one(logits[0], temp,
-                               jax.random.fold_in(key, t0))
+                               jax.random.fold_in(key, t0),
+                               top_k, top_p, rep_pen, pres_pen,
+                               counts, bias, vocab_mask)
         if self.guard_nonfinite:             # sign-encoded, see decode
             tok = jnp.where(jnp.any(~jnp.isfinite(logits)),
                             -tok - 1, tok)
@@ -875,7 +989,8 @@ class InferenceEngine:
 
     def _chunk_prefill_fn(self, param_vals, kpools, vpools, kamax,
                           vamax, ids, start, n_real, page_row, temp,
-                          key):
+                          key, top_k, top_p, rep_pen, pres_pen, counts,
+                          bias, vocab_mask):
         """ONE prefill chunk of ONE slot's prompt: ids (1, Cpad) holds
         ``n_real`` prompt tokens at absolute positions ``start + i``.
         Their K/V is scattered into the slot's pages (padded tokens land
@@ -946,7 +1061,9 @@ class InferenceEngine:
         # matches the dense prefill's exactly — chunked vs monolithic
         # prefill emit the identical first token even at temperature
         tok = self._sample_one(logits[0], temp,
-                               jax.random.fold_in(key, start + n_real))
+                               jax.random.fold_in(key, start + n_real),
+                               top_k, top_p, rep_pen, pres_pen,
+                               counts, bias, vocab_mask)
         if self.guard_nonfinite:             # sign-encoded, see decode
             tok = jnp.where(jnp.any(~jnp.isfinite(logits)),
                             -tok - 1, tok)
@@ -1021,7 +1138,8 @@ class InferenceEngine:
     @property
     def completed(self) -> int:
         return self.health[Outcome.EOS.value] + \
-            self.health[Outcome.MAX_TOKENS.value]
+            self.health[Outcome.MAX_TOKENS.value] + \
+            self.health[Outcome.STOP.value]
 
     @property
     def shed(self) -> int:
@@ -1180,6 +1298,8 @@ class InferenceEngine:
             "prefix_hits": self.prefix_hits,
             "prefix_lookups": self.prefix_lookups,
             "prefix_hit_tokens": self.prefix_hit_tokens,
+            "stop_hits": self.stop_hits,
+            "constrained_requests": self.constrained_requests,
             "preemptions": self.preemptions,
             "brownout_level": self.brownout_level,
             "brownout_escalations": bo.escalations if bo else 0,
@@ -1311,6 +1431,16 @@ class InferenceEngine:
                 f"engine caps at max_len {self.max_len} / "
                 f"{self.num_pages - 1} usable pages")
             return False
+        if request.sampling is not None:
+            # fail-fast like the size bound: a grammar over the wrong
+            # vocab (or a bias on a token the model has no logit for)
+            # could NEVER be served — it must not wedge the queue head
+            err = request.sampling.validate_for(self.model.vocab_size,
+                                                request.eos_id)
+            if err is not None:
+                self._record_terminal(request,
+                                      Outcome.FAILED_UNSERVABLE, err)
+                return False
         est = self._estimated_queue_delay(request.tier)
         # the newcomer's OWN refusals come first: a request its tier
         # bound or delay limit is about to refuse anyway must not
@@ -1363,11 +1493,38 @@ class InferenceEngine:
         else None."""
         slot = self._slots[slot_idx]
         req = slot.request
-        req.token_ids.append(int(token))
+        tok = int(token)
+        req.token_ids.append(tok)
         req.token_times.append(dt)
         req.token_stamps.append(time.perf_counter())
-        if req.eos_id >= 0 and int(token) == req.eos_id:
+        self._tok_counts[slot_idx, tok] += 1     # penalty history
+        if req.eos_id >= 0 and tok == req.eos_id:
             return Outcome.EOS
+        sp = req.sampling
+        if sp is not None:
+            if sp.grammar is not None:
+                nxt = sp.grammar.advance(slot.grammar_state, tok)
+                if nxt is not None:
+                    slot.grammar_state = nxt
+            if sp.stop_sequences:
+                slot.stop_tail.append(tok)
+                if len(slot.stop_tail) > sp.max_stop_len:
+                    del slot.stop_tail[:-sp.max_stop_len]
+                hit = match_stop(slot.stop_tail, sp.stop_sequences)
+                if hit:
+                    # the matched sequence is NOT part of the output:
+                    # truncate what this attempt recorded; anything the
+                    # match reaches back into an EARLIER attempt's
+                    # stream is reported via _stop_trim for the router
+                    # to trim off the client (docs/SERVING.md)
+                    trim = min(hit, len(req.token_ids))
+                    if trim:
+                        del req.token_ids[-trim:]
+                        del req.token_times[-trim:]
+                        del req.token_stamps[-trim:]
+                    req._stop_trim = hit - trim
+                    self.stop_hits += 1
+                    return Outcome.STOP
         if len(req.token_ids) >= req.max_new_tokens:
             return Outcome.MAX_TOKENS
         return None
@@ -1487,6 +1644,13 @@ class InferenceEngine:
         self._lengths[slot_idx] = 0
         self._temps[slot_idx] = 0.0
         self._slot_keys[slot_idx] = 0
+        # sampling-menu state back to exact-identity neutrals
+        self._top_k[slot_idx] = 0
+        self._top_p[slot_idx] = 1.0
+        self._rep_pen[slot_idx] = 1.0
+        self._pres_pen[slot_idx] = 0.0
+        self._logit_bias[slot_idx, :] = 0.0
+        self._tok_counts[slot_idx, :] = 0
         self._slots[slot_idx] = None
 
     def _preempt(self, slot_idx: int, detail: str = ""):
@@ -1657,6 +1821,40 @@ class InferenceEngine:
         self._page_table[slot_idx, :] = NULL_PAGE
         self._lengths[slot_idx] = 0
         self._temps[slot_idx] = 0.0
+        # sampling-menu slot state (serve/sampling.py): knob vectors,
+        # bias row, and the token-count table (full attempt history —
+        # prompt + carried tokens) the penalties read. Grammar state
+        # and the stop-sequence window are re-derived from the
+        # GENERATED part only (``prompt_len`` marks the resume split),
+        # so a preemption/failover resume samples exactly as the
+        # unbroken run would — bit-identical continuations under every
+        # knob (tests/test_sampling.py)
+        self._tok_counts[slot_idx] = np.bincount(
+            ids, minlength=self._vocab)[:self._vocab]
+        sp = req.sampling
+        slot.menu_active = sp is not None and not sp.logits_neutral
+        if sp is not None:
+            self._top_k[slot_idx] = sp.top_k
+            self._top_p[slot_idx] = sp.top_p
+            self._rep_pen[slot_idx] = sp.repetition_penalty
+            self._pres_pen[slot_idx] = sp.presence_penalty
+            if sp.logit_bias:
+                for t, b in sp.logit_bias.items():
+                    self._logit_bias[slot_idx, t] = b
+            base = req.prompt_len if req.prompt_len is not None \
+                else int(req.prompt_ids.size)
+            gen = [int(t) for t in ids[base:]]
+            if sp.grammar is not None:
+                self.constrained_requests += 1
+                st = sp.grammar.start()
+                for t in gen:
+                    nxt = sp.grammar.advance(st, t)
+                    if nxt is None:
+                        break        # off-grammar history: hold state
+                    st = nxt
+                slot.grammar_state = st
+            if sp.stop_sequences and sp.max_stop_len > 1:
+                slot.stop_tail = gen[-(sp.max_stop_len - 1):]
         if partial is not None:
             # COW: the boundary page becomes a private copy; drop
             # the temporary pin on the cached source
@@ -1683,6 +1881,40 @@ class InferenceEngine:
         # calls under the token budget
         return True
 
+    def _slot_sampling_args(self, slot_idx: int) -> tuple:
+        """The per-request sampling-row operands a prefill/chunk
+        program takes: knob scalars, the count/bias rows, and the
+        grammar mask for the FIRST generated token — all traced data
+        (same bucket, same compile; trace counts asserted). A slot
+        with neutral (or no) params reuses one cached device-resident
+        row set — a long chunked prompt re-ships zero sampling bytes
+        per chunk."""
+        slot = self._slots[slot_idx]
+        req = slot.request
+        sp = req.sampling
+        if not slot.menu_active:
+            ops = self._neutral_ops.get("row")
+            if ops is None:
+                V = self._vocab
+                ops = (jnp.int32(0), jnp.float32(1.0),
+                       jnp.float32(1.0), jnp.float32(0.0),
+                       jnp.zeros((V,), jnp.int32),
+                       jnp.zeros((V,), jnp.float32),
+                       jnp.ones((V,), bool))
+                self._neutral_ops["row"] = ops
+            return ops
+        if sp is not None and sp.grammar is not None:
+            mask = grammar_mask(sp.grammar, slot.grammar_state,
+                                req.eos_id)
+        else:
+            mask = np.ones((self._vocab,), bool)
+        return (np.int32(self._top_k[slot_idx]),
+                np.float32(self._top_p[slot_idx]),
+                np.float32(self._rep_pen[slot_idx]),
+                np.float32(self._pres_pen[slot_idx]),
+                self._tok_counts[slot_idx].copy(),
+                self._logit_bias[slot_idx].copy(), mask)
+
     def _dense_prefill(self, slot_idx: int):
         """The PR 2 monolithic prompt program (one pow2-page bucket)."""
         slot = self._slots[slot_idx]
@@ -1703,7 +1935,8 @@ class InferenceEngine:
         self._kpools, self._vpools, ka, va, tok = fn(
             self._param_vals, self._kpools, self._vpools, self._kamax,
             self._vamax, ids, np.int32(t0), pages_arr,
-            np.float32(req.temperature), slot.key)
+            np.float32(req.temperature), slot.key,
+            *self._slot_sampling_args(slot_idx))
         self._pull_amax(ka, va)
         slot.prefill_pos = t0
         # mxlint: allow-host-sync(prefill-boundary readback, once per prompt: the sampled first token must reach token_ids)
@@ -1742,7 +1975,8 @@ class InferenceEngine:
         self._kpools, self._vpools, ka, va, tok = fn(
             self._param_vals, self._kpools, self._vpools, self._kamax,
             self._vamax, ids, np.int32(start), np.int32(n),
-            slot.row.copy(), np.float32(req.temperature), slot.key)
+            slot.row.copy(), np.float32(req.temperature), slot.key,
+            *self._slot_sampling_args(slot_idx))
         self._pull_amax(ka, va)
         slot.prefill_pos = start + n
         # mxlint: allow-host-sync(chunk-boundary readback, once per chunk: the guard flag and tail token gate the next chunk)
@@ -1862,6 +2096,26 @@ class InferenceEngine:
             oob = np.nonzero((d < 0) | (d >= vocab))[0]
             if oob.size:
                 d = d[:oob[0]]
+            sp = req.sampling
+            if d.size and sp is not None and sp.grammar is not None:
+                # truncate at the first grammar-forbidden draft: a
+                # masked token has probability 0 under the constrained
+                # target, so verifying it (and everything after it)
+                # would be a guaranteed rejection — pure waste
+                st = slot.grammar_state
+                keep = 0
+                for t in d:
+                    t = int(t)
+                    if not grammar_mask(sp.grammar, st, req.eos_id)[t]:
+                        break
+                    keep += 1
+                    if t == req.eos_id:
+                        break            # drafting past EOS is waste
+                    nxt = sp.grammar.advance(st, t)
+                    if nxt is None:
+                        break
+                    st = nxt
+                d = d[:keep]
             if d.size:
                 drafts[s] = d
         return drafts, gated
@@ -1939,6 +2193,70 @@ class InferenceEngine:
                         drafts[s] = d[:cap]
         return stalled
 
+    def _mask_block(self, drafts: dict, W: int, live) -> np.ndarray:
+        """The (S, W, V) vocabulary-mask block this step's decode
+        program takes: column j of a grammar-constrained slot is
+        masked at the grammar state AFTER consuming its drafts at
+        columns <= j (the host walks the known draft chain), so every
+        verify column is constrained exactly as the sequential decode
+        at that position would be. Grammar-free steps reuse one cached
+        all-True block per width — no per-step allocation on the
+        unconstrained hot path."""
+        gslots = [s for s in live
+                  if self._slots[s].request.sampling is not None and
+                  self._slots[s].request.sampling.grammar is not None]
+        if not gslots:
+            # cached all-True block per width (host np: this branch
+            # only runs on the menu-ACTIVE path — fully-neutral steps
+            # take _neutral_step_ops' device-resident operands and
+            # never reach here)
+            m = self._mask_true.get(W)
+            if m is None:
+                m = self._mask_true[W] = np.ones(
+                    (self.num_slots, W, self._vocab), bool)
+            return m
+        m = np.ones((self.num_slots, W, self._vocab), bool)
+        for s in gslots:
+            slot = self._slots[s]
+            sp = slot.request.sampling
+            eos = slot.request.eos_id
+            st = slot.grammar_state
+            m[s, 0] = grammar_mask(sp.grammar, st, eos)
+            d = drafts.get(s)
+            if d is None:
+                continue
+            for j, t in enumerate(d):
+                t = int(t)
+                if t == eos:
+                    break                # later columns are dead
+                nxt = sp.grammar.advance(st, t)
+                if nxt is not None:
+                    st = nxt
+                if j + 1 < W:
+                    m[s, j + 1] = grammar_mask(sp.grammar, st, eos)
+        return m
+
+    def _neutral_step_ops(self, W: int) -> tuple:
+        """Committed device-resident NEUTRAL sampling operands for a
+        step whose live slots all carry neutral (or no) sampling
+        params — built once per width and reused, so the menu-free hot
+        path ships zero per-step sampling bytes (the operands are
+        value-identical to the real tables when every knob is neutral:
+        the penalties never read the counts, the bias adds zero, the
+        mask allows everything)."""
+        ops = self._neutral_ops.get(W)
+        if ops is None:
+            S, V = self.num_slots, self._vocab
+            ops = (jnp.zeros((S,), jnp.int32),        # top_k (off)
+                   jnp.ones((S,), jnp.float32),       # top_p
+                   jnp.ones((S,), jnp.float32),       # rep_pen
+                   jnp.zeros((S,), jnp.float32),      # pres_pen
+                   jnp.zeros((S, V), jnp.int32),      # counts (unread)
+                   jnp.zeros((S, V), jnp.float32),    # bias
+                   jnp.ones((S, W, V), bool))         # mask
+            self._neutral_ops[W] = ops
+        return ops
+
     def step(self) -> int:
         """Enforce deadlines, admit, advance chunked prefill under the
         token budget, then run ONE decode/verify step for all
@@ -1984,6 +2302,14 @@ class InferenceEngine:
         for s in stalled:                    # decode-invisible this step
             lengths_dev[s] = 0
             table_dev[s, :] = NULL_PAGE
+        if any(self._slots[s].menu_active for s in live):
+            samp_ops = (self._top_k.copy(), self._top_p.copy(),
+                        self._rep_pen.copy(), self._pres_pen.copy(),
+                        self._tok_counts.copy(),
+                        self._logit_bias.copy(),
+                        self._mask_block(drafts, W, live))
+        else:
+            samp_ops = self._neutral_step_ops(W)
         t_start = time.perf_counter()
         self._kpools, self._vpools, ka, va, emitted, n_emit, lengths = \
             self._decode_step(self._param_vals, self._kpools,
@@ -1991,7 +2317,7 @@ class InferenceEngine:
                               tokens, draft_len,
                               table_dev, lengths_dev,
                               self._temps.copy(),
-                              self._slot_keys.copy())
+                              self._slot_keys.copy(), *samp_ops)
         self._pull_amax(ka, va)
         # THE designed per-step host sync: the scheduler needs the
         # emitted tokens/acceptance counts to advance slots; everything
@@ -2188,6 +2514,34 @@ class InferenceEngine:
             self._record_terminal(self._queue.popleft(), Outcome.SHED,
                                   detail)
 
+    def _fail_starved_head(self, polls: int):
+        """Bounded give-up on an unadmittable queue head while the
+        engine is otherwise idle — shared by ``run()`` and the HTTP
+        front end's driver loop (serve/frontend.py), so both speak the
+        same outcome semantics. The PRIORITY head is what admission is
+        blocked on — failing a lower tier behind it would not unwedge
+        anything. A head that is only queued because the brownout
+        clamp holds its tier is NOT page-starved: it gets a retryable
+        SHED (the honest 'come back when pressure clears'), not a
+        FAILED_UNSERVABLE — still bounded, the engine never wedges on
+        a pinned controller."""
+        head = self._queue_head(clamped_ok=False)
+        if head is not None:
+            self.withdraw(head)
+            self._record_terminal(
+                head, Outcome.FAILED_UNSERVABLE,
+                f"page-starved: head of an idle engine "
+                f"for {polls} polls "
+                f"(free={self._alloc.free_count})")
+        else:
+            head = self._queue_head()
+            self.withdraw(head)
+            self._record_terminal(
+                head, Outcome.SHED,
+                f"brownout level {self.brownout_level} "
+                f"held {head.tier.value} admissions "
+                f"clamped for {polls} idle polls")
+
     def run(self, requests, arrival_times=None, poll_sleep=1e-3,
             before_step=None, after_step=None):
         """Drive ``requests`` until EVERY one is terminal (structured
@@ -2235,30 +2589,7 @@ class InferenceEngine:
                 # nothing decoding, nothing prefilling, head unadmitted
                 stall += 1
                 if stall > self.stall_steps:
-                    # the PRIORITY head is what admission is blocked
-                    # on — failing a lower tier behind it would not
-                    # unwedge anything. A head that is only queued
-                    # because the brownout clamp holds its tier is
-                    # NOT page-starved: it gets a retryable SHED (the
-                    # honest 'come back when pressure clears'), not a
-                    # FAILED_UNSERVABLE — still bounded, the engine
-                    # never wedges on a pinned controller
-                    head = self._queue_head(clamped_ok=False)
-                    if head is not None:
-                        self.withdraw(head)
-                        self._record_terminal(
-                            head, Outcome.FAILED_UNSERVABLE,
-                            f"page-starved: head of an idle engine "
-                            f"for {stall} polls "
-                            f"(free={self._alloc.free_count})")
-                    else:
-                        head = self._queue_head()
-                        self.withdraw(head)
-                        self._record_terminal(
-                            head, Outcome.SHED,
-                            f"brownout level {self.brownout_level} "
-                            f"held {head.tier.value} admissions "
-                            f"clamped for {stall} idle polls")
+                    self._fail_starved_head(stall)
                     stall = 0
                 else:
                     time.sleep(poll_sleep)   # let deadlines/holds move

@@ -29,7 +29,7 @@ from __future__ import annotations
 
 from typing import List, Optional
 
-__all__ = ["render_metrics"]
+__all__ = ["render_metrics", "render_frontend_metrics"]
 
 _NS = "mxtpu_serve"
 
@@ -59,6 +59,8 @@ _ENGINE_COUNTERS = [
     ("accepted_tokens", "accepted_tokens_total"),
     ("prefix_hits", "prefix_hits_total"),
     ("prefix_lookups", "prefix_lookups_total"),
+    ("stop_hits", "stop_hits_total"),
+    ("constrained_requests", "constrained_requests_total"),
     ("preemptions", "preemptions_total"),
     ("brownout_escalations", "brownout_escalations_total"),
     ("brownout_deescalations", "brownout_deescalations_total"),
@@ -202,6 +204,30 @@ def _emit_engine(w: _Writer, snap: dict, ns: str = _NS,
             w.add(f"{ns}_{suffix}", "counter", snap[key],
                   _labels(**extra))
     _emit_hists(w, snap, ns, extra)
+
+
+def render_frontend_metrics(stats: dict) -> str:
+    """Prometheus text for the HTTP front end's own counters
+    (``ServeFrontend.stats_snapshot()`` — serve/frontend.py): request
+    and per-status response totals, disconnect/slow-reader cancels,
+    and streamed-token count. Appended to the backend's
+    ``render_metrics`` output by the ``/metrics`` handler so one
+    scrape covers the client edge and the serving core."""
+    w = _Writer()
+    w.add(f"{_NS}_http_requests_total", "counter",
+          stats.get("http_requests", 0))
+    for status, n in sorted(stats.get("http_responses", {}).items()):
+        w.add(f"{_NS}_http_responses_total", "counter", n,
+              _labels(status=status))
+    w.add(f"{_NS}_http_disconnects_total", "counter",
+          stats.get("disconnects", 0))
+    w.add(f"{_NS}_http_slow_reader_cancels_total", "counter",
+          stats.get("slow_reader_cancels", 0))
+    w.add(f"{_NS}_sse_tokens_total", "counter",
+          stats.get("sse_tokens", 0))
+    w.add(f"{_NS}_http_open_streams", "gauge",
+          stats.get("open_streams", 0))
+    return w.render()
 
 
 def render_metrics(snapshot: dict) -> str:

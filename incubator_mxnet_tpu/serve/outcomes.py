@@ -6,6 +6,9 @@ offer under overload and faults (docs/RESILIENCE.md). The taxonomy:
 
   EOS                 stopped at the request's eos_id (success)
   MAX_TOKENS          generated max_new_tokens (success)
+  STOP                a client stop sequence matched the generated
+                      stream (success; the matched sequence is NOT
+                      part of the output — serve/sampling.py)
   DEADLINE_EXPIRED    the request's deadline (or the engine's per-slot
                       wall cap) passed — queued requests are dropped,
                       decoding slots are evicted with their pages
@@ -58,6 +61,7 @@ __all__ = ["Outcome"]
 class Outcome(enum.Enum):
     EOS = "EOS"
     MAX_TOKENS = "MAX_TOKENS"
+    STOP = "STOP"
     DEADLINE_EXPIRED = "DEADLINE_EXPIRED"
     SHED = "SHED"
     FAILED_NONFINITE = "FAILED_NONFINITE"
@@ -70,7 +74,7 @@ class Outcome(enum.Enum):
     def ok(self) -> bool:
         """True for the success outcomes (the request's own stopping
         condition, not an engine intervention)."""
-        return self in (Outcome.EOS, Outcome.MAX_TOKENS)
+        return self in (Outcome.EOS, Outcome.MAX_TOKENS, Outcome.STOP)
 
     @property
     def retryable(self) -> bool:

@@ -381,6 +381,16 @@ class Router:
                 f"request needs {total} positions but no replica can "
                 f"ever hold it")
             return False
+        if request.sampling is not None:
+            # same fail-fast the engine applies (one shared validator,
+            # serve/sampling.py) — a grammar over the wrong vocab must
+            # not bounce through dispatch to die there
+            err = request.sampling.validate_for(
+                alive[0].engine.model.vocab_size, request.eos_id)
+            if err is not None:
+                self._record_terminal(request,
+                                      Outcome.FAILED_UNSERVABLE, err)
+                return False
         # the newcomer's OWN refusals come first (tier bound, delay
         # limit): a request about to be refused anyway must not
         # displace an innocent lower-tier victim on the way out
@@ -554,7 +564,17 @@ class Router:
         att = Request(self._attempt_prompt(tracked).copy(),
                       max_new_tokens=remaining,
                       temperature=c.temperature, eos_id=c.eos_id,
-                      deadline_s=deadline, seed=c.seed, tier=c.tier)
+                      deadline_s=deadline, seed=c.seed, tier=c.tier,
+                      # the sampling menu rides every replay attempt;
+                      # prompt_len marks where the TRUE prompt ends so
+                      # the engine re-derives grammar state and the
+                      # stop window from the generated suffix only —
+                      # resumed continuations stay bit-identical under
+                      # every knob (serve/sampling.py)
+                      sampling=c.sampling,
+                      prompt_len=(c.prompt_len if c.prompt_len
+                                  is not None
+                                  else int(c.prompt_ids.size)))
         return att
 
     def _absorb(self, tracked: _Tracked, att: Request):
@@ -570,7 +590,18 @@ class Router:
 
     def _finish_from_attempt(self, tracked: _Tracked, att: Request):
         self._absorb(tracked, att)
-        self._record_terminal(tracked.client, att.outcome, att.detail,
+        c = tracked.client
+        if att.outcome is Outcome.STOP and att._stop_trim:
+            # the stop-sequence match reached back into tokens an
+            # EARLIER attempt emitted (the engine could only truncate
+            # its own stream) — trim the remainder off the client so
+            # the matched sequence never appears in the output
+            trim = min(att._stop_trim, len(c.token_ids))
+            if trim:
+                del c.token_ids[-trim:]
+                del c.token_times[-trim:]
+                del c.token_stamps[-trim:]
+        self._record_terminal(c, att.outcome, att.detail,
                               att.retry_after_s)
 
     def _requeue(self, tracked: _Tracked, detail: str):
@@ -890,47 +921,10 @@ class Router:
                 continue
             if self._queue or self._inflight:
                 self._stall += 1
-                degraded = any(r.state is ReplicaState.DEGRADED
-                               for r in self._alive())
-                # a DEGRADED replica's recovery is pending (half-open
-                # probes on backoff), so idle passes are expected —
-                # give the breaker loop several full backoff cycles
-                # before concluding it is a wedge, but DO keep
-                # counting: a permanently-degraded fleet must still
-                # give up, bounded, not hang forever
-                limit = self.stall_steps * (8 if degraded else 1)
+                limit = self._stall_limit()
                 if self._stall > limit:
                     self._stall = 0
-                    if self._queue:
-                        head = self._queue.popleft()
-                        if degraded:
-                            # replica-health cause: survivors exist
-                            # but none recovered in time
-                            self._record_terminal(
-                                head.client, Outcome.FAILED_REPLICA,
-                                f"no replica recovered within {limit} "
-                                f"idle passes (fleet degraded)")
-                        else:
-                            # capacity/starvation cause on a healthy
-                            # fleet — same outcome as the engine's own
-                            # starved-head give-up (non-retryable:
-                            # 'retry later' is a lie here)
-                            self._record_terminal(
-                                head.client, Outcome.FAILED_UNSERVABLE,
-                                f"router queue head starved for "
-                                f"{limit} idle passes (no serving "
-                                f"replica could admit it)")
-                    else:
-                        # in-flight but frozen: an attempt stuck in a
-                        # replica's OWN admission queue never advances
-                        # and (unlike slotted work, which the engine's
-                        # watchdog evicts) no engine-side give-up
-                        # covers it — the engine's starved-head path
-                        # lives in engine.run(), which the router
-                        # does not use. Withdraw one, bounded, with
-                        # the same cause split as the queue-head
-                        # give-up above.
-                        self._withdraw_starved(degraded, limit)
+                    self._fail_starved(limit)
                 else:
                     time.sleep(poll_sleep)
             elif pending:
@@ -938,6 +932,54 @@ class Router:
                 time.sleep(min(poll_sleep,
                                max(0.0, pending[0][0] - now)))
         return requests
+
+    def _stall_limit(self) -> int:
+        """Idle passes before the fleet gives up on its starved head.
+        A DEGRADED replica's recovery is pending (half-open probes on
+        backoff), so idle passes are expected — give the breaker loop
+        several full backoff cycles before concluding it is a wedge,
+        but DO keep counting: a permanently-degraded fleet must still
+        give up, bounded, not hang forever."""
+        degraded = any(r.state is ReplicaState.DEGRADED
+                       for r in self._alive())
+        return self.stall_steps * (8 if degraded else 1)
+
+    def _fail_starved(self, limit: int):
+        """Bounded give-up after ``limit`` idle passes — shared by
+        ``run()`` and the HTTP front end's driver (serve/frontend.py),
+        one audited outcome path for both."""
+        degraded = any(r.state is ReplicaState.DEGRADED
+                       for r in self._alive())
+        if self._queue:
+            head = self._queue.popleft()
+            if degraded:
+                # replica-health cause: survivors exist
+                # but none recovered in time
+                self._record_terminal(
+                    head.client, Outcome.FAILED_REPLICA,
+                    f"no replica recovered within {limit} "
+                    f"idle passes (fleet degraded)")
+            else:
+                # capacity/starvation cause on a healthy
+                # fleet — same outcome as the engine's own
+                # starved-head give-up (non-retryable:
+                # 'retry later' is a lie here)
+                self._record_terminal(
+                    head.client, Outcome.FAILED_UNSERVABLE,
+                    f"router queue head starved for "
+                    f"{limit} idle passes (no serving "
+                    f"replica could admit it)")
+        else:
+            # in-flight but frozen: an attempt stuck in a
+            # replica's OWN admission queue never advances
+            # and (unlike slotted work, which the engine's
+            # watchdog evicts) no engine-side give-up
+            # covers it — the engine's starved-head path
+            # lives in engine.run(), which the router
+            # does not use. Withdraw one, bounded, with
+            # the same cause split as the queue-head
+            # give-up above.
+            self._withdraw_starved(degraded, limit)
 
     def _withdraw_starved(self, degraded: bool, limit: int) -> bool:
         """Pull one attempt out of a live replica's admission queue
@@ -971,6 +1013,23 @@ class Router:
                     f"admission queue for {limit} idle fleet passes")
             return True
         return False
+
+    def live_tokens(self, request) -> List[int]:
+        """The client-visible token stream RIGHT NOW: tokens already
+        absorbed onto the client plus the in-flight attempt's
+        emissions. Safe to stream before the attempt finishes — the
+        partial-tokens-kept contract means a failover/preemption/shed
+        can only PRESERVE these (the re-queue absorbs them), never
+        take them back; the one exception, a stop-sequence match
+        reaching back across an attempt boundary, is bounded by
+        max_stop_len - 1 tokens, exactly the holdback the HTTP front
+        end applies while stop sequences are armed
+        (serve/frontend.py)."""
+        for t in self._inflight:
+            if t.client is request:
+                return list(t.client.token_ids) + \
+                    list(t.attempt.token_ids)
+        return list(request.token_ids)
 
     def cancel(self, request, detail: str = "cancelled by client") \
             -> bool:

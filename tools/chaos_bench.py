@@ -1210,6 +1210,251 @@ def run_sigterm_scenario(errors):
         return report
 
 
+# --------------------------------------------------------------------- #
+# client-edge (HTTP/SSE frontend) scenarios — ci/run.sh frontsmoke's
+# sibling: chaos AT the protocol boundary (serve/frontend.py)
+# --------------------------------------------------------------------- #
+
+def run_frontend_scenarios(n_requests, errors):
+    """Chaos at the client edge: real sockets over localhost against a
+    live ``ServeFrontend``. Two faults nobody unit-tests but every
+    production API dies from:
+
+      - ``disconnect_storm``: clients hang up mid-stream (and one
+        before its first token — a cancel landing while
+        queued/prefilling). Every disconnect must become EXACTLY ONE
+        CANCELLED terminal with pages reclaimed; survivors must emit
+        BIT-IDENTICAL tokens to a frontend-free engine run (greedy
+        determinism is occupancy-independent); pages audit clean after
+        every driver step and the decode family compiles once.
+      - ``slow_reader``: a client that stops consuming. The
+        write-buffer bound + drain timeout must convert the stalled
+        socket into a CANCELLED terminal (never a wedged slot), while
+        concurrent healthy clients finish untouched.
+    """
+    import threading
+
+    import numpy as np
+    from incubator_mxnet_tpu.base import MXNetError
+    from incubator_mxnet_tpu.serve import (InferenceEngine, Outcome,
+                                           Request, ServeFrontend,
+                                           stream_completion)
+    from incubator_mxnet_tpu.serve.chaos import assert_health_consistent
+
+    results = {}
+    vocab = 64
+
+    def _audit(tag):
+        def hook(backend):
+            try:
+                backend.audit_pages()
+            except MXNetError as e:
+                errors.append(f"{tag}: audit failed mid-run: {e}")
+        return hook
+
+    # ---- disconnect storm ----------------------------------------- #
+    tag = "frontend_disconnect_storm"
+    model = _build_model()
+    rng = np.random.RandomState(5)
+    prompts = [rng.randint(0, vocab, size=(5 + i % 4,)).astype(np.int32)
+               for i in range(n_requests)]
+    # long generations: an abort after 2 received tokens must land
+    # while the request is still DECODING (a 24-token budget races —
+    # the engine can finish before the client's close is visible)
+    max_new = 96
+    # the frontend-free oracle: greedy determinism is per-request, so
+    # survivors through HTTP must match a plain engine run exactly
+    ref_eng = _engine(model, num_slots=2)
+    ref_reqs = [Request(p.copy(), max_new_tokens=max_new)
+                for p in prompts]
+    ref_eng.run(ref_reqs)
+    ref_tokens = {tuple(p.tolist()): list(r.token_ids)
+                  for p, r in zip(prompts, ref_reqs)}
+
+    eng = _engine(model, num_slots=2)
+    results_by_i = [None] * n_requests
+    with ServeFrontend(eng, after_step=_audit(tag)) as fe:
+        port = fe.bound_port
+
+        def client(i):
+            abort = None
+            if i % 2 == 1:
+                abort = 2           # mid-stream hangup
+            if i == n_requests - 1:
+                abort = 0           # hang up before the first token
+            results_by_i[i] = stream_completion(
+                "127.0.0.1", port,
+                {"prompt": [int(t) for t in prompts[i]],
+                 "max_new_tokens": max_new},
+                abort_after_tokens=abort)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n_requests)]
+        for t in threads:
+            t.start()
+            time.sleep(0.01)        # staggered: cancels land in
+        for t in threads:           # queued/prefill/decode states
+            t.join(timeout=120)
+        deadline = time.perf_counter() + 60
+        while len(fe.finished) < n_requests and \
+                time.perf_counter() < deadline:
+            time.sleep(0.02)
+        finished = list(fe.finished)
+
+    if len(finished) != n_requests:
+        errors.append(f"{tag}: {len(finished)}/{n_requests} requests "
+                      f"reached a terminal outcome")
+    # client-side view: the aborting clients must actually have been
+    # mid-stream (saw their tokens before hanging up), the healthy
+    # ones must have received their full stream + terminal event
+    for i, res in enumerate(results_by_i):
+        if res is None:
+            errors.append(f"{tag}: client {i} never returned")
+        elif i == n_requests - 1:
+            if not res["aborted"] or res["tokens"]:
+                errors.append(f"{tag}: pre-first-token client {i} "
+                              f"did not hang up before a token")
+        elif i % 2 == 1:
+            if not res["aborted"] or len(res["tokens"]) != 2:
+                errors.append(f"{tag}: mid-stream client {i} aborted "
+                              f"with {len(res['tokens'])} tokens "
+                              f"(want 2)")
+        elif res["final"] is None or \
+                res["final"]["outcome"] != "MAX_TOKENS":
+            errors.append(f"{tag}: healthy client {i} missing its "
+                          f"terminal event")
+    n_cancelled = n_survived = 0
+    for r in finished:
+        if r.outcome is None:
+            errors.append(f"{tag}: request {r.request_id} non-terminal")
+        elif r.outcome is Outcome.CANCELLED:
+            n_cancelled += 1
+        elif r.outcome.ok:
+            n_survived += 1
+            want = ref_tokens.get(tuple(int(t) for t in r.prompt_ids))
+            if want is not None and list(r.token_ids) != want:
+                errors.append(f"{tag}: survivor {r.request_id} "
+                              f"diverged from the frontend-free run")
+        else:
+            errors.append(f"{tag}: unexpected outcome {r.outcome} for "
+                          f"request {r.request_id}")
+    expect_cancels = n_requests // 2 + (1 if (n_requests - 1) % 2 == 0
+                                        else 0)
+    if n_cancelled != expect_cancels:
+        errors.append(f"{tag}: {n_cancelled} CANCELLED != "
+                      f"{expect_cancels} disconnected clients")
+    try:
+        assert_health_consistent(eng, finished)
+    except MXNetError as e:
+        errors.append(f"{tag}: {e}")
+    try:
+        eng.audit_pages()
+    except MXNetError as e:
+        errors.append(f"{tag}: final audit failed: {e}")
+    if eng._alloc.free_count != eng.num_pages - 1 - \
+            (len(eng._prefix.held_pages()) if eng._prefix else 0):
+        errors.append(f"{tag}: pages not reclaimed after the storm")
+    _check_compile_once(tag, eng, errors)
+    snap = eng.health_snapshot()
+    results[tag] = {
+        "requests": n_requests, "cancelled": n_cancelled,
+        "survived": n_survived,
+        "outcomes": {o: n for o, n in snap["outcomes"].items() if n},
+        "decode_trace_count": eng.decode_trace_count,
+        "verify_trace_count": eng.verify_trace_count,
+    }
+
+    # ---- slow reader ---------------------------------------------- #
+    tag = "frontend_slow_reader"
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.models import gpt as g
+    mx.random.seed(0)
+    big = g.gpt_mini(vocab_size=vocab, max_length=2048)
+    big.initialize()
+    eng2 = InferenceEngine(big, num_slots=2, page_size=16,
+                           spec_k=_SPEC_K)
+    slow_done = {}
+    with ServeFrontend(eng2, drain_timeout_s=0.3, write_buffer=512,
+                       sndbuf=2048, sse_pad_bytes=8192,
+                       after_step=_audit(tag)) as fe:
+        port = fe.bound_port
+
+        def slow_client():
+            # reads a trickle then stalls: the server must cut it
+            # loose, not wedge the slot. Daemon thread — it may sleep
+            # long past the scenario.
+            try:
+                slow_done["out"] = stream_completion(
+                    "127.0.0.1", port,
+                    {"prompt": [1, 2, 3, 4], "max_new_tokens": 1900},
+                    read_delay_s=30.0, recv_buf=1024, timeout=120)
+            except Exception:
+                pass
+
+        ts = threading.Thread(target=slow_client, daemon=True)
+        ts.start()
+        # healthy traffic rides alongside
+        fast = [None, None]
+
+        def fast_client(i):
+            fast[i] = stream_completion(
+                "127.0.0.1", port,
+                {"prompt": [5 + i, 6, 7], "max_new_tokens": 12})
+
+        tf = [threading.Thread(target=fast_client, args=(i,))
+              for i in range(2)]
+        for t in tf:
+            t.start()
+        for t in tf:
+            t.join(timeout=120)
+        deadline = time.perf_counter() + 90
+        while len(fe.finished) < 3 and time.perf_counter() < deadline:
+            time.sleep(0.05)
+        finished2 = list(fe.finished)
+        stats = fe.stats_snapshot()
+
+    if len(finished2) < 3:
+        errors.append(f"{tag}: {len(finished2)}/3 requests reached a "
+                      f"terminal outcome (slow reader wedged the "
+                      f"engine?)")
+    slow_req = next((r for r in finished2
+                     if r.max_new_tokens == 1900), None)
+    if slow_req is None:
+        errors.append(f"{tag}: slow request never terminal")
+    elif slow_req.outcome is not Outcome.CANCELLED:
+        errors.append(f"{tag}: slow reader ended {slow_req.outcome} "
+                      f"(want CANCELLED via drain timeout)")
+    elif "slow reader" not in slow_req.detail:
+        errors.append(f"{tag}: cancel cause does not name the slow "
+                      f"reader: {slow_req.detail!r}")
+    if stats["slow_reader_cancels"] < 1:
+        errors.append(f"{tag}: slow_reader_cancels counter never "
+                      f"moved")
+    for r in finished2:
+        if r is not slow_req and not (r.outcome and r.outcome.ok):
+            errors.append(f"{tag}: healthy client ended {r.outcome}")
+    for f in fast:
+        if not f or not f["final"] or \
+                f["final"]["outcome"] != "MAX_TOKENS":
+            errors.append(f"{tag}: healthy client failed to complete")
+    try:
+        eng2.audit_pages()
+    except MXNetError as e:
+        errors.append(f"{tag}: final audit failed: {e}")
+    _check_compile_once(tag, eng2, errors)
+    results[tag] = {
+        "slow_outcome": slow_req.outcome.value if slow_req and
+        slow_req.outcome else None,
+        "slow_tokens_delivered": len(slow_done.get("out", {})
+                                     .get("tokens", [])
+                                     if slow_done.get("out") else []),
+        "slow_reader_cancels": stats["slow_reader_cancels"],
+        "decode_trace_count": eng2.decode_trace_count,
+        "verify_trace_count": eng2.verify_trace_count,
+    }
+    return results
+
+
 def main():
     global _SPEC_K
     ap = argparse.ArgumentParser()
@@ -1226,6 +1471,12 @@ def main():
                     help="SLO-tier scenarios — tiered overload storm, "
                          "cancel storm, preempt-vs-quarantine, "
                          "brownout flap (ci/run.sh tiersmoke)")
+    ap.add_argument("--frontend", action="store_true",
+                    help="client-edge scenarios over real localhost "
+                         "sockets — mid-stream disconnect storm and "
+                         "slow-reader backpressure against a live "
+                         "ServeFrontend (ci/run.sh frontsmoke's chaos "
+                         "sibling)")
     ap.add_argument("--replicas", type=int, default=2,
                     help="fleet size for --fleet scenarios")
     ap.add_argument("--spec-k", type=int, default=_SPEC_K,
@@ -1244,7 +1495,9 @@ def main():
     n = args.requests or (10 if args.smoke else 24)
     errors = []
     t0 = time.perf_counter()
-    if args.tiers:
+    if args.frontend:
+        results = run_frontend_scenarios(n, errors)
+    elif args.tiers:
         results = run_tier_scenarios(n, errors)
     elif args.fleet:
         results = run_fleet_scenarios(n, errors,
@@ -1265,8 +1518,9 @@ def main():
             f.write("\n")
         print(f"banked {args.json}")
     if not errors:
-        scope = "tiers" if args.tiers else \
-            ("fleet" if args.fleet else "chaos")
+        scope = "frontend" if args.frontend else \
+            ("tiers" if args.tiers else
+             ("fleet" if args.fleet else "chaos"))
         print(f"{scope}: all scenarios quiescent, isolated, audited, "
               f"compile-clean")
     sys.exit(0 if not errors else 1)

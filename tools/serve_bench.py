@@ -1295,20 +1295,21 @@ def _make_tap_engine_cls():
             super().__init__(*a, **kw)
 
         def _accept_emit(self, logits, tokens, draft_len, temps,
-                         slot_keys, pos, act):
+                         slot_keys, pos, act, **kw):
             jax.debug.callback(
                 lambda lg, dl, a: self.tap_decode.append(
                     (np.array(lg), np.array(dl), np.array(a))),
                 logits, draft_len, act)
             return super()._accept_emit(logits, tokens, draft_len,
-                                        temps, slot_keys, pos, act)
+                                        temps, slot_keys, pos, act,
+                                        **kw)
 
-        def _sample_one(self, logits, temp, pos_key):
+        def _sample_one(self, logits, temp, pos_key, *sargs):
             if logits.ndim == 1:     # prefill/chunk head (V,)
                 jax.debug.callback(
                     lambda lg: self.tap_prefill.append(np.array(lg)),
                     logits)
-            return super()._sample_one(logits, temp, pos_key)
+            return super()._sample_one(logits, temp, pos_key, *sargs)
 
     return _LogitTapEngine
 
@@ -1554,6 +1555,282 @@ def bench_int8_allreduce(*, smoke, errors):
     return out
 
 
+# --------------------------------------------------------------------- #
+# round-18: HTTP/SSE front end (--frontend, banks BENCH_FRONTEND.json)
+# --------------------------------------------------------------------- #
+
+def bench_frontend_overhead(model, *, n_requests, prompt_len, max_new,
+                            slots, page_size, rate_hz, smoke, errors):
+    """The protocol-overhead bar: the SAME Poisson workload served (a)
+    directly through ``engine.run`` and (b) over localhost HTTP/SSE
+    through ``ServeFrontend`` with one real socket client per request
+    — banks tokens/s both ways plus CLIENT-side TTFT/TPOT (receive
+    stamps), the numbers a user actually observes. Smoke asserts the
+    end-to-end contracts: streamed tokens arrive incrementally, a
+    mid-stream disconnect lands as CANCELLED with pages reclaimed,
+    the decode step compiled exactly once through the HTTP path, and
+    stop-sequence truncation is correct over the wire."""
+    import threading
+
+    import numpy as np
+    from incubator_mxnet_tpu.serve import (InferenceEngine, Outcome,
+                                           Request, ServeFrontend,
+                                           stream_completion)
+    vocab = model.vocab_size
+    rng = np.random.RandomState(21)
+    prompts = [rng.randint(0, vocab, size=(prompt_len,)).astype(np.int32)
+               for _ in range(n_requests)]
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_hz,
+                                         size=n_requests))
+    arrivals[0] = 0.0
+
+    # -- direct arm ------------------------------------------------- #
+    eng_d = InferenceEngine(model, num_slots=slots,
+                            page_size=page_size, recorder=False)
+    # warm the decode + this prompt bucket OUTSIDE the timed window
+    # (both arms: the comparison is protocol cost, not who paid the
+    # first compile)
+    eng_d.run([Request(prompts[0].copy(), max_new_tokens=2)])
+    steps0 = eng_d.decode_steps
+    reqs = [Request(p.copy(), max_new_tokens=max_new) for p in prompts]
+    t0 = time.perf_counter()
+    eng_d.run(reqs, arrival_times=list(arrivals))
+    direct = _engine_stats(eng_d, reqs, time.perf_counter() - t0,
+                           decode_steps0=steps0)
+    _check_compile_discipline("frontend.direct", direct, errors)
+
+    # -- HTTP/SSE arm ----------------------------------------------- #
+    eng_h = InferenceEngine(model, num_slots=slots,
+                            page_size=page_size, recorder=False)
+    results = [None] * n_requests
+    send_ts = [None] * n_requests
+
+    with ServeFrontend(eng_h) as fe:
+        port = fe.bound_port
+        stream_completion("127.0.0.1", port,     # warm, untimed
+                          {"prompt": [int(t) for t in prompts[0]],
+                           "max_new_tokens": 2})
+
+        def client(i):
+            send_ts[i] = time.perf_counter()
+            results[i] = stream_completion(
+                "127.0.0.1", port,
+                {"prompt": [int(t) for t in prompts[i]],
+                 "max_new_tokens": max_new})
+
+        threads = []
+        t0 = time.perf_counter()
+        for i, arr in enumerate(arrivals):
+            now = time.perf_counter() - t0
+            if now < arr:
+                time.sleep(arr - now)
+            th = threading.Thread(target=client, args=(i,))
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=300)
+        wall_h = time.perf_counter() - t0
+
+        # contract: incremental SSE delivery (not one terminal burst)
+        bursts = [len({round(s, 4) for s in r["stamps"]})
+                  for r in results if r and r["stamps"]]
+        if bursts and sorted(bursts)[len(bursts) // 2] < 3:
+            errors.append(f"frontend: median distinct token-arrival "
+                          f"count {sorted(bursts)[len(bursts)//2]} — "
+                          f"SSE is not streaming incrementally")
+
+        # contract: disconnect mid-stream -> CANCELLED, pages clean
+        free0 = eng_h._alloc.free_count
+        dis = stream_completion(
+            "127.0.0.1", port,
+            {"prompt": [int(t) for t in prompts[0]],
+             "max_new_tokens": max(32, max_new)},
+            abort_after_tokens=2)
+        if not dis["aborted"]:
+            errors.append("frontend: disconnect client failed to abort")
+        tdead = time.perf_counter() + 30
+        while time.perf_counter() < tdead:
+            done = [r for r in fe.finished
+                    if r.outcome is Outcome.CANCELLED]
+            if done:
+                break
+            time.sleep(0.02)
+        else:
+            errors.append("frontend: mid-stream disconnect never "
+                          "became a CANCELLED terminal")
+        t_idle = time.perf_counter() + 10
+        while eng_h.active_count and time.perf_counter() < t_idle:
+            time.sleep(0.01)
+        if eng_h._alloc.free_count != free0:
+            errors.append(f"frontend: disconnect leaked pages "
+                          f"({eng_h._alloc.free_count} != {free0})")
+
+        # contract: stop-sequence truncation over the wire
+        greedy = stream_completion(
+            "127.0.0.1", port,
+            {"prompt": [int(t) for t in prompts[1]],
+             "max_new_tokens": max_new})
+        ref = greedy["final"]["tokens"]
+        if len(ref) >= 4:
+            stop = ref[2:4]
+            cut = next(i for i in range(len(ref) - 1)
+                       if ref[i:i + 2] == stop)
+            stopped = stream_completion(
+                "127.0.0.1", port,
+                {"prompt": [int(t) for t in prompts[1]],
+                 "max_new_tokens": max_new, "stop": [stop]})
+            if stopped["final"]["outcome"] != "STOP" or \
+                    stopped["final"]["tokens"] != ref[:cut] or \
+                    stopped["tokens"] != ref[:cut]:
+                errors.append(
+                    f"frontend: stop-sequence truncation wrong over "
+                    f"HTTP (got {stopped['final']['outcome']} "
+                    f"{stopped['final']['tokens']}, want STOP "
+                    f"{ref[:cut]})")
+
+    eng_h.audit_pages()
+    if eng_h.decode_trace_count != 1:
+        errors.append(f"frontend: decode compiled "
+                      f"{eng_h.decode_trace_count} times through the "
+                      f"HTTP path (must be 1)")
+    bad = [i for i, r in enumerate(results)
+           if r is None or r["final"] is None or
+           r["final"]["outcome"] != "MAX_TOKENS"]
+    if bad:
+        errors.append(f"frontend: requests {bad} did not complete "
+                      f"over HTTP")
+    # server-vs-client parity: the finished engine requests must carry
+    # exactly the token streams the clients received
+    server = {tuple(r["final"]["tokens"]) for r in results if r}
+    direct_set = {tuple(r.token_ids) for r in reqs}
+    if server != direct_set:
+        errors.append("frontend: HTTP token streams diverge from the "
+                      "direct-run streams (greedy parity broken)")
+
+    tokens = sum(len(r["tokens"]) for r in results if r)
+    ttft = [r["stamps"][0] - s for r, s in zip(results, send_ts)
+            if r and r["stamps"]]
+    gaps = [b - a for r in results if r
+            for a, b in zip(r["stamps"], r["stamps"][1:])]
+    http = {
+        "tokens": tokens,
+        "wall_s": wall_h,
+        "tokens_per_s": tokens / wall_h,
+        "client_ttft_p50_ms": _percentile(ttft, 50) * 1e3,
+        "client_ttft_p99_ms": _percentile(ttft, 99) * 1e3,
+        "client_itl_p50_ms": _percentile(gaps, 50) * 1e3,
+        "client_itl_p99_ms": _percentile(gaps, 99) * 1e3,
+        "decode_trace_count": eng_h.decode_trace_count,
+        "responses": fe.stats_snapshot()["http_responses"],
+    }
+    return {
+        "config": {"n_requests": n_requests, "prompt_len": prompt_len,
+                   "max_new": max_new, "slots": slots,
+                   "rate_hz": rate_hz},
+        "direct": direct,
+        "http_sse": http,
+        "protocol_overhead_tokens_per_s":
+            direct["tokens_per_s"] / http["tokens_per_s"],
+    }
+
+
+def bench_constrained_decoding(model, *, n_requests, spec_k, slots,
+                               page_size, smoke, errors):
+    """The constrained agent/tool-call workload: decoding restricted
+    to a menu of tool-call token templates (``choice_grammar``) on a
+    SPECULATIVE engine — banks the accept-rate delta the grammar mask
+    causes vs the same prompts unconstrained (masks reject drafts the
+    language forbids, and draft truncation at the first forbidden
+    token claws most of that back), plus the in-language rate (must
+    be 100%) and the compile discipline under masks."""
+    import numpy as np
+    from incubator_mxnet_tpu.serve import (InferenceEngine, Request,
+                                           SamplingParams,
+                                           choice_grammar)
+    vocab = model.vocab_size
+    rng = np.random.RandomState(33)
+    eos = 9
+    templates = [rng.randint(10, vocab, size=(8,)).tolist()
+                 for _ in range(4)]
+    gram = choice_grammar(templates, vocab)
+
+    def _workload():
+        reqs = []
+        for i in range(n_requests):
+            tpl = templates[i % len(templates)]
+            # the agent shape: the template appears in the prompt
+            # (tool docs / few-shot), so the n-gram drafter can find
+            # it once generation enters the template
+            prompt = np.asarray(tpl + tpl[:2], np.int32)
+            reqs.append((prompt, len(tpl) + 1))
+        return reqs
+
+    def _arm(constrained):
+        eng = InferenceEngine(model, num_slots=slots,
+                              page_size=page_size, spec_k=spec_k,
+                              recorder=False)
+        reqs = []
+        for prompt, max_new in _workload():
+            sp = SamplingParams(grammar=gram) if constrained else None
+            reqs.append(Request(prompt.copy(), max_new_tokens=max_new,
+                                eos_id=eos, sampling=sp))
+        t0 = time.perf_counter()
+        eng.run(reqs)
+        wall = time.perf_counter() - t0
+        return eng, reqs, wall
+
+    eng_u, reqs_u, wall_u = _arm(False)
+    eng_c, reqs_c, wall_c = _arm(True)
+
+    # an in-language completion is a full template + EOS; MAX_TOKENS
+    # mid-template (possible only if the budget ran out) is still a
+    # PREFIX of a template — anything else is a mask violation
+    allowed = {tuple(t) for t in templates}
+    prefixes = {tuple(t[:k]) for t in templates
+                for k in range(1, len(t) + 1)}
+    def _is_full(r):
+        return bool(r.token_ids) and r.token_ids[-1] == eos and \
+            tuple(r.token_ids[:-1]) in allowed
+
+    in_lang = sum(1 for r in reqs_c if _is_full(r))
+    bad = [list(r.token_ids) for r in reqs_c
+           if not _is_full(r) and tuple(r.token_ids) not in prefixes]
+    if bad:
+        errors.append(f"constrained: off-language outputs {bad[:3]}")
+    for tag, eng in (("unconstrained", eng_u), ("constrained", eng_c)):
+        if eng.decode_trace_count > 1 or eng.verify_trace_count > 1:
+            errors.append(
+                f"constrained.{tag}: decode family retraced "
+                f"({eng.decode_trace_count}/{eng.verify_trace_count})")
+        eng.audit_pages()
+    if eng_c.drafted_tokens == 0:
+        errors.append("constrained: the speculative engine never "
+                      "drafted under the grammar mask")
+    return {
+        "config": {"n_requests": n_requests, "spec_k": spec_k,
+                   "templates": len(templates),
+                   "template_len": len(templates[0])},
+        "unconstrained": {
+            "accept_rate": eng_u.accept_rate,
+            "drafted": eng_u.drafted_tokens,
+            "accepted": eng_u.accepted_tokens,
+            "tokens_per_s": sum(len(r.token_ids)
+                                for r in reqs_u) / wall_u,
+        },
+        "constrained": {
+            "accept_rate": eng_c.accept_rate,
+            "drafted": eng_c.drafted_tokens,
+            "accepted": eng_c.accepted_tokens,
+            "tokens_per_s": sum(len(r.token_ids)
+                                for r in reqs_c) / wall_c,
+            "in_language": in_lang,
+            "constrained_requests": eng_c.constrained_requests,
+        },
+        "accept_rate_delta":
+            eng_c.accept_rate - eng_u.accept_rate,
+    }
+
+
 def _check_compile_discipline(tag, stats, errors):
     if stats["decode_trace_count"] != 1:
         errors.append(f"{tag}: decode step compiled "
@@ -1598,9 +1875,59 @@ def main():
                          "match rate, slots-at-fixed-pool-bytes, plus "
                          "the int8-allreduce convergence seam) — "
                          "banks BENCH_QUANT.json")
+    ap.add_argument("--frontend", action="store_true",
+                    help="round-18 HTTP/SSE front-end workloads ONLY "
+                         "(protocol overhead vs direct Router.submit, "
+                         "client-side TTFT/TPOT, constrained "
+                         "tool-call accept-rate delta) — banks "
+                         "BENCH_FRONTEND.json; with --smoke this is "
+                         "the frontsmoke CI stage")
     args = ap.parse_args()
 
     errors = []
+
+    if args.frontend:
+        model = _build(max_length=128)
+        if args.smoke:
+            fo_cfg = dict(n_requests=8, prompt_len=8, max_new=16,
+                          slots=4, page_size=args.page_size,
+                          rate_hz=60.0)
+            cd_cfg = dict(n_requests=8, spec_k=3, slots=4,
+                          page_size=args.page_size)
+        else:
+            fo_cfg = dict(n_requests=32, prompt_len=args.prompt_len,
+                          max_new=args.max_new, slots=args.slots,
+                          page_size=args.page_size, rate_hz=args.rate)
+            cd_cfg = dict(n_requests=24, spec_k=args.spec_k,
+                          slots=args.slots, page_size=args.page_size)
+        result = {"config": {"smoke": args.smoke,
+                             "backend": os.environ.get("JAX_PLATFORMS",
+                                                       "cpu")}}
+        result["frontend_overhead"] = bench_frontend_overhead(
+            model, smoke=args.smoke, errors=errors, **fo_cfg)
+        result["constrained_decoding"] = bench_constrained_decoding(
+            model, smoke=args.smoke, errors=errors, **cd_cfg)
+        print(json.dumps(result, indent=2))
+        for e in errors:
+            print(f"FAIL: {e}", file=sys.stderr)
+        if not args.smoke:
+            ratio = result["frontend_overhead"][
+                "protocol_overhead_tokens_per_s"]
+            if ratio > 1.25:
+                print(f"WARN: HTTP/SSE path delivers "
+                      f"{1 / ratio:.2f}x of direct tokens/s — "
+                      f"protocol overhead over the 25% bar",
+                      file=sys.stderr)
+        out = args.json
+        if out is None and not args.smoke:
+            out = os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "BENCH_FRONTEND.json")
+        if out:
+            with open(out, "w") as f:
+                json.dump(result, f, indent=2)
+                f.write("\n")
+            print(f"banked {out}")
+        sys.exit(0 if not errors else 1)
 
     if args.quant:
         model = _build(max_length=256)

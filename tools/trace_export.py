@@ -36,6 +36,7 @@ import argparse
 import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -343,6 +344,51 @@ def _smoke(tmpdir: str) -> int:
     evs = eng.flight.events()
     if not any(e.etype is EventType.PREEMPT for e in evs):
         errors.append("obssmoke: no PREEMPT event recorded")
+
+    # -- 2.5 client edge: the HTTP front end's lane must ride the
+    #        same timeline — request residency spans on a "frontend"
+    #        process carrying the HTTP status and disconnect cause --- #
+    print("== obssmoke: HTTP/SSE client edge → frontend lane")
+    from incubator_mxnet_tpu.serve import (ServeFrontend,
+                                           stream_completion)
+    eng_f = InferenceEngine(model, num_slots=2, page_size=8,
+                            max_len=64)
+    with ServeFrontend(eng_f) as fe:
+        ok = stream_completion("127.0.0.1", fe.bound_port,
+                               {"prompt": [3, 4, 5],
+                                "max_new_tokens": 6})
+        cut = stream_completion("127.0.0.1", fe.bound_port,
+                                {"prompt": [6, 7, 8],
+                                 "max_new_tokens": 48},
+                                abort_after_tokens=2)
+        tdead = time.perf_counter() + 30
+        while len(fe.finished) < 2 and time.perf_counter() < tdead:
+            time.sleep(0.02)
+    if ok["final"] is None or not cut["aborted"]:
+        errors.append("obssmoke: frontend drive did not produce one "
+                      "completion + one disconnect")
+    ftrace = to_perfetto(eng_f.flight.events())
+    try:
+        validate_trace(ftrace)
+    except ValueError as e:
+        errors.append(f"obssmoke: frontend export invalid: {e}")
+    fprocs = {ev["args"]["name"] for ev in ftrace["traceEvents"]
+              if ev["ph"] == "M" and ev["name"] == "process_name"}
+    if "frontend" not in fprocs:
+        errors.append(f"obssmoke: export lacks the frontend lane: "
+                      f"{sorted(fprocs)}")
+    fe_spans = [ev for ev in ftrace["traceEvents"]
+                if ev["ph"] == "X" and ev.get("cat") == "request" and
+                "http_status" in ev.get("args", {})]
+    statuses = {ev["args"]["http_status"] for ev in fe_spans}
+    if not {200, 499} <= statuses:
+        errors.append(f"obssmoke: frontend request spans lack the "
+                      f"200-completion/499-disconnect statuses: "
+                      f"{sorted(statuses)}")
+    if not any("disconnect" in str(ev["args"].get("cause", ""))
+               for ev in fe_spans):
+        errors.append("obssmoke: no frontend span carries the "
+                      "client-disconnect cause")
 
     # -- 3. fleet timeline export (router + replica lanes merge) ----- #
     fleet_trace = to_perfetto(rt.flight_events())
