@@ -126,6 +126,21 @@ stage_tiersmoke() {
   JAX_PLATFORMS=cpu python tools/chaos_bench.py --tiers --smoke
 }
 
+stage_hiersmoke() {
+  echo "== hiersmoke: hierarchical KV-cache guard (demote evicted prefix"
+  echo "              pages to host DRAM/disk, re-admit by COPY — tiered"
+  echo "              serving must be bit-identical to flat and recompute"
+  echo "              arms, every page free XOR live XOR demoted at every"
+  echo "              step, one promotion program ever; a corrupted demoted"
+  echo "              payload must be convicted by crc and recomputed"
+  echo "              loudly, a full disk must degrade the tier to a loud"
+  echo "              no-op, and a kill mid-promotion must leave a"
+  echo "              replacement engine that wipes stale tier dirs and"
+  echo "              serves clean)"
+  JAX_PLATFORMS=cpu python tools/serve_bench.py --hier --smoke
+  JAX_PLATFORMS=cpu python tools/chaos_bench.py --hier --smoke
+}
+
 stage_frontsmoke() {
   echo "== frontsmoke: client-protocol guard (HTTP/SSE front end over"
   echo "               localhost — an end-to-end SSE stream must deliver"
@@ -197,7 +212,7 @@ ge.dryrun_multichip(8)"
 }
 
 stages=("$@")
-[ ${#stages[@]} -eq 0 ] && stages=(sanity lintcore native unit stepbench mfubench servebench quantbench chaossmoke fleetsmoke tiersmoke frontsmoke frontchaos obssmoke trainchaos ckptbench entry report)
+[ ${#stages[@]} -eq 0 ] && stages=(sanity lintcore native unit stepbench mfubench servebench quantbench chaossmoke fleetsmoke tiersmoke hiersmoke frontsmoke frontchaos obssmoke trainchaos ckptbench entry report)
 for s in "${stages[@]}"; do
   "stage_$s"
 done

@@ -83,6 +83,9 @@ class EventType(enum.Enum):
     SUPERVISOR_RESTART = "SUPERVISOR_RESTART"
     SUPERVISOR_GIVEUP = "SUPERVISOR_GIVEUP"
     CHAOS = "CHAOS"                     # injector fired
+    CACHE_DEMOTE = "CACHE_DEMOTE"       # prefix page HBM → DRAM/disk
+    CACHE_PROMOTE = "CACHE_PROMOTE"     # prefix page re-admitted by copy
+    CACHE_TIER_MISS = "CACHE_TIER_MISS"  # tier consulted, no usable page
 
     def __str__(self) -> str:
         return self.value

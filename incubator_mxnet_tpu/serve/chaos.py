@@ -25,6 +25,7 @@ own seeded ``RandomState``.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import List, Optional
 
@@ -38,7 +39,7 @@ from .outcomes import Outcome
 from .router import ReplicaState, Router
 
 __all__ = ["ChaosInjector", "NaNWeights", "CorruptPageWrite",
-           "CorruptPageScale",
+           "CorruptPageScale", "CorruptDemotedPage", "DiskFullDemotion",
            "PagePressure", "DelayedSteps", "CancelStorm", "run_chaos",
            "assert_all_terminal", "assert_health_consistent",
            "FleetInjector", "KillReplica", "SlowReplica",
@@ -297,6 +298,134 @@ class CorruptPageScale(ChaosInjector):
         ``NaNWeights.mark_submitted_after``)."""
         if self.fired and self.mode == "zero":
             self._mark(request)
+
+
+class CorruptDemotedPage(ChaosInjector):
+    """Corrupt one DEMOTED prefix page's at-rest payload — the 'bit rot
+    below HBM' fault for the hierarchical cache (docs/SERVING.md
+    "Hierarchical prefix cache"): a flipped byte in the host-DRAM pool,
+    or in a disk-tier shard file, of a page the engine believes it can
+    re-admit by copy.
+
+    The integrity contract makes ``affected`` EMPTY: every DRAM entry
+    carries a crc32 verified at promotion (the disk tier rides the
+    checkpoint manifest's per-shard crc plus the same payload crc), so
+    the corrupted page must be caught, dropped, and counted
+    (``tier_crc_fallbacks``), and the admission must fall back to
+    recomputing prefill — producing BIT-IDENTICAL tokens to a
+    fault-free run. A fallback that records even one garbage token is
+    the invariant breach this injector exists to catch.
+
+    ``tier`` targets "dram", "disk", or None (whichever has an entry
+    first, DRAM preferred). Defers until the engine's tier store holds
+    a candidate. Requires a tiered engine (``kv_tiers`` set)."""
+
+    name = "corrupt_demoted_page"
+
+    def __init__(self, at_step: int, tier: Optional[str] = None,
+                 seed: int = 0):
+        super().__init__(seed)
+        if tier not in (None, "dram", "disk"):
+            raise MXNetError(f"demoted-corrupt tier {tier!r} not in "
+                             f"dram|disk|None")
+        self.at_step = at_step
+        self.tier = tier
+
+    def on_step(self, engine, step_idx):
+        if self.fired or step_idx < self.at_step:
+            return
+        if engine._tiers is None:
+            raise MXNetError("CorruptDemotedPage needs a tiered engine "
+                             "(kv_tiers set) — there is nothing "
+                             "demoted to corrupt otherwise")
+        cands = [(k, e) for k, e in engine._tiers.entries()
+                 if self.tier is None or e.tier == self.tier]
+        if not cands:
+            return                       # defer until something demoted
+        if self.tier is None:
+            dram = [c for c in cands if c[1].tier == "dram"]
+            cands = dram or cands
+        key, ent = cands[self.rng.randint(len(cands))]
+        if ent.tier == "dram":
+            # flip one byte of the layer-0 K payload (payloads may be
+            # read-only views of device buffers — corrupt a copy and
+            # swap it in; the stored crc now convicts it)
+            arr = np.array(ent.k_payload[0])
+            buf = arr.view(np.uint8).reshape(-1)
+            buf[self.rng.randint(buf.size)] ^= 0xFF
+            ent.k_payload = (arr,) + tuple(ent.k_payload[1:])
+            where = "dram payload"
+        else:
+            from ..checkpoint.manifest import step_dir
+            d = step_dir(engine._tiers.disk_dir, ent.step)
+            shards = sorted(f for f in os.listdir(d)
+                            if f.endswith(".bin"))
+            path = os.path.join(d, shards[0])
+            size = os.path.getsize(path)
+            off = int(self.rng.randint(size))
+            with open(path, "r+b") as f:
+                f.seek(off)
+                b = f.read(1)
+                f.seek(off)
+                f.write(bytes([b[0] ^ 0xFF]))
+            where = f"disk shard {shards[0]}"
+        self.fired = True
+        self.log.append(f"step {step_idx}: flipped a byte in the "
+                        f"{where} of demoted page depth {ent.depth} "
+                        f"(key {key.hex()[:16]})")
+
+
+class DiskFullDemotion(ChaosInjector):
+    """Fail the disk tier's writes from step ``at_step`` on — the
+    'disk filled up mid-demotion' fault. Wraps the tier store's
+    ``_write_step`` seam with an ENOSPC raiser (``mode="torn"`` first
+    leaves a partial ``.tmp`` step directory behind, the torn-write
+    flavour — a later successful write must clear it, and the startup
+    wipe must survive it).
+
+    ``affected`` is EMPTY: a failed demotion degrades to plain
+    eviction, loudly (``tier_disk_errors`` counts, the entry is
+    dropped, the event lane records the failure) — every request must
+    still end in a terminal outcome with tokens bit-identical to a
+    fault-free run, because eviction-instead-of-demotion only costs
+    recompute, never correctness."""
+
+    name = "disk_full_demotion"
+
+    def __init__(self, at_step: int, mode: str = "enospc",
+                 seed: int = 0):
+        super().__init__(seed)
+        if mode not in ("enospc", "torn"):
+            raise MXNetError(f"disk-full mode {mode!r} not in "
+                             f"enospc|torn")
+        self.at_step = at_step
+        self.mode = mode
+        self.failed_writes = 0
+
+    def on_step(self, engine, step_idx):
+        if self.fired or step_idx < self.at_step:
+            return
+        if engine._tiers is None or engine._tiers.disk_dir is None:
+            raise MXNetError("DiskFullDemotion needs a tiered engine "
+                             "with a disk_dir")
+        self.fired = True
+        store = engine._tiers
+        inj = self
+
+        def _enospc(root, step, entries, **kw):
+            if inj.mode == "torn":
+                from ..checkpoint.manifest import step_dir
+                tmp = step_dir(root, step) + ".tmp"
+                os.makedirs(tmp, exist_ok=True)
+                with open(os.path.join(tmp, "shards_p0.bin"),
+                          "wb") as f:
+                    f.write(b"torn")
+            inj.failed_writes += 1
+            raise OSError(28, "No space left on device (chaos)")
+
+        store._write_step = _enospc
+        self.log.append(f"step {step_idx}: disk tier writes now fail "
+                        f"ENOSPC ({self.mode})")
 
 
 class PagePressure(ChaosInjector):

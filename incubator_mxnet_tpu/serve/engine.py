@@ -113,7 +113,7 @@ from ..ops.ragged_attention import (ragged_attention_reference,
 from .draft import make_ngram_drafter
 from .events import EventType, resolve_recorder, terminal_fields
 from .outcomes import Outcome
-from .paged_kv import (NULL_PAGE, PageAllocator, PrefixIndex,
+from .paged_kv import (NULL_PAGE, KVTierStore, PageAllocator, PrefixIndex,
                        init_kv_pools, kv_quant_spec, page_scales,
                        write_block_kv, write_block_kv_q,
                        write_prompt_kv, write_prompt_kv_q,
@@ -408,8 +408,8 @@ class InferenceEngine:
                  spec_k=0, draft_fn=None, draft_ngram=3,
                  spec_patience=2, spec_probe_every=64,
                  tier_policies=None, max_preemptions=4,
-                 brownout=None, kv_quant=None, recorder=None,
-                 component="engine"):
+                 brownout=None, kv_quant=None, kv_tiers=None,
+                 recorder=None, component="engine"):
         self.model = model
         self.num_slots = int(num_slots)
         self.page_size = int(page_size)
@@ -602,11 +602,43 @@ class InferenceEngine:
         self.prefix_flushes = 0
         self.prefix_reclaimed_pages = 0
         self.max_step_prefill_tokens = 0
+
+        # hierarchical cache tiers beneath the prefix index
+        # (docs/SERVING.md "Hierarchical prefix cache"): demote
+        # evicted-but-published pages to host DRAM (and DRAM overflow
+        # to disk), re-admit by copy instead of recomputing prefill.
+        # ``kv_tiers`` is a dict: {"dram_bytes": int, "disk_dir": str?,
+        # "disk_bytes": int?} — or None for the untiered engine.
+        self._tiers = None
+        if kv_tiers is not None:
+            if self._prefix is None:
+                raise MXNetError("kv_tiers requires prefix_cache=True "
+                                 "(tiers hold evicted PREFIX pages)")
+            cfg = dict(kv_tiers)
+            self._tiers = KVTierStore(
+                self.page_size, cfg.pop("dram_bytes"),
+                disk_dir=cfg.pop("disk_dir", None),
+                disk_bytes=cfg.pop("disk_bytes", None),
+                recorder=self.flight, component=self._component)
+            if cfg:
+                raise MXNetError(f"unknown kv_tiers keys: "
+                                 f"{sorted(cfg)}")
+        self.tier_demotions = 0          # pages captured HBM → DRAM
+        self.tier_promotions = 0         # pages re-admitted by copy
+        self.tier_hits = 0               # admissions a tier extended
+        self.tier_hit_tokens = 0         # prompt tokens served by tiers
+        self.tier_misses = 0             # tier consulted, nothing usable
+        self.tier_crc_fallbacks = 0      # integrity check → recompute
+        self.promote_trace_count = 0     # the one promotion program
+        self.demote_trace_count = 0      # the one page-gather program
+
         self._decode_step = jax.jit(self._decode_step_fn,
                                     donate_argnums=(1, 2))
         self._prefill_jits = {}          # bucket_pages -> jitted dense fn
         self._chunk_jits = {}            # bucket_pages -> jitted chunk fn
         self._copy_jit = None
+        self._promote_jit = None
+        self._gather_jit = None
 
     # ------------------------------------------------------------- #
     # traced programs
@@ -1095,6 +1127,79 @@ class InferenceEngine:
             for a in self._vamax:
                 a[dst] = a[src]
 
+    def _promote_page_fn(self, kpools, vpools, kpage, vpage, dst):
+        """Write one demoted page's payload (per-layer (H, ps, D)
+        host arrays, traced as data) into page ``dst`` of every pool —
+        the tier PROMOTION program. Like the COW copy it is jitted
+        once with donated pools and traced operands: re-admitting a
+        page from DRAM or disk is data movement, never a new program
+        and never a prefill recompute."""
+        self.promote_trace_count += 1        # trace-time only
+        new_k = tuple(p.at[dst].set(pg.astype(p.dtype))
+                      for p, pg in zip(kpools, kpage))
+        new_v = tuple(p.at[dst].set(pg.astype(p.dtype))
+                      for p, pg in zip(vpools, vpage))
+        return new_k, new_v
+
+    def _promote_page(self, k_payload, v_payload, kamax, vamax,
+                      dst: int):
+        if self._promote_jit is None:
+            self._promote_jit = jax.jit(self._promote_page_fn,
+                                        donate_argnums=(0, 1))
+        self._kpools, self._vpools = self._promote_jit(
+            self._kpools, self._vpools, tuple(k_payload),
+            tuple(v_payload), np.int32(dst))
+        if self._kv_spec is not None:
+            # scale metadata rides back with the codes: the payload
+            # was captured at demotion with exactly these amaxes
+            for l, a in enumerate(self._kamax):
+                a[dst] = kamax[l]
+            for l, a in enumerate(self._vamax):
+                a[dst] = vamax[l]
+
+    def _gather_page_fn(self, kpools, vpools, page):
+        """Demotion capture: slice one page out of EVERY pool in one
+        program call. Naively ``np.asarray(pool[page])`` per layer
+        costs 2L separate dispatches per demoted page — on a small
+        host that overhead alone made re-admission-by-copy slower
+        than the recompute it replaces. One program, traced once
+        (``page`` is a traced scalar), then a single device_get."""
+        self.demote_trace_count += 1         # trace-time only
+        return (tuple(p[page] for p in kpools),
+                tuple(p[page] for p in vpools))
+
+    def _demote_entry(self, key: bytes, ent) -> None:
+        """Capture an evicted-but-published page's payload into the
+        cache tiers BEFORE its page returns to the free list (the
+        ``demote`` callback threaded through PrefixIndex.reclaim).
+        For quantized pools the payload is the page's int8/fp8 codes
+        plus its per-layer amax — the 4x-denser at-rest form; for
+        unquantized pools the raw-dtype page."""
+        page = ent.page
+        if self._gather_jit is None:
+            self._gather_jit = jax.jit(self._gather_page_fn)
+        k_payload, v_payload = jax.device_get(
+            self._gather_jit(self._kpools, self._vpools,
+                             np.int32(page)))
+        kamax = vamax = None
+        if self._kv_spec is not None:
+            kamax = np.asarray([a[page] for a in self._kamax],
+                               np.float32)
+            vamax = np.asarray([a[page] for a in self._vamax],
+                               np.float32)
+        if self._tiers.put(key, ent.tokens, ent.depth, k_payload,
+                           v_payload, kamax, vamax):
+            self.tier_demotions += 1
+            self.flight.emit(self._component, EventType.CACHE_DEMOTE,
+                             entity=f"tier:{key.hex()[:16]}",
+                             tier="dram", depth=ent.depth)
+
+    def _reclaim_prefix(self, n: int) -> int:
+        """Reclaim ``n`` pages from the prefix index, demoting every
+        victim's payload into the cache tiers when they are on."""
+        demote = self._demote_entry if self._tiers is not None else None
+        return self._prefix.reclaim(n, self._alloc, demote)
+
     def _reset_page_amax(self, pages):
         """Zero the scale metadata of freshly-allocated pages (host-
         side np — the arrays are host-owned between program calls).
@@ -1298,6 +1403,25 @@ class InferenceEngine:
             "prefix_hits": self.prefix_hits,
             "prefix_lookups": self.prefix_lookups,
             "prefix_hit_tokens": self.prefix_hit_tokens,
+            # hierarchical cache tiers (docs/SERVING.md "Hierarchical
+            # prefix cache"): per-tier resident bytes plus the
+            # demotion/promotion/fallback counters — all zeros when
+            # tiers are off, so scrapers need no feature probe
+            "kv_tier_bytes": (self._tiers.tier_bytes()
+                              if self._tiers is not None
+                              else {"dram": 0, "disk": 0}),
+            "tier_demotions": self.tier_demotions,
+            "tier_disk_demotions": (self._tiers.disk_demotions
+                                    if self._tiers is not None else 0),
+            "tier_promotions": self.tier_promotions,
+            "tier_hits": self.tier_hits,
+            "tier_hit_tokens": self.tier_hit_tokens,
+            "tier_misses": self.tier_misses,
+            "tier_crc_fallbacks": self.tier_crc_fallbacks,
+            "tier_disk_errors": (self._tiers.disk_errors
+                                 if self._tiers is not None else 0),
+            "tier_dropped": (self._tiers.dropped
+                             if self._tiers is not None else 0),
             "stop_hits": self.stop_hits,
             "constrained_requests": self.constrained_requests,
             "preemptions": self.preemptions,
@@ -1320,6 +1444,24 @@ class InferenceEngine:
         if self._prefix is None:
             return 0
         return int(self._prefix.probe(prompt_ids))
+
+    def tier_probe(self, prompt_ids) -> int:
+        """READ-ONLY twin of ``prefix_probe`` for the cache tiers: how
+        many leading tokens the engine could serve counting HBM PLUS
+        the pages its lower tiers would re-admit by copy. Side-effect
+        free like ``prefix_probe`` (no LRU ticks in any tier) — the
+        router's SECOND affinity axis. Equals ``prefix_probe`` when
+        tiers are off."""
+        if self._prefix is None:
+            return 0
+        shared, _, cached_len = self._prefix.match(prompt_ids,
+                                                   mutate=False)
+        if self._tiers is None:
+            return int(cached_len)
+        n = self._tiers.probe(prompt_ids, len(shared))
+        if n == 0:
+            return int(cached_len)
+        return (len(shared) + n) * self.page_size
 
     def can_serve(self, total_positions: int) -> bool:
         """Could a request spanning ``total_positions`` (prompt +
@@ -1547,6 +1689,10 @@ class InferenceEngine:
         if self._prefix is not None and len(self._prefix):
             self._prefix.flush(self._alloc)
             self.prefix_flushes += 1
+        if self._tiers is not None and len(self._tiers):
+            # demoted payloads were captured from the same poisoned
+            # cache lineage — quarantine drops them with the index
+            self._tiers.flush()
 
     def _expire_queue(self):
         """Host-side deadline enforcement for QUEUED requests: a
@@ -1744,6 +1890,24 @@ class InferenceEngine:
             if partial is not None:
                 self._alloc.incref(partial[0])
 
+        tier_chain = []
+        if self._tiers is not None:
+            # continue the radix walk through the lower tiers from the
+            # page where HBM stopped. A chain supersedes a boundary
+            # partial hit: the tiers hold the FULL page the partial is
+            # a prefix of, and promotion is cheaper than COW + suffix
+            # recompute of the same tokens.
+            tier_chain = self._tiers.match_chain(ids, len(shared))
+            if tier_chain:
+                if partial is not None:
+                    self._alloc.decref(partial[0])
+                    partial = None
+                    cached_len = len(shared) * self.page_size
+                # pin the chain: THIS admission's reclaim demotes pages
+                # into the same store and must not spill or drop what
+                # it is about to promote
+                self._tiers.pin(tier_chain)
+
         def _budget():
             n_new = need - len(shared)   # pages the free list owes
             avail = self._alloc.free_count - self._lazy_debt
@@ -1780,10 +1944,12 @@ class InferenceEngine:
                 self._alloc.decref(p)
             if partial is not None:
                 self._alloc.decref(partial[0])
+            if tier_chain:
+                self._tiers.unpin(tier_chain)
             return False
         if avail < n_new:
             self.prefix_reclaimed_pages += \
-                self._prefix.reclaim(n_new - avail, self._alloc)
+                self._reclaim_prefix(n_new - avail)
         if cached_len:
             self.prefix_hits += 1
             self.prefix_hit_tokens += cached_len
@@ -1795,6 +1961,50 @@ class InferenceEngine:
         row = np.zeros((self.max_pages,), np.int32)
         row[:len(shared)] = shared
         row[len(shared):prompt_pages] = priv
+
+        promoted = 0
+        if tier_chain:
+            # re-admit the chain BY COPY into the freshly allocated
+            # pages: host-side data movement through the one jitted
+            # promotion program — never a prefill recompute. A failed
+            # integrity check truncates the chain there and falls back
+            # to recomputing the rest, loudly.
+            for key, ent in tier_chain:
+                dst = int(priv[promoted])
+                src_tier = ent.tier
+                payload = self._tiers.load(key, ent)
+                if payload is None:
+                    self.tier_crc_fallbacks += 1
+                    self.flight.emit(
+                        self._component, EventType.CACHE_TIER_MISS,
+                        request_id=req.request_id, reason="integrity",
+                        tier=src_tier, depth=ent.depth)
+                    break
+                self._promote_page(*payload, dst)
+                self._tiers.remove(key, ent)
+                promoted += 1
+                self.tier_promotions += 1
+                self.flight.emit(
+                    self._component, EventType.CACHE_PROMOTE,
+                    request_id=req.request_id, tier=src_tier,
+                    depth=ent.depth, page=dst)
+            self._tiers.unpin(tier_chain)
+            if promoted:
+                cached_len = (len(shared) + promoted) * self.page_size
+                self.tier_hits += 1
+                self.tier_hit_tokens += promoted * self.page_size
+                # promoted pages are published back into the HBM index
+                # IMMEDIATELY (refcount slot + index, exactly as if
+                # never evicted) so sibling requests share them without
+                # waiting for this slot's prefill to finish
+                self._prefix.insert(ids[:cached_len], row, self._alloc)
+        elif self._tiers is not None \
+                and (t0 - 1) // self.page_size > len(shared):
+            # tiers consulted, nothing usable, and at least one full
+            # page of this prompt was demotable — a true tier miss
+            self.tier_misses += 1
+            self.flight.emit(self._component, EventType.CACHE_TIER_MISS,
+                             request_id=req.request_id, reason="absent")
         # per-request RNG key: pinned by Request.seed (reproducible
         # across engines/occupancy), engine-split otherwise — and
         # REMEMBERED on the request, so a preemption resume keeps the
@@ -2161,7 +2371,7 @@ class InferenceEngine:
                 if self._alloc.free_count == 0 and \
                         self._prefix is not None:
                     self.prefix_reclaimed_pages += \
-                        self._prefix.reclaim(1, self._alloc)
+                        self._reclaim_prefix(1)
                 if self._alloc.free_count == 0:
                     if pi == first_pi:
                         slot.stall_count += 1
@@ -2384,7 +2594,10 @@ class InferenceEngine:
         refcount equals exactly the number of slot mappings plus index
         entries that hold it. Raises MXNetError on any leak (page
         unreachable but not free) or double grant (page free AND
-        referenced, or granted twice)."""
+        referenced, or granted twice). With cache tiers on, the third
+        state — demoted — is audited too: a demoted entry is payload
+        WITHOUT a page id (structurally disjoint from free and live),
+        and the tier store's own byte/shape accounting must balance."""
         expect = [0] * self.num_pages
         for slot in self._slots:
             if slot is None:
@@ -2414,6 +2627,12 @@ class InferenceEngine:
                 state = "free AND referenced (double grant)" if rc > 0 \
                     else "neither free nor referenced (leak)"
                 raise MXNetError(f"page audit: page {p} is {state}")
+        if self._tiers is not None:
+            # demoted entries hold PAYLOADS, never page ids, so the
+            # page-level invariant above cannot see them; the tier
+            # store audits its own accounting (free XOR live XOR
+            # demoted — "demoted" lives entirely below this line)
+            self._tiers.audit()
 
     # ------------------------------------------------------------- #
     # elastic checkpointing / warm restart (checkpoint/ subsystem)
@@ -2473,6 +2692,10 @@ class InferenceEngine:
             # the old weights must never be matched again
             self._prefix.flush(self._alloc)
             self.prefix_flushes += 1
+        if self._tiers is not None:
+            # same contract one level down: DRAM/disk payloads were
+            # captured under the old weights — ALL tiers flush
+            self._tiers.flush()
         self.warm_restarts += 1
 
     def save_checkpoint(self, manager, step=None, block=False) -> int:

@@ -64,6 +64,18 @@ _ENGINE_COUNTERS = [
     ("preemptions", "preemptions_total"),
     ("brownout_escalations", "brownout_escalations_total"),
     ("brownout_deescalations", "brownout_deescalations_total"),
+    # hierarchical prefix-cache tiers (docs/SERVING.md): demotion /
+    # promotion traffic and the integrity-fallback counter — all zero
+    # (but present) on an untiered engine
+    ("tier_demotions", "kv_tier_demotions_total"),
+    ("tier_disk_demotions", "kv_tier_disk_demotions_total"),
+    ("tier_promotions", "kv_tier_promotions_total"),
+    ("tier_hits", "kv_tier_hits_total"),
+    ("tier_hit_tokens", "kv_tier_hit_tokens_total"),
+    ("tier_misses", "kv_tier_misses_total"),
+    ("tier_crc_fallbacks", "kv_tier_crc_fallbacks_total"),
+    ("tier_disk_errors", "kv_tier_disk_errors_total"),
+    ("tier_dropped", "kv_tier_dropped_total"),
 ]
 _ROUTER_COUNTERS = [
     ("requeues", "requeues_total"),
@@ -72,6 +84,7 @@ _ROUTER_COUNTERS = [
     ("probes", "probes_total"),
     ("recoveries", "recoveries_total"),
     ("affinity_routed", "affinity_routed_total"),
+    ("tier_affinity_routed", "tier_affinity_routed_total"),
     ("spill_routed", "spill_routed_total"),
 ]
 
@@ -199,6 +212,11 @@ def _emit_engine(w: _Writer, snap: dict, ns: str = _NS,
         if key in snap:
             w.add(f"{ns}_{suffix}", mtype, snap[key],
                   _labels(**extra))
+    # per-tier resident bytes of the hierarchical prefix cache: one
+    # gauge, ``tier`` label ("dram"/"disk") — bounded label space
+    for tier, nbytes in sorted(snap.get("kv_tier_bytes", {}).items()):
+        w.add(f"{ns}_kv_tier_bytes", "gauge", nbytes,
+              _labels(tier=tier, **extra))
     for key, suffix in _ENGINE_COUNTERS:
         if key in snap:
             w.add(f"{ns}_{suffix}", "counter", snap[key],

@@ -239,6 +239,7 @@ class Router:
         self.probes = 0
         self.recoveries = 0
         self.affinity_routed = 0
+        self.tier_affinity_routed = 0    # won on the lower-tier axis
         self.spill_routed = 0
         self.log: List[str] = []
 
@@ -489,13 +490,24 @@ class Router:
             return None
         if self.affinity:
             prompt = self._attempt_prompt(tracked)
-            best, best_len = None, 0
+            # two-axis affinity: HBM-resident prefix length first,
+            # then what a replica's lower cache tiers could re-admit
+            # by copy (engine.tier_probe — equals prefix_probe when
+            # tiers are off, so an untiered fleet routes exactly as
+            # before). A replica holding the prefix only in DRAM/disk
+            # still beats a cold spill: promotion is a page copy,
+            # recompute is a full prefill.
+            best, best_key = None, (0, 0)
             for r, _ in cands:
-                n = r.engine.prefix_probe(prompt)
-                if n > best_len:
-                    best, best_len = r, n
+                key = (r.engine.prefix_probe(prompt),
+                       r.engine.tier_probe(prompt))
+                if key > best_key:
+                    best, best_key = r, key
             if best is not None:
-                self.affinity_routed += 1
+                if best_key[0] > 0:
+                    self.affinity_routed += 1
+                else:
+                    self.tier_affinity_routed += 1
                 return best
             # spill: least estimated delay, then shortest backlog —
             # occupancy derived from the pass view's free_slots so
@@ -1157,6 +1169,7 @@ class Router:
             "probes": self.probes,
             "recoveries": self.recoveries,
             "affinity_routed": self.affinity_routed,
+            "tier_affinity_routed": self.tier_affinity_routed,
             "spill_routed": self.spill_routed,
             # CLIENT-level latency histograms (the SLO percentiles a
             # dashboard should alert on — per-replica attempt

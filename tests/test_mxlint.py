@@ -335,6 +335,62 @@ def test_page_pass_clean_fixture(tmp_path):
                              rule="page-refcount")) == []
 
 
+BAD_TIER = {
+    "incubator_mxnet_tpu/serve/badtier.py": """
+        class Sidecar:
+            def peek(self, store):
+                return len(store._entries)
+
+            def shrink(self, store):
+                store._dram_used -= 4096
+
+
+        class KVTierStore:
+            def promote(self, key, ent):
+                # a demoted page has no refcount: the store must not
+                # hand out (or free) HBM pages itself
+                page = self._alloc.alloc()
+                self._alloc.free(page)
+                return page
+    """,
+}
+
+CLEAN_TIER = {
+    "incubator_mxnet_tpu/serve/goodtier.py": """
+        class KVTierStore:
+            def __init__(self):
+                self._entries = {}
+                self._dram_used = 0
+
+            def entries(self):
+                for key, bucket in self._entries.items():
+                    for ent in bucket:
+                        yield key, ent
+
+
+        class Sidecar:
+            def peek(self, store):
+                return sum(1 for _ in store.entries())
+    """,
+}
+
+
+def test_page_pass_tier_internals_and_alloc_in_store(tmp_path):
+    active = _active(_findings(tmp_path, BAD_TIER,
+                               rule="page-refcount"))
+    msgs = "\n".join(f.message for f in active)
+    assert msgs.count("outside KVTierStore") == 2
+    assert msgs.count("inside KVTierStore") == 2
+    # the unpaired-alloc check must NOT double-fire here: alloc and
+    # free are paired inside the class scope
+    assert "silent pool leak" not in msgs
+
+
+def test_page_pass_tier_clean_fixture(tmp_path):
+    assert _active(_findings(tmp_path, CLEAN_TIER,
+                             rule="page-refcount")) == []
+
+
 def test_page_pass_null_page_and_rc_internals(tmp_path):
     files = {"incubator_mxnet_tpu/serve/nullpage.py": """
         NULL_PAGE = 0
@@ -714,6 +770,12 @@ _INJECTIONS = {
     "page-refcount": (
         "incubator_mxnet_tpu/serve/injected_pages.py",
         BAD_PAGES["incubator_mxnet_tpu/serve/badpages.py"]),
+    # second page-refcount injection: the round-19 tier rules (a
+    # sidecar poking demoted-page bookkeeping + a tier store that
+    # allocs/frees HBM pages)
+    "page-refcount#tiers": (
+        "incubator_mxnet_tpu/serve/injected_tier.py",
+        BAD_TIER["incubator_mxnet_tpu/serve/badtier.py"]),
     "host-sync": (
         "incubator_mxnet_tpu/serve/router.py",
         """

@@ -759,6 +759,47 @@ def test_metrics_engine_golden_quantized(model):
     assert ratio > 3.0                   # f32 → int8 + scale metadata
 
 
+def test_metrics_engine_golden_hier_tiers(model, tmp_path):
+    """The hierarchical-cache surface: ``kv_tier_bytes{tier=...}``
+    gauges plus the demote/promote/hit/fallback counter family parse
+    back to the snapshot of an engine whose tiers actually cycled."""
+    rng = np.random.RandomState(17)
+    eng = InferenceEngine(model, num_slots=1, page_size=8, max_len=64,
+                          num_pages=7, prefix_cache=True,
+                          kv_tiers={"dram_bytes": 128 << 10,
+                                    "disk_dir": str(tmp_path)})
+    head = _prompt(rng, 24)
+    heads = [head] + [_prompt(rng, 24) for _ in range(4)]
+    for p in [0, 1, 2, 0, 1, 2, 3, 4, 0]:
+        reqs = [Request(np.concatenate([heads[p], _prompt(rng, 5)]),
+                        max_new_tokens=3)]
+        eng.submit(reqs[0])
+        _drain(eng, reqs)
+    assert eng.tier_demotions > 0 and eng.tier_promotions > 0
+    snap = eng.health_snapshot()
+    typed, samples = _golden_parse(render_metrics(snap))
+    by = {}
+    for name, labels, v in samples:
+        by.setdefault(name, {})[labels] = v
+    assert typed["mxtpu_serve_kv_tier_bytes"] == "gauge"
+    for tier in ("dram", "disk"):
+        assert by["mxtpu_serve_kv_tier_bytes"][f'{{tier="{tier}"}}'] \
+            == snap["kv_tier_bytes"][tier]
+    for key, metric in (
+            ("tier_demotions", "kv_tier_demotions_total"),
+            ("tier_disk_demotions", "kv_tier_disk_demotions_total"),
+            ("tier_promotions", "kv_tier_promotions_total"),
+            ("tier_hits", "kv_tier_hits_total"),
+            ("tier_hit_tokens", "kv_tier_hit_tokens_total"),
+            ("tier_misses", "kv_tier_misses_total"),
+            ("tier_crc_fallbacks", "kv_tier_crc_fallbacks_total"),
+            ("tier_disk_errors", "kv_tier_disk_errors_total"),
+            ("tier_dropped", "kv_tier_dropped_total")):
+        assert typed[f"mxtpu_serve_{metric}"] == "counter"
+        assert by[f"mxtpu_serve_{metric}"][""] == snap[key], metric
+    eng.audit_pages()
+
+
 def test_metrics_router_golden(model):
     rt = build_fleet(model, 2, engine_kw=dict(num_slots=1, page_size=8,
                                               max_len=64))

@@ -780,6 +780,200 @@ def run_tier_scenarios(n_requests, errors):
 
 
 # --------------------------------------------------------------------- #
+# hierarchical KV-cache tier scenarios (serve/paged_kv.KVTierStore —
+# ci/run.sh hiersmoke stage)
+# --------------------------------------------------------------------- #
+
+def _make_hier_requests(n, vocab, seed, n_personas=4, max_len=128):
+    """Persona-family greedy workload for the cache tiers: every
+    request extends one of ``n_personas`` shared 24-token (3-page)
+    prefixes, so published prefix pages churn through LRU reclaim —
+    and with tiers on, through demotion and re-admission by copy."""
+    import numpy as np
+    from incubator_mxnet_tpu.serve import Request
+    rng = np.random.RandomState(seed)
+    personas = [rng.randint(0, vocab, size=(24,)).astype(np.int32)
+                for _ in range(n_personas)]
+    reqs = []
+    for i in range(n):
+        p = personas[i % n_personas]
+        tail = rng.randint(0, vocab, size=(3 + i % 5,)).astype(np.int32)
+        reqs.append(Request(np.concatenate([p, tail]),
+                            max_new_tokens=4 + i % 4))
+    return reqs
+
+
+def _hier_engine(model, tiers_dir, dram_bytes=1 << 20, disk=True,
+                 **kw):
+    """Reclaim-forcing tiered engine: the page pool holds fewer pages
+    than the persona corpus publishes, so every scenario exercises
+    demote-on-reclaim and promote-on-hit, not just the happy path."""
+    kv_tiers = {"dram_bytes": int(dram_bytes)}
+    if disk:
+        kv_tiers["disk_dir"] = tiers_dir
+    cfg = dict(num_slots=2, num_pages=12, kv_tiers=kv_tiers)
+    cfg.update(kw)
+    return _engine(model, **cfg)
+
+
+def run_hier_scenarios(n_requests, errors):
+    """Hierarchical-cache chaos: corrupt demoted payloads (DRAM and
+    disk), disk-full mid-demotion, and a kill-mid-promotion restart.
+    The load-bearing invariant everywhere: ``affected`` is EMPTY —
+    crc catches corruption and the engine recomputes, disk failure
+    degrades to plain eviction — so EVERY request must end in exactly
+    one terminal outcome with tokens bit-identical to a fault-free
+    run, pages (and tier bytes) audited after every step, and the
+    promotion program compiled at most once."""
+    import shutil
+    import tempfile
+    import numpy as np
+    from incubator_mxnet_tpu.serve.chaos import (CorruptDemotedPage,
+                                                 DiskFullDemotion,
+                                                 run_chaos)
+    results = {}
+    vocab = 64
+    root = tempfile.mkdtemp(prefix="hier_chaos_")
+
+    def hier_stats(tag, eng, reqs, baseline, affected):
+        stats = _check_invariants(tag, eng, reqs, baseline, affected,
+                                  errors, allow_non_ok=False)
+        if eng.promote_trace_count > 1:
+            errors.append(f"{tag}: promotion program retraced "
+                          f"({eng.promote_trace_count})")
+        stats.update(tier_demotions=eng.tier_demotions,
+                     tier_promotions=eng.tier_promotions,
+                     tier_crc_fallbacks=eng.tier_crc_fallbacks,
+                     tier_disk_errors=(eng._tiers.disk_errors
+                                       if eng._tiers is not None else 0),
+                     promote_trace_count=eng.promote_trace_count)
+        return stats
+
+    # ---- fault-free tiered baseline ------------------------------- #
+    model = _build_model()
+    eng = _hier_engine(model, os.path.join(root, "base"))
+    reqs = _make_hier_requests(n_requests, vocab, seed=42)
+    run_chaos(eng, reqs, [], audit_every_step=True)
+    baseline = [list(r.token_ids) for r in reqs]
+    stats = hier_stats("hier_baseline", eng, reqs, baseline, set())
+    if eng.tier_demotions == 0 or eng.tier_promotions == 0:
+        errors.append(
+            f"hier_baseline: pool not reclaim-forcing (demotions "
+            f"{eng.tier_demotions}, promotions {eng.tier_promotions}) "
+            f"— the scenarios are not exercising the tiers")
+    # the promotion-parity oracle: the SAME workload on an untiered
+    # engine must emit identical tokens (re-admission by copy is
+    # invisible to every request)
+    model = _build_model()
+    eng0 = _engine(model, num_slots=2, num_pages=12)
+    reqs0 = _make_hier_requests(n_requests, vocab, seed=42)
+    run_chaos(eng0, reqs0, [], audit_every_step=True)
+    for i, (a, b) in enumerate(zip(reqs, reqs0)):
+        if list(a.token_ids) != list(b.token_ids):
+            errors.append(f"hier_baseline: request {i} diverged from "
+                          f"the untiered run (promotion parity broken)")
+            break
+    results["hier_baseline"] = stats
+
+    # ---- corrupt a demoted DRAM payload --------------------------- #
+    model = _build_model()
+    eng = _hier_engine(model, os.path.join(root, "dram"))
+    reqs = _make_hier_requests(n_requests, vocab, seed=42)
+    inj = CorruptDemotedPage(at_step=4, tier="dram", seed=3)
+    run_chaos(eng, reqs, [inj], audit_every_step=True)
+    stats = hier_stats("corrupt_demoted_dram", eng, reqs, baseline,
+                       inj.affected)
+    if not inj.fired:
+        errors.append("corrupt_demoted_dram: injector never fired")
+    if eng.tier_crc_fallbacks == 0:
+        errors.append("corrupt_demoted_dram: corruption never caught "
+                      "(no crc fallback — either the corrupted entry "
+                      "was never re-matched or the check is broken)")
+    stats["log"] = inj.log
+    results["corrupt_demoted_dram"] = stats
+
+    # ---- corrupt a demoted DISK shard ----------------------------- #
+    model = _build_model()
+    # dram_bytes=0: every demotion spills straight to the disk tier
+    eng = _hier_engine(model, os.path.join(root, "disk"), dram_bytes=0)
+    reqs = _make_hier_requests(n_requests, vocab, seed=42)
+    inj = CorruptDemotedPage(at_step=4, tier="disk", seed=3)
+    run_chaos(eng, reqs, [inj], audit_every_step=True)
+    stats = hier_stats("corrupt_demoted_disk", eng, reqs, baseline,
+                       inj.affected)
+    if not inj.fired:
+        errors.append("corrupt_demoted_disk: injector never fired")
+    if eng.tier_crc_fallbacks == 0:
+        errors.append("corrupt_demoted_disk: corruption never caught")
+    stats["log"] = inj.log
+    results["corrupt_demoted_disk"] = stats
+
+    # ---- disk full mid-demotion ----------------------------------- #
+    model = _build_model()
+    eng = _hier_engine(model, os.path.join(root, "full"), dram_bytes=0)
+    reqs = _make_hier_requests(n_requests, vocab, seed=42)
+    inj = DiskFullDemotion(at_step=4, mode="torn", seed=3)
+    run_chaos(eng, reqs, [inj], audit_every_step=True)
+    stats = hier_stats("disk_full_demotion", eng, reqs, baseline,
+                       inj.affected)
+    if not inj.fired:
+        errors.append("disk_full_demotion: injector never fired")
+    if eng._tiers.disk_errors == 0:
+        errors.append("disk_full_demotion: no disk write ever failed "
+                      "— the fault did not land")
+    stats["failed_writes"] = inj.failed_writes
+    stats["log"] = inj.log
+    results["disk_full_demotion"] = stats
+
+    # ---- kill mid-promotion, restart on the same disk_dir --------- #
+    # A process death between a tier hit and its promotion (or mid-
+    # demotion) leaves committed-but-orphaned step dirs and .tmp
+    # residue on disk. Tier contents are process-lifetime: the
+    # REPLACEMENT engine must wipe them at construction and serve the
+    # whole workload correctly from scratch.
+    kill_dir = os.path.join(root, "kill")
+    model = _build_model()
+    eng = _hier_engine(model, kill_dir, dram_bytes=0)
+    reqs = _make_hier_requests(n_requests, vocab, seed=42)
+
+    class _Killed(Exception):
+        pass
+
+    def _kill(e, i):
+        # die only once demotions have landed shards on disk
+        if e._tiers.disk_demotions > 0 and i >= 6:
+            raise _Killed()
+
+    try:
+        eng.run(reqs, before_step=_kill, poll_sleep=1e-4)
+        errors.append("kill_mid_promotion: the kill never fired "
+                      "(no disk demotion happened in 6+ steps)")
+    except _Killed:
+        pass
+    leftover = [n_ for n_ in os.listdir(kill_dir)
+                if os.path.isdir(os.path.join(kill_dir, n_))]
+    if not leftover:
+        errors.append("kill_mid_promotion: the kill left no disk "
+                      "residue — the restart wipe is untested")
+    model = _build_model()
+    eng2 = _hier_engine(model, kill_dir, dram_bytes=0)
+    stale = [n_ for n_ in os.listdir(kill_dir)
+             if os.path.isdir(os.path.join(kill_dir, n_))]
+    if stale:
+        errors.append(f"kill_mid_promotion: replacement engine kept "
+                      f"stale tier dirs {stale}")
+    reqs2 = _make_hier_requests(n_requests, vocab, seed=42)
+    run_chaos(eng2, reqs2, [], audit_every_step=True)
+    stats = hier_stats("kill_mid_promotion", eng2, reqs2, baseline,
+                       set())
+    stats["stale_dirs_at_kill"] = len(leftover)
+    results["kill_mid_promotion"] = stats
+
+    shutil.rmtree(root, ignore_errors=True)
+    return results
+
+
+# --------------------------------------------------------------------- #
 # fleet scenarios (serve/router.py — ci/run.sh fleetsmoke stage)
 # --------------------------------------------------------------------- #
 
@@ -1477,6 +1671,11 @@ def main():
                          "slow-reader backpressure against a live "
                          "ServeFrontend (ci/run.sh frontsmoke's chaos "
                          "sibling)")
+    ap.add_argument("--hier", action="store_true",
+                    help="hierarchical KV-cache tier scenarios — "
+                         "corrupt demoted page (DRAM + disk shard), "
+                         "disk-full mid-demotion, kill-mid-promotion "
+                         "restart (ci/run.sh hiersmoke)")
     ap.add_argument("--replicas", type=int, default=2,
                     help="fleet size for --fleet scenarios")
     ap.add_argument("--spec-k", type=int, default=_SPEC_K,
@@ -1497,6 +1696,8 @@ def main():
     t0 = time.perf_counter()
     if args.frontend:
         results = run_frontend_scenarios(n, errors)
+    elif args.hier:
+        results = run_hier_scenarios(n, errors)
     elif args.tiers:
         results = run_tier_scenarios(n, errors)
     elif args.fleet:
@@ -1519,8 +1720,9 @@ def main():
         print(f"banked {args.json}")
     if not errors:
         scope = "frontend" if args.frontend else \
-            ("tiers" if args.tiers else
-             ("fleet" if args.fleet else "chaos"))
+            ("hier" if args.hier else
+             ("tiers" if args.tiers else
+              ("fleet" if args.fleet else "chaos")))
         print(f"{scope}: all scenarios quiescent, isolated, audited, "
               f"compile-clean")
     sys.exit(0 if not errors else 1)

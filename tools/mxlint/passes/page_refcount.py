@@ -15,7 +15,16 @@ Checks:
     the owning component;
   - literal page 0 (or ``NULL_PAGE``) passed to ``alloc``-family calls;
   - refcount internals (``._rc`` / ``._free``) touched outside
-    ``PageAllocator``.
+    ``PageAllocator``;
+  - tier-store internals (``._entries`` / ``._dram_used`` /
+    ``._disk_used``) touched outside ``KVTierStore`` — demoted-page
+    bookkeeping belongs to the store (readers go through
+    ``entries()`` / ``tier_bytes()``);
+  - allocator-mutation calls lexically inside ``KVTierStore`` — a
+    demoted page has NO page number and no refcount (free XOR live
+    XOR demoted); a tier store that allocs or frees HBM pages is
+    conflating the tiers, and freeing a "demoted page" corrupts the
+    free list.
 """
 
 from __future__ import annotations
@@ -32,6 +41,8 @@ _SCOPE = "incubator_mxnet_tpu/serve/"
 _ACQUIRE = {"alloc", "incref"}
 _RELEASE = {"decref", "free", "release_held"}
 _INTERNAL = {"_rc", "_free"}
+_TIER_INTERNAL = {"_entries", "_dram_used", "_disk_used"}
+_ALLOC_MUTATORS = _ACQUIRE | _RELEASE
 
 
 def _calls_in(node: ast.AST):
@@ -79,6 +90,16 @@ class PageRefcountPass:
                         f = self._check_pairing(node, unit)
                         if f is not None:
                             out.append(f)
+                    if attr in _ALLOC_MUTATORS \
+                            and self._inside(node, "KVTierStore"):
+                        out.append(Finding(
+                            RULE, unit.path, node.lineno,
+                            f"`.{attr}()` inside KVTierStore — a "
+                            f"demoted page has no page number and no "
+                            f"refcount (free XOR live XOR demoted); "
+                            f"the tier store must never touch the "
+                            f"HBM allocator",
+                            symbol=qualname_of(node)))
                 elif isinstance(node, ast.Attribute) \
                         and node.attr in _INTERNAL \
                         and isinstance(node.value, ast.Name) \
@@ -90,13 +111,26 @@ class PageRefcountPass:
                             f"touched outside PageAllocator — refcount "
                             f"arithmetic belongs to the allocator",
                             symbol=qualname_of(node)))
+                elif isinstance(node, ast.Attribute) \
+                        and node.attr in _TIER_INTERNAL:
+                    if not self._inside(node, "KVTierStore"):
+                        out.append(Finding(
+                            RULE, unit.path, node.lineno,
+                            f"tier-store internals `.{node.attr}` "
+                            f"touched outside KVTierStore — demoted-"
+                            f"page bookkeeping belongs to the store "
+                            f"(read via entries()/tier_bytes())",
+                            symbol=qualname_of(node)))
         return out
 
     @staticmethod
-    def _inside_allocator(node: ast.AST) -> bool:
-        return any(isinstance(s, ast.ClassDef)
-                   and s.name == "PageAllocator"
+    def _inside(node: ast.AST, cls: str) -> bool:
+        return any(isinstance(s, ast.ClassDef) and s.name == cls
                    for s in enclosing_scopes(node))
+
+    @classmethod
+    def _inside_allocator(cls, node: ast.AST) -> bool:
+        return cls._inside(node, "PageAllocator")
 
     def _check_pairing(self, call: ast.Call,
                        unit) -> Optional[Finding]:

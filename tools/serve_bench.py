@@ -1831,6 +1831,214 @@ def bench_constrained_decoding(model, *, n_requests, spec_k, slots,
     }
 
 
+# --------------------------------------------------------------------- #
+# round-19: hierarchical KV cache (--hier, banks BENCH_HIER.json)
+# --------------------------------------------------------------------- #
+
+def _hier_personas(personas, prefix_len, vocab, seed=7):
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, vocab, size=(prefix_len,)).astype(np.int32)
+            for _ in range(personas)]
+
+
+def _hier_visit(eng, head, suffix_len, max_new, vocab, srng, audit):
+    """One warm-repeat visit: persona head + fresh suffix, served
+    SOLO (slots=1 workload) so TTFT is pure admission cost — queue
+    wait never pollutes the recompute-vs-copy comparison."""
+    import numpy as np
+    from incubator_mxnet_tpu.serve import Request
+    tail = srng.randint(0, vocab, size=(suffix_len,)).astype(np.int32)
+    req = Request(np.concatenate([head, tail]), max_new_tokens=max_new)
+    eng.run([req], poll_sleep=1e-4)
+    if audit:
+        eng.audit_pages()
+    ttft = req.token_stamps[0] - req.submit_time
+    return req, ttft
+
+
+def bench_hier_cache(model, *, smoke, errors, personas, prefix_pages,
+                     suffix_len, max_new, num_pages, page_size,
+                     dram_bytes, repeats):
+    """Hierarchical prefix cache vs flat prefix cache under HBM
+    pressure. The persona corpus is sized WAY over the page pool
+    (>= 4x), so every warm repeat finds its prefix evicted from HBM:
+    the flat arm recomputes prefill, the tiered arm re-admits by copy
+    from host DRAM (overflow: disk). Both arms run the SAME personas,
+    suffixes and visit order — greedy decoding, so the token streams
+    must be bit-identical (a tier that changes even one token is a
+    correctness bug, not a perf lever).
+
+    Protocol per arm: an untimed populate round (visit every persona
+    once — compiles every program incl. the one promotion program and
+    fills the tiers), one untimed warm-repeat round (compiles the
+    re-admission path), then ``repeats`` timed warm-repeat rounds.
+    ``warm_ttft_p50_ms`` is the per-visit submit->first-token time;
+    ``ttft_speedup`` = flat p50 / hier p50. ``lower_tier_hit_rate``
+    counts only tokens re-admitted FROM A TIER (HBM index hits do not
+    count) over the prefix tokens offered in the timed window."""
+    import shutil
+    import tempfile
+    import numpy as np
+    from incubator_mxnet_tpu.serve import InferenceEngine
+    vocab = model.vocab_size
+    prefix_len = prefix_pages * page_size
+    corpus_pages = personas * prefix_pages
+    if corpus_pages < 4 * num_pages:
+        errors.append(f"hier: corpus {corpus_pages} pages is under 4x "
+                      f"the {num_pages}-page HBM pool — the workload "
+                      f"is not reclaim-forcing")
+    heads = _hier_personas(personas, prefix_len, vocab)
+    root = tempfile.mkdtemp(prefix="hier_bench_")
+    stats = {}
+    tokens_by_arm = {}
+    try:
+        for name in ("flat", "hier"):
+            kw = {}
+            if name == "hier":
+                kw["kv_tiers"] = {"dram_bytes": dram_bytes,
+                                  "disk_dir": os.path.join(root, "t"),
+                                  "disk_bytes": 1 << 30}
+            eng = InferenceEngine(model, num_slots=1,
+                                  page_size=page_size,
+                                  num_pages=num_pages,
+                                  max_len=model.max_length,
+                                  prefix_cache=True, **kw)
+            toks = []
+            srng = np.random.RandomState(11)   # same tails, both arms
+            # untimed: populate round + one warm-repeat round — after
+            # these, every program (full prefill, suffix prefill,
+            # decode, COW copy, promotion) is compiled on this engine
+            for _ in range(2):
+                for head in heads:
+                    req, _ = _hier_visit(eng, head, suffix_len,
+                                         max_new, vocab, srng, smoke)
+                    toks.append(list(req.token_ids))
+            hits0 = eng.tier_hit_tokens
+            traces0 = (eng.decode_trace_count, eng.promote_trace_count,
+                       dict(eng.prefill_trace_counts))
+            ttfts = []
+            t0 = time.perf_counter()
+            n_tok = 0
+            for _ in range(repeats):
+                for head in heads:
+                    req, ttft = _hier_visit(eng, head, suffix_len,
+                                            max_new, vocab, srng,
+                                            smoke)
+                    ttfts.append(ttft)
+                    toks.append(list(req.token_ids))
+                    n_tok += len(req.token_ids)
+            wall = time.perf_counter() - t0
+            if not smoke:
+                eng.audit_pages()            # smoke audits every visit
+            traces1 = (eng.decode_trace_count, eng.promote_trace_count,
+                       dict(eng.prefill_trace_counts))
+            if traces1 != traces0:
+                errors.append(f"hier[{name}]: timed warm repeats "
+                              f"compiled something new "
+                              f"({traces0} -> {traces1})")
+            if eng.promote_trace_count > 1:
+                errors.append(f"hier[{name}]: promotion retraced "
+                              f"({eng.promote_trace_count})")
+            bad = {k: v for k, v in eng.prefill_trace_counts.items()
+                   if v != 1}
+            if bad:
+                errors.append(f"hier[{name}]: prefill buckets "
+                              f"retraced: {bad}")
+            offered = repeats * personas * prefix_len
+            snap = eng.health_snapshot()
+            stats[name] = {
+                "warm_ttft_p50_ms": _percentile(ttfts, 50) * 1e3,
+                "warm_ttft_p99_ms": _percentile(ttfts, 99) * 1e3,
+                "tokens_per_s": n_tok / wall,
+                "decode_trace_count": eng.decode_trace_count,
+                "promote_trace_count": eng.promote_trace_count,
+                "tier_demotions": eng.tier_demotions,
+                "tier_disk_demotions": snap["tier_disk_demotions"],
+                "tier_promotions": eng.tier_promotions,
+                "tier_hit_tokens": eng.tier_hit_tokens,
+                "tier_crc_fallbacks": eng.tier_crc_fallbacks,
+                "kv_tier_bytes": snap["kv_tier_bytes"],
+                "timed_tier_hit_rate": ((eng.tier_hit_tokens - hits0) /
+                                        offered),
+            }
+            tokens_by_arm[name] = toks
+            if name == "hier":
+                if eng.tier_demotions == 0 or eng.tier_promotions == 0:
+                    errors.append(
+                        f"hier: tiers never cycled (demotions "
+                        f"{eng.tier_demotions}, promotions "
+                        f"{eng.tier_promotions}) — pool not "
+                        f"reclaim-forcing")
+                if smoke:
+                    # deliberately rot one demoted payload: the next
+                    # visit to that persona must fall back to
+                    # recompute LOUDLY and still emit the exact
+                    # flat-arm tokens (no garbage re-admission)
+                    from incubator_mxnet_tpu.serve.chaos import \
+                        CorruptDemotedPage
+                    CorruptDemotedPage(at_step=0, seed=3).on_step(
+                        eng, 0)
+                    fb0 = eng.tier_crc_fallbacks
+                    srng2 = np.random.RandomState(211)
+                    crc_toks = []
+                    for head in heads:
+                        req, _ = _hier_visit(eng, head, suffix_len,
+                                             max_new, vocab, srng2,
+                                             True)
+                        crc_toks.append(list(req.token_ids))
+                    if eng.tier_crc_fallbacks <= fb0:
+                        errors.append("hier: corrupted demoted page "
+                                      "was re-admitted without a crc "
+                                      "fallback")
+                    flat = InferenceEngine(model, num_slots=1,
+                                           page_size=page_size,
+                                           num_pages=num_pages,
+                                           max_len=model.max_length,
+                                           prefix_cache=True)
+                    srng2 = np.random.RandomState(211)
+                    ref_toks = []
+                    for head in heads:
+                        req, _ = _hier_visit(flat, head, suffix_len,
+                                             max_new, vocab, srng2,
+                                             False)
+                        ref_toks.append(list(req.token_ids))
+                    if crc_toks != ref_toks:
+                        errors.append("hier: crc fallback emitted "
+                                      "garbage tokens")
+                    stats["hier"]["tier_crc_fallbacks"] = \
+                        eng.tier_crc_fallbacks
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    if tokens_by_arm["flat"] != tokens_by_arm["hier"]:
+        errors.append("hier: tiered arm tokens differ from flat arm — "
+                      "re-admission by copy is not bit-identical")
+    out = {
+        "config": {"personas": personas, "prefix_pages": prefix_pages,
+                   "suffix_len": suffix_len, "max_new": max_new,
+                   "num_pages": num_pages, "page_size": page_size,
+                   "dram_bytes": dram_bytes, "repeats": repeats,
+                   "corpus_pages": corpus_pages,
+                   "corpus_over_hbm": corpus_pages / num_pages},
+        "flat": stats["flat"],
+        "hier": stats["hier"],
+        "ttft_speedup": (stats["flat"]["warm_ttft_p50_ms"] /
+                         stats["hier"]["warm_ttft_p50_ms"]),
+        "lower_tier_hit_rate": stats["hier"]["timed_tier_hit_rate"],
+        "token_parity": tokens_by_arm["flat"] == tokens_by_arm["hier"],
+    }
+    if not smoke:
+        if out["ttft_speedup"] < 1.5:
+            errors.append(f"hier: warm-repeat TTFT speedup "
+                          f"{out['ttft_speedup']:.2f}x under the 1.5x "
+                          f"bar")
+        if out["lower_tier_hit_rate"] < 0.6:
+            errors.append(f"hier: lower-tier hit rate "
+                          f"{out['lower_tier_hit_rate']:.2f} under the "
+                          f"0.6 bar")
+    return out
+
+
 def _check_compile_discipline(tag, stats, errors):
     if stats["decode_trace_count"] != 1:
         errors.append(f"{tag}: decode step compiled "
@@ -1875,6 +2083,13 @@ def main():
                          "match rate, slots-at-fixed-pool-bytes, plus "
                          "the int8-allreduce convergence seam) — "
                          "banks BENCH_QUANT.json")
+    ap.add_argument("--hier", action="store_true",
+                    help="round-19 hierarchical KV-cache workload ONLY "
+                         "(warm-repeat TTFT under HBM pressure: "
+                         "re-admit by copy from DRAM/disk vs recompute "
+                         "prefill, token parity, lower-tier hit rate) "
+                         "— banks BENCH_HIER.json; with --smoke this "
+                         "is the hiersmoke CI stage")
     ap.add_argument("--frontend", action="store_true",
                     help="round-18 HTTP/SSE front-end workloads ONLY "
                          "(protocol overhead vs direct Router.submit, "
@@ -1885,6 +2100,43 @@ def main():
     args = ap.parse_args()
 
     errors = []
+
+    if args.hier:
+        model = _build_round9(args.smoke)
+        if args.smoke:
+            h_cfg = dict(personas=10, prefix_pages=3, suffix_len=5,
+                         max_new=4, num_pages=7, page_size=8,
+                         dram_bytes=128 << 10, repeats=1)
+        else:
+            # page_size 32: each re-admitted page replaces 32 tokens
+            # of prefill compute with one gather + one promote call —
+            # the copy-vs-recompute gap the lever exists for. DRAM is
+            # sized for the whole corpus: a SYNCHRONOUS disk spill on
+            # the admission path costs more than this CPU model's
+            # recompute (the smoke run and chaos_bench --hier cover
+            # the disk tier; on a TPU-class model the break-even
+            # moves far the other way)
+            h_cfg = dict(personas=10, prefix_pages=6, suffix_len=7,
+                         max_new=8, num_pages=12, page_size=32,
+                         dram_bytes=16 << 20, repeats=2)
+        result = {"config": {"smoke": args.smoke,
+                             "backend": os.environ.get("JAX_PLATFORMS",
+                                                       "cpu")}}
+        result["hier_cache"] = bench_hier_cache(
+            model, smoke=args.smoke, errors=errors, **h_cfg)
+        print(json.dumps(result, indent=2))
+        for e in errors:
+            print(f"FAIL: {e}", file=sys.stderr)
+        out = args.json
+        if out is None and not args.smoke:
+            out = os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "BENCH_HIER.json")
+        if out:
+            with open(out, "w") as f:
+                json.dump(result, f, indent=2)
+                f.write("\n")
+            print(f"banked {out}")
+        sys.exit(0 if not errors else 1)
 
     if args.frontend:
         model = _build(max_length=128)
