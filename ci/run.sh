@@ -141,6 +141,23 @@ stage_hiersmoke() {
   JAX_PLATFORMS=cpu python tools/chaos_bench.py --hier --smoke
 }
 
+stage_migratesmoke() {
+  echo "== migratesmoke: page-transport guard (drain a replica under"
+  echo "              load — decode-ready slots migrate with ZERO redone"
+  echo "              prefill and zero lost requests, vs the replay arm's"
+  echo "              full recompute; prefill/decode role split hands"
+  echo "              every slot off at publication, bit-identical to"
+  echo "              mixed; quantized capsules ship ~4x fewer wire"
+  echo "              bytes; chaos: kill source mid-capture leaves the"
+  echo "              slot decoding in place, kill destination"
+  echo "              mid-install and capsule bit rot fall back to replay"
+  echo "              LOUDLY, a migrate-vs-cancel race keeps exactly one"
+  echo "              CANCELLED terminal; fails on any parity break,"
+  echo "              page-audit violation, or steady-state retrace)"
+  JAX_PLATFORMS=cpu python tools/serve_bench.py --migrate --smoke
+  JAX_PLATFORMS=cpu python tools/chaos_bench.py --migrate --smoke
+}
+
 stage_frontsmoke() {
   echo "== frontsmoke: client-protocol guard (HTTP/SSE front end over"
   echo "               localhost — an end-to-end SSE stream must deliver"
@@ -212,7 +229,7 @@ ge.dryrun_multichip(8)"
 }
 
 stages=("$@")
-[ ${#stages[@]} -eq 0 ] && stages=(sanity lintcore native unit stepbench mfubench servebench quantbench chaossmoke fleetsmoke tiersmoke hiersmoke frontsmoke frontchaos obssmoke trainchaos ckptbench entry report)
+[ ${#stages[@]} -eq 0 ] && stages=(sanity lintcore native unit stepbench mfubench servebench quantbench chaossmoke fleetsmoke tiersmoke hiersmoke migratesmoke frontsmoke frontchaos obssmoke trainchaos ckptbench entry report)
 for s in "${stages[@]}"; do
   "stage_$s"
 done

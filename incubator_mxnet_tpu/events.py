@@ -86,6 +86,9 @@ class EventType(enum.Enum):
     CACHE_DEMOTE = "CACHE_DEMOTE"       # prefix page HBM → DRAM/disk
     CACHE_PROMOTE = "CACHE_PROMOTE"     # prefix page re-admitted by copy
     CACHE_TIER_MISS = "CACHE_TIER_MISS"  # tier consulted, no usable page
+    MIGRATE_OUT = "MIGRATE_OUT"         # slot captured off a replica
+    MIGRATE_IN = "MIGRATE_IN"           # capsule installed on a replica
+    MIGRATE_FAIL = "MIGRATE_FAIL"       # transfer failed → replay path
 
     def __str__(self) -> str:
         return self.value

@@ -41,6 +41,7 @@ from .draft import make_ngram_drafter, ngram_propose
 from .sampling import (SamplingParams, TokenFsm, TokenGrammar,
                        choice_grammar)
 from .engine import InferenceEngine, Request
+from .transport import PageCapsule, PageTransport
 from .router import (Replica, ReplicaKilled, ReplicaState, Router,
                      build_fleet)
 from .metrics import render_metrics
@@ -56,4 +57,4 @@ __all__ = ["InferenceEngine", "Request", "Outcome", "PageAllocator",
            "render_metrics", "Event", "EventType", "FlightRecorder",
            "SamplingParams", "TokenGrammar", "TokenFsm",
            "choice_grammar", "ServeFrontend", "OUTCOME_HTTP_STATUS",
-           "stream_completion"]
+           "stream_completion", "PageCapsule", "PageTransport"]

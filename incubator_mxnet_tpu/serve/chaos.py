@@ -43,8 +43,8 @@ __all__ = ["ChaosInjector", "NaNWeights", "CorruptPageWrite",
            "PagePressure", "DelayedSteps", "CancelStorm", "run_chaos",
            "assert_all_terminal", "assert_health_consistent",
            "FleetInjector", "KillReplica", "SlowReplica",
-           "FlappingReplica", "FleetCancelStorm", "run_fleet_chaos",
-           "assert_fleet_health_consistent"]
+           "FlappingReplica", "FleetCancelStorm", "MigrateFault",
+           "run_fleet_chaos", "assert_fleet_health_consistent"]
 
 
 class ChaosInjector:
@@ -723,6 +723,154 @@ class FleetCancelStorm(FleetInjector):
                 self.log.append(f"step {step_idx}: cancelled client "
                                 f"request {req.request_id} "
                                 f"({len(req.token_ids)} tokens in)")
+
+
+class MigrateFault(FleetInjector):
+    """Force ONE live-slot migration (serve/transport.py) with a fault
+    injected at a chosen point of the transfer — the
+    migration-failure taxonomy of docs/RESILIENCE.md, made runnable.
+
+    At ``at_step`` (deferring until a decode-ready, mid-stream victim
+    and a viable destination both exist) the injector arms the
+    transport's chaos seam for its ``mode`` and calls
+    ``router.migrate``:
+
+      ``none``         no fault — the forced-migration control arm;
+                       the transfer must SUCCEED and the continuation
+                       stay bit-identical.
+      ``kill_source``  the source replica dies mid-capture, BEFORE the
+                       slot detaches: capture aborts read-only
+                       (MIGRATE_FAIL fallback="none"), and the death
+                       path replays everything the source held.
+      ``kill_dst``     the destination dies mid-install, AFTER the
+                       source detached: the install rolls back its
+                       pages, the source custody is released, and the
+                       replay fallback re-queues from the delivered
+                       suffix (MIGRATE_FAIL fallback="replay").
+      ``corrupt``      wire bit rot: one payload byte flips after
+                       capture; the destination's crc-chain check
+                       refuses the install — replay fallback, loudly.
+      ``cancel_race``  the client cancels in the same step the
+                       migration is requested. ``order="before"``:
+                       migrate must REFUSE the cancelled request and
+                       the cancel stands as exactly one CANCELLED
+                       terminal; ``order="after"``: the cancel lands
+                       on whichever side of the transfer now owns the
+                       slot — still exactly one terminal.
+
+    ``affected`` is EMPTY for everything but ``cancel_race`` (its
+    victim's stream truncates): every fallback replays bit-identical
+    to the fault-free run — migration is an optimisation over replay
+    and a failed one may cost only recompute, never correctness."""
+
+    name = "migrate_fault"
+
+    _MODES = ("none", "kill_source", "kill_dst", "corrupt",
+              "cancel_race")
+
+    def __init__(self, at_step: int, mode: str = "none",
+                 order: str = "before", seed: int = 0):
+        super().__init__(seed)
+        if mode not in self._MODES:
+            raise MXNetError(f"migrate-fault mode {mode!r} not in "
+                             f"{'|'.join(self._MODES)}")
+        if order not in ("before", "after"):
+            raise MXNetError(f"cancel order {order!r} not in "
+                             f"before|after")
+        self.at_step = at_step
+        self.mode = mode
+        self.order = order
+        self.victim: Optional[Request] = None
+        self.src: Optional[int] = None
+        self.dst: Optional[int] = None
+        self.migrate_returned: Optional[bool] = None
+
+    def _candidate(self, router: Router):
+        """A mid-stream victim (decode-ready WITH emitted tokens — the
+        fallback must have a non-empty prefix to preserve) plus a
+        viable destination, or (None, None) to defer."""
+        for t in router._inflight:
+            if t.attempt is None or t.attempt.outcome is not None:
+                continue
+            rep = router.replicas[t.replica]
+            if rep.state is not ReplicaState.SERVING or \
+                    rep.killed is not None:
+                continue
+            if not rep.engine.decode_ready(t.attempt.request_id):
+                continue
+            if not t.attempt.token_ids and not t.client.token_ids:
+                continue
+            dst = router._migration_dst(t, exclude=t.replica)
+            if dst is not None:
+                return t, dst
+        return None, None
+
+    def on_step(self, router, step_idx):
+        if self.fired or step_idx < self.at_step:
+            return
+        t, dst = self._candidate(router)
+        if t is None:
+            return                       # defer until one exists
+        self.fired = True
+        self.victim, self.src, self.dst = t.client, t.replica, dst
+        cid = t.client.request_id
+        tr = router._transport
+        if self.mode == "none":
+            self.migrate_returned = router.migrate(cid, dst)
+        elif self.mode == "kill_source":
+            src_rep = router.replicas[t.replica]
+
+            def die_mid_capture():
+                src_rep.kill(f"chaos: source died mid-capture at "
+                             f"router step {step_idx}")
+                return True
+
+            tr._capture_abort = die_mid_capture
+            try:
+                self.migrate_returned = router.migrate(cid, dst)
+            finally:
+                tr._capture_abort = None
+        elif self.mode == "kill_dst":
+            dst_rep = router.replicas[dst]
+
+            def die_mid_install():
+                dst_rep.kill(f"chaos: destination died mid-install "
+                             f"at router step {step_idx}")
+                return True
+
+            tr._install_abort = die_mid_install
+            try:
+                self.migrate_returned = router.migrate(cid, dst)
+            finally:
+                tr._install_abort = None
+        elif self.mode == "corrupt":
+            byte = int(self.rng.randint(256))
+            tr._capsule_hook = \
+                lambda c: c.corrupt(page_idx=0, byte=byte)
+            try:
+                self.migrate_returned = router.migrate(cid, dst)
+            finally:
+                tr._capsule_hook = None
+        else:                            # cancel_race
+            self._mark(t.client)
+            if self.order == "before":
+                router.cancel(t.client,
+                              detail=f"{self.name}: cancel-then-"
+                                     f"migrate at step {step_idx}")
+                self.migrate_returned = router.migrate(cid, dst)
+                if self.migrate_returned:
+                    raise MXNetError(
+                        "migrate accepted a cancelled request — the "
+                        "race the refusal ladder exists to lose")
+            else:
+                self.migrate_returned = router.migrate(cid, dst)
+                router.cancel(t.client,
+                              detail=f"{self.name}: migrate-then-"
+                                     f"cancel at step {step_idx}")
+        self.log.append(
+            f"step {step_idx}: {self.mode} migration of request "
+            f"{cid} replica{self.src}->replica{dst} returned "
+            f"{self.migrate_returned}")
 
 
 def _mirror_injector_events(flight, component, injectors, seen):

@@ -94,7 +94,7 @@ import itertools
 import math
 import time
 from collections import deque
-from typing import List, Optional, Union
+from typing import Dict, List, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -632,6 +632,23 @@ class InferenceEngine:
         self.promote_trace_count = 0     # the one promotion program
         self.demote_trace_count = 0      # the one page-gather program
 
+        # page transport (serve/transport.py): capsule traffic through
+        # this engine — pages/bytes captured off it and installed into
+        # it — plus the in-capsule page custody: a detached slot's
+        # pages stay refcounted here (owned by the in-flight capsule,
+        # keyed by the attempt's request_id) until the transfer lands
+        # or falls back, so ``audit_pages`` sees every in-transit page
+        self.migrated_out_pages = 0      # pages captured into capsules
+        self.migrated_in_pages = 0       # pages installed from capsules
+        self.migrated_out_bytes = 0      # capsule wire bytes, outbound
+        self.migrated_in_bytes = 0       # capsule wire bytes, inbound
+        self._capsule_pages: Dict[int, List[int]] = {}
+        # fleet-aware preemption (serve/router.py fleet_preempt): set
+        # by the router, called with the victim's request_id BEFORE an
+        # engine-internal preemption — True means the fleet moved the
+        # slot to a sibling and this engine must not evict/terminal it
+        self.preempt_handoff = None
+
         self._decode_step = jax.jit(self._decode_step_fn,
                                     donate_argnums=(1, 2))
         self._prefill_jits = {}          # bucket_pages -> jitted dense fn
@@ -1168,14 +1185,13 @@ class InferenceEngine:
         return (tuple(p[page] for p in kpools),
                 tuple(p[page] for p in vpools))
 
-    def _demote_entry(self, key: bytes, ent) -> None:
-        """Capture an evicted-but-published page's payload into the
-        cache tiers BEFORE its page returns to the free list (the
-        ``demote`` callback threaded through PrefixIndex.reclaim).
-        For quantized pools the payload is the page's int8/fp8 codes
-        plus its per-layer amax — the 4x-denser at-rest form; for
-        unquantized pools the raw-dtype page."""
-        page = ent.page
+    def gather_page(self, page: int) -> tuple:
+        """One page's wire/at-rest payload: per-layer (H, ps, D)
+        host arrays plus the per-layer amax pair on quantized pools
+        (int8/fp8 codes + one f32 scale — the 4x-denser form), None
+        otherwise. One jitted gather program (traced once) and ONE
+        device_get — shared by tier demotion and page transport, so
+        a capture never compiles a second program."""
         if self._gather_jit is None:
             self._gather_jit = jax.jit(self._gather_page_fn)
         k_payload, v_payload = jax.device_get(
@@ -1187,6 +1203,16 @@ class InferenceEngine:
                                np.float32)
             vamax = np.asarray([a[page] for a in self._vamax],
                                np.float32)
+        return k_payload, v_payload, kamax, vamax
+
+    def _demote_entry(self, key: bytes, ent) -> None:
+        """Capture an evicted-but-published page's payload into the
+        cache tiers BEFORE its page returns to the free list (the
+        ``demote`` callback threaded through PrefixIndex.reclaim).
+        For quantized pools the payload is the page's int8/fp8 codes
+        plus its per-layer amax — the 4x-denser at-rest form; for
+        unquantized pools the raw-dtype page."""
+        k_payload, v_payload, kamax, vamax = self.gather_page(ent.page)
         if self._tiers.put(key, ent.tokens, ent.depth, k_payload,
                            v_payload, kamax, vamax):
             self.tier_demotions += 1
@@ -1422,6 +1448,16 @@ class InferenceEngine:
                                  if self._tiers is not None else 0),
             "tier_dropped": (self._tiers.dropped
                              if self._tiers is not None else 0),
+            # page transport (serve/transport.py): capsule traffic
+            # through this engine, plus live in-custody state — pages
+            # a detached slot parked here while its transfer is in
+            # flight (gauge, normally 0 between router steps)
+            "migrated_out_pages": self.migrated_out_pages,
+            "migrated_in_pages": self.migrated_in_pages,
+            "migrated_out_bytes": self.migrated_out_bytes,
+            "migrated_in_bytes": self.migrated_in_bytes,
+            "capsule_pages": sum(len(p) for p in
+                                 self._capsule_pages.values()),
             "stop_hits": self.stop_hits,
             "constrained_requests": self.constrained_requests,
             "preemptions": self.preemptions,
@@ -1786,6 +1822,13 @@ class InferenceEngine:
         shared by eviction (terminal) and preemption (re-queue)."""
         slot = self._slots[slot_idx]
         self._alloc.free(slot.refs)          # refcounted: shared pages
+        self._scrub_slot_arrays(slot_idx)
+
+    def _scrub_slot_arrays(self, slot_idx: int):
+        """Scrub a slot's device-facing arrays WITHOUT touching its
+        page references — the shared tail of ``_free_slot_state``
+        (pages freed) and ``detach_slot`` (pages move to in-capsule
+        custody instead)."""
         self._page_table[slot_idx, :] = NULL_PAGE  # survive via sharers
         self._lengths[slot_idx] = 0
         self._temps[slot_idx] = 0.0
@@ -1811,6 +1854,28 @@ class InferenceEngine:
         PREEMPTED — bounded, retryable, hinted."""
         slot = self._slots[slot_idx]
         req = slot.request
+        if self.preempt_handoff is not None and not slot.prefilling:
+            # fleet-aware preemption: offer the victim to a sibling
+            # FIRST — a successful handoff MOVES the slot's pages
+            # (zero redone prefill, no queue bounce); the fallback
+            # below keeps the engine-internal requeue semantics when
+            # nobody can take it. The handoff may also end with the
+            # router re-queueing the request itself (replay fallback
+            # after a failed transfer) — the slot is gone from this
+            # engine either way, so the re-check guards the eviction,
+            # not the return value alone.
+            try:
+                handed = bool(self.preempt_handoff(req.request_id))
+            except Exception:
+                handed = False
+            if handed or self._slots[slot_idx] is not slot:
+                self.preemptions += 1
+                self.flight.emit(self._component, EventType.PREEMPT,
+                                 request_id=req.request_id,
+                                 tier=req.tier.value, slot=slot_idx,
+                                 preemptions=req.preemptions,
+                                 handoff=True, detail=detail)
+                return
         req.preemptions += 1
         self.preemptions += 1
         self._free_slot_state(slot_idx)
@@ -2031,14 +2096,46 @@ class InferenceEngine:
         self._page_table[slot_idx, :] = NULL_PAGE
         self._lengths[slot_idx] = 0
         self._temps[slot_idx] = 0.0
-        # sampling-menu slot state (serve/sampling.py): knob vectors,
-        # bias row, and the token-count table (full attempt history —
-        # prompt + carried tokens) the penalties read. Grammar state
-        # and the stop-sequence window are re-derived from the
-        # GENERATED part only (``prompt_len`` marks the resume split),
-        # so a preemption/failover resume samples exactly as the
-        # unbroken run would — bit-identical continuations under every
-        # knob (tests/test_sampling.py)
+        self._restore_stream_state(slot_idx, slot)
+        if partial is not None:
+            # COW: the boundary page becomes a private copy; drop
+            # the temporary pin on the cached source
+            self._copy_page(partial[0], int(row[len(shared)]))
+            self._alloc.decref(partial[0])
+        self.flight.emit(
+            self._component, EventType.ADMIT,
+            request_id=req.request_id, tier=req.tier.value,
+            slot=slot_idx, t0=t0, cached_len=cached_len,
+            queue_delay_s=(slot.t_admit - req.submit_time
+                           if req.submit_time is not None else None))
+
+        if self.chunk_pages is None:
+            # monolithic mode: prefill to completion inside _admit.
+            # A cache hit still runs the (chunk-program) suffix path
+            # — the dense program cannot start mid-prompt.
+            if cached_len == 0:
+                self._dense_prefill(slot_idx)
+            else:
+                while (self._slots[slot_idx] is slot and
+                       slot.prefilling):
+                    self._run_chunk(slot_idx)
+        # chunked mode: the slot prefills across subsequent step()
+        # calls under the token budget
+        return True
+
+    def _restore_stream_state(self, slot_idx: int, slot: "_Slot"):
+        """Re-derive a slot's resumable-as-data stream state from its
+        attempt ids — sampling-menu slot state (serve/sampling.py):
+        knob vectors, bias row, and the token-count table (full attempt
+        history — prompt + carried tokens) the penalties read. Grammar
+        state and the stop-sequence window are re-derived from the
+        GENERATED part only (``prompt_len`` marks the resume split), so
+        a preemption/failover resume — and a migration install, which
+        goes through exactly this path on the destination — samples as
+        the unbroken run would: bit-identical continuations under every
+        knob (tests/test_sampling.py, tests/test_transport.py)."""
+        req = slot.request
+        ids = slot.attempt_ids
         self._tok_counts[slot_idx] = np.bincount(
             ids, minlength=self._vocab)[:self._vocab]
         sp = req.sampling
@@ -2065,30 +2162,184 @@ class InferenceEngine:
                 slot.grammar_state = st
             if sp.stop_sequences and sp.max_stop_len > 1:
                 slot.stop_tail = gen[-(sp.max_stop_len - 1):]
-        if partial is not None:
-            # COW: the boundary page becomes a private copy; drop
-            # the temporary pin on the cached source
-            self._copy_page(partial[0], int(row[len(shared)]))
-            self._alloc.decref(partial[0])
+
+    # ------------------------------------------------------------- #
+    # page-transport hooks (serve/transport.py owns the capsule)
+    # ------------------------------------------------------------- #
+
+    def kv_wire_sig(self) -> tuple:
+        """The pool layout a page payload is only meaningful under:
+        quant mode, page size, layer count, per-page shape, and code
+        dtype. A capsule captured under one signature must never be
+        installed under another — the transport refuses the transfer
+        and the replay fallback recomputes instead."""
+        return (self.kv_quant or "off", self.page_size,
+                len(self._kpools), tuple(self._kpools[0].shape[1:]),
+                str(self._kpools[0].dtype))
+
+    def decode_ready(self, request_id: int) -> bool:
+        """True when ``request_id`` holds a slot past prefill — the
+        only state a slot is page-capturable from (a prefilling slot's
+        pages are half-built; migrating it is a replay, not a
+        transfer). The router's role-split streaming poll."""
+        for slot in self._slots:
+            if slot is not None and \
+                    slot.request.request_id == request_id:
+                return not slot.prefilling
+        return False
+
+    def capture_slot(self, request_id: int) -> Optional[dict]:
+        """READ-ONLY capture probe for the page transport: the decode-
+        ready slot's populated page row (positions ``[0, n_pos)`` —
+        the one position beyond it is recomputed on the destination,
+        its logits must seed the next sample there), its pinned RNG
+        key, and the attempt request. Nothing moves: refcounts, the
+        slot, and the pools are untouched, so an aborted capture
+        (source death mid-transfer) leaves the slot exactly as it was.
+        None when the request holds no slot here or is still
+        prefilling."""
+        for i, slot in enumerate(self._slots):
+            if slot is not None and \
+                    slot.request.request_id == request_id:
+                if slot.prefilling:
+                    return None
+                n_pos = int(self._lengths[i])
+                if n_pos <= 0:
+                    return None
+                n_pages = -(-n_pos // self.page_size)
+                return {
+                    "request": slot.request,
+                    "key": np.array(slot.key, np.uint32),
+                    "pages": [int(p) for p in
+                              self._page_table[i, :n_pages]],
+                    "n_pos": n_pos,
+                }
+        return None
+
+    def detach_slot(self, request_id: int) -> Optional[Request]:
+        """Move a captured slot's page references into in-capsule
+        custody (``_capsule_pages``) and release the slot — WITHOUT a
+        terminal (the transport owns the outcome: install on the
+        destination, or the replay fallback). The pages stay
+        refcounted by the custody entry, so ``audit_pages`` balances
+        at every step of an in-flight transfer; ``release_capsule``
+        returns them to the pool once the transfer lands or falls
+        back. Returns the detached attempt request, or None when the
+        request holds no decode-ready slot here."""
+        for i, slot in enumerate(self._slots):
+            if slot is not None and \
+                    slot.request.request_id == request_id:
+                if slot.prefilling:
+                    return None
+                self._capsule_pages[int(request_id)] = list(slot.refs)
+                self._scrub_slot_arrays(i)
+                return slot.request
+        return None
+
+    def release_capsule(self, request_id: int) -> int:
+        """Drop an in-flight capsule's page custody — the source-side
+        end of every transfer, success or fallback. Returns the number
+        of page references released."""
+        pages = self._capsule_pages.pop(int(request_id), None)
+        if pages is None:
+            return 0
+        self._alloc.free(pages)
+        return len(pages)
+
+    def install_slot(self, request: Request, payloads, n_pos: int,
+                     key, wire_bytes: int = 0, page_hook=None,
+                     abort=None) -> bool:
+        """Install a transported slot: allocate private pages, write
+        every capsule payload through the ONE jitted promotion program
+        (the tier re-admission program — nothing new compiles), pin
+        the capsule's RNG key, and re-derive the stream state exactly
+        as a preemption resume would. The slot resumes with
+        ``prefill_pos = n_pos``: the only recomputed position is the
+        boundary token the wire cannot carry (its logits seed the next
+        sample), so redone prefill is zero.
+
+        Refuses — False, engine untouched — when no slot or not
+        enough pages are free, the request is already terminal, or the
+        capsule does not line up with the resume attempt
+        (``n_pos != len(attempt) - 1``). A mid-install abort (chaos:
+        destination death) frees the allocated pages and refuses —
+        ``audit_pages`` stays clean on the destination too."""
+        if request.outcome is not None:
+            return False
+        slot_idx = next((i for i in range(self.num_slots)
+                         if self._slots[i] is None), None)
+        if slot_idx is None:
+            return False
+        ids = self._attempt_ids(request)
+        t0 = int(ids.size)
+        if n_pos != t0 - 1 or n_pos <= 0:
+            return False                 # capsule/attempt mismatch
+        n_install = -(-n_pos // self.page_size)
+        if n_install != len(payloads):
+            return False
+        total = t0 + (request.max_new_tokens - len(request.token_ids))
+        need = -(-total // self.page_size)
+        prompt_pages = -(-t0 // self.page_size)
+        avail = self._alloc.free_count - self._lazy_debt
+        recl = self._prefix.reclaimable(self._alloc) \
+            if self._prefix is not None else 0
+        if avail + recl < need:
+            return False
+        if avail < prompt_pages:
+            self.prefix_reclaimed_pages += \
+                self._reclaim_prefix(prompt_pages - avail)
+        priv = [self._alloc.alloc() for _ in range(prompt_pages)]
+        self._reset_page_amax(priv)      # fresh pages, fresh scales
+        aborted = False
+        for j, payload in enumerate(payloads):
+            if page_hook is not None:
+                page_hook(j, len(payloads))
+            if abort is not None and abort():
+                aborted = True
+                break
+            self._promote_page(*payload, int(priv[j]))
+        if aborted:
+            # pages are identity-free: a half-written payload needs no
+            # scrub, only its references back on the free list
+            self._alloc.free(priv)
+            return False
+        row = np.zeros((self.max_pages,), np.int32)
+        row[:prompt_pages] = priv
+        skey = np.asarray(key, np.uint32)
+        # the capsule's pinned key IS the live stream's key: remember
+        # it on the request so a later preemption resume on THIS
+        # replica keeps the same stream (the cross-replica seed gap —
+        # an engine-drawn key must travel, never be re-drawn)
+        request._assigned_key = skey
+        if request.submit_time is None:
+            request.submit_time = time.perf_counter()
+        if request._deadline_abs is None and \
+                request.deadline_s is not None:
+            request._deadline_abs = \
+                request.submit_time + request.deadline_s
+        slot = _Slot(request, reserved_pages=need, refs=priv, row=row,
+                     t0=t0, attempt_ids=ids, prefill_pos=n_pos,
+                     t_admit=time.perf_counter(), key=skey)
+        self._slots[slot_idx] = slot
+        self._slot_keys[slot_idx] = skey
+        # decode-invisible until the boundary token lands — exactly
+        # the cache-hit suffix admission contract
+        self._page_table[slot_idx, :] = NULL_PAGE
+        self._lengths[slot_idx] = 0
+        self._temps[slot_idx] = 0.0
+        self._restore_stream_state(slot_idx, slot)
+        self.migrated_in_pages += len(payloads)
+        self.migrated_in_bytes += int(wire_bytes)
         self.flight.emit(
             self._component, EventType.ADMIT,
-            request_id=req.request_id, tier=req.tier.value,
-            slot=slot_idx, t0=t0, cached_len=cached_len,
-            queue_delay_s=(slot.t_admit - req.submit_time
-                           if req.submit_time is not None else None))
-
-        if self.chunk_pages is None:
-            # monolithic mode: prefill to completion inside _admit.
-            # A cache hit still runs the (chunk-program) suffix path
-            # — the dense program cannot start mid-prompt.
-            if cached_len == 0:
-                self._dense_prefill(slot_idx)
-            else:
-                while (self._slots[slot_idx] is slot and
-                       slot.prefilling):
-                    self._run_chunk(slot_idx)
-        # chunked mode: the slot prefills across subsequent step()
-        # calls under the token budget
+            request_id=request.request_id, tier=request.tier.value,
+            slot=slot_idx, t0=t0, cached_len=n_pos, migrated=True,
+            queue_delay_s=None)
+        # recompute ONLY the boundary position, through the same chunk
+        # program family a cache-hit suffix uses (bucket 1 — already
+        # compiled on any engine that admitted a cache hit)
+        while self._slots[slot_idx] is slot and slot.prefilling:
+            self._run_chunk(slot_idx)
         return True
 
     def _slot_sampling_args(self, slot_idx: int) -> tuple:
@@ -2597,7 +2848,19 @@ class InferenceEngine:
         referenced, or granted twice). With cache tiers on, the third
         state — demoted — is audited too: a demoted entry is payload
         WITHOUT a page id (structurally disjoint from free and live),
-        and the tier store's own byte/shape accounting must balance."""
+        and the tier store's own byte/shape accounting must balance.
+        With page transport in play there is a fourth state — IN
+        CAPSULE: a detached slot's pages sit in ``_capsule_pages``
+        custody (refcounted here, owned by the in-flight transfer) so
+        the invariant is free XOR live XOR demoted XOR in-capsule, and
+        a request id must never be both slotted and in custody."""
+        for rid in self._capsule_pages:
+            for slot in self._slots:
+                if slot is not None and \
+                        slot.request.request_id == rid:
+                    raise MXNetError(
+                        f"page audit: request {rid} holds a slot AND "
+                        f"an in-flight capsule (double identity)")
         expect = [0] * self.num_pages
         for slot in self._slots:
             if slot is None:
@@ -2609,6 +2872,9 @@ class InferenceEngine:
                 expect[p] += 1
         for p in self._alloc.held:           # chaos-harness page holds
             expect[p] += 1
+        for pages in self._capsule_pages.values():   # in-capsule custody
+            for p in pages:
+                expect[p] += 1
         free = self._alloc._free
         free_set = set(free)
         if len(free_set) != len(free):

@@ -76,6 +76,12 @@ _ENGINE_COUNTERS = [
     ("tier_crc_fallbacks", "kv_tier_crc_fallbacks_total"),
     ("tier_disk_errors", "kv_tier_disk_errors_total"),
     ("tier_dropped", "kv_tier_dropped_total"),
+    # page transport (serve/transport.py): capsule traffic through
+    # THIS engine — outbound captures and inbound installs
+    ("migrated_out_pages", "kv_migrated_out_pages_total"),
+    ("migrated_in_pages", "kv_migrated_in_pages_total"),
+    ("migrated_out_bytes", "kv_migrated_out_bytes_total"),
+    ("migrated_in_bytes", "kv_migrated_in_bytes_total"),
 ]
 _ROUTER_COUNTERS = [
     ("requeues", "requeues_total"),
@@ -86,6 +92,11 @@ _ROUTER_COUNTERS = [
     ("affinity_routed", "affinity_routed_total"),
     ("tier_affinity_routed", "tier_affinity_routed_total"),
     ("spill_routed", "spill_routed_total"),
+    # page transport: fleet-level migration tally
+    ("migrations", "migrations_total"),
+    ("migrations_failed", "migrations_failed_total"),
+    ("migrated_pages", "kv_migrated_pages_total"),
+    ("migrated_bytes", "kv_migrated_bytes_total"),
 ]
 
 _REPLICA_UP = {"SERVING": 1.0, "DEGRADED": 0.5, "DEAD": 0.0}

@@ -536,14 +536,34 @@ class _TierEntry:                       # _PrefixEntry (ndarray fields)
     pinned: bool = False        # admission in flight — not evictable
 
 
-def _payload_crc(k_payload, v_payload, kamax, vamax) -> int:
-    c = 0
+def payload_crc(k_payload, v_payload, kamax, vamax, seed: int = 0) -> int:
+    """crc32 over one page payload's bytes, chained from ``seed`` —
+    the ONE integrity primitive for KV bytes at rest and on the wire:
+    tier entries checksum each page independently (seed 0), the page
+    transport (serve/transport.py) chains page crcs through the whole
+    capsule so a reordered, dropped, or substituted page breaks every
+    later link, not just its own."""
+    c = seed & 0xFFFFFFFF
     for arr in (*k_payload, *v_payload):
         c = zlib.crc32(np.ascontiguousarray(arr).tobytes(), c)
     for arr in (kamax, vamax):
         if arr is not None:
             c = zlib.crc32(np.ascontiguousarray(arr).tobytes(), c)
     return c
+
+
+def payload_nbytes(k_payload, v_payload, kamax, vamax) -> int:
+    """Wire/at-rest size of one page payload — the accounting unit
+    behind tier byte budgets and capsule ``kv_migrated_bytes_total``
+    (int8 codes + f32 scales ≈ 1/4 the raw-dtype bytes)."""
+    n = sum(a.nbytes for a in (*k_payload, *v_payload))
+    for arr in (kamax, vamax):
+        if arr is not None:
+            n += arr.nbytes
+    return n
+
+
+_payload_crc = payload_crc              # internal alias (pre-transport name)
 
 
 class KVTierStore:

@@ -78,10 +78,13 @@ from ..base import MXNetError
 from .engine import InferenceEngine, Request
 from .events import EventType, resolve_recorder, terminal_fields
 from .outcomes import Outcome
-from .slo import Tier, resolve_tier_policies
+from .slo import Tier, resolve_tier_policies, wants_rebalance
+from .transport import PageTransport
 
 __all__ = ["Router", "Replica", "ReplicaState", "ReplicaKilled",
            "build_fleet"]
+
+_ROLES = ("prefill", "decode", "mixed")
 
 
 class ReplicaState(enum.Enum):
@@ -105,9 +108,21 @@ class Replica:
     are harvested from the ROUTER'S own bookkeeping (the token stream
     it already received), not from the dead engine's memory."""
 
-    def __init__(self, idx: int, engine: InferenceEngine):
+    def __init__(self, idx: int, engine: InferenceEngine,
+                 role: str = "mixed"):
+        if role not in _ROLES:
+            raise MXNetError(f"replica role must be one of {_ROLES}, "
+                             f"got {role!r}")
         self.idx = idx
         self.engine = engine
+        # disaggregated serving role: a 'prefill' replica runs chunked
+        # prefill only — the router streams each slot to a decode/
+        # mixed sibling the moment prefill publishes its pages; a
+        # 'decode' replica takes no fresh admissions while any
+        # prefill/mixed sibling can (its slots arrive by migration).
+        # 'mixed' (default) does both — a single-role fleet behaves
+        # exactly as before this field existed.
+        self.role = role
         self.state = ReplicaState.SERVING
         self.killed: Optional[str] = None    # chaos kill reason
         self.delay_s = 0.0                   # chaos per-step stall
@@ -187,10 +202,24 @@ class Router:
                  max_queue_delay_s: Optional[float] = None,
                  stall_steps: int = 2000, seed: int = 0,
                  tier_policies: Optional[dict] = None,
+                 roles: Optional[List[str]] = None,
+                 rebalance: bool = False,
+                 fleet_preempt: bool = False,
                  recorder=None):
         if not engines:
             raise MXNetError("a fleet needs at least one replica")
-        self.replicas = [Replica(i, e) for i, e in enumerate(engines)]
+        if roles is not None and len(roles) != len(engines):
+            raise MXNetError(f"roles ({len(roles)}) must match "
+                             f"engines ({len(engines)})")
+        if roles is not None and engines and \
+                all(r == "decode" for r in roles):
+            raise MXNetError("a fleet of only 'decode' replicas can "
+                             "never prefill — include a 'prefill' or "
+                             "'mixed' replica")
+        self.replicas = [
+            Replica(i, e, role=(roles[i] if roles is not None
+                                else "mixed"))
+            for i, e in enumerate(engines)]
         # the router's own flight recorder (serve/events.py): CLIENT
         # lifecycle + routing/failover/replica-health events. Each
         # replica keeps its OWN recorder (attempt-level events and
@@ -241,6 +270,27 @@ class Router:
         self.affinity_routed = 0
         self.tier_affinity_routed = 0    # won on the lower-tier axis
         self.spill_routed = 0
+        # page transport (serve/transport.py): live slot migration
+        # between replicas — role-split streaming, drain-before-
+        # warm_start, brownout rebalancing, fleet-aware preemption.
+        # Every failed transfer degrades to the replay fallback
+        # (resume-from-suffix re-queue), loudly, WITHOUT charging the
+        # request's requeue budget: a failed optimisation is the
+        # router's fault, not the request's.
+        self._transport = PageTransport()
+        self.rebalance = bool(rebalance)
+        self.migrations = 0
+        self.migrations_failed = 0
+        self.migrated_pages = 0
+        self.migrated_bytes = 0
+        if fleet_preempt:
+            # fleet-aware preemption: an engine about to preempt a
+            # victim offers it to the router first — a successful
+            # handoff MOVES the slot to a sibling (zero redone
+            # prefill) instead of bouncing it through the queue
+            for rep in self.replicas:
+                rep.engine.preempt_handoff = \
+                    self._make_preempt_handoff(rep.idx)
         self.log: List[str] = []
 
     # ------------------------------------------------------------- #
@@ -486,6 +536,12 @@ class Router:
         cands = [(r, s) for r, s in snaps
                  if self._can_hold(r, tracked)
                  and self._capacity(r, s)]
+        if any(r.role != "decode" for r, _ in cands):
+            # role split: a 'decode' replica's slots arrive by page
+            # migration, never fresh admission — unless it is the ONLY
+            # replica that can take the request (correctness over
+            # purity: a request must not starve to honor a role)
+            cands = [(r, s) for r, s in cands if r.role != "decode"]
         if not cands:
             return None
         if self.affinity:
@@ -616,12 +672,27 @@ class Router:
         self._record_terminal(c, att.outcome, att.detail,
                               att.retry_after_s)
 
-    def _requeue(self, tracked: _Tracked, detail: str):
+    def _requeue(self, tracked: _Tracked, detail: str,
+                 cause: str = "failover", charge: bool = True):
         """The structured-failover path: bounded, exactly-once-
         terminal. Already-emitted tokens stay on the client; the next
-        dispatch replays from the suffix."""
+        dispatch replays from the suffix. ``charge=False`` re-queues
+        WITHOUT burning the request's requeue budget — the migration
+        fallback and drain use it: those re-queues are the router's
+        own doing (a failed optimisation / an operator action), and a
+        request must not die FAILED_REPLICA for them."""
         if self._remint_if_complete(tracked):
             return                           # nothing left to replay
+        if not charge:
+            self.requeues += 1
+            self.flight.emit(self._component, EventType.REQUEUE,
+                             request_id=tracked.client.request_id,
+                             cause=cause, requeues=tracked.requeues,
+                             detail=detail[:200],
+                             tokens_preserved=len(
+                                 tracked.client.token_ids))
+            self._queue.append(tracked)
+            return
         if tracked.requeues >= self.max_requeues:
             self._record_terminal(
                 tracked.client, Outcome.FAILED_REPLICA,
@@ -642,7 +713,7 @@ class Router:
         self.requeues += 1
         self.flight.emit(self._component, EventType.REQUEUE,
                          request_id=tracked.client.request_id,
-                         cause="failover", requeues=tracked.requeues,
+                         cause=cause, requeues=tracked.requeues,
                          detail=detail[:200],
                          tokens_preserved=len(
                              tracked.client.token_ids))
@@ -839,6 +910,251 @@ class Router:
                              f"{detail}")
 
     # ------------------------------------------------------------- #
+    # page transport: live slot migration between replicas
+    # ------------------------------------------------------------- #
+
+    def _find_tracked(self, request_id: int) -> Optional[_Tracked]:
+        """In-flight lookup by CLIENT request id (the attempt id also
+        matches — callers holding an engine-side id still resolve)."""
+        for t in self._inflight:
+            if t.client.request_id == request_id or \
+                    (t.attempt is not None and
+                     t.attempt.request_id == request_id):
+                return t
+        return None
+
+    def migrate(self, request_id: int, dst: int) -> bool:
+        """Move ``request_id``'s live slot to replica ``dst`` — pages
+        by capsule, zero redone prefill, bit-identical continuation.
+        Returns True when the slot now decodes on ``dst``.
+
+        Failure is never partial: an abort BEFORE the source detaches
+        (not decode-ready, no capacity probe, source death mid-
+        capture) leaves the slot decoding where it was and returns
+        False; a failure AFTER (crc mismatch, destination refusal or
+        death mid-install) releases the source-side custody and falls
+        back to the replay path — the request re-queues from its
+        delivered suffix WITHOUT charging its requeue budget, and a
+        ``MIGRATE_FAIL`` event records which fallback engaged."""
+        tracked = self._find_tracked(request_id)
+        if tracked is None or tracked.attempt is None:
+            return False
+        src = self.replicas[tracked.replica]
+        dst_rep = self.replicas[dst]
+        if dst_rep is src or \
+                src.state is ReplicaState.DEAD or \
+                dst_rep.state is ReplicaState.DEAD or \
+                dst_rep.killed is not None:
+            return False
+        att = tracked.attempt
+        if att.outcome is not None:
+            return False                 # finished — _collect owns it
+        capsule = None
+        try:
+            capsule = self._transport.capture(src.engine,
+                                              att.request_id)
+        except Exception as e:           # torn source — death path
+            self.flight.emit(self._component, EventType.MIGRATE_FAIL,
+                             request_id=tracked.client.request_id,
+                             entity=f"replica{src.idx}",
+                             src=src.idx, dst=dst, fallback="none",
+                             reason=f"{type(e).__name__}: {e}"[:200])
+            self.migrations_failed += 1
+            return False
+        if capsule is None:
+            # pre-detach refusal: still prefilling, already gone, or
+            # an injected source death aborted the capture — the slot
+            # (if any) keeps decoding on the source; nothing to undo
+            self.flight.emit(self._component, EventType.MIGRATE_FAIL,
+                             request_id=tracked.client.request_id,
+                             entity=f"replica{src.idx}",
+                             src=src.idx, dst=dst, fallback="none",
+                             reason="capture refused/aborted")
+            self.migrations_failed += 1
+            return False
+        # the source slot is detached into custody: from here every
+        # path either installs on dst or falls back to replay — and
+        # either way releases the custody exactly once
+        self.flight.emit(self._component, EventType.MIGRATE_OUT,
+                         request_id=tracked.client.request_id,
+                         entity=f"replica{src.idx}", src=src.idx,
+                         dst=dst, pages=capsule.num_pages,
+                         bytes=capsule.nbytes)
+        self._inflight.remove(tracked)
+        tracked.attempt, tracked.replica = None, None
+        self._absorb(tracked, att)
+        att2 = self._make_attempt(tracked)
+        if att2 is None:
+            # completed/expired under the transfer — _make_attempt
+            # minted the terminal; the capsule is moot
+            src.engine.release_capsule(att.request_id)
+            return False
+        ok = False
+        reason = "install refused"
+        try:
+            ok = self._transport.install(dst_rep.engine, capsule,
+                                         att2)
+        except Exception as e:           # torn destination
+            ok = False
+            reason = f"{type(e).__name__}: {e}"[:200]
+        src.engine.release_capsule(att.request_id)
+        if not ok:
+            if not capsule.verify():
+                reason = "capsule crc chain broken"
+            self.migrations_failed += 1
+            self.flight.emit(self._component, EventType.MIGRATE_FAIL,
+                             request_id=tracked.client.request_id,
+                             entity=f"replica{dst}", src=src.idx,
+                             dst=dst, fallback="replay",
+                             reason=reason)
+            self.log.append(f"migration {tracked.client.request_id} "
+                            f"replica{src.idx}->replica{dst} failed "
+                            f"({reason}): replay fallback")
+            self._requeue(tracked,
+                          f"migration to replica {dst} failed "
+                          f"({reason}) — replaying from the suffix",
+                          cause="migration-fallback", charge=False)
+            return False
+        tracked.attempt = att2
+        tracked.replica = dst
+        self._inflight.append(tracked)
+        self.migrations += 1
+        self.migrated_pages += capsule.num_pages
+        self.migrated_bytes += capsule.nbytes
+        self.flight.emit(self._component, EventType.MIGRATE_IN,
+                         request_id=tracked.client.request_id,
+                         entity=f"replica{dst}", src=src.idx,
+                         dst=dst, pages=capsule.num_pages,
+                         bytes=capsule.nbytes,
+                         attempt_id=att2.request_id)
+        return True
+
+    def _migration_dst(self, tracked: _Tracked, exclude: int,
+                       decode_pref: bool = True) -> Optional[int]:
+        """Pick the destination replica for a migration: serving, not
+        the source, can hold the request, has a free slot — 'decode'
+        and 'mixed' roles only when ``decode_pref`` (a migrated slot
+        is decode work; a dedicated prefill replica must not collect
+        it back). Least-occupied wins, index breaks ties."""
+        best, best_key = None, None
+        for rep in self._serving():
+            if rep.idx == exclude or rep.killed is not None:
+                continue
+            if decode_pref and rep.role == "prefill":
+                continue
+            if not self._can_hold(rep, tracked):
+                continue
+            snap = rep.engine.health_snapshot()
+            if snap["free_slots"] <= 0:
+                continue
+            key = (snap["active_slots"], snap["queue_depth"], rep.idx)
+            if best_key is None or key < best_key:
+                best, best_key = rep.idx, key
+        return best
+
+    def _stream_prefill_roles(self):
+        """Role-split streaming: every decode-ready slot on a
+        'prefill' replica moves to a decode/mixed sibling NOW — the
+        publication moment is the handoff point, so a prefill replica
+        never spends a step decoding. A slot that cannot move yet (no
+        sibling has a free slot) keeps decoding in place: the role is
+        an optimisation, the stream must not stall for it."""
+        for rep in self.replicas:
+            if rep.role != "prefill" or \
+                    rep.state is not ReplicaState.SERVING:
+                continue
+            for t in [t for t in self._inflight
+                      if t.replica == rep.idx]:
+                if t.attempt.outcome is not None:
+                    continue
+                if not rep.engine.decode_ready(t.attempt.request_id):
+                    continue
+                dst = self._migration_dst(t, exclude=rep.idx)
+                if dst is not None:
+                    self.migrate(t.client.request_id, dst)
+
+    def _rebalance_brownout(self):
+        """Brownout rebalancing: a replica browned out to the
+        rebalance level sheds ONE decode-ready slot per fleet pass to
+        the least-occupied cool sibling — pages move, tokens don't
+        replay, and the hot replica's pressure signal (its own queue +
+        occupancy) actually falls instead of bouncing work through
+        the router queue."""
+        snaps = {r.idx: r.engine.health_snapshot()
+                 for r in self._serving()}
+        hot = [r for r in self._serving()
+               if wants_rebalance(snaps[r.idx]["brownout_level"])]
+        for rep in hot:
+            for t in [t for t in self._inflight
+                      if t.replica == rep.idx]:
+                if t.attempt.outcome is not None:
+                    continue
+                if not rep.engine.decode_ready(t.attempt.request_id):
+                    continue
+                dst = self._migration_dst(t, exclude=rep.idx)
+                if dst is None or \
+                        wants_rebalance(
+                            snaps[dst]["brownout_level"]):
+                    continue             # nowhere cooler to go
+                if self.migrate(t.client.request_id, dst):
+                    break                # one slot per pass per replica
+
+    def _make_preempt_handoff(self, src_idx: int):
+        """The engine->router preemption seam (``fleet_preempt``): the
+        engine calls this with its victim's request id BEFORE evicting
+        — True means the fleet took the slot (migrated to a sibling,
+        or the replay fallback already re-queued it at the router) and
+        the engine must not record a PREEMPTED terminal; False means
+        the slot is untouched and engine-internal preemption proceeds
+        as ever."""
+        def handoff(request_id: int) -> bool:
+            tracked = self._find_tracked(request_id)
+            if tracked is None:
+                return False
+            dst = self._migration_dst(tracked, exclude=src_idx)
+            if dst is None:
+                return False
+            if self.migrate(tracked.client.request_id, dst):
+                return True
+            # a post-detach failure already re-queued the request at
+            # the router (replay fallback) — the slot is gone from the
+            # source either way, so the engine must stand down
+            return tracked not in self._inflight
+        return handoff
+
+    def drain_replica(self, idx: int) -> dict:
+        """Drain replica ``idx`` for an upgrade (drain, then
+        ``engine.warm_start`` the new weights, with zero lost
+        requests): queued attempts are withdrawn back to the router
+        (they hold no pages), decode-ready slots MIGRATE to siblings
+        (zero redone prefill), still-prefilling slots are left to
+        finish — call again after ``step()`` until ``remaining`` is 0.
+        Returns ``{"migrated", "requeued", "remaining"}``."""
+        rep = self.replicas[idx]
+        migrated = requeued = 0
+        for t in [t for t in self._inflight if t.replica == idx]:
+            if t.attempt.outcome is not None:
+                continue                 # _collect owns it
+            if rep.engine.withdraw(t.attempt):
+                self._inflight.remove(t)
+                att, t.attempt, t.replica = t.attempt, None, None
+                self._absorb(t, att)
+                self._requeue(t, f"withdrawn in drain of replica "
+                                 f"{idx}", cause="drain",
+                              charge=False)
+                requeued += 1
+                continue
+            if not rep.engine.decode_ready(t.attempt.request_id):
+                continue                 # mid-prefill: next pass
+            dst = self._migration_dst(t, exclude=idx)
+            if dst is not None and \
+                    self.migrate(t.client.request_id, dst):
+                migrated += 1
+        remaining = sum(1 for t in self._inflight if t.replica == idx)
+        return {"migrated": migrated, "requeued": requeued,
+                "remaining": remaining}
+
+    # ------------------------------------------------------------- #
     # the scheduler
     # ------------------------------------------------------------- #
 
@@ -888,6 +1204,12 @@ class Router:
             advanced += n
             self._step_ok(rep, dt, compiled)
         self._collect()
+        if any(r.role == "prefill" for r in self.replicas):
+            # role split: hand freshly-published page sets to the
+            # decode side the same pass prefill finished them
+            self._stream_prefill_roles()
+        if self.rebalance:
+            self._rebalance_brownout()
         if self._queue:
             self._dispatch()                 # freed slots take work now
         return advanced
@@ -1147,6 +1469,7 @@ class Router:
         reps = []
         for r in self.replicas:
             entry = {"idx": r.idx, "state": r.state.value,
+                     "role": r.role,
                      "breaker_opens": r.breaker_opens,
                      "probes": r.probes, "steps": r.steps}
             if r.state is ReplicaState.DEAD:
@@ -1171,6 +1494,13 @@ class Router:
             "affinity_routed": self.affinity_routed,
             "tier_affinity_routed": self.tier_affinity_routed,
             "spill_routed": self.spill_routed,
+            # page transport: fleet-level migration tally (each
+            # replica's snapshot carries its own in/out capsule
+            # counters) — serve/metrics.py renders all four
+            "migrations": self.migrations,
+            "migrations_failed": self.migrations_failed,
+            "migrated_pages": self.migrated_pages,
+            "migrated_bytes": self.migrated_bytes,
             # CLIENT-level latency histograms (the SLO percentiles a
             # dashboard should alert on — per-replica attempt
             # histograms ride each replica's own engine snapshot)
@@ -1180,11 +1510,14 @@ class Router:
 
 
 def build_fleet(model, n_replicas: int, engine_kw: Optional[dict] = None,
+                roles: Optional[List[str]] = None,
                 **router_kw) -> Router:
     """N homogeneous replicas over ONE model's weights (each engine
     binds the same parameter arrays — host RAM holds one copy) behind
-    a Router. The common test/bench constructor."""
+    a Router. ``roles`` (one of 'prefill'|'decode'|'mixed' per
+    replica) builds a disaggregated fleet; omitted, every replica is
+    'mixed'. The common test/bench constructor."""
     engine_kw = dict(engine_kw or {})
     engines = [InferenceEngine(model, **engine_kw)
                for _ in range(n_replicas)]
-    return Router(engines, **router_kw)
+    return Router(engines, roles=roles, **router_kw)

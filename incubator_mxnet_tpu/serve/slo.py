@@ -48,7 +48,24 @@ import enum
 from typing import List, Optional, Tuple
 
 __all__ = ["Tier", "TierPolicy", "default_tier_policies",
-           "resolve_tier_policies", "BrownoutController"]
+           "resolve_tier_policies", "BrownoutController",
+           "REBALANCE_LEVEL", "wants_rebalance"]
+
+# the brownout level at which a fleet should start MOVING work off a
+# replica instead of only degrading it in place: level 2 is where the
+# replica begins trading prompt latency for decode headroom (chunk
+# budget clamped), i.e. the point where a cooler sibling genuinely
+# serves the same slot better. Level 1 (speculation off) is not worth
+# a page transfer; level 3 is far past it.
+REBALANCE_LEVEL = 2
+
+
+def wants_rebalance(level: int) -> bool:
+    """Should a fleet rebalance work OFF a replica at this brownout
+    level? The router's migration trigger (serve/router.py
+    ``rebalance=True``) — kept here so the degradation ladder and the
+    rebalance threshold live in one file."""
+    return int(level) >= REBALANCE_LEVEL
 
 
 class Tier(enum.Enum):

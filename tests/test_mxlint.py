@@ -391,6 +391,61 @@ def test_page_pass_tier_clean_fixture(tmp_path):
                              rule="page-refcount")) == []
 
 
+BAD_TRANSPORT = {
+    "incubator_mxnet_tpu/serve/badtransport.py": """
+        class ChainForger:
+            def splice(self, capsule, payload):
+                # forges a record past verify(): the wire chain the
+                # destination trusts no longer covers this payload
+                capsule._records.append(payload)
+                capsule._chain_crc = 0
+
+        class Sidecar:
+            def steal_pages(self, engine, rid):
+                # moves custody pages around the engine's
+                # detach/release seam: audit_pages can no longer
+                # prove free XOR live XOR demoted XOR in-capsule
+                pages = engine._capsule_pages.pop(rid)
+                return pages
+    """,
+}
+
+CLEAN_TRANSPORT = {
+    "incubator_mxnet_tpu/serve/goodtransport.py": """
+        class PageCapsule:
+            def __init__(self):
+                self._records = []
+                self._chain_crc = 0
+
+            def payloads(self):
+                return list(self._records)
+
+        class PageTransport:
+            def nbytes(self, capsule):
+                return sum(len(r) for r in capsule._records)
+
+        class Sidecar:
+            def ship(self, capsule, engine, rid):
+                payloads = capsule.payloads()   # the one read API
+                engine.release_capsule(rid)     # the one custody API
+                return payloads
+    """,
+}
+
+
+def test_page_pass_transport_internals(tmp_path):
+    active = _active(_findings(tmp_path, BAD_TRANSPORT,
+                               rule="page-refcount"))
+    msgs = "\n".join(f.message for f in active)
+    assert msgs.count("outside PageCapsule/PageTransport") == 2
+    assert msgs.count("outside InferenceEngine") == 1
+
+
+def test_page_pass_transport_clean_fixture(tmp_path):
+    assert _active(_findings(tmp_path, CLEAN_TRANSPORT,
+                             rule="page-refcount")) == []
+
+
 def test_page_pass_null_page_and_rc_internals(tmp_path):
     files = {"incubator_mxnet_tpu/serve/nullpage.py": """
         NULL_PAGE = 0
@@ -776,6 +831,12 @@ _INJECTIONS = {
     "page-refcount#tiers": (
         "incubator_mxnet_tpu/serve/injected_tier.py",
         BAD_TIER["incubator_mxnet_tpu/serve/badtier.py"]),
+    # third page-refcount injection: the round-20 transport rules (a
+    # crc-chain forger + a sidecar moving in-capsule custody pages
+    # around detach_slot/release_capsule)
+    "page-refcount#transport": (
+        "incubator_mxnet_tpu/serve/injected_transport.py",
+        BAD_TRANSPORT["incubator_mxnet_tpu/serve/badtransport.py"]),
     "host-sync": (
         "incubator_mxnet_tpu/serve/router.py",
         """

@@ -1,0 +1,541 @@
+"""Page-transport tests (serve/transport.py PageCapsule/PageTransport +
+serve/engine.py capture/detach/install custody + serve/router.py
+migrate/roles/drain/fleet-preempt).
+
+The load-bearing claims: (1) a migrated slot's continuation is
+BIT-IDENTICAL to the never-migrated stream — quantized and raw pools,
+greedy and seedless temperature (the pinned RNG key travels in the
+capsule), and a seeded stream replays replica-independent; (2) every
+failure mode degrades to the always-correct replay/in-place fallback
+with the page-state contract intact at each step (free XOR live XOR
+demoted XOR in-capsule, ``audit_pages``): a capture abort is
+PRE-detach (source slot untouched, still decoding), an install abort
+rolls the destination back to untouched, a corrupted capsule or a
+wire-signature mismatch is refused before any page lands; (3) the
+jit-once contract survives transport — the destination's decode and
+chunk programs compile once each; (4) the router composes it: migrate
+parity + MIGRATE_OUT/IN events + the /metrics counters, role-split
+fleets whose prefill replica never decodes, drain with zero lost
+requests, fleet-aware preemption that MOVES the victim instead of
+requeueing it, and the migrate-vs-cancel race losing to the refusal
+ladder."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.base import MXNetError
+from incubator_mxnet_tpu.models import gpt as g
+from incubator_mxnet_tpu.serve import (EventType, InferenceEngine,
+                                       Outcome, PageTransport, Request,
+                                       build_fleet)
+from incubator_mxnet_tpu.serve.metrics import render_metrics
+
+VOCAB = 64
+PS = 8
+
+ENG_KW = dict(num_slots=2, page_size=PS, max_len=64, chunk_pages=1,
+              prefix_cache=True)
+
+
+@pytest.fixture(scope="module")
+def model():
+    mx.random.seed(0)
+    m = g.gpt_mini(vocab_size=VOCAB, max_length=64)
+    m.initialize()
+    return m
+
+
+def _eng(model, **kw):
+    return InferenceEngine(model, **dict(ENG_KW, **kw))
+
+
+def _fleet(model, n=2, **router_kw):
+    router_kw.setdefault("seed", 3)
+    return build_fleet(model, n, engine_kw=dict(ENG_KW), **router_kw)
+
+
+def _prompt(seed=5, n=18):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, VOCAB, size=(n,)).astype(np.int32)
+
+
+def _workload(n, seed=42, max_new=8):
+    """Greedy (parity-assertable) mixed persona workload."""
+    rng = np.random.RandomState(seed)
+    persona = rng.randint(0, VOCAB, size=(14,)).astype(np.int32)
+    reqs = []
+    for i in range(n):
+        if i % 2 == 0:
+            prompt = np.concatenate(
+                [persona,
+                 rng.randint(0, VOCAB, size=(3 + i % 4,))
+                 .astype(np.int32)])
+        else:
+            prompt = rng.randint(0, VOCAB,
+                                 size=(5 + 3 * (i % 3),)).astype(np.int32)
+        reqs.append(Request(prompt, max_new_tokens=max_new))
+    return reqs
+
+
+def _step_until(eng, pred, guard=400):
+    for _ in range(guard):
+        if pred():
+            return True
+        eng.step()
+    return pred()
+
+
+def _run_to_tokens(eng, req, k):
+    assert eng.submit(req)
+    assert _step_until(eng, lambda: len(req.token_ids) >= k), \
+        f"source never reached {k} tokens"
+
+
+def _finish(eng, req):
+    assert _step_until(eng, lambda: req.outcome is not None), \
+        "request never reached a terminal"
+
+
+def _reference(model, req_kw, **eng_kw):
+    eng = _eng(model, **eng_kw)
+    req = Request(**req_kw)
+    eng.run([req], poll_sleep=1e-4)
+    assert req.outcome is not None and req.outcome.ok
+    return list(req.token_ids)
+
+
+# --------------------------------------------------------------------- #
+# capture/install parity — the headline correctness claim
+# --------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("kv_quant,temperature", [
+    (None, 0.0),
+    # the temperature arms double the work (the seedless oracle needs
+    # a second reference run) — tier-1 keeps the greedy pair, the
+    # full stage_unit runs all four
+    pytest.param(None, 0.8, marks=pytest.mark.slow),
+    ("int8", 0.0),
+    pytest.param("int8", 0.8, marks=pytest.mark.slow),
+], ids=["f32-greedy", "f32-temp", "int8-greedy", "int8-temp"])
+def test_capture_install_parity(model, kv_quant, temperature):
+    """Migrate a slot mid-stream between two engines: the combined
+    stream (source tokens + destination continuation) must equal the
+    never-migrated oracle. The temperature arms are SEEDLESS — the
+    parity there is carried entirely by the pinned key travelling in
+    the capsule (identically-constructed engines replay the same key
+    stream, asserted as a precondition)."""
+    kw = {} if kv_quant is None else {"kv_quant": kv_quant}
+    req_kw = dict(prompt_ids=_prompt(), max_new_tokens=8,
+                  temperature=temperature)
+    want = _reference(model, req_kw, **kw)
+    if temperature > 0.0:
+        # precondition for the seedless oracle: engine key streams are
+        # construction-deterministic
+        assert _reference(model, req_kw, **kw) == want
+
+    src = _eng(model, **kw)
+    dst = _eng(model, **kw)
+    req = Request(**req_kw)
+    _run_to_tokens(src, req, 3)
+    head = list(req.token_ids)
+
+    tr = PageTransport()
+    cap = tr.capture(src, req.request_id)
+    assert cap is not None and tr.captures == 1
+    src.audit_pages()                    # pages in in-capsule custody
+    att = cap.make_resume_request()
+    assert att is not None
+    assert tr.install(dst, cap, att) and tr.installs == 1
+    assert src.release_capsule(req.request_id) == cap.num_pages
+    src.audit_pages()
+    dst.audit_pages()
+
+    _finish(dst, att)
+    assert att.outcome.ok
+    assert head + list(att.token_ids) == want
+
+    # jit-once survives transport on BOTH ends
+    for eng in (src, dst):
+        assert eng.decode_trace_count <= 1
+        assert all(v == 1 for v in eng.prefill_trace_counts.values())
+
+
+@pytest.mark.slow   # ~10 s: three engines + two full reference runs
+def test_seeded_temperature_replica_independent(model):
+    """The cross-replica seed gap: a SEEDED stream is a function of
+    (seed, position) alone, so it replays identically on any replica —
+    a fresh engine run and a mid-stream migration must both reproduce
+    it exactly."""
+    req_kw = dict(prompt_ids=_prompt(11), max_new_tokens=8,
+                  temperature=0.8, seed=1234)
+    want = _reference(model, req_kw)
+    # a different engine (different construction history: its internal
+    # key stream has advanced) still replays the seeded stream
+    other = _eng(model)
+    warm = Request(_prompt(12), max_new_tokens=2)
+    other.run([warm], poll_sleep=1e-4)
+    again = Request(**req_kw)
+    other.run([again], poll_sleep=1e-4)
+    assert list(again.token_ids) == want
+
+    src, dst = _eng(model), _eng(model)
+    req = Request(**req_kw)
+    _run_to_tokens(src, req, 3)
+    tr = PageTransport()
+    cap = tr.capture(src, req.request_id)
+    assert cap is not None
+    att = cap.make_resume_request()
+    assert tr.install(dst, cap, att)
+    src.release_capsule(req.request_id)
+    _finish(dst, att)
+    assert list(req.token_ids) + list(att.token_ids) == want
+
+
+# --------------------------------------------------------------------- #
+# failure modes — every one degrades, loudly, with clean audits
+# --------------------------------------------------------------------- #
+
+def test_capture_abort_pre_detach_leaves_slot_decoding(model):
+    """An abort ANYWHERE during capture lands before the detach, so
+    the source slot is untouched — it keeps decoding in place and the
+    stream still matches the oracle (the fallback owes nothing)."""
+    req_kw = dict(prompt_ids=_prompt(21), max_new_tokens=8)
+    want = _reference(model, req_kw)
+    src = _eng(model)
+    req = Request(**req_kw)
+    _run_to_tokens(src, req, 3)
+    tr = PageTransport()
+    tr._capture_abort = lambda: True
+    assert tr.capture(src, req.request_id) is None
+    assert tr.capture_failures == 1
+    src.audit_pages()
+    _finish(src, req)
+    assert req.outcome.ok and list(req.token_ids) == want
+
+
+def test_install_abort_rolls_destination_back(model):
+    """A mid-install abort (destination dying) frees every allocated
+    page and refuses — the destination ends exactly as it began, and
+    the source's custody release is still the caller's to run."""
+    src, dst = _eng(model), _eng(model)
+    req = Request(_prompt(22), max_new_tokens=8)
+    _run_to_tokens(src, req, 3)
+    tr = PageTransport()
+    cap = tr.capture(src, req.request_id)
+    assert cap is not None
+    free0 = dst._alloc.free_count
+    tr._install_abort = lambda: True
+    att = cap.make_resume_request()
+    assert tr.install(dst, cap, att) is False
+    assert tr.install_failures == 1
+    assert dst._alloc.free_count == free0
+    dst.audit_pages()
+    assert src.release_capsule(req.request_id) == cap.num_pages
+    src.audit_pages()
+
+
+def test_corrupt_capsule_refused(model):
+    """Wire bit rot: one flipped payload byte breaks the crc chain —
+    ``verify`` fails, ``install`` refuses before any page lands, and
+    ``payloads`` raises rather than expose unvouched bytes."""
+    src, dst = _eng(model), _eng(model)
+    req = Request(_prompt(23), max_new_tokens=8)
+    _run_to_tokens(src, req, 3)
+    tr = PageTransport()
+    cap = tr.capture(src, req.request_id)
+    assert cap is not None and cap.verify()
+    cap.corrupt(page_idx=0, byte=5)
+    assert not cap.verify()
+    att = cap.make_resume_request()
+    free0 = dst._alloc.free_count
+    assert tr.install(dst, cap, att) is False
+    assert tr.install_failures == 1
+    assert dst._alloc.free_count == free0
+    with pytest.raises(MXNetError, match="crc chain"):
+        cap.payloads()
+    src.release_capsule(req.request_id)
+    src.audit_pages()
+
+
+def test_wire_sig_mismatch_refused(model):
+    """A capsule captured off a quantized pool must not install into a
+    raw pool (the payload encodings differ) — refused by wire
+    signature before the crc is even walked."""
+    src = _eng(model, kv_quant="int8")
+    dst = _eng(model)
+    req = Request(_prompt(24), max_new_tokens=8)
+    _run_to_tokens(src, req, 3)
+    tr = PageTransport()
+    cap = tr.capture(src, req.request_id)
+    assert cap is not None
+    att = cap.make_resume_request()
+    assert tr.install(dst, cap, att) is False
+    assert tr.install_failures == 1
+    src.release_capsule(req.request_id)
+    src.audit_pages()
+    dst.audit_pages()
+
+
+def test_custody_accounting(model):
+    """Between detach and release the pages live in the FOURTH state
+    (in-capsule custody): not free, not a slot's, still refcounted —
+    ``audit_pages`` accepts them, the free count is unchanged until
+    release, and a double release is a no-op returning 0."""
+    src = _eng(model)
+    req = Request(_prompt(25), max_new_tokens=8)
+    _run_to_tokens(src, req, 3)
+    free_live = src._alloc.free_count
+    tr = PageTransport()
+    cap = tr.capture(src, req.request_id)
+    assert cap is not None
+    assert src._alloc.free_count == free_live   # custody, not freed
+    src.audit_pages()
+    assert src.migrated_out_pages == cap.num_pages
+    assert src.migrated_out_bytes == cap.nbytes
+    assert src.release_capsule(req.request_id) == cap.num_pages
+    assert src._alloc.free_count > free_live
+    assert src.release_capsule(req.request_id) == 0
+    src.audit_pages()
+
+
+def test_capture_refuses_unknown_request(model):
+    src = _eng(model)
+    tr = PageTransport()
+    assert tr.capture(src, 10 ** 9) is None
+    assert tr.capture_failures == 1
+    src.audit_pages()
+
+
+# --------------------------------------------------------------------- #
+# the router composition
+# --------------------------------------------------------------------- #
+
+def _fleet_audit(rt):
+    from incubator_mxnet_tpu.serve.router import ReplicaState
+    for rep in rt.replicas:
+        if rep.state is not ReplicaState.DEAD and rep.killed is None:
+            rep.engine.audit_pages()
+
+
+def test_router_migrate_parity_events_metrics(model):
+    """``Router.migrate`` mid-run: token streams stay identical to an
+    unmigrated fleet, MIGRATE_OUT/MIGRATE_IN land in the merged
+    timeline, and every transport counter reaches /metrics under its
+    documented name."""
+    base = _fleet(model)
+    reqs_b = _workload(6)
+    base.run(reqs_b)
+    rt = _fleet(model)
+    reqs = _workload(6)
+    moved = {}
+
+    def before(router, i):
+        if moved or i < 3:
+            return
+        for t in list(router._inflight):
+            if t.attempt is None or t.attempt.outcome is not None:
+                continue
+            rep = router.replicas[t.replica]
+            if not rep.engine.decode_ready(t.attempt.request_id):
+                continue
+            # probe the destination first: a refused migrate counts as
+            # a failed one (it IS one — the fallback ran), and this
+            # test asserts the clean-path counters
+            snap = router.replicas[1 - t.replica].engine.health_snapshot()
+            if snap["free_slots"] <= 0 or snap["free_pages"] < 6:
+                continue
+            if router.migrate(t.client.request_id, 1 - t.replica):
+                moved["cid"] = t.client.request_id
+                return
+
+    rt.run(reqs, before_step=before)
+    assert moved, "no slot ever became migratable"
+    assert all(r.outcome is not None and r.outcome.ok for r in reqs)
+    assert [list(r.token_ids) for r in reqs] == \
+        [list(r.token_ids) for r in reqs_b]
+    assert rt.migrations >= 1 and rt.migrations_failed == 0
+    assert rt.migrated_pages >= 1 and rt.migrated_bytes > 0
+    _fleet_audit(rt)
+
+    ev = rt.flight_events()
+    outs = [e for e in ev if e.etype is EventType.MIGRATE_OUT]
+    ins = [e for e in ev if e.etype is EventType.MIGRATE_IN]
+    assert moved["cid"] in [e.request_id for e in outs]
+    assert moved["cid"] in [e.request_id for e in ins]
+
+    text = render_metrics(rt.health_snapshot())
+    for name in ("migrations_total", "migrations_failed_total",
+                 "kv_migrated_pages_total", "kv_migrated_bytes_total"):
+        assert name in text, f"{name} missing from fleet /metrics"
+    etext = "".join(render_metrics(rep.engine.health_snapshot())
+                    for rep in rt.replicas)
+    for name in ("kv_migrated_out_pages_total",
+                 "kv_migrated_in_pages_total",
+                 "kv_migrated_out_bytes_total",
+                 "kv_migrated_in_bytes_total"):
+        assert name in etext, f"{name} missing from engine /metrics"
+
+
+@pytest.mark.slow   # ~10 s: two fleets; the split contract is also
+def test_role_split_fleet(model):    # drilled every CI run by migratesmoke
+    """roles=['prefill','decode']: every stream prefills on the
+    prefill replica and hands off at publication — the prefill replica
+    never spends a decode step, the decode replica admits nothing
+    fresh, and the streams equal a mixed fleet's (the split is
+    invisible in the tokens)."""
+    mixed = _fleet(model)
+    reqs_m = _workload(4, seed=17)
+    mixed.run(reqs_m)
+
+    rt = build_fleet(model, 2, engine_kw=dict(ENG_KW, num_slots=4),
+                     roles=["prefill", "decode"], seed=3)
+    reqs = _workload(4, seed=17)
+    rt.run(reqs)
+    assert all(r.outcome is not None and r.outcome.ok for r in reqs)
+    assert [list(r.token_ids) for r in reqs] == \
+        [list(r.token_ids) for r in reqs_m]
+    assert rt.migrations >= len(reqs)
+    # publication is one decode step in (the step that emits the first
+    # token makes the slot decode-ready), so the prefill replica is
+    # allowed at most that boundary step per stream — the decode
+    # replica must carry everything else
+    assert rt.replicas[0].engine.decode_steps <= len(reqs), \
+        "the prefill replica kept decoding past publication"
+    assert rt.replicas[1].engine.decode_steps > \
+        rt.replicas[0].engine.decode_steps
+    _fleet_audit(rt)
+
+
+@pytest.mark.slow   # ~11 s: two fleets; drain zero-lost/zero-redone
+def test_drain_replica_zero_lost(model):   # is migratesmoke's headline gate
+    """Drain a replica mid-run: decode-ready slots migrate, queued
+    attempts bounce back to the router, nothing is lost, and the
+    streams match an undrained fleet."""
+    base = _fleet(model)
+    reqs_b = _workload(6, seed=29, max_new=10)
+    base.run(reqs_b)
+    rt = _fleet(model)
+    reqs = _workload(6, seed=29, max_new=10)
+    drained = {"migrated": 0, "requeued": 0}
+
+    def before(router, i):
+        if drained.get("done") or i < 4:
+            return
+        r = router.drain_replica(0)
+        drained["migrated"] += r["migrated"]
+        drained["requeued"] += r["requeued"]
+        if r["remaining"] == 0:
+            drained["done"] = True
+
+    rt.run(reqs, before_step=before)
+    assert drained.get("done"), "the drain never completed"
+    assert drained["migrated"] >= 1, \
+        "the drained replica held no decode-ready work"
+    assert all(r.outcome is not None and r.outcome.ok for r in reqs)
+    assert [list(r.token_ids) for r in reqs] == \
+        [list(r.token_ids) for r in reqs_b]
+    _fleet_audit(rt)
+
+
+@pytest.mark.slow   # ~8 s: affinity warmup + solo reference run
+def test_fleet_preempt_handoff(model):
+    """Fleet-aware preemption: a LATENCY admission preempting a BATCH
+    victim offers it to the router FIRST — the victim MOVES to the
+    sibling (pages migrate, zero requeue, zero redone prefill) and its
+    stream still matches an uninterfered solo run."""
+    rt = build_fleet(model, 2,
+                     engine_kw=dict(ENG_KW, num_slots=1),
+                     fleet_preempt=True, seed=3)
+    rng = np.random.RandomState(31)
+    head = rng.randint(0, VOCAB, size=(14,)).astype(np.int32)
+
+    def _with_tail(seed):
+        trng = np.random.RandomState(seed)
+        return np.concatenate(
+            [head, trng.randint(0, VOCAB, size=(4,)).astype(np.int32)])
+
+    warm = Request(_with_tail(1), max_new_tokens=2)
+    rt.run([warm], poll_sleep=1e-4)
+    assert warm.outcome.ok
+    src = next(i for i, rep in enumerate(rt.replicas)
+               if rep.engine.prefix_probe(_with_tail(2)) > 0)
+
+    batch_kw = dict(prompt_ids=_with_tail(2), max_new_tokens=10,
+                    tier="BATCH")
+    want = _reference(model, batch_kw, num_slots=1)
+    batch = Request(**batch_kw)
+    assert rt.submit(batch)
+    for _ in range(400):
+        rt.step()
+        t = rt._find_tracked(batch.request_id)
+        if t is not None and t.attempt is not None \
+                and t.replica == src and len(batch.token_ids) + \
+                len(t.attempt.token_ids) >= 2 \
+                and rt.replicas[src].engine.decode_ready(
+                    t.attempt.request_id):
+            break
+    else:
+        pytest.fail("BATCH victim never reached decode on the "
+                    "affinity replica")
+
+    lat = Request(_with_tail(3), max_new_tokens=2, tier="LATENCY")
+    assert rt.submit(lat)
+    for _ in range(600):
+        if batch.outcome is not None and lat.outcome is not None:
+            break
+        rt.step()
+    assert batch.outcome is not None and batch.outcome.ok
+    assert lat.outcome is not None and lat.outcome.ok
+    assert rt.migrations == 1, "the victim did not move to the sibling"
+    assert rt.requeues == 0, "the handoff bounced through the queue"
+    assert list(batch.token_ids) == want
+    handed = [e for e in rt.flight_events()
+              if e.etype is EventType.PREEMPT and e.data.get("handoff")]
+    assert handed, "no handoff-flagged PREEMPT event"
+    _fleet_audit(rt)
+
+
+def test_migrate_cancel_race(model):
+    """The refusal ladder must lose the migrate-vs-cancel race in both
+    orders: cancel-then-migrate refuses with NO migration events;
+    migrate-then-cancel leaves exactly one CANCELLED terminal on the
+    destination."""
+    rt = _fleet(model)
+
+    def _decode_ready(req):
+        t = rt._find_tracked(req.request_id)
+        return (t is not None and t.attempt is not None
+                and t.replica is not None
+                and rt.replicas[t.replica].engine.decode_ready(
+                    t.attempt.request_id))
+
+    def _mig_events():
+        return sum(1 for e in rt.flight_events()
+                   if e.etype in (EventType.MIGRATE_OUT,
+                                  EventType.MIGRATE_IN,
+                                  EventType.MIGRATE_FAIL))
+
+    # cancel first: migrate must refuse silently
+    r1 = Request(_prompt(41), max_new_tokens=12)
+    assert rt.submit(r1)
+    assert _step_until(rt, lambda: _decode_ready(r1))
+    t = rt._find_tracked(r1.request_id)
+    dst = 1 - t.replica
+    ev0 = _mig_events()
+    assert rt.cancel(r1)
+    assert rt.migrate(r1.request_id, dst) is False
+    assert _mig_events() == ev0, "a refused migrate emitted events"
+    assert r1.outcome == Outcome.CANCELLED
+
+    # migrate first: the cancel lands on the destination, exactly once
+    r2 = Request(_prompt(42), max_new_tokens=12)
+    assert rt.submit(r2)
+    assert _step_until(rt, lambda: _decode_ready(r2))
+    t = rt._find_tracked(r2.request_id)
+    assert rt.migrate(r2.request_id, 1 - t.replica)
+    assert rt.cancel(r2)
+    assert r2.outcome == Outcome.CANCELLED
+    assert _step_until(rt, lambda: not rt._inflight and not rt._queue)
+    assert rt.migrations == 1
+    _fleet_audit(rt)

@@ -52,6 +52,17 @@ to the fault-free fleet run, every SURVIVING replica's
 decode compiled exactly once, and every retryable outcome carries a
 ``retry_after_s`` hint.
 
+``--migrate`` runs the page-transport scenarios (serve/transport.py,
+ci/run.sh ``migratesmoke`` stage): one forced live-slot migration per
+scenario with a deterministic fault at a different point of the
+protocol — source death mid-capture (pre-detach: slot untouched,
+death path replays), destination death mid-install (post-detach:
+custody released, replay fallback re-queues from the suffix),
+capsule crc corruption (wire bit rot refused loudly), the
+migrate-vs-cancel race (exactly one CANCELLED terminal, both
+orders), plus a fault-free forced-migration control arm. Every
+fallback must be bit-identical to the fault-free fleet run.
+
 ``--smoke`` is the CI guard (ci/run.sh chaossmoke / fleetsmoke
 stages): the same scenarios at a size that runs in minutes on CPU;
 exits non-zero on any violated invariant.
@@ -1275,6 +1286,210 @@ def run_fleet_scenarios(n_requests, errors, n_replicas=2):
 
 
 # --------------------------------------------------------------------- #
+# page-transport / migration scenarios (serve/transport.py —
+# ci/run.sh migratesmoke stage)
+# --------------------------------------------------------------------- #
+
+def run_migrate_scenarios(n_requests, errors, n_replicas=2):
+    """Migration chaos: every scenario forces one live-slot transfer
+    (serve/transport.py) with a deterministic fault at a different
+    point of the protocol — source death mid-capture, destination
+    death mid-install, wire bit rot (capsule crc), and the
+    migrate-vs-cancel race, plus a fault-free forced-migration
+    control arm.
+
+    The load-bearing invariant everywhere: a FAILED transfer degrades
+    to the replay fallback LOUDLY (a MIGRATE_FAIL event naming which
+    fallback engaged) and the request still ends in EXACTLY ONE
+    terminal outcome with tokens BIT-IDENTICAL to the fault-free
+    fleet run — migration is an optimisation over replay, and no
+    fault in it may cost more than recompute. Pages are audited on
+    every surviving replica after every router step (in-capsule
+    custody included), and no replica's decode/prefill programs ever
+    retrace."""
+    from incubator_mxnet_tpu.serve import EventType, Outcome
+    from incubator_mxnet_tpu.serve.chaos import (MigrateFault,
+                                                 run_fleet_chaos)
+    results = {}
+    vocab = 64
+
+    def _mig_events(rt, etype):
+        return [e for e in rt.flight_events() if e.etype is etype]
+
+    # ---- fault-free fleet baseline (the parity oracle) ------------- #
+    model = _build_model()
+    rt = _fleet(model, n_replicas, spec_k=0)
+    reqs = _make_requests(n_requests, vocab, seed=42)
+    t0 = time.perf_counter()
+    run_fleet_chaos(rt, reqs, [])
+    wall = time.perf_counter() - t0
+    baseline = [list(r.token_ids) for r in reqs]
+    stats = _check_fleet_invariants("migrate_baseline", rt, reqs,
+                                    baseline, set(), errors)
+    if not all(r.outcome is not None and r.outcome.ok for r in reqs):
+        errors.append("migrate_baseline: not every request succeeded")
+    stats["wall_s"] = wall
+    results["migrate_baseline"] = stats
+
+    # ---- forced migration, no fault (the control arm) -------------- #
+    model = _build_model()
+    rt = _fleet(model, n_replicas, spec_k=0)
+    reqs = _make_requests(n_requests, vocab, seed=42)
+    inj = MigrateFault(at_step=5, mode="none", seed=3)
+    run_fleet_chaos(rt, reqs, [inj])
+    stats = _check_fleet_invariants("migrate_clean", rt, reqs,
+                                    baseline, set(), errors)
+    if not inj.fired:
+        errors.append("migrate_clean: injector never fired")
+    if inj.migrate_returned is not True:
+        errors.append(f"migrate_clean: fault-free migration returned "
+                      f"{inj.migrate_returned}, not True")
+    if rt.migrations < 1 or rt.migrated_pages < 1:
+        errors.append(f"migrate_clean: counters unmoved (migrations "
+                      f"{rt.migrations}, pages {rt.migrated_pages})")
+    if not _mig_events(rt, EventType.MIGRATE_OUT) or \
+            not _mig_events(rt, EventType.MIGRATE_IN):
+        errors.append("migrate_clean: MIGRATE_OUT/MIGRATE_IN never "
+                      "landed on the flight timeline")
+    if not all(r.outcome is not None and r.outcome.ok for r in reqs):
+        errors.append("migrate_clean: a request was lost to a "
+                      "SUCCESSFUL migration")
+    stats.update(migrations=rt.migrations,
+                 migrated_pages=rt.migrated_pages,
+                 migrated_bytes=rt.migrated_bytes, log=inj.log)
+    results["migrate_clean"] = stats
+
+    # ---- source dies mid-capture (pre-detach) ---------------------- #
+    # capture is read-only until the last page: the abort leaves the
+    # slot exactly as it was, MIGRATE_FAIL records fallback="none",
+    # and the DEATH path owns the replay of everything the source held
+    model = _build_model()
+    rt = _fleet(model, n_replicas, spec_k=0)
+    reqs = _make_requests(n_requests, vocab, seed=42)
+    inj = MigrateFault(at_step=5, mode="kill_source", seed=3)
+    run_fleet_chaos(rt, reqs, [inj])
+    stats = _check_fleet_invariants("kill_source_mid_capture", rt,
+                                    reqs, baseline, set(), errors)
+    if not inj.fired:
+        errors.append("kill_source_mid_capture: injector never fired")
+    if inj.migrate_returned is not False:
+        errors.append("kill_source_mid_capture: migrate claimed "
+                      "success off a dying source")
+    fails = _mig_events(rt, EventType.MIGRATE_FAIL)
+    if not any(e.data.get("fallback") == "none" for e in fails):
+        errors.append("kill_source_mid_capture: no MIGRATE_FAIL with "
+                      "fallback='none' (pre-detach abort must leave "
+                      "the replay to the death path)")
+    if rt.replica_deaths != 1:
+        errors.append(f"kill_source_mid_capture: {rt.replica_deaths} "
+                      f"deaths != 1")
+    if rt.requeues == 0:
+        errors.append("kill_source_mid_capture: the death re-queued "
+                      "nothing")
+    if not all(r.outcome is not None and r.outcome.ok for r in reqs):
+        errors.append("kill_source_mid_capture: a request was lost")
+    stats.update(migrations_failed=rt.migrations_failed, log=inj.log)
+    results["kill_source_mid_capture"] = stats
+
+    # ---- destination dies mid-install (post-detach) ---------------- #
+    # the slot is already in source-side custody: the install rolls
+    # back, custody is released exactly once, and the replay fallback
+    # re-queues from the delivered suffix WITHOUT charging the
+    # requeue budget (MIGRATE_FAIL fallback="replay")
+    model = _build_model()
+    rt = _fleet(model, n_replicas, spec_k=0)
+    reqs = _make_requests(n_requests, vocab, seed=42)
+    inj = MigrateFault(at_step=5, mode="kill_dst", seed=3)
+    run_fleet_chaos(rt, reqs, [inj])
+    stats = _check_fleet_invariants("kill_dst_mid_install", rt, reqs,
+                                    baseline, set(), errors)
+    if not inj.fired:
+        errors.append("kill_dst_mid_install: injector never fired")
+    if inj.migrate_returned is not False:
+        errors.append("kill_dst_mid_install: migrate claimed success "
+                      "onto a dying destination")
+    fails = _mig_events(rt, EventType.MIGRATE_FAIL)
+    if not any(e.data.get("fallback") == "replay" for e in fails):
+        errors.append("kill_dst_mid_install: no MIGRATE_FAIL with "
+                      "fallback='replay' — the post-detach fallback "
+                      "never engaged (or engaged silently)")
+    if not all(r.outcome is not None and r.outcome.ok for r in reqs):
+        errors.append("kill_dst_mid_install: a request was lost — the "
+                      "replay fallback dropped it")
+    stats.update(migrations_failed=rt.migrations_failed, log=inj.log)
+    results["kill_dst_mid_install"] = stats
+
+    # ---- wire bit rot: capsule crc chain --------------------------- #
+    # nobody dies — the capsule itself took a flipped byte. The
+    # destination must refuse the install on the broken chain and the
+    # replay fallback must produce a stream bit-identical to
+    # fault-free; the MIGRATE_FAIL reason must NAME the crc chain
+    model = _build_model()
+    rt = _fleet(model, n_replicas, spec_k=0)
+    reqs = _make_requests(n_requests, vocab, seed=42)
+    inj = MigrateFault(at_step=5, mode="corrupt", seed=3)
+    run_fleet_chaos(rt, reqs, [inj])
+    stats = _check_fleet_invariants("corrupt_capsule", rt, reqs,
+                                    baseline, set(), errors)
+    if not inj.fired:
+        errors.append("corrupt_capsule: injector never fired")
+    if inj.migrate_returned is not False:
+        errors.append("corrupt_capsule: a corrupted capsule was "
+                      "installed — the crc chain is not load-bearing")
+    fails = _mig_events(rt, EventType.MIGRATE_FAIL)
+    if not any("crc" in str(e.data.get("reason", "")) and
+               e.data.get("fallback") == "replay" for e in fails):
+        errors.append("corrupt_capsule: MIGRATE_FAIL does not name "
+                      "the broken crc chain with fallback='replay'")
+    if rt.replica_deaths:
+        errors.append("corrupt_capsule: wire corruption killed a "
+                      "replica")
+    if not all(r.outcome is not None and r.outcome.ok for r in reqs):
+        errors.append("corrupt_capsule: a request was lost to wire "
+                      "corruption")
+    stats.update(migrations_failed=rt.migrations_failed, log=inj.log)
+    results["corrupt_capsule"] = stats
+
+    # ---- migrate-vs-cancel race (both orders) ---------------------- #
+    for order in ("before", "after"):
+        tag = f"cancel_race_{order}"
+        model = _build_model()
+        rt = _fleet(model, n_replicas, spec_k=0)
+        reqs = _make_requests(n_requests, vocab, seed=42)
+        inj = MigrateFault(at_step=5, mode="cancel_race", order=order,
+                           seed=3)
+        run_fleet_chaos(rt, reqs, [inj])
+        stats = _check_fleet_invariants(tag, rt, reqs, baseline,
+                                        inj.affected, errors)
+        if not inj.fired:
+            errors.append(f"{tag}: injector never fired")
+        v = inj.victim
+        if v is None or v.outcome is not Outcome.CANCELLED:
+            errors.append(f"{tag}: the raced request ended "
+                          f"{v.outcome if v else None}, not exactly "
+                          f"one CANCELLED terminal")
+        if v is not None:
+            # identity lookup: Request's dataclass __eq__ compares
+            # ndarray fields elementwise, so list.index() would throw
+            base = next((baseline[i] for i, r in enumerate(reqs)
+                         if r is v), None)
+            if base is not None and \
+                    list(v.token_ids) != base[:len(v.token_ids)]:
+                errors.append(f"{tag}: the cancelled stream is not a "
+                              f"prefix of the fault-free stream")
+        survivors = [r for r in reqs if r is not v]
+        if not all(r.outcome is not None and r.outcome.ok
+                   for r in survivors):
+            errors.append(f"{tag}: a bystander was lost to the race")
+        stats.update(migrations=rt.migrations,
+                     migrations_failed=rt.migrations_failed,
+                     log=inj.log)
+        results[tag] = stats
+
+    return results
+
+
+# --------------------------------------------------------------------- #
 # SIGTERM mid-serve (subprocess scenario)
 # --------------------------------------------------------------------- #
 
@@ -1676,6 +1891,12 @@ def main():
                          "corrupt demoted page (DRAM + disk shard), "
                          "disk-full mid-demotion, kill-mid-promotion "
                          "restart (ci/run.sh hiersmoke)")
+    ap.add_argument("--migrate", action="store_true",
+                    help="page-transport scenarios — forced live-slot "
+                         "migration with source death mid-capture, "
+                         "destination death mid-install, capsule crc "
+                         "corruption, and the migrate-vs-cancel race "
+                         "(ci/run.sh migratesmoke)")
     ap.add_argument("--replicas", type=int, default=2,
                     help="fleet size for --fleet scenarios")
     ap.add_argument("--spec-k", type=int, default=_SPEC_K,
@@ -1696,6 +1917,9 @@ def main():
     t0 = time.perf_counter()
     if args.frontend:
         results = run_frontend_scenarios(n, errors)
+    elif args.migrate:
+        results = run_migrate_scenarios(n, errors,
+                                        n_replicas=args.replicas)
     elif args.hier:
         results = run_hier_scenarios(n, errors)
     elif args.tiers:
@@ -1720,9 +1944,10 @@ def main():
         print(f"banked {args.json}")
     if not errors:
         scope = "frontend" if args.frontend else \
-            ("hier" if args.hier else
-             ("tiers" if args.tiers else
-              ("fleet" if args.fleet else "chaos")))
+            ("migrate" if args.migrate else
+             ("hier" if args.hier else
+              ("tiers" if args.tiers else
+               ("fleet" if args.fleet else "chaos"))))
         print(f"{scope}: all scenarios quiescent, isolated, audited, "
               f"compile-clean")
     sys.exit(0 if not errors else 1)

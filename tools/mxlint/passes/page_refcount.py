@@ -24,7 +24,19 @@ Checks:
     demoted page has NO page number and no refcount (free XOR live
     XOR demoted); a tier store that allocs or frees HBM pages is
     conflating the tiers, and freeing a "demoted page" corrupts the
-    free list.
+    free list;
+  - transport internals (``._records`` / ``._chain_crc``) touched
+    outside ``PageCapsule``/``PageTransport`` (serve/transport.py) —
+    the capsule's payload records and crc chain are what ``verify()``
+    vouches for; outside writes could forge a chain the destination
+    would trust (consumers go through ``verify()``/``payloads()``/
+    ``nbytes``, fault injection through the public ``corrupt()``
+    seam);
+  - in-capsule custody (``._capsule_pages``) touched outside
+    ``InferenceEngine`` — a detached slot's pages are the fourth
+    page state (free XOR live XOR demoted XOR in-capsule) and only
+    the engine's ``detach_slot``/``release_capsule`` may move pages
+    across that boundary, or ``audit_pages`` stops meaning anything.
 """
 
 from __future__ import annotations
@@ -42,6 +54,8 @@ _ACQUIRE = {"alloc", "incref"}
 _RELEASE = {"decref", "free", "release_held"}
 _INTERNAL = {"_rc", "_free"}
 _TIER_INTERNAL = {"_entries", "_dram_used", "_disk_used"}
+_TRANSPORT_INTERNAL = {"_records", "_chain_crc"}
+_CUSTODY_INTERNAL = {"_capsule_pages"}
 _ALLOC_MUTATORS = _ACQUIRE | _RELEASE
 
 
@@ -120,6 +134,31 @@ class PageRefcountPass:
                             f"touched outside KVTierStore — demoted-"
                             f"page bookkeeping belongs to the store "
                             f"(read via entries()/tier_bytes())",
+                            symbol=qualname_of(node)))
+                elif isinstance(node, ast.Attribute) \
+                        and node.attr in _TRANSPORT_INTERNAL:
+                    if not (self._inside(node, "PageCapsule") or
+                            self._inside(node, "PageTransport")):
+                        out.append(Finding(
+                            RULE, unit.path, node.lineno,
+                            f"transport internals `.{node.attr}` "
+                            f"touched outside PageCapsule/"
+                            f"PageTransport — an outside write could "
+                            f"forge the crc chain verify() vouches "
+                            f"for (read via verify()/payloads()/"
+                            f"nbytes; inject faults via corrupt())",
+                            symbol=qualname_of(node)))
+                elif isinstance(node, ast.Attribute) \
+                        and node.attr in _CUSTODY_INTERNAL:
+                    if not self._inside(node, "InferenceEngine"):
+                        out.append(Finding(
+                            RULE, unit.path, node.lineno,
+                            f"in-capsule custody `.{node.attr}` "
+                            f"touched outside InferenceEngine — only "
+                            f"detach_slot/release_capsule may move "
+                            f"pages across the in-capsule page state "
+                            f"(free XOR live XOR demoted XOR "
+                            f"in-capsule)",
                             symbol=qualname_of(node)))
         return out
 

@@ -2039,6 +2039,317 @@ def bench_hier_cache(model, *, smoke, errors, personas, prefix_pages,
     return out
 
 
+# --------------------------------------------------------------------- #
+# round-20: page transport (serve/transport.py) — banks
+# BENCH_MIGRATE.json
+# --------------------------------------------------------------------- #
+
+def _admit_prefill_totals(events):
+    """Prefill positions CHARGED across every ADMIT in ``events``: an
+    admission runs positions [cached_len, t0) through the prefill
+    programs, and position t0-1 (the boundary) is forced everywhere —
+    its logits must seed the next sample — so the redone accounting
+    charges ``t0 - 1 - cached_len`` per admission. A migrated install
+    (``cached_len == n_pos == t0 - 1``) charges exactly zero; a replay
+    re-admission charges its whole recomputed prompt+suffix. Returns
+    (total_charged, charged_on_migrated_installs, n_admits)."""
+    from incubator_mxnet_tpu.serve import EventType
+    tot = mig = n = 0
+    for e in events:
+        if e.etype is not EventType.ADMIT:
+            continue
+        n += 1
+        work = max(int(e.data.get("t0") or 0) - 1 -
+                   int(e.data.get("cached_len") or 0), 0)
+        tot += work
+        if e.data.get("migrated"):
+            mig += work
+    return tot, mig, n
+
+
+def bench_drain_migration(model, *, n_requests, prompt_len, max_new,
+                          slots, page_size, rate_hz, drain_after_step,
+                          window_s, errors, smoke):
+    """Drain a replica UNDER LOAD two ways over the same workload and
+    arrival trace at N=2: the page-transport way (``drain_replica`` —
+    decode-ready slots MIGRATE to the sibling, queued attempts are
+    withdrawn, zero redone prefill) vs the pre-transport story (the
+    replica is lost and the router's replay fallback re-queues and
+    RECOMPUTES prompt + delivered suffix). Both arms fire on the same
+    trigger — the victim actually holding >= 2 decode-ready slots
+    (draining an idle replica measures nothing) — and both must lose
+    ZERO requests; greedy decode then makes BOTH arms' token streams
+    bit-identical, which is asserted. Banked: redone prefill tokens
+    (the migrate arm must charge 0, and its migrated installs must
+    charge 0 by construction), completion p50/p99, and the throughput
+    timeline around the event. The prefix cache is OFF here — the
+    prompts are random, so a hit can only be an accidental shared
+    sub-page prefix, which would silently shrink the redone ledger
+    the arm comparison is built on."""
+    from incubator_mxnet_tpu.serve import build_fleet
+    vocab = model.vocab_size
+    eng_kw = dict(num_slots=slots, page_size=page_size, chunk_pages=1,
+                  prefix_cache=False)
+    out = {"config": {"n_requests": n_requests,
+                      "prompt_len": prompt_len, "max_new": max_new,
+                      "slots": slots, "page_size": page_size,
+                      "rate_hz": rate_hz,
+                      "drain_after_step": drain_after_step,
+                      "window_s": window_s}}
+    ideal = (prompt_len - 1) * n_requests
+    tokens_by_arm = {}
+    for arm in ("migrate", "replay"):
+        rt = build_fleet(model, 2, engine_kw=dict(eng_kw), seed=7)
+        wreqs, _ = _make_requests(4, prompt_len, 4, rate_hz, vocab,
+                                  seed=99)
+        rt.run(wreqs)                        # untimed compile warmup
+        tot0, mig0, n0 = _admit_prefill_totals(rt.flight_events())
+        reqs, arrivals = _make_requests(n_requests, prompt_len,
+                                        max_new, rate_hz, vocab,
+                                        seed=42)
+        fired = {}
+        t0 = time.perf_counter()
+
+        def _victim_busy(router):
+            eng = router.replicas[0].engine
+            busy = sum(1 for t in router._inflight
+                       if t.replica == 0
+                       and t.attempt.outcome is None
+                       and eng.decode_ready(t.attempt.request_id))
+            return busy >= 2
+
+        def before(router, i, fired=fired, arm=arm, t0=t0):
+            if i < drain_after_step or "done" in fired:
+                return
+            if "t_s" not in fired:
+                if not _victim_busy(router):
+                    return
+                fired["t_s"] = time.perf_counter() - t0
+                if arm == "replay":
+                    router.replicas[0].kill(
+                        "drain bench replay arm: simulated loss")
+                    fired["done"] = True
+                    return
+                fired["migrated"] = fired["requeued"] = 0
+                fired["passes"] = 0
+            r = router.drain_replica(0)
+            fired["migrated"] += r["migrated"]
+            fired["requeued"] += r["requeued"]
+            fired["passes"] += 1
+            if r["remaining"] == 0 or fired["passes"] >= 50:
+                fired["done"] = True
+
+        rt.run(reqs, arrival_times=arrivals, before_step=before)
+        wall = time.perf_counter() - t0
+        if "t_s" not in fired:
+            errors.append(f"drain_{arm}: the trigger never fired — "
+                          f"the victim replica never held 2 "
+                          f"decode-ready slots")
+        bad = [r for r in reqs if r.outcome is None or not r.outcome.ok]
+        if bad:
+            errors.append(f"drain_{arm}: {len(bad)} requests did not "
+                          f"complete ok (zero lost is the bar)")
+        _fleet_check_compile(f"drain_{arm}", rt, errors)
+        tot1, mig1, n1 = _admit_prefill_totals(rt.flight_events())
+        redone = (tot1 - tot0) - ideal
+        comp = [r.token_stamps[-1] - t0 - arr
+                for r, arr in zip(reqs, arrivals) if r.token_stamps]
+        stamps = sorted(s - t0 for r in reqs for s in r.token_stamps)
+        n_win = max(int(wall / window_s) + 1, 1)
+        counts = [0] * n_win
+        for s in stamps:
+            counts[min(int(s / window_s), n_win - 1)] += 1
+        tokens_by_arm[arm] = [list(r.token_ids) for r in reqs]
+        out[arm] = {
+            "tokens": sum(len(r.token_ids) for r in reqs),
+            "wall_s": wall,
+            "tokens_per_s": sum(len(r.token_ids) for r in reqs) / wall,
+            "completion_p50_ms": _percentile(comp, 50) * 1e3,
+            "completion_p99_ms": _percentile(comp, 99) * 1e3,
+            "event_t_s": fired.get("t_s"),
+            "admits": n1 - n0,
+            "redone_prefill_tokens": redone,
+            "redone_on_migrated_installs": mig1 - mig0,
+            "migrations": rt.migrations,
+            "migrations_failed": rt.migrations_failed,
+            "migrated_pages": rt.migrated_pages,
+            "migrated_bytes": rt.migrated_bytes,
+            "requeues": rt.requeues,
+            "replica_deaths": rt.replica_deaths,
+            "outcomes": {o: cnt for o, cnt in
+                         rt.health_snapshot()["outcomes"].items()
+                         if cnt},
+            "timeline": [{"t_s": round((i + 1) * window_s, 3),
+                          "tokens_per_s": c / window_s}
+                         for i, c in enumerate(counts)],
+        }
+        if arm == "migrate":
+            out[arm]["drain"] = {k: fired.get(k) for k in
+                                 ("migrated", "requeued", "passes")}
+            if fired.get("migrated", 0) < 1:
+                errors.append("drain_migrate: the drain migrated no "
+                              "slots — the victim held no decode-ready "
+                              "work at the trigger (retune the "
+                              "workload)")
+            if redone != 0:
+                errors.append(f"drain_migrate: {redone} prefill "
+                              f"tokens redone — a drain must replay "
+                              f"NOTHING")
+            if mig1 - mig0 != 0:
+                errors.append(f"drain_migrate: migrated installs "
+                              f"charged {mig1 - mig0} prefill tokens "
+                              f"(cached_len must equal t0-1)")
+        else:
+            if rt.replica_deaths != 1:
+                errors.append(f"drain_replay: expected exactly one "
+                              f"replica death, saw {rt.replica_deaths}")
+            if redone <= 0:
+                errors.append(f"drain_replay: redone prefill tokens "
+                              f"{redone} — the replay arm must "
+                              f"recompute (did the kill land before "
+                              f"any work?)")
+    if tokens_by_arm.get("migrate") != tokens_by_arm.get("replay"):
+        errors.append("drain: migrate and replay arms diverged — "
+                      "greedy streams must be bit-identical through "
+                      "either path")
+    out["token_parity"] = (tokens_by_arm.get("migrate") ==
+                           tokens_by_arm.get("replay"))
+    if out.get("replay", {}).get("redone_prefill_tokens", 0) > 0:
+        out["redone_saved_tokens"] = \
+            out["replay"]["redone_prefill_tokens"] - \
+            out["migrate"]["redone_prefill_tokens"]
+    return out
+
+
+def bench_role_split(model, *, n_short, short_len, short_new, n_long,
+                     long_len, long_new, slots, page_size, errors,
+                     smoke):
+    """Disaggregated prefill/decode roles vs a mixed N=2 fleet on the
+    long-prompt-mixed trace — the workload whose prefill/decode
+    interference the role split exists for. In the split arm every
+    prompt prefills on the 'prefill' replica and hands off AT the
+    publication moment (page transport), so the 'decode' replica's
+    inter-token gaps never absorb a prompt; the mixed arm lets long
+    prefills land between its own decode steps. Both arms must lose
+    nothing, and greedy decode must make their token streams
+    bit-identical (a handoff is invisible in the stream). CPU
+    magnitudes are reported, not gated — the interference gap is a
+    device-regime effect."""
+    from incubator_mxnet_tpu.serve import build_fleet
+    vocab = model.vocab_size
+    eng_kw = dict(num_slots=slots, page_size=page_size, chunk_pages=1,
+                  prefix_cache=True)
+    out = {"config": {"n_short": n_short, "short_len": short_len,
+                      "short_new": short_new, "n_long": n_long,
+                      "long_len": long_len, "long_new": long_new,
+                      "slots": slots, "page_size": page_size}}
+    tokens_by_arm = {}
+    for arm, roles in (("mixed", None), ("split", ["prefill",
+                                                   "decode"])):
+        rt = build_fleet(model, 2, engine_kw=dict(eng_kw), seed=7,
+                         roles=roles)
+        wreqs, _ = _make_requests(4, short_len, 4, 50.0, vocab,
+                                  seed=99)
+        rt.run(wreqs)                        # untimed compile warmup
+        reqs, arrivals = _long_mixed_requests(
+            n_short, short_len, short_new, n_long, long_len, long_new,
+            vocab, long_at0=0.05, long_gap=0.1)
+        t0 = time.perf_counter()
+        rt.run(reqs, arrival_times=arrivals)
+        wall = time.perf_counter() - t0
+        bad = [r for r in reqs if r.outcome is None or not r.outcome.ok]
+        if bad:
+            errors.append(f"role_{arm}: {len(bad)} requests did not "
+                          f"complete ok")
+        _fleet_check_compile(f"role_{arm}", rt, errors)
+        itl = _itl_gaps(reqs)
+        tokens_by_arm[arm] = [list(r.token_ids) for r in reqs]
+        out[arm] = {
+            "tokens": sum(len(r.token_ids) for r in reqs),
+            "wall_s": wall,
+            "tokens_per_s": sum(len(r.token_ids) for r in reqs) / wall,
+            "itl_p50_ms": _percentile(itl, 50) * 1e3,
+            "itl_p99_ms": _percentile(itl, 99) * 1e3,
+            "migrations": rt.migrations,
+            "migrations_failed": rt.migrations_failed,
+            "migrated_pages": rt.migrated_pages,
+            "requeues": rt.requeues,
+            "outcomes": {o: cnt for o, cnt in
+                         rt.health_snapshot()["outcomes"].items()
+                         if cnt},
+        }
+        if arm == "split" and rt.migrations < 1:
+            errors.append("role_split: the prefill replica handed "
+                          "nothing off — the role stream is not "
+                          "migrating")
+    if tokens_by_arm.get("mixed") != tokens_by_arm.get("split"):
+        errors.append("role_split: mixed and split arms diverged — "
+                      "the handoff must be invisible in a greedy "
+                      "stream")
+    out["token_parity"] = (tokens_by_arm.get("mixed") ==
+                           tokens_by_arm.get("split"))
+    if out.get("split", {}).get("itl_p99_ms"):
+        out["itl_p99_mixed_over_split"] = (
+            out["mixed"]["itl_p99_ms"] / out["split"]["itl_p99_ms"])
+    return out
+
+
+def bench_capsule_bytes(model, *, prompt_len, decode_steps, page_size,
+                        errors):
+    """Wire bytes of one captured slot, quantized vs raw pools: the
+    capsule ships a quantized pool's int8 codes + per-page scales
+    (~1/4 the raw f32 page), so disaggregation bandwidth rides the
+    round-14 quantization for free. Same prompt, same emitted-token
+    count on both engines (the capture trigger counts tokens, not
+    content — quantization may flip a token, never a length), so the
+    page counts must match and the byte ratio is pure encoding."""
+    import numpy as np
+    from incubator_mxnet_tpu.serve import (InferenceEngine,
+                                           PageTransport, Request)
+    out = {"config": {"prompt_len": prompt_len,
+                      "decode_steps": decode_steps,
+                      "page_size": page_size}}
+    for name, kvq in (("raw", None), ("int8", "int8")):
+        eng = InferenceEngine(model, num_slots=2, page_size=page_size,
+                              prefix_cache=False, kv_quant=kvq)
+        rng = np.random.RandomState(5)
+        req = Request(rng.randint(0, model.vocab_size,
+                                  size=(prompt_len,)).astype(np.int32),
+                      max_new_tokens=decode_steps + 8)
+        if not eng.submit(req):
+            errors.append(f"capsule_bytes.{name}: submit refused")
+            continue
+        guard = 0
+        while len(req.token_ids) < decode_steps and guard < 100:
+            eng.step()
+            guard += 1
+        tr = PageTransport()
+        cap = tr.capture(eng, req.request_id)
+        if cap is None:
+            errors.append(f"capsule_bytes.{name}: capture refused on "
+                          f"a decode-ready slot")
+            continue
+        out[name] = {"pages": cap.num_pages, "n_pos": cap.n_pos,
+                     "nbytes": cap.nbytes,
+                     "bytes_per_page": cap.nbytes /
+                     max(cap.num_pages, 1)}
+        eng.release_capsule(req.request_id)
+        eng.audit_pages()
+    if "raw" in out and "int8" in out:
+        if out["raw"]["pages"] != out["int8"]["pages"]:
+            errors.append(f"capsule_bytes: page counts diverged "
+                          f"({out['raw']['pages']} raw vs "
+                          f"{out['int8']['pages']} int8) — the byte "
+                          f"ratio is meaningless")
+        ratio = out["raw"]["nbytes"] / max(out["int8"]["nbytes"], 1)
+        out["raw_over_int8_bytes"] = ratio
+        if ratio < 2.0:
+            errors.append(f"capsule_bytes: quantized capsule only "
+                          f"{ratio:.2f}x smaller than raw — the wire "
+                          f"is not shipping codes+scales")
+    return out
+
+
 def _check_compile_discipline(tag, stats, errors):
     if stats["decode_trace_count"] != 1:
         errors.append(f"{tag}: decode step compiled "
@@ -2090,6 +2401,14 @@ def main():
                          "prefill, token parity, lower-tier hit rate) "
                          "— banks BENCH_HIER.json; with --smoke this "
                          "is the hiersmoke CI stage")
+    ap.add_argument("--migrate", action="store_true",
+                    help="round-20 page-transport workloads ONLY "
+                         "(drain-a-replica-under-load: migrate vs "
+                         "replay redone prefill + completion "
+                         "percentiles, prefill/decode role split vs "
+                         "mixed, quantized vs raw capsule wire bytes) "
+                         "— banks BENCH_MIGRATE.json; with --smoke "
+                         "this is the migratesmoke CI stage")
     ap.add_argument("--frontend", action="store_true",
                     help="round-18 HTTP/SSE front-end workloads ONLY "
                          "(protocol overhead vs direct Router.submit, "
@@ -2131,6 +2450,48 @@ def main():
         if out is None and not args.smoke:
             out = os.path.join(os.path.dirname(os.path.dirname(
                 os.path.abspath(__file__))), "BENCH_HIER.json")
+        if out:
+            with open(out, "w") as f:
+                json.dump(result, f, indent=2)
+                f.write("\n")
+            print(f"banked {out}")
+        sys.exit(0 if not errors else 1)
+
+    if args.migrate:
+        model = _build(max_length=256)
+        if args.smoke:
+            dr_cfg = dict(n_requests=12, prompt_len=24, max_new=12,
+                          slots=4, page_size=8, rate_hz=60.0,
+                          drain_after_step=6, window_s=0.25)
+            rs_cfg = dict(n_short=4, short_len=8, short_new=16,
+                          n_long=1, long_len=96, long_new=4, slots=4,
+                          page_size=8)
+            cb_cfg = dict(prompt_len=24, decode_steps=4, page_size=8)
+        else:
+            dr_cfg = dict(n_requests=48, prompt_len=48, max_new=32,
+                          slots=args.slots, page_size=8, rate_hz=40.0,
+                          drain_after_step=20, window_s=0.5)
+            rs_cfg = dict(n_short=8, short_len=16, short_new=64,
+                          n_long=6, long_len=192, long_new=8,
+                          slots=args.slots, page_size=args.page_size)
+            cb_cfg = dict(prompt_len=96, decode_steps=8,
+                          page_size=args.page_size)
+        result = {"config": {"smoke": args.smoke,
+                             "backend": os.environ.get("JAX_PLATFORMS",
+                                                       "cpu")}}
+        result["drain_migration"] = bench_drain_migration(
+            model, smoke=args.smoke, errors=errors, **dr_cfg)
+        result["role_split"] = bench_role_split(
+            model, smoke=args.smoke, errors=errors, **rs_cfg)
+        result["capsule_bytes"] = bench_capsule_bytes(
+            model, errors=errors, **cb_cfg)
+        print(json.dumps(result, indent=2))
+        for e in errors:
+            print(f"FAIL: {e}", file=sys.stderr)
+        out = args.json
+        if out is None and not args.smoke:
+            out = os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "BENCH_MIGRATE.json")
         if out:
             with open(out, "w") as f:
                 json.dump(result, f, indent=2)
