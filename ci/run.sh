@@ -54,9 +54,9 @@ stage_stepbench() {
 }
 
 stage_mfubench() {
-  echo "== mfubench: training-throughput regression guard (round 16 —"
-  echo "             the microbatch-accumulation program must compile"
-  echo "             exactly once across accumulation counts {1,4},"
+  echo "== mfubench: training-throughput regression guard (round 16"
+  echo "             gates: the microbatch-accumulation program must"
+  echo "             compile exactly once across accumulation counts,"
   echo "             a non-finite microbatch must veto the WHOLE"
   echo "             accumulated apply as one outcome with params"
   echo "             bit-identical, the guarded accumulated trajectory"
@@ -64,7 +64,20 @@ stage_mfubench() {
   echo "             streams, the overlapped bucket issue order must be"
   echo "             deterministic and equal to the plan order, and"
   echo "             every banked arm must carry tokens/s AND an MFU"
-  echo "             field computed from the same run)"
+  echo "             field computed from the same run."
+  echo "             Round-19 pipelined gates: the in-program overlapped"
+  echo "             step on dp2 AND fsdp2 must (a) compile its"
+  echo "             microbatch program exactly once across accumulation"
+  echo "             counts {1,4,8}, (b) hold loss+param parity with the"
+  echo "             paired GSPMD baseline over 3 steps — BITWISE on dp2,"
+  echo "             allclose under fsdp (GSPMD's per-dot contraction"
+  echo "             choice for sharded params is shape-regime noise),"
+  echo "             (c) show structural overlap in StableHLO: grad"
+  echo "             collectives in plan_grad_buckets order with backward"
+  echo "             dots strictly between them (CPU-checkable); the int8"
+  echo "             grad all-reduce must stay within 5% convergence"
+  echo "             divergence of f32, and any arm tagged arm_kind="
+  echo "             overlap that issues 0 buckets fails the stage)"
   JAX_PLATFORMS=cpu python tools/step_bench.py --mfu --smoke
 }
 

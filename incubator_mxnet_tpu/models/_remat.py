@@ -9,12 +9,14 @@ pass. Params enter via closure → saved as residuals, not recomputed."""
 
 from __future__ import annotations
 
+import math
+
 import jax
 
 from .. import random as _rand
 from ..ndarray import NDArray
 
-__all__ = ["remat_call", "resolve_policy"]
+__all__ = ["remat_call", "resolve_policy", "plan_remat_from_profile"]
 
 
 def resolve_policy(remat):
@@ -31,6 +33,50 @@ def resolve_policy(remat):
         raise ValueError(
             f"remat must be False, True, or 'dots'; got {remat!r}")
     return None
+
+
+def plan_remat_from_profile(stats, num_blocks):
+    """Derive a per-block remat plan from a measured overlap profile.
+
+    ``stats`` is ``tools.trace_summary.overlap_stats(trace_dir)`` — the
+    per-lane compute/collective split of a real profile. Returns a list
+    of ``num_blocks`` entries (``False`` | ``"dots"`` | ``True``)
+    suitable for ``SPMDTrainer(remat_plan=...)``, which wraps each
+    pipeline block in ``jax.checkpoint`` with the matching policy
+    (parallel/pipelined.py).
+
+    Heuristic, keyed on the EXPOSED fraction (collective time the
+    backward failed to hide, relative to compute):
+
+      exposed/compute < 0.05  → no remat: collectives already overlap,
+                                extra recompute only slows the step.
+      exposed/compute < 0.25  → ``"dots"`` everywhere: cheap recompute
+                                (elementwise/norm only) lengthens each
+                                block's backward a little, giving the
+                                in-flight bucket reductions more compute
+                                to hide behind, and frees activation HBM.
+      otherwise               → full remat on the EARLIEST
+                                ``ceil(frac * num_blocks)`` blocks (they
+                                backward LAST, exactly when the deep
+                                buckets drain and exposure concentrates)
+                                and ``"dots"`` on the rest.
+
+    A profile with no compute attribution (e.g. cpu_mode traces) maps to
+    no remat — never guess from an empty window."""
+    num_blocks = int(num_blocks)
+    if num_blocks <= 0:
+        return []
+    compute = float(stats.get("compute_us") or 0.0)
+    exposed = float(stats.get("exposed_us") or 0.0)
+    if compute <= 0.0:
+        return [False] * num_blocks
+    frac = exposed / compute
+    if frac < 0.05:
+        return [False] * num_blocks
+    if frac < 0.25:
+        return ["dots"] * num_blocks
+    n_full = min(num_blocks, max(1, math.ceil(min(frac, 1.0) * num_blocks)))
+    return [True] * n_full + ["dots"] * (num_blocks - n_full)
 
 
 def remat_call(block, *args, policy=None):

@@ -35,7 +35,8 @@ from ..gluon.block import HybridBlock
 from .. import initializer as init
 
 __all__ = ["BERTModel", "BERTForPretraining", "BERTClassifier",
-           "bert_base", "bert_large", "bert_tiny"]
+           "bert_base", "bert_large", "bert_tiny",
+           "pretraining_pipeline"]
 
 
 class BERTSelfAttention(HybridBlock):
@@ -348,6 +349,84 @@ def pretraining_loss(model: BERTForPretraining, input_ids, token_types,
     nsp_logp = nsp_scores.log_softmax(axis=-1)
     nsp_loss = -nsp_logp.pick(nsp_labels, axis=-1).mean()
     return mlm_loss + nsp_loss
+
+
+def pretraining_pipeline(model: BERTForPretraining):
+    """PipelineSpec for ``pretraining_loss`` under the pipelined SPMD
+    step (parallel/pipelined.py): stem = embeddings + embedding LN/
+    dropout, one pipeline block per encoder layer (attention mask and
+    valid_length ride the parameter-free context), head = pooler + MLM
+    transform/decoder + NSP with the MLM/NSP losses emitted as LOCAL
+    partial sums. Batch layout matches ``pretraining_loss``:
+    (input_ids, token_types, valid_length, masked_positions,
+    masked_labels, masked_weights, nsp_labels). Stem/head replicate the
+    forward op-for-op so loss/grads are bitwise vs the GSPMD step."""
+    from ..parallel.pipelined import PipelineSpec
+    from ..gluon.block import nd as F
+    bert = model.bert
+
+    def stem(input_ids, token_types, valid_length, *rest):
+        from ..parallel.spmd import constrain
+        B, T = input_ids.shape
+        pos = F.arange(0, T, dtype="int32").reshape((1, T)) \
+            .broadcast_to((B, T))
+        emb = bert.word_embed(input_ids) + bert.position_embed(pos)
+        if token_types is not None:
+            emb = emb + bert.token_type_embed(token_types)
+        emb = constrain(emb, ("dp", "fsdp"), None, None)
+        if bert._dtype != "float32":
+            emb = emb.astype(bert._dtype)
+        return bert.embed_dropout(bert.embed_ln(emb))
+
+    def context(input_ids, token_types, valid_length, *rest):
+        T = input_ids.shape[1]
+        mask = None
+        if valid_length is not None:
+            ar = F.arange(0, T, dtype="float32").reshape((1, T))
+            mask = (ar < valid_length.astype("float32").reshape((-1, 1)))
+        return (mask, valid_length)
+
+    def head(x, input_ids, token_types, valid_length, masked_positions,
+             masked_labels, masked_weights, nsp_labels):
+        from ..parallel.spmd import constrain
+        B, T = x.shape[0], x.shape[1]
+        cls = x._op("slice_axis", axis=1, begin=0, end=1).reshape(
+            (B, bert._units)).astype("float32")
+        pooled = constrain(bert.pooler(cls), ("dp", "fsdp"), None)
+        onehot = F.one_hot(masked_positions, depth=T, dtype=bert._dtype)
+        gathered = F.batch_dot(onehot, x)
+        h = constrain(model.mlm_transform(gathered.astype("float32")),
+                      ("dp", "fsdp"), None, None)
+        h = F.gelu(h)
+        h = constrain(model.mlm_ln(h), ("dp", "fsdp"), None, None)
+        embed_w = bert.word_embed.weight.data()
+        dt = bert._dtype
+        scores = F.dot(h.astype(dt), embed_w.astype(dt),
+                       transpose_b=True) + model.mlm_bias.data().astype(dt)
+        scores = constrain(scores, ("dp", "fsdp"), None, "tp")
+        nsp_scores = model.nsp(pooled)
+        label_scores = scores.pick(masked_labels, axis=-1)   # (B, M)
+        lse = scores._op("logsumexp", axis=-1)
+        mlm_ll = label_scores.astype("float32") - lse
+        nsp_logp = nsp_scores.log_softmax(axis=-1)
+        nsp_pick = nsp_logp.pick(nsp_labels, axis=-1)        # (B,)
+        return ((mlm_ll * masked_weights).sum(), masked_weights.sum(),
+                nsp_pick.sum(), NDArray(jnp.float32(nsp_pick._data.size)))
+
+    def finalize(n_mlm, d_mlm, n_nsp, d_nsp):
+        # mirrors pretraining_loss: mlm_loss + nsp_loss, with the MLM
+        # denominator's +1e-6 applied to the GLOBAL weight sum
+        return -(n_mlm / (d_mlm + 1e-6)) - (n_nsp / d_nsp)
+
+    blocks = [getattr(bert, f"layer{i}") for i in range(bert.num_layers)]
+    return PipelineSpec(
+        blocks=blocks, head=head, finalize=finalize, stem=stem,
+        context=context,
+        stem_modules=[bert.word_embed, bert.token_type_embed,
+                      bert.position_embed, bert.embed_ln],
+        head_modules=[bert.pooler, model.mlm_transform, model.mlm_ln,
+                      model.nsp, model.mlm_bias, bert.word_embed],
+        name="bert_pretrain")
 
 
 def bert_tiny(vocab_size=1024, max_length=128, **kwargs) -> BERTModel:

@@ -29,7 +29,7 @@ from ..ndarray import NDArray
 from .. import initializer as init
 from .. import random as _rand
 
-__all__ = ["GPTModel", "gpt_mini", "gpt_small", "lm_loss",
+__all__ = ["GPTModel", "gpt_mini", "gpt_small", "lm_loss", "lm_pipeline",
            "greedy_generate", "cached_generate", "init_kv_cache",
            "decode_forward"]
 
@@ -219,6 +219,65 @@ def lm_loss(model: GPTModel, input_ids, labels, weights=None):
         return -ll.mean()
     denom = weights.sum() + 1e-6
     return -(ll * weights).sum() / denom
+
+
+def lm_pipeline(model: GPTModel, weighted: bool = False):
+    """PipelineSpec for ``lm_loss`` training under the pipelined SPMD
+    step (parallel/pipelined.py): stem = embeddings, one pipeline block
+    per transformer layer, head = final norm + tied vocab projection +
+    the next-token CE as LOCAL partial sums.
+
+    ``weighted`` selects the ``lm_loss(..., weights=...)`` form (batch =
+    (input_ids, labels, weights)); default mirrors the plain mean form
+    (batch = (input_ids, labels)). The stem/head bodies replicate
+    ``GPTModel.hybrid_forward`` + ``lm_loss`` op-for-op so the pipelined
+    loss/gradients are bitwise-identical to the GSPMD step."""
+    from ..parallel.pipelined import PipelineSpec
+    from ..gluon.block import nd as F
+
+    def stem(input_ids, *rest):
+        from ..parallel.spmd import constrain
+        B, T = input_ids.shape
+        pos = F.arange(0, T, dtype="int32").reshape((1, T)) \
+            .broadcast_to((B, T))
+        x = model.word_embed(input_ids) + model.position_embed(pos)
+        x = constrain(x, ("dp", "fsdp"), None, None)
+        x = model.embed_dropout(x)
+        if model._dtype != "float32":
+            x = x.astype(model._dtype)
+        return x
+
+    def head(x, input_ids, labels, *rest):
+        from ..parallel.spmd import constrain
+        x = model.ln_f(x)
+        embed_w = model.word_embed.weight.data()
+        logits = F.dot(x, embed_w.astype(x.dtype), transpose_b=True)
+        logits = constrain(logits, ("dp", "fsdp"), None, "tp")
+        label_scores = logits.pick(labels, axis=-1)        # (B, T)
+        lse = logits._op("logsumexp", axis=-1)
+        ll = label_scores.astype("float32") - lse
+        if weighted:
+            if not rest:
+                raise MXNetError(
+                    "lm_pipeline(weighted=True) expects batch = "
+                    "(input_ids, labels, weights)")
+            w = rest[0]
+            return ((ll * w).sum(), w.sum())
+        return (ll.sum(), NDArray(jnp.float32(ll._data.size)))
+
+    if weighted:
+        def finalize(n, d):
+            return -(n / (d + 1e-6))
+    else:
+        def finalize(n, d):
+            return -(n / d)
+
+    blocks = [getattr(model, f"block{i}") for i in range(model.num_layers)]
+    return PipelineSpec(
+        blocks=blocks, head=head, finalize=finalize, stem=stem,
+        stem_modules=[model.word_embed, model.position_embed],
+        head_modules=[model.ln_f, model.word_embed],
+        name="gpt_lm")
 
 
 def greedy_generate(model: GPTModel, prompt_ids, max_new_tokens=32,

@@ -59,11 +59,22 @@ from ..base import getenv_bool
 from ..ndarray import NDArray
 
 __all__ = ["apply_updates", "FusedApplier", "hyperparam_signature",
-           "all_finite"]
+           "all_finite", "norm_based"]
 
 
 def _is_nd(x):
     return isinstance(x, NDArray)
+
+
+def norm_based(optimizer) -> bool:
+    """True for optimizers whose update rule reads a GLOBAL weight/grad
+    norm (LAMB/LARS trust ratios). Those updates are only correct over
+    full parameter values: under fsdp the pipelined step applies updates
+    on shard-local slices, where a per-shard norm would silently change
+    the trust ratio — parallel/pipelined.py rejects the combination via
+    this one shared predicate so the two trainers cannot drift."""
+    name = type(optimizer).__name__.lower()
+    return any(t in name for t in ("lamb", "lars"))
 
 
 def all_finite(grad_vals):

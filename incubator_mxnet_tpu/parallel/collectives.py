@@ -48,6 +48,68 @@ def reduce_scatter(x, axis_name: str, scatter_dimension: int = 0,
                             scatter_dimension=scatter_dimension, tiled=tiled)
 
 
+def ring_allreduce_flat(flat, axis_name: str, axis_size: int):
+    """Chunked ring all-reduce of a flat f32 buffer over one mesh axis
+    via `lax.ppermute` (reduce-scatter pass then all-gather pass) — the
+    escape hatch for schedulers that cluster `all-reduce` ops but leave
+    `collective-permute` chains alone (ISSUE 19 tentpole; used by the
+    pipelined step's ``grad_collective='ring'`` mode). At 2 devices each
+    chunk's sum is one commutative add, so the result is bitwise the
+    psum value."""
+    s = int(axis_size)
+    if s == 1:
+        return flat
+    n = flat.size
+    chunk = -(-n // s)
+    buf = jnp.pad(flat, (0, chunk * s - n)).reshape(s, chunk)
+    r = lax.axis_index(axis_name)
+    fwd = [(i, (i + 1) % s) for i in range(s)]
+
+    def row(i):
+        return lax.dynamic_slice_in_dim(buf, i % s, 1, axis=0)[0]
+
+    # reduce-scatter: after s-1 hops rank r holds the full sum of
+    # chunk (r+1) % s
+    partial = row(r)
+    for t in range(s - 1):
+        partial = lax.ppermute(partial, axis_name, fwd)
+        partial = partial + row(r - t - 1)
+    # all-gather: circulate the reduced chunks back around the ring
+    owned = (r + 1) % s
+    out = jnp.zeros_like(buf)
+    out = lax.dynamic_update_slice_in_dim(out, partial[None], owned, 0)
+    for t in range(s - 1):
+        partial = lax.ppermute(partial, axis_name, fwd)
+        out = lax.dynamic_update_slice_in_dim(
+            out, partial[None], (owned - t - 1) % s, 0)
+    return out.reshape(-1)[:n]
+
+
+def int8_bucket_allreduce(vals, reduce_axes):
+    """EQuARX-style traced quantized all-reduce of one gradient bucket:
+    ONE symmetric per-bucket scale from the GLOBAL amax (pmax over the
+    batch axes), int32 code psum, dequantize. Returns the reduced member
+    list in order.
+
+    The scale is shared across every member of the bucket so the whole
+    bucket ships as one int32 psum; a non-finite gradient anywhere
+    poisons the amax → the scale → every dequantized member, which is
+    exactly what lets the PR-8 guard (reading the dequantized grads)
+    veto the step without a second reduction."""
+    from ..ops.quantization import (dequantize_symmetric,
+                                    quantize_symmetric, symmetric_scale)
+    amax = jnp.max(jnp.stack(
+        [jnp.max(jnp.abs(v.astype(jnp.float32))) for v in vals]))
+    amax = lax.pmax(amax, reduce_axes)
+    scale = symmetric_scale(amax)
+    codes = tuple(
+        quantize_symmetric(v.astype(jnp.float32), scale)
+        .astype(jnp.int32) for v in vals)
+    summed = lax.psum(codes, reduce_axes)
+    return [dequantize_symmetric(c, scale).astype(v.dtype)
+            for c, v in zip(summed, vals)]
+
+
 # ----------------------------------------------------------------------- #
 # host-level eager collectives (the KVStore facade's transport)
 # ----------------------------------------------------------------------- #

@@ -186,6 +186,24 @@ class SPMDTrainer:
     sharding : 'replicated' | 'fsdp'.
     forward_loss : optional ``fn(block, *batch) -> scalar NDArray`` override
         for models whose loss is not ``loss(block(x), y)`` (e.g. BERT MLM).
+    pipeline : optional ``parallel.pipelined.PipelineSpec`` — run the
+        step as the pipelined-backward program with in-program bucket
+        collectives interleaved between block pullbacks (ROADMAP item 5;
+        bit-identical to the GSPMD step on clean streams, asserted in
+        tests). ``forward_loss``/``loss`` are ignored when set: the
+        spec's head/finalize ARE the loss.
+    int8_allreduce : traced in-program int8 gradient all-reduce
+        (quantize → psum int32 codes → dequantize, per-bucket scale;
+        the PR-11 compression promoted from host-side seam to program
+        ops). Default from ``MXTPU_INT8_ALLREDUCE``. Pipeline-only.
+    grad_collective : 'psum' (default) or 'ring' — how pipelined bucket
+        collectives are emitted; 'ring' uses a collective-permute chunk
+        ring for schedulers that cluster all-reduce ops. Env:
+        ``MXTPU_GRAD_COLLECTIVE``.
+    remat_plan : optional per-pipeline-block remat policy list (entries
+        False | True | 'dots'), e.g. from
+        ``models._remat.plan_remat_from_profile`` fed by
+        ``trace_summary overlap_stats``. Pipeline-only.
     """
 
     def __init__(self, block, loss=None, optimizer="sgd",
@@ -194,9 +212,12 @@ class SPMDTrainer:
                  forward_loss: Optional[Callable] = None,
                  donate: bool = True, loss_scaler=None,
                  guard: Optional[bool] = None,
-                 max_consecutive_nonfinite: Optional[int] = None):
-        if loss is None and forward_loss is None:
-            raise MXNetError("provide loss or forward_loss")
+                 max_consecutive_nonfinite: Optional[int] = None,
+                 pipeline=None, int8_allreduce: Optional[bool] = None,
+                 grad_collective: Optional[str] = None,
+                 remat_plan: Optional[Sequence] = None):
+        if loss is None and forward_loss is None and pipeline is None:
+            raise MXNetError("provide loss, forward_loss or pipeline")
         self.block = block
         self.loss = loss
         self.forward_loss = forward_loss
@@ -226,6 +247,39 @@ class SPMDTrainer:
         # accumulation — ONE once-compiled microbatch program whose
         # accumulation count is pure host data (see step_microbatches)
         self.accum_step_trace_count = 0
+        # round 19 (ROADMAP item 5): pipelined-backward step with
+        # in-program bucket collectives (parallel/pipelined.py)
+        self._pipeline = pipeline
+        if int8_allreduce is None:
+            int8_allreduce = getenv_bool("MXTPU_INT8_ALLREDUCE", False)
+        self._int8_allreduce = bool(int8_allreduce)
+        if grad_collective is None:
+            import os
+            grad_collective = os.environ.get(
+                "MXTPU_GRAD_COLLECTIVE", "psum")
+        if grad_collective not in ("psum", "ring"):
+            raise MXNetError(
+                f"grad_collective must be 'psum' or 'ring', got "
+                f"{grad_collective!r}")
+        if grad_collective == "ring" and self._int8_allreduce:
+            raise MXNetError(
+                "int8_allreduce composes with grad_collective='psum' "
+                "only (the ring carries f32 chunks)")
+        self._grad_collective = grad_collective
+        self._remat_plan = list(remat_plan) if remat_plan is not None \
+            else None
+        if pipeline is None and (self._int8_allreduce
+                                 or remat_plan is not None):
+            raise MXNetError(
+                "int8_allreduce / remat_plan require pipeline= (the "
+                "GSPMD step has no in-program collective seam)")
+        self.pipelined_step_trace_count = 0
+        self.pipelined_accum_step_trace_count = 0
+        self.pipelined_issue_ledger = None   # set at trace time
+        self.pipelined_bucket_order = None
+        self._pipe_lowering = False          # suppress counters in .lower
+        self._pipe_example_args = None       # ShapeDtypeStruct snapshot
+        self._pipe_example_accum_args = None
         self._accum_step_fn = None
         self._accum_bufs = None      # f32 grad accumulators (jax arrays)
         self._accum_ok = None        # carried combined-verdict scalar
@@ -282,7 +336,62 @@ class SPMDTrainer:
         snap["step_trace_count"] = self.step_trace_count
         snap["accum_step_trace_count"] = self.accum_step_trace_count
         snap["last_accum_count"] = self.last_accum_count
+        if self._pipeline is not None:
+            snap["pipelined"] = True
+            snap["pipelined_step_trace_count"] = \
+                self.pipelined_step_trace_count
+            snap["pipelined_accum_step_trace_count"] = \
+                self.pipelined_accum_step_trace_count
+            snap["pipelined_bucket_order"] = self.pipelined_bucket_order
+            snap["grad_collective"] = self._grad_collective
+            snap["int8_allreduce"] = self._int8_allreduce
         return snap
+
+    # -- pipelined-step structure surface (parallel/pipelined.py) ------- #
+    @staticmethod
+    def _abstract_args(args, static=frozenset()):
+        """Freeze a call's arguments as ShapeDtypeStructs (static
+        positions kept verbatim) so `.lower()` can re-derive the HLO
+        later without holding donated buffers alive."""
+
+        def _abs(a):
+            return jax.ShapeDtypeStruct(jnp.shape(a),
+                                        jnp.result_type(a))
+        return tuple(
+            a if pos in static else jtu.tree_map(_abs, a)
+            for pos, a in enumerate(args))
+
+    def pipelined_hlo(self, accum: bool = False) -> str:
+        """Lowered StableHLO text of the pipelined step program (the
+        substrate of the structural overlap assertion). Requires one
+        prior dispatch (step / step_microbatches) so the example
+        signature exists. The re-trace for lowering is excluded from
+        the trace counters (`_pipe_lowering`)."""
+        if self._pipeline is None:
+            raise MXNetError("pipelined_hlo: trainer has no pipeline=")
+        fn = self._accum_step_fn if accum else self._step_fn
+        args = self._pipe_example_accum_args if accum \
+            else self._pipe_example_args
+        if fn is None or args is None:
+            raise MXNetError(
+                "pipelined_hlo: run one step first (the lowering "
+                "snapshot is captured at first dispatch)")
+        self._pipe_lowering = True
+        try:
+            return fn.lower(*args).as_text()
+        finally:
+            self._pipe_lowering = False
+
+    def pipelined_structure(self, accum: bool = False) -> dict:
+        """`pipelined.structure_report` over the compiled program: grad
+        collectives present per bucket, in plan order, interleaved
+        between block backwards (not clustered after them)."""
+        from .pipelined import structure_report
+        if self.pipelined_issue_ledger is None:
+            raise MXNetError(
+                "pipelined_structure: run one step first")
+        return structure_report(self.pipelined_hlo(accum=accum),
+                                self.pipelined_issue_ledger)
 
     # ------------------------------------------------------------------ #
     def _materialize(self, batch_nds):
@@ -602,7 +711,13 @@ class SPMDTrainer:
         if self._opt_state is None:
             self._materialize(rounds[0])
         if self._accum_step_fn is None:
-            self._accum_step_fn = self._build_accum_step(len(rounds[0]))
+            if self._pipeline is not None:
+                from .pipelined import build_pipelined_accum_step
+                self._accum_step_fn = build_pipelined_accum_step(
+                    self, len(rounds[0]))
+            else:
+                self._accum_step_fn = self._build_accum_step(
+                    len(rounds[0]))
         if self._accum_bufs is None:
             # f32 accumulators placed with their parameter's sharding
             repl, _, train_sh, _, _ = self._step_shardings()
@@ -650,6 +765,14 @@ class SPMDTrainer:
                     [b._data for b in batch_nds])
                 if jax.process_count() > 1:
                     key = _host_np.asarray(key)
+                if self._pipeline is not None and \
+                        self._pipe_example_accum_args is None:
+                    self._pipe_example_accum_args = self._abstract_args(
+                        (train_vals, frozen_vals, tuple(opt_leaves),
+                         opt_tree, tuple(self._accum_bufs),
+                         self._accum_ok, self._accum_loss, t, lr,
+                         scale, inv_k, is_last, key)
+                        + tuple(batch_vals), static={3})
                 (new_train, aux, new_leaves, acc_out, acc_ok_out,
                  acc_loss_out, loss_report, ok_report) = \
                     self._accum_step_fn(
@@ -741,7 +864,12 @@ class SPMDTrainer:
         if self._opt_state is None:
             self._materialize(batch_nds)
         if self._step_fn is None:
-            self._step_fn = self._build_step(len(batch_nds))
+            if self._pipeline is not None:
+                from .pipelined import build_pipelined_step
+                self._step_fn = build_pipelined_step(
+                    self, len(batch_nds))
+            else:
+                self._step_fn = self._build_step(len(batch_nds))
 
         train_vals = tuple(self._params[i]._data._data
                            for i in self._train_idx)
@@ -763,6 +891,11 @@ class SPMDTrainer:
         batch_vals = self._global_batch_vals([b._data for b in batch_nds])
         if jax.process_count() > 1:
             key = _host_np.asarray(key)
+        if self._pipeline is not None and self._pipe_example_args is None:
+            # abstract snapshot for on-demand .lower() (structure checks)
+            self._pipe_example_args = self._abstract_args(
+                (train_vals, frozen_vals, tuple(opt_leaves), opt_tree,
+                 t, lr, scale, key) + tuple(batch_vals), static={3})
 
         self._recorder.open_step()
         try:

@@ -40,15 +40,23 @@ __all__ = ["transformer_train_flops", "gpt_train_flops",
            "peak_flops_per_device", "mfu"]
 
 # bf16 peak FLOPs per chip by TPU generation (datasheet numbers; the
-# device_kind strings match jax.devices()[0].device_kind)
+# device_kind strings match jax.devices()[0].device_kind). Runtimes have
+# reported the same chip under several spellings across libtpu releases
+# ("TPU v5 lite" vs "TPU v5e", "TPU v6 lite" vs "TPU v6e", "TPU v5" for
+# v5p pods), so each generation lists its known variants — matching is
+# longest-prefix so "TPU v5 lite" never falls into the bare "TPU v5" row.
 _TPU_PEAK_BF16 = {
     "TPU v2": 45e12,
     "TPU v3": 123e12,
     "TPU v4": 275e12,
     "TPU v5 lite": 197e12,
     "TPU v5e": 197e12,
+    "TPU v5litepod": 197e12,
     "TPU v5p": 459e12,
+    "TPU v5": 459e12,
+    "TPU v6 lite": 918e12,
     "TPU v6e": 918e12,
+    "TPU v6": 918e12,
 }
 
 _CPU_PEAK_CACHE: Optional[float] = None
@@ -165,15 +173,31 @@ def peak_flops_per_device() -> dict:
     "device_kind": str}``. ``MXTPU_PEAK_FLOPS`` overrides."""
     import jax
 
-    kind = jax.devices()[0].device_kind
+    dev = jax.devices()[0]
+    kind = dev.device_kind
     env = os.environ.get("MXTPU_PEAK_FLOPS")
     if env:
         return {"flops": float(env), "source": "env",
                 "device_kind": kind}
-    for k, v in _TPU_PEAK_BF16.items():
+    # longest-prefix match so variant spellings ("TPU v5 lite") never
+    # fall into a shorter generation row ("TPU v5")
+    for k in sorted(_TPU_PEAK_BF16, key=len, reverse=True):
         if kind.lower().startswith(k.lower()):
-            return {"flops": v, "source": "tpu-datasheet",
+            return {"flops": _TPU_PEAK_BF16[k], "source": "tpu-datasheet",
                     "device_kind": kind}
+    if dev.platform != "cpu":
+        # an accelerator we have no datasheet row for: the cpu-proxy
+        # ceiling below would silently bank nonsense MFU, so say so
+        # loudly and name the escape hatch
+        import warnings
+        warnings.warn(
+            f"no peak-FLOPs datasheet entry for device_kind={kind!r} "
+            f"(platform={dev.platform!r}); falling back to a measured "
+            f"matmul-rate proxy ceiling, so MFU numbers are NOT a "
+            f"hardware-utilization claim. Set MXTPU_PEAK_FLOPS to the "
+            f"chip's bf16 peak (FLOPs/s) or add a row to "
+            f"utils/flops.py:_TPU_PEAK_BF16.",
+            RuntimeWarning, stacklevel=2)
     global _CPU_PEAK_CACHE
     if _CPU_PEAK_CACHE is None:
         _CPU_PEAK_CACHE = _measure_cpu_peak()

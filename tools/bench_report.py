@@ -107,6 +107,79 @@ def collect():
     return rows
 
 
+MFU_TARGET = 0.45    # the ROADMAP north-star: >=45% MFU on TPU
+
+
+def mfu_rows():
+    """The measured-MFU ladder: one row per BENCH_MEASURED_*.json
+    (real-hardware measurements banked by the TPU ladder, in
+    measurement order), each with its workload and commit of record.
+    BENCH_MFU.json rows are cpu-proxy numbers — relative evidence for
+    the overlap/pipelining arms, never a hardware-utilization claim —
+    so they are summarised separately, not plotted on the ladder."""
+    rows = []
+    for path in sorted(glob.glob(
+            os.path.join(REPO, "BENCH_MEASURED_*.json"))):
+        fname = os.path.basename(path)
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except Exception:
+            continue
+        for key in ("mfu", "best_mfu"):
+            if isinstance(d.get(key), (int, float)):
+                metric = d.get("best_mfu_metric" if key == "best_mfu"
+                               else "metric", "")
+                rows.append((fname, key, float(d[key]),
+                             str(metric), str(d.get("measured_utc",
+                                                    ""))[:16],
+                             _commit_of_record(fname)))
+    rows.sort(key=lambda r: (r[4], r[0], r[1]))
+    return rows
+
+
+def mfu_section():
+    rows = mfu_rows()
+    out = ["", "## MFU trajectory",
+           "",
+           f"Measured on TPU (BENCH_MEASURED_*.json); north-star "
+           f"**>= {MFU_TARGET:.0%} MFU** (utils/flops.py ladder).",
+           ""]
+    if rows:
+        out += ["| file | metric | MFU | gap to target | workload | "
+                "measured | commit of record |",
+                "|---|---|---|---|---|---|---|"]
+        for fname, key, v, metric, when, rec in rows:
+            gap = MFU_TARGET - v
+            out.append(f"| {fname} | {key} | {v:.1%} | "
+                       f"{'MET' if gap <= 0 else f'{gap:.1%}'} | "
+                       f"{metric} | {when} | {rec} |")
+        best = max(r[2] for r in rows)
+        out += ["",
+                f"Best measured so far: **{best:.1%}** "
+                f"({best / MFU_TARGET:.0%} of the {MFU_TARGET:.0%} "
+                f"target)."]
+    else:
+        out.append("(no BENCH_MEASURED_*.json banked yet)")
+    # cpu-proxy caveat for the BENCH_MFU.json bank
+    try:
+        with open(os.path.join(REPO, "BENCH_MFU.json")) as f:
+            mb = json.load(f)
+        cfg = mb.get("config", {})
+        if str(cfg.get("peak_source", "")).startswith("cpu"):
+            out += ["",
+                    f"BENCH_MFU.json ({cfg.get('backend', '?')} "
+                    f"backend, peak_source="
+                    f"`{cfg.get('peak_source')}`) holds the "
+                    f"overlap/pipelined/int8 arm comparisons — "
+                    f"*relative* numbers against a measured matmul "
+                    f"proxy ceiling, not hardware MFU; `arm_kind` "
+                    f"tags each arm as overlap or parity."]
+    except Exception:
+        pass
+    return "\n".join(out) + "\n"
+
+
 def render(rows):
     out = ["# Bench trajectory",
            "",
@@ -123,7 +196,7 @@ def render(rows):
         shown_rec = record if fname != last else ""
         last = fname
         out.append(f"| {shown} | {metric} | {value} | {shown_rec} |")
-    return "\n".join(out) + "\n"
+    return "\n".join(out) + "\n" + mfu_section()
 
 
 def main():

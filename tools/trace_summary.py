@@ -207,6 +207,21 @@ def overlap_stats(trace_dir):
     }
 
 
+def remat_recipe(trace_dir, num_blocks):
+    """Profile-driven remat plan for the pipelined step (round 19,
+    docs/TRAINING_PERF.md): feed the per-lane overlap split of a real
+    capture into ``models._remat.plan_remat_from_profile`` and return
+    ``{"stats": ..., "remat_plan": [...]}`` — the list goes verbatim to
+    ``SPMDTrainer(remat_plan=...)``. The heuristic keys on the EXPOSED
+    fraction: hidden collectives → no remat; mild exposure → "dots"
+    everywhere; heavy exposure → full remat on the earliest blocks
+    (they backward last, exactly when the deep buckets drain)."""
+    from incubator_mxnet_tpu.models._remat import plan_remat_from_profile
+    stats = overlap_stats(trace_dir)
+    return {"stats": stats,
+            "remat_plan": plan_remat_from_profile(stats, num_blocks)}
+
+
 def mfu_section(trace_dir, step_flops, n_steps=1, peak_flops=None):
     """Markdown MFU block from a capture of ``n_steps`` training steps
     whose analytic cost is ``step_flops`` each (utils/flops.py
@@ -495,11 +510,21 @@ def main():
     ap.add_argument("--peak-flops", type=float, default=None,
                     help="per-device peak FLOPs override (default: TPU "
                          "datasheet by device_kind, CPU measured proxy)")
+    ap.add_argument("--remat-blocks", type=int, default=None,
+                    help="number of pipeline blocks — appends the "
+                         "profile-driven remat plan recipe for "
+                         "SPMDTrainer(remat_plan=...)")
     args = ap.parse_args()
     md = summarize(args.trace_dir, top=args.top)
     if args.step_flops:
         md += mfu_section(args.trace_dir, args.step_flops,
                           n_steps=args.steps, peak_flops=args.peak_flops)
+    if args.remat_blocks:
+        rec = remat_recipe(args.trace_dir, args.remat_blocks)
+        md += ("\n## Remat recipe (profile-driven)\n\n"
+               f"exposed/compute = {rec['stats']['exposed_us']:.0f}/"
+               f"{rec['stats']['compute_us']:.0f} us -> "
+               f"`remat_plan={rec['remat_plan']!r}`\n")
     if args.out:
         with open(args.out, "w") as f:
             f.write(md)
