@@ -171,6 +171,22 @@ stage_migratesmoke() {
   JAX_PLATFORMS=cpu python tools/chaos_bench.py --migrate --smoke
 }
 
+stage_elasticsmoke() {
+  echo "== elasticsmoke: elastic-membership guard (wave load against the"
+  echo "              autoscaling supervisor — grow on sustained brownout,"
+  echo "              shrink in the gaps, zero lost requests either arm;"
+  echo "              rolling same-weights upgrade under load stays"
+  echo "              bit-identical to the un-upgraded control; chaos:"
+  echo "              scale-down racing scale-up in one fleet pass,"
+  echo "              supervisor killed mid-roll leaves no replica"
+  echo "              stranded DRAINING, replica death mid-drain replays"
+  echo "              everything the drain had not moved — each ending"
+  echo "              100% exactly-one-terminal with clean page audits"
+  echo "              on every survivor and zero retraces)"
+  JAX_PLATFORMS=cpu python tools/serve_bench.py --elastic --smoke
+  JAX_PLATFORMS=cpu python tools/chaos_bench.py --elastic --smoke
+}
+
 stage_frontsmoke() {
   echo "== frontsmoke: client-protocol guard (HTTP/SSE front end over"
   echo "               localhost — an end-to-end SSE stream must deliver"
@@ -242,7 +258,7 @@ ge.dryrun_multichip(8)"
 }
 
 stages=("$@")
-[ ${#stages[@]} -eq 0 ] && stages=(sanity lintcore native unit stepbench mfubench servebench quantbench chaossmoke fleetsmoke tiersmoke hiersmoke migratesmoke frontsmoke frontchaos obssmoke trainchaos ckptbench entry report)
+[ ${#stages[@]} -eq 0 ] && stages=(sanity lintcore native unit stepbench mfubench servebench quantbench chaossmoke fleetsmoke tiersmoke hiersmoke migratesmoke elasticsmoke frontsmoke frontchaos obssmoke trainchaos ckptbench entry report)
 for s in "${stages[@]}"; do
   "stage_$s"
 done

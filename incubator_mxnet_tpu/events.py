@@ -89,6 +89,10 @@ class EventType(enum.Enum):
     MIGRATE_OUT = "MIGRATE_OUT"         # slot captured off a replica
     MIGRATE_IN = "MIGRATE_IN"           # capsule installed on a replica
     MIGRATE_FAIL = "MIGRATE_FAIL"       # transfer failed → replay path
+    SCALE_UP = "SCALE_UP"               # replica admitted to the fleet
+    SCALE_DOWN = "SCALE_DOWN"           # replica drained out / retired
+    UPGRADE = "UPGRADE"                 # rolling weight-swap phase
+    WARMUP = "WARMUP"                   # cold replica warming / serving
 
     def __str__(self) -> str:
         return self.value

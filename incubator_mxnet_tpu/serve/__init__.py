@@ -44,6 +44,7 @@ from .engine import InferenceEngine, Request
 from .transport import PageCapsule, PageTransport
 from .router import (Replica, ReplicaKilled, ReplicaState, Router,
                      build_fleet)
+from .fleet_supervisor import FleetSupervisor
 from .metrics import render_metrics
 from .frontend import (OUTCOME_HTTP_STATUS, ServeFrontend,
                        stream_completion)
@@ -57,4 +58,5 @@ __all__ = ["InferenceEngine", "Request", "Outcome", "PageAllocator",
            "render_metrics", "Event", "EventType", "FlightRecorder",
            "SamplingParams", "TokenGrammar", "TokenFsm",
            "choice_grammar", "ServeFrontend", "OUTCOME_HTTP_STATUS",
-           "stream_completion", "PageCapsule", "PageTransport"]
+           "stream_completion", "PageCapsule", "PageTransport",
+           "FleetSupervisor"]

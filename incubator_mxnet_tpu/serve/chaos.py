@@ -44,6 +44,7 @@ __all__ = ["ChaosInjector", "NaNWeights", "CorruptPageWrite",
            "assert_all_terminal", "assert_health_consistent",
            "FleetInjector", "KillReplica", "SlowReplica",
            "FlappingReplica", "FleetCancelStorm", "MigrateFault",
+           "ScaleDownRace", "DrainKill", "SupervisorChaos",
            "run_fleet_chaos", "assert_fleet_health_consistent"]
 
 
@@ -871,6 +872,132 @@ class MigrateFault(FleetInjector):
             f"step {step_idx}: {self.mode} migration of request "
             f"{cid} replica{self.src}->replica{dst} returned "
             f"{self.migrate_returned}")
+
+
+class ScaleDownRace(FleetInjector):
+    """The membership race: remove one replica and admit a fresh one
+    in the SAME fleet pass — scale-down racing scale-up. The drain
+    must route its migrations around the newcomer's WARMING state (or
+    into it: spill-class work may land there), every request must
+    still reach exactly one terminal, and the retiring replica's
+    tombstone must keep every older index stable. ``spawn`` is a
+    zero-arg engine factory (the supervisor's contract)."""
+
+    name = "scale_down_race"
+
+    def __init__(self, victim: int, spawn, at_step: int, seed=0):
+        super().__init__(seed)
+        self.victim = victim
+        self.spawn = spawn
+        self.at_step = at_step
+        self.added: Optional[int] = None
+
+    def on_step(self, router, step_idx):
+        if self.fired or step_idx < self.at_step:
+            return
+        rep = router.replicas[self.victim]
+        if rep.state is not ReplicaState.SERVING:
+            return                           # defer to a clean fire
+        self.fired = True
+        stats = router.remove_replica(self.victim)
+        self.added = router.add_replica(self.spawn())
+        self.log.append(
+            f"step {step_idx}: remove_replica({self.victim}) "
+            f"(migrated={stats['migrated']} requeued="
+            f"{stats['requeued']} remaining={stats['remaining']}) "
+            f"racing add_replica -> {self.added}")
+
+
+class DrainKill(FleetInjector):
+    """Replica death MID-DRAIN: ``remove_replica`` at ``at_step``,
+    then — ``kill_after`` router steps later, while the victim is
+    still DRAINING — the host disappears. Whatever the drain had not
+    yet migrated must come back through the death path's replay
+    re-queue: zero lost requests either way, and the drain's
+    finalisation must simply never happen (DEAD wins over RETIRED)."""
+
+    name = "drain_kill"
+
+    def __init__(self, victim: int, at_step: int, kill_after: int = 2,
+                 seed=0):
+        super().__init__(seed)
+        self.victim = victim
+        self.at_step = at_step
+        self.kill_after = kill_after
+        self.removed_at: Optional[int] = None
+        self.killed_mid_drain = False
+
+    def on_step(self, router, step_idx):
+        if self.fired:
+            return
+        rep = router.replicas[self.victim]
+        if self.removed_at is None:
+            if step_idx < self.at_step:
+                return
+            if rep.state is not ReplicaState.SERVING:
+                return
+            stats = router.remove_replica(self.victim)
+            self.removed_at = step_idx
+            self.log.append(
+                f"step {step_idx}: draining replica {self.victim} "
+                f"(remaining={stats['remaining']})")
+            return
+        if step_idx < self.removed_at + self.kill_after:
+            return
+        self.fired = True
+        if rep.state is ReplicaState.DRAINING and rep.killed is None:
+            rep.kill(f"chaos kill mid-drain at router step {step_idx}")
+            self.killed_mid_drain = True
+            self.log.append(
+                f"step {step_idx}: killed replica {self.victim} "
+                f"mid-drain")
+        else:
+            self.log.append(
+                f"step {step_idx}: drain already finalised "
+                f"({rep.state}) — kill skipped")
+
+
+class SupervisorChaos(FleetInjector):
+    """Drives a ``FleetSupervisor`` from the chaos hook — one
+    ``tick()`` per router step — optionally arming a rolling upgrade
+    at ``upgrade_at``, and modelling the supervisor PROCESS dying at
+    ``kill_at``: from that step on it never ticks again. The contract
+    under test is the router-owned finalisation: the replica the roll
+    had mid-drain still finishes its warm_start on the router's own
+    step loop, the fleet serves on, and only the not-yet-started
+    targets stay on old weights."""
+
+    name = "supervisor_kill"
+
+    def __init__(self, supervisor, kill_at: Optional[int] = None,
+                 upgrade_at: Optional[int] = None,
+                 upgrade_src: Optional[dict] = None, seed=0):
+        super().__init__(seed)
+        self.supervisor = supervisor
+        self.kill_at = kill_at
+        self.upgrade_at = upgrade_at
+        self.upgrade_src = upgrade_src or {}
+        self.killed_at_step: Optional[int] = None
+        self.upgrade_started = False
+
+    def on_step(self, router, step_idx):
+        if self.killed_at_step is not None:
+            return                           # the supervisor is gone
+        if self.kill_at is not None and step_idx >= self.kill_at:
+            self.killed_at_step = step_idx
+            self.fired = True
+            roll = self.supervisor.snapshot()["roll"]
+            self.log.append(
+                f"step {step_idx}: supervisor killed (roll state at "
+                f"death: {roll})")
+            return
+        if self.upgrade_at is not None and not self.upgrade_started \
+                and step_idx >= self.upgrade_at:
+            self.supervisor.start_upgrade(**self.upgrade_src)
+            self.upgrade_started = True
+            self.log.append(
+                f"step {step_idx}: rolling upgrade armed")
+        self.supervisor.tick()
 
 
 def _mirror_injector_events(flight, component, injectors, seen):

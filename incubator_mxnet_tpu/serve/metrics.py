@@ -97,9 +97,16 @@ _ROUTER_COUNTERS = [
     ("migrations_failed", "migrations_failed_total"),
     ("migrated_pages", "kv_migrated_pages_total"),
     ("migrated_bytes", "kv_migrated_bytes_total"),
+    # elastic membership (add/remove/upgrade_replica)
+    ("scale_ups", "scale_ups_total"),
+    ("scale_downs", "scale_downs_total"),
+    ("upgrades", "upgrades_total"),
 ]
 
-_REPLICA_UP = {"SERVING": 1.0, "DEGRADED": 0.5, "DEAD": 0.0}
+# replica-state gauge: 1.0 fully routable, fractional while joining
+# (WARMING: spill-only) or leaving (DRAINING: no admissions), 0.0 gone
+_REPLICA_UP = {"SERVING": 1.0, "WARMING": 0.75, "DEGRADED": 0.5,
+               "DRAINING": 0.25, "DEAD": 0.0, "RETIRED": 0.0}
 
 # flight-recorder latency metrics (serve/events.py) -> prometheus name
 _HIST_METRICS = [
@@ -276,6 +283,8 @@ def render_metrics(snapshot: dict) -> str:
     _emit_hists(w, snapshot)             # client-level SLO histograms
     w.add(f"{_NS}_queue_depth", "gauge", snapshot["queue_depth"])
     w.add(f"{_NS}_inflight", "gauge", snapshot["inflight"])
+    w.add(f"{_NS}_fleet_size", "gauge",
+          snapshot.get("fleet_size", len(snapshot["replicas"])))
     for key, suffix in _ROUTER_COUNTERS:
         w.add(f"{_NS}_{suffix}", "counter", snapshot[key])
     rns = f"{_NS}_replica"

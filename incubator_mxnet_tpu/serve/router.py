@@ -19,6 +19,13 @@ replicas, and the two things a fleet adds that no single engine can:
     Per-replica health states:
 
         SERVING   routable; heartbeats healthy
+        WARMING   just admitted (``add_replica``) or fresh from a
+                  rolling weight swap: routable for SPILL/round-robin
+                  only — the affinity probe skips it until it has
+                  earned ``warmup_steps`` consecutive healthy steps
+                  (compile steps are heartbeat-exempt AND not warmup
+                  evidence, exactly the breaker's cold-start rule),
+                  then it graduates to SERVING
         DEGRADED  circuit breaker open after ``breaker_failures``
                   consecutive heartbeat misses (a step slower than
                   ``heartbeat_timeout_s``): no new admissions; the
@@ -26,9 +33,24 @@ replicas, and the two things a fleet adds that no single engine can:
                   seeded-jitter exponential backoff schedule;
                   ``probe_recovery`` consecutive healthy probes close
                   the breaker back to SERVING
+        DRAINING  leaving the fleet (``remove_replica``) or swapping
+                  weights (``upgrade_replica``): no new admissions;
+                  queued attempts withdraw back to the router, decode-
+                  ready slots migrate to siblings (PR-18 capsules,
+                  replay fallback), still-prefilling slots finish in
+                  place — the router's own step loop finalises the
+                  drain (retire or warm_start) the pass the last slot
+                  leaves, so a supervisor death mid-transition can
+                  never wedge the fleet
         DEAD      the replica raised out of a step (``ReplicaKilled``
                   or any engine exception — its state can no longer be
                   trusted): terminal, never probed again
+        RETIRED   drained out clean by ``remove_replica`` and shut
+                  down: terminal, never stepped again. Retired (and
+                  dead) replicas stay in ``self.replicas`` as
+                  TOMBSTONES — replica index == list position is
+                  load-bearing across every in-flight bookkeeping
+                  structure, so membership changes never renumber
 
     On death every in-flight request of that replica is RE-QUEUED with
     its already-emitted tokens preserved: the replay attempt's prompt
@@ -89,8 +111,11 @@ _ROLES = ("prefill", "decode", "mixed")
 
 class ReplicaState(enum.Enum):
     SERVING = "SERVING"
+    WARMING = "WARMING"       # cold admit / post-upgrade: spill-only
     DEGRADED = "DEGRADED"
+    DRAINING = "DRAINING"     # leaving or upgrading: no admissions
     DEAD = "DEAD"
+    RETIRED = "RETIRED"       # drained out clean: tombstone
 
     def __str__(self) -> str:
         return self.value
@@ -134,6 +159,9 @@ class Replica:
         self.probes = 0
         self.steps = 0
         self.death_detail = ""
+        self.warm_steps = 0                  # healthy steps while WARMING
+        self.drain_reason: Optional[str] = None   # "retire" | "upgrade"
+        self.upgrade_src: Optional[dict] = None   # warm_start kwargs
 
     def kill(self, reason: str = "killed"):
         """Mark the replica process dead: every later ``step`` raises
@@ -205,6 +233,7 @@ class Router:
                  roles: Optional[List[str]] = None,
                  rebalance: bool = False,
                  fleet_preempt: bool = False,
+                 warmup_steps: int = 2,
                  recorder=None):
         if not engines:
             raise MXNetError("a fleet needs at least one replica")
@@ -239,6 +268,7 @@ class Router:
         self.probe_backoff_max_s = float(probe_backoff_max_s)
         self.probe_recovery = int(probe_recovery)
         self.replica_queue_depth = replica_queue_depth
+        self.warmup_steps = int(warmup_steps)
         self.max_queue = None if max_queue is None else int(max_queue)
         self.max_queue_delay_s = max_queue_delay_s
         self.stall_steps = int(stall_steps)
@@ -283,6 +313,12 @@ class Router:
         self.migrations_failed = 0
         self.migrated_pages = 0
         self.migrated_bytes = 0
+        # elastic membership (add_replica / remove_replica /
+        # upgrade_replica) tally — serve/metrics.py renders all three
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.upgrades = 0
+        self._fleet_preempt = bool(fleet_preempt)
         if fleet_preempt:
             # fleet-aware preemption: an engine about to preempt a
             # victim offers it to the router first — a successful
@@ -304,7 +340,8 @@ class Router:
         read of engine state)."""
         ewmas = [r.engine.health_snapshot()["ewma_service_s"]
                  for r in self.replicas
-                 if r.state is not ReplicaState.DEAD]
+                 if r.state not in (ReplicaState.DEAD,
+                                    ReplicaState.RETIRED)]
         ewmas = [e for e in ewmas if e]
         return min(ewmas) if ewmas else 0.05
 
@@ -342,18 +379,28 @@ class Router:
 
     def _alive(self) -> List[Replica]:
         return [r for r in self.replicas
-                if r.state is not ReplicaState.DEAD]
+                if r.state not in (ReplicaState.DEAD,
+                                   ReplicaState.RETIRED)]
 
     def _serving(self) -> List[Replica]:
         return [r for r in self.replicas
                 if r.state is ReplicaState.SERVING]
+
+    def _routable(self) -> List[Replica]:
+        """Replicas that may take NEW admissions: SERVING plus WARMING
+        (a warming replica takes spill/round-robin traffic only — the
+        affinity probe in ``_route`` is restricted to SERVING, so it
+        earns affinity by building its PrefixIndex from spills)."""
+        return [r for r in self.replicas
+                if r.state in (ReplicaState.SERVING,
+                               ReplicaState.WARMING)]
 
     def _fleet_delay_estimate(self) -> Optional[float]:
         """Estimated admission delay for a NEWLY submitted request:
         the best serving replica's own estimate, plus the router
         backlog's waves riding on top of the fleet's total slots.
         None until any replica has a calibrated EWMA."""
-        serving = self._serving()
+        serving = self._routable()
         if not serving:
             return None
         ests, ewmas, slots = [], [], 0
@@ -552,9 +599,14 @@ class Router:
             # tiers are off, so an untiered fleet routes exactly as
             # before). A replica holding the prefix only in DRAM/disk
             # still beats a cold spill: promotion is a page copy,
-            # recompute is a full prefill.
+            # recompute is a full prefill. WARMING replicas are
+            # spill-only: the probe skips them until they graduate
+            # (their index is cold anyway — probing it would only add
+            # host work to the dispatch hot loop).
             best, best_key = None, (0, 0)
             for r, _ in cands:
+                if r.state is not ReplicaState.SERVING:
+                    continue
                 key = (r.engine.prefix_probe(prompt),
                        r.engine.tier_probe(prompt))
                 if key > best_key:
@@ -743,9 +795,12 @@ class Router:
             self._queue = deque(sorted(
                 self._queue, key=lambda t: t.client.tier.order))
         # one snapshot per replica per pass; admissions bump the local
-        # view so later queue entries see the new depth
+        # view so later queue entries see the new depth. The routable
+        # set (SERVING + WARMING) is resolved fresh each pass —
+        # membership can change between passes (add/remove/upgrade)
+        # and a stale candidate list would route into a tombstone.
         snaps = [(r, r.engine.health_snapshot())
-                 for r in self._serving()]
+                 for r in self._routable()]
         while self._queue:
             t = self._queue.popleft()
             c = t.client
@@ -825,9 +880,11 @@ class Router:
     def _heartbeat_miss(self, rep: Replica, detail: str):
         rep.consecutive_misses += 1
         rep.probe_successes = 0
+        rep.warm_steps = 0                   # warmup wants HEALTHY runs
         now = time.perf_counter()
-        if rep.state is ReplicaState.SERVING:
+        if rep.state in (ReplicaState.SERVING, ReplicaState.WARMING):
             if rep.consecutive_misses >= self.breaker_failures:
+                prev = rep.state
                 rep.state = ReplicaState.DEGRADED
                 rep.backoff_s = self.probe_backoff_s
                 rep.next_probe_t = now + self._jittered(rep.backoff_s)
@@ -836,18 +893,23 @@ class Router:
                 self.flight.emit(
                     self._component, EventType.REPLICA_HEALTH,
                     entity=f"replica{rep.idx}", replica=rep.idx,
-                    from_state=ReplicaState.SERVING.value,
+                    from_state=prev.value,
                     to_state=ReplicaState.DEGRADED.value,
                     detail=detail[:200])
                 self.log.append(f"replica {rep.idx}: breaker OPEN "
                                 f"after {rep.consecutive_misses} "
                                 f"misses ({detail})")
-        else:                                # failed half-open probe
+        elif rep.state is ReplicaState.DEGRADED:
+            # failed half-open probe
             rep.backoff_s = min(rep.backoff_s * 2.0,
                                 self.probe_backoff_max_s)
             rep.next_probe_t = now + self._jittered(rep.backoff_s)
             self.log.append(f"replica {rep.idx}: probe failed, backoff "
                             f"-> {rep.backoff_s:.3f}s")
+        # DRAINING: a slow step on a replica already leaving the fleet
+        # changes nothing — drain already stopped its admissions, and
+        # its exit (retire / warm_start) is the fix a breaker would
+        # only delay
 
     def _step_ok(self, rep: Replica, dt: float, compiled: bool):
         if compiled:
@@ -863,6 +925,27 @@ class Router:
                      f"{self.heartbeat_timeout_s}s")
             return
         rep.consecutive_misses = 0
+        if rep.state is ReplicaState.WARMING:
+            # warmup evidence: a healthy NON-compile step (compile
+            # steps returned above — expected-slow is not warm). After
+            # ``warmup_steps`` in a row the replica graduates and the
+            # affinity probe starts seeing it.
+            rep.warm_steps += 1
+            if rep.warm_steps >= self.warmup_steps:
+                rep.state = ReplicaState.SERVING
+                self.flight.emit(
+                    self._component, EventType.WARMUP,
+                    entity=f"replica{rep.idx}", replica=rep.idx,
+                    phase="done", warm_steps=rep.warm_steps)
+                self.flight.emit(
+                    self._component, EventType.REPLICA_HEALTH,
+                    entity=f"replica{rep.idx}", replica=rep.idx,
+                    from_state=ReplicaState.WARMING.value,
+                    to_state=ReplicaState.SERVING.value,
+                    detail="warmup complete")
+                self.log.append(f"replica {rep.idx}: warmed up "
+                                f"({rep.warm_steps} healthy steps)")
+            return
         if rep.state is ReplicaState.DEGRADED:
             rep.probe_successes += 1
             if rep.probe_successes >= self.probe_recovery:
@@ -939,11 +1022,18 @@ class Router:
         tracked = self._find_tracked(request_id)
         if tracked is None or tracked.attempt is None:
             return False
+        if not 0 <= dst < len(self.replicas):
+            return False                 # membership-safe: a caller
+                                         # holding a stale index must
+                                         # get the replay fallback's
+                                         # refusal, not an IndexError
         src = self.replicas[tracked.replica]
         dst_rep = self.replicas[dst]
         if dst_rep is src or \
                 src.state is ReplicaState.DEAD or \
-                dst_rep.state is ReplicaState.DEAD or \
+                dst_rep.state in (ReplicaState.DEAD,
+                                  ReplicaState.RETIRED,
+                                  ReplicaState.DRAINING) or \
                 dst_rep.killed is not None:
             return False
         att = tracked.attempt
@@ -1031,13 +1121,16 @@ class Router:
 
     def _migration_dst(self, tracked: _Tracked, exclude: int,
                        decode_pref: bool = True) -> Optional[int]:
-        """Pick the destination replica for a migration: serving, not
-        the source, can hold the request, has a free slot — 'decode'
-        and 'mixed' roles only when ``decode_pref`` (a migrated slot
-        is decode work; a dedicated prefill replica must not collect
-        it back). Least-occupied wins, index breaks ties."""
+        """Pick the destination replica for a migration: routable
+        (SERVING, or WARMING — a migrated slot is spill-class work, so
+        a warming replica is a legitimate landing zone; DRAINING never
+        is: it is on its way OUT), not the source, can hold the
+        request, has a free slot — 'decode' and 'mixed' roles only
+        when ``decode_pref`` (a migrated slot is decode work; a
+        dedicated prefill replica must not collect it back).
+        Least-occupied wins, index breaks ties."""
         best, best_key = None, None
-        for rep in self._serving():
+        for rep in self._routable():
             if rep.idx == exclude or rep.killed is not None:
                 continue
             if decode_pref and rep.role == "prefill":
@@ -1081,7 +1174,7 @@ class Router:
         occupancy) actually falls instead of bouncing work through
         the router queue."""
         snaps = {r.idx: r.engine.health_snapshot()
-                 for r in self._serving()}
+                 for r in self._routable()}
         hot = [r for r in self._serving()
                if wants_rebalance(snaps[r.idx]["brownout_level"])]
         for rep in hot:
@@ -1123,13 +1216,15 @@ class Router:
         return handoff
 
     def drain_replica(self, idx: int) -> dict:
-        """Drain replica ``idx`` for an upgrade (drain, then
-        ``engine.warm_start`` the new weights, with zero lost
-        requests): queued attempts are withdrawn back to the router
-        (they hold no pages), decode-ready slots MIGRATE to siblings
-        (zero redone prefill), still-prefilling slots are left to
-        finish — call again after ``step()`` until ``remaining`` is 0.
-        Returns ``{"migrated", "requeued", "remaining"}``."""
+        """One drain pass over replica ``idx`` (the mechanism under
+        ``remove_replica`` / ``upgrade_replica``, callable directly
+        for a manual drain): queued attempts are withdrawn back to
+        the router (they hold no pages), decode-ready slots MIGRATE
+        to siblings (zero redone prefill), still-prefilling slots are
+        left to finish — call again after ``step()`` until
+        ``remaining`` is 0 (the DRAINING states' ``_drain_tick`` does
+        exactly that). Zero lost requests, zero charged requeue
+        budget. Returns ``{"migrated", "requeued", "remaining"}``."""
         rep = self.replicas[idx]
         migrated = requeued = 0
         for t in [t for t in self._inflight if t.replica == idx]:
@@ -1153,6 +1248,203 @@ class Router:
         remaining = sum(1 for t in self._inflight if t.replica == idx)
         return {"migrated": migrated, "requeued": requeued,
                 "remaining": remaining}
+
+    # ------------------------------------------------------------- #
+    # elastic membership: add / remove / upgrade under live traffic
+    # ------------------------------------------------------------- #
+
+    def add_replica(self, engine: InferenceEngine,
+                    role: str = "mixed") -> int:
+        """Admit a cold engine to the fleet. It enters WARMING —
+        spill/round-robin traffic only (the circuit breaker's compile
+        exemption covers its cold compiles), graduating to SERVING
+        after ``warmup_steps`` consecutive healthy steps, by which
+        point its PrefixIndex has started earning affinity the normal
+        way. Returns the new replica's index (stable forever — the
+        fleet list only ever appends; departures tombstone)."""
+        if role not in _ROLES:
+            raise MXNetError(f"replica role must be one of {_ROLES}, "
+                             f"got {role!r}")
+        if role == "decode" and not any(
+                r.role != "decode" for r in self._alive()):
+            raise MXNetError("cannot add a 'decode' replica to a fleet "
+                             "with no live prefill/mixed replica — "
+                             "nothing could ever feed it")
+        idx = len(self.replicas)
+        rep = Replica(idx, engine, role=role)
+        rep.state = ReplicaState.WARMING
+        if getattr(engine, "_component", None) == "engine":
+            engine._component = f"replica{idx}"
+        if self._fleet_preempt:
+            engine.preempt_handoff = self._make_preempt_handoff(idx)
+        self.replicas.append(rep)
+        self.scale_ups += 1
+        self.flight.emit(self._component, EventType.SCALE_UP,
+                         entity=f"replica{idx}", replica=idx,
+                         role=role, fleet_size=len(self._alive()))
+        self.flight.emit(self._component, EventType.WARMUP,
+                         entity=f"replica{idx}", replica=idx,
+                         phase="start",
+                         warmup_steps=self.warmup_steps)
+        self.log.append(f"replica {idx}: joined the fleet "
+                        f"(role={role}, WARMING)")
+        return idx
+
+    def _check_removable(self, idx: int, verb: str) -> Replica:
+        """The shared refusal ladder for remove/upgrade: loud, typed
+        errors — a membership mistake must never be a silent no-op."""
+        if not 0 <= idx < len(self.replicas):
+            raise MXNetError(f"{verb}: no replica {idx} "
+                             f"(fleet has {len(self.replicas)})")
+        rep = self.replicas[idx]
+        if rep.state is ReplicaState.DRAINING:
+            raise MXNetError(
+                f"{verb}: replica {idx} is already DRAINING "
+                f"({rep.drain_reason}) — double membership operation")
+        if rep.state in (ReplicaState.DEAD, ReplicaState.RETIRED):
+            raise MXNetError(f"{verb}: replica {idx} is "
+                             f"{rep.state} — nothing to drain")
+        return rep
+
+    def remove_replica(self, idx: int) -> dict:
+        """Retire replica ``idx``: stop admissions (DRAINING), migrate
+        its decode-ready slots to siblings / withdraw its queued
+        attempts back to the router (both via ``drain_replica`` —
+        zero lost requests, zero charged requeue budget), and let the
+        step loop retire it the pass the last slot leaves. Raises
+        loudly on a double remove, a dead/retired target, or when the
+        survivors could not serve at all. Returns the first drain
+        pass's ``{"migrated","requeued","remaining"}``."""
+        rep = self._check_removable(idx, "remove_replica")
+        # DRAINING siblings are NOT survivors — they are leaving too,
+        # and counting them would let sequential removes drain the
+        # whole fleet to zero
+        survivors = [r for r in self._alive() if r.idx != idx
+                     and r.state is not ReplicaState.DRAINING]
+        if not survivors:
+            raise MXNetError(f"remove_replica: replica {idx} is the "
+                             f"last live replica — a fleet of zero "
+                             f"serves nobody")
+        if all(r.role == "decode" for r in survivors):
+            raise MXNetError(f"remove_replica: removing replica {idx} "
+                             f"would leave a decode-only fleet that "
+                             f"can never prefill")
+        prev = rep.state
+        rep.state = ReplicaState.DRAINING
+        rep.drain_reason = "retire"
+        self.flight.emit(self._component, EventType.SCALE_DOWN,
+                         entity=f"replica{idx}", replica=idx,
+                         phase="drain",
+                         fleet_size=len(self._alive()))
+        self.flight.emit(self._component, EventType.REPLICA_HEALTH,
+                         entity=f"replica{idx}", replica=idx,
+                         from_state=prev.value,
+                         to_state=ReplicaState.DRAINING.value,
+                         detail="remove_replica: draining to retire")
+        self.log.append(f"replica {idx}: DRAINING (retire)")
+        return self.drain_replica(idx)
+
+    def upgrade_replica(self, idx: int, params=None, manager=None,
+                        step=None) -> dict:
+        """Rolling weight swap for one replica: drain it exactly like
+        ``remove_replica`` (admissions stop, slots migrate or finish),
+        then — on the step-loop pass its last slot leaves — swap
+        weights in place via ``engine.warm_start`` (which flushes its
+        PrefixIndex and cache tiers: the per-replica stagger of a
+        fleet-wide prefix flush) and re-enter through WARMING. The
+        weight source is stashed NOW (``params`` or ``manager``/
+        ``step``), so the caller — typically the FleetSupervisor — can
+        die mid-roll without wedging the swap."""
+        if params is None and manager is None:
+            raise MXNetError("upgrade_replica needs params= or "
+                             "manager= (a weight source to swap in)")
+        rep = self._check_removable(idx, "upgrade_replica")
+        prev = rep.state
+        rep.state = ReplicaState.DRAINING
+        rep.drain_reason = "upgrade"
+        rep.upgrade_src = ({"params": params} if params is not None
+                           else {"manager": manager, "step": step})
+        self.flight.emit(self._component, EventType.UPGRADE,
+                         entity=f"replica{idx}", replica=idx,
+                         phase="drain")
+        self.flight.emit(self._component, EventType.REPLICA_HEALTH,
+                         entity=f"replica{idx}", replica=idx,
+                         from_state=prev.value,
+                         to_state=ReplicaState.DRAINING.value,
+                         detail="upgrade_replica: draining to swap "
+                                "weights")
+        self.log.append(f"replica {idx}: DRAINING (upgrade)")
+        return self.drain_replica(idx)
+
+    def _drain_tick(self):
+        """One drain pass per DRAINING replica per fleet step, plus
+        finalisation the pass the replica empties: retire-shutdown or
+        warm_start-and-rewarm. Runs from ``step()`` — router-owned, so
+        the transition completes no matter what happened to whoever
+        started it."""
+        for rep in self.replicas:
+            if rep.state is not ReplicaState.DRAINING:
+                continue
+            stats = self.drain_replica(rep.idx)
+            if stats["remaining"] > 0:
+                continue                     # still-prefilling slots
+            if rep.drain_reason == "retire":
+                rep.engine.shutdown(
+                    f"replica {rep.idx} retired (scale-down)")
+                rep.state = ReplicaState.RETIRED
+                rep.drain_reason = None
+                self.scale_downs += 1
+                self.flight.emit(
+                    self._component, EventType.SCALE_DOWN,
+                    entity=f"replica{rep.idx}", replica=rep.idx,
+                    phase="retired", fleet_size=len(self._alive()))
+                self.flight.emit(
+                    self._component, EventType.REPLICA_HEALTH,
+                    entity=f"replica{rep.idx}", replica=rep.idx,
+                    from_state=ReplicaState.DRAINING.value,
+                    to_state=ReplicaState.RETIRED.value,
+                    detail="drained clean, engine shut down")
+                self.log.append(f"replica {rep.idx}: RETIRED")
+                continue
+            # upgrade: swap weights in the emptied engine, re-warm.
+            # A warm_start that raises (shape/dtype mismatch, a
+            # checkpoint that no longer loads) is a replica the fleet
+            # can no longer trust — the death path owns it and the
+            # supervisor's dead-replacement machinery takes over.
+            src, rep.upgrade_src, rep.drain_reason = \
+                rep.upgrade_src, None, None
+            try:
+                rep.engine.warm_start(**src)
+            except Exception as e:
+                self.flight.emit(
+                    self._component, EventType.UPGRADE,
+                    entity=f"replica{rep.idx}", replica=rep.idx,
+                    phase="failed",
+                    reason=f"{type(e).__name__}: {e}"[:200])
+                self._on_replica_death(
+                    rep, f"upgrade warm_start failed: "
+                         f"{type(e).__name__}: {e}")
+                continue
+            rep.state = ReplicaState.WARMING
+            rep.warm_steps = 0
+            self.upgrades += 1
+            self.flight.emit(self._component, EventType.UPGRADE,
+                             entity=f"replica{rep.idx}",
+                             replica=rep.idx, phase="swapped")
+            self.flight.emit(self._component,
+                             EventType.REPLICA_HEALTH,
+                             entity=f"replica{rep.idx}",
+                             replica=rep.idx,
+                             from_state=ReplicaState.DRAINING.value,
+                             to_state=ReplicaState.WARMING.value,
+                             detail="weights swapped (warm_start), "
+                                    "re-warming")
+            self.flight.emit(self._component, EventType.WARMUP,
+                             entity=f"replica{rep.idx}",
+                             replica=rep.idx, phase="start",
+                             warmup_steps=self.warmup_steps)
+            self.log.append(f"replica {rep.idx}: upgraded "
+                            f"(warm_start), WARMING")
 
     # ------------------------------------------------------------- #
     # the scheduler
@@ -1189,7 +1481,7 @@ class Router:
         advanced = 0
         now = time.perf_counter()
         for rep in self.replicas:
-            if rep.state is ReplicaState.DEAD:
+            if rep.state in (ReplicaState.DEAD, ReplicaState.RETIRED):
                 continue
             if rep.state is ReplicaState.DEGRADED:
                 if now < rep.next_probe_t:
@@ -1204,6 +1496,12 @@ class Router:
             advanced += n
             self._step_ok(rep, dt, compiled)
         self._collect()
+        if any(r.state is ReplicaState.DRAINING for r in self.replicas):
+            # the drain tick lives on the ROUTER'S step loop, not on
+            # whoever called remove/upgrade_replica: a supervisor
+            # killed mid-transition leaves a DRAINING replica that the
+            # next fleet pass still finishes — no wedge by construction
+            self._drain_tick()
         if any(r.role == "prefill" for r in self.replicas):
             # role split: hand freshly-published page sets to the
             # decode side the same pass prefill finished them
@@ -1471,10 +1769,15 @@ class Router:
             entry = {"idx": r.idx, "state": r.state.value,
                      "role": r.role,
                      "breaker_opens": r.breaker_opens,
-                     "probes": r.probes, "steps": r.steps}
+                     "probes": r.probes, "steps": r.steps,
+                     "warm_steps": r.warm_steps,
+                     "drain_reason": r.drain_reason}
             if r.state is ReplicaState.DEAD:
                 entry["death_detail"] = r.death_detail
             else:
+                # RETIRED included: shutdown leaves the engine
+                # structurally valid and auditable — its final
+                # snapshot is the retirement's evidence
                 entry["engine"] = r.engine.health_snapshot()
             reps.append(entry)
         return {
@@ -1501,6 +1804,12 @@ class Router:
             "migrations_failed": self.migrations_failed,
             "migrated_pages": self.migrated_pages,
             "migrated_bytes": self.migrated_bytes,
+            # elastic membership: live fleet size (tombstones
+            # excluded) + the scale/upgrade tally
+            "fleet_size": len(self._alive()),
+            "scale_ups": self.scale_ups,
+            "scale_downs": self.scale_downs,
+            "upgrades": self.upgrades,
             # CLIENT-level latency histograms (the SLO percentiles a
             # dashboard should alert on — per-replica attempt
             # histograms ride each replica's own engine snapshot)

@@ -1,0 +1,670 @@
+"""Elastic-fleet tests (serve/router.py membership +
+serve/fleet_supervisor.py policy).
+
+The load-bearing claims: (1) ``add_replica`` admits a cold engine
+through WARMING — spill-only until it graduates, compile steps exempt
+from the heartbeat AND from warmup evidence — and ``remove_replica``
+drains via slot migration with resume-from-suffix replay as the
+always-correct fallback: zero lost requests, exactly one terminal,
+clean page audits on every survivor; (2) membership is TOMBSTONED —
+replica index == list position survives every add/remove/upgrade, so
+mid-dispatch removal can neither skew spill selection nor raise on a
+stale index; (3) refusals are LOUD: double remove, removing the last
+live replica, and upgrading without a weight source all raise typed
+errors; (4) every shed emitted during a membership transition carries
+an honest ``retry_after_s`` and the PR-15 frontend surfaces it as
+Retry-After over one stable endpoint while the fleet churns; (5) the
+FleetSupervisor's policy (grow on sustained pressure, shrink on
+sustained idleness, replace deaths from the latest checkpoint, roll
+upgrades one replica at a time and halt while degraded) is pure
+snapshot-driven hysteresis — unit-tested against a fake router,
+integration-tested on a live fleet; (6) the race matrix
+(add-during-drain, remove-during-kill-failover,
+cancel-vs-migrate-vs-retire) resolves to the standard outcome
+taxonomy with no wedge and no double-finish.
+
+The race matrix and supervisor integration runs each build + compile
+fleets (~10-20s each), so they ride in ``slow`` (ci stage_unit runs
+them; the elasticsmoke CI stage ALSO churns membership end-to-end on
+every run) — tier-1 keeps the host-only policy/refusal units plus the
+cheap single-fleet regressions."""
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.base import MXNetError
+from incubator_mxnet_tpu.models import gpt as g
+from incubator_mxnet_tpu.serve import (FleetSupervisor, InferenceEngine,
+                                       Outcome, Request, ReplicaState,
+                                       build_fleet, render_metrics)
+from incubator_mxnet_tpu.serve.chaos import (DrainKill, KillReplica,
+                                             ScaleDownRace,
+                                             SupervisorChaos,
+                                             assert_fleet_health_consistent,
+                                             run_fleet_chaos)
+from incubator_mxnet_tpu.serve.events import EventType
+
+VOCAB = 64
+
+
+@pytest.fixture(scope="module")
+def model():
+    mx.random.seed(0)
+    m = g.gpt_mini(vocab_size=VOCAB, max_length=64)
+    m.initialize()
+    return m
+
+
+ENG_KW = dict(num_slots=2, page_size=8, max_len=64, chunk_pages=1,
+              prefix_cache=True)
+
+
+def _fleet(model, n=2, **router_kw):
+    router_kw.setdefault("seed", 3)
+    return build_fleet(model, n, engine_kw=dict(ENG_KW), **router_kw)
+
+
+def _engine(model, **kw):
+    return InferenceEngine(model, **dict(ENG_KW, **kw))
+
+
+def _workload(n, seed=42):
+    """Greedy (parity-assertable): persona-shared + unique ragged."""
+    rng = np.random.RandomState(seed)
+    persona = rng.randint(0, VOCAB, size=(14,)).astype(np.int32)
+    reqs = []
+    for i in range(n):
+        if i % 2 == 0:
+            prompt = np.concatenate(
+                [persona, rng.randint(0, VOCAB,
+                                      size=(3 + i % 4,)).astype(np.int32)])
+        else:
+            prompt = rng.randint(0, VOCAB,
+                                 size=(5 + 3 * (i % 3),)).astype(np.int32)
+        reqs.append(Request(prompt, max_new_tokens=8 + 2 * (i % 3)))
+    return reqs
+
+
+_BASELINES = {}
+
+
+def _baseline(model, n):
+    key = n
+    if key not in _BASELINES:
+        rt = _fleet(model)
+        reqs = _workload(n)
+        rt.run(reqs)        # plain run: the oracle needs streams, not
+        assert all(r.outcome is not None and r.outcome.ok for r in reqs)
+        _BASELINES[key] = [list(r.token_ids) for r in reqs]
+    return _BASELINES[key]  # the per-step audit run_fleet_chaos does
+
+
+def _same_params(router):
+    """The serving weights as a warm_start source — the same-weights
+    upgrade whose survivor streams must stay bit-identical."""
+    live = next(r for r in router.replicas
+                if r.state not in (ReplicaState.DEAD,
+                                   ReplicaState.RETIRED))
+    return {str(i): p.data().asnumpy()
+            for i, p in enumerate(live.engine._eng_params)}
+
+
+# --------------------------------------------------------------------- #
+# membership mechanics: refusal ladder (host-only, no engine stepping)
+# --------------------------------------------------------------------- #
+
+def test_membership_refusals_are_loud(model):
+    rt = _fleet(model, n=2)
+    # out of range
+    with pytest.raises(MXNetError, match="no replica"):
+        rt.remove_replica(7)
+    # upgrade needs a weight source
+    with pytest.raises(MXNetError, match="weight source"):
+        rt.upgrade_replica(0)
+    # drain replica 1, then a second remove must be refused LOUDLY
+    rt.remove_replica(1)
+    assert rt.replicas[1].state is ReplicaState.DRAINING
+    with pytest.raises(MXNetError, match="double membership"):
+        rt.remove_replica(1)
+    with pytest.raises(MXNetError, match="double membership"):
+        rt.upgrade_replica(1, params=_same_params(rt))
+    # removing the only non-draining replica would zero the fleet
+    with pytest.raises(MXNetError, match="last live replica"):
+        rt.remove_replica(0)
+    # bad role on admission
+    with pytest.raises(MXNetError, match="role"):
+        rt.add_replica(_engine(model), role="nonsense")
+    # a retired tombstone stays refused
+    rt.step()                            # finalises the idle drain
+    assert rt.replicas[1].state is ReplicaState.RETIRED
+    with pytest.raises(MXNetError, match="nothing to drain"):
+        rt.remove_replica(1)
+    # the tally and events agree
+    snap = rt.health_snapshot()
+    assert snap["fleet_size"] == 1 and snap["scale_downs"] == 1
+    etypes = [e.etype for e in rt.flight.events()]
+    assert EventType.SCALE_DOWN in etypes
+
+
+def test_add_replica_enters_warming_and_graduates(model):
+    rt = _fleet(model, n=1, warmup_steps=2)
+    idx = rt.add_replica(_engine(model))
+    assert idx == 1
+    rep = rt.replicas[idx]
+    assert rep.state is ReplicaState.WARMING
+    assert rep.engine._component == "replica1"
+    # warming replicas are routable (spill) but NOT affinity targets
+    assert rep in rt._routable() and rep not in rt._serving()
+    # idle healthy steps are warmup evidence — after warmup_steps the
+    # replica graduates and the WARMUP/SCALE_UP events are on the
+    # timeline
+    for _ in range(3):
+        rt.step()
+    assert rep.state is ReplicaState.SERVING
+    evs = [(e.etype, e.data.get("phase")) for e in rt.flight.events()]
+    assert (EventType.SCALE_UP, None) in evs
+    assert (EventType.WARMUP, "start") in evs
+    assert (EventType.WARMUP, "done") in evs
+    snap = rt.health_snapshot()
+    assert snap["fleet_size"] == 2 and snap["scale_ups"] == 1
+
+
+def test_metrics_render_fleet_size_and_replica_states(model):
+    rt = _fleet(model, n=2)
+    rt.add_replica(_engine(model))       # WARMING
+    rt.remove_replica(1)                 # DRAINING
+    text = render_metrics(rt.health_snapshot())
+    assert "mxtpu_serve_fleet_size 3" in text     # all three alive
+    assert "mxtpu_serve_scale_ups_total 1" in text
+    assert "mxtpu_serve_scale_downs_total 0" in text
+    assert "mxtpu_serve_upgrades_total 0" in text
+    up = "mxtpu_serve_replica_up"
+    assert up + '{replica="1"} 0.25' in text      # DRAINING
+    assert up + '{replica="2"} 0.75' in text      # WARMING
+    # golden-parse: every line is "name{labels} value" or a comment
+    for line in text.splitlines():
+        assert line.startswith("#") or len(line.rsplit(" ", 1)) == 2
+
+
+# --------------------------------------------------------------------- #
+# membership-change-safe routing (the stale-index regression)
+# --------------------------------------------------------------------- #
+
+@pytest.mark.slow    # live decode on 2 fleets (~13s of shape-bucket
+def test_remove_replica_mid_dispatch_zero_loss_and_parity(model):
+    # compiles) and tier-1 sits at the 870s wall; ci stage_unit runs
+    # it every time and chaos_bench --elastic scale_down_race re-gates
+    # the same remove-mid-flight invariant in elasticsmoke
+    """The satellite regression: a replica removed BETWEEN dispatch
+    passes (stale indices in flight, round-robin cursor mid-sequence)
+    must neither raise nor lose a request — and the survivors' token
+    streams stay bit-identical to a fixed-fleet run."""
+    base = _baseline(model, 6)
+    rt = _fleet(model, n=3, affinity=False)   # round-robin: the
+    reqs = _workload(6)                       # cursor-skew surface
+    for r in reqs:
+        rt.submit(r)
+    rt.step()                            # in-flight on all replicas
+    rt.remove_replica(2)
+    guard = 0
+    while any(r.outcome is None for r in reqs):
+        rt.step()
+        guard += 1
+        assert guard < 3000, "fleet wedged after mid-dispatch removal"
+    for _ in range(4):                   # let the drain finalise
+        rt.step()
+    assert rt.replicas[2].state is ReplicaState.RETIRED
+    assert all(r.outcome is not None and r.outcome.ok for r in reqs)
+    for i, r in enumerate(reqs):
+        assert list(r.token_ids) == base[i], f"request {i} diverged"
+    assert_fleet_health_consistent(rt, reqs)
+    for rep in rt.replicas:
+        if rep.state is not ReplicaState.DEAD:
+            rep.engine.audit_pages()
+    # post-retirement traffic routes over the survivors only
+    more = _workload(2, seed=9)
+    for r in more:
+        rt.submit(r)
+    guard = 0
+    while any(r.outcome is None for r in more):
+        rt.step()
+        guard += 1
+        assert guard < 3000
+    assert all(r.outcome.ok for r in more)
+    assert rt.replicas[2].steps < rt.steps   # tombstone never stepped
+
+
+@pytest.mark.slow    # live decode (~5s of compiles); see the 870s-wall
+def test_drain_requeues_do_not_charge_budget(model):
+    # note above — re-gated per CI run by stage_unit + elasticsmoke
+    """Drain-time re-queues are the router's doing: max_requeues=0
+    still finishes every request (a charged re-queue would terminate
+    FAILED_REPLICA immediately)."""
+    rt = _fleet(model, n=2, max_requeues=0)
+    reqs = _workload(4)
+    for r in reqs:
+        rt.submit(r)
+    rt.step()
+    rt.remove_replica(1)
+    guard = 0
+    while any(r.outcome is None for r in reqs):
+        rt.step()
+        guard += 1
+        assert guard < 3000
+    assert all(r.outcome is not None and r.outcome.ok for r in reqs), \
+        [r.outcome.value for r in reqs]
+
+
+# --------------------------------------------------------------------- #
+# honest Retry-After through the frontend while membership churns
+# --------------------------------------------------------------------- #
+
+def test_shed_during_transition_carries_retry_after(model):
+    """Router-level half of the satellite: a shed recorded while a
+    replica is mid-transition carries the fleet retry hint."""
+    rt = _fleet(model, n=2, max_queue=1)
+    rt.remove_replica(1)                 # transition in progress
+    assert rt.replicas[1].state is ReplicaState.DRAINING
+    reqs = _workload(6)
+    shed = [r for r in reqs if not rt.submit(r)]
+    assert shed, "expected sheds past the depth-1 router queue"
+    for r in shed:
+        assert r.outcome is Outcome.SHED
+        assert r.retry_after_s is not None and r.retry_after_s > 0
+
+
+@pytest.mark.slow    # live HTTP streams over a decoding fleet (~3s);
+def test_frontend_surfaces_retry_after_across_scale_down(model):
+    # see the 870s-wall note above — ci stage_unit runs it every time
+    """One stable HTTP endpoint while membership churns underneath:
+    scale the fleet down before traffic, saturate the survivor, and
+    the 429 must carry a real Retry-After header round-tripped from
+    the fleet retry hint."""
+    import threading
+    import time as _time
+    from incubator_mxnet_tpu.serve import ServeFrontend
+    from incubator_mxnet_tpu.serve.frontend import (http_request,
+                                                    stream_completion)
+    rt = _fleet(model, n=2, max_queue=8)
+    rt.remove_replica(1)                 # churn before the endpoint
+    with ServeFrontend(rt) as fe:        # opens — the driven steps
+        holds = []                       # finalise the retirement
+
+        def long_stream():
+            holds.append(stream_completion(
+                "127.0.0.1", fe.bound_port,
+                {"prompt": [2, 3, 4], "max_new_tokens": 48}))
+
+        threads = [threading.Thread(target=long_stream, daemon=True)
+                   for _ in range(2)]
+        for t in threads:
+            t.start()
+        t0 = _time.perf_counter()
+        while _time.perf_counter() - t0 < 30:
+            if rt.replicas[1].state is ReplicaState.RETIRED and \
+                    len(rt._inflight) >= 2:
+                break
+            _time.sleep(0.01)
+        assert rt.replicas[1].state is ReplicaState.RETIRED
+        # squeeze the admission bound shut so the probe sheds
+        # DETERMINISTICALLY — the point under test is the honest
+        # Retry-After on the refusal, not the exact saturation shape
+        rt.max_queue = 0
+        status, headers, body = http_request(
+            "127.0.0.1", fe.bound_port, "POST", "/v1/completions",
+            {"prompt": [5, 6], "max_new_tokens": 4, "stream": False})
+        rt.max_queue = 8
+        assert status == 429
+        assert body["outcome"] == "SHED"
+        assert "retry-after" in headers
+        assert int(headers["retry-after"]) >= 1
+        assert body["retry_after_s"] > 0
+        for t in threads:
+            t.join(timeout=60)
+        assert all(h["final"]["outcome"] == "MAX_TOKENS"
+                   for h in holds)
+    assert rt.scale_downs == 1
+
+
+# --------------------------------------------------------------------- #
+# FleetSupervisor policy units (fake router — pure host-side)
+# --------------------------------------------------------------------- #
+
+class _FakeRep:
+    def __init__(self, idx, state=ReplicaState.SERVING):
+        self.idx = idx
+        self.state = state
+        self.role = "mixed"
+
+
+class _FakeRouter:
+    """Just enough Router surface for the supervisor's policy loop."""
+
+    def __init__(self, n=2):
+        self.replicas = [_FakeRep(i) for i in range(n)]
+        self.replica_deaths = 0
+        self.log = []
+        self.flight = False
+        self.calls = []
+        self.queue_depth = 0
+        self.busy = False
+        self.brownout = 0
+
+    def add_replica(self, engine, role="mixed"):
+        idx = len(self.replicas)
+        self.replicas.append(_FakeRep(idx, ReplicaState.WARMING))
+        self.calls.append(("add", idx))
+        return idx
+
+    def remove_replica(self, idx):
+        self.replicas[idx].state = ReplicaState.DRAINING
+        self.calls.append(("remove", idx))
+        return {"migrated": 0, "requeued": 0, "remaining": 0}
+
+    def upgrade_replica(self, idx, params=None, manager=None,
+                        step=None):
+        self.replicas[idx].state = ReplicaState.DRAINING
+        self.calls.append(("upgrade", idx))
+        return {"migrated": 0, "requeued": 0, "remaining": 0}
+
+    def health_snapshot(self):
+        live = [r for r in self.replicas
+                if r.state not in (ReplicaState.DEAD,
+                                   ReplicaState.RETIRED)]
+        return {
+            "queue_depth": self.queue_depth,
+            "inflight": int(self.busy),
+            "fleet_size": len(live),
+            "replicas": [
+                {"idx": r.idx, "state": r.state.value,
+                 "engine": {"brownout_level": self.brownout,
+                            "free_slots": 0 if self.busy else 2,
+                            "queue_depth": 0,
+                            "active_slots": 2 if self.busy else 0}}
+                for r in self.replicas
+                if r.state not in (ReplicaState.DEAD,
+                                   ReplicaState.RETIRED)],
+        }
+
+
+def _fake_sup(rt, **kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("up_steps", 3)
+    kw.setdefault("down_steps", 5)
+    return FleetSupervisor(rt, spawn=lambda: object(), recorder=False,
+                           **kw)
+
+
+def test_supervisor_scales_up_after_sustained_pressure():
+    rt = _FakeRouter(2)
+    sup = _fake_sup(rt)
+    rt.queue_depth, rt.busy = 3, True    # pressured
+    sup.tick()
+    sup.tick()
+    assert not rt.calls                  # dwell: not yet
+    sup.tick()
+    assert rt.calls == [("add", 2)]      # 3rd consecutive tick fires
+    sup.tick()                           # WARMING blocks a 2nd spawn
+    assert rt.calls == [("add", 2)]
+    rt.replicas[2].state = ReplicaState.SERVING
+    rt.queue_depth, rt.busy = 0, False   # pressure gone: counter resets
+    sup.tick()
+    rt.queue_depth, rt.busy = 3, True
+    sup.tick()
+    sup.tick()
+    assert len(rt.calls) == 1            # dwell restarted from zero
+
+
+def test_supervisor_scale_up_respects_max_replicas():
+    rt = _FakeRouter(2)
+    sup = _fake_sup(rt, max_replicas=2)
+    rt.queue_depth, rt.busy = 5, True
+    for _ in range(10):
+        sup.tick()
+    assert not rt.calls
+
+
+def test_supervisor_scales_down_after_sustained_idle():
+    rt = _FakeRouter(3)
+    sup = _fake_sup(rt, down_steps=5)
+    for _ in range(4):
+        sup.tick()
+    assert not rt.calls
+    sup.tick()
+    assert rt.calls == [("remove", 2)]   # newest SERVING retires
+    rt.replicas[2].state = ReplicaState.RETIRED
+    for _ in range(10):
+        sup.tick()
+    # min_replicas=1 allows one more, after a fresh dwell
+    assert rt.calls == [("remove", 2), ("remove", 1)]
+    rt.replicas[1].state = ReplicaState.RETIRED
+    for _ in range(10):
+        sup.tick()
+    assert len(rt.calls) == 2            # never below min_replicas
+
+
+def test_supervisor_replaces_deaths_and_respects_max():
+    rt = _FakeRouter(2)
+    sup = _fake_sup(rt, max_replicas=2)
+    rt.replicas[0].state = ReplicaState.DEAD
+    rt.replica_deaths = 1
+    sup.tick()
+    assert rt.calls == [("add", 2)]      # replacement fits under max
+    assert sup.replacements == 1
+    rt.replicas[1].state = ReplicaState.DEAD
+    rt.replica_deaths = 2
+    rt.replicas[2].state = ReplicaState.SERVING
+    sup.tick()
+    assert len(rt.calls) == 2 and sup.replacements == 2
+    assert sup.snapshot()["replacements"] == 2
+
+
+def test_supervisor_roll_walks_fleet_and_halts_when_degraded():
+    rt = _FakeRouter(3)
+    sup = _fake_sup(rt)
+    sup.start_upgrade(params={"0": np.zeros((1,), np.float32)})
+    with pytest.raises(MXNetError, match="one roll"):
+        sup.start_upgrade(params={})
+    sup.tick()
+    assert rt.calls == [("upgrade", 0)]
+    sup.tick()                           # replica 0 still DRAINING
+    assert len(rt.calls) == 1
+    rt.replicas[0].state = ReplicaState.SERVING
+    rt.replicas[1].state = ReplicaState.DEGRADED
+    sup.tick()                           # degraded fleet: roll halts
+    assert len(rt.calls) == 1
+    assert sup.snapshot()["roll"]["halted"]
+    rt.replicas[1].state = ReplicaState.SERVING
+    sup.tick()                           # resumed
+    assert rt.calls[-1] == ("upgrade", 1)
+    rt.replicas[1].state = ReplicaState.SERVING
+    sup.tick()
+    assert rt.calls[-1] == ("upgrade", 2)
+    rt.replicas[2].state = ReplicaState.SERVING
+    sup.tick()
+    assert sup.snapshot()["roll"] is None
+    assert sup.upgrades_completed == 1
+
+
+# --------------------------------------------------------------------- #
+# the race matrix (live fleets — slow; elasticsmoke reruns these
+# shapes end-to-end every CI run)
+# --------------------------------------------------------------------- #
+
+@pytest.mark.slow
+def test_race_add_during_drain(model):
+    base = _baseline(model, 10)
+    rt = _fleet(model, n=3)
+    reqs = _workload(10)
+    inj = ScaleDownRace(victim=2, spawn=lambda: _engine(model),
+                        at_step=2)
+    run_fleet_chaos(rt, reqs, [inj])
+    assert inj.fired and inj.added == 3
+    assert all(r.outcome is not None and r.outcome.ok for r in reqs)
+    for i, r in enumerate(reqs):
+        assert list(r.token_ids) == base[i]
+    assert_fleet_health_consistent(rt, reqs)
+    for _ in range(4):
+        rt.step()                        # finalise the drain
+    assert rt.replicas[2].state is ReplicaState.RETIRED
+    for rep in rt.replicas:
+        if rep.state is not ReplicaState.DEAD:
+            rep.engine.audit_pages()
+
+
+@pytest.mark.slow
+def test_race_remove_during_kill_failover(model):
+    """A replica dies; while its requests replay, another replica is
+    removed — the failover re-queues and the drain migrations must
+    not double-finish or lose anything."""
+    base = _baseline(model, 10)
+    rt = _fleet(model, n=3, max_requeues=3)
+    reqs = _workload(10)
+    kill = KillReplica(replica=0, at_step=3, phase="decode")
+    drain = DrainKill(victim=1, at_step=4, kill_after=10 ** 6)
+    # kill_after never fires: this instance only drives the remove
+    run_fleet_chaos(rt, reqs, [kill, drain])
+    assert kill.fired and drain.removed_at is not None
+    assert all(r.outcome is not None for r in reqs)
+    ok = [r for r in reqs if r.outcome.ok]
+    for i, r in enumerate(reqs):
+        if r.outcome.ok:
+            assert list(r.token_ids) == base[i]
+        else:                            # bounded structured give-up
+            assert r.outcome in (Outcome.FAILED_REPLICA,)
+            assert r.retry_after_s is not None
+    assert len(ok) >= 8
+    assert_fleet_health_consistent(rt, reqs)
+    for rep in rt.replicas:
+        if rep.state is not ReplicaState.DEAD and rep.killed is None:
+            rep.engine.audit_pages()
+
+
+@pytest.mark.slow
+def test_race_death_mid_drain(model):
+    base = _baseline(model, 10)
+    rt = _fleet(model, n=3, max_requeues=3)
+    reqs = _workload(10)
+    inj = DrainKill(victim=2, at_step=2, kill_after=1)
+    run_fleet_chaos(rt, reqs, [inj])
+    assert inj.fired
+    assert all(r.outcome is not None for r in reqs)
+    for i, r in enumerate(reqs):
+        if r.outcome.ok:
+            assert list(r.token_ids) == base[i]
+    assert_fleet_health_consistent(rt, reqs)
+    if inj.killed_mid_drain:
+        # DEAD wins over RETIRED: the drain must never finalise
+        assert rt.replicas[2].state is ReplicaState.DEAD
+    for rep in rt.replicas:
+        if rep.state is not ReplicaState.DEAD and rep.killed is None:
+            rep.engine.audit_pages()
+
+
+@pytest.mark.slow
+def test_race_cancel_vs_migrate_vs_retire(model):
+    """Cancel a request that the retirement drain is migrating —
+    whichever transition wins, exactly one CANCELLED-or-ok terminal,
+    never two."""
+    rt = _fleet(model, n=2)
+    reqs = _workload(8)
+    cancelled = []
+
+    def before(router, i):
+        if i == 2:
+            router.remove_replica(1)
+        if i == 3:
+            for t in list(router._inflight):
+                if router.cancel(t.client, detail="race cancel"):
+                    cancelled.append(t.client)
+                break
+
+    rt.run(reqs, before_step=before)
+    assert all(r.outcome is not None for r in reqs)
+    assert cancelled, "the cancel should land at step 3"
+    for r in cancelled:
+        assert r.outcome is Outcome.CANCELLED
+    assert_fleet_health_consistent(rt, reqs)
+    for rep in rt.replicas:
+        if rep.state is not ReplicaState.DEAD:
+            rep.engine.audit_pages()
+
+
+# --------------------------------------------------------------------- #
+# supervisor integration on a live fleet (slow)
+# --------------------------------------------------------------------- #
+
+@pytest.mark.slow
+def test_supervisor_grows_under_load_and_upgrade_roll_is_lossless(model):
+    rt = _fleet(model, n=2)
+    sup = FleetSupervisor(rt, spawn=lambda: _engine(model),
+                          min_replicas=1, max_replicas=3, up_steps=2,
+                          down_steps=10 ** 6)
+    reqs = _workload(16)
+    for r in reqs:
+        rt.submit(r)
+    guard = 0
+    while any(r.outcome is None for r in reqs):
+        rt.step()
+        sup.tick()
+        guard += 1
+        assert guard < 5000
+    assert all(r.outcome.ok for r in reqs)
+    assert sup.scale_ups >= 1 and rt.scale_ups == sup.scale_ups
+    # same-weights rolling upgrade under fresh load: zero losses,
+    # parity with the pre-upgrade streams
+    params = _same_params(rt)
+    sup.start_upgrade(params=params)
+    reqs2 = _workload(10, seed=5)
+    for r in reqs2:
+        rt.submit(r)
+    guard = 0
+    while any(r.outcome is None for r in reqs2) or \
+            sup.snapshot()["roll"] is not None:
+        rt.step()
+        sup.tick()
+        guard += 1
+        assert guard < 8000
+    assert all(r.outcome is not None and r.outcome.ok for r in reqs2)
+    assert sup.upgrades_completed == 1
+    assert rt.upgrades >= 2              # every live replica swapped
+    control = _fleet(model)
+    creqs = _workload(10, seed=5)
+    control.run(creqs)
+    for a, b in zip(reqs2, creqs):
+        assert list(a.token_ids) == list(b.token_ids)
+    for rep in rt.replicas:
+        if rep.state not in (ReplicaState.DEAD, ReplicaState.RETIRED):
+            rep.engine.audit_pages()
+
+
+@pytest.mark.slow
+def test_supervisor_killed_mid_upgrade_cannot_wedge(model):
+    """The tentpole chaos claim: the roll's in-flight replica is
+    finalised by the ROUTER'S step loop even after the supervisor
+    stops ticking forever."""
+    base = _baseline(model, 10)
+    rt = _fleet(model, n=2)
+    sup = FleetSupervisor(rt, spawn=lambda: _engine(model),
+                          min_replicas=1, max_replicas=3,
+                          up_steps=10 ** 6, down_steps=10 ** 6)
+    inj = SupervisorChaos(sup, upgrade_at=2, kill_at=4,
+                          upgrade_src={"params": _same_params(rt)})
+    reqs = _workload(10)
+    run_fleet_chaos(rt, reqs, [inj])
+    assert inj.upgrade_started and inj.killed_at_step == 4
+    assert all(r.outcome is not None and r.outcome.ok for r in reqs)
+    for i, r in enumerate(reqs):
+        assert list(r.token_ids) == base[i]
+    assert_fleet_health_consistent(rt, reqs)
+    # no replica stranded DRAINING: the router finalised whatever the
+    # dead supervisor left mid-swap
+    for _ in range(6):
+        rt.step()
+    assert not any(r.state is ReplicaState.DRAINING
+                   for r in rt.replicas)
+    for rep in rt.replicas:
+        if rep.state is not ReplicaState.DEAD:
+            rep.engine.audit_pages()

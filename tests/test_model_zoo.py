@@ -55,7 +55,8 @@ def test_forward_small(name, size):
     assert np.isfinite(out.asnumpy()).all()
 
 
-def test_resnet18_hybridize_and_grad():
+@pytest.mark.slow   # 12s (round-21 tier-1 budget repair); ci
+def test_resnet18_hybridize_and_grad():  # stage_unit still runs it
     net = vision.get_model("resnet18_v1", classes=4)
     net.initialize()
     net.hybridize()
@@ -72,7 +73,9 @@ def test_resnet18_hybridize_and_grad():
     assert total > 0
 
 
-def test_vgg11_forward_224():
+@pytest.mark.slow   # 10s (round-21 tier-1 budget repair, like its
+def test_vgg11_forward_224():  # densenet sibling); ci stage_unit
+    # still runs it every time
     net = vision.get_model("vgg11", classes=3)
     net.initialize()
     out = net(nd.random.uniform(shape=(1, 3, 224, 224)))

@@ -299,7 +299,9 @@ def test_bert_seq_output_keeps_compute_dtype():
     assert pooled.dtype == "float32", pooled.dtype
 
 
+@pytest.mark.slow   # 18s (round-21 tier-1 budget repair); ci
 def test_bert_classifier_finetunes():
+    # stage_unit still runs it every time
     """BERTClassifier (GluonNLP finetune_classifier surface): logits
     shape and a few SPMD fine-tuning steps reduce the loss."""
     from incubator_mxnet_tpu.models import BERTClassifier

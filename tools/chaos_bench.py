@@ -1489,6 +1489,147 @@ def run_migrate_scenarios(n_requests, errors, n_replicas=2):
     return results
 
 
+def run_elastic_scenarios(n_requests, errors, n_replicas=3):
+    """Elastic-membership chaos (serve/fleet_supervisor.py +
+    Router.add/remove/upgrade_replica): the three transition races the
+    tentpole names — scale-down racing scale-up in the same fleet
+    pass, the supervisor process dying mid-rolling-upgrade, and
+    replica death landing mid-drain. Every scenario replays the same
+    greedy workload against a fresh fleet; the bar everywhere is the
+    migrate suite's, lifted to membership scope: every request ends in
+    EXACTLY ONE terminal outcome, survivors' streams stay
+    bit-identical to the fault-free baseline (membership churn is
+    invisible to a greedy stream under position-keyed sampling), no
+    replica's programs retrace, and every surviving — including
+    RETIRED — replica's pages audit clean after every router step."""
+    from incubator_mxnet_tpu.serve import (FleetSupervisor,
+                                           InferenceEngine)
+    from incubator_mxnet_tpu.serve.chaos import (DrainKill,
+                                                 ScaleDownRace,
+                                                 SupervisorChaos,
+                                                 run_fleet_chaos)
+    from incubator_mxnet_tpu.serve.router import ReplicaState
+    results = {}
+    vocab = 64
+    eng_kw = dict(num_slots=4, page_size=8, max_len=128,
+                  chunk_pages=1, prefix_cache=True)
+
+    def _spawn(model):
+        return lambda: InferenceEngine(model, **dict(eng_kw))
+
+    # ---- fault-free fleet baseline (the parity oracle) ------------- #
+    model = _build_model()
+    rt = _fleet(model, n_replicas, spec_k=0)
+    reqs = _make_requests(n_requests, vocab, seed=42)
+    t0 = time.perf_counter()
+    run_fleet_chaos(rt, reqs, [])
+    wall = time.perf_counter() - t0
+    baseline = [list(r.token_ids) for r in reqs]
+    stats = _check_fleet_invariants("elastic_baseline", rt, reqs,
+                                    baseline, set(), errors)
+    if not all(r.outcome is not None and r.outcome.ok for r in reqs):
+        errors.append("elastic_baseline: not every request succeeded")
+    stats["wall_s"] = wall
+    results["elastic_baseline"] = stats
+
+    # ---- scale-down racing scale-up (same fleet pass) -------------- #
+    model = _build_model()
+    rt = _fleet(model, n_replicas, spec_k=0)
+    reqs = _make_requests(n_requests, vocab, seed=42)
+    inj = ScaleDownRace(victim=n_replicas - 1, spawn=_spawn(model),
+                        at_step=4, seed=3)
+    run_fleet_chaos(rt, reqs, [inj])
+    stats = _check_fleet_invariants("scale_down_race", rt, reqs,
+                                    baseline, set(), errors)
+    if not inj.fired:
+        errors.append("scale_down_race: injector never fired")
+    if inj.added != n_replicas:
+        errors.append(f"scale_down_race: newcomer landed at index "
+                      f"{inj.added}, not the tombstone-stable "
+                      f"{n_replicas}")
+    for _ in range(6):
+        rt.step()                        # finalise the retirement
+    if rt.replicas[n_replicas - 1].state is not ReplicaState.RETIRED:
+        errors.append(f"scale_down_race: victim ended "
+                      f"{rt.replicas[n_replicas - 1].state}, not "
+                      f"RETIRED")
+    if not all(r.outcome is not None and r.outcome.ok for r in reqs):
+        errors.append("scale_down_race: a request was lost to the "
+                      "membership race")
+    stats.update(scale_ups=rt.scale_ups, scale_downs=rt.scale_downs,
+                 log=inj.log)
+    results["scale_down_race"] = stats
+
+    # ---- supervisor killed mid-rolling-upgrade --------------------- #
+    # the roll's in-flight replica must be finalised by the ROUTER'S
+    # own step loop after the supervisor stops ticking forever — a
+    # dead control plane may strand pending targets on old weights,
+    # never a replica in DRAINING
+    model = _build_model()
+    rt = _fleet(model, n_replicas, spec_k=0)
+    reqs = _make_requests(n_requests, vocab, seed=42)
+    sup = FleetSupervisor(rt, spawn=_spawn(model), min_replicas=1,
+                          max_replicas=n_replicas + 1,
+                          up_steps=10 ** 9, down_steps=10 ** 9)
+    src = {str(i): p.data().asnumpy() for i, p in
+           enumerate(rt.replicas[0].engine._eng_params)}
+    inj = SupervisorChaos(sup, upgrade_at=3, kill_at=6,
+                          upgrade_src={"params": src}, seed=3)
+    run_fleet_chaos(rt, reqs, [inj])
+    stats = _check_fleet_invariants("supervisor_kill_mid_upgrade", rt,
+                                    reqs, baseline, set(), errors)
+    if not inj.upgrade_started:
+        errors.append("supervisor_kill_mid_upgrade: the roll never "
+                      "started")
+    if inj.killed_at_step is None:
+        errors.append("supervisor_kill_mid_upgrade: the supervisor "
+                      "was never killed")
+    for _ in range(8):
+        rt.step()                        # router-owned finalisation
+    stuck = [rep.idx for rep in rt.replicas
+             if rep.state is ReplicaState.DRAINING]
+    if stuck:
+        errors.append(f"supervisor_kill_mid_upgrade: replicas {stuck} "
+                      f"stranded DRAINING — the router's drain tick "
+                      f"must not need the supervisor")
+    if rt.upgrades < 1:
+        errors.append("supervisor_kill_mid_upgrade: no replica "
+                      "finished its swap after the supervisor died")
+    if not all(r.outcome is not None and r.outcome.ok for r in reqs):
+        errors.append("supervisor_kill_mid_upgrade: a request was "
+                      "lost mid-roll")
+    stats.update(upgrades=rt.upgrades,
+                 supervisor=sup.snapshot(), log=inj.log)
+    results["supervisor_kill_mid_upgrade"] = stats
+
+    # ---- replica death mid-drain ----------------------------------- #
+    # whatever the drain had not migrated yet comes back through the
+    # death path's replay re-queue; DEAD wins over RETIRED
+    model = _build_model()
+    rt = _fleet(model, n_replicas, spec_k=0,
+                router_kw=dict(max_requeues=3))
+    reqs = _make_requests(n_requests, vocab, seed=42)
+    inj = DrainKill(victim=n_replicas - 1, at_step=4, kill_after=1,
+                    seed=3)
+    run_fleet_chaos(rt, reqs, [inj])
+    stats = _check_fleet_invariants("death_mid_drain", rt, reqs,
+                                    baseline, set(), errors)
+    if not inj.fired:
+        errors.append("death_mid_drain: injector never fired")
+    victim = rt.replicas[n_replicas - 1]
+    if inj.killed_mid_drain and victim.state is not ReplicaState.DEAD:
+        errors.append(f"death_mid_drain: killed victim ended "
+                      f"{victim.state} — DEAD must win over RETIRED")
+    if not all(r.outcome is not None and r.outcome.ok for r in reqs):
+        errors.append("death_mid_drain: a request was lost between "
+                      "the drain and the death")
+    stats.update(killed_mid_drain=inj.killed_mid_drain,
+                 scale_downs=rt.scale_downs, log=inj.log)
+    results["death_mid_drain"] = stats
+
+    return results
+
+
 # --------------------------------------------------------------------- #
 # SIGTERM mid-serve (subprocess scenario)
 # --------------------------------------------------------------------- #
@@ -1897,6 +2038,11 @@ def main():
                          "destination death mid-install, capsule crc "
                          "corruption, and the migrate-vs-cancel race "
                          "(ci/run.sh migratesmoke)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="elastic-membership scenarios — scale-down "
+                         "racing scale-up, supervisor killed "
+                         "mid-rolling-upgrade, replica death "
+                         "mid-drain (ci/run.sh elasticsmoke)")
     ap.add_argument("--replicas", type=int, default=2,
                     help="fleet size for --fleet scenarios")
     ap.add_argument("--spec-k", type=int, default=_SPEC_K,
@@ -1917,6 +2063,8 @@ def main():
     t0 = time.perf_counter()
     if args.frontend:
         results = run_frontend_scenarios(n, errors)
+    elif args.elastic:
+        results = run_elastic_scenarios(n, errors)
     elif args.migrate:
         results = run_migrate_scenarios(n, errors,
                                         n_replicas=args.replicas)
@@ -1944,10 +2092,11 @@ def main():
         print(f"banked {args.json}")
     if not errors:
         scope = "frontend" if args.frontend else \
-            ("migrate" if args.migrate else
+            ("elastic" if args.elastic else
+             ("migrate" if args.migrate else
              ("hier" if args.hier else
               ("tiers" if args.tiers else
-               ("fleet" if args.fleet else "chaos"))))
+               ("fleet" if args.fleet else "chaos")))))
         print(f"{scope}: all scenarios quiescent, isolated, audited, "
               f"compile-clean")
     sys.exit(0 if not errors else 1)

@@ -2350,6 +2350,234 @@ def bench_capsule_bytes(model, *, prompt_len, decode_steps, page_size,
     return out
 
 
+# --------------------------------------------------------------------- #
+# round-21: elastic fleet (--elastic, serve/fleet_supervisor.py) — banks
+# BENCH_ELASTIC.json
+# --------------------------------------------------------------------- #
+
+def _wave_arrivals(n, rate_hz, waves, gap_s, seed):
+    """``waves`` Poisson bursts of ``n//waves`` requests separated by
+    ``gap_s`` of silence — the offered-load shape autoscaling exists
+    for: a fixed fleet is sized for either the burst (idle waste in
+    the gaps) or the trough (brownout in the bursts), never both."""
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    arrivals = []
+    t = 0.0
+    per = max(n // waves, 1)
+    for w in range(waves):
+        for _ in range(per if w < waves - 1 else n - per * (waves - 1)):
+            t += float(rng.exponential(1.0 / rate_hz))
+            arrivals.append(t)
+        t += gap_s
+    return arrivals
+
+
+def bench_elastic_autoscale(model, *, n_requests, slots, page_size,
+                            rate_hz, waves, gap_s, up_steps,
+                            down_steps, max_replicas, window_s,
+                            errors, smoke):
+    """The SAME wave-load trace (mixed-tier, Poisson bursts separated
+    by idle gaps) against (a) a FIXED fleet pinned at min size and (b)
+    the same starting fleet under a ``FleetSupervisor`` allowed to
+    grow to ``max_replicas`` on sustained pressure and shrink back
+    once traffic subsides. The arrival gaps rarely idle the FLEET
+    (service is slower than arrival, so the backlog bridges them), so
+    after the waves complete the bench keeps the idle fleet ticking
+    through a bounded cooldown until the supervisor walks it back to
+    the floor. Banks per-tier completion p50/p99 for both arms plus
+    the autoscale arm's fleet-size timeline (the grow-on-burst /
+    shrink-on-quiet trace is the artifact). Asserts zero lost requests
+    in both arms, at least one scale-up AND one scale-down observed,
+    and per-replica compile discipline on every survivor."""
+    from incubator_mxnet_tpu.serve import (FleetSupervisor,
+                                           InferenceEngine, build_fleet)
+    vocab = model.vocab_size
+    eng_kw = dict(num_slots=slots, page_size=page_size, chunk_pages=1,
+                  prefix_cache=True)
+    classes, build, _ = _tiered_workload(n_requests, vocab, rate_hz,
+                                         seed=3)
+    arrivals = _wave_arrivals(n_requests, rate_hz, waves, gap_s,
+                              seed=11)
+    out = {"config": {"n_requests": n_requests, "slots": slots,
+                      "page_size": page_size, "rate_hz": rate_hz,
+                      "waves": waves, "gap_s": gap_s,
+                      "up_steps": up_steps, "down_steps": down_steps,
+                      "max_replicas": max_replicas}}
+    for arm in ("fixed", "autoscale"):
+        rt = build_fleet(model, 1, engine_kw=dict(eng_kw), seed=7)
+        wreqs = build(True)[:2]
+        rt.run(wreqs)                        # untimed compile warmup
+        reqs = build(True)
+        sup = None
+        if arm == "autoscale":
+            sup = FleetSupervisor(
+                rt, spawn=lambda: InferenceEngine(model,
+                                                  **dict(eng_kw)),
+                min_replicas=1, max_replicas=max_replicas,
+                up_steps=up_steps, down_steps=down_steps)
+        t0 = time.perf_counter()
+        timeline = []
+
+        def after(router, i, t0=t0, timeline=timeline, sup=sup):
+            if sup is not None:
+                sup.tick()
+            if i % 20 == 0:
+                timeline.append(
+                    {"t_s": round(time.perf_counter() - t0, 3),
+                     "fleet_size": len(router._alive()),
+                     "queue_depth": len(router._queue)})
+
+        rt.run(reqs, arrival_times=arrivals, after_step=after)
+        wall = time.perf_counter() - t0
+        cooldown_steps = 0
+        if sup is not None:
+            # traffic has subsided: keep the idle fleet ticking until
+            # the supervisor walks it back to the floor. Bounded — a
+            # wedged scale-down must FAIL the bench, not hang it.
+            guard = down_steps * (max_replicas + 2) + 2000
+            while len(rt._alive()) > 1 and cooldown_steps < guard:
+                rt.step()
+                sup.tick()
+                cooldown_steps += 1
+                if cooldown_steps % 20 == 0:
+                    timeline.append(
+                        {"t_s": round(time.perf_counter() - t0, 3),
+                         "fleet_size": len(rt._alive()),
+                         "queue_depth": len(rt._queue)})
+        bad = [r for r in reqs if r.outcome is None or not r.outcome.ok]
+        if bad:
+            errors.append(f"elastic_{arm}: {len(bad)} requests lost "
+                          f"(zero lost is the bar)")
+        _fleet_check_compile(f"elastic_{arm}", rt, errors)
+        lat, outcomes = _class_latencies(classes, reqs)
+        out[arm] = {
+            "wall_s": wall,
+            "tokens": sum(len(r.token_ids) for r in reqs),
+            "completion_by_tier": {
+                cls: {"p50_ms": _percentile(xs, 50) * 1e3,
+                      "p99_ms": _percentile(xs, 99) * 1e3,
+                      "n": len(xs)}
+                for cls, xs in sorted(lat.items())},
+            "outcomes_by_tier": outcomes,
+            "scale_ups": rt.scale_ups,
+            "scale_downs": rt.scale_downs,
+            "final_fleet_size": len(rt._alive()),
+            "timeline": timeline,
+        }
+        if arm == "autoscale":
+            out[arm]["supervisor"] = sup.snapshot()
+            out[arm]["cooldown_steps"] = cooldown_steps
+            if rt.scale_ups < 1:
+                errors.append("elastic_autoscale: the bursts never "
+                              "provoked a scale-up — retune the wave")
+            if rt.scale_downs < 1:
+                errors.append("elastic_autoscale: the quiet tail "
+                              "never provoked a scale-down — the "
+                              "supervisor is wedged or down_steps "
+                              "exceeds the cooldown guard")
+    return out
+
+
+def bench_elastic_upgrade(model, *, n_requests, prompt_len, max_new,
+                          slots, page_size, rate_hz, upgrade_after_step,
+                          errors, smoke):
+    """Rolling weight upgrade UNDER LOAD at N=2 vs an un-upgraded
+    control on the same workload and arrival trace. The roll swaps in
+    the SAME weights (the mechanism is under test, not the model), so
+    the bar is exact: zero lost requests, zero non-retryable failures,
+    and every survivor's greedy token stream bit-identical to the
+    control's. Banks the roll duration, per-replica warm restarts and
+    prefix flushes (the staggered-flush evidence), and completion
+    percentiles for both arms."""
+    from incubator_mxnet_tpu.serve import FleetSupervisor, build_fleet
+    vocab = model.vocab_size
+    eng_kw = dict(num_slots=slots, page_size=page_size, chunk_pages=1,
+                  prefix_cache=True)
+    out = {"config": {"n_requests": n_requests,
+                      "prompt_len": prompt_len, "max_new": max_new,
+                      "slots": slots, "page_size": page_size,
+                      "rate_hz": rate_hz,
+                      "upgrade_after_step": upgrade_after_step}}
+    tokens_by_arm = {}
+    for arm in ("control", "upgrade"):
+        rt = build_fleet(model, 2, engine_kw=dict(eng_kw), seed=7)
+        wreqs, _ = _make_requests(4, prompt_len, 4, rate_hz, vocab,
+                                  seed=99)
+        rt.run(wreqs)                        # untimed compile warmup
+        reqs, arrivals = _make_requests(n_requests, prompt_len,
+                                        max_new, rate_hz, vocab,
+                                        seed=42)
+        sup = FleetSupervisor(rt, spawn=lambda: None, min_replicas=1,
+                              max_replicas=2, up_steps=10 ** 9,
+                              down_steps=10 ** 9)
+        fired = {}
+        t0 = time.perf_counter()
+
+        def before(router, i, arm=arm, fired=fired, t0=t0):
+            if arm == "upgrade" and "t_s" not in fired \
+                    and i >= upgrade_after_step:
+                src = {str(j): p.data().asnumpy() for j, p in
+                       enumerate(router.replicas[0]
+                                 .engine._eng_params)}
+                sup.start_upgrade(params=src)
+                fired["t_s"] = time.perf_counter() - t0
+
+        def after(router, i, arm=arm, fired=fired, t0=t0):
+            sup.tick()
+            if arm == "upgrade" and "t_s" in fired \
+                    and "roll_s" not in fired \
+                    and sup.snapshot()["roll"] is None:
+                fired["roll_s"] = time.perf_counter() - t0 \
+                    - fired["t_s"]
+
+        rt.run(reqs, arrival_times=arrivals, before_step=before,
+               after_step=after)
+        # the roll can outlive the last request: idle steps finish it
+        guard = 0
+        while sup.snapshot()["roll"] is not None and guard < 2000:
+            rt.step()
+            sup.tick()
+            guard += 1
+        wall = time.perf_counter() - t0
+        bad = [r for r in reqs if r.outcome is None or not r.outcome.ok]
+        if bad:
+            errors.append(f"elastic_upgrade/{arm}: {len(bad)} requests "
+                          f"did not complete ok — an upgrade must "
+                          f"lose NOTHING")
+        comp = [r.finish_time - r.submit_time for r in reqs
+                if r.outcome is not None and r.outcome.ok]
+        tokens_by_arm[arm] = [list(r.token_ids) for r in reqs]
+        out[arm] = {
+            "wall_s": wall,
+            "tokens": sum(len(r.token_ids) for r in reqs),
+            "completion_p50_ms": _percentile(comp, 50) * 1e3,
+            "completion_p99_ms": _percentile(comp, 99) * 1e3,
+            "upgrades": rt.upgrades,
+            "warm_restarts": [rep.engine.warm_restarts
+                              for rep in rt.replicas],
+            "prefix_flushes": [rep.engine.prefix_flushes
+                               for rep in rt.replicas],
+            "outcomes": {o: cnt for o, cnt in
+                         rt.health_snapshot()["outcomes"].items()
+                         if cnt},
+        }
+        if arm == "upgrade":
+            out[arm]["upgrade_t_s"] = fired.get("t_s")
+            out[arm]["roll_duration_s"] = fired.get("roll_s")
+            if rt.upgrades != 2:
+                errors.append(f"elastic_upgrade: {rt.upgrades} "
+                              f"replicas swapped (want 2 — the roll "
+                              f"must walk the whole fleet)")
+    if tokens_by_arm.get("control") != tokens_by_arm.get("upgrade"):
+        errors.append("elastic_upgrade: token streams diverged across "
+                      "the roll — a same-weights upgrade must be "
+                      "bit-invisible to survivors")
+    out["token_parity"] = (tokens_by_arm.get("control") ==
+                           tokens_by_arm.get("upgrade"))
+    return out
+
+
 def _check_compile_discipline(tag, stats, errors):
     if stats["decode_trace_count"] != 1:
         errors.append(f"{tag}: decode step compiled "
@@ -2409,6 +2637,15 @@ def main():
                          "mixed, quantized vs raw capsule wire bytes) "
                          "— banks BENCH_MIGRATE.json; with --smoke "
                          "this is the migratesmoke CI stage")
+    ap.add_argument("--elastic", action="store_true",
+                    help="round-21 elastic-fleet workloads ONLY "
+                         "(wave-load completion p50 by tier with the "
+                         "autoscaling supervisor vs a fixed fleet, "
+                         "rolling same-weights upgrade under load vs "
+                         "an un-upgraded control: zero lost, streams "
+                         "bit-identical) — banks BENCH_ELASTIC.json; "
+                         "with --smoke this is half the elasticsmoke "
+                         "CI stage")
     ap.add_argument("--frontend", action="store_true",
                     help="round-18 HTTP/SSE front-end workloads ONLY "
                          "(protocol overhead vs direct Router.submit, "
@@ -2492,6 +2729,45 @@ def main():
         if out is None and not args.smoke:
             out = os.path.join(os.path.dirname(os.path.dirname(
                 os.path.abspath(__file__))), "BENCH_MIGRATE.json")
+        if out:
+            with open(out, "w") as f:
+                json.dump(result, f, indent=2)
+                f.write("\n")
+            print(f"banked {out}")
+        sys.exit(0 if not errors else 1)
+
+    if args.elastic:
+        model = _build(max_length=128)
+        if args.smoke:
+            au_cfg = dict(n_requests=12, slots=2, page_size=8,
+                          rate_hz=120.0, waves=2, gap_s=0.4,
+                          up_steps=2, down_steps=60, max_replicas=3,
+                          window_s=0.25)
+            up_cfg = dict(n_requests=10, prompt_len=12, max_new=12,
+                          slots=2, page_size=8, rate_hz=80.0,
+                          upgrade_after_step=4)
+        else:
+            au_cfg = dict(n_requests=48, slots=2, page_size=8,
+                          rate_hz=150.0, waves=3, gap_s=0.8,
+                          up_steps=3, down_steps=60, max_replicas=4,
+                          window_s=0.5)
+            up_cfg = dict(n_requests=32, prompt_len=24, max_new=24,
+                          slots=4, page_size=8, rate_hz=60.0,
+                          upgrade_after_step=10)
+        result = {"config": {"smoke": args.smoke,
+                             "backend": os.environ.get("JAX_PLATFORMS",
+                                                       "cpu")}}
+        result["autoscale_waves"] = bench_elastic_autoscale(
+            model, smoke=args.smoke, errors=errors, **au_cfg)
+        result["upgrade_under_load"] = bench_elastic_upgrade(
+            model, smoke=args.smoke, errors=errors, **up_cfg)
+        print(json.dumps(result, indent=2))
+        for e in errors:
+            print(f"FAIL: {e}", file=sys.stderr)
+        out = args.json
+        if out is None and not args.smoke:
+            out = os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "BENCH_ELASTIC.json")
         if out:
             with open(out, "w") as f:
                 json.dump(result, f, indent=2)
